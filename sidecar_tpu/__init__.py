@@ -1,0 +1,31 @@
+"""sidecar-tpu: a TPU-native service-discovery + gossip-simulation framework.
+
+A ground-up rebuild of the capabilities of WCC-Analytics/sidecar (a Go
+peer-to-peer service-discovery platform built on SWIM gossip) with a
+TPU-first architecture:
+
+* ``sidecar_tpu.ops``      — pure JAX kernels: LWW merge, gossip scatter,
+  TTL decay, topology builders. The reference's ``ServicesState.Merge`` /
+  ``AddServiceEntry`` (catalog/services_state.go:293-373) become a batched
+  scatter/segment-max over a peer-adjacency structure.
+* ``sidecar_tpu.models``   — simulation models built from the ops: the exact
+  record-level model and the large-scale bitmap model.
+* ``sidecar_tpu.parallel`` — device-mesh sharding (``jax.sharding`` +
+  ``shard_map``) for multi-chip simulation of 100k+-node clusters.
+* ``sidecar_tpu.sim``      — scenario runners (BASELINE.json configs),
+  convergence instrumentation, checkpointing, and the NumPy oracle used to
+  validate kernels against the Go reference's merge-loop semantics.
+* ``sidecar_tpu.catalog``  — the live replicated-state core (the analog of
+  the reference's catalog/ServicesState).
+* ``sidecar_tpu.discovery`` / ``health`` / ``proxy`` / ``http`` /
+  ``receiver`` — the live service-discovery surface: discovery plugins,
+  health monitor, HAProxy/Envoy drivers, HTTP API, event receiver library.
+* ``sidecar_tpu.transport`` — gossip wire transport (C++ core via ctypes).
+* ``sidecar_tpu.bridge``   — the Delegate-shaped simulation bridge
+  ("simulate N rounds over M nodes").
+
+The package is built out incrementally; a module listed above that does
+not import yet is simply not built yet — check the repo history.
+"""
+
+__version__ = "0.1.0"

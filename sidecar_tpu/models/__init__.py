@@ -1,0 +1,4 @@
+"""Simulation models assembled from the ops kernels."""
+
+from sidecar_tpu.models.timecfg import TimeConfig  # noqa: F401
+from sidecar_tpu.models.exact import ExactSim, SimParams, SimState  # noqa: F401

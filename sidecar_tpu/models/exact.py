@@ -1,0 +1,285 @@
+"""The exact record-level gossip simulation model.
+
+Cluster state is one packed int32 tensor ``known[N, M]``: node *n*'s
+current belief about every service slot *m* (M = N × services_per_node;
+slot *m* is owned by node ``m // services_per_node``).  This is the dense
+recast of the reference's ``Servers[hostname].Services[id]`` two-level map
+(catalog/services_state.go:70-80) — a node's row is its whole replicated
+catalog, and the owner's own cells double as its local truth (exactly as
+the reference keeps local services in the same state map).
+
+One simulated round = one GossipInterval (200 ms):
+
+1. **announce** — owners re-stamp their own records (discovery/health →
+   ``BroadcastServices``, services_state.go:525-574): every refresh
+   interval (1 min, staggered per node), plus every-second repeats for
+   records changed in the last few seconds (the ALIVE_COUNT=5× /
+   TOMBSTONE_COUNT=10× @ 1 Hz repeats, services_state.go:28-29 — each
+   repeat strictly newer, the +50 ns-skew trick of SendServices,
+   services_state.go:597-599).
+2. **gossip** — sample fan-out peers, take each node's top-``budget``
+   freshest records, scatter-merge into targets (ops/gossip.py).
+3. **push-pull** — every 20 s, full two-way anti-entropy with one random
+   peer (services_delegate.go:146-167).
+4. **sweep** — every 2 s, the lifespan/tombstone-GC sweep (ops/ttl.py).
+
+Everything is shape-static and scan-compatible; ``run`` drives N rounds
+under ``jax.lax.scan`` and reports a per-round convergence fraction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, is_known, pack, unpack_status, unpack_ts
+from sidecar_tpu.ops.topology import Topology
+from sidecar_tpu.ops.ttl import ttl_sweep
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SimState:
+    """Pytree carried through the round scan."""
+
+    known: jax.Array       # int32 [N, M] packed (ts<<3|status)
+    sent: jax.Array        # int8 [N, M] transmit counts (TransmitLimited queue)
+    node_alive: jax.Array  # bool [N] — cluster membership (churn/SWIM)
+    round_idx: jax.Array   # int32 scalar — completed rounds
+
+
+@dataclasses.dataclass(frozen=True)
+class SimParams:
+    """Static simulation parameters (hashable; safe to close over jit)."""
+
+    n: int                      # nodes
+    services_per_node: int = 10
+    fanout: int = 3             # gossip targets per round (memberlist GossipNodes)
+    budget: int = 15            # records per message batch (GossipMessages=15, config/config.go:46)
+    drop_prob: float = 0.0      # UDP loss fault injection
+    retransmit_limit: int = 0   # 0 = auto: RetransmitMult(4) × ⌈log10(n+1)⌉
+                                # transmissions per record version (memberlist
+                                # TransmitLimited semantics)
+
+    @property
+    def m(self) -> int:
+        return self.n * self.services_per_node
+
+    def resolved_retransmit_limit(self) -> int:
+        if self.retransmit_limit > 0:
+            return self.retransmit_limit
+        import math
+        return 4 * math.ceil(math.log10(self.n + 1))
+
+
+# A perturbation hook: (state, key, now_tick) -> state, applied before each
+# round. Scenario logic (service churn, node kill, partition toggling) goes
+# here so the core step stays pure protocol.
+PerturbFn = Callable[[SimState, jax.Array, jax.Array], SimState]
+
+
+class ExactSim:
+    """Single-chip exact simulator (multi-chip: ``sidecar_tpu.parallel``)."""
+
+    def __init__(self, params: SimParams, topo: Topology,
+                 timecfg: TimeConfig = TimeConfig(),
+                 perturb: Optional[PerturbFn] = None,
+                 cut_mask: Optional[np.ndarray] = None):
+        if topo.n != params.n:
+            raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
+        self.p = params
+        self.t = timecfg
+        self.topo = topo
+        self.perturb = perturb
+        if cut_mask is not None and topo.nbrs is None:
+            raise ValueError(
+                "cut_mask requires a neighbor-list topology (mesh/ring/ER/BA);"
+                " a complete graph has no edge structure to cut"
+            )
+        self._nbrs = None if topo.nbrs is None else jnp.asarray(topo.nbrs)
+        self._deg = None if topo.deg is None else jnp.asarray(topo.deg)
+        self._cut = None if cut_mask is None else jnp.asarray(cut_mask)
+        # owner[m] = node that announces slot m.
+        self.owner = jnp.arange(params.m, dtype=jnp.int32) // params.services_per_node
+
+    # -- state construction ------------------------------------------------
+
+    def init_state(self, live_fraction: float = 1.0, seed: int = 0) -> SimState:
+        """Cold start: every owner knows (only) its own services, announced
+        at tick 1 — the moment after cluster boot, before any gossip."""
+        p = self.p
+        known = jnp.zeros((p.n, p.m), dtype=jnp.int32)
+        rows = self.owner
+        cols = jnp.arange(p.m, dtype=jnp.int32)
+        vals = jnp.full((p.m,), pack(1, ALIVE), dtype=jnp.int32)
+        if live_fraction < 1.0:
+            rng = np.random.default_rng(seed)
+            live = jnp.asarray(rng.random(p.m) < live_fraction)
+            vals = jnp.where(live, vals, 0)
+        known = known.at[rows, cols].set(vals)
+        return SimState(
+            known=known,
+            sent=jnp.zeros((p.n, p.m), dtype=jnp.int8),
+            node_alive=jnp.ones((p.n,), dtype=bool),
+            round_idx=jnp.zeros((), jnp.int32),
+        )
+
+    # -- kernels -----------------------------------------------------------
+
+    def _announce(self, known, node_alive, round_idx, now_tick):
+        """Owners re-stamp their own live records on the refresh schedule.
+
+        This is ``BroadcastServices``'s 1-minute refresh path
+        (services_state.go:547-549), staggered per node.  The reference's
+        extra 5×/10× @ 1 Hz announce repeats (ALIVE_COUNT/TOMBSTONE_COUNT)
+        exist to keep a new record version in the gossip queue long enough
+        to be delivered; here the transmit-count queue provides exactly
+        that (a fresh version has ``sent == 0`` and stays eligible for
+        ~retransmit_limit/fanout rounds), so repeats need no re-stamping.
+        Tombstones are never refreshed — they age out via the 3 h GC.
+        """
+        p, t = self.p, self.t
+        own = known[self.owner, jnp.arange(p.m)]          # [M] owner's own cells
+        st = unpack_status(own)
+        present = is_known(own) & node_alive[self.owner]
+
+        phase = self.owner % t.refresh_rounds
+        refresh_due = (round_idx % t.refresh_rounds) == phase
+
+        due = refresh_due & present & (st != TOMBSTONE)
+        new_own = jnp.where(due, pack(now_tick, st), own)
+        return known.at[self.owner, jnp.arange(p.m)].set(new_own)
+
+    def _step(self, state: SimState, key: jax.Array) -> SimState:
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+        known, sent, node_alive = state.known, state.sent, state.node_alive
+
+        def reset_changed(sent, pre, post):
+            # A changed cell is a freshly-accepted/announced record version:
+            # re-enqueue it (transmit count 0) — the vectorized `retransmit`
+            # (services_state.go:377-392).
+            return jnp.where(post != pre, jnp.int8(0), sent)
+
+        pre = known
+        known = self._announce(known, node_alive, round_idx, now)
+        sent = reset_changed(sent, pre, known)
+
+        dst = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+        )
+        svc_idx, msg = gossip_ops.select_messages(known, sent, p.budget, limit)
+        sent = gossip_ops.record_transmissions(sent, svc_idx, msg, p.fanout, limit)
+        pre = known
+        known = gossip_ops.deliver(
+            known, dst, svc_idx, msg,
+            now_tick=now, stale_ticks=t.stale_ticks,
+            node_alive=node_alive,
+            drop_prob=p.drop_prob, drop_key=k_drop,
+        )
+        sent = reset_changed(sent, pre, known)
+
+        pre = known
+        pp_partner = gossip_ops.sample_peers(
+            k_pp, p.n, 1,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+        )[:, 0]
+        known = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            lambda kn: gossip_ops.push_pull(
+                kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
+                node_alive=node_alive),
+            lambda kn: kn,
+            known,
+        )
+        sent = reset_changed(sent, pre, known)
+
+        pre = known
+        known = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            lambda kn: ttl_sweep(
+                kn, now,
+                alive_lifespan=t.alive_lifespan,
+                draining_lifespan=t.draining_lifespan,
+                tombstone_lifespan=t.tombstone_lifespan,
+                one_second=t.one_second)[0],
+            lambda kn: kn,
+            known,
+        )
+        sent = reset_changed(sent, pre, known)
+
+        return SimState(known=known, sent=sent, node_alive=node_alive,
+                        round_idx=round_idx)
+
+    def convergence(self, state: SimState) -> jax.Array:
+        """Fraction of (alive-node, slot) cells agreeing with the global
+        freshest belief — 1.0 means every live node has converged."""
+        alive = state.node_alive
+        truth = jnp.max(jnp.where(alive[:, None], state.known, 0), axis=0)
+        agree = state.known == truth[None, :]
+        alive_f = alive.astype(jnp.float32)
+        per_node = jnp.mean(agree.astype(jnp.float32), axis=1)
+        return jnp.sum(per_node * alive_f) / jnp.maximum(jnp.sum(alive_f), 1.0)
+
+    # -- drivers -----------------------------------------------------------
+    # Public drivers validate the tick horizon against the *starting*
+    # round_idx (state is concrete between calls) before dispatching to the
+    # jitted implementations — a resumed/chunked simulation must not be
+    # able to silently run the int32 packed-key clock into the sign bit.
+
+    def _check_horizon(self, state: SimState, num_rounds: int) -> None:
+        self.t.validate_horizon(int(state.round_idx) + num_rounds)
+
+    def step(self, state: SimState, key: jax.Array) -> SimState:
+        self._check_horizon(state, 1)
+        return self._step_jit(state, key)
+
+    def run(self, state: SimState, key: jax.Array, num_rounds: int):
+        """Scan ``num_rounds`` gossip rounds; returns (final state,
+        per-round convergence fraction [num_rounds])."""
+        self._check_horizon(state, num_rounds)
+        return self._run_jit(state, key, num_rounds)
+
+    def run_fast(self, state: SimState, key: jax.Array, num_rounds: int):
+        """Scan without per-round metrics — the benchmark path."""
+        self._check_horizon(state, num_rounds)
+        return self._run_fast_jit(state, key, num_rounds)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_jit(self, state: SimState, key: jax.Array) -> SimState:
+        return self._step(state, key)
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _run_jit(self, state: SimState, key: jax.Array, num_rounds: int):
+        def body(st, k):
+            st = self._step(st, k)
+            return st, self.convergence(st)
+
+        keys = jax.random.split(key, num_rounds)
+        return lax.scan(body, state, keys)
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _run_fast_jit(self, state: SimState, key: jax.Array, num_rounds: int):
+        def body(st, k):
+            return self._step(st, k), None
+
+        keys = jax.random.split(key, num_rounds)
+        final, _ = lax.scan(body, state, keys)
+        return final

@@ -1,0 +1,100 @@
+"""Mapping of the reference's wall-clock protocol constants onto logical
+ticks.
+
+The reference keys every lifecycle decision off nanosecond wall clocks
+(service/service.go:39, catalog/services_state.go:26-37).  int64
+nanoseconds are hostile to TPU (emulated 64-bit, half scatter throughput),
+so the simulator uses an int32 **logical tick** clock: 1 tick = 1 ms by
+default, advancing ``round_ticks`` per gossip round.  All protocol
+constants are expressed in ticks, derived from the same wall-clock values
+the reference uses:
+
+=========================  =======================  =========================
+constant                   reference                default here
+=========================  =======================  =========================
+gossip interval            200 ms (config.go:47)    round_ticks = 200
+alive lifespan             80 s  (s_state.go:32)    80_000 ticks
+draining lifespan          10 min (s_state.go:33)   600_000 ticks
+tombstone retention        3 h   (s_state.go:27)    10_800_000 ticks
+staleness fudge            1 min (service.go:68-72) +60_000 ticks
+alive refresh broadcast    1 min (s_state.go:35)    every 300 rounds
+anti-entropy push-pull     20 s  (config.go:45)     every 100 rounds
+lifespan sweep cadence     2 s   (s_state.go:30)    every 10 rounds
+=========================  =======================  =========================
+
+The reference's 5×/10× @ 1 Hz announce repeats (ALIVE_COUNT /
+TOMBSTONE_COUNT, services_state.go:28-29) have no tick constant here: the
+simulator's transmit-count queue keeps a fresh record version eligible for
+~retransmit_limit/fanout rounds, which models the same delivery guarantee
+(see models/exact.py ``_announce``).
+
+int32 packed keys give 2^28-1 ticks of range (~74 h of simulated time at
+1 ms/tick) — enough for every BASELINE.json scenario with wide margin.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from sidecar_tpu.ops.status import MAX_TICK
+
+
+@dataclasses.dataclass(frozen=True)
+class TimeConfig:
+    ticks_per_second: int = 1000
+    round_ticks: int = 200            # GossipInterval 200 ms (config/config.go:47)
+    alive_lifespan_s: float = 80.0    # ALIVE_LIFESPAN (services_state.go:32)
+    draining_lifespan_s: float = 600.0  # DRAINING_LIFESPAN (:33)
+    tombstone_lifespan_s: float = 10800.0  # TOMBSTONE_LIFESPAN (:27)
+    staleness_fudge_s: float = 60.0   # clock-drift fudge (service/service.go:70-71)
+    refresh_interval_s: float = 60.0  # ALIVE_BROADCAST_INTERVAL (:35)
+    push_pull_interval_s: float = 20.0  # PushPullInterval (config/config.go:45)
+    sweep_interval_s: float = 2.0     # TOMBSTONE_SLEEP_INTERVAL (:30)
+
+    def ticks(self, seconds: float) -> int:
+        return int(round(seconds * self.ticks_per_second))
+
+    @property
+    def alive_lifespan(self) -> int:
+        return self.ticks(self.alive_lifespan_s)
+
+    @property
+    def draining_lifespan(self) -> int:
+        return self.ticks(self.draining_lifespan_s)
+
+    @property
+    def tombstone_lifespan(self) -> int:
+        return self.ticks(self.tombstone_lifespan_s)
+
+    @property
+    def stale_ticks(self) -> int:
+        """Merge-time staleness bound: tombstone lifespan + fudge
+        (services_state.go:302 + service/service.go:68-72)."""
+        return self.ticks(self.tombstone_lifespan_s + self.staleness_fudge_s)
+
+    @property
+    def one_second(self) -> int:
+        return self.ticks_per_second
+
+    def rounds(self, seconds: float) -> int:
+        """Number of gossip rounds in a wall-clock duration."""
+        return max(1, self.ticks(seconds) // self.round_ticks)
+
+    @property
+    def refresh_rounds(self) -> int:
+        return self.rounds(self.refresh_interval_s)
+
+    @property
+    def push_pull_rounds(self) -> int:
+        return self.rounds(self.push_pull_interval_s)
+
+    @property
+    def sweep_rounds(self) -> int:
+        return self.rounds(self.sweep_interval_s)
+
+    def validate_horizon(self, num_rounds: int) -> None:
+        if num_rounds * self.round_ticks > MAX_TICK:
+            raise ValueError(
+                f"{num_rounds} rounds x {self.round_ticks} ticks overflows the "
+                f"int32 packed-key tick range ({MAX_TICK}); use a coarser tick"
+            )

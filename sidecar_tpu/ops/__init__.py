@@ -1,0 +1,18 @@
+"""Pure JAX kernels for the gossip/merge compute path."""
+
+from sidecar_tpu.ops.status import (  # noqa: F401
+    ALIVE,
+    TOMBSTONE,
+    UNHEALTHY,
+    UNKNOWN,
+    DRAINING,
+    STATUS_BITS,
+    STATUS_MASK,
+    MAX_TICK,
+    pack,
+    unpack_ts,
+    unpack_status,
+    status_string,
+)
+from sidecar_tpu.ops.merge import merge_packed, merge_records  # noqa: F401
+from sidecar_tpu.ops.ttl import ttl_sweep  # noqa: F401

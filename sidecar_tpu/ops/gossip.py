@@ -1,0 +1,170 @@
+"""The gossip-round kernels: peer sampling, message selection, scatter
+delivery, and anti-entropy push-pull.
+
+This is the TPU recast of the reference's broadcast loop:
+
+* Peer selection — memberlist gossips each interval to randomly-selected
+  members (GossipNodes; configured main.go:239-274).  Here:
+  :func:`sample_peers` draws ``fanout`` targets per node, uniformly from
+  the full cluster (complete topology) or from a padded neighbor list.
+* Message selection — the reference drains a broadcast queue and packs
+  messages first-fit into one ~1398 B UDP packet (``GetBroadcasts`` +
+  ``packPacket``, services_delegate.go:85-144,182-223), so each round
+  carries a bounded number of the *freshest* records.  Here:
+  :func:`select_messages` takes the top-``budget`` packed keys per node —
+  freshest-first, because packed keys order by timestamp.  Records a node
+  just accepted have the newest timestamps, so epidemic relay
+  (``retransmit``, services_state.go:342-345,377-392) emerges from the
+  same top-k without explicit queues.
+* Delivery — one scatter-max over (target, service) cells, i.e. the
+  batched ``AddServiceEntry`` merge, followed by the DRAINING-stickiness
+  fixup (see ops/merge.py).
+* Anti-entropy — every PushPullInterval (20 s) each memberlist node does a
+  full two-way state exchange with one random peer
+  (services_delegate.go:146-167, main.go:252-256).  Here:
+  :func:`push_pull` gathers the partner's whole row (pull) and row-scatters
+  ours onto the partner (push), both through the LWW max-merge.
+
+Message loss is first-class fault injection: ``drop_prob`` zeroes a
+Bernoulli subset of messages pre-scatter (a zero packed key is a merge
+no-op), modeling UDP loss — which the reference's 5×/10× announce repeats
+(services_state.go:29,28) exist to beat.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sidecar_tpu.ops.merge import apply_stickiness, merge_packed, staleness_mask
+
+
+def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
+                 cut_mask=None):
+    """Sample ``fanout`` gossip targets per node.
+
+    Returns dst[int32 N, fanout].  Dead senders and cut edges resolve to
+    the sender's own index (a self-send is a merge no-op).
+
+    nbrs/deg: padded neighbor list (see ops/topology.py); None = complete
+    graph, sampled without self via the shift trick.
+    cut_mask: bool[N, K] marking partitioned-away edges.
+    """
+    self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    if nbrs is None:
+        if cut_mask is not None:
+            raise ValueError(
+                "cut_mask requires an explicit neighbor-list topology; a "
+                "complete graph has no edge structure to cut — build the "
+                "cluster on a mesh/ring/ER/BA topology to model partitions"
+            )
+        r = jax.random.randint(key, (n, fanout), 0, n - 1, dtype=jnp.int32)
+        dst = r + (r >= self_idx).astype(jnp.int32)
+    else:
+        slot = jax.random.randint(
+            key, (n, fanout), 0, jnp.maximum(deg, 1)[:, None], dtype=jnp.int32
+        )
+        dst = jnp.take_along_axis(nbrs, slot, axis=1)
+        if cut_mask is not None:
+            cut = jnp.take_along_axis(cut_mask, slot, axis=1)
+            dst = jnp.where(cut, self_idx, dst)
+    if node_alive is not None:
+        dst = jnp.where(node_alive[:, None], dst, self_idx)
+    return dst
+
+
+def select_messages(known, sent, budget, retransmit_limit):
+    """Top-``budget`` freshest *eligible* records per node.
+
+    The reference's broadcast queue (``GetBroadcasts`` draining
+    ``state.Broadcasts`` + pending leftovers into a ~1398 B packet,
+    services_delegate.go:85-144) holds only records that were recently
+    announced or relayed, and memberlist's TransmitLimited queue drops a
+    message after ``RetransmitMult × ⌈log10(n+1)⌉`` transmissions.  The
+    vectorized equivalent: a record is *eligible* while its transmit
+    count is below the retransmit limit; eligible records are offered
+    freshest-first (packed keys sort by timestamp), up to ``budget`` per
+    round.  Acceptance of a record resets its count to zero — that is the
+    re-enqueue performed by ``retransmit`` (services_state.go:377-392),
+    and it is what makes epidemic relay emerge.
+
+    Returns (svc_idx[N, B], msg[N, B]) — ``msg`` is 0 (merge no-op) in
+    slots where a node has fewer than ``budget`` eligible records.
+    """
+    eligible = sent < retransmit_limit
+    priority = jnp.where(eligible, known, 0)
+    msg, svc_idx = lax.top_k(priority, budget)
+    return svc_idx.astype(jnp.int32), msg
+
+
+def record_transmissions(sent, svc_idx, msg, fanout, retransmit_limit):
+    """Bump transmit counts for the records actually offered this round
+    (``fanout`` sends each), saturating at the retransmit limit."""
+    n = sent.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    bump = jnp.where(msg > 0, fanout, 0).astype(sent.dtype)
+    new = sent.at[rows, svc_idx].add(bump, mode="drop")
+    return jnp.minimum(new, retransmit_limit)
+
+
+def deliver(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
+            node_alive=None, drop_prob=0.0, drop_key=None):
+    """Scatter-merge every sender's message batch into its targets.
+
+    Each sender transmits its ``B`` selected records to each of its ``F``
+    targets; delivery is a single scatter-max over (target, service) cells
+    followed by the DRAINING-stickiness fixup — the batched equivalent of
+    one ``AddServiceEntry`` per received gossip message
+    (services_delegate.go:72-83 → services_state.go:293-347).
+
+    Returns the merged ``known``.
+    """
+    n, fanout = dst.shape
+    budget = svc_idx.shape[1]
+
+    val = jnp.broadcast_to(msg[:, None, :], (n, fanout, budget))
+    tgt = jnp.broadcast_to(dst[:, :, None], (n, fanout, budget))
+    svc = jnp.broadcast_to(svc_idx[:, None, :], (n, fanout, budget))
+
+    # Staleness gate (services_state.go:302-308).
+    val = jnp.where(staleness_mask(val, now_tick, stale_ticks), 0, val)
+
+    if node_alive is not None:
+        # Dead senders transmit nothing; dead receivers merge nothing.
+        val = jnp.where(node_alive[:, None, None], val, 0)
+        val = jnp.where(node_alive[tgt], val, 0)
+
+    if drop_prob > 0.0:
+        keep = jax.random.bernoulli(drop_key, 1.0 - drop_prob, val.shape)
+        val = jnp.where(keep, val, 0)
+
+    post = known.at[tgt, svc].max(val, mode="drop")
+    return apply_stickiness(known, post)
+
+
+def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None):
+    """Anti-entropy: each node initiates a full two-way state exchange with
+    one reachable peer (services_delegate.go:146-167).
+
+    ``partner[n]`` is the peer node *n* initiates with — callers sample it
+    with :func:`sample_peers` (fanout=1) so the exchange respects the
+    topology, network partitions (a split cuts TCP push-pull exactly as it
+    cuts UDP gossip), and dead nodes; ``partner[n] == n`` means no
+    exchange (all merges below are self-identities).
+
+    Pull: merge the partner's full row into ours (gather + elementwise
+    LWW merge).  Push: row-scatter our state onto the partner with the
+    same max combiner.
+    """
+    self_idx = jnp.arange(known.shape[0], dtype=jnp.int32)
+    if node_alive is not None:
+        partner = jnp.where(node_alive & node_alive[partner], partner, self_idx)
+
+    # Pull: our row ← partner's row.
+    pulled = merge_packed(known, known[partner], now_tick, stale_ticks)
+
+    # Push: partner's row ← our (pre-exchange) row.
+    offered = jnp.where(staleness_mask(known, now_tick, stale_ticks), 0, known)
+    pushed = pulled.at[partner].max(offered, mode="drop")
+    return apply_stickiness(pulled, pushed)

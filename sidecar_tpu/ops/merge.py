@@ -1,0 +1,99 @@
+"""The LWW merge kernel — the TPU recast of ``ServicesState.AddServiceEntry``.
+
+Reference semantics (catalog/services_state.go:293-347):
+
+1. *Staleness gate*: drop records older than the tombstone window plus a
+   1-minute clock-drift fudge (services_state.go:302-308 via
+   ``Service.IsStale``, service/service.go:68-72).
+2. *Strictly newer wins*: an incoming record replaces a known one only if
+   its timestamp is strictly greater (``Invalidates``,
+   service/service.go:64-66); unknown cells accept anything non-stale.
+3. *DRAINING stickiness*: when a newer ALIVE record lands on a cell
+   currently DRAINING, the timestamp advances but the status stays
+   DRAINING (services_state.go:329-331).
+
+Here the rule is applied to whole tensors of packed (ts<<3|status) keys at
+once: rule 2 is integer ``max`` (see ops/status.py for why), rules 1 and 3
+are masks.  ``merge_packed`` merges two aligned views (the anti-entropy
+push-pull path, services_delegate.go:146-167); the scatter-based delivery
+for fan-out gossip lives in ops/gossip.py and reuses ``apply_stickiness``.
+
+Known divergence from the Go loop: within a single batched delivery the
+reference processes messages sequentially, so a DRAINING record followed
+by a newer ALIVE record in the *same* batch sticks, while the reverse
+order does not — the outcome is order-dependent in the reference itself.
+The batched kernel resolves such races one consistent way (highest packed
+key wins, then stickiness vs. the pre-batch state).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from sidecar_tpu.ops.status import (
+    ALIVE,
+    DRAINING,
+    STATUS_BITS,
+    is_known,
+    pack,
+    unpack_status,
+    unpack_ts,
+)
+
+
+def staleness_mask(packed, now_tick, stale_ticks):
+    """True where a packed record is too old to merge.
+
+    ``stale_ticks`` should already include the reference's 1-minute fudge
+    (TOMBSTONE_LIFESPAN + 1 min, services_state.go:302 +
+    service/service.go:68-72).
+    """
+    ts = unpack_ts(packed)
+    return (ts > 0) & (ts < jnp.asarray(now_tick, jnp.int32) - jnp.asarray(stale_ticks, jnp.int32))
+
+
+def apply_stickiness(pre, post):
+    """Re-apply DRAINING stickiness after a max-merge.
+
+    For every cell where ``post`` advanced past ``pre`` and the transition
+    is DRAINING→ALIVE, keep the new timestamp but restore DRAINING
+    (services_state.go:329-331).
+    """
+    advanced = post > pre
+    sticky = (
+        advanced
+        & is_known(pre)
+        & (unpack_status(pre) == DRAINING)
+        & (unpack_status(post) == ALIVE)
+    )
+    return jnp.where(sticky, pack(unpack_ts(post), DRAINING), post)
+
+
+def merge_packed(known, incoming, now_tick, stale_ticks):
+    """Merge an aligned tensor of incoming packed records into ``known``.
+
+    This is the full-state anti-entropy merge (``MergeRemoteState`` →
+    ``state.Merge`` → per-record ``AddServiceEntry``,
+    services_delegate.go:153-167, services_state.go:367-373) vectorized:
+    ``incoming`` and ``known`` have the same shape, one cell per
+    (node, service) belief.
+
+    Returns the merged tensor.  Cells where ``incoming`` is unknown
+    (ts == 0) or stale are left untouched.
+    """
+    # Canonicalize: a ts==0 key is the unknown sentinel regardless of its
+    # status bits — never merge it.
+    incoming = jnp.where(is_known(incoming), incoming, 0)
+    incoming = jnp.where(staleness_mask(incoming, now_tick, stale_ticks), 0, incoming)
+    post = jnp.maximum(known, incoming)
+    return apply_stickiness(known, post)
+
+
+def merge_records(known_ts, known_status, inc_ts, inc_status, now_tick, stale_ticks):
+    """Unpacked-tensor variant of :func:`merge_packed` for callers that keep
+    separate ts/status tensors. Returns (ts, status, accepted-mask)."""
+    known = pack(known_ts, known_status)
+    incoming = pack(inc_ts, inc_status)
+    merged = merge_packed(known, incoming, now_tick, stale_ticks)
+    accepted = merged != known
+    return unpack_ts(merged), unpack_status(merged), accepted

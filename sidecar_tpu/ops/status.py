@@ -1,0 +1,86 @@
+"""Service status codes and the packed (timestamp, status) representation.
+
+Status codes mirror the reference enum (service/service.go:17-23):
+ALIVE, TOMBSTONE, UNHEALTHY, UNKNOWN, DRAINING.
+
+The simulator's unit of knowledge — "what does node *n* currently believe
+about service *m*" — is a single int32 **packed key**::
+
+    packed = (ts << STATUS_BITS) | status
+
+where ``ts`` is a logical-tick timestamp (the analog of the reference's
+nanosecond ``Service.Updated`` wall clock, service/service.go:39) and
+``status`` occupies the low 3 bits.  ``ts == 0`` is the *unknown* sentinel:
+a cell with ``packed < (1 << STATUS_BITS)`` means the node has never heard
+of the service (the reference models this as a missing map key,
+catalog/services_state.go:317).
+
+Why packed?  The merge rule is "accept iff strictly newer timestamp"
+(``Service.Invalidates``, service/service.go:64-66).  With timestamps in
+the high bits, that rule becomes integer ``max`` — so delivering a batch of
+gossip messages to their targets is one ``scatter-max``, which XLA lowers
+to an efficient combiner on TPU, and the per-cell status rides along for
+free.  Ties (equal ts) resolve toward the higher status code; the simulator
+gives every announced record version a distinct tick so ties only occur
+between copies of the *same* version, where the resolution is either
+harmless (identical payload) or actively correct (a DRAINING-stickied copy
+beats the plain ALIVE copy, matching catalog/services_state.go:329-331).
+
+Using int32 logical ticks instead of int64 nanoseconds is a deliberate
+TPU-first choice: int64 is emulated on TPU and would halve scatter
+throughput.  Wall-clock protocol constants (80 s alive lifespan, 3 h
+tombstone retention, ...) are expressed in ticks via
+``models.timecfg.TimeConfig``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Mirror of service/service.go:17-23.
+ALIVE = 0
+TOMBSTONE = 1
+UNHEALTHY = 2
+UNKNOWN = 3
+DRAINING = 4
+
+STATUS_BITS = 3
+STATUS_MASK = (1 << STATUS_BITS) - 1
+
+# Highest representable tick in a non-negative int32 packed key.
+MAX_TICK = (1 << (31 - STATUS_BITS)) - 1  # 268_435_455
+
+_STATUS_NAMES = {
+    ALIVE: "Alive",
+    TOMBSTONE: "Tombstone",
+    UNHEALTHY: "Unhealthy",
+    UNKNOWN: "Unknown",
+    DRAINING: "Draining",
+}
+
+
+def status_string(status: int) -> str:
+    """Human name for a status code (service/service.go:168-181)."""
+    return _STATUS_NAMES.get(int(status), "Tombstone")
+
+
+def pack(ts, status):
+    """Pack (logical tick, status) into an int32 key. ts=0 means unknown."""
+    ts = jnp.asarray(ts, jnp.int32)
+    status = jnp.asarray(status, jnp.int32)
+    return (ts << STATUS_BITS) | status
+
+
+def unpack_ts(packed):
+    """Logical tick of a packed key (0 = unknown sentinel)."""
+    return jnp.asarray(packed, jnp.int32) >> STATUS_BITS
+
+
+def unpack_status(packed):
+    """Status code of a packed key (meaningless when ts == 0)."""
+    return jnp.asarray(packed, jnp.int32) & STATUS_MASK
+
+
+def is_known(packed):
+    """True where the cell holds a real record (ts > 0)."""
+    return unpack_ts(packed) > 0

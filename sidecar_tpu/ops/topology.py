@@ -1,0 +1,151 @@
+"""Cluster topologies for the gossip simulator.
+
+The reference gossips to randomly-selected members of the full cluster
+(memberlist SWIM over UDP); the BASELINE.json validation configs also call
+for constrained graphs (ring, Erdős–Rényi, Barabási–Albert, partitioned
+mesh).  A :class:`Topology` is the peer-adjacency structure the gossip
+kernel samples fan-out targets from.
+
+Representation: a padded neighbor list ``nbrs[N, K]`` (int32) plus a
+degree vector ``deg[N]`` — sampling peer *i* of node *n* is
+``nbrs[n, randint(deg[n])]``, which keeps peer selection uniform over real
+neighbors without ragged shapes (static shapes are required under jit).
+The fully-connected ("complete") topology used by memberlist-style gossip
+is special-cased: peers are sampled directly from ``[0, N)`` with a
+self-exclusion shift, so no O(N²) structure is ever materialized.
+
+Builders run host-side in NumPy (topology construction is one-time setup,
+not the hot path) and return device-ready arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Peer-adjacency for N nodes.
+
+    ``nbrs`` is None for the complete graph.  ``cut_mask`` (optional,
+    bool[N, K]) marks edges disabled while a network partition is active
+    (the split+heal scenario, BASELINE.json config 5); the gossip kernel
+    treats a cut edge as a self-loop (no-op delivery).
+    """
+
+    n: int
+    nbrs: Optional[np.ndarray] = None  # int32 [N, K], padded with self-index
+    deg: Optional[np.ndarray] = None   # int32 [N]
+    name: str = "complete"
+
+    @property
+    def max_degree(self) -> int:
+        return 0 if self.nbrs is None else int(self.nbrs.shape[1])
+
+
+def complete(n: int) -> Topology:
+    """Fully-connected cluster — memberlist's random-member gossip."""
+    return Topology(n=n, name="complete")
+
+
+def _pad_neighbor_list(n: int, adj: list[list[int]], name: str) -> Topology:
+    deg = np.array([len(a) for a in adj], dtype=np.int32)
+    k = max(1, int(deg.max()))
+    nbrs = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, k))  # self-pad
+    for i, a in enumerate(adj):
+        if a:
+            nbrs[i, : len(a)] = np.asarray(a, dtype=np.int32)
+    return Topology(n=n, nbrs=nbrs, deg=deg, name=name)
+
+
+def ring(n: int, hops: int = 1) -> Topology:
+    """Ring lattice: each node linked to ``hops`` neighbors on each side
+    (BASELINE.json config 2 uses a 32-node ring)."""
+    offsets = [d for h in range(1, hops + 1) for d in (h, -h)]
+    nbrs = np.stack(
+        [(np.arange(n) + d) % n for d in offsets], axis=1
+    ).astype(np.int32)
+    deg = np.full(n, len(offsets), dtype=np.int32)
+    return Topology(n=n, nbrs=nbrs, deg=deg, name=f"ring{hops}")
+
+
+def erdos_renyi(n: int, avg_degree: float, seed: int = 0) -> Topology:
+    """Erdős–Rényi G(n, p) with p = avg_degree/(n-1) (config 3)."""
+    rng = np.random.default_rng(seed)
+    p = min(1.0, avg_degree / max(1, n - 1))
+    adj: list[list[int]] = [[] for _ in range(n)]
+    # Sample undirected edges in blocks of rows to bound memory.
+    block = max(1, min(n, 4_000_000 // max(n, 1) + 1))
+    for start in range(0, n, block):
+        stop = min(n, start + block)
+        rows = np.arange(start, stop)
+        mask = rng.random((stop - start, n)) < p
+        # Keep upper triangle only (i < j) to avoid double-sampling.
+        mask &= np.arange(n)[None, :] > rows[:, None]
+        for r, i in enumerate(rows):
+            for j in np.nonzero(mask[r])[0]:
+                adj[i].append(int(j))
+                adj[j].append(int(i))
+    return _pad_neighbor_list(n, adj, f"er{avg_degree:g}")
+
+
+def barabasi_albert(n: int, m: int, seed: int = 0) -> Topology:
+    """Barabási–Albert scale-free graph, m edges per new node (config 4)."""
+    rng = np.random.default_rng(seed)
+    adj: list[list[int]] = [[] for _ in range(n)]
+    # Degree-proportional attachment via the repeated-endpoint list.
+    repeated: list[int] = []
+    for v in range(m, n):
+        chosen = set()
+        while len(chosen) < min(m, v):
+            if repeated and rng.random() < 0.9:
+                cand = repeated[rng.integers(len(repeated))]
+            else:
+                cand = int(rng.integers(v))
+            chosen.add(cand)
+        for t in chosen:
+            adj[v].append(t)
+            adj[t].append(v)
+            repeated.extend((v, t))
+    return _pad_neighbor_list(n, adj, f"ba{m}")
+
+
+def mesh2d(rows: int, cols: int) -> Topology:
+    """2-D grid mesh with 4-neighbor connectivity (config 5's 1M-node
+    partitioned mesh is a split mesh2d)."""
+    n = rows * cols
+    idx = np.arange(n).reshape(rows, cols)
+    nbrs = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, 4))
+    deg = np.zeros(n, dtype=np.int32)
+
+    def add(src, dst):
+        nbrs[src, deg[src]] = dst
+        deg[src] += 1
+
+    for r in range(rows):
+        for c in range(cols):
+            i = idx[r, c]
+            if r > 0:
+                add(i, idx[r - 1, c])
+            if r < rows - 1:
+                add(i, idx[r + 1, c])
+            if c > 0:
+                add(i, idx[r, c - 1])
+            if c < cols - 1:
+                add(i, idx[r, c + 1])
+    return Topology(n=n, nbrs=nbrs, deg=deg, name=f"mesh{rows}x{cols}")
+
+
+def partition_mask(topo: Topology, side_of: np.ndarray) -> np.ndarray:
+    """Bool[N, K] mask of edges crossing a partition boundary.
+
+    ``side_of[n]`` assigns each node to a side; an edge is cut when its
+    endpoints differ.  Feed the result to the gossip kernel while the
+    split is active, then drop it to heal (config 5: 2-way split + heal).
+    """
+    if topo.nbrs is None:
+        raise ValueError("partition_mask requires an explicit neighbor list")
+    return side_of[topo.nbrs] != side_of[:, None]

@@ -1,0 +1,64 @@
+"""Record-lifespan sweep — the TPU recast of ``TombstoneOthersServices``.
+
+Reference semantics (catalog/services_state.go:635-683), applied by every
+node over its *entire* view (its own and everyone else's records):
+
+* Tombstones older than TOMBSTONE_LIFESPAN (3 h) are garbage-collected
+  (services_state.go:645-653; empty-server cleanup is implicit here — a
+  row of unknown cells simply contributes nothing).
+* Any non-tombstone record not refreshed within its lifespan —
+  ALIVE_LIFESPAN (80 s) normally, DRAINING_LIFESPAN (10 min) for draining
+  records (services_state.go:655-658) — is tombstoned **at its original
+  timestamp + 1 s**, not at now, so an unseen newer record still wins the
+  LWW race (the "+1 s rule", services_state.go:667-675).
+
+The reference runs this every TOMBSTONE_SLEEP_INTERVAL (2 s); the
+simulator invokes it on the equivalent round cadence.  Expired records get
+their timestamp bumped, which naturally pushes them into the node's top-k
+freshest records for rebroadcast — the vectorized analog of the 10×
+tombstone retransmit (services_state.go:620-624).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from sidecar_tpu.ops.status import (
+    DRAINING,
+    TOMBSTONE,
+    is_known,
+    pack,
+    unpack_status,
+    unpack_ts,
+)
+
+
+def ttl_sweep(known, now_tick, *, alive_lifespan, draining_lifespan,
+              tombstone_lifespan, one_second):
+    """Apply the lifespan sweep to a tensor of packed records.
+
+    Args:
+      known: int32 packed (ts<<3|status) tensor, any shape.
+      now_tick: current logical tick (scalar).
+      alive_lifespan / draining_lifespan / tombstone_lifespan / one_second:
+        durations in ticks (see models/timecfg.py for the mapping from the
+        reference's wall-clock constants).
+
+    Returns:
+      (swept, expired) — the updated tensor and a bool mask of cells that
+      were tombstoned by this sweep (for event accounting / metrics).
+    """
+    now_tick = jnp.asarray(now_tick, jnp.int32)
+    ts = unpack_ts(known)
+    st = unpack_status(known)
+    present = is_known(known)
+
+    is_tomb = present & (st == TOMBSTONE)
+    gc = is_tomb & (ts < now_tick - tombstone_lifespan)
+
+    lifespan = jnp.where(st == DRAINING, draining_lifespan, alive_lifespan)
+    expired = present & ~is_tomb & (ts < now_tick - lifespan)
+
+    swept = jnp.where(expired, pack(ts + one_second, TOMBSTONE), known)
+    swept = jnp.where(gc, 0, swept)
+    return swept, expired

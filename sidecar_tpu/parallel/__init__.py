@@ -1,0 +1,11 @@
+"""Multi-device execution: mesh construction + node-axis-sharded simulators.
+
+The reference scales by adding hosts to the gossip cluster (memberlist over
+UDP/TCP, SURVEY.md §2.3); the TPU build scales by sharding the *node axis*
+of the state tensors over a ``jax.sharding.Mesh`` and letting XLA place the
+cross-shard exchanges on ICI collectives — the scaling-book recipe: pick a
+mesh, annotate shardings, let XLA insert collectives.
+"""
+
+from sidecar_tpu.parallel.mesh import make_mesh, node_sharding  # noqa: F401
+from sidecar_tpu.parallel.sharded import ShardedSim  # noqa: F401

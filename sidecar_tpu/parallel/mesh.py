@@ -1,0 +1,33 @@
+"""Mesh + sharding helpers.
+
+One logical axis, ``nodes``: every state tensor (known[N, M], sent[N, M],
+node_alive[N]) is sharded along its leading node dimension; the service
+axis M is kept whole per shard so each node's row — its entire replicated
+catalog — lives on one device, exactly the data locality the reference has
+(one host's ``ServicesState`` on one machine).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D device mesh over the node axis (all visible devices by default)."""
+    devices = list(devices if devices is not None else jax.devices())
+    import numpy as np
+    return Mesh(np.asarray(devices), (NODE_AXIS,))
+
+
+def node_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [N, ...] tensors: leading axis split over the mesh."""
+    return NamedSharding(mesh, P(NODE_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

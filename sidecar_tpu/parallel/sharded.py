@@ -1,0 +1,363 @@
+"""Node-axis-sharded gossip simulator for multi-chip meshes.
+
+Scaling design (the project's analog of context parallelism, SURVEY.md §5
+"long-context"): the cluster-size axis N is sharded over the device mesh.
+Each device owns a contiguous block of nodes — a node's entire row
+(its full replicated catalog, the ``ServicesState`` of one host) stays
+device-local, so the per-round compute (announce, top-k selection,
+scatter-merge, TTL sweep) is embarrassingly parallel.
+
+Cross-device traffic, by construction, is only:
+
+* **Gossip messages** — each round's offers are budget-limited
+  (``fanout × budget`` packed keys per node, the ~1398 B-packet analog,
+  services_delegate.go:182-223), so an ``all_gather`` of the message
+  tensors is tiny; every shard then scatter-merges the subset of
+  deliveries targeting its own rows.  This mirrors reality: gossip
+  *messages* cross the network, state stays put.
+* **Anti-entropy** — instead of uniform-random partners (which would be a
+  full-row all-to-all), the sharded simulator uses a **random-stride ring
+  exchange**: each push-pull event draws one global stride s and every
+  node i does a two-way full-state exchange with node (i+s) mod N.
+  ``jnp.roll`` along the sharded axis lowers to an XLA collective-permute
+  riding ICI.  Random strides give expander-like mixing across events;
+  the divergence from memberlist's uniform partner choice
+  (services_delegate.go:146-167) is a deliberate scalability trade and is
+  visible only in the tail of convergence curves.
+
+Partitions: pass ``node_side`` (int[N] side assignment) — gossip edges are
+cut via ``cut_mask`` exactly as in the single-chip model, and the stride
+exchange is masked where the two sides differ (a network split severs TCP
+push-pull too).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from sidecar_tpu.models.exact import SimParams, SimState
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops.merge import apply_stickiness, merge_packed, staleness_mask
+from sidecar_tpu.ops.status import TOMBSTONE, is_known, pack, unpack_status
+from sidecar_tpu.ops.topology import Topology
+from sidecar_tpu.ops.ttl import ttl_sweep
+from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh
+
+
+class ShardedSim:
+    """Multi-device exact simulator; protocol semantics match ExactSim
+    except for the documented anti-entropy pairing."""
+
+    def __init__(self, params: SimParams, topo: Topology,
+                 timecfg: TimeConfig = TimeConfig(),
+                 mesh=None,
+                 cut_mask: Optional[np.ndarray] = None,
+                 node_side: Optional[np.ndarray] = None):
+        if topo.n != params.n:
+            raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
+        if cut_mask is not None and topo.nbrs is None:
+            raise ValueError("cut_mask requires a neighbor-list topology")
+        self.p = params
+        self.t = timecfg
+        self.topo = topo
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.d = self.mesh.devices.size
+        if params.n % self.d != 0:
+            raise ValueError(f"n={params.n} must divide the {self.d}-device mesh")
+
+        shard = NamedSharding(self.mesh, P(NODE_AXIS))
+        self._row_sharding = shard
+        self._nbrs = (None if topo.nbrs is None
+                      else jax.device_put(jnp.asarray(topo.nbrs), shard))
+        self._deg = (None if topo.deg is None
+                     else jax.device_put(jnp.asarray(topo.deg), shard))
+        self._cut = (None if cut_mask is None
+                     else jax.device_put(jnp.asarray(cut_mask), shard))
+        self._side = (None if node_side is None
+                      else jax.device_put(jnp.asarray(node_side, dtype=jnp.int32),
+                                          NamedSharding(self.mesh, P())))
+
+    # -- state -------------------------------------------------------------
+
+    def init_state(self) -> SimState:
+        p = self.p
+        owner = np.arange(p.m, dtype=np.int64) // p.services_per_node
+        known = np.zeros((p.n, p.m), dtype=np.int32)
+        known[owner, np.arange(p.m)] = int(pack(1, 0))  # ALIVE @ tick 1
+        shard = self._row_sharding
+        repl = NamedSharding(self.mesh, P())
+        return SimState(
+            known=jax.device_put(jnp.asarray(known), shard),
+            sent=jax.device_put(jnp.zeros((p.n, p.m), jnp.int8), shard),
+            node_alive=jax.device_put(jnp.ones((p.n,), bool), repl),
+            round_idx=jax.device_put(jnp.zeros((), jnp.int32), repl),
+        )
+
+    # -- the per-shard gossip round (inside shard_map) ---------------------
+
+    def _gossip_shard(self, known_l, sent_l, alive, key, round_idx):
+        """Announce + gossip + sweep for one shard's node block.
+        ``alive`` is the full (replicated) [N] liveness vector."""
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        s = p.services_per_node
+        nl = known_l.shape[0]
+        ax = lax.axis_index(NODE_AXIS)
+        r0 = (ax * nl).astype(jnp.int32)
+        now = round_idx * t.round_ticks
+
+        def reset_changed(sent, pre, post):
+            return jnp.where(post != pre, jnp.int8(0), sent)
+
+        # announce (owners of my rows' slots are exactly my rows)
+        lr = jnp.arange(nl * s, dtype=jnp.int32) // s
+        cols = r0 * s + jnp.arange(nl * s, dtype=jnp.int32)
+        own = known_l[lr, cols]
+        st = unpack_status(own)
+        present = is_known(own) & alive[r0 + lr]
+        phase = (r0 + lr) % t.refresh_rounds
+        due = ((round_idx % t.refresh_rounds) == phase) & present & (st != TOMBSTONE)
+        pre = known_l
+        known_l = known_l.at[lr, cols].set(jnp.where(due, pack(now, st), own))
+        sent_l = reset_changed(sent_l, pre, known_l)
+
+        # peer sampling (global dst indices), per-shard PRNG stream.
+        # This variant handles only the complete topology; neighbor-list
+        # topologies go through _gossip_shard_nbrs, which takes the sharded
+        # nbrs/deg blocks as shard_map operands.
+        key_shard = jax.random.fold_in(key, ax)
+        k_peers, k_drop = jax.random.split(key_shard)
+        gi = r0 + jnp.arange(nl, dtype=jnp.int32)      # my global node ids
+        r = jax.random.randint(k_peers, (nl, p.fanout), 0, p.n - 1,
+                               dtype=jnp.int32)
+        dst = r + (r >= gi[:, None]).astype(jnp.int32)
+        dst = jnp.where(alive[gi][:, None], dst, gi[:, None])
+
+        return self._gossip_tail(known_l, sent_l, alive, dst, gi, now,
+                                 k_drop, round_idx, limit)
+
+    def _gossip_shard_nbrs(self, known_l, sent_l, alive, nbrs_l, deg_l,
+                           cut_l, key, round_idx):
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        s = p.services_per_node
+        nl = known_l.shape[0]
+        ax = lax.axis_index(NODE_AXIS)
+        r0 = (ax * nl).astype(jnp.int32)
+        now = round_idx * t.round_ticks
+
+        def reset_changed(sent, pre, post):
+            return jnp.where(post != pre, jnp.int8(0), sent)
+
+        lr = jnp.arange(nl * s, dtype=jnp.int32) // s
+        cols = r0 * s + jnp.arange(nl * s, dtype=jnp.int32)
+        own = known_l[lr, cols]
+        st = unpack_status(own)
+        present = is_known(own) & alive[r0 + lr]
+        phase = (r0 + lr) % t.refresh_rounds
+        due = ((round_idx % t.refresh_rounds) == phase) & present & (st != TOMBSTONE)
+        pre = known_l
+        known_l = known_l.at[lr, cols].set(jnp.where(due, pack(now, st), own))
+        sent_l = reset_changed(sent_l, pre, known_l)
+
+        key_shard = jax.random.fold_in(key, ax)
+        k_peers, k_drop = jax.random.split(key_shard)
+        gi = r0 + jnp.arange(nl, dtype=jnp.int32)
+        slot = jax.random.randint(k_peers, (nl, p.fanout), 0,
+                                  jnp.maximum(deg_l, 1)[:, None], dtype=jnp.int32)
+        dst = jnp.take_along_axis(nbrs_l, slot, axis=1)
+        if cut_l is not None:
+            cut = jnp.take_along_axis(cut_l, slot, axis=1)
+            dst = jnp.where(cut, gi[:, None], dst)
+        dst = jnp.where(alive[gi][:, None], dst, gi[:, None])
+
+        return self._gossip_tail(known_l, sent_l, alive, dst, gi, now,
+                                 k_drop, round_idx, limit)
+
+    def _gossip_tail(self, known_l, sent_l, alive, dst, gi, now, k_drop,
+                     round_idx, limit):
+        """Select → all-gather messages → local scatter-merge → sweep."""
+        p, t = self.p, self.t
+        nl = known_l.shape[0]
+        ax = lax.axis_index(NODE_AXIS)
+        r0 = (ax * nl).astype(jnp.int32)
+
+        def reset_changed(sent, pre, post):
+            return jnp.where(post != pre, jnp.int8(0), sent)
+
+        svc_idx, msg = gossip_ops.select_messages(known_l, sent_l, p.budget, limit)
+        sent_l = gossip_ops.record_transmissions(sent_l, svc_idx, msg,
+                                                 p.fanout, limit)
+
+        # The only cross-shard gossip traffic: the message offers.
+        dst_all = lax.all_gather(dst, NODE_AXIS, tiled=True)        # [N, F]
+        svc_all = lax.all_gather(svc_idx, NODE_AXIS, tiled=True)    # [N, B]
+        msg_all = lax.all_gather(msg, NODE_AXIS, tiled=True)        # [N, B]
+
+        n_total, fanout = dst_all.shape
+        budget = svc_all.shape[1]
+        val = jnp.broadcast_to(msg_all[:, None, :], (n_total, fanout, budget))
+        tgt = jnp.broadcast_to(dst_all[:, :, None], (n_total, fanout, budget))
+        svc = jnp.broadcast_to(svc_all[:, None, :], (n_total, fanout, budget))
+
+        val = jnp.where(staleness_mask(val, now, t.stale_ticks), 0, val)
+        sender_alive = alive[jnp.arange(n_total)]
+        val = jnp.where(sender_alive[:, None, None], val, 0)
+        val = jnp.where(alive[tgt], val, 0)
+        if p.drop_prob > 0.0:
+            keep = jax.random.bernoulli(k_drop, 1.0 - p.drop_prob, val.shape)
+            val = jnp.where(keep, val, 0)
+
+        tgt_local = tgt - r0  # rows outside [0, nl) are dropped by the scatter
+        pre = known_l
+        post = known_l.at[tgt_local, svc].max(val, mode="drop")
+        known_l = apply_stickiness(pre, post)
+        sent_l = reset_changed(sent_l, pre, known_l)
+
+        # lifespan sweep (local)
+        pre = known_l
+        known_l = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            lambda kn: ttl_sweep(
+                kn, now,
+                alive_lifespan=t.alive_lifespan,
+                draining_lifespan=t.draining_lifespan,
+                tombstone_lifespan=t.tombstone_lifespan,
+                one_second=t.one_second)[0],
+            lambda kn: kn,
+            known_l,
+        )
+        sent_l = reset_changed(sent_l, pre, known_l)
+        return known_l, sent_l
+
+    # -- anti-entropy stride exchange (jit level, sharding-propagated) -----
+
+    def _push_pull_stride(self, known, sent, alive, key, now):
+        """Two-way full-state exchange with the node `stride` positions
+        away on the ring; jnp.roll on the sharded axis becomes an XLA
+        collective-permute."""
+        t = self.t
+        stride = jax.random.randint(key, (), 1, self.p.n, dtype=jnp.int32)
+
+        def exch(kn):
+            fwd = jnp.roll(kn, -stride, axis=0)   # row i sees row (i+s) mod N
+            return fwd
+
+        ok = alive & jnp.roll(alive, -stride)
+        if self._side is not None:
+            ok &= self._side == jnp.roll(self._side, -stride)
+        fwd = jnp.where(ok[:, None], exch(known), 0)
+        pulled = merge_packed(known, fwd, now, t.stale_ticks)
+
+        offered = jnp.where(staleness_mask(known, now, t.stale_ticks), 0, known)
+        ok_back = alive & jnp.roll(alive, stride)
+        if self._side is not None:
+            ok_back &= self._side == jnp.roll(self._side, stride)
+        back = jnp.where(ok_back[:, None], jnp.roll(offered, stride, axis=0), 0)
+        pushed = jnp.maximum(pulled, back)
+        merged = apply_stickiness(pulled, pushed)
+        sent = jnp.where(merged != known, jnp.int8(0), sent)
+        return merged, sent
+
+    # -- drivers -----------------------------------------------------------
+
+    def _step(self, state: SimState, key: jax.Array) -> SimState:
+        p, t = self.p, self.t
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_round, k_pp = jax.random.split(key)
+
+        spec_row = P(NODE_AXIS)
+        spec_repl = P()
+        if self._nbrs is None:
+            fn = shard_map(
+                self._gossip_shard,
+                mesh=self.mesh,
+                in_specs=(spec_row, spec_row, spec_repl, spec_repl, spec_repl),
+                out_specs=(spec_row, spec_row),
+                check_rep=False,
+            )
+            known, sent = fn(state.known, state.sent, state.node_alive,
+                             k_round, round_idx)
+        else:
+            cut = self._cut
+            def wrapper(kn, se, al, nb, dg, ct, k, r):
+                return self._gossip_shard_nbrs(kn, se, al, nb, dg, ct, k, r)
+            def wrapper_nocut(kn, se, al, nb, dg, k, r):
+                return self._gossip_shard_nbrs(kn, se, al, nb, dg, None, k, r)
+            if cut is not None:
+                fn = shard_map(
+                    wrapper, mesh=self.mesh,
+                    in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 3
+                             + (spec_repl, spec_repl),
+                    out_specs=(spec_row, spec_row), check_rep=False)
+                known, sent = fn(state.known, state.sent, state.node_alive,
+                                 self._nbrs, self._deg, cut, k_round, round_idx)
+            else:
+                fn = shard_map(
+                    wrapper_nocut, mesh=self.mesh,
+                    in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 2
+                             + (spec_repl, spec_repl),
+                    out_specs=(spec_row, spec_row), check_rep=False)
+                known, sent = fn(state.known, state.sent, state.node_alive,
+                                 self._nbrs, self._deg, k_round, round_idx)
+
+        known, sent = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            lambda kn_se: self._push_pull_stride(
+                kn_se[0], kn_se[1], state.node_alive, k_pp, now),
+            lambda kn_se: kn_se,
+            (known, sent),
+        )
+
+        return SimState(known=known, sent=sent, node_alive=state.node_alive,
+                        round_idx=round_idx)
+
+    def convergence(self, state: SimState) -> jax.Array:
+        alive = state.node_alive
+        truth = jnp.max(jnp.where(alive[:, None], state.known, 0), axis=0)
+        agree = state.known == truth[None, :]
+        alive_f = alive.astype(jnp.float32)
+        per_node = jnp.mean(agree.astype(jnp.float32), axis=1)
+        return jnp.sum(per_node * alive_f) / jnp.maximum(jnp.sum(alive_f), 1.0)
+
+    def step(self, state: SimState, key: jax.Array) -> SimState:
+        self.t.validate_horizon(int(state.round_idx) + 1)
+        return self._step_jit(state, key)
+
+    def run(self, state: SimState, key: jax.Array, num_rounds: int):
+        self.t.validate_horizon(int(state.round_idx) + num_rounds)
+        return self._run_jit(state, key, num_rounds)
+
+    def run_fast(self, state: SimState, key: jax.Array, num_rounds: int):
+        self.t.validate_horizon(int(state.round_idx) + num_rounds)
+        return self._run_fast_jit(state, key, num_rounds)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_jit(self, state, key):
+        return self._step(state, key)
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _run_jit(self, state, key, num_rounds):
+        def body(st, k):
+            st = self._step(st, k)
+            return st, self.convergence(st)
+        keys = jax.random.split(key, num_rounds)
+        return lax.scan(body, state, keys)
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _run_fast_jit(self, state, key, num_rounds):
+        def body(st, k):
+            return self._step(st, k), None
+        keys = jax.random.split(key, num_rounds)
+        final, _ = lax.scan(body, state, keys)
+        return final

@@ -1,0 +1,1 @@
+"""Scenario runners, convergence instrumentation, oracle, checkpointing."""

@@ -1,0 +1,220 @@
+"""Straight-line NumPy oracle for the gossip kernels.
+
+This is the test-time ground truth: a sequential, loop-based
+re-implementation of the reference's merge/sweep/anti-entropy semantics
+(catalog/services_state.go) operating on the same packed representation as
+the TPU kernels.  It deliberately mirrors the *Go control flow* — one
+record merged at a time, full-state exchanges done pairwise and in order —
+so that equivalence tests between the batched kernels and this oracle
+carry the same weight as the reference's own two-state merge tests
+(services_state_test.go:299-308), plus the convergence-over-rounds
+coverage the reference never had (SURVEY.md §4).
+
+Peer/message *sampling* is shared with the kernels (the oracle calls the
+same deterministic ``sample_peers`` / ``select_messages`` with the same
+PRNG keys); what the oracle re-implements independently is every state
+*transition*: announce scheduling, per-record LWW merge with stickiness
+and staleness, the lifespan sweep with the +1 s rule, and push-pull.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from sidecar_tpu.models.exact import ExactSim, SimState
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops.status import (
+    ALIVE,
+    DRAINING,
+    STATUS_BITS,
+    STATUS_MASK,
+    TOMBSTONE,
+)
+
+
+def _ts(p: int) -> int:
+    return p >> STATUS_BITS
+
+
+def _st(p: int) -> int:
+    return p & STATUS_MASK
+
+
+def _pack(ts: int, st: int) -> int:
+    return (int(ts) << STATUS_BITS) | int(st)
+
+
+class OracleSim:
+    """Sequential mirror of :class:`ExactSim`. Evolves its own NumPy state
+    using the same PRNG keys; `known` should match the kernel bit-for-bit
+    in scenarios without same-batch DRAINING races (see ops/merge.py)."""
+
+    def __init__(self, sim: ExactSim, state: SimState):
+        self.sim = sim
+        self.p = sim.p
+        self.t = sim.t
+        self.known = np.asarray(state.known).copy()
+        self.sent = np.asarray(state.sent).astype(np.int32).copy()
+        self.node_alive = np.asarray(state.node_alive).copy()
+        self.round_idx = int(state.round_idx)
+        self.owner = np.asarray(sim.owner)
+        self.limit = sim.p.resolved_retransmit_limit()
+
+    # -- the Go-faithful single-record merge (AddServiceEntry) -------------
+
+    def merge_one(self, node: int, svc: int, incoming: int, now: int) -> None:
+        """services_state.go:293-347, one record at a time."""
+        its, ist = _ts(incoming), _st(incoming)
+        if its == 0:
+            return
+        if its < now - self.t.stale_ticks:  # IsStale + fudge (:302-308)
+            return
+        cur = int(self.known[node, svc])
+        cts, cst = _ts(cur), _st(cur)
+        if cts == 0:  # unknown server/service: accept (:317-320)
+            self.known[node, svc] = incoming
+            self.sent[node, svc] = 0  # re-enqueue for relay (:377-392)
+            return
+        if its > cts:  # Invalidates: strictly newer (:321, service.go:64-66)
+            if cst == DRAINING and ist == ALIVE:  # sticky (:329-331)
+                ist = DRAINING
+            new = _pack(its, ist)
+            if new != cur:
+                self.known[node, svc] = new
+                self.sent[node, svc] = 0
+
+    # -- announce (BroadcastServices/SendServices schedule) ----------------
+
+    def announce(self, round_idx: int, now: int) -> None:
+        p, t = self.p, self.t
+        for m in range(p.m):
+            o = int(self.owner[m])
+            if not self.node_alive[o]:
+                continue
+            cur = int(self.known[o, m])
+            ts, st = _ts(cur), _st(cur)
+            if ts == 0 or st == TOMBSTONE:
+                continue
+            phase = o % t.refresh_rounds
+            if (round_idx % t.refresh_rounds) == phase:
+                new = _pack(now, st)
+                if new != cur:
+                    self.known[o, m] = new
+                    self.sent[o, m] = 0
+
+    # -- gossip delivery (sequential, Go-style) ----------------------------
+
+    def deliver(self, dst: np.ndarray, svc_idx: np.ndarray, msg: np.ndarray,
+                now: int, drop: np.ndarray | None = None) -> None:
+        n, fanout = dst.shape
+        budget = svc_idx.shape[1]
+        for s in range(n):
+            if not self.node_alive[s]:
+                continue
+            for f in range(fanout):
+                tgt = int(dst[s, f])
+                if not self.node_alive[tgt]:
+                    continue
+                for b in range(budget):
+                    if drop is not None and drop[s, f, b]:
+                        continue
+                    self.merge_one(tgt, int(svc_idx[s, b]), int(msg[s, b]), now)
+
+    # -- anti-entropy ------------------------------------------------------
+
+    def push_pull(self, partner: np.ndarray, now: int) -> None:
+        """Two-way full-state exchange per initiator (LocalState/
+        MergeRemoteState, services_delegate.go:146-167). All exchanged
+        payloads are read from the pre-exchange snapshot — in the kernel
+        every pull gathers and every push offers pre-round state, so the
+        oracle does the same to stay bit-identical."""
+        n = self.known.shape[0]
+        pre = self.known.copy()
+        for i in range(n):
+            t = int(partner[i])
+            if t == i:
+                continue
+            for m in range(self.known.shape[1]):
+                self.merge_one(i, m, int(pre[t, m]), now)   # pull
+            for m in range(self.known.shape[1]):
+                self.merge_one(t, m, int(pre[i, m]), now)   # push
+
+    # -- lifespan sweep ----------------------------------------------------
+
+    def sweep(self, now: int) -> None:
+        """TombstoneOthersServices per node (services_state.go:635-683)."""
+        t = self.t
+        n, m_tot = self.known.shape
+        for node in range(n):
+            for m in range(m_tot):
+                cur = int(self.known[node, m])
+                ts, st = _ts(cur), _st(cur)
+                if ts == 0:
+                    continue
+                if st == TOMBSTONE:
+                    if ts < now - t.tombstone_lifespan:
+                        self.known[node, m] = 0  # GC (:645-653)
+                        self.sent[node, m] = 0
+                    continue
+                lifespan = (t.draining_lifespan if st == DRAINING
+                            else t.alive_lifespan)
+                if ts < now - lifespan:
+                    # +1 s rule (:667-675); re-enqueue for the 10× rebroadcast
+                    self.known[node, m] = _pack(ts + t.one_second, TOMBSTONE)
+                    self.sent[node, m] = 0
+
+    # -- full round, mirroring ExactSim._step ------------------------------
+
+    def step(self, key: jax.Array) -> None:
+        p, t = self.p, self.t
+        self.round_idx += 1
+        now = self.round_idx * t.round_ticks
+        _k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        self.announce(self.round_idx, now)
+
+        dst = np.asarray(gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout,
+            nbrs=self.sim._nbrs, deg=self.sim._deg,
+            node_alive=jax.numpy.asarray(self.node_alive),
+            cut_mask=self.sim._cut,
+        ))
+        svc_idx, msg = gossip_ops.select_messages(
+            jax.numpy.asarray(self.known),
+            jax.numpy.asarray(self.sent.astype(np.int8)),
+            p.budget, self.limit)
+        svc_idx, msg = np.asarray(svc_idx), np.asarray(msg)
+        # Transmit accounting (TransmitLimited: fanout sends per offer).
+        for node in range(p.n):
+            for b in range(p.budget):
+                if msg[node, b] > 0:
+                    s = int(svc_idx[node, b])
+                    self.sent[node, s] = min(self.sent[node, s] + p.fanout,
+                                             self.limit)
+        drop = None
+        if p.drop_prob > 0:
+            keep = jax.random.bernoulli(
+                k_drop, 1.0 - p.drop_prob, (p.n, p.fanout, p.budget))
+            drop = ~np.asarray(keep)
+        self.deliver(dst, svc_idx, msg, now, drop)
+
+        if self.round_idx % t.push_pull_rounds == 0:
+            partner = np.asarray(gossip_ops.sample_peers(
+                k_pp, p.n, 1,
+                nbrs=self.sim._nbrs, deg=self.sim._deg,
+                node_alive=jax.numpy.asarray(self.node_alive),
+                cut_mask=self.sim._cut,
+            ))[:, 0]
+            alive = self.node_alive
+            partner = np.where(alive & alive[partner], partner, np.arange(p.n))
+            self.push_pull(partner, now)
+
+        if self.round_idx % t.sweep_rounds == 0:
+            self.sweep(now)
+
+    def convergence(self) -> float:
+        alive = self.node_alive
+        truth = np.max(np.where(alive[:, None], self.known, 0), axis=0)
+        agree = (self.known == truth[None, :]).mean(axis=1)
+        return float((agree * alive).sum() / max(alive.sum(), 1))

@@ -1,0 +1,14 @@
+"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax
+imports, so sharding tests exercise a real multi-device mesh without TPU
+hardware (the driver's dryrun_multichip uses the same mechanism)."""
+
+import os
+
+# Must override, not setdefault: the environment exports JAX_PLATFORMS=axon
+# (the real TPU tunnel), and tests must never compete for the single chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,133 @@
+"""Unit + property tests for the LWW merge kernel — the TPU analog of the
+reference's merge tests (services_state_test.go: AddServiceEntry cases)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sidecar_tpu.ops import (
+    ALIVE,
+    DRAINING,
+    TOMBSTONE,
+    UNHEALTHY,
+    UNKNOWN,
+    merge_packed,
+    pack,
+    unpack_status,
+    unpack_ts,
+)
+from sidecar_tpu.ops.status import STATUS_BITS, STATUS_MASK
+
+NOW = 1_000_000
+# Staleness threshold: records with ts < NOW - STALE are dropped. Chosen so
+# the small ts values used in these tests (100, 200, ...) are NOT stale;
+# explicit staleness tests use ts below NOW - STALE.
+STALE = NOW - 10
+
+
+def mp(known, incoming):
+    return merge_packed(jnp.asarray(known, jnp.int32),
+                        jnp.asarray(incoming, jnp.int32), NOW, STALE)
+
+
+def key(ts, st):
+    return int(pack(ts, st))
+
+
+class TestPacking:
+    def test_roundtrip(self):
+        p = pack(12345, DRAINING)
+        assert int(unpack_ts(p)) == 12345
+        assert int(unpack_status(p)) == DRAINING
+
+    def test_unknown_sentinel_is_zero_ts(self):
+        assert int(unpack_ts(jnp.int32(0))) == 0
+
+    def test_packed_orders_by_timestamp_first(self):
+        assert key(10, ALIVE) > key(9, DRAINING)
+        assert key(10, DRAINING) > key(10, ALIVE)
+
+
+class TestMergeSemantics:
+    """AddServiceEntry rules, catalog/services_state.go:293-347."""
+
+    def test_unknown_cell_accepts_anything(self):
+        out = mp([0], [key(NOW - 5, TOMBSTONE)])
+        assert int(out[0]) == key(NOW - 5, TOMBSTONE)
+
+    def test_strictly_newer_wins(self):
+        out = mp([key(100, ALIVE)], [key(101, TOMBSTONE)])
+        assert int(out[0]) == key(101, TOMBSTONE)
+
+    def test_older_rejected(self):
+        out = mp([key(101, TOMBSTONE)], [key(100, ALIVE)])
+        assert int(out[0]) == key(101, TOMBSTONE)
+
+    def test_equal_ts_keeps_existing_alive_vs_tombstone(self):
+        # Invalidates() requires strictly newer (service/service.go:64-66):
+        # equal-ts TOMBSTONE must not displace ALIVE.
+        out = mp([key(100, TOMBSTONE)], [key(100, ALIVE)])
+        assert int(out[0]) == key(100, TOMBSTONE)
+
+    def test_stale_record_dropped_even_on_unknown_cell(self):
+        # services_state.go:302-308
+        stale_ts = NOW - STALE - 1
+        out = mp([0], [key(stale_ts, ALIVE)])
+        assert int(out[0]) == 0
+
+    def test_just_inside_staleness_window_accepted(self):
+        ts = NOW - STALE
+        out = mp([0], [key(ts, ALIVE)])
+        assert int(out[0]) == key(ts, ALIVE)
+
+    def test_draining_sticky_vs_newer_alive(self):
+        # services_state.go:329-331: ts advances, status stays DRAINING.
+        out = mp([key(100, DRAINING)], [key(200, ALIVE)])
+        assert int(unpack_ts(out[0])) == 200
+        assert int(unpack_status(out[0])) == DRAINING
+
+    def test_draining_not_sticky_vs_newer_tombstone(self):
+        out = mp([key(100, DRAINING)], [key(200, TOMBSTONE)])
+        assert int(out[0]) == key(200, TOMBSTONE)
+
+    def test_draining_not_sticky_vs_newer_unhealthy(self):
+        out = mp([key(100, DRAINING)], [key(200, UNHEALTHY)])
+        assert int(out[0]) == key(200, UNHEALTHY)
+
+    def test_unknown_incoming_is_noop(self):
+        out = mp([key(100, ALIVE)], [0])
+        assert int(out[0]) == key(100, ALIVE)
+
+
+class TestMergeVsOracle:
+    """Randomized elementwise equivalence against the sequential oracle
+    merge (sim/oracle.py merge_one semantics, aligned-view case)."""
+
+    def _oracle_cell(self, cur, inc):
+        its, ist = inc >> STATUS_BITS, inc & STATUS_MASK
+        if its == 0 or its < NOW - STALE:
+            return cur
+        cts, cst = cur >> STATUS_BITS, cur & STATUS_MASK
+        if cts == 0:
+            return inc
+        if its > cts:
+            if cst == DRAINING and ist == ALIVE:
+                ist = DRAINING
+            return (its << STATUS_BITS) | ist
+        return cur
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_tensors(self, seed):
+        rng = np.random.default_rng(seed)
+        shape = (64, 37)
+        def rand_packed():
+            ts = rng.integers(0, NOW + 10, shape)
+            ts = np.where(rng.random(shape) < 0.2, 0, ts)  # some unknowns
+            st = rng.integers(0, 5, shape)
+            packed = (ts << STATUS_BITS) | st
+            return np.where(ts == 0, 0, packed).astype(np.int32)  # canonical unknown
+
+        known, incoming = rand_packed(), rand_packed()
+        got = np.asarray(mp(known, incoming))
+        want = np.vectorize(self._oracle_cell)(known, incoming).astype(np.int32)
+        np.testing.assert_array_equal(got, want)

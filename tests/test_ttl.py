@@ -1,0 +1,80 @@
+"""Tests for the lifespan sweep kernel (TombstoneOthersServices semantics,
+catalog/services_state.go:635-683)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import ALIVE, DRAINING, TOMBSTONE, UNKNOWN, pack, ttl_sweep
+from sidecar_tpu.ops.status import STATUS_BITS, STATUS_MASK
+
+T = TimeConfig()
+
+
+def sweep(cells, now):
+    out, expired = ttl_sweep(
+        jnp.asarray(cells, jnp.int32), now,
+        alive_lifespan=T.alive_lifespan,
+        draining_lifespan=T.draining_lifespan,
+        tombstone_lifespan=T.tombstone_lifespan,
+        one_second=T.one_second,
+    )
+    return np.asarray(out), np.asarray(expired)
+
+
+def key(ts, st):
+    return int(pack(ts, st))
+
+
+def test_fresh_alive_untouched():
+    now = T.ticks(100)
+    out, exp = sweep([key(now - T.ticks(10), ALIVE)], now)
+    assert out[0] == key(now - T.ticks(10), ALIVE)
+    assert not exp[0]
+
+
+def test_alive_expires_after_80s_with_plus_one_second_rule():
+    now = T.ticks(1000)
+    ts = now - T.alive_lifespan - 1
+    out, exp = sweep([key(ts, ALIVE)], now)
+    # Tombstoned at original ts + 1 s, NOT at now (services_state.go:667-675).
+    assert out[0] == key(ts + T.one_second, TOMBSTONE)
+    assert exp[0]
+
+
+def test_draining_uses_10min_lifespan():
+    now = T.ticks(1000)
+    ts = now - T.alive_lifespan - 1  # old enough for alive, not for draining
+    out, _ = sweep([key(ts, DRAINING)], now)
+    assert out[0] == key(ts, DRAINING)
+
+    ts2 = now - T.draining_lifespan - 1
+    out2, _ = sweep([key(ts2, DRAINING)], now)
+    assert out2[0] == key(ts2 + T.one_second, TOMBSTONE)
+
+
+def test_unhealthy_and_unknown_status_expire_like_alive():
+    now = T.ticks(1000)
+    ts = now - T.alive_lifespan - 1
+    for st in (2, UNKNOWN):  # UNHEALTHY, UNKNOWN
+        out, _ = sweep([key(ts, st)], now)
+        assert out[0] == key(ts + T.one_second, TOMBSTONE)
+
+
+def test_tombstone_gc_after_3h():
+    now = T.ticks(4 * 3600)
+    ts = now - T.tombstone_lifespan - 1
+    out, _ = sweep([key(ts, TOMBSTONE)], now)
+    assert out[0] == 0  # cell cleared (services_state.go:645-653)
+
+
+def test_recent_tombstone_kept():
+    now = T.ticks(4 * 3600)
+    ts = now - T.tombstone_lifespan + T.one_second
+    out, _ = sweep([key(ts, TOMBSTONE)], now)
+    assert out[0] == key(ts, TOMBSTONE)
+
+
+def test_unknown_cells_untouched():
+    out, exp = sweep([0], T.ticks(10_000))
+    assert out[0] == 0 and not exp[0]
