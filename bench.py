@@ -1,0 +1,72 @@
+"""Headline benchmark: simulated gossip rounds/sec/chip.
+
+The reference runs gossip in real time — one round per GossipInterval
+(200 ms, config/config.go:47), i.e. 5 rounds/sec regardless of hardware.
+The TPU framework's whole point is to run the same broadcast→merge
+protocol as batched on-chip compute, so the headline metric is how many
+full cluster-wide gossip rounds one chip simulates per second, and
+``vs_baseline`` is the speedup over the reference's 5 rounds/sec
+wall-clock rate (BASELINE.md north-star table).
+
+Default config: 4,096-node Erdős–Rényi-class cluster (BASELINE.json
+config 3 scale) with 10 services/node — 4096 × 40,960 packed-int32 state
+(~670 MB), fanout 3, budget 15.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    # Keep the virtual-CPU test config out of the way: bench runs on
+    # whatever real platform the driver provides.
+    import jax
+
+    from sidecar_tpu.models.exact import ExactSim, SimParams
+    from sidecar_tpu.ops.topology import complete
+
+    n = int(os.environ.get("BENCH_NODES", "4096"))
+    spn = int(os.environ.get("BENCH_SERVICES_PER_NODE", "10"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "200"))
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and "BENCH_NODES" not in os.environ:
+        # CPU fallback (no TPU attached): shrink so the bench still runs.
+        n, rounds = 512, 50
+
+    params = SimParams(n=n, services_per_node=spn, fanout=3, budget=15)
+    sim = ExactSim(params, complete(n))
+    state = sim.init_state()
+    key = jax.random.PRNGKey(0)
+
+    # Warm-up: compile + one short run.  Sync via device_get — on remote
+    # TPU platforms block_until_ready can return before execution ends.
+    warm = sim.run_fast(state, key, rounds)
+    jax.device_get(warm.known[0, :4])
+
+    t0 = time.perf_counter()
+    final = sim.run_fast(state, key, rounds)
+    jax.device_get(final.known[0, :4])
+    dt = time.perf_counter() - t0
+
+    rounds_per_sec = rounds / dt
+    # Reference wall-clock rate: 1 round / 200 ms gossip interval.
+    baseline_rounds_per_sec = 5.0
+
+    print(json.dumps({
+        "metric": f"simulated gossip rounds/sec/chip (n={n}, spn={spn}, {platform})",
+        "value": round(rounds_per_sec, 3),
+        "unit": "rounds/sec/chip",
+        "vs_baseline": round(rounds_per_sec / baseline_rounds_per_sec, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

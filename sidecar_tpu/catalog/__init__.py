@@ -1,0 +1,22 @@
+"""The replicated-state core: the live analog of the reference's
+``catalog`` package (ServicesState, catalog/services_state.go)."""
+
+from sidecar_tpu.catalog.state import (
+    ALIVE_BROADCAST_INTERVAL,
+    ALIVE_COUNT,
+    ChangeEvent,
+    LISTENER_EVENT_BUFFER_SIZE,
+    Listener,
+    QueueListener,
+    Server,
+    ServicesState,
+    TOMBSTONE_COUNT,
+    decode,
+    decode_stream,
+)
+
+__all__ = [
+    "ChangeEvent", "Server", "ServicesState", "Listener", "QueueListener",
+    "decode", "decode_stream", "ALIVE_COUNT", "TOMBSTONE_COUNT",
+    "ALIVE_BROADCAST_INTERVAL", "LISTENER_EVENT_BUFFER_SIZE",
+]

@@ -1,0 +1,613 @@
+"""The replicated service catalog — eventual-consistency state core.
+
+Capability mirror of the reference's ``catalog.ServicesState``
+(catalog/services_state.go): a two-level map ``servers[hostname] →
+services[id] → Service`` with latest-timestamp-wins merge semantics,
+change-event fan-out to listeners, and the broadcast/tombstone lifecycle
+loops.  Wire format (JSON field names, RFC3339-ns timestamps) matches the
+Go implementation so mixed clusters and existing downstream consumers
+keep working.
+
+Concurrency model: one re-entrant lock around the state (the reference
+uses one RWMutex, services_state.go:79), a single-writer message queue
+(``service_msgs``; services_state.go:127-140), and bounded per-listener
+queues with non-blocking delivery (services_state.go:217-240).  All
+background loops take a ``Looper`` so tests drive them deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+from sidecar_tpu import service as svc_mod
+from sidecar_tpu.output import time_ago
+from sidecar_tpu.runtime.looper import Looper, TimedLooper
+from sidecar_tpu.service import (
+    ALIVE_LIFESPAN,
+    DRAINING_LIFESPAN,
+    NS_PER_SECOND,
+    Service,
+    TOMBSTONE,
+    TOMBSTONE_LIFESPAN,
+    UNKNOWN,
+    ns_to_rfc3339,
+    rfc3339_to_ns,
+)
+
+log = logging.getLogger(__name__)
+
+# Lifecycle constants (catalog/services_state.go:26-37).
+TOMBSTONE_COUNT = 10           # tombstone announce repetitions @ 1 Hz
+ALIVE_COUNT = 5                # new-service announce repetitions @ 1 Hz
+TOMBSTONE_SLEEP_INTERVAL = 2.0
+TOMBSTONE_RETRANSMIT = 1.0
+ALIVE_SLEEP_INTERVAL = 1.0
+ALIVE_BROADCAST_INTERVAL = 60.0
+LISTENER_EVENT_BUFFER_SIZE = 20
+SERVICE_MSGS_BUFFER = 25       # NewServicesState (services_state.go:95)
+
+
+@dataclasses.dataclass
+class ChangeEvent:
+    """A major state transition (catalog/services_state.go:42-46)."""
+
+    service: Service
+    previous_status: int
+    time: int  # ns since epoch
+
+    def to_json(self) -> dict:
+        return {"Service": self.service.to_json(),
+                "PreviousStatus": self.previous_status,
+                "Time": ns_to_rfc3339(self.time)}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ChangeEvent":
+        return cls(service=Service.from_json(d.get("Service") or {}),
+                   previous_status=int(d.get("PreviousStatus", UNKNOWN)),
+                   time=_ts(d.get("Time")))
+
+
+def _ts(v) -> int:
+    if v is None:
+        return 0
+    if isinstance(v, (int, float)):
+        return int(v)
+    return rfc3339_to_ns(v)
+
+
+class Listener:
+    """Receives ChangeEvents on a bounded queue
+    (catalog.Listener interface, services_state.go:83-87)."""
+
+    def chan(self) -> "queue.Queue[ChangeEvent]":
+        raise NotImplementedError
+
+    def name(self) -> str:
+        raise NotImplementedError
+
+    def managed(self) -> bool:
+        """Auto-added/removed by discovery (SidecarListener labels)?"""
+        return False
+
+
+class QueueListener(Listener):
+    """Trivial listener backed by a queue — test and building-block use."""
+
+    def __init__(self, name: str,
+                 buffer: int = LISTENER_EVENT_BUFFER_SIZE,
+                 managed: bool = False) -> None:
+        self._name = name
+        self._chan: "queue.Queue[ChangeEvent]" = queue.Queue(maxsize=buffer)
+        self._managed = managed
+
+    def chan(self) -> "queue.Queue[ChangeEvent]":
+        return self._chan
+
+    def name(self) -> str:
+        return self._name
+
+    def managed(self) -> bool:
+        return self._managed
+
+
+class Server:
+    """State about one host in the cluster (services_state.go:50-56)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.services: dict[str, Service] = {}
+        self.last_updated: int = 0
+        self.last_changed: int = 0
+
+    def has_service(self, service_id: str) -> bool:
+        return service_id in self.services
+
+    def to_json(self) -> dict:
+        return {
+            "Name": self.name,
+            "Services": {sid: s.to_json() for sid, s in self.services.items()},
+            "LastUpdated": ns_to_rfc3339(self.last_updated),
+            "LastChanged": ns_to_rfc3339(self.last_changed),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Server":
+        server = cls(d.get("Name", ""))
+        for sid, sd in (d.get("Services") or {}).items():
+            server.services[sid] = Service.from_json(sd)
+        server.last_updated = _ts(d.get("LastUpdated"))
+        server.last_changed = _ts(d.get("LastChanged"))
+        return server
+
+
+class ServicesState:
+    """The cluster-wide replicated catalog (services_state.go:70-110)."""
+
+    def __init__(self, hostname: Optional[str] = None,
+                 cluster_name: str = "") -> None:
+        import socket
+
+        self.servers: dict[str, Server] = {}
+        self.last_changed: int = 0
+        self.cluster_name = cluster_name
+        self.hostname = hostname if hostname is not None else socket.gethostname()
+        # Encoded outbound gossip payloads (lists of encoded records);
+        # the transport drains this (services_state.go:75 Broadcasts chan).
+        self.broadcasts: "queue.Queue[Optional[list[bytes]]]" = queue.Queue()
+        # Single-writer mutation queue (services_state.go:127-140).
+        self.service_msgs: "queue.Queue[Service]" = queue.Queue(
+            maxsize=SERVICE_MSGS_BUFFER)
+        self._listeners: dict[str, Listener] = {}
+        self.tombstone_retransmit = TOMBSTONE_RETRANSMIT
+        self._lock = threading.RLock()
+        self._now: Callable[[], int] = svc_mod.now_ns
+
+    # -- time injection (tests) -------------------------------------------
+
+    def set_clock(self, now_fn: Callable[[], int]) -> None:
+        self._now = now_fn
+
+    # -- basic accessors ---------------------------------------------------
+
+    def has_server(self, hostname: str) -> bool:
+        return hostname in self.servers
+
+    def get_local_service_by_id(self, service_id: str) -> Service:
+        """services_state.go:349-363; raises KeyError when absent."""
+        with self._lock:
+            server = self.servers.get(self.hostname)
+            if server and service_id in server.services:
+                return server.services[service_id].copy()
+        raise KeyError(
+            f"service with ID {service_id!r} not found on host "
+            f"{self.hostname!r}")
+
+    # -- encode / decode ---------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "Servers": {h: s.to_json() for h, s in self.servers.items()},
+            "LastChanged": ns_to_rfc3339(self.last_changed),
+            "ClusterName": self.cluster_name,
+            "Hostname": self.hostname,
+        }
+
+    def encode(self) -> bytes:
+        with self._lock:
+            return json.dumps(self.to_json(), separators=(",", ":")).encode()
+
+    # -- mutation: the merge kernel ---------------------------------------
+
+    def update_service(self, svc: Service) -> None:
+        """Enqueue a state update (services_state.go:137-140).  Blocks if
+        the single-writer queue is full, like an unbuffered-over-capacity
+        Go channel send."""
+        self.service_msgs.put(svc)
+
+    def process_service_msgs(self, looper: Looper) -> None:
+        """Single-writer loop draining ``service_msgs``
+        (services_state.go:129-135)."""
+        def one() -> None:
+            svc = self.service_msgs.get()
+            if svc is None:  # shutdown sentinel
+                raise StopIteration
+            self.add_service_entry(svc)
+
+        try:
+            looper.loop(one)
+        except StopIteration:
+            pass
+
+    def stop_processing(self) -> None:
+        self.service_msgs.put(None)  # type: ignore[arg-type]
+
+    def add_service_entry(self, new_svc: Service) -> None:
+        """THE merge kernel — latest-timestamp-wins with DRAINING
+        stickiness and staleness rejection (services_state.go:293-347).
+        This is the host-side scalar twin of ops/merge.py's vectorized
+        kernel."""
+        with self._lock:
+            now = self._now()
+            if new_svc.is_stale(TOMBSTONE_LIFESPAN, now=now):
+                log.warning("Dropping stale service received on gossip: "
+                            "%s:%s (%s)", new_svc.hostname, new_svc.name,
+                            new_svc.id)
+                return
+
+            if not self.has_server(new_svc.hostname):
+                self.servers[new_svc.hostname] = Server(new_svc.hostname)
+            server = self.servers[new_svc.hostname]
+
+            if not server.has_service(new_svc.id):
+                server.services[new_svc.id] = new_svc
+                self.service_changed(new_svc, UNKNOWN, new_svc.updated)
+                self.retransmit(new_svc)
+            elif new_svc.invalidates(server.services[new_svc.id]):
+                server.last_updated = new_svc.updated
+                old = server.services[new_svc.id]
+                # DRAINING stickiness (services_state.go:329-331).
+                if old.status == svc_mod.DRAINING and \
+                        new_svc.status == svc_mod.ALIVE:
+                    new_svc.status = old.status
+                server.services[new_svc.id] = new_svc
+                if old.status != new_svc.status:
+                    self.service_changed(new_svc, old.status, new_svc.updated)
+                self.retransmit(new_svc)
+
+    def merge(self, other: "ServicesState") -> None:
+        """Full-state anti-entropy merge (services_state.go:367-373)."""
+        for server in other.servers.values():
+            for svc in server.services.values():
+                self.update_service(svc.copy())
+
+    def retransmit(self, svc: Service) -> None:
+        """Epidemic relay of non-local changes (services_state.go:377-392);
+        bounded by the invalidates() check in add_service_entry."""
+        if svc.hostname == self.hostname:
+            return
+        try:
+            self.broadcasts.put_nowait([svc.encode()])
+        except queue.Full:  # pragma: no cover — unbounded by default
+            log.warning("Broadcast queue full; dropping retransmit")
+
+    # -- change accounting + listener fan-out ------------------------------
+
+    def service_changed(self, svc: Service, previous_status: int,
+                        updated: int) -> None:
+        """services_state.go:195-201."""
+        self._server_changed(svc.hostname, updated)
+        self.notify_listeners(svc, previous_status, self.last_changed)
+
+    def _server_changed(self, hostname: str, updated: int) -> None:
+        if not self.has_server(hostname):
+            log.error("Attempt to change a server we don't have! (%s)",
+                      hostname)
+            return
+        self.servers[hostname].last_updated = updated
+        self.servers[hostname].last_changed = updated
+        self.last_changed = updated
+
+    def notify_listeners(self, svc: Service, previous_status: int,
+                         changed_time: int) -> None:
+        """Non-blocking fan-out (services_state.go:217-240)."""
+        event = ChangeEvent(service=svc.copy(),
+                            previous_status=previous_status,
+                            time=changed_time)
+        for listener in list(self._listeners.values()):
+            try:
+                listener.chan().put_nowait(event)
+            except queue.Full:
+                log.warning("Can't notify listener (%s). May not be ready "
+                            "yet.", listener.name())
+
+    def add_listener(self, listener: Listener) -> None:
+        """services_state.go:245-261 — queues must be bounded (≥1)."""
+        ch = listener.chan()
+        if ch is None:
+            log.error("Refusing to add listener %s with nil channel!",
+                      listener.name())
+            return
+        if ch.maxsize < 1:
+            log.error("Refusing to add blocking channel as listener: %s",
+                      listener.name())
+            return
+        with self._lock:
+            self._listeners[listener.name()] = listener
+
+    def remove_listener(self, name: str) -> None:
+        with self._lock:
+            if name not in self._listeners:
+                raise KeyError(f"no listener found with the name {name!r}")
+            del self._listeners[name]
+
+    def get_listeners(self) -> list[Listener]:
+        with self._lock:
+            return list(self._listeners.values())
+
+    # -- server expiry (SWIM NotifyLeave path) -----------------------------
+
+    def expire_server(self, hostname: str) -> None:
+        """Tombstone all of a dead node's records and announce them
+        TOMBSTONE_COUNT× (services_state.go:150-192)."""
+        with self._lock:
+            server = self.servers.get(hostname)
+            if not server or not server.services:
+                log.info("No records to expire for %s", hostname)
+                return
+            if all(svc.is_tombstone() for svc in server.services.values()):
+                log.info("No records to expire for %s (no live services)",
+                         hostname)
+                return
+            log.info("Expiring %s", hostname)
+            tombstones = []
+            now = self._now()
+            for svc in server.services.values():
+                previous = svc.status
+                svc.tombstone(now=now)
+                self.service_changed(svc, previous, svc.updated)
+                tombstones.append(svc.copy())
+        self.send_services(
+            tombstones,
+            TimedLooper(self.tombstone_retransmit, TOMBSTONE_COUNT))
+
+    # -- broadcast lifecycle loops -----------------------------------------
+
+    def is_new_service(self, svc: Service) -> bool:
+        """services_state.go:505-517."""
+        found = None
+        if self.has_server(svc.hostname):
+            found = self.servers[svc.hostname].services.get(svc.id)
+        return found is None or (not svc.is_tombstone()
+                                 and svc.status != found.status)
+
+    def broadcast_services(self, fn: Callable[[], list[Service]],
+                           looper: Looper) -> None:
+        """Announce local services: new ⇒ ALIVE_COUNT× @ 1 Hz, else
+        re-announce on the 1-minute refresh window
+        (services_state.go:525-574)."""
+        last_time = 0
+
+        def one() -> None:
+            nonlocal last_time
+            services = []
+            have_new = False
+            service_list = fn()
+            with self._lock:
+                now = self._now()
+                for svc in service_list:
+                    if self.is_new_service(svc):
+                        have_new = True
+                        services.append(svc)
+                    elif now - int(ALIVE_BROADCAST_INTERVAL *
+                                   NS_PER_SECOND) > last_time:
+                        services.append(svc)
+            if services:
+                run_count = ALIVE_COUNT if have_new else 1
+                last_time = self._now()
+                self.send_services(
+                    services,
+                    TimedLooper(self.tombstone_retransmit, run_count))
+            else:
+                self.broadcasts.put(None)
+
+        looper.loop(one)
+
+    def send_services(self, services: list[Service], looper: Looper,
+                      background: bool = True) -> Optional[threading.Thread]:
+        """Re-enqueue each record every second, bumping Updated +50 ns per
+        round so peers retransmit (services_state.go:579-604)."""
+        services = [svc.copy() for svc in services]
+
+        def run() -> None:
+            additional = 0
+
+            def one() -> None:
+                nonlocal additional
+                prepared = []
+                for svc in services:
+                    svc.updated = svc.updated + additional
+                    prepared.append(svc.encode())
+                additional += 50  # ns — the retransmit-forcing skew
+                self.broadcasts.put(prepared)
+
+            looper.loop(one)
+
+        if background:
+            t = threading.Thread(target=run, name="send-services", daemon=True)
+            t.start()
+            return t
+        run()
+        return None
+
+    def broadcast_tombstones(self, fn: Callable[[], list[Service]],
+                             looper: Looper) -> None:
+        """Tombstone vanished local services + expire remote state
+        (services_state.go:606-633)."""
+        def one() -> None:
+            with self._lock:
+                container_list = fn()
+                other = self.tombstone_others_services()
+                mine = self.tombstone_services(self.hostname, container_list)
+                tombstones = mine + other
+            if tombstones:
+                self.send_services(
+                    tombstones,
+                    TimedLooper(self.tombstone_retransmit, TOMBSTONE_COUNT))
+            else:
+                self.broadcasts.put(None)
+
+        looper.loop(one)
+
+    def tombstone_others_services(self) -> list[Service]:
+        """Lifespan sweep over the whole view: GC 3h-old tombstones, and
+        tombstone expired records at original-timestamp+1s so unseen newer
+        records still win (services_state.go:635-683)."""
+        result = []
+        now = self._now()
+        with self._lock:
+            for hostname in list(self.servers):
+                server = self.servers[hostname]
+                for sid in list(server.services):
+                    svc = server.services[sid]
+                    if svc.is_tombstone() and svc.updated < now - int(
+                            TOMBSTONE_LIFESPAN * NS_PER_SECOND):
+                        del server.services[sid]
+                        if not server.services:
+                            del self.servers[hostname]
+                        continue
+                    lifespan = (DRAINING_LIFESPAN if svc.is_draining()
+                                else ALIVE_LIFESPAN)
+                    if not svc.is_tombstone() and svc.updated < now - int(
+                            lifespan * NS_PER_SECOND):
+                        log.warning(
+                            "Found expired service %s ID %s from %s, "
+                            "tombstoning", svc.name, svc.id, svc.hostname)
+                        previous = svc.status
+                        # Original timestamp + 1 s, NOT now — the "+1 s
+                        # rule" (services_state.go:667-675).
+                        svc.status = TOMBSTONE
+                        svc.updated = svc.updated + NS_PER_SECOND
+                        self.service_changed(svc, previous, svc.updated)
+                        result.append(svc.copy())
+        return result
+
+    def tombstone_services(self, hostname: str,
+                           container_list: list[Service]) -> list[Service]:
+        """Tombstone local services that vanished from discovery; each
+        record twice for receipt (services_state.go:685-715)."""
+        if not self.has_server(hostname):
+            return []
+        mapping = {svc.id for svc in container_list}
+        result = []
+        now = self._now()
+        with self._lock:
+            for svc in self.servers[hostname].services.values():
+                if svc.id not in mapping and not svc.is_tombstone():
+                    log.warning("Tombstoning %s", svc.id)
+                    previous = svc.status
+                    svc.tombstone(now=now)
+                    self.service_changed(svc, previous, svc.updated)
+                    result.extend([svc.copy(), svc.copy()])
+        return result
+
+    # -- tracking loops ----------------------------------------------------
+
+    def track_new_services(self, fn: Callable[[], list[Service]],
+                           looper: Looper) -> None:
+        """services_state.go:444-452."""
+        def one() -> None:
+            for svc in fn():
+                self.update_service(svc)
+        looper.loop(one)
+
+    def track_local_listeners(self, fn: Callable[[], list[Listener]],
+                              looper: Looper) -> None:
+        """Sync managed listeners with discovery
+        (services_state.go:454-497)."""
+        def one() -> None:
+            discovered = fn()
+            names = {listener.name() for listener in discovered}
+            for listener in discovered:
+                with self._lock:
+                    have = listener.name() in self._listeners
+                if not have:
+                    log.info("Adding listener %s because it was just "
+                             "discovered", listener.name())
+                    watch = getattr(listener, "watch", None)
+                    if callable(watch):
+                        watch(self)
+                    else:
+                        self.add_listener(listener)
+            for listener in self.get_listeners():
+                if listener.managed() and listener.name() not in names:
+                    log.info("Removing listener %s because the service "
+                             "appears to be gone", listener.name())
+                    stop = getattr(listener, "stop", None)
+                    if callable(stop):
+                        stop()
+                    try:
+                        self.remove_listener(listener.name())
+                    except KeyError as exc:
+                        log.warning("Failed to remove listener: %s", exc)
+        looper.loop(one)
+
+    # -- iteration / views -------------------------------------------------
+
+    def each_server(self) -> Iterator[tuple[str, Server]]:
+        yield from list(self.servers.items())
+
+    def each_service(self) -> Iterator[tuple[str, str, Service]]:
+        for hostname, server in self.each_server():
+            for sid, svc in list(server.services.items()):
+                yield hostname, sid, svc
+
+    def each_service_sorted(self) -> Iterator[tuple[str, str, Service]]:
+        """Deterministic order — hostname then service ID (view.go:14-33);
+        the Envoy adapter's oldest-wins collision guard relies on it."""
+        for hostname in sorted(self.servers):
+            server = self.servers[hostname]
+            for sid in sorted(server.services):
+                yield hostname, sid, server.services[sid]
+
+    def by_service(self) -> dict[str, list[Service]]:
+        """Group instances by service name (services_state.go:752-764)."""
+        out: dict[str, list[Service]] = {}
+        with self._lock:
+            for _, _, svc in self.each_service_sorted():
+                out.setdefault(svc.name, []).append(svc.copy())
+        return out
+
+    # -- display -----------------------------------------------------------
+
+    def format(self, members: Optional[Iterable[str]] = None) -> str:
+        """Human-readable dump (services_state.go:396-436)."""
+        now = self._now()
+        out = "Services ------------------------------\n"
+        with self._lock:
+            for name in sorted(self.servers):
+                server = self.servers[name]
+                out += f"  {name}: ({time_ago(server.last_updated, now)})\n"
+                for svc in sorted(server.services.values(),
+                                  key=lambda s: s.name):
+                    out += svc_mod.format_service(svc, now)
+                out += "\n"
+        if members is None:
+            return out
+        out += "\nCluster Hosts -------------------------\n"
+        for host in members:
+            out += f"    {host}\n"
+        out += "---------------------------------------"
+        return out
+
+
+def decode(data: bytes | str) -> ServicesState:
+    """Rebuild a state from its JSON wire form (services_state.go:774-782)."""
+    d = json.loads(data)
+    state = ServicesState(hostname=d.get("Hostname", ""))
+    state.cluster_name = d.get("ClusterName", "") or ""
+    state.last_changed = _ts(d.get("LastChanged"))
+    for hostname, sd in (d.get("Servers") or {}).items():
+        state.servers[hostname] = Server.from_json(sd)
+    return state
+
+
+def decode_stream(stream, callback) -> None:
+    """Newline-delimited JSON of by-service maps
+    (services_state.go:766-772): calls ``callback(mapping, error)`` per
+    document."""
+    for line in stream:
+        if not line.strip():
+            continue
+        try:
+            doc = json.loads(line)
+            mapping = {name: [Service.from_json(s) for s in svcs]
+                       for name, svcs in doc.items()}
+            callback(mapping, None)
+        except (json.JSONDecodeError, AttributeError, TypeError) as exc:
+            callback(None, exc)
+            return

@@ -1,0 +1,15 @@
+"""Service discovery: plugins that find local services and their health
+checks (reference: discovery/ package)."""
+
+from sidecar_tpu.discovery.base import (
+    ChangeListener,
+    Discoverer,
+    MultiDiscovery,
+)
+from sidecar_tpu.discovery.static import StaticDiscovery
+from sidecar_tpu.discovery.namer import DockerLabelNamer, RegexpNamer
+
+__all__ = [
+    "ChangeListener", "Discoverer", "MultiDiscovery", "StaticDiscovery",
+    "RegexpNamer", "DockerLabelNamer",
+]

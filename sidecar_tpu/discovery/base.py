@@ -1,0 +1,86 @@
+"""The Discoverer interface and fan-in aggregation
+(reference: discovery/discovery.go:16-102)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from sidecar_tpu.runtime.looper import FreeLooper, Looper, run_in_thread
+from sidecar_tpu.service import Service
+
+DEFAULT_SLEEP_INTERVAL = 1.0  # discovery.go:11
+
+
+@dataclasses.dataclass
+class ChangeListener:
+    """A co-located service that wants ChangeEvents over HTTP
+    (discovery.go:16-20)."""
+
+    name: str
+    url: str
+
+
+class Discoverer:
+    """discovery.go:26-37."""
+
+    def services(self) -> list[Service]:
+        raise NotImplementedError
+
+    def health_check(self, svc: Service) -> tuple[str, str]:
+        """(check type, check args) for a service; ("", "") if unknown."""
+        raise NotImplementedError
+
+    def listeners(self) -> list[ChangeListener]:
+        raise NotImplementedError
+
+    def run(self, looper: Looper) -> None:
+        """Non-blocking: start the discovery loop."""
+        raise NotImplementedError
+
+
+class MultiDiscovery(Discoverer):
+    """Fan-in over N discoverers; first non-empty health check wins
+    (discovery.go:41-102)."""
+
+    def __init__(self, discoverers: list[Discoverer]) -> None:
+        self.discoverers = discoverers
+        self._sub_loopers: list[Looper] = []
+
+    def health_check(self, svc: Service) -> tuple[str, str]:
+        for disco in self.discoverers:
+            check, args = disco.health_check(svc)
+            if check:
+                return check, args
+        return "", ""
+
+    def services(self) -> list[Service]:
+        out: list[Service] = []
+        for disco in self.discoverers:
+            out.extend(disco.services())
+        return out
+
+    def listeners(self) -> list[ChangeListener]:
+        out: list[ChangeListener] = []
+        for disco in self.discoverers:
+            out.extend(disco.listeners())
+        return out
+
+    def run(self, looper: Looper) -> None:
+        from sidecar_tpu.runtime.looper import TimedLooper
+
+        for disco in self.discoverers:
+            sub = TimedLooper(DEFAULT_SLEEP_INTERVAL)
+            self._sub_loopers.append(sub)
+            disco.run(sub)
+
+        # Idle on the controlling looper; when it quits, stop the plugins
+        # (discovery.go:86-102).
+        def watch() -> None:
+            looper.loop(lambda: None)
+            for sub in self._sub_loopers:
+                sub.quit()
+
+        import threading
+        threading.Thread(target=watch, name="multi-discovery",
+                         daemon=True).start()

@@ -1,0 +1,374 @@
+"""Docker container discovery
+(reference: discovery/docker_discovery.go:16-404).
+
+Polls the container list every second, subscribes to the Docker event
+stream ("die"/"stop" delete services immediately), names services with a
+pluggable ServiceNamer, and keeps an inspect-result cache with periodic
+drain + prune.  The Docker daemon is reached through a ``DockerClient``
+protocol; the default implementation is a dependency-free stdlib HTTP
+client speaking the Docker Engine API over a Unix socket or TCP
+(the reference uses go-dockerclient; the five-method interface it
+isolates for testing — docker_discovery.go:20-26 — is preserved here).
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import queue
+import socket
+import threading
+import time
+from typing import Callable, Optional
+
+from sidecar_tpu.discovery.base import (
+    ChangeListener,
+    DEFAULT_SLEEP_INTERVAL,
+    Discoverer,
+)
+from sidecar_tpu.discovery.namer import ServiceNamer
+from sidecar_tpu.runtime.looper import Looper
+from sidecar_tpu.service import Service, to_service
+
+log = logging.getLogger(__name__)
+
+CACHE_DRAIN_INTERVAL = 600.0  # docker_discovery.go:17
+
+
+class DockerClient:
+    """The five-method client interface (docker_discovery.go:20-26)."""
+
+    def inspect_container(self, container_id: str) -> dict:
+        raise NotImplementedError
+
+    def list_containers(self, all: bool = False) -> list[dict]:
+        raise NotImplementedError
+
+    def add_event_listener(self, listener: "queue.Queue") -> None:
+        raise NotImplementedError
+
+    def remove_event_listener(self, listener: "queue.Queue") -> None:
+        raise NotImplementedError
+
+    def ping(self) -> None:
+        """Raises on failure."""
+        raise NotImplementedError
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, path: str, timeout: float = 10.0) -> None:
+        super().__init__("localhost", timeout=timeout)
+        self._path = path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(self.timeout)
+        sock.connect(self._path)
+        self.sock = sock
+
+
+class EngineAPIClient(DockerClient):
+    """Minimal Docker Engine API client (stdlib only).
+
+    ``endpoint`` accepts ``unix:///var/run/docker.sock`` or
+    ``tcp://host:port``; empty uses the conventional Unix socket.
+    """
+
+    def __init__(self, endpoint: str = "") -> None:
+        self.endpoint = endpoint or "unix:///var/run/docker.sock"
+        self._event_threads: dict[int, threading.Event] = {}
+
+    def _conn(self, timeout: float = 10.0) -> http.client.HTTPConnection:
+        ep = self.endpoint
+        if ep.startswith("unix://"):
+            return _UnixHTTPConnection(ep[len("unix://"):], timeout)
+        if ep.startswith("tcp://"):
+            hostport = ep[len("tcp://"):]
+            return http.client.HTTPConnection(hostport, timeout=timeout)
+        raise ValueError(f"unsupported Docker endpoint: {ep}")
+
+    def _get_json(self, path: str):
+        conn = self._conn()
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status >= 400:
+                raise OSError(f"docker API {path}: HTTP {resp.status}")
+            return json.loads(body)
+        finally:
+            conn.close()
+
+    def inspect_container(self, container_id: str) -> dict:
+        return self._get_json(f"/containers/{container_id}/json")
+
+    def list_containers(self, all: bool = False) -> list[dict]:
+        flag = "1" if all else "0"
+        return self._get_json(f"/containers/json?all={flag}")
+
+    def ping(self) -> None:
+        conn = self._conn(timeout=3.0)
+        try:
+            conn.request("GET", "/_ping")
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise OSError(f"docker ping: HTTP {resp.status}")
+        finally:
+            conn.close()
+
+    def add_event_listener(self, listener: "queue.Queue") -> None:
+        stop = threading.Event()
+        self._event_threads[id(listener)] = stop
+
+        def stream() -> None:
+            try:
+                conn = self._conn(timeout=None)  # long-lived stream
+                conn.request("GET", "/events")
+                resp = conn.getresponse()
+                while not stop.is_set():
+                    line = resp.fp.readline()
+                    if not line:
+                        break
+                    try:
+                        listener.put(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+            except OSError as exc:
+                log.debug("Docker event stream ended: %s", exc)
+            finally:
+                listener.put(None)  # signals disconnect, like a closed chan
+
+        threading.Thread(target=stream, name="docker-events",
+                         daemon=True).start()
+
+    def remove_event_listener(self, listener: "queue.Queue") -> None:
+        stop = self._event_threads.pop(id(listener), None)
+        if stop is not None:
+            stop.set()
+
+
+class ContainerCache:
+    """Inspect-result cache with drain + prune
+    (docker_discovery.go:349-404)."""
+
+    def __init__(self) -> None:
+        self._cache: dict[str, dict] = {}
+        self._lock = threading.RLock()
+
+    def get(self, svc_id: str) -> Optional[dict]:
+        with self._lock:
+            return self._cache.get(svc_id)
+
+    def set(self, svc_id: str, container: dict) -> None:
+        with self._lock:
+            self._cache[svc_id] = container
+
+    def drain(self) -> None:
+        with self._lock:
+            self._cache = {}
+
+    def prune(self, live_ids: set[str]) -> None:
+        with self._lock:
+            for cid in list(self._cache):
+                if cid not in live_ids:
+                    del self._cache[cid]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+
+class DockerDiscovery(Discoverer):
+    def __init__(self, endpoint: str, namer: ServiceNamer,
+                 advertise_ip: str,
+                 client_provider: Optional[
+                     Callable[[], DockerClient]] = None,
+                 hostname: Optional[str] = None) -> None:
+        self.endpoint = endpoint
+        self.namer = namer
+        self.advertise_ip = advertise_ip
+        self.hostname = hostname
+        self.client_provider = client_provider or (
+            lambda: EngineAPIClient(endpoint))
+        self.events: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self.container_cache = ContainerCache()
+        self.sleep_interval = DEFAULT_SLEEP_INTERVAL
+        self._services: list[Service] = []
+        self._lock = threading.RLock()
+        self._quit = threading.Event()
+
+    # -- Discoverer --------------------------------------------------------
+
+    def services(self) -> list[Service]:
+        with self._lock:
+            return [svc.copy() for svc in self._services]
+
+    def health_check(self, svc: Service) -> tuple[str, str]:
+        """Check type/args from container labels
+        (docker_discovery.go:75-83)."""
+        container = self._inspect(svc)
+        if container is None:
+            return "", ""
+        labels = (container.get("Config") or {}).get("Labels") or {}
+        return labels.get("HealthCheck", ""), labels.get("HealthCheckArgs", "")
+
+    def listeners(self) -> list[ChangeListener]:
+        """Containers with a SidecarListener=<ServicePort> label subscribe
+        to change events (docker_discovery.go:157-223)."""
+        out = []
+        with self._lock:
+            svcs = list(self._services)
+        for svc in svcs:
+            container = self._inspect(svc)
+            if container is None:
+                continue
+            listener = self._listener_for(svc, container)
+            if listener is not None:
+                out.append(listener)
+        return out
+
+    def _listener_for(self, svc: Service,
+                      container: dict) -> Optional[ChangeListener]:
+        labels = (container.get("Config") or {}).get("Labels") or {}
+        port_str = labels.get("SidecarListener")
+        if port_str is None:
+            return None
+        try:
+            svc_port = int(port_str)
+        except ValueError:
+            log.warning("SidecarListener label found on %s, can't decode "
+                        "port '%s'", svc.id, port_str)
+            return None
+        for port in svc.ports:
+            if port.service_port == svc_port and port.type == "tcp":
+                return ChangeListener(
+                    name=svc.listener_name(),
+                    url=f"http://{port.ip}:{port.port}/sidecar/update")
+        log.warning("SidecarListener label found on %s, but no matching "
+                    "ServicePort! '%s'", svc.id, port_str)
+        return None
+
+    def run(self, looper: Looper) -> None:
+        threading.Thread(target=self._manage_connection,
+                         name="docker-conn", daemon=True).start()
+
+        def one() -> None:
+            # Event-or-poll multiplexing (docker_discovery.go:117-137):
+            # handle any queued events, then refresh the full listing.
+            deadline = time.monotonic() + self.sleep_interval
+            try:
+                event = self.events.get(timeout=self.sleep_interval)
+                if event is not None:
+                    self._handle_event(event)
+                    # Drain any burst before re-polling.
+                    while True:
+                        try:
+                            more = self.events.get_nowait()
+                        except queue.Empty:
+                            break
+                        if more is not None:
+                            self._handle_event(more)
+            except queue.Empty:
+                pass
+            remaining = deadline - time.monotonic()
+            if remaining > 0:
+                time.sleep(remaining)
+            self.get_containers()
+            if time.monotonic() - self._last_drain > CACHE_DRAIN_INTERVAL:
+                self.container_cache.drain()
+                self._last_drain = time.monotonic()
+
+        self._last_drain = time.monotonic()
+
+        def drive() -> None:
+            looper.loop(one)
+            self._quit.set()
+
+        threading.Thread(target=drive, name="docker-discovery",
+                         daemon=True).start()
+
+    # -- internals ---------------------------------------------------------
+
+    def _inspect(self, svc: Service) -> Optional[dict]:
+        cached = self.container_cache.get(svc.id)
+        if cached is not None:
+            return cached
+        try:
+            client = self.client_provider()
+            container = client.inspect_container(svc.id)
+        except OSError as exc:
+            log.error("Error inspecting container %s: %s", svc.id, exc)
+            return None
+        self.container_cache.set(svc.id, container)
+        return container
+
+    def get_containers(self) -> None:
+        """Refresh the service list from a full container listing
+        (docker_discovery.go:248-283)."""
+        try:
+            client = self.client_provider()
+            containers = client.list_containers(all=False)
+        except OSError as exc:
+            log.error("Error listing containers: %s", exc)
+            return
+        live_ids: set[str] = set()
+        services: list[Service] = []
+        for container in containers:
+            labels = container.get("Labels") or {}
+            if labels.get("SidecarDiscover") == "false":
+                continue
+            svc = to_service(container, self.advertise_ip,
+                             hostname=self.hostname)
+            svc.name = self.namer.service_name(container)
+            services.append(svc)
+            live_ids.add(svc.id)
+        with self._lock:
+            self._services = services
+        self.container_cache.prune(live_ids)
+
+    def _handle_event(self, event: dict) -> None:
+        """'die'/'stop' events delete the service immediately
+        (docker_discovery.go:327-347)."""
+        status = event.get("status") or event.get("Status") or ""
+        if status not in ("die", "stop"):
+            return
+        cid = (event.get("id") or event.get("ID") or "")[:12]
+        if len(cid) < 12:
+            return
+        with self._lock:
+            for i, svc in enumerate(self._services):
+                if svc.id == cid:
+                    log.info("Deleting %s based on Docker '%s' event",
+                             svc.id, status)
+                    del self._services[i]
+                    return
+
+    def _manage_connection(self) -> None:
+        """Self-healing event-stream connection
+        (docker_discovery.go:299-325)."""
+        client: Optional[DockerClient] = self._connect()
+        while not self._quit.is_set():
+            try:
+                if client is None:
+                    raise OSError("no client")
+                client.ping()
+            except OSError:
+                log.warning("Lost connection to Docker, re-connecting")
+                if client is not None:
+                    try:
+                        client.remove_event_listener(self.events)
+                    except OSError:
+                        pass
+                client = self._connect()
+            self._quit.wait(self.sleep_interval)
+
+    def _connect(self) -> Optional[DockerClient]:
+        try:
+            client = self.client_provider()
+            client.add_event_listener(self.events)
+            return client
+        except OSError as exc:
+            log.error("Error creating Docker client: %s", exc)
+            return None

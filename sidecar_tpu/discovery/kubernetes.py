@@ -1,0 +1,179 @@
+"""Kubernetes API discovery
+(reference: discovery/kubernetes_api_discovery.go:17-183,
+kubernetes_support.go:96-203).
+
+Announces K8s Services carrying a ``ServiceName`` label, with NodePort
+port mappings, either for this node only or for every node
+(``announce_all_nodes``).  Health checks are always ``AlwaysSuccessful``
+— the fronting load balancer is assumed to have done the health
+checking.  The K8s REST API call is isolated behind a
+``K8sDiscoveryAdapter`` so tests can inject canned payloads."""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+import urllib.request
+from typing import Optional
+
+from sidecar_tpu.discovery.base import ChangeListener, Discoverer
+from sidecar_tpu.runtime.looper import Looper, run_in_thread
+from sidecar_tpu.service import ALIVE, Port, Service, now_ns, rfc3339_to_ns
+
+log = logging.getLogger(__name__)
+
+
+class K8sDiscoveryAdapter:
+    """kubernetes_support.go:96-99 — the mockable API-call seam."""
+
+    def get_services(self) -> bytes:
+        raise NotImplementedError
+
+    def get_nodes(self) -> bytes:
+        raise NotImplementedError
+
+
+class KubeAPIDiscoveryCommand(K8sDiscoveryAdapter):
+    """Direct K8s REST API caller with bearer-token + CA from the
+    serviceaccount path (kubernetes_support.go:102-203)."""
+
+    def __init__(self, kube_host: str, kube_port: int, namespace: str,
+                 timeout: float, creds_path: str) -> None:
+        self.kube_host = kube_host
+        self.kube_port = kube_port
+        self.namespace = namespace
+        self.timeout = timeout
+        self.token = ""
+        self._ssl_context: Optional[ssl.SSLContext] = None
+        try:
+            with open(f"{creds_path}/token") as fh:
+                self.token = fh.read().replace("\n", "")
+        except OSError as exc:
+            log.error("Failed to read serviceaccount token: %s", exc)
+        try:
+            ctx = ssl.create_default_context()
+            ctx.load_verify_locations(f"{creds_path}/ca.crt")
+            self._ssl_context = ctx
+        except (OSError, ssl.SSLError) as exc:
+            log.warning("Failed to load CA cert file: %s", exc)
+
+    def _make_request(self, path: str) -> bytes:
+        scheme = "https" if self.kube_port == 443 else "http"
+        url = f"{scheme}://{self.kube_host}:{self.kube_port}{path}"
+        req = urllib.request.Request(
+            url, headers={"Authorization": f"Bearer {self.token}"})
+        kwargs = {}
+        if scheme == "https" and self._ssl_context is not None:
+            kwargs["context"] = self._ssl_context
+        with urllib.request.urlopen(req, timeout=self.timeout,
+                                    **kwargs) as resp:
+            if not (200 <= resp.status < 300):
+                raise OSError(
+                    f"got unexpected response code from {path}: "
+                    f"{resp.status}")
+            return resp.read()
+
+    def get_services(self) -> bytes:
+        return self._make_request("/api/v1/services/")
+
+    def get_nodes(self) -> bytes:
+        return self._make_request("/api/v1/nodes/")
+
+
+def _node_ip_host(node: dict) -> tuple[str, str]:
+    """kubernetes_api_discovery.go:117-128."""
+    hostname = ip = ""
+    for addr in ((node.get("status") or {}).get("addresses") or []):
+        if addr.get("type") == "InternalIP":
+            ip = addr.get("address", "")
+        elif addr.get("type") == "Hostname":
+            hostname = addr.get("address", "")
+    return hostname, ip
+
+
+class K8sAPIDiscoverer(Discoverer):
+    def __init__(self, command: K8sDiscoveryAdapter, namespace: str = "",
+                 announce_all_nodes: bool = False,
+                 hostname: str = "") -> None:
+        self.command = command
+        self.namespace = namespace
+        self.announce_all_nodes = announce_all_nodes
+        self.hostname = hostname
+        self._svcs: dict = {}
+        self._nodes: dict = {}
+        self._lock = threading.RLock()
+
+    # -- Discoverer --------------------------------------------------------
+
+    def services(self) -> list[Service]:
+        with self._lock:
+            out: list[Service] = []
+            for node in (self._nodes.get("items") or []):
+                hostname, ip = _node_ip_host(node)
+                if self.announce_all_nodes:
+                    out.extend(self._services_for_node(hostname, ip))
+                    continue
+                if hostname == self.hostname:
+                    out = self._services_for_node(hostname, ip)
+                    break
+            return out
+
+    def _services_for_node(self, hostname: str, ip: str) -> list[Service]:
+        """kubernetes_api_discovery.go:48-86 — only items labeled
+        ServiceName, only NodePort ports."""
+        services = []
+        now = now_ns()
+        for item in (self._svcs.get("items") or []):
+            meta = item.get("metadata") or {}
+            labels = meta.get("labels") or {}
+            name = labels.get("ServiceName", "")
+            if not name:
+                continue
+            created_raw = meta.get("creationTimestamp")
+            svc = Service(
+                id=meta.get("uid", ""),
+                name=name,
+                image=f"{name}:kubernetes-hosted",
+                created=(rfc3339_to_ns(created_raw) if created_raw else 0),
+                hostname=hostname,
+                proxy_mode="http",
+                status=ALIVE,
+                updated=now,
+            )
+            for port in ((item.get("spec") or {}).get("ports") or []):
+                node_port = int(port.get("nodePort", 0) or 0)
+                if node_port < 1:
+                    continue
+                svc.ports.append(Port(type="tcp", port=node_port,
+                                      service_port=int(port.get("port", 0)),
+                                      ip=ip))
+            services.append(svc)
+        return services
+
+    def health_check(self, svc: Service) -> tuple[str, str]:
+        """Always AlwaysSuccessful (kubernetes_api_discovery.go:133-135)."""
+        return "AlwaysSuccessful", ""
+
+    def listeners(self) -> list[ChangeListener]:
+        return []
+
+    def run(self, looper: Looper) -> None:
+        def one() -> None:
+            try:
+                data = self.command.get_services()
+                parsed = json.loads(data)
+                with self._lock:
+                    self._svcs = parsed
+            except (OSError, json.JSONDecodeError) as exc:
+                log.error("Failed K8s services discovery: %s", exc)
+            try:
+                data = self.command.get_nodes()
+                parsed = json.loads(data)
+                with self._lock:
+                    self._nodes = parsed
+            except (OSError, json.JSONDecodeError) as exc:
+                log.error("Failed K8s nodes discovery: %s", exc)
+
+        run_in_thread(looper, one, name="k8s-discovery")

@@ -1,0 +1,60 @@
+"""Service naming strategies for container discovery
+(reference: discovery/service_namer.go:11-85)."""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+
+class ServiceNamer:
+    """service_namer.go:11-13 — container dict → service name."""
+
+    def service_name(self, container: Optional[dict]) -> str:
+        raise NotImplementedError
+
+
+class RegexpNamer(ServiceNamer):
+    """First capture group of a regex over the container name, falling
+    back to the image (service_namer.go:17-57)."""
+
+    def __init__(self, expression: str) -> None:
+        self.service_name_match = expression
+        try:
+            self.expression = re.compile(expression)
+        except re.error as exc:
+            raise ValueError(
+                f"Invalid regex, can't compile: {expression}") from exc
+
+    def service_name(self, container: Optional[dict]) -> str:
+        if container is None:
+            log.warning("service_name() called with nil container!")
+            return ""
+        name = (container.get("Names") or [""])[0]
+        match = self.expression.search(name)
+        if match is None or match.lastindex is None:
+            return container.get("Image", "")
+        return match.group(1)
+
+
+class DockerLabelNamer(ServiceNamer):
+    """Value of a Docker label, falling back to the image
+    (service_namer.go:61-85)."""
+
+    def __init__(self, label: str = "ServiceName") -> None:
+        self.label = label
+
+    def service_name(self, container: Optional[dict]) -> str:
+        if container is None:
+            log.warning("service_name() called with nil container!")
+            return ""
+        labels = container.get("Labels") or {}
+        if self.label in labels:
+            return labels[self.label]
+        log.debug("Found container with no '%s' label: %s, returning '%s'",
+                  self.label, container.get("Id", ""),
+                  container.get("Image", ""))
+        return container.get("Image", "")

@@ -1,0 +1,119 @@
+"""File-based static discovery
+(reference: discovery/static_discovery.go:18-159).
+
+Parses a ``static.json`` array of Targets once at ``run``; each target's
+service gets a random 6-byte-hex ID and is re-stamped ``updated=now`` on
+every ``services()`` call so the records stay alive in the catalog."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import secrets
+import socket
+from typing import Optional
+
+from sidecar_tpu.discovery.base import ChangeListener, Discoverer
+from sidecar_tpu.runtime.looper import Looper
+from sidecar_tpu.service import Port, Service, now_ns
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class StaticCheck:
+    """static_discovery.go:33-36."""
+
+    type: str = ""
+    args: str = ""
+
+
+@dataclasses.dataclass
+class Target:
+    """static_discovery.go:18-22."""
+
+    service: Service
+    check: StaticCheck
+    listen_port: int = 0
+
+
+def random_hex(count: int = 6) -> str:
+    """static_discovery.go:148-159."""
+    return secrets.token_hex(count)
+
+
+class StaticDiscovery(Discoverer):
+    def __init__(self, config_file: str, default_ip: str,
+                 hostname: Optional[str] = None) -> None:
+        self.config_file = config_file
+        self.default_ip = default_ip
+        self.hostname = hostname if hostname is not None \
+            else socket.gethostname()
+        self.targets: list[Target] = []
+
+    # -- Discoverer --------------------------------------------------------
+
+    def services(self) -> list[Service]:
+        now = now_ns()
+        out = []
+        for target in self.targets:
+            target.service.updated = now  # keep-alive re-stamp (:62-69)
+            out.append(target.service.copy())
+        return out
+
+    def health_check(self, svc: Service) -> tuple[str, str]:
+        for target in self.targets:
+            if svc.id == target.service.id:
+                return target.check.type, target.check.args
+        return "", ""
+
+    def listeners(self) -> list[ChangeListener]:
+        """Targets with a ListenPort subscribe to change events
+        (:72-85)."""
+        out = []
+        for target in self.targets:
+            if target.listen_port > 0:
+                out.append(ChangeListener(
+                    name=target.service.listener_name(),
+                    url=(f"http://{self.hostname}:{target.listen_port}"
+                         "/sidecar/update")))
+        return out
+
+    def run(self, looper: Looper) -> None:
+        try:
+            self.targets = self.parse_config(self.config_file)
+        except (OSError, ValueError) as exc:
+            log.error("StaticDiscovery cannot parse: %s", exc)
+            looper.quit()
+
+    # -- config ------------------------------------------------------------
+
+    def parse_config(self, filename: str) -> list[Target]:
+        """static_discovery.go:102-145."""
+        with open(filename, "rb") as fh:
+            raw = json.load(fh)
+        if not isinstance(raw, list):
+            raise ValueError("static config must be a JSON array of Targets")
+        targets = []
+        now = now_ns()
+        for entry in raw:
+            svc = Service.from_json(entry.get("Service") or {})
+            svc.id = random_hex(6)
+            svc.created = now
+            # Services may be exported for a 3rd party; an empty hostname
+            # means "this host" (:122-126).
+            if not svc.hostname:
+                svc.hostname = self.hostname
+            for port in svc.ports:
+                if not port.ip:
+                    port.ip = self.default_ip
+            check_raw = entry.get("Check") or {}
+            targets.append(Target(
+                service=svc,
+                check=StaticCheck(type=check_raw.get("Type", ""),
+                                  args=check_raw.get("Args", "")),
+                listen_port=int(entry.get("ListenPort", 0) or 0),
+            ))
+            log.info("Discovered service: %s, ID: %s", svc.name, svc.id)
+        return targets

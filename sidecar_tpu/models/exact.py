@@ -10,18 +10,26 @@ the reference keeps local services in the same state map).
 
 One simulated round = one GossipInterval (200 ms):
 
-1. **announce** — owners re-stamp their own records (discovery/health →
-   ``BroadcastServices``, services_state.go:525-574): every refresh
-   interval (1 min, staggered per node), plus every-second repeats for
-   records changed in the last few seconds (the ALIVE_COUNT=5× /
-   TOMBSTONE_COUNT=10× @ 1 Hz repeats, services_state.go:28-29 — each
-   repeat strictly newer, the +50 ns-skew trick of SendServices,
-   services_state.go:597-599).
-2. **gossip** — sample fan-out peers, take each node's top-``budget``
-   freshest records, scatter-merge into targets (ops/gossip.py).
+1. **select** — sample fan-out peers; take each node's top-``budget``
+   freshest *eligible* records (ops/gossip.py; eligibility is the int8
+   round-stamp queue ``acc`` — the vectorized TransmitLimited broadcast
+   queue).
+2. **deliver + announce** — expand messages into update triples with the
+   merge semantics (staleness gate, DRAINING stickiness vs the pre-round
+   state), fold in the announce path's re-stamps (``BroadcastServices``'s
+   1-minute refresh, services_state.go:547-549, staggered per node), and
+   apply them all in ONE scatter-max on ``known`` plus ONE stamp scatter
+   on ``acc``.  Scatters on the big tensors each cost a full buffer
+   rewrite on TPU — one per tensor per round is the performance budget.
+   Announce re-stamps therefore land at the END of a round and become
+   broadcastable the following round (the reference's 5×/10× @ 1 Hz
+   announce repeats are subsumed by the eligibility window, which keeps a
+   fresh version offered for ~limit/fanout rounds).
 3. **push-pull** — every 20 s, full two-way anti-entropy with one random
    peer (services_delegate.go:146-167).
-4. **sweep** — every 2 s, the lifespan/tombstone-GC sweep (ops/ttl.py).
+4. **sweep** — every 2 s, the lifespan/tombstone-GC sweep (ops/ttl.py);
+   expired cells get stamped eligible, the vectorized analog of the 10×
+   tombstone rebroadcast (services_state.go:620-624).
 
 Everything is shape-static and scan-compatible; ``run`` drives N rounds
 under ``jax.lax.scan`` and reports a per-round convergence fraction.
@@ -31,6 +39,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Callable, Optional
 
 import jax
@@ -51,7 +60,7 @@ class SimState:
     """Pytree carried through the round scan."""
 
     known: jax.Array       # int32 [N, M] packed (ts<<3|status)
-    sent: jax.Array        # int8 [N, M] transmit counts (TransmitLimited queue)
+    acc: jax.Array         # int8 [N, M] round-stamp of last change (mod 256)
     node_alive: jax.Array  # bool [N] — cluster membership (churn/SWIM)
     round_idx: jax.Array   # int32 scalar — completed rounds
 
@@ -76,8 +85,15 @@ class SimParams:
     def resolved_retransmit_limit(self) -> int:
         if self.retransmit_limit > 0:
             return self.retransmit_limit
-        import math
         return 4 * math.ceil(math.log10(self.n + 1))
+
+    def eligible_window(self) -> int:
+        """Rounds a freshly-changed record stays in the broadcast queue:
+        TransmitLimited's ``limit`` transmissions at ``fanout`` per round
+        (capped below the mod-256 stamp wrap; eligible_mask uses
+        ``diff <= window``)."""
+        return min(254, max(1, -(-self.resolved_retransmit_limit()
+                                 // self.fanout)))
 
 
 # A perturbation hook: (state, key, now_tick) -> state, applied before each
@@ -127,105 +143,109 @@ class ExactSim:
         known = known.at[rows, cols].set(vals)
         return SimState(
             known=known,
-            sent=jnp.zeros((p.n, p.m), dtype=jnp.int8),
+            acc=jnp.zeros((p.n, p.m), dtype=jnp.int8),
             node_alive=jnp.ones((p.n,), dtype=bool),
             round_idx=jnp.zeros((), jnp.int32),
         )
 
     # -- kernels -----------------------------------------------------------
 
-    def _announce(self, known, node_alive, round_idx, now_tick):
-        """Owners re-stamp their own live records on the refresh schedule.
-
-        This is ``BroadcastServices``'s 1-minute refresh path
-        (services_state.go:547-549), staggered per node.  The reference's
-        extra 5×/10× @ 1 Hz announce repeats (ALIVE_COUNT/TOMBSTONE_COUNT)
-        exist to keep a new record version in the gossip queue long enough
-        to be delivered; here the transmit-count queue provides exactly
-        that (a fresh version has ``sent == 0`` and stays eligible for
-        ~retransmit_limit/fanout rounds), so repeats need no re-stamping.
-        Tombstones are never refreshed — they age out via the 3 h GC.
-        """
+    def _announce_updates(self, known, node_alive, round_idx, now_tick):
+        """Update triples for the owners' refresh re-stamps
+        (``BroadcastServices``'s 1-minute path, services_state.go:547-549,
+        staggered per node).  Non-due cells are masked to val 0 / row OOB
+        so the combined scatter drops them.  Tombstones are never
+        refreshed — they age out via the 3 h GC."""
         p, t = self.p, self.t
-        own = known[self.owner, jnp.arange(p.m)]          # [M] owner's own cells
+        cols = jnp.arange(p.m, dtype=jnp.int32)
+        own = known[self.owner, cols]              # [M] owners' own cells
         st = unpack_status(own)
         present = is_known(own) & node_alive[self.owner]
 
         phase = self.owner % t.refresh_rounds
-        refresh_due = (round_idx % t.refresh_rounds) == phase
+        due = ((round_idx % t.refresh_rounds) == phase) & present \
+            & (st != TOMBSTONE)
 
-        due = refresh_due & present & (st != TOMBSTONE)
-        new_own = jnp.where(due, pack(now_tick, st), own)
-        return known.at[self.owner, jnp.arange(p.m)].set(new_own)
+        vals = jnp.where(due, pack(now_tick, st), 0)
+        rows = jnp.where(due, self.owner, p.n)     # OOB row drops the entry
+        return rows, cols, vals, due
 
     def _step(self, state: SimState, key: jax.Array) -> SimState:
         p, t = self.p, self.t
-        limit = p.resolved_retransmit_limit()
+        window = p.eligible_window()
         round_idx = state.round_idx + 1
         now = round_idx * t.round_ticks
         k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
 
         if self.perturb is not None:
             state = self.perturb(state, k_perturb, now)
-        known, sent, node_alive = state.known, state.sent, state.node_alive
+        known, acc, node_alive = state.known, state.acc, state.node_alive
 
-        def reset_changed(sent, pre, post):
-            # A changed cell is a freshly-accepted/announced record version:
-            # re-enqueue it (transmit count 0) — the vectorized `retransmit`
-            # (services_state.go:377-392).
-            return jnp.where(post != pre, jnp.int8(0), sent)
-
-        pre = known
-        known = self._announce(known, node_alive, round_idx, now)
-        sent = reset_changed(sent, pre, known)
-
+        # 1. select + gossip deliveries (from the pre-round state).
         dst = gossip_ops.sample_peers(
             k_peers, p.n, p.fanout,
             nbrs=self._nbrs, deg=self._deg,
             node_alive=node_alive, cut_mask=self._cut,
         )
-        svc_idx, msg = gossip_ops.select_messages(known, sent, p.budget, limit)
-        sent = gossip_ops.record_transmissions(sent, svc_idx, msg, p.fanout, limit)
-        pre = known
-        known = gossip_ops.deliver(
+        svc_idx, msg = gossip_ops.select_messages(
+            known, acc, round_idx, p.budget, window)
+        d_rows, d_cols, d_vals, d_adv = gossip_ops.prepare_deliveries(
             known, dst, svc_idx, msg,
             now_tick=now, stale_ticks=t.stale_ticks,
             node_alive=node_alive,
             drop_prob=p.drop_prob, drop_key=k_drop,
         )
-        sent = reset_changed(sent, pre, known)
 
-        pre = known
+        # 2. announce re-stamps, folded into the same scatter.
+        a_rows, a_cols, a_vals, a_due = self._announce_updates(
+            known, node_alive, round_idx, now)
+
+        rows = jnp.concatenate([d_rows, a_rows])
+        cols = jnp.concatenate([d_cols, a_cols])
+        vals = jnp.concatenate([d_vals, a_vals])
+        advanced = jnp.concatenate([d_adv, a_due])
+        known, acc = gossip_ops.apply_updates(
+            known, acc, rows, cols, vals, advanced, round_idx)
+
+        # 3. anti-entropy push-pull (amortized: every push_pull_rounds).
         pp_partner = gossip_ops.sample_peers(
             k_pp, p.n, 1,
             nbrs=self._nbrs, deg=self._deg,
             node_alive=node_alive, cut_mask=self._cut,
         )[:, 0]
-        known = lax.cond(
-            round_idx % t.push_pull_rounds == 0,
-            lambda kn: gossip_ops.push_pull(
-                kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
-                node_alive=node_alive),
-            lambda kn: kn,
-            known,
-        )
-        sent = reset_changed(sent, pre, known)
 
-        pre = known
-        known = lax.cond(
-            round_idx % t.sweep_rounds == 0,
-            lambda kn: ttl_sweep(
+        def do_push_pull(kn_ac):
+            kn, ac = kn_ac
+            merged = gossip_ops.push_pull(
+                kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
+                node_alive=node_alive)
+            stamp = (round_idx & 255).astype(jnp.int8)
+            ac = jnp.where(merged != kn, stamp, ac)
+            return merged, ac
+
+        known, acc = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            do_push_pull, lambda kn_ac: kn_ac, (known, acc))
+
+        # 4. lifespan sweep (amortized: every sweep_rounds).  Expired
+        # cells are stamped eligible — the 10× tombstone rebroadcast.
+        def do_sweep(kn_ac):
+            kn, ac = kn_ac
+            swept, expired = ttl_sweep(
                 kn, now,
                 alive_lifespan=t.alive_lifespan,
                 draining_lifespan=t.draining_lifespan,
                 tombstone_lifespan=t.tombstone_lifespan,
-                one_second=t.one_second)[0],
-            lambda kn: kn,
-            known,
-        )
-        sent = reset_changed(sent, pre, known)
+                one_second=t.one_second)
+            stamp = (round_idx & 255).astype(jnp.int8)
+            ac = jnp.where(swept != kn, stamp, ac)
+            return swept, ac
 
-        return SimState(known=known, sent=sent, node_alive=node_alive,
+        known, acc = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            do_sweep, lambda kn_ac: kn_ac, (known, acc))
+
+        return SimState(known=known, acc=acc, node_alive=node_alive,
                         round_idx=round_idx)
 
     def convergence(self, state: SimState) -> jax.Array:

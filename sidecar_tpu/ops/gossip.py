@@ -11,14 +11,34 @@ This is the TPU recast of the reference's broadcast loop:
   messages first-fit into one ~1398 B UDP packet (``GetBroadcasts`` +
   ``packPacket``, services_delegate.go:85-144,182-223), so each round
   carries a bounded number of the *freshest* records.  Here:
-  :func:`select_messages` takes the top-``budget`` packed keys per node —
-  freshest-first, because packed keys order by timestamp.  Records a node
-  just accepted have the newest timestamps, so epidemic relay
-  (``retransmit``, services_state.go:342-345,377-392) emerges from the
-  same top-k without explicit queues.
-* Delivery — one scatter-max over (target, service) cells, i.e. the
-  batched ``AddServiceEntry`` merge, followed by the DRAINING-stickiness
-  fixup (see ops/merge.py).
+  :func:`select_messages` takes the top-``budget`` packed keys per node
+  among *eligible* records — those whose cell changed within the last
+  ``window`` rounds, tracked by an int8 round-stamp tensor ``acc``
+  (the vectorized broadcast queue; see below).  Records a node just
+  accepted have both a fresh stamp and the newest timestamps, so epidemic
+  relay (``retransmit``, services_state.go:342-345,377-392) emerges from
+  the same top-k without explicit queues.
+* Delivery — ONE scatter-max over (target, service) cells — the batched
+  ``AddServiceEntry`` merge — with DRAINING stickiness applied to the
+  message values *before* the scatter (against the pre-round state), and
+  ONE int8 scatter stamping accepted cells into ``acc``.  Scatters on
+  the big state tensors dominate the round on TPU (each costs a full
+  buffer rewrite), so the kernel is built around exactly one scatter per
+  tensor per round; the announce path's updates are folded into the same
+  scatter via the ``extra_*`` operands.
+
+Eligibility bookkeeping (the ``acc`` tensor): the reference's
+TransmitLimited queue lets each record version be transmitted
+``RetransmitMult × ⌈log10(n+1)⌉`` times at ``fanout`` sends per round —
+i.e. a version stays in the queue ~limit/fanout rounds after (re-)entry,
+and acceptance of a newer version re-enqueues it.  The vectorized
+equivalent stamps ``acc[cell] = round & 255`` whenever the cell changes;
+a record is eligible while ``(round - acc) mod 256 < window``.  The mod-
+256 wrap can make long-idle cells spuriously eligible for ``window``
+rounds every 256 rounds — those stale offers lose the freshest-first
+top-k to any real traffic, and delivering an old record a peer already
+knows is a merge no-op (at worst it is bonus anti-entropy).
+
 * Anti-entropy — every PushPullInterval (20 s) each memberlist node does a
   full two-way state exchange with one random peer
   (services_delegate.go:146-167, main.go:252-256).  Here:
@@ -33,11 +53,17 @@ no-op), modeling UDP loss — which the reference's 5×/10× announce repeats
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from sidecar_tpu.ops.merge import apply_stickiness, merge_packed, staleness_mask
+from sidecar_tpu.ops.merge import (
+    merge_packed,
+    staleness_mask,
+    sticky_adjust,
+)
 
 
 def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
@@ -74,51 +100,92 @@ def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
     return dst
 
 
-def select_messages(known, sent, budget, retransmit_limit):
+def eligible_mask(acc, round_idx, window):
+    """True where a cell changed within the last ``window`` rounds.
+
+    ``acc`` is the int8 round-stamp tensor (round & 255 at last change);
+    see the module docstring for the TransmitLimited mapping.  A cell
+    stamped during round r is first observable by round r+1's select
+    (diff == 1), so eligibility is ``diff <= window`` — the record is
+    offered for exactly ``window`` rounds."""
+    acc32 = acc.astype(jnp.int32) & 255
+    diff = ((jnp.asarray(round_idx, jnp.int32) & 255) - acc32) & 255
+    return diff <= window
+
+
+def select_messages(known, acc, round_idx, budget, window):
     """Top-``budget`` freshest *eligible* records per node.
 
     The reference's broadcast queue (``GetBroadcasts`` draining
     ``state.Broadcasts`` + pending leftovers into a ~1398 B packet,
-    services_delegate.go:85-144) holds only records that were recently
-    announced or relayed, and memberlist's TransmitLimited queue drops a
-    message after ``RetransmitMult × ⌈log10(n+1)⌉`` transmissions.  The
-    vectorized equivalent: a record is *eligible* while its transmit
-    count is below the retransmit limit; eligible records are offered
-    freshest-first (packed keys sort by timestamp), up to ``budget`` per
-    round.  Acceptance of a record resets its count to zero — that is the
-    re-enqueue performed by ``retransmit`` (services_state.go:377-392),
-    and it is what makes epidemic relay emerge.
+    services_delegate.go:85-144) holds only recently-announced or
+    recently-relayed records; eligibility here is "cell changed within
+    ``window`` rounds" (see module docstring).  Eligible records are
+    offered freshest-first (packed keys sort by timestamp), up to
+    ``budget`` per round.
 
     Returns (svc_idx[N, B], msg[N, B]) — ``msg`` is 0 (merge no-op) in
     slots where a node has fewer than ``budget`` eligible records.
     """
-    eligible = sent < retransmit_limit
-    priority = jnp.where(eligible, known, 0)
-    msg, svc_idx = lax.top_k(priority, budget)
+    priority = jnp.where(eligible_mask(acc, round_idx, window), known, 0)
+    n, m = priority.shape
+
+    if m <= 4 * 1024:
+        msg, svc_idx = lax.top_k(priority, budget)
+        return svc_idx.astype(jnp.int32), msg
+
+    # Two-stage exact top-k for wide rows: a flat top_k over M dominates
+    # the whole round on TPU, so split the row into G groups, rank groups
+    # by their max (one cheap bandwidth-bound pass), gather the top
+    # ``budget`` groups, and run the real top_k over that small slice.
+    # Any true top-``budget`` element has at most budget-1 elements above
+    # it, hence at most budget-1 groups with a strictly larger max, so its
+    # group is always among the gathered ones (ties resolve to an
+    # equal-valued — i.e. identical — record).
+    sub = max(8, math.isqrt(m // budget) + 1)
+    g = -(-m // sub)  # ceil
+    pad = g * sub - m
+    if pad:
+        priority = jnp.pad(priority, ((0, 0), (0, pad)))
+    pr = priority.reshape(n, g, sub)
+    gmax = jnp.max(pr, axis=2)
+    _, top_g = lax.top_k(gmax, budget)                         # [N, budget]
+    cand = jnp.take_along_axis(pr, top_g[:, :, None], axis=1)  # [N, budget, sub]
+    msg, pos = lax.top_k(cand.reshape(n, budget * sub), budget)
+    gsel = pos // sub
+    off = pos % sub
+    svc_idx = jnp.take_along_axis(top_g, gsel, axis=1) * sub + off
+    # Padding cells carry priority 0 (merge no-op); their indices may lie
+    # past M-1, which every consumer drops (scatter mode="drop") or
+    # ignores (msg == 0 short-circuits).  Clamp anyway so gathers stay in
+    # bounds.
+    svc_idx = jnp.minimum(svc_idx, m - 1)
     return svc_idx.astype(jnp.int32), msg
 
 
-def record_transmissions(sent, svc_idx, msg, fanout, retransmit_limit):
-    """Bump transmit counts for the records actually offered this round
-    (``fanout`` sends each), saturating at the retransmit limit."""
-    n = sent.shape[0]
-    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
-    bump = jnp.where(msg > 0, fanout, 0).astype(sent.dtype)
-    new = sent.at[rows, svc_idx].add(bump, mode="drop")
-    return jnp.minimum(new, retransmit_limit)
-
-
-def deliver(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
-            node_alive=None, drop_prob=0.0, drop_key=None):
-    """Scatter-merge every sender's message batch into its targets.
+def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
+                       node_alive=None, drop_prob=0.0, drop_key=None):
+    """Expand each sender's message batch into flat (row, col, val) update
+    triples with all merge semantics pre-applied.
 
     Each sender transmits its ``B`` selected records to each of its ``F``
-    targets; delivery is a single scatter-max over (target, service) cells
-    followed by the DRAINING-stickiness fixup — the batched equivalent of
-    one ``AddServiceEntry`` per received gossip message
-    (services_delegate.go:72-83 → services_state.go:293-347).
+    targets — the batched equivalent of one ``AddServiceEntry`` per
+    received gossip message (services_delegate.go:72-83 →
+    services_state.go:293-347):
 
-    Returns the merged ``known``.
+    * staleness gate (services_state.go:302-308) — stale vals become 0;
+    * dead senders transmit nothing, dead receivers accept nothing;
+    * ``drop_prob`` models UDP loss;
+    * DRAINING stickiness (services_state.go:329-331) — where a delivery
+      would advance a cell DRAINING→ALIVE, the delivered value itself is
+      rewritten to DRAINING at the new timestamp, evaluated against the
+      pre-round state.  (The reference applies messages sequentially, so
+      same-batch races are order-dependent there; this kernel resolves
+      them one consistent way — max over sticky-adjusted values.)
+
+    Returns (rows, cols, vals, advanced): int32 [N·F·B] flat triples plus
+    the bool mask of entries that strictly advance their target cell
+    (exactly the cells whose merge is an accept — used to stamp ``acc``).
     """
     n, fanout = dst.shape
     budget = svc_idx.shape[1]
@@ -127,11 +194,9 @@ def deliver(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
     tgt = jnp.broadcast_to(dst[:, :, None], (n, fanout, budget))
     svc = jnp.broadcast_to(svc_idx[:, None, :], (n, fanout, budget))
 
-    # Staleness gate (services_state.go:302-308).
     val = jnp.where(staleness_mask(val, now_tick, stale_ticks), 0, val)
 
     if node_alive is not None:
-        # Dead senders transmit nothing; dead receivers merge nothing.
         val = jnp.where(node_alive[:, None, None], val, 0)
         val = jnp.where(node_alive[tgt], val, 0)
 
@@ -139,8 +204,35 @@ def deliver(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
         keep = jax.random.bernoulli(drop_key, 1.0 - drop_prob, val.shape)
         val = jnp.where(keep, val, 0)
 
-    post = known.at[tgt, svc].max(val, mode="drop")
-    return apply_stickiness(known, post)
+    rows = tgt.reshape(-1)
+    cols = svc.reshape(-1)
+    val = val.reshape(-1)
+
+    pre_vals = known[rows, cols]
+    advanced = val > pre_vals
+    val = sticky_adjust(val, pre_vals, advanced)
+    return rows, cols, val, advanced
+
+
+def apply_updates(known, acc, rows, cols, vals, advanced, round_idx,
+                  num_rows=None):
+    """The two scatters of a gossip round: merge ``vals`` into ``known``
+    (scatter-max) and stamp ``acc`` at advanced cells.
+
+    Callers concatenate ALL of a round's updates (gossip deliveries +
+    announce re-stamps) into one call — scatters on the big tensors cost
+    a full buffer rewrite each on TPU, so one per tensor per round is the
+    budget.  ``num_rows`` overrides the out-of-bounds row used to drop
+    non-advancing stamps (defaults to known's row count; sharded callers
+    pass their local block height).
+    """
+    oob = known.shape[0] if num_rows is None else num_rows
+    known = known.at[rows, cols].max(vals, mode="drop")
+    stamp_rows = jnp.where(advanced, rows, oob)
+    stamp = ((jnp.asarray(round_idx, jnp.int32) & 255)
+             .astype(acc.dtype))
+    acc = acc.at[stamp_rows, cols].set(stamp, mode="drop")
+    return known, acc
 
 
 def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None):
@@ -161,10 +253,15 @@ def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None):
     if node_alive is not None:
         partner = jnp.where(node_alive & node_alive[partner], partner, self_idx)
 
-    # Pull: our row ← partner's row.
+    # Pull: our row ← partner's row (stickiness inside merge_packed is
+    # evaluated against the pre-exchange state).
     pulled = merge_packed(known, known[partner], now_tick, stale_ticks)
 
-    # Push: partner's row ← our (pre-exchange) row.
+    # Push: partner's row ← our (pre-exchange) row.  Stickiness is
+    # applied to the offered values against the RECEIVER's pre-exchange
+    # row — both phases resolve vs the same snapshot, matching the
+    # oracle's batch resolution.
     offered = jnp.where(staleness_mask(known, now_tick, stale_ticks), 0, known)
-    pushed = pulled.at[partner].max(offered, mode="drop")
-    return apply_stickiness(pulled, pushed)
+    pre_tgt = known[partner]
+    offered = sticky_adjust(offered, pre_tgt, offered > pre_tgt)
+    return pulled.at[partner].max(offered, mode="drop")

@@ -52,6 +52,26 @@ def staleness_mask(packed, now_tick, stale_ticks):
     return (ts > 0) & (ts < jnp.asarray(now_tick, jnp.int32) - jnp.asarray(stale_ticks, jnp.int32))
 
 
+def sticky_adjust(vals, pre_vals, advanced):
+    """Apply DRAINING stickiness to incoming message values against the
+    receiver's pre-batch state (services_state.go:329-331): where an
+    advancing value would flip a known DRAINING cell to ALIVE, rewrite
+    the value itself to DRAINING at the new timestamp.
+
+    ``advanced`` is the precomputed ``vals > pre_vals`` mask (callers
+    usually need it for accept-stamping as well).  Used by every delivery
+    path — gossip scatter, push-pull, and their sharded twins — so batch
+    races resolve one consistent way everywhere.
+    """
+    sticky = (
+        advanced
+        & is_known(pre_vals)
+        & (unpack_status(pre_vals) == DRAINING)
+        & (unpack_status(vals) == ALIVE)
+    )
+    return jnp.where(sticky, pack(unpack_ts(vals), DRAINING), vals)
+
+
 def apply_stickiness(pre, post):
     """Re-apply DRAINING stickiness after a max-merge.
 

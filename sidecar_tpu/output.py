@@ -1,0 +1,32 @@
+"""Human-readable output helpers (reference: output/output.go:8-31)."""
+
+from __future__ import annotations
+
+from sidecar_tpu.service import NS_PER_SECOND
+
+
+def time_ago(when_ns: int, ref_ns: int) -> str:
+    """Humanized elapsed time, mirroring output.TimeAgo's buckets."""
+    if when_ns == 0:
+        return "never"
+    diff = (ref_ns - when_ns) / NS_PER_SECOND
+    if diff < 0:
+        return "in the future"
+    if diff < 1.5:
+        return "1 sec ago"
+    if diff < 60:
+        return f"{int(diff)} secs ago"
+    mins = diff / 60
+    if mins < 1.5:
+        return "1 min ago"
+    if mins < 60:
+        return f"{int(mins)} mins ago"
+    hours = mins / 60
+    if hours < 1.5:
+        return "1 hour ago"
+    if hours < 24:
+        return f"{int(hours)} hours ago"
+    days = hours / 24
+    if days < 1.5:
+        return "1 day ago"
+    return f"{int(days)} days ago"

@@ -25,6 +25,11 @@ Cross-device traffic, by construction, is only:
   (services_delegate.go:146-167) is a deliberate scalability trade and is
   visible only in the tail of convergence curves.
 
+Like the single-chip model, the round is built around ONE scatter-max on
+``known`` and ONE stamp scatter on ``acc`` per shard per round (scatters
+on the big tensors cost a full buffer rewrite each on TPU); announce
+updates ride the same scatter.
+
 Partitions: pass ``node_side`` (int[N] side assignment) — gossip edges are
 cut via ``cut_mask`` exactly as in the single-chip model, and the stride
 exchange is masked where the two sides differ (a network split severs TCP
@@ -46,8 +51,13 @@ from jax.experimental.shard_map import shard_map
 from sidecar_tpu.models.exact import SimParams, SimState
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
-from sidecar_tpu.ops.merge import apply_stickiness, merge_packed, staleness_mask
-from sidecar_tpu.ops.status import TOMBSTONE, is_known, pack, unpack_status
+from sidecar_tpu.ops.merge import merge_packed, staleness_mask, sticky_adjust
+from sidecar_tpu.ops.status import (
+    TOMBSTONE,
+    is_known,
+    pack,
+    unpack_status,
+)
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
 from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh
@@ -55,7 +65,8 @@ from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh
 
 class ShardedSim:
     """Multi-device exact simulator; protocol semantics match ExactSim
-    except for the documented anti-entropy pairing."""
+    except for the documented anti-entropy pairing (and independent PRNG
+    streams per shard)."""
 
     def __init__(self, params: SimParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
@@ -97,106 +108,57 @@ class ShardedSim:
         repl = NamedSharding(self.mesh, P())
         return SimState(
             known=jax.device_put(jnp.asarray(known), shard),
-            sent=jax.device_put(jnp.zeros((p.n, p.m), jnp.int8), shard),
+            acc=jax.device_put(jnp.zeros((p.n, p.m), jnp.int8), shard),
             node_alive=jax.device_put(jnp.ones((p.n,), bool), repl),
             round_idx=jax.device_put(jnp.zeros((), jnp.int32), repl),
         )
 
     # -- the per-shard gossip round (inside shard_map) ---------------------
 
-    def _gossip_shard(self, known_l, sent_l, alive, key, round_idx):
-        """Announce + gossip + sweep for one shard's node block.
-        ``alive`` is the full (replicated) [N] liveness vector."""
-        p, t = self.p, self.t
-        limit = p.resolved_retransmit_limit()
-        s = p.services_per_node
-        nl = known_l.shape[0]
-        ax = lax.axis_index(NODE_AXIS)
-        r0 = (ax * nl).astype(jnp.int32)
-        now = round_idx * t.round_ticks
-
-        def reset_changed(sent, pre, post):
-            return jnp.where(post != pre, jnp.int8(0), sent)
-
-        # announce (owners of my rows' slots are exactly my rows)
-        lr = jnp.arange(nl * s, dtype=jnp.int32) // s
-        cols = r0 * s + jnp.arange(nl * s, dtype=jnp.int32)
-        own = known_l[lr, cols]
-        st = unpack_status(own)
-        present = is_known(own) & alive[r0 + lr]
-        phase = (r0 + lr) % t.refresh_rounds
-        due = ((round_idx % t.refresh_rounds) == phase) & present & (st != TOMBSTONE)
-        pre = known_l
-        known_l = known_l.at[lr, cols].set(jnp.where(due, pack(now, st), own))
-        sent_l = reset_changed(sent_l, pre, known_l)
-
-        # peer sampling (global dst indices), per-shard PRNG stream.
-        # This variant handles only the complete topology; neighbor-list
-        # topologies go through _gossip_shard_nbrs, which takes the sharded
-        # nbrs/deg blocks as shard_map operands.
-        key_shard = jax.random.fold_in(key, ax)
-        k_peers, k_drop = jax.random.split(key_shard)
-        gi = r0 + jnp.arange(nl, dtype=jnp.int32)      # my global node ids
+    def _sample_dst_complete(self, k_peers, gi, alive, nl):
+        """Complete-topology sampling (uniform over the whole cluster,
+        self-excluded via the shift trick)."""
+        p = self.p
         r = jax.random.randint(k_peers, (nl, p.fanout), 0, p.n - 1,
                                dtype=jnp.int32)
         dst = r + (r >= gi[:, None]).astype(jnp.int32)
-        dst = jnp.where(alive[gi][:, None], dst, gi[:, None])
+        return jnp.where(alive[gi][:, None], dst, gi[:, None])
 
-        return self._gossip_tail(known_l, sent_l, alive, dst, gi, now,
-                                 k_drop, round_idx, limit)
-
-    def _gossip_shard_nbrs(self, known_l, sent_l, alive, nbrs_l, deg_l,
-                           cut_l, key, round_idx):
-        p, t = self.p, self.t
-        limit = p.resolved_retransmit_limit()
-        s = p.services_per_node
-        nl = known_l.shape[0]
-        ax = lax.axis_index(NODE_AXIS)
-        r0 = (ax * nl).astype(jnp.int32)
-        now = round_idx * t.round_ticks
-
-        def reset_changed(sent, pre, post):
-            return jnp.where(post != pre, jnp.int8(0), sent)
-
-        lr = jnp.arange(nl * s, dtype=jnp.int32) // s
-        cols = r0 * s + jnp.arange(nl * s, dtype=jnp.int32)
-        own = known_l[lr, cols]
-        st = unpack_status(own)
-        present = is_known(own) & alive[r0 + lr]
-        phase = (r0 + lr) % t.refresh_rounds
-        due = ((round_idx % t.refresh_rounds) == phase) & present & (st != TOMBSTONE)
-        pre = known_l
-        known_l = known_l.at[lr, cols].set(jnp.where(due, pack(now, st), own))
-        sent_l = reset_changed(sent_l, pre, known_l)
-
-        key_shard = jax.random.fold_in(key, ax)
-        k_peers, k_drop = jax.random.split(key_shard)
-        gi = r0 + jnp.arange(nl, dtype=jnp.int32)
+    def _sample_dst_nbrs(self, k_peers, gi, alive, nl, nbrs_l, deg_l, cut_l):
+        p = self.p
         slot = jax.random.randint(k_peers, (nl, p.fanout), 0,
-                                  jnp.maximum(deg_l, 1)[:, None], dtype=jnp.int32)
+                                  jnp.maximum(deg_l, 1)[:, None],
+                                  dtype=jnp.int32)
         dst = jnp.take_along_axis(nbrs_l, slot, axis=1)
         if cut_l is not None:
             cut = jnp.take_along_axis(cut_l, slot, axis=1)
             dst = jnp.where(cut, gi[:, None], dst)
-        dst = jnp.where(alive[gi][:, None], dst, gi[:, None])
+        return jnp.where(alive[gi][:, None], dst, gi[:, None])
 
-        return self._gossip_tail(known_l, sent_l, alive, dst, gi, now,
-                                 k_drop, round_idx, limit)
-
-    def _gossip_tail(self, known_l, sent_l, alive, dst, gi, now, k_drop,
-                     round_idx, limit):
-        """Select → all-gather messages → local scatter-merge → sweep."""
+    def _gossip_shard(self, known_l, acc_l, alive, key, round_idx,
+                      nbrs_l=None, deg_l=None, cut_l=None):
+        """One shard's gossip round: select → all-gather offers → local
+        combined scatter (deliveries + announce) → sweep."""
         p, t = self.p, self.t
+        window = p.eligible_window()
+        s = p.services_per_node
         nl = known_l.shape[0]
         ax = lax.axis_index(NODE_AXIS)
         r0 = (ax * nl).astype(jnp.int32)
+        now = round_idx * t.round_ticks
+        gi = r0 + jnp.arange(nl, dtype=jnp.int32)      # my global node ids
 
-        def reset_changed(sent, pre, post):
-            return jnp.where(post != pre, jnp.int8(0), sent)
+        key_shard = jax.random.fold_in(key, ax)
+        k_peers, k_drop = jax.random.split(key_shard)
+        if nbrs_l is None:
+            dst = self._sample_dst_complete(k_peers, gi, alive, nl)
+        else:
+            dst = self._sample_dst_nbrs(k_peers, gi, alive, nl,
+                                        nbrs_l, deg_l, cut_l)
 
-        svc_idx, msg = gossip_ops.select_messages(known_l, sent_l, p.budget, limit)
-        sent_l = gossip_ops.record_transmissions(sent_l, svc_idx, msg,
-                                                 p.fanout, limit)
+        # Select offers from the local block.
+        svc_idx, msg = gossip_ops.select_messages(
+            known_l, acc_l, round_idx, p.budget, window)
 
         # The only cross-shard gossip traffic: the message offers.
         dst_all = lax.all_gather(dst, NODE_AXIS, tiled=True)        # [N, F]
@@ -217,56 +179,83 @@ class ShardedSim:
             keep = jax.random.bernoulli(k_drop, 1.0 - p.drop_prob, val.shape)
             val = jnp.where(keep, val, 0)
 
-        tgt_local = tgt - r0  # rows outside [0, nl) are dropped by the scatter
-        pre = known_l
-        post = known_l.at[tgt_local, svc].max(val, mode="drop")
-        known_l = apply_stickiness(pre, post)
-        sent_l = reset_changed(sent_l, pre, known_l)
+        # Localize: rows outside [0, nl) belong to other shards — their
+        # gathers clamp harmlessly and their scatters drop.
+        tgt_local = (tgt - r0).reshape(-1)
+        cols = svc.reshape(-1)
+        val = val.reshape(-1)
+        local = (tgt_local >= 0) & (tgt_local < nl)
+        val = jnp.where(local, val, 0)
 
-        # lifespan sweep (local)
-        pre = known_l
-        known_l = lax.cond(
-            round_idx % t.sweep_rounds == 0,
-            lambda kn: ttl_sweep(
+        pre_vals = known_l[tgt_local, cols]
+        advanced = (val > pre_vals) & local
+        val = sticky_adjust(val, pre_vals, advanced)
+        d_rows = jnp.where(local, tgt_local, nl)
+
+        # Announce (owners of my rows' slots are exactly my rows).
+        lr = jnp.arange(nl * s, dtype=jnp.int32) // s
+        a_cols = r0 * s + jnp.arange(nl * s, dtype=jnp.int32)
+        own = known_l[lr, a_cols]
+        st = unpack_status(own)
+        present = is_known(own) & alive[r0 + lr]
+        phase = (r0 + lr) % t.refresh_rounds
+        due = ((round_idx % t.refresh_rounds) == phase) & present \
+            & (st != TOMBSTONE)
+        a_vals = jnp.where(due, pack(now, st), 0)
+        a_rows = jnp.where(due, lr, nl)
+
+        rows = jnp.concatenate([d_rows, a_rows])
+        cols = jnp.concatenate([cols, a_cols])
+        vals = jnp.concatenate([val, a_vals])
+        adv = jnp.concatenate([advanced, due])
+        known_l, acc_l = gossip_ops.apply_updates(
+            known_l, acc_l, rows, cols, vals, adv, round_idx, num_rows=nl)
+
+        # Lifespan sweep (local, amortized).
+        def do_sweep(kn_ac):
+            kn, ac = kn_ac
+            swept, _ = ttl_sweep(
                 kn, now,
                 alive_lifespan=t.alive_lifespan,
                 draining_lifespan=t.draining_lifespan,
                 tombstone_lifespan=t.tombstone_lifespan,
-                one_second=t.one_second)[0],
-            lambda kn: kn,
-            known_l,
-        )
-        sent_l = reset_changed(sent_l, pre, known_l)
-        return known_l, sent_l
+                one_second=t.one_second)
+            ac = jnp.where(swept != kn,
+                           (round_idx & 255).astype(jnp.int8), ac)
+            return swept, ac
+
+        known_l, acc_l = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            do_sweep, lambda kn_ac: kn_ac, (known_l, acc_l))
+        return known_l, acc_l
 
     # -- anti-entropy stride exchange (jit level, sharding-propagated) -----
 
-    def _push_pull_stride(self, known, sent, alive, key, now):
+    def _push_pull_stride(self, known, acc, alive, key, now, round_idx):
         """Two-way full-state exchange with the node `stride` positions
         away on the ring; jnp.roll on the sharded axis becomes an XLA
         collective-permute."""
         t = self.t
         stride = jax.random.randint(key, (), 1, self.p.n, dtype=jnp.int32)
 
-        def exch(kn):
-            fwd = jnp.roll(kn, -stride, axis=0)   # row i sees row (i+s) mod N
-            return fwd
-
         ok = alive & jnp.roll(alive, -stride)
         if self._side is not None:
             ok &= self._side == jnp.roll(self._side, -stride)
-        fwd = jnp.where(ok[:, None], exch(known), 0)
+        fwd = jnp.where(ok[:, None], jnp.roll(known, -stride, axis=0), 0)
         pulled = merge_packed(known, fwd, now, t.stale_ticks)
 
+        # Push = the reverse roll, stickiness vs the receiver's
+        # pre-exchange row (same batch resolution as ops/gossip.push_pull).
         offered = jnp.where(staleness_mask(known, now, t.stale_ticks), 0, known)
         ok_back = alive & jnp.roll(alive, stride)
         if self._side is not None:
             ok_back &= self._side == jnp.roll(self._side, stride)
         back = jnp.where(ok_back[:, None], jnp.roll(offered, stride, axis=0), 0)
-        pushed = jnp.maximum(pulled, back)
-        merged = apply_stickiness(pulled, pushed)
-        sent = jnp.where(merged != known, jnp.int8(0), sent)
-        return merged, sent
+        back = sticky_adjust(back, known, back > known)
+        merged = jnp.maximum(pulled, back)
+        acc = jnp.where(merged != known,
+                        (round_idx & 255).astype(jnp.int8), acc)
+        return merged, acc
 
     # -- drivers -----------------------------------------------------------
 
@@ -282,44 +271,46 @@ class ShardedSim:
             fn = shard_map(
                 self._gossip_shard,
                 mesh=self.mesh,
-                in_specs=(spec_row, spec_row, spec_repl, spec_repl, spec_repl),
+                in_specs=(spec_row, spec_row, spec_repl, spec_repl,
+                          spec_repl),
                 out_specs=(spec_row, spec_row),
                 check_rep=False,
             )
-            known, sent = fn(state.known, state.sent, state.node_alive,
-                             k_round, round_idx)
+            known, acc = fn(state.known, state.acc, state.node_alive,
+                            k_round, round_idx)
+        elif self._cut is not None:
+            def wrapper(kn, ac, al, nb, dg, ct, k, r):
+                return self._gossip_shard(kn, ac, al, k, r, nbrs_l=nb,
+                                          deg_l=dg, cut_l=ct)
+            fn = shard_map(
+                wrapper, mesh=self.mesh,
+                in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 3
+                         + (spec_repl, spec_repl),
+                out_specs=(spec_row, spec_row), check_rep=False)
+            known, acc = fn(state.known, state.acc, state.node_alive,
+                            self._nbrs, self._deg, self._cut, k_round,
+                            round_idx)
         else:
-            cut = self._cut
-            def wrapper(kn, se, al, nb, dg, ct, k, r):
-                return self._gossip_shard_nbrs(kn, se, al, nb, dg, ct, k, r)
-            def wrapper_nocut(kn, se, al, nb, dg, k, r):
-                return self._gossip_shard_nbrs(kn, se, al, nb, dg, None, k, r)
-            if cut is not None:
-                fn = shard_map(
-                    wrapper, mesh=self.mesh,
-                    in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 3
-                             + (spec_repl, spec_repl),
-                    out_specs=(spec_row, spec_row), check_rep=False)
-                known, sent = fn(state.known, state.sent, state.node_alive,
-                                 self._nbrs, self._deg, cut, k_round, round_idx)
-            else:
-                fn = shard_map(
-                    wrapper_nocut, mesh=self.mesh,
-                    in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 2
-                             + (spec_repl, spec_repl),
-                    out_specs=(spec_row, spec_row), check_rep=False)
-                known, sent = fn(state.known, state.sent, state.node_alive,
-                                 self._nbrs, self._deg, k_round, round_idx)
+            def wrapper_nocut(kn, ac, al, nb, dg, k, r):
+                return self._gossip_shard(kn, ac, al, k, r, nbrs_l=nb,
+                                          deg_l=dg, cut_l=None)
+            fn = shard_map(
+                wrapper_nocut, mesh=self.mesh,
+                in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 2
+                         + (spec_repl, spec_repl),
+                out_specs=(spec_row, spec_row), check_rep=False)
+            known, acc = fn(state.known, state.acc, state.node_alive,
+                            self._nbrs, self._deg, k_round, round_idx)
 
-        known, sent = lax.cond(
+        known, acc = lax.cond(
             round_idx % t.push_pull_rounds == 0,
-            lambda kn_se: self._push_pull_stride(
-                kn_se[0], kn_se[1], state.node_alive, k_pp, now),
-            lambda kn_se: kn_se,
-            (known, sent),
+            lambda kn_ac: self._push_pull_stride(
+                kn_ac[0], kn_ac[1], state.node_alive, k_pp, now, round_idx),
+            lambda kn_ac: kn_ac,
+            (known, acc),
         )
 
-        return SimState(known=known, sent=sent, node_alive=state.node_alive,
+        return SimState(known=known, acc=acc, node_alive=state.node_alive,
                         round_idx=round_idx)
 
     def convergence(self, state: SimState) -> jax.Array:

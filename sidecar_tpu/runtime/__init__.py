@@ -1,0 +1,17 @@
+"""Host-side runtime primitives for the live framework.
+
+The reference coordinates its background work with goroutines driven by
+``go-director`` loopers (seven created in main.go:318-338), which is also
+what makes its async behavior deterministically testable: tests inject a
+``FreeLooper(N)`` to run a loop exactly N times (SURVEY.md §4).  This
+package provides the same pattern for Python threads.
+"""
+
+from sidecar_tpu.runtime.looper import (
+    FreeLooper,
+    Looper,
+    TimedLooper,
+    run_in_thread,
+)
+
+__all__ = ["Looper", "FreeLooper", "TimedLooper", "run_in_thread"]

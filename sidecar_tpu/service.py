@@ -1,0 +1,283 @@
+"""The service record — wire format and lifecycle predicates.
+
+Capability mirror of the reference's ``service`` package
+(service/service.go:17-210): a compact record describing one service
+instance on one host, shipped over gossip as JSON.  Field names and the
+RFC3339-nanosecond timestamp encoding match the Go wire format exactly so
+a cluster can mix nodes of both implementations and downstream consumers
+(receivers, UIs) keep working.
+
+Timestamps are **integer nanoseconds** since the Unix epoch, not
+``datetime`` — the protocol's correctness leans on nanosecond resolution
+(the +50 ns broadcast skew, services_state.go:597-599) that
+``datetime``'s microseconds would silently destroy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time as _time
+from typing import Any, Iterable, Optional
+
+# Status enum — mirror of service/service.go:17-23.
+ALIVE = 0
+TOMBSTONE = 1
+UNHEALTHY = 2
+UNKNOWN = 3
+DRAINING = 4
+
+NS_PER_SECOND = 1_000_000_000
+
+# Lifecycle constants (catalog/services_state.go:26-37), in seconds.
+TOMBSTONE_LIFESPAN = 3 * 3600.0
+ALIVE_LIFESPAN = 80.0
+DRAINING_LIFESPAN = 600.0
+STALENESS_FUDGE = 60.0
+
+
+def now_ns() -> int:
+    return _time.time_ns()
+
+
+def status_string(status: int) -> str:
+    """service/service.go:168-181 — unknown codes render as Tombstone."""
+    return {
+        ALIVE: "Alive",
+        UNHEALTHY: "Unhealthy",
+        UNKNOWN: "Unknown",
+        DRAINING: "Draining",
+    }.get(status, "Tombstone")
+
+
+# -- RFC3339-nanosecond timestamps (Go time.Time JSON encoding) ------------
+
+def ns_to_rfc3339(ns: int) -> str:
+    """Render like Go's time.Time.MarshalJSON: RFC3339, nanosecond
+    precision with trailing zeros trimmed, 'Z' zone."""
+    secs, nanos = divmod(ns, NS_PER_SECOND)
+    base = _time.strftime("%Y-%m-%dT%H:%M:%S", _time.gmtime(secs))
+    if nanos:
+        frac = f"{nanos:09d}".rstrip("0")
+        return f"{base}.{frac}Z"
+    return base + "Z"
+
+
+def rfc3339_to_ns(text: str) -> int:
+    """Parse RFC3339 (with optional fractional seconds / numeric zone)."""
+    import calendar
+
+    t = text.strip()
+    offset = 0
+    if t.endswith(("Z", "z")):
+        body = t[:-1]
+    else:
+        body = t
+        for i in range(len(t) - 1, 10, -1):
+            if t[i] in "+-":
+                body = t[:i]
+                sign = -1 if t[i] == "-" else 1
+                hh, mm = t[i + 1:].split(":")
+                offset = sign * (int(hh) * 3600 + int(mm) * 60)
+                break
+    if "." in body:
+        main, frac = body.split(".", 1)
+        nanos = int((frac + "000000000")[:9])
+    else:
+        main, nanos = body, 0
+    st = _time.strptime(main, "%Y-%m-%dT%H:%M:%S")
+    secs = calendar.timegm(st) - offset
+    return secs * NS_PER_SECOND + nanos
+
+
+@dataclasses.dataclass
+class Port:
+    """One published port (service/service.go:25-30)."""
+
+    type: str = "tcp"
+    port: int = 0
+    service_port: int = 0
+    ip: str = ""
+
+    def to_json(self) -> dict:
+        return {"Type": self.type, "Port": self.port,
+                "ServicePort": self.service_port, "IP": self.ip}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Port":
+        return cls(type=d.get("Type", "tcp"), port=int(d.get("Port", 0) or 0),
+                   service_port=int(d.get("ServicePort", 0) or 0),
+                   ip=d.get("IP", "") or "")
+
+
+@dataclasses.dataclass
+class Service:
+    """One service instance record (service/service.go:32-42)."""
+
+    id: str = ""
+    name: str = ""
+    image: str = ""
+    created: int = 0           # ns since epoch
+    hostname: str = ""
+    ports: list[Port] = dataclasses.field(default_factory=list)
+    updated: int = 0           # ns since epoch — the LWW merge key
+    proxy_mode: str = "http"
+    status: int = UNKNOWN
+
+    # -- predicates (service/service.go:50-72) -----------------------------
+
+    def is_alive(self) -> bool:
+        return self.status == ALIVE
+
+    def is_tombstone(self) -> bool:
+        return self.status == TOMBSTONE
+
+    def is_draining(self) -> bool:
+        return self.status == DRAINING
+
+    def invalidates(self, other: Optional["Service"]) -> bool:
+        """True when this record supersedes ``other`` (strictly newer,
+        service/service.go:64-66)."""
+        return other is not None and self.updated > other.updated
+
+    def is_stale(self, lifespan_s: float = TOMBSTONE_LIFESPAN,
+                 now: Optional[int] = None) -> bool:
+        """Older than lifespan + 1-minute clock-drift fudge
+        (service/service.go:68-72)."""
+        now = now_ns() if now is None else now
+        oldest = now - int((lifespan_s + STALENESS_FUDGE) * NS_PER_SECOND)
+        return self.updated < oldest
+
+    def tombstone(self, now: Optional[int] = None) -> None:
+        """service/service.go:91-94."""
+        self.status = TOMBSTONE
+        self.updated = now_ns() if now is None else now
+
+    # -- accessors ---------------------------------------------------------
+
+    def status_string(self) -> str:
+        return status_string(self.status)
+
+    def version(self) -> str:
+        """Image tag, or the full image when untagged
+        (service/service.go:116-123)."""
+        parts = self.image.split(":")
+        return parts[1] if len(parts) > 1 else parts[0]
+
+    def port_for_service_port(self, find_port: int, ptype: str = "tcp") -> int:
+        """service/service.go:97-106; -1 when unmapped."""
+        for p in self.ports:
+            if p.service_port == find_port and p.type == ptype:
+                return p.port
+        return -1
+
+    def listener_name(self) -> str:
+        return f"Service({self.name}-{self.id})"
+
+    # -- wire format -------------------------------------------------------
+
+    def to_json(self) -> dict:
+        return {
+            "ID": self.id,
+            "Name": self.name,
+            "Image": self.image,
+            "Created": ns_to_rfc3339(self.created),
+            "Hostname": self.hostname,
+            "Ports": [p.to_json() for p in self.ports] or None,
+            "Updated": ns_to_rfc3339(self.updated),
+            "ProxyMode": self.proxy_mode,
+            "Status": self.status,
+        }
+
+    def encode(self) -> bytes:
+        return json.dumps(self.to_json(), separators=(",", ":")).encode()
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Service":
+        ports = d.get("Ports") or []
+        return cls(
+            id=d.get("ID", ""),
+            name=d.get("Name", ""),
+            image=d.get("Image", ""),
+            created=_parse_ts(d.get("Created")),
+            hostname=d.get("Hostname", ""),
+            ports=[Port.from_json(p) for p in ports],
+            updated=_parse_ts(d.get("Updated")),
+            proxy_mode=d.get("ProxyMode", "http") or "http",
+            status=int(d.get("Status", UNKNOWN)),
+        )
+
+    def copy(self) -> "Service":
+        return dataclasses.replace(self, ports=[dataclasses.replace(p)
+                                                for p in self.ports])
+
+
+def _parse_ts(v: Any) -> int:
+    if v is None:
+        return 0
+    if isinstance(v, (int, float)):
+        return int(v)
+    return rfc3339_to_ns(v)
+
+
+def decode(data: bytes | str) -> Service:
+    """service/service.go:127-136."""
+    try:
+        d = json.loads(data)
+    except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+        raise ValueError(f"failed to decode service JSON: {exc}") from exc
+    if not isinstance(d, dict):
+        raise ValueError("failed to decode service JSON: not an object")
+    return Service.from_json(d)
+
+
+def to_service(container: dict, ip: str, hostname: Optional[str] = None,
+               now: Optional[int] = None) -> Service:
+    """Convert a Docker API container listing into a Service record
+    (service/service.go:139-166, 184-210).
+
+    ``container`` is the dict shape of Docker's ``GET /containers/json``
+    entries: Id, Names, Image, Created (unix secs), Labels, Ports
+    ([{PrivatePort, PublicPort, Type, IP}]).  ``ServicePort_<private>``
+    labels map container ports to well-known service ports; a container
+    bound to a specific IP overrides the host IP.
+    """
+    import socket
+
+    labels = container.get("Labels") or {}
+    now = now_ns() if now is None else now
+    svc = Service(
+        id=(container.get("Id") or "")[:12],
+        name=(container.get("Names") or [""])[0],
+        image=container.get("Image", ""),
+        created=int(container.get("Created", 0)) * NS_PER_SECOND,
+        hostname=hostname if hostname is not None else socket.gethostname(),
+        updated=now,
+        proxy_mode=labels.get("ProxyMode", "http"),
+        status=ALIVE,
+    )
+    for port in container.get("Ports") or []:
+        if not port.get("PublicPort"):
+            continue
+        pip = port.get("IP") or ""
+        use_ip = pip if pip not in ("", "0.0.0.0") else ip
+        p = Port(type=port.get("Type", "tcp"), port=int(port["PublicPort"]),
+                 ip=use_ip)
+        label = f"ServicePort_{port.get('PrivatePort', 0)}"
+        if label in labels:
+            try:
+                p.service_port = int(labels[label])
+            except ValueError:
+                pass
+        svc.ports.append(p)
+    return svc
+
+
+def format_service(svc: Service, now: Optional[int] = None) -> str:
+    """Human one-liner (service/service.go:74-89)."""
+    from sidecar_tpu.output import time_ago
+
+    now = now_ns() if now is None else now
+    ports = ",".join(f"{p.service_port}->{p.port}" for p in svc.ports)
+    return (f"      {svc.id} {svc.name:<30} {ports:<15} {svc.image:<45}  "
+            f"{time_ago(svc.updated, now):<15} {svc.status_string():<9}\n")

@@ -14,7 +14,16 @@ Peer/message *sampling* is shared with the kernels (the oracle calls the
 same deterministic ``sample_peers`` / ``select_messages`` with the same
 PRNG keys); what the oracle re-implements independently is every state
 *transition*: announce scheduling, per-record LWW merge with stickiness
-and staleness, the lifespan sweep with the +1 s rule, and push-pull.
+and staleness, eligibility stamping, the lifespan sweep with the +1 s
+rule, and push-pull.
+
+Batch-resolution note: the reference applies same-round messages
+sequentially, so a round where one cell receives both a DRAINING-sticky
+and a plain update is order-dependent *in the reference itself*.  The
+kernel resolves such races one consistent way (stickiness evaluated
+against the pre-round state, then max over adjusted values); the oracle
+implements that same resolution — sequentially, record by record, but
+with stickiness against its own pre-round snapshot.
 """
 
 from __future__ import annotations
@@ -47,102 +56,149 @@ def _pack(ts: int, st: int) -> int:
 
 class OracleSim:
     """Sequential mirror of :class:`ExactSim`. Evolves its own NumPy state
-    using the same PRNG keys; `known` should match the kernel bit-for-bit
-    in scenarios without same-batch DRAINING races (see ops/merge.py)."""
+    using the same PRNG keys; `known`/`acc` should match the kernel
+    bit-for-bit."""
 
     def __init__(self, sim: ExactSim, state: SimState):
         self.sim = sim
         self.p = sim.p
         self.t = sim.t
         self.known = np.asarray(state.known).copy()
-        self.sent = np.asarray(state.sent).astype(np.int32).copy()
+        # uint8 view of the kernel's int8 stamps — same bits, and the
+        # 0..255 round-stamp domain stays printable.
+        self.acc = np.asarray(state.acc).astype(np.uint8).copy()
         self.node_alive = np.asarray(state.node_alive).copy()
         self.round_idx = int(state.round_idx)
         self.owner = np.asarray(sim.owner)
-        self.limit = sim.p.resolved_retransmit_limit()
+        self.window = sim.p.eligible_window()
 
-    # -- the Go-faithful single-record merge (AddServiceEntry) -------------
+    # -- one delivered/announced value, vs the pre-round snapshot ----------
 
-    def merge_one(self, node: int, svc: int, incoming: int, now: int) -> None:
-        """services_state.go:293-347, one record at a time."""
-        its, ist = _ts(incoming), _st(incoming)
-        if its == 0:
+    def apply_one(self, node: int, svc: int, incoming: int,
+                  pre: np.ndarray, stamp: int) -> None:
+        """One update through the merge semantics
+        (services_state.go:293-347 recast to the kernel's batch
+        resolution): staleness was already gated at prepare time; accept
+        iff the packed key advances the cell; DRAINING stickiness is
+        evaluated against the pre-round snapshot ``pre``."""
+        if incoming == 0:
             return
-        if its < now - self.t.stale_ticks:  # IsStale + fudge (:302-308)
-            return
-        cur = int(self.known[node, svc])
-        cts, cst = _ts(cur), _st(cur)
-        if cts == 0:  # unknown server/service: accept (:317-320)
-            self.known[node, svc] = incoming
-            self.sent[node, svc] = 0  # re-enqueue for relay (:377-392)
-            return
-        if its > cts:  # Invalidates: strictly newer (:321, service.go:64-66)
-            if cst == DRAINING and ist == ALIVE:  # sticky (:329-331)
-                ist = DRAINING
-            new = _pack(its, ist)
-            if new != cur:
-                self.known[node, svc] = new
-                self.sent[node, svc] = 0
+        pre_val = int(pre[node, svc])
+        if incoming > pre_val:
+            ist = _st(incoming)
+            if (pre_val >> STATUS_BITS) > 0 and _st(pre_val) == DRAINING \
+                    and ist == ALIVE:
+                incoming = _pack(_ts(incoming), DRAINING)
+            if incoming > int(self.known[node, svc]):
+                self.known[node, svc] = incoming
+            # Any advancing update marks the cell accepted this round
+            # (re-enqueue for relay, services_state.go:377-392).
+            self.acc[node, svc] = stamp
 
-    # -- announce (BroadcastServices/SendServices schedule) ----------------
+    # -- full round, mirroring ExactSim._step ------------------------------
 
-    def announce(self, round_idx: int, now: int) -> None:
+    def step(self, key: jax.Array) -> None:
         p, t = self.p, self.t
+        self.round_idx += 1
+        now = self.round_idx * t.round_ticks
+        stamp = self.round_idx & 255
+        _k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        pre = self.known.copy()
+
+        # 1. select + deliveries (sampling shared with the kernel).
+        dst = np.asarray(gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout,
+            nbrs=self.sim._nbrs, deg=self.sim._deg,
+            node_alive=jax.numpy.asarray(self.node_alive),
+            cut_mask=self.sim._cut,
+        ))
+        svc_idx, msg = gossip_ops.select_messages(
+            jax.numpy.asarray(self.known),
+            jax.numpy.asarray(self.acc),
+            jax.numpy.asarray(self.round_idx), p.budget, self.window)
+        svc_idx, msg = np.asarray(svc_idx), np.asarray(msg)
+
+        drop = None
+        if p.drop_prob > 0:
+            keep = jax.random.bernoulli(
+                k_drop, 1.0 - p.drop_prob, (p.n, p.fanout, p.budget))
+            drop = ~np.asarray(keep)
+
+        stale_floor = now - t.stale_ticks
+        for s in range(p.n):
+            if not self.node_alive[s]:
+                continue
+            for f in range(p.fanout):
+                tgt = int(dst[s, f])
+                if not self.node_alive[tgt]:
+                    continue
+                for b in range(p.budget):
+                    if drop is not None and drop[s, f, b]:
+                        continue
+                    val = int(msg[s, b])
+                    ts = val >> STATUS_BITS
+                    if ts > 0 and ts < stale_floor:  # staleness gate
+                        continue
+                    self.apply_one(tgt, int(svc_idx[s, b]), val, pre, stamp)
+
+        # 2. announce re-stamps (end of round, same scatter in the kernel).
         for m in range(p.m):
             o = int(self.owner[m])
             if not self.node_alive[o]:
                 continue
-            cur = int(self.known[o, m])
+            cur = int(pre[o, m])
             ts, st = _ts(cur), _st(cur)
             if ts == 0 or st == TOMBSTONE:
                 continue
             phase = o % t.refresh_rounds
-            if (round_idx % t.refresh_rounds) == phase:
-                new = _pack(now, st)
-                if new != cur:
-                    self.known[o, m] = new
-                    self.sent[o, m] = 0
+            if (self.round_idx % t.refresh_rounds) == phase:
+                self.apply_one(o, m, _pack(now, st), pre, stamp)
 
-    # -- gossip delivery (sequential, Go-style) ----------------------------
+        # 3. anti-entropy push-pull.
+        if self.round_idx % t.push_pull_rounds == 0:
+            partner = np.asarray(gossip_ops.sample_peers(
+                k_pp, p.n, 1,
+                nbrs=self.sim._nbrs, deg=self.sim._deg,
+                node_alive=jax.numpy.asarray(self.node_alive),
+                cut_mask=self.sim._cut,
+            ))[:, 0]
+            alive = self.node_alive
+            partner = np.where(alive & alive[partner], partner,
+                               np.arange(p.n))
+            self.push_pull(partner, now, stamp)
 
-    def deliver(self, dst: np.ndarray, svc_idx: np.ndarray, msg: np.ndarray,
-                now: int, drop: np.ndarray | None = None) -> None:
-        n, fanout = dst.shape
-        budget = svc_idx.shape[1]
-        for s in range(n):
-            if not self.node_alive[s]:
-                continue
-            for f in range(fanout):
-                tgt = int(dst[s, f])
-                if not self.node_alive[tgt]:
-                    continue
-                for b in range(budget):
-                    if drop is not None and drop[s, f, b]:
-                        continue
-                    self.merge_one(tgt, int(svc_idx[s, b]), int(msg[s, b]), now)
+        # 4. lifespan sweep.
+        if self.round_idx % t.sweep_rounds == 0:
+            self.sweep(now, stamp)
 
     # -- anti-entropy ------------------------------------------------------
 
-    def push_pull(self, partner: np.ndarray, now: int) -> None:
+    def push_pull(self, partner: np.ndarray, now: int, stamp: int) -> None:
         """Two-way full-state exchange per initiator (LocalState/
         MergeRemoteState, services_delegate.go:146-167). All exchanged
         payloads are read from the pre-exchange snapshot — in the kernel
         every pull gathers and every push offers pre-round state, so the
         oracle does the same to stay bit-identical."""
         n = self.known.shape[0]
+        t = self.t
         pre = self.known.copy()
+        stale_floor = now - t.stale_ticks
         for i in range(n):
-            t = int(partner[i])
-            if t == i:
+            tgt = int(partner[i])
+            if tgt == i:
                 continue
             for m in range(self.known.shape[1]):
-                self.merge_one(i, m, int(pre[t, m]), now)   # pull
-            for m in range(self.known.shape[1]):
-                self.merge_one(t, m, int(pre[i, m]), now)   # push
+                for node, val in ((i, int(pre[tgt, m])),   # pull
+                                  (tgt, int(pre[i, m]))):  # push
+                    ts = val >> STATUS_BITS
+                    if ts == 0 or ts < stale_floor:
+                        continue
+                    self.apply_one(node, m, val, pre, stamp)
 
     # -- lifespan sweep ----------------------------------------------------
 
-    def sweep(self, now: int) -> None:
+    def sweep(self, now: int, stamp: int) -> None:
         """TombstoneOthersServices per node (services_state.go:635-683)."""
         t = self.t
         n, m_tot = self.known.shape
@@ -155,63 +211,14 @@ class OracleSim:
                 if st == TOMBSTONE:
                     if ts < now - t.tombstone_lifespan:
                         self.known[node, m] = 0  # GC (:645-653)
-                        self.sent[node, m] = 0
+                        self.acc[node, m] = stamp
                     continue
                 lifespan = (t.draining_lifespan if st == DRAINING
                             else t.alive_lifespan)
                 if ts < now - lifespan:
-                    # +1 s rule (:667-675); re-enqueue for the 10× rebroadcast
+                    # +1 s rule (:667-675); stamp for the 10× rebroadcast.
                     self.known[node, m] = _pack(ts + t.one_second, TOMBSTONE)
-                    self.sent[node, m] = 0
-
-    # -- full round, mirroring ExactSim._step ------------------------------
-
-    def step(self, key: jax.Array) -> None:
-        p, t = self.p, self.t
-        self.round_idx += 1
-        now = self.round_idx * t.round_ticks
-        _k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
-
-        self.announce(self.round_idx, now)
-
-        dst = np.asarray(gossip_ops.sample_peers(
-            k_peers, p.n, p.fanout,
-            nbrs=self.sim._nbrs, deg=self.sim._deg,
-            node_alive=jax.numpy.asarray(self.node_alive),
-            cut_mask=self.sim._cut,
-        ))
-        svc_idx, msg = gossip_ops.select_messages(
-            jax.numpy.asarray(self.known),
-            jax.numpy.asarray(self.sent.astype(np.int8)),
-            p.budget, self.limit)
-        svc_idx, msg = np.asarray(svc_idx), np.asarray(msg)
-        # Transmit accounting (TransmitLimited: fanout sends per offer).
-        for node in range(p.n):
-            for b in range(p.budget):
-                if msg[node, b] > 0:
-                    s = int(svc_idx[node, b])
-                    self.sent[node, s] = min(self.sent[node, s] + p.fanout,
-                                             self.limit)
-        drop = None
-        if p.drop_prob > 0:
-            keep = jax.random.bernoulli(
-                k_drop, 1.0 - p.drop_prob, (p.n, p.fanout, p.budget))
-            drop = ~np.asarray(keep)
-        self.deliver(dst, svc_idx, msg, now, drop)
-
-        if self.round_idx % t.push_pull_rounds == 0:
-            partner = np.asarray(gossip_ops.sample_peers(
-                k_pp, p.n, 1,
-                nbrs=self.sim._nbrs, deg=self.sim._deg,
-                node_alive=jax.numpy.asarray(self.node_alive),
-                cut_mask=self.sim._cut,
-            ))[:, 0]
-            alive = self.node_alive
-            partner = np.where(alive & alive[partner], partner, np.arange(p.n))
-            self.push_pull(partner, now)
-
-        if self.round_idx % t.sweep_rounds == 0:
-            self.sweep(now)
+                    self.acc[node, m] = stamp
 
     def convergence(self) -> float:
         alive = self.node_alive

@@ -1,14 +1,21 @@
-"""Test bootstrap: force an 8-device virtual CPU platform BEFORE jax
-imports, so sharding tests exercise a real multi-device mesh without TPU
-hardware (the driver's dryrun_multichip uses the same mechanism)."""
+"""Test bootstrap: force an 8-device virtual CPU platform so sharding
+tests exercise a real multi-device mesh without TPU hardware (the
+driver's dryrun_multichip uses the same mechanism).
+
+The environment's sitecustomize imports jax at interpreter start (the
+axon TPU tunnel), so setting JAX_PLATFORMS here is too late — jax's
+config already captured the env value.  Instead, set XLA_FLAGS (read
+lazily at backend init) and override the platform through jax.config
+before any test triggers backend initialization."""
 
 import os
 
-# Must override, not setdefault: the environment exports JAX_PLATFORMS=axon
-# (the real TPU tunnel), and tests must never compete for the single chip.
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

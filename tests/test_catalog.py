@@ -1,0 +1,337 @@
+"""Live-catalog tests — mirror of the reference's GoConvey suites for
+ServicesState (catalog/services_state_test.go) and the service model
+(service/service_test.go): LWW merge, DRAINING stickiness, staleness
+rejection, the +1 s expiry rule, tombstone GC, broadcast scheduling, and
+listener fan-out, all driven deterministically with FreeLooper."""
+
+import json
+import queue
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import (
+    ALIVE_COUNT,
+    ChangeEvent,
+    QueueListener,
+    ServicesState,
+    TOMBSTONE_COUNT,
+    decode,
+)
+from sidecar_tpu.runtime.looper import FreeLooper
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS  # fixed epoch for deterministic clocks
+
+
+def make_state(now=T0, hostname="h1"):
+    state = ServicesState(hostname=hostname)
+    state.set_clock(lambda: now)
+    return state
+
+
+def make_svc(sid="s1", host="h1", updated=T0, status=S.ALIVE, name="web"):
+    return S.Service(id=sid, name=name, image="img:1", created=T0 - 60 * NS,
+                     hostname=host, updated=updated, status=status)
+
+
+class TestServiceModel:
+    def test_invalidates_strictly_newer(self):
+        a = make_svc(updated=T0)
+        b = make_svc(updated=T0 + 1)
+        assert b.invalidates(a)
+        assert not a.invalidates(b)
+        assert not a.invalidates(a.copy())  # equal ts: not newer
+        assert not a.invalidates(None)
+
+    def test_is_stale_includes_fudge(self):
+        lifespan = S.TOMBSTONE_LIFESPAN
+        edge = T0 - int((lifespan + S.STALENESS_FUDGE) * NS)
+        assert make_svc(updated=edge - 1).is_stale(lifespan, now=T0)
+        assert not make_svc(updated=edge + 1).is_stale(lifespan, now=T0)
+
+    def test_wire_round_trip_ns_precision(self):
+        svc = make_svc(updated=T0 + 123456789)  # odd nanoseconds
+        back = S.decode(svc.encode())
+        assert back.updated == svc.updated
+        assert back == svc
+
+    def test_version_from_image_tag(self):
+        assert make_svc().version() == "1"
+        svc = make_svc()
+        svc.image = "repo/img"
+        assert svc.version() == "repo/img"
+
+    def test_port_for_service_port(self):
+        svc = make_svc()
+        svc.ports = [S.Port("tcp", 32768, 8080, "10.0.0.1")]
+        assert svc.port_for_service_port(8080) == 32768
+        assert svc.port_for_service_port(9999) == -1
+        assert svc.port_for_service_port(8080, "udp") == -1
+
+    def test_to_service_from_docker_listing(self):
+        container = {
+            "Id": "cafedeadbeef4567890",
+            "Names": ["/web-1"],
+            "Image": "repo/web:2.1",
+            "Created": T0 // NS,
+            "Labels": {"ServicePort_80": "8080", "ProxyMode": "tcp"},
+            "Ports": [
+                {"PrivatePort": 80, "PublicPort": 32768, "Type": "tcp",
+                 "IP": "0.0.0.0"},
+                {"PrivatePort": 9000, "Type": "tcp"},  # unpublished: skipped
+            ],
+        }
+        svc = S.to_service(container, ip="192.168.1.5", hostname="h9",
+                           now=T0)
+        assert svc.id == "cafedeadbeef"  # 12-char short ID
+        assert svc.name == "/web-1"
+        assert svc.proxy_mode == "tcp"
+        assert len(svc.ports) == 1
+        assert svc.ports[0].port == 32768
+        assert svc.ports[0].service_port == 8080
+        assert svc.ports[0].ip == "192.168.1.5"
+
+
+class TestAddServiceEntry:
+    def test_accepts_unknown_service(self):
+        state = make_state()
+        state.add_service_entry(make_svc())
+        assert state.servers["h1"].services["s1"].name == "web"
+
+    def test_lww_strictly_newer_wins(self):
+        state = make_state()
+        state.add_service_entry(make_svc(updated=T0, status=S.ALIVE))
+        state.add_service_entry(make_svc(updated=T0 - 1, status=S.UNHEALTHY))
+        assert state.servers["h1"].services["s1"].status == S.ALIVE
+        state.add_service_entry(make_svc(updated=T0 + 1, status=S.UNHEALTHY))
+        assert state.servers["h1"].services["s1"].status == S.UNHEALTHY
+
+    def test_equal_timestamp_rejected(self):
+        state = make_state()
+        state.add_service_entry(make_svc(updated=T0, status=S.ALIVE))
+        state.add_service_entry(make_svc(updated=T0, status=S.UNHEALTHY))
+        assert state.servers["h1"].services["s1"].status == S.ALIVE
+
+    def test_draining_stickiness(self):
+        # services_state.go:329-331 — a newer ALIVE does not un-drain.
+        state = make_state()
+        state.add_service_entry(make_svc(updated=T0, status=S.DRAINING))
+        state.add_service_entry(make_svc(updated=T0 + NS, status=S.ALIVE))
+        got = state.servers["h1"].services["s1"]
+        assert got.status == S.DRAINING
+        assert got.updated == T0 + NS  # timestamp still advances
+        # ...but a newer UNHEALTHY does override DRAINING.
+        state.add_service_entry(make_svc(updated=T0 + 2 * NS,
+                                         status=S.UNHEALTHY))
+        assert state.servers["h1"].services["s1"].status == S.UNHEALTHY
+
+    def test_stale_record_dropped(self):
+        state = make_state()
+        stale = make_svc(
+            updated=T0 - int((S.TOMBSTONE_LIFESPAN + 61) * NS))
+        state.add_service_entry(stale)
+        assert not state.has_server("h1")
+
+    def test_retransmits_remote_changes_only(self):
+        state = make_state()
+        remote = make_svc(host="h2")
+        state.add_service_entry(remote)
+        assert state.broadcasts.get_nowait() == [remote.encode()]
+        local = make_svc(host="h1")
+        state.add_service_entry(local)
+        with pytest.raises(queue.Empty):
+            state.broadcasts.get_nowait()
+
+    def test_single_writer_queue(self):
+        state = make_state()
+        state.update_service(make_svc())
+        looper = FreeLooper(1)
+        state.process_service_msgs(looper)
+        assert state.servers["h1"].services["s1"].name == "web"
+
+
+class TestListeners:
+    def test_fanout_and_previous_status(self):
+        state = make_state()
+        listener = QueueListener("l1")
+        state.add_listener(listener)
+        state.add_service_entry(make_svc())
+        event = listener.chan().get_nowait()
+        assert event.service.id == "s1"
+        assert event.previous_status == S.UNKNOWN
+
+    def test_rejects_unbuffered(self):
+        state = make_state()
+
+        class Bad(QueueListener):
+            def __init__(self):
+                super().__init__("bad")
+                self._chan = queue.Queue(maxsize=0)  # unbounded/blocking
+
+        state.add_listener(Bad())
+        assert state.get_listeners() == []
+
+    def test_full_queue_does_not_block(self):
+        state = make_state()
+        listener = QueueListener("l1", buffer=1)
+        state.add_listener(listener)
+        state.add_service_entry(make_svc(sid="a"))
+        state.add_service_entry(make_svc(sid="b"))  # queue full: dropped
+        assert listener.chan().qsize() == 1
+
+    def test_remove_listener(self):
+        state = make_state()
+        state.add_listener(QueueListener("l1"))
+        state.remove_listener("l1")
+        assert state.get_listeners() == []
+        with pytest.raises(KeyError):
+            state.remove_listener("l1")
+
+
+class TestExpireServer:
+    def test_tombstones_all_and_announces_10x(self):
+        state = make_state()
+        state.tombstone_retransmit = 0.0  # no sleeping in tests
+        state.add_service_entry(make_svc(sid="a", host="h2"))
+        state.add_service_entry(make_svc(sid="b", host="h2"))
+        while not state.broadcasts.empty():
+            state.broadcasts.get_nowait()
+
+        state.expire_server("h2")
+        for svc in state.servers["h2"].services.values():
+            assert svc.is_tombstone()
+        # TOMBSTONE_COUNT batches of 2 records land on the queue.
+        batches = []
+        for _ in range(TOMBSTONE_COUNT):
+            batches.append(state.broadcasts.get(timeout=5))
+        assert all(len(b) == 2 for b in batches)
+        # +50 ns skew per round so peers retransmit.
+        first = S.decode(batches[0][0]).updated
+        second = S.decode(batches[1][0]).updated
+        assert second - first == 50
+
+    def test_no_live_services_noop(self):
+        state = make_state()
+        svc = make_svc(host="h2", status=S.TOMBSTONE)
+        state.add_service_entry(svc)
+        while not state.broadcasts.empty():  # drain the remote retransmit
+            state.broadcasts.get_nowait()
+        state.expire_server("h2")
+        with pytest.raises(queue.Empty):
+            state.broadcasts.get_nowait()
+
+
+class TestLifecycleSweeps:
+    def test_tombstone_others_plus_one_second_rule(self):
+        # services_state.go:667-675 — expiry stamps original ts + 1 s.
+        state = make_state()
+        old = T0 - int((S.ALIVE_LIFESPAN + 5) * NS)
+        state.add_service_entry(make_svc(host="h2", updated=old))
+        result = state.tombstone_others_services()
+        assert len(result) == 1
+        assert result[0].status == S.TOMBSTONE
+        assert result[0].updated == old + NS
+
+    def test_draining_longer_lifespan(self):
+        state = make_state()
+        age = T0 - int((S.ALIVE_LIFESPAN + 5) * NS)  # dead for ALIVE, fine for DRAINING
+        state.add_service_entry(make_svc(host="h2", updated=age,
+                                         status=S.DRAINING))
+        assert state.tombstone_others_services() == []
+
+    def test_tombstone_gc_after_3h_and_server_cleanup(self):
+        state = make_state()
+        ancient = T0 - int((S.TOMBSTONE_LIFESPAN + 61) * NS)
+        server_svc = make_svc(host="h2", updated=T0, status=S.TOMBSTONE)
+        state.add_service_entry(server_svc)
+        # Backdate directly (add_service_entry would reject stale input).
+        state.servers["h2"].services["s1"].updated = ancient
+        state.tombstone_others_services()
+        assert not state.has_server("h2")
+
+    def test_tombstone_services_for_vanished_locals(self):
+        state = make_state()
+        state.add_service_entry(make_svc(sid="gone"))
+        state.add_service_entry(make_svc(sid="here"))
+        result = state.tombstone_services(
+            "h1", [make_svc(sid="here", updated=T0 + 1)])
+        # Each tombstone is listed twice for delivery insurance
+        # (services_state.go:707-710).
+        assert len(result) == 2
+        assert all(svc.id == "gone" and svc.is_tombstone() for svc in result)
+
+
+class TestBroadcastServices:
+    def test_new_services_announced_alive_count_times(self):
+        state = make_state()
+        state.tombstone_retransmit = 0.0
+        svc = make_svc()
+        state.broadcast_services(lambda: [svc.copy()], FreeLooper(1))
+        batches = [state.broadcasts.get(timeout=5)
+                   for _ in range(ALIVE_COUNT)]
+        assert all(len(b) == 1 for b in batches)
+        decoded = S.decode(batches[-1][0])
+        assert decoded.id == "s1"
+
+    def test_no_services_pushes_none(self):
+        state = make_state()
+        state.broadcast_services(lambda: [], FreeLooper(1))
+        assert state.broadcasts.get_nowait() is None
+
+
+class TestMergeAndViews:
+    def test_merge_via_queue(self):
+        a = make_state()
+        b = make_state(hostname="h2")
+        b.add_service_entry(make_svc(host="h2", sid="x"))
+        a.merge(b)
+        a.process_service_msgs(FreeLooper(1))
+        assert a.servers["h2"].services["x"].name == "web"
+
+    def test_by_service_groups_by_name(self):
+        state = make_state()
+        state.add_service_entry(make_svc(sid="a", name="web"))
+        state.add_service_entry(make_svc(sid="b", name="web", host="h2"))
+        state.add_service_entry(make_svc(sid="c", name="db", host="h2"))
+        grouped = state.by_service()
+        assert sorted(grouped) == ["db", "web"]
+        assert len(grouped["web"]) == 2
+
+    def test_state_wire_round_trip(self):
+        state = make_state()
+        state.add_service_entry(make_svc())
+        back = decode(state.encode())
+        assert back.hostname == "h1"
+        assert back.servers["h1"].services["s1"].updated == T0
+
+    def test_encode_shape_matches_go(self):
+        state = make_state()
+        state.add_service_entry(make_svc())
+        doc = json.loads(state.encode())
+        assert set(doc) == {"Servers", "LastChanged", "ClusterName",
+                            "Hostname"}
+        server = doc["Servers"]["h1"]
+        assert set(server) == {"Name", "Services", "LastUpdated",
+                               "LastChanged"}
+        svc = server["Services"]["s1"]
+        assert set(svc) == {"ID", "Name", "Image", "Created", "Hostname",
+                            "Ports", "Updated", "ProxyMode", "Status"}
+
+    def test_get_local_service_by_id(self):
+        state = make_state()
+        state.add_service_entry(make_svc())
+        assert state.get_local_service_by_id("s1").name == "web"
+        with pytest.raises(KeyError):
+            state.get_local_service_by_id("nope")
+
+    def test_is_new_service(self):
+        state = make_state()
+        svc = make_svc()
+        assert state.is_new_service(svc)
+        state.add_service_entry(svc.copy())
+        assert not state.is_new_service(svc)
+        changed = make_svc(status=S.UNHEALTHY)
+        assert state.is_new_service(changed)
+        tomb = make_svc(status=S.TOMBSTONE)
+        assert not state.is_new_service(tomb)

@@ -1,0 +1,367 @@
+"""Discovery subsystem tests — interface fakes for every external system,
+mirroring the reference's technique (stubDockerClient ↔ DockerClient,
+mockK8sDiscoveryCommand ↔ K8sDiscoveryAdapter; SURVEY.md §4)."""
+
+import json
+import queue
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.discovery import (
+    ChangeListener,
+    DockerLabelNamer,
+    MultiDiscovery,
+    RegexpNamer,
+    StaticDiscovery,
+)
+from sidecar_tpu.discovery.base import Discoverer
+from sidecar_tpu.discovery.docker import DockerClient, DockerDiscovery
+from sidecar_tpu.discovery.kubernetes import (
+    K8sAPIDiscoverer,
+    K8sDiscoveryAdapter,
+)
+from sidecar_tpu.runtime.looper import FreeLooper
+
+STATIC_JSON = [
+    {
+        "Service": {
+            "Name": "some_service",
+            "Image": "bb6268ff91dc42a51f51db53846f72102ed9ff3f",
+            "Ports": [
+                {"Type": "tcp", "Port": 10234, "ServicePort": 9999}
+            ],
+            "ProxyMode": "http",
+        },
+        "ListenPort": 9999,
+        "Check": {"Type": "HttpGet", "Args": "http://:10234/"},
+    }
+]
+
+
+@pytest.fixture
+def static_file(tmp_path):
+    path = tmp_path / "static.json"
+    path.write_text(json.dumps(STATIC_JSON))
+    return str(path)
+
+
+class TestStaticDiscovery:
+    def test_parse_assigns_ids_and_defaults(self, static_file):
+        disco = StaticDiscovery(static_file, default_ip="10.0.0.5",
+                                hostname="me")
+        disco.run(FreeLooper(1))
+        assert len(disco.targets) == 1
+        target = disco.targets[0]
+        assert len(target.service.id) == 12  # 6 random bytes hex-encoded
+        assert target.service.hostname == "me"
+        assert target.service.ports[0].ip == "10.0.0.5"
+        assert target.check.type == "HttpGet"
+
+    def test_hostnamed_service_keeps_hostname(self, tmp_path):
+        doc = json.loads(json.dumps(STATIC_JSON))
+        doc[0]["Service"]["Hostname"] = "chaucer"
+        path = tmp_path / "static.json"
+        path.write_text(json.dumps(doc))
+        disco = StaticDiscovery(str(path), default_ip="10.0.0.5",
+                                hostname="me")
+        disco.run(FreeLooper(1))
+        assert disco.targets[0].service.hostname == "chaucer"
+
+    def test_services_restamps_updated(self, static_file):
+        disco = StaticDiscovery(static_file, "10.0.0.5", hostname="me")
+        disco.run(FreeLooper(1))
+        first = disco.services()[0].updated
+        second = disco.services()[0].updated
+        assert second >= first > 0
+
+    def test_health_check_by_id(self, static_file):
+        disco = StaticDiscovery(static_file, "10.0.0.5", hostname="me")
+        disco.run(FreeLooper(1))
+        svc = disco.services()[0]
+        assert disco.health_check(svc) == ("HttpGet", "http://:10234/")
+        assert disco.health_check(S.Service(id="zzz")) == ("", "")
+
+    def test_listeners_from_listen_port(self, static_file):
+        disco = StaticDiscovery(static_file, "10.0.0.5", hostname="me")
+        disco.run(FreeLooper(1))
+        listeners = disco.listeners()
+        assert len(listeners) == 1
+        assert listeners[0].url == "http://me:9999/sidecar/update"
+
+    def test_bad_config_quits_looper(self, tmp_path):
+        path = tmp_path / "bad.json"
+        path.write_text("{not json")
+        disco = StaticDiscovery(str(path), "10.0.0.5", hostname="me")
+        looper = FreeLooper(1)
+        disco.run(looper)
+        assert looper._quit.is_set()
+
+
+class TestNamers:
+    CONTAINER = {
+        "Id": "deadbeef12345678",
+        "Names": ["/project-chaucer-worker-1"],
+        "Image": "example/worker:1.2",
+        "Labels": {"ServiceName": "worker-svc"},
+    }
+
+    def test_regexp_namer_capture_group(self):
+        namer = RegexpNamer(r"^/(?:project-)?chaucer-([a-z]+)")
+        assert namer.service_name(self.CONTAINER) == "worker"
+
+    def test_regexp_namer_falls_back_to_image(self):
+        namer = RegexpNamer(r"nomatch-(\d+)")
+        assert namer.service_name(self.CONTAINER) == "example/worker:1.2"
+        assert namer.service_name(None) == ""
+
+    def test_regexp_namer_invalid_regex(self):
+        with pytest.raises(ValueError):
+            RegexpNamer("([unclosed")
+
+    def test_label_namer(self):
+        namer = DockerLabelNamer("ServiceName")
+        assert namer.service_name(self.CONTAINER) == "worker-svc"
+        bare = dict(self.CONTAINER, Labels={})
+        assert namer.service_name(bare) == "example/worker:1.2"
+
+
+class StubDockerClient(DockerClient):
+    """Interface fake (reference: docker_discovery_test.go:16-70)."""
+
+    def __init__(self, containers=None, inspect=None, fail_list=False):
+        self.containers = containers or []
+        self.inspect = inspect or {}
+        self.fail_list = fail_list
+        self.pings = 0
+
+    def list_containers(self, all=False):
+        if self.fail_list:
+            raise OSError("cannot list")
+        return self.containers
+
+    def inspect_container(self, container_id):
+        if container_id in self.inspect:
+            return self.inspect[container_id]
+        raise OSError(f"no such container {container_id}")
+
+    def add_event_listener(self, listener):
+        self.listener = listener
+
+    def remove_event_listener(self, listener):
+        pass
+
+    def ping(self):
+        self.pings += 1
+
+
+def make_container(cid="cafedeadbeef4567", name="/web-1", labels=None):
+    return {
+        "Id": cid,
+        "Names": [name],
+        "Image": "example/web:3",
+        "Created": 1_700_000_000,
+        "Labels": labels or {},
+        "Ports": [{"PrivatePort": 80, "PublicPort": 32768, "Type": "tcp",
+                   "IP": "0.0.0.0"}],
+    }
+
+
+class TestDockerDiscovery:
+    def make(self, client):
+        return DockerDiscovery(
+            "tcp://localhost:2375", DockerLabelNamer("ServiceName"),
+            advertise_ip="10.1.1.1", client_provider=lambda: client,
+            hostname="dockerhost")
+
+    def test_get_containers_builds_services(self):
+        client = StubDockerClient(containers=[
+            make_container(labels={"ServiceName": "web",
+                                   "ServicePort_80": "8080"}),
+            make_container(cid="feedfacecafe0001", name="/skipme",
+                           labels={"SidecarDiscover": "false"}),
+        ])
+        disco = self.make(client)
+        disco.get_containers()
+        services = disco.services()
+        assert len(services) == 1
+        assert services[0].name == "web"
+        assert services[0].id == "cafedeadbeef"
+        assert services[0].ports[0].service_port == 8080
+        assert services[0].ports[0].ip == "10.1.1.1"
+
+    def test_die_event_deletes_service(self):
+        client = StubDockerClient(containers=[
+            make_container(labels={"ServiceName": "web"})])
+        disco = self.make(client)
+        disco.get_containers()
+        assert len(disco.services()) == 1
+        disco._handle_event({"status": "die", "id": "cafedeadbeef4567"})
+        assert disco.services() == []
+
+    def test_unrelated_event_ignored(self):
+        client = StubDockerClient(containers=[
+            make_container(labels={"ServiceName": "web"})])
+        disco = self.make(client)
+        disco.get_containers()
+        disco._handle_event({"status": "start", "id": "cafedeadbeef4567"})
+        disco._handle_event({"status": "die", "id": "0000aaaabbbbcccc"})
+        assert len(disco.services()) == 1
+
+    def test_health_check_from_labels(self):
+        inspect = {"cafedeadbeef": {
+            "Config": {"Labels": {"HealthCheck": "HttpGet",
+                                  "HealthCheckArgs": "http://{{ host }}/"}}}}
+        client = StubDockerClient(
+            containers=[make_container(labels={"ServiceName": "web"})],
+            inspect=inspect)
+        disco = self.make(client)
+        disco.get_containers()
+        svc = disco.services()[0]
+        assert disco.health_check(svc) == ("HttpGet", "http://{{ host }}/")
+        # Second call served from the container cache.
+        client.inspect = {}
+        assert disco.health_check(svc) == ("HttpGet", "http://{{ host }}/")
+
+    def test_listeners_from_label(self):
+        inspect = {"cafedeadbeef": {
+            "Config": {"Labels": {"SidecarListener": "8080"}}}}
+        client = StubDockerClient(
+            containers=[make_container(
+                labels={"ServiceName": "web", "ServicePort_80": "8080"})],
+            inspect=inspect)
+        disco = self.make(client)
+        disco.get_containers()
+        listeners = disco.listeners()
+        assert len(listeners) == 1
+        assert listeners[0].url == "http://10.1.1.1:32768/sidecar/update"
+
+    def test_listener_bad_port_label(self):
+        inspect = {"cafedeadbeef": {
+            "Config": {"Labels": {"SidecarListener": "not-a-port"}}}}
+        client = StubDockerClient(
+            containers=[make_container(labels={"ServiceName": "web"})],
+            inspect=inspect)
+        disco = self.make(client)
+        disco.get_containers()
+        assert disco.listeners() == []
+
+    def test_failed_listing_keeps_old_services(self):
+        client = StubDockerClient(containers=[
+            make_container(labels={"ServiceName": "web"})])
+        disco = self.make(client)
+        disco.get_containers()
+        client.fail_list = True
+        disco.get_containers()
+        assert len(disco.services()) == 1
+
+
+K8S_SERVICES = {
+    "items": [
+        {
+            "metadata": {
+                "uid": "abc-123",
+                "creationTimestamp": "2024-01-01T00:00:00Z",
+                "labels": {"ServiceName": "api"},
+            },
+            "spec": {"ports": [
+                {"port": 80, "nodePort": 30080},
+                {"port": 443},  # no NodePort: skipped
+            ]},
+        },
+        {"metadata": {"uid": "no-label", "labels": {}},
+         "spec": {"ports": [{"port": 80, "nodePort": 30081}]}},
+    ]
+}
+
+K8S_NODES = {
+    "items": [
+        {"status": {"addresses": [
+            {"type": "InternalIP", "address": "10.2.0.1"},
+            {"type": "Hostname", "address": "node-a"}]}},
+        {"status": {"addresses": [
+            {"type": "InternalIP", "address": "10.2.0.2"},
+            {"type": "Hostname", "address": "node-b"}]}},
+    ]
+}
+
+
+class MockK8sCommand(K8sDiscoveryAdapter):
+    def get_services(self):
+        return json.dumps(K8S_SERVICES).encode()
+
+    def get_nodes(self):
+        return json.dumps(K8S_NODES).encode()
+
+
+class TestK8sDiscovery:
+    def test_announce_this_node_only(self):
+        disco = K8sAPIDiscoverer(MockK8sCommand(), hostname="node-b")
+        disco.run(FreeLooper(1))
+        import time
+        time.sleep(0.2)  # run() is backgrounded
+        services = disco.services()
+        assert len(services) == 1
+        svc = services[0]
+        assert svc.name == "api"
+        assert svc.hostname == "node-b"
+        assert svc.ports[0].port == 30080
+        assert svc.ports[0].service_port == 80
+        assert svc.ports[0].ip == "10.2.0.2"
+        assert svc.image == "api:kubernetes-hosted"
+
+    def test_announce_all_nodes(self):
+        disco = K8sAPIDiscoverer(MockK8sCommand(), hostname="node-b",
+                                 announce_all_nodes=True)
+        disco.run(FreeLooper(1))
+        import time
+        time.sleep(0.2)
+        assert len(disco.services()) == 2
+
+    def test_health_check_always_successful(self):
+        disco = K8sAPIDiscoverer(MockK8sCommand())
+        assert disco.health_check(S.Service()) == ("AlwaysSuccessful", "")
+        assert disco.listeners() == []
+
+
+class FakeDiscoverer(Discoverer):
+    def __init__(self, services=None, check=("", "")):
+        self._services = services or []
+        self._check = check
+        self.ran = False
+
+    def services(self):
+        return self._services
+
+    def health_check(self, svc):
+        return self._check
+
+    def listeners(self):
+        return [ChangeListener("l", "http://x")] if self._services else []
+
+    def run(self, looper):
+        self.ran = True
+
+
+class TestMultiDiscovery:
+    def test_aggregates_services_and_listeners(self):
+        a = FakeDiscoverer([S.Service(id="a")])
+        b = FakeDiscoverer([S.Service(id="b")])
+        multi = MultiDiscovery([a, b])
+        assert [s.id for s in multi.services()] == ["a", "b"]
+        assert len(multi.listeners()) == 2
+
+    def test_first_nonempty_health_check_wins(self):
+        a = FakeDiscoverer(check=("", ""))
+        b = FakeDiscoverer(check=("HttpGet", "http://x"))
+        c = FakeDiscoverer(check=("External", "cmd"))
+        multi = MultiDiscovery([a, b, c])
+        assert multi.health_check(S.Service()) == ("HttpGet", "http://x")
+
+    def test_run_starts_all(self):
+        a, b = FakeDiscoverer(), FakeDiscoverer()
+        multi = MultiDiscovery([a, b])
+        looper = FreeLooper(1)
+        multi.run(looper)
+        looper.wait(2)
+        assert a.ran and b.ran
