@@ -1,0 +1,591 @@
+// Gossip transport core — the memberlist-equivalent native engine.
+//
+// Capability mirror of the reference's external dependency
+// NinesStack/memberlist as used by Sidecar (main.go:239-274,
+// services_delegate.go): SWIM-style UDP failure detection (ping/ack with
+// suspicion), piggybacked gossip broadcast every GossipInterval packed
+// first-fit into ~1398-byte UDP packets (services_delegate.go:182-223),
+// TCP full-state push-pull anti-entropy on join and every
+// PushPullInterval (services_delegate.go:146-167), and ClusterName
+// isolation (services_delegate.go:29-32).
+//
+// Design: the engine runs its own threads for network IO and exposes a
+// poll-based C API (create/start/join/broadcast/poll_*) consumed from
+// Python via ctypes — no callbacks cross the language boundary, so there
+// are no GIL-reentrancy hazards.  Inbound user messages, full-state
+// payloads, and membership events are queued until the host drains them.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+using Millis = std::chrono::milliseconds;
+
+// Wire constants.
+constexpr uint32_t kMagic = 0x53433031;  // "SC01"
+constexpr size_t kMaxPacket = 1398;      // single-UDP-packet budget
+constexpr uint8_t kTypeGossip = 0;
+constexpr uint8_t kTypePing = 1;
+constexpr uint8_t kTypeAck = 2;
+
+constexpr int kProbeTimeoutMs = 1000;    // ack deadline
+constexpr int kSuspectTimeoutMs = 3000;  // suspect -> dead
+constexpr int kRetransmitMult = 4;       // memberlist RetransmitMult
+
+struct Member {
+  std::string name;
+  std::string ip;
+  uint16_t port = 0;
+  bool suspect = false;
+  Clock::time_point last_heard = Clock::now();
+  Clock::time_point suspect_since;
+};
+
+struct Broadcast {
+  std::string payload;
+  int transmits_left = 0;
+};
+
+void put_u16(std::string* out, uint16_t v) {
+  out->push_back(static_cast<char>(v >> 8));
+  out->push_back(static_cast<char>(v & 0xff));
+}
+
+uint16_t get_u16(const uint8_t* p) {
+  return static_cast<uint16_t>((p[0] << 8) | p[1]);
+}
+
+void put_u32(std::string* out, uint32_t v) {
+  out->push_back(static_cast<char>(v >> 24));
+  out->push_back(static_cast<char>((v >> 16) & 0xff));
+  out->push_back(static_cast<char>((v >> 8) & 0xff));
+  out->push_back(static_cast<char>(v & 0xff));
+}
+
+uint32_t get_u32(const uint8_t* p) {
+  return (static_cast<uint32_t>(p[0]) << 24) |
+         (static_cast<uint32_t>(p[1]) << 16) |
+         (static_cast<uint32_t>(p[2]) << 8) | static_cast<uint32_t>(p[3]);
+}
+
+void put_str8(std::string* out, const std::string& s) {
+  uint8_t n = static_cast<uint8_t>(std::min<size_t>(s.size(), 255));
+  out->push_back(static_cast<char>(n));
+  out->append(s.data(), n);
+}
+
+bool get_str8(const uint8_t*& p, const uint8_t* end, std::string* out) {
+  if (p >= end) return false;
+  uint8_t n = *p++;
+  if (p + n > end) return false;
+  out->assign(reinterpret_cast<const char*>(p), n);
+  p += n;
+  return true;
+}
+
+bool read_full(int fd, void* buf, size_t len) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t n = recv(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t len) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t n = send(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+class Transport {
+ public:
+  Transport(std::string name, std::string cluster, std::string bind_ip,
+            uint16_t bind_port, std::string advertise_ip, int gossip_ms,
+            int pushpull_ms, int gossip_nodes, int gossip_messages)
+      : name_(std::move(name)),
+        cluster_(std::move(cluster)),
+        bind_ip_(std::move(bind_ip)),
+        advertise_ip_(std::move(advertise_ip)),
+        bind_port_(bind_port),
+        gossip_ms_(gossip_ms),
+        pushpull_ms_(pushpull_ms),
+        gossip_nodes_(gossip_nodes),
+        gossip_messages_(gossip_messages),
+        rng_(std::random_device{}()) {}
+
+  ~Transport() { stop(); }
+
+  // Binds sockets and launches the IO threads.  Returns the actual bound
+  // port (0 input picks an ephemeral port) or -1 on failure.
+  int start() {
+    udp_fd_ = socket(AF_INET, SOCK_DGRAM, 0);
+    tcp_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (udp_fd_ < 0 || tcp_fd_ < 0) return -1;
+    int one = 1;
+    setsockopt(tcp_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(bind_port_);
+    addr.sin_addr.s_addr =
+        bind_ip_.empty() ? INADDR_ANY : inet_addr(bind_ip_.c_str());
+    if (bind(udp_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return -1;
+
+    socklen_t len = sizeof(addr);
+    getsockname(udp_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    bind_port_ = ntohs(addr.sin_port);  // both protocols share the port
+
+    sockaddr_in taddr = addr;
+    if (bind(tcp_fd_, reinterpret_cast<sockaddr*>(&taddr), sizeof(taddr)) < 0)
+      return -1;
+    if (listen(tcp_fd_, 16) < 0) return -1;
+
+    // 500 ms recv timeout so loops notice quit_.
+    timeval tv{0, 500000};
+    setsockopt(udp_fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(tcp_fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+    quit_ = false;
+    threads_.emplace_back(&Transport::udp_loop, this);
+    threads_.emplace_back(&Transport::gossip_loop, this);
+    threads_.emplace_back(&Transport::probe_loop, this);
+    threads_.emplace_back(&Transport::tcp_accept_loop, this);
+    threads_.emplace_back(&Transport::pushpull_loop, this);
+    return bind_port_;
+  }
+
+  void stop() {
+    if (quit_.exchange(true)) return;
+    for (auto& t : threads_)
+      if (t.joinable()) t.join();
+    threads_.clear();
+    if (udp_fd_ >= 0) close(udp_fd_);
+    if (tcp_fd_ >= 0) close(tcp_fd_);
+    udp_fd_ = tcp_fd_ = -1;
+  }
+
+  // TCP dial a seed and run the join push-pull (README.md:83-87).
+  bool join(const std::string& host, uint16_t port) {
+    return pushpull_with(host, port);
+  }
+
+  void broadcast(const uint8_t* data, size_t len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    int n_members = static_cast<int>(members_.size()) + 1;
+    int limit = kRetransmitMult *
+                static_cast<int>(std::ceil(std::log10(n_members + 1)));
+    queue_.push_back(
+        {std::string(reinterpret_cast<const char*>(data), len),
+         std::max(limit, 1)});
+    // MAX_PENDING-ish bound so a partitioned node doesn't grow forever.
+    while (queue_.size() > 4096) queue_.pop_front();
+  }
+
+  void set_local_state(const uint8_t* data, size_t len) {
+    std::lock_guard<std::mutex> lk(mu_);
+    local_state_.assign(reinterpret_cast<const char*>(data), len);
+  }
+
+  // Poll queues (returns empty string when drained).
+  std::string poll(std::deque<std::string>* q) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (q->empty()) return {};
+    std::string out = std::move(q->front());
+    q->pop_front();
+    return out;
+  }
+
+  std::string poll_msg() { return poll(&inbound_); }
+  std::string poll_state() { return poll(&states_); }
+  std::string poll_event() { return poll(&events_); }
+
+  std::string members_list() {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::string out = name_ + "\n";
+    for (auto& kv : members_) out += kv.first + "\n";
+    return out;
+  }
+
+  uint16_t port() const { return bind_port_; }
+
+ private:
+  // -- packet building ---------------------------------------------------
+
+  std::string packet_header(uint8_t type) {
+    std::string out;
+    put_u32(&out, kMagic);
+    out.push_back(static_cast<char>(type));
+    put_str8(&out, cluster_);
+    put_str8(&out, name_);
+    put_str8(&out, advertise_ip_);
+    put_u16(&out, bind_port_);
+    return out;
+  }
+
+  // First-fit packing of queued broadcasts into one UDP packet
+  // (packPacket, services_delegate.go:182-223).
+  std::string build_gossip_packet() {
+    std::string pkt = packet_header(kTypeGossip);
+    std::lock_guard<std::mutex> lk(mu_);
+    int packed = 0;
+    for (auto it = queue_.begin();
+         it != queue_.end() && packed < gossip_messages_;) {
+      size_t frame = 2 + it->payload.size();
+      if (pkt.size() + frame > kMaxPacket) {
+        ++it;
+        continue;  // first-fit: try a smaller one
+      }
+      put_u16(&pkt, static_cast<uint16_t>(it->payload.size()));
+      pkt += it->payload;
+      ++packed;
+      if (--it->transmits_left <= 0)
+        it = queue_.erase(it);
+      else
+        ++it;
+    }
+    if (packed == 0) return {};
+    return pkt;
+  }
+
+  void send_to(const std::string& ip, uint16_t port,
+               const std::string& pkt) {
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    addr.sin_addr.s_addr = inet_addr(ip.c_str());
+    sendto(udp_fd_, pkt.data(), pkt.size(), 0,
+           reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  }
+
+  std::vector<Member> pick_members(int k) {
+    std::lock_guard<std::mutex> lk(mu_);
+    std::vector<Member> all;
+    all.reserve(members_.size());
+    for (auto& kv : members_) all.push_back(kv.second);
+    std::shuffle(all.begin(), all.end(), rng_);
+    if (static_cast<int>(all.size()) > k) all.resize(k);
+    return all;
+  }
+
+  // -- member accounting -------------------------------------------------
+
+  void heard_from(const std::string& node, const std::string& ip,
+                  uint16_t port) {
+    if (node == name_) return;
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = members_.find(node);
+    if (it == members_.end()) {
+      members_[node] = {node, ip, port, false, Clock::now(), {}};
+      events_.push_back("join " + node + " " + ip);
+    } else {
+      it->second.last_heard = Clock::now();
+      it->second.suspect = false;
+      it->second.ip = ip;
+      it->second.port = port;
+    }
+  }
+
+  // -- IO loops ----------------------------------------------------------
+
+  void udp_loop() {
+    std::vector<uint8_t> buf(65536);
+    while (!quit_) {
+      sockaddr_in src{};
+      socklen_t slen = sizeof(src);
+      ssize_t n = recvfrom(udp_fd_, buf.data(), buf.size(), 0,
+                           reinterpret_cast<sockaddr*>(&src), &slen);
+      if (n <= 0) continue;
+      const uint8_t* p = buf.data();
+      const uint8_t* end = p + n;
+      if (n < 5 || get_u32(p) != kMagic) continue;
+      uint8_t type = p[4];
+      p += 5;
+      std::string cluster, node, ip;
+      if (!get_str8(p, end, &cluster) || !get_str8(p, end, &node) ||
+          !get_str8(p, end, &ip) || p + 2 > end)
+        continue;
+      uint16_t port = get_u16(p);
+      p += 2;
+      // ClusterName isolation (services_delegate.go:29-32).
+      if (cluster != cluster_) continue;
+      heard_from(node, ip, port);
+
+      if (type == kTypePing) {
+        std::string ack = packet_header(kTypeAck);
+        send_to(ip, port, ack);
+      } else if (type == kTypeGossip) {
+        while (p + 2 <= end) {
+          uint16_t flen = get_u16(p);
+          p += 2;
+          if (p + flen > end) break;
+          std::lock_guard<std::mutex> lk(mu_);
+          inbound_.emplace_back(reinterpret_cast<const char*>(p), flen);
+          if (inbound_.size() > 65536) inbound_.pop_front();
+          p += flen;
+        }
+      }
+      // kTypeAck: heard_from already refreshed liveness.
+    }
+  }
+
+  void gossip_loop() {
+    while (!quit_) {
+      std::this_thread::sleep_for(Millis(gossip_ms_));
+      std::string pkt = build_gossip_packet();
+      if (pkt.empty()) continue;
+      for (auto& m : pick_members(gossip_nodes_)) send_to(m.ip, m.port, pkt);
+    }
+  }
+
+  void probe_loop() {
+    while (!quit_) {
+      std::this_thread::sleep_for(Millis(std::max(gossip_ms_ * 5, 500)));
+      auto targets = pick_members(1);
+      if (!targets.empty()) {
+        std::string ping = packet_header(kTypePing);
+        send_to(targets[0].ip, targets[0].port, ping);
+      }
+      // Sweep: probe timeouts -> suspect -> dead (SWIM-lite; the
+      // reference's NotifyLeave -> ExpireServer path).
+      std::vector<std::string> dead;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto now = Clock::now();
+        for (auto it = members_.begin(); it != members_.end();) {
+          auto& m = it->second;
+          auto quiet = std::chrono::duration_cast<Millis>(
+                           now - m.last_heard).count();
+          if (!m.suspect && quiet > kProbeTimeoutMs + gossip_ms_ * 10) {
+            m.suspect = true;
+            m.suspect_since = now;
+          }
+          if (m.suspect &&
+              std::chrono::duration_cast<Millis>(now - m.suspect_since)
+                      .count() > kSuspectTimeoutMs) {
+            dead.push_back(it->first);
+            it = members_.erase(it);
+            continue;
+          }
+          ++it;
+        }
+        for (auto& d : dead) events_.push_back("leave " + d);
+      }
+    }
+  }
+
+  void tcp_accept_loop() {
+    while (!quit_) {
+      sockaddr_in src{};
+      socklen_t slen = sizeof(src);
+      int fd = accept(tcp_fd_, reinterpret_cast<sockaddr*>(&src), &slen);
+      if (fd < 0) continue;
+      std::thread([this, fd] {
+        handle_pushpull_conn(fd);
+        close(fd);
+      }).detach();
+    }
+  }
+
+  // Framed state exchange: both sides send
+  //   [magic u32][cluster str8][name str8][ip str8][port u16]
+  //   [state_len u32][state bytes]
+  void send_state_frame(int fd) {
+    std::string hdr = packet_header(kTypeGossip);
+    std::string state;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      state = local_state_;
+    }
+    std::string out;
+    out.reserve(hdr.size() + 4 + state.size());
+    out += hdr;
+    put_u32(&out, static_cast<uint32_t>(state.size()));
+    out += state;
+    write_full(fd, out.data(), out.size());
+  }
+
+  bool recv_state_frame(int fd) {
+    uint8_t fixed[5];
+    if (!read_full(fd, fixed, 5) || get_u32(fixed) != kMagic) return false;
+    auto read_str8 = [&](std::string* out) {
+      uint8_t n;
+      if (!read_full(fd, &n, 1)) return false;
+      out->resize(n);
+      return n == 0 || read_full(fd, &(*out)[0], n);
+    };
+    std::string cluster, node, ip;
+    uint8_t pbuf[2];
+    if (!read_str8(&cluster) || !read_str8(&node) || !read_str8(&ip) ||
+        !read_full(fd, pbuf, 2))
+      return false;
+    uint16_t port = get_u16(pbuf);
+    uint8_t lbuf[4];
+    if (!read_full(fd, lbuf, 4)) return false;
+    uint32_t slen = get_u32(lbuf);
+    if (slen > (64u << 20)) return false;  // sanity cap: 64 MB
+    std::string state(slen, '\0');
+    if (slen > 0 && !read_full(fd, &state[0], slen)) return false;
+    if (cluster != cluster_) return false;
+    heard_from(node, ip, port);
+    if (!state.empty()) {
+      std::lock_guard<std::mutex> lk(mu_);
+      states_.push_back(std::move(state));
+      if (states_.size() > 64) states_.pop_front();
+    }
+    return true;
+  }
+
+  void handle_pushpull_conn(int fd) {
+    // Remote sends first, then we reply (LocalState/MergeRemoteState).
+    if (!recv_state_frame(fd)) return;
+    send_state_frame(fd);
+  }
+
+  bool pushpull_with(const std::string& host, uint16_t port) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    timeval tv{5, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    addr.sin_addr.s_addr = inet_addr(host.c_str());
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      close(fd);
+      return false;
+    }
+    send_state_frame(fd);
+    bool ok = recv_state_frame(fd);
+    close(fd);
+    return ok;
+  }
+
+  void pushpull_loop() {
+    // Periodic anti-entropy with one random member
+    // (PushPullInterval, main.go:252-256).
+    int elapsed = 0;
+    while (!quit_) {
+      std::this_thread::sleep_for(Millis(250));
+      elapsed += 250;
+      if (elapsed < pushpull_ms_) continue;
+      elapsed = 0;
+      auto targets = pick_members(1);
+      if (!targets.empty())
+        pushpull_with(targets[0].ip, targets[0].port);
+    }
+  }
+
+  std::string name_, cluster_, bind_ip_, advertise_ip_;
+  uint16_t bind_port_;
+  int gossip_ms_, pushpull_ms_, gossip_nodes_, gossip_messages_;
+  int udp_fd_ = -1, tcp_fd_ = -1;
+  std::atomic<bool> quit_{true};
+  std::vector<std::thread> threads_;
+  std::mutex mu_;
+  std::map<std::string, Member> members_;
+  std::deque<Broadcast> queue_;
+  std::deque<std::string> inbound_, states_, events_;
+  std::string local_state_;
+  std::mt19937 rng_;
+};
+
+int copy_out(const std::string& s, uint8_t* buf, int cap) {
+  if (s.empty()) return 0;
+  int n = static_cast<int>(std::min<size_t>(s.size(), cap));
+  memcpy(buf, s.data(), n);
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* st_create(const char* name, const char* cluster, const char* bind_ip,
+                int bind_port, const char* advertise_ip, int gossip_ms,
+                int pushpull_ms, int gossip_nodes, int gossip_messages) {
+  return new Transport(name, cluster, bind_ip, (uint16_t)bind_port,
+                       advertise_ip, gossip_ms, pushpull_ms, gossip_nodes,
+                       gossip_messages);
+}
+
+int st_start(void* h) {
+  if (!h) return -1;
+  return static_cast<Transport*>(h)->start();
+}
+
+int st_join(void* h, const char* host, int port) {
+  if (!h) return -1;
+  return static_cast<Transport*>(h)->join(host, (uint16_t)port) ? 0 : -1;
+}
+
+void st_broadcast(void* h, const uint8_t* data, int len) {
+  if (!h) return;
+  static_cast<Transport*>(h)->broadcast(data, (size_t)len);
+}
+
+void st_set_local_state(void* h, const uint8_t* data, int len) {
+  if (!h) return;
+  static_cast<Transport*>(h)->set_local_state(data, (size_t)len);
+}
+
+int st_poll_msg(void* h, uint8_t* buf, int cap) {
+  if (!h) return 0;
+  return copy_out(static_cast<Transport*>(h)->poll_msg(), buf, cap);
+}
+
+int st_poll_state(void* h, uint8_t* buf, int cap) {
+  if (!h) return 0;
+  return copy_out(static_cast<Transport*>(h)->poll_state(), buf, cap);
+}
+
+int st_poll_event(void* h, uint8_t* buf, int cap) {
+  if (!h) return 0;
+  return copy_out(static_cast<Transport*>(h)->poll_event(), buf, cap);
+}
+
+int st_members(void* h, uint8_t* buf, int cap) {
+  if (!h) return 0;
+  return copy_out(static_cast<Transport*>(h)->members_list(), buf, cap);
+}
+
+int st_port(void* h) {
+  if (!h) return -1;
+  return static_cast<Transport*>(h)->port();
+}
+
+void st_stop(void* h) {
+  if (h) static_cast<Transport*>(h)->stop();
+}
+
+void st_destroy(void* h) { delete static_cast<Transport*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,60 @@
+"""Advertise-IP selection: first private IPv4 not excluded
+(reference: addresses.go:10-99)."""
+
+from __future__ import annotations
+
+import ipaddress
+import socket
+from typing import Optional
+
+PRIVATE_BLOCKS = [
+    ipaddress.ip_network("10.0.0.0/8"),
+    ipaddress.ip_network("172.16.0.0/12"),
+    ipaddress.ip_network("192.168.0.0/16"),
+]
+
+
+def is_private_ip(ip_str: str) -> bool:
+    try:
+        ip = ipaddress.ip_address(ip_str)
+    except ValueError:
+        return False
+    return any(ip in block for block in PRIVATE_BLOCKS)
+
+
+def find_private_addresses() -> list[str]:
+    """All private IPv4 addresses on this host (addresses.go:36-78)."""
+    found: list[str] = []
+    seen: set[str] = set()
+    hostname = socket.gethostname()
+    candidates: list[str] = []
+    try:
+        for info in socket.getaddrinfo(hostname, None,
+                                       family=socket.AF_INET):
+            candidates.append(info[4][0])
+    except socket.gaierror:
+        pass
+    # Route-based discovery: a UDP "connection" picks the egress IP
+    # without sending anything.
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as probe:
+            probe.connect(("10.255.255.255", 1))
+            candidates.append(probe.getsockname()[0])
+    except OSError:
+        pass
+    for addr in candidates:
+        if addr not in seen and is_private_ip(addr):
+            seen.add(addr)
+            found.append(addr)
+    return found
+
+
+def get_published_ip(excluded: list[str], advertise: str = "") -> str:
+    """ADVERTISE_IP wins; else first non-excluded private IPv4
+    (addresses.go:81-99).  Raises RuntimeError when nothing is found."""
+    if advertise:
+        return advertise
+    for addr in find_private_addresses():
+        if addr not in excluded:
+            return addr
+    raise RuntimeError("Can't find address!")

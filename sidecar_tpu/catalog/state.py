@@ -402,6 +402,7 @@ class ServicesState:
         """Re-enqueue each record every second, bumping Updated +50 ns per
         round so peers retransmit (services_state.go:579-604)."""
         services = [svc.copy() for svc in services]
+        base_updated = [svc.updated for svc in services]
 
         def run() -> None:
             additional = 0
@@ -409,10 +410,15 @@ class ServicesState:
             def one() -> None:
                 nonlocal additional
                 prepared = []
-                for svc in services:
-                    svc.updated = svc.updated + additional
+                for svc, base in zip(services, base_updated):
+                    # Linear +50 ns per round from the ORIGINAL stamp so
+                    # peers see each round as strictly newer
+                    # (services_state.go:585-599 copies the struct per
+                    # iteration; re-adding to the mutated copy would
+                    # compound the skew).
+                    svc.updated = base + additional
                     prepared.append(svc.encode())
-                additional += 50  # ns — the retransmit-forcing skew
+                additional += 50
                 self.broadcasts.put(prepared)
 
             looper.loop(one)

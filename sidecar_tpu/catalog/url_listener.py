@@ -1,0 +1,121 @@
+"""UrlListener: pushes StateChangedEvents to subscriber URLs over HTTP
+POST (reference: catalog/url_listener.go:22-161)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Optional
+
+from sidecar_tpu.catalog.state import (
+    ChangeEvent,
+    LISTENER_EVENT_BUFFER_SIZE,
+    Listener,
+    ServicesState,
+)
+
+log = logging.getLogger(__name__)
+
+CLIENT_TIMEOUT = 3.0   # url_listener.go:18
+DEFAULT_RETRIES = 5    # url_listener.go:19
+
+
+def with_retries(count: int, fn) -> Optional[Exception]:
+    """url_listener.go:81-94 — linear backoff, first try immediate."""
+    last: Optional[Exception] = None
+    for i in range(-1, count):
+        try:
+            fn()
+            return None
+        except Exception as exc:  # noqa: BLE001 — retry any failure
+            last = exc
+            if i + 1 < count:
+                time.sleep(max(0.1 * (i + 1), 0))
+    log.warning("Failed after %d retries", count)
+    return last
+
+
+def state_changed_event_json(state: ServicesState,
+                             event: ChangeEvent) -> bytes:
+    """Wire shape of StateChangedEvent (url_listener.go:36-39)."""
+    with state._lock:
+        doc = {"State": state.to_json(), "ChangeEvent": event.to_json()}
+    return json.dumps(doc, separators=(",", ":")).encode()
+
+
+class UrlListener(Listener):
+    def __init__(self, url: str, managed: bool = False,
+                 retries: int = DEFAULT_RETRIES,
+                 timeout: float = CLIENT_TIMEOUT) -> None:
+        self.url = url
+        self.retries = retries
+        self.timeout = timeout
+        self._managed = managed
+        self._name = f"UrlListener({url})"
+        self._chan: "queue.Queue[ChangeEvent]" = queue.Queue(
+            maxsize=LISTENER_EVENT_BUFFER_SIZE)
+        self._quit = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Session-affinity cookie for LB stickiness
+        # (url_listener.go:40-60).
+        self._cookie = ("sidecar-session-host="
+                        f"{socket.gethostname()}-{time.time()}")
+
+    # -- Listener ----------------------------------------------------------
+
+    def chan(self):
+        return self._chan
+
+    def name(self) -> str:
+        return self._name
+
+    def set_name(self, name: str) -> None:
+        self._name = name
+
+    def managed(self) -> bool:
+        return self._managed
+
+    def stop(self) -> None:
+        self._quit.set()
+        try:
+            self._chan.put_nowait(None)  # type: ignore[arg-type]
+        except queue.Full:
+            pass  # drain thread re-checks _quit after its current POST
+
+    # -- the POST loop -----------------------------------------------------
+
+    def _post(self, data: bytes) -> None:
+        req = urllib.request.Request(
+            self.url, data=data,
+            headers={"Content-Type": "application/json",
+                     "Cookie": self._cookie},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if not (200 <= resp.status < 300):
+                raise OSError(f"Bad status code returned ({resp.status})")
+
+    def watch(self, state: ServicesState) -> None:
+        """Register and start draining events in a background thread
+        (url_listener.go:116-161)."""
+        state.add_listener(self)
+
+        def drain() -> None:
+            while not self._quit.is_set():
+                event = self._chan.get()
+                if event is None or self._quit.is_set():
+                    return
+                data = state_changed_event_json(state, event)
+                err = with_retries(self.retries, lambda: self._post(data))
+                if err is not None:
+                    log.warning("Failed posting state to '%s' %s: %s",
+                                self.url, self.name(), err)
+
+        self._thread = threading.Thread(target=drain, name=self._name,
+                                        daemon=True)
+        self._thread.start()

@@ -1,0 +1,281 @@
+"""Layered configuration: env vars with defaults, overridden by CLI
+flags (reference: config/config.go:11-110 ← cli.go:25-41, wired in
+main.go:44-60; effective config printed at boot à la rubberneck,
+main.go:305-306).
+
+Env prefixes match the reference exactly (SIDECAR_, DOCKER_, STATIC_,
+K8S_, SERVICES_, HAPROXY_, ENVOY_, LISTENERS_) so existing deployments
+carry over unchanged.  Durations accept Go syntax ("200ms", "20s",
+"1m"), lists are comma-separated."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Optional
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(h|ms|us|µs|ns|m|s)")
+_UNITS = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6,
+          "µs": 1e-6, "ns": 1e-9}
+
+
+def parse_duration(text: str) -> float:
+    """Go duration string → seconds."""
+    text = text.strip()
+    if not text:
+        return 0.0
+    try:
+        return float(text)  # bare number = seconds
+    except ValueError:
+        pass
+    total = 0.0
+    pos = 0
+    for match in _DURATION_RE.finditer(text):
+        if match.start() != pos:
+            raise ValueError(f"invalid duration: {text!r}")
+        total += float(match.group(1)) * _UNITS[match.group(2)]
+        pos = match.end()
+    if pos != len(text):
+        raise ValueError(f"invalid duration: {text!r}")
+    return total
+
+
+def _env(prefix: str, name: str, default, cast=None):
+    raw = os.environ.get(f"{prefix}_{name}")
+    if raw is None:
+        return default
+    if cast is not None:
+        return cast(raw)
+    if isinstance(default, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(raw)
+    if isinstance(default, float):
+        return parse_duration(raw)
+    if isinstance(default, list):
+        return [s for s in raw.split(",") if s]
+    return raw
+
+
+@dataclasses.dataclass
+class ListenerUrlsConfig:
+    """LISTENERS_ (config.go:11-13)."""
+
+    urls: list[str] = dataclasses.field(default_factory=list)
+
+    @classmethod
+    def from_env(cls) -> "ListenerUrlsConfig":
+        return cls(urls=_env("LISTENERS", "URLS", []))
+
+
+@dataclasses.dataclass
+class HAproxyConfig:
+    """HAPROXY_ (config.go:15-26)."""
+
+    reload_cmd: str = ""
+    verify_cmd: str = ""
+    bind_ip: str = "192.168.168.168"
+    template_file: str = "views/haproxy.cfg"
+    config_file: str = "/etc/haproxy.cfg"
+    pid_file: str = "/var/run/haproxy.pid"
+    disable: bool = False
+    user: str = "haproxy"
+    group: str = ""
+    use_hostnames: bool = False
+
+    @classmethod
+    def from_env(cls) -> "HAproxyConfig":
+        d = cls()
+        return cls(
+            reload_cmd=_env("HAPROXY", "RELOAD_COMMAND", d.reload_cmd),
+            verify_cmd=_env("HAPROXY", "VERIFY_COMMAND", d.verify_cmd),
+            bind_ip=_env("HAPROXY", "BIND_IP", d.bind_ip),
+            template_file=_env("HAPROXY", "TEMPLATE_FILE", d.template_file),
+            config_file=_env("HAPROXY", "CONFIG_FILE", d.config_file),
+            pid_file=_env("HAPROXY", "PID_FILE", d.pid_file),
+            disable=_env("HAPROXY", "DISABLE", d.disable),
+            user=_env("HAPROXY", "USER", d.user),
+            group=_env("HAPROXY", "GROUP", d.group),
+            use_hostnames=_env("HAPROXY", "USE_HOSTNAMES", d.use_hostnames),
+        )
+
+
+@dataclasses.dataclass
+class EnvoyConfig:
+    """ENVOY_ (config.go:28-33)."""
+
+    use_grpc_api: bool = True
+    bind_ip: str = "192.168.168.168"
+    use_hostnames: bool = False
+    grpc_port: str = "7776"
+
+    @classmethod
+    def from_env(cls) -> "EnvoyConfig":
+        d = cls()
+        return cls(
+            use_grpc_api=_env("ENVOY", "USE_GRPC_API", d.use_grpc_api),
+            bind_ip=_env("ENVOY", "BIND_IP", d.bind_ip),
+            use_hostnames=_env("ENVOY", "USE_HOSTNAMES", d.use_hostnames),
+            grpc_port=_env("ENVOY", "GRPC_PORT", d.grpc_port),
+        )
+
+
+@dataclasses.dataclass
+class ServicesConfig:
+    """SERVICES_ (config.go:35-39)."""
+
+    name_match: str = ""
+    service_namer: str = "docker_label"
+    name_label: str = "ServiceName"
+
+    @classmethod
+    def from_env(cls) -> "ServicesConfig":
+        d = cls()
+        return cls(
+            name_match=_env("SERVICES", "NAME_MATCH", d.name_match),
+            service_namer=_env("SERVICES", "NAMER", d.service_namer),
+            name_label=_env("SERVICES", "NAME_LABEL", d.name_label),
+        )
+
+
+@dataclasses.dataclass
+class SidecarConfig:
+    """SIDECAR_ (config.go:41-59)."""
+
+    exclude_ips: list[str] = dataclasses.field(
+        default_factory=lambda: ["192.168.168.168"])
+    discovery: list[str] = dataclasses.field(
+        default_factory=lambda: ["docker"])
+    stats_addr: str = ""
+    push_pull_interval: float = 20.0
+    gossip_messages: int = 15
+    gossip_interval: float = 0.2
+    handoff_queue_depth: int = 1024
+    logging_format: str = ""
+    logging_level: str = "info"
+    default_check_endpoint: str = "/version"
+    seeds: list[str] = dataclasses.field(default_factory=list)
+    cluster_name: str = "default"
+    advertise_ip: str = ""
+    bind_port: int = 7946
+    debug: bool = False
+    discovery_sleep_interval: float = 1.0
+
+    @classmethod
+    def from_env(cls) -> "SidecarConfig":
+        d = cls()
+        return cls(
+            exclude_ips=_env("SIDECAR", "EXCLUDE_IPS", d.exclude_ips),
+            discovery=_env("SIDECAR", "DISCOVERY", d.discovery),
+            stats_addr=_env("SIDECAR", "STATS_ADDR", d.stats_addr),
+            push_pull_interval=_env("SIDECAR", "PUSH_PULL_INTERVAL",
+                                    d.push_pull_interval),
+            gossip_messages=_env("SIDECAR", "GOSSIP_MESSAGES",
+                                 d.gossip_messages),
+            gossip_interval=_env("SIDECAR", "GOSSIP_INTERVAL",
+                                 d.gossip_interval),
+            handoff_queue_depth=_env("SIDECAR", "HANDOFF_QUEUE_DEPTH",
+                                     d.handoff_queue_depth),
+            logging_format=_env("SIDECAR", "LOGGING_FORMAT",
+                                d.logging_format),
+            logging_level=_env("SIDECAR", "LOGGING_LEVEL", d.logging_level),
+            default_check_endpoint=_env("SIDECAR", "DEFAULT_CHECK_ENDPOINT",
+                                        d.default_check_endpoint),
+            seeds=_env("SIDECAR", "SEEDS", d.seeds),
+            cluster_name=_env("SIDECAR", "CLUSTER_NAME", d.cluster_name),
+            advertise_ip=_env("SIDECAR", "ADVERTISE_IP", d.advertise_ip),
+            bind_port=_env("SIDECAR", "BIND_PORT", d.bind_port),
+            debug=_env("SIDECAR", "DEBUG", d.debug),
+            discovery_sleep_interval=_env(
+                "SIDECAR", "DISCOVERY_SLEEP_INTERVAL",
+                d.discovery_sleep_interval),
+        )
+
+
+@dataclasses.dataclass
+class DockerConfig:
+    """DOCKER_ (config.go:61-63)."""
+
+    docker_url: str = "unix:///var/run/docker.sock"
+
+    @classmethod
+    def from_env(cls) -> "DockerConfig":
+        return cls(docker_url=_env("DOCKER", "URL", cls().docker_url))
+
+
+@dataclasses.dataclass
+class StaticConfig:
+    """STATIC_ (config.go:65-67)."""
+
+    config_file: str = "static.json"
+
+    @classmethod
+    def from_env(cls) -> "StaticConfig":
+        return cls(config_file=_env("STATIC", "CONFIG_FILE",
+                                    cls().config_file))
+
+
+@dataclasses.dataclass
+class K8sAPIConfig:
+    """K8S_ (config.go:69-76)."""
+
+    kube_api_ip: str = "127.0.0.1"
+    kube_api_port: int = 8080
+    namespace: str = "default"
+    kube_timeout: float = 3.0
+    creds_path: str = "/var/run/secrets/kubernetes.io/serviceaccount"
+    announce_all_nodes: bool = False
+
+    @classmethod
+    def from_env(cls) -> "K8sAPIConfig":
+        d = cls()
+        return cls(
+            kube_api_ip=_env("K8S", "KUBE_API_IP", d.kube_api_ip),
+            kube_api_port=_env("K8S", "KUBE_API_PORT", d.kube_api_port),
+            namespace=_env("K8S", "NAMESPACE", d.namespace),
+            kube_timeout=_env("K8S", "KUBE_TIMEOUT", d.kube_timeout),
+            creds_path=_env("K8S", "CREDS_PATH", d.creds_path),
+            announce_all_nodes=_env("K8S", "ANNOUNCE_ALL_NODES",
+                                    d.announce_all_nodes),
+        )
+
+
+@dataclasses.dataclass
+class Config:
+    """config.go:78-87."""
+
+    sidecar: SidecarConfig
+    docker_discovery: DockerConfig
+    static_discovery: StaticConfig
+    k8s_api_discovery: K8sAPIConfig
+    services: ServicesConfig
+    haproxy: HAproxyConfig
+    envoy: EnvoyConfig
+    listeners: ListenerUrlsConfig
+
+
+def parse_config() -> Config:
+    """config.go:88-110."""
+    return Config(
+        sidecar=SidecarConfig.from_env(),
+        docker_discovery=DockerConfig.from_env(),
+        static_discovery=StaticConfig.from_env(),
+        k8s_api_discovery=K8sAPIConfig.from_env(),
+        services=ServicesConfig.from_env(),
+        haproxy=HAproxyConfig.from_env(),
+        envoy=EnvoyConfig.from_env(),
+        listeners=ListenerUrlsConfig.from_env(),
+    )
+
+
+def format_config(config: Config) -> str:
+    """Effective-config dump at boot (rubberneck, main.go:305-306)."""
+    lines = ["Settings -----------------------------------------"]
+    for field in dataclasses.fields(config):
+        section = getattr(config, field.name)
+        lines.append(f"  * {field.name}:")
+        for sub in dataclasses.fields(section):
+            lines.append(f"      {sub.name}: {getattr(section, sub.name)}")
+    lines.append("--------------------------------------------------")
+    return "\n".join(lines)

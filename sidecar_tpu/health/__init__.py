@@ -1,0 +1,25 @@
+"""Health monitoring: checks services before announcing them
+(reference: healthy/ package)."""
+
+from sidecar_tpu.health.monitor import (
+    Check,
+    FAILED,
+    HEALTH_INTERVAL,
+    HEALTHY,
+    Monitor,
+    SICKLY,
+    UNKNOWN,
+    WATCH_INTERVAL,
+)
+from sidecar_tpu.health.checks import (
+    AlwaysSuccessfulCmd,
+    Checker,
+    ExternalCmd,
+    HttpGetCmd,
+)
+
+__all__ = [
+    "Monitor", "Check", "Checker", "HttpGetCmd", "ExternalCmd",
+    "AlwaysSuccessfulCmd", "HEALTHY", "SICKLY", "FAILED", "UNKNOWN",
+    "HEALTH_INTERVAL", "WATCH_INTERVAL",
+]

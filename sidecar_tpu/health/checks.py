@@ -1,0 +1,72 @@
+"""Checker implementations (reference: healthy/commands.go:19-64)."""
+
+from __future__ import annotations
+
+import logging
+import shlex
+import subprocess
+import urllib.error
+import urllib.request
+
+log = logging.getLogger(__name__)
+
+# Check status codes (healthy/healthy.go:18-23).
+HEALTHY = 0
+SICKLY = 1
+FAILED = 2
+UNKNOWN = 3
+
+
+class Checker:
+    """healthy/healthy.go:76-78 — run(args) → (status, error|None)."""
+
+    def run(self, args: str) -> tuple[int, Exception | None]:
+        raise NotImplementedError
+
+
+class HttpGetCmd(Checker):
+    """2xx ⇒ HEALTHY, anything else SICKLY (commands.go:19-33)."""
+
+    def __init__(self, timeout: float = 10.0) -> None:
+        self.timeout = timeout
+
+    def run(self, args: str) -> tuple[int, Exception | None]:
+        try:
+            with urllib.request.urlopen(args, timeout=self.timeout) as resp:
+                if 200 <= resp.status < 300:
+                    return HEALTHY, None
+                return SICKLY, None
+        except urllib.error.HTTPError as exc:
+            return SICKLY, exc
+        except (OSError, ValueError) as exc:
+            return UNKNOWN, exc
+
+
+class ExternalCmd(Checker):
+    """Exit 0 ⇒ HEALTHY (commands.go:42-55).  Executed without a shell
+    wrapper, like the reference; invoke a shell yourself if needed."""
+
+    def __init__(self, timeout: float = 30.0) -> None:
+        self.timeout = timeout
+
+    def run(self, args: str) -> tuple[int, Exception | None]:
+        argv = shlex.split(args)
+        if not argv:
+            return UNKNOWN, ValueError("empty check command")
+        try:
+            result = subprocess.run(
+                argv, capture_output=True, timeout=self.timeout, check=False)
+        except (OSError, subprocess.TimeoutExpired) as exc:
+            return SICKLY, exc
+        if result.returncode == 0:
+            return HEALTHY, None
+        log.error("Error running command: exit %d (%s)", result.returncode,
+                  result.stdout + result.stderr)
+        return SICKLY, RuntimeError(f"exit code {result.returncode}")
+
+
+class AlwaysSuccessfulCmd(Checker):
+    """commands.go:60-64."""
+
+    def run(self, args: str) -> tuple[int, Exception | None]:
+        return HEALTHY, None

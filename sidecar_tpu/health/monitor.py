@@ -1,0 +1,245 @@
+"""The health Monitor: manages and runs Checks on a fixed interval
+(reference: healthy/healthy.go:33-218, service_bridge.go:18-187).
+
+``watch`` syncs the check set with discovery (new service ⇒ fetch its
+check type/args from the Discoverer, or a default HttpGet on the first
+TCP port); ``run`` executes all checks concurrently each tick with a
+per-check timeout of interval−1 ms; ``services()`` returns discovery's
+services re-marked with check status — this is the ``serviceFunc`` the
+catalog broadcasts (main.go:351)."""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import re
+import threading
+from typing import Callable, Optional
+
+from sidecar_tpu.discovery.base import Discoverer
+from sidecar_tpu.health.checks import (
+    AlwaysSuccessfulCmd,
+    Checker,
+    ExternalCmd,
+    FAILED,
+    HEALTHY,
+    HttpGetCmd,
+    SICKLY,
+    UNKNOWN,
+)
+from sidecar_tpu.runtime.looper import Looper
+from sidecar_tpu import service as svc_mod
+from sidecar_tpu.service import Service
+
+log = logging.getLogger(__name__)
+
+WATCH_INTERVAL = 0.5     # healthy.go:27
+HEALTH_INTERVAL = 3.0    # healthy.go:28
+DEFAULT_STATUS_ENDPOINT = "/"  # service_bridge.go:15
+
+
+class Check:
+    """One service's health check (healthy.go:44-89)."""
+
+    def __init__(self, check_id: str, type: str = "http",
+                 args: str = "", command: Optional[Checker] = None,
+                 max_count: int = 1, status: int = UNKNOWN) -> None:
+        self.id = check_id
+        self.status = status
+        self.count = 0
+        self.max_count = max_count
+        self.type = type
+        self.args = args
+        self.command: Optional[Checker] = (
+            command if command is not None else HttpGetCmd())
+        self.last_error: Optional[Exception] = None
+
+    def update_status(self, status: int,
+                      err: Optional[Exception]) -> None:
+        """State machine with MaxCount escalation (healthy.go:93-114)."""
+        if err is not None:
+            log.debug("Error executing check, status UNKNOWN: (id %s)",
+                      self.id)
+            self.status = UNKNOWN
+            self.last_error = err
+        else:
+            self.status = status
+
+        if status == HEALTHY:
+            self.count = 0
+            return
+        self.count += 1
+        if self.count >= self.max_count:
+            self.status = FAILED
+
+    def service_status(self) -> int:
+        """Check status → service status (healthy.go:116-127)."""
+        if self.status in (HEALTHY, SICKLY):
+            return svc_mod.ALIVE
+        if self.status == UNKNOWN:
+            return svc_mod.UNKNOWN
+        return svc_mod.UNHEALTHY
+
+
+# The check-arg template subset the reference supports
+# (service_bridge.go:105-127): {{ host }}, {{ container }},
+# {{ tcp <port> }}, {{ udp <port> }}.
+_TEMPLATE_RE = re.compile(
+    r"\{\{\s*(host|container|tcp|udp)(?:\s+(\d+))?\s*\}\}")
+
+
+class Monitor:
+    """healthy.go:33-42, 130-216."""
+
+    def __init__(self, default_check_host: str,
+                 default_check_endpoint: str = "") -> None:
+        self.checks: dict[str, Check] = {}
+        self.check_interval = HEALTH_INTERVAL
+        self.default_check_host = default_check_host
+        self.default_check_endpoint = default_check_endpoint
+        self.discovery_fn: Optional[Callable[[], list[Service]]] = None
+        self._lock = threading.RLock()
+        # One long-lived pool for the whole monitor; sized generously so a
+        # few hung checks can't starve the rest of a tick.
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="health-check")
+
+    # -- check management --------------------------------------------------
+
+    def add_check(self, check: Check) -> None:
+        with self._lock:
+            log.info("Adding health check: %s (ID: %s), Args: %s",
+                     check.type, check.id, check.args)
+            self.checks[check.id] = check
+
+    def mark_service(self, svc: Service) -> None:
+        """healthy.go:149-163."""
+        with self._lock:
+            check = self.checks.get(svc.id)
+            svc.status = (check.service_status() if check is not None
+                          else svc_mod.UNKNOWN)
+
+    def services(self) -> list[Service]:
+        """Discovery output re-marked with check status — the catalog's
+        broadcast source (service_bridge.go:18-37)."""
+        if self.discovery_fn is None:
+            log.error("Error: discovery_fn not defined!")
+            return []
+        out = []
+        for svc in self.discovery_fn():
+            if not svc.id:
+                log.error("Error: monitor found empty service ID")
+                continue
+            self.mark_service(svc)
+            out.append(svc)
+        return out
+
+    # -- check construction ------------------------------------------------
+
+    def get_command_named(self, name: str) -> Checker:
+        """service_bridge.go:72-83."""
+        return {
+            "HttpGet": HttpGetCmd,
+            "External": ExternalCmd,
+            "AlwaysSuccessful": AlwaysSuccessfulCmd,
+        }.get(name, HttpGetCmd)()
+
+    def default_check_for_service(self, svc: Service) -> Check:
+        """HttpGet on the first TCP port at the default endpoint
+        (service_bridge.go:48-69)."""
+        port = next((p for p in svc.ports if p.type == "tcp"), None)
+        if port is None:
+            return Check(svc.id, command=AlwaysSuccessfulCmd())
+        endpoint = self.default_check_endpoint or DEFAULT_STATUS_ENDPOINT
+        url = f"http://{self.default_check_host}:{port.port}{endpoint}"
+        return Check(svc.id, type="HttpGet", args=url, status=FAILED,
+                     command=HttpGetCmd())
+
+    def template_check_args(self, args: str, svc: Service) -> str:
+        """Substitute service info into check args
+        (service_bridge.go:105-127): ``{{ host }}``, ``{{ container }}``,
+        ``{{ tcp N }}``/``{{ udp N }}`` (ServicePort → mapped port)."""
+        def sub(match: re.Match) -> str:
+            kind, port = match.group(1), match.group(2)
+            if kind == "host":
+                return self.default_check_host
+            if kind == "container":
+                return svc.hostname
+            if port is None:
+                return match.group(0)
+            return str(svc.port_for_service_port(int(port), kind))
+
+        return _TEMPLATE_RE.sub(sub, args)
+
+    def check_for_service(self, svc: Service,
+                          disco: Discoverer) -> Check:
+        """service_bridge.go:131-141."""
+        ctype, args = disco.health_check(svc)
+        if not ctype:
+            log.warning("Using default check for service %s (id: %s).",
+                        svc.name, svc.id)
+            check = self.default_check_for_service(svc)
+        else:
+            check = Check(svc.id, type=ctype, args=args, status=FAILED,
+                          command=self.get_command_named(ctype))
+        check.args = self.template_check_args(check.args, svc)
+        return check
+
+    # -- loops -------------------------------------------------------------
+
+    def watch(self, disco: Discoverer, looper: Looper) -> None:
+        """Sync the check set with discovery (service_bridge.go:146-187)."""
+        self.discovery_fn = disco.services
+
+        def one() -> None:
+            services = disco.services()
+            for svc in services:
+                with self._lock:
+                    have = svc.id in self.checks
+                if not have:
+                    check = self.check_for_service(svc, disco)
+                    if check.command is None:
+                        log.error("Attempted to add %s (id: %s) but no "
+                                  "check configured!", svc.name, svc.id)
+                    else:
+                        self.add_check(check)
+            live = {svc.id for svc in services}
+            with self._lock:
+                for cid in list(self.checks):
+                    if cid not in live:
+                        del self.checks[cid]
+
+        looper.loop(one)
+
+    def run(self, looper: Looper) -> None:
+        """Run all checks concurrently each tick, per-check timeout
+        interval−1 ms (healthy.go:166-213)."""
+        def one() -> None:
+            with self._lock:
+                checks = list(self.checks.values())
+            if not checks:
+                return
+            timeout = max(self.check_interval - 0.001, 0.001)
+            futures = {self._pool.submit(c.command.run, c.args): c
+                       for c in checks}
+            done, not_done = concurrent.futures.wait(
+                futures, timeout=timeout)
+            for fut in done:
+                check = futures[fut]
+                try:
+                    status, err = fut.result()
+                except Exception as exc:  # noqa: BLE001 — check errors are data
+                    status, err = UNKNOWN, exc
+                check.update_status(status, err)
+            # Move on at the timeout like the reference — a stuck check's
+            # worker lingers in the pool but cannot block the loop
+            # (healthy.go:196-202); cancel() frees the queued-not-started
+            # ones.
+            for fut in not_done:
+                check = futures[fut]
+                log.error("Error, check %s timed out! (%s)", check.id,
+                          check.args)
+                check.update_status(UNKNOWN, TimeoutError("Timed out!"))
+                fut.cancel()
+
+        looper.loop(one)

@@ -1,0 +1,320 @@
+"""Node bootstrap: wires config → catalog → discovery → health →
+broadcast loops → HTTP API → proxies → gossip transport
+(reference: main.go:284-414 and its configure* helpers).
+
+``SidecarNode`` owns the whole object graph so tests can assemble nodes
+in-process; ``main()`` is the CLI entry point
+(``python -m sidecar_tpu.main`` or the ``sidecar-tpu`` alias)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import threading
+from typing import Optional
+
+from sidecar_tpu import service as svc_mod
+from sidecar_tpu.addresses import get_published_ip
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.catalog.url_listener import UrlListener
+from sidecar_tpu.config import Config, format_config, parse_config
+from sidecar_tpu.discovery import MultiDiscovery, StaticDiscovery
+from sidecar_tpu.discovery.base import ChangeListener, Discoverer
+from sidecar_tpu.discovery.docker import DockerDiscovery
+from sidecar_tpu.discovery.kubernetes import (
+    K8sAPIDiscoverer,
+    KubeAPIDiscoveryCommand,
+)
+from sidecar_tpu.discovery.namer import DockerLabelNamer, RegexpNamer
+from sidecar_tpu.health import Monitor
+from sidecar_tpu.health.monitor import HEALTH_INTERVAL, WATCH_INTERVAL
+from sidecar_tpu.proxy.envoy import XdsServer
+from sidecar_tpu.proxy.haproxy import HAProxy
+from sidecar_tpu.runtime.looper import TimedLooper, run_in_thread
+from sidecar_tpu.web import SidecarApi, serve_http
+
+log = logging.getLogger(__name__)
+
+
+def configure_logging(level: str, fmt: str = "") -> None:
+    """main.go:212-237."""
+    levels = {"debug": logging.DEBUG, "info": logging.INFO,
+              "warn": logging.WARNING, "error": logging.ERROR}
+    logging.basicConfig(
+        level=levels.get(level.lower(), logging.INFO),
+        format=("%(message)s" if fmt == "json" else
+                "%(asctime)s %(levelname)-7s %(name)s: %(message)s"))
+
+
+def configure_discovery(config: Config, advertise_ip: str,
+                        hostname: Optional[str] = None) -> MultiDiscovery:
+    """main.go:62-141 — build the discovery stack from config."""
+    discoverers: list[Discoverer] = []
+    for kind in config.sidecar.discovery:
+        if kind == "docker":
+            if config.services.service_namer == "regex":
+                namer = RegexpNamer(config.services.name_match)
+            else:
+                namer = DockerLabelNamer(config.services.name_label)
+            discoverers.append(DockerDiscovery(
+                config.docker_discovery.docker_url, namer, advertise_ip,
+                hostname=hostname))
+        elif kind == "static":
+            discoverers.append(StaticDiscovery(
+                config.static_discovery.config_file, advertise_ip,
+                hostname=hostname))
+        elif kind == "kubernetes_api":
+            k8s = config.k8s_api_discovery
+            discoverers.append(K8sAPIDiscoverer(
+                KubeAPIDiscoveryCommand(
+                    k8s.kube_api_ip, k8s.kube_api_port, k8s.namespace,
+                    k8s.kube_timeout, k8s.creds_path),
+                namespace=k8s.namespace,
+                announce_all_nodes=k8s.announce_all_nodes,
+                hostname=hostname or ""))
+        elif kind == "none":
+            continue
+        else:
+            log.error("Unrecognized discovery method: %s", kind)
+    return MultiDiscovery(discoverers)
+
+
+class SidecarNode:
+    """The assembled node (main.go:284-414)."""
+
+    def __init__(self, config: Optional[Config] = None,
+                 hostname: Optional[str] = None,
+                 transport=None) -> None:
+        import socket
+
+        self.config = config if config is not None else parse_config()
+        self.hostname = hostname or socket.gethostname()
+        self.advertise_ip = get_published_ip(
+            self.config.sidecar.exclude_ips,
+            self.config.sidecar.advertise_ip)
+        self.state = ServicesState(
+            hostname=self.hostname,
+            cluster_name=self.config.sidecar.cluster_name)
+        self.disco = configure_discovery(self.config, self.advertise_ip,
+                                         self.hostname)
+        self.monitor = Monitor(self.advertise_ip,
+                               self.config.sidecar.default_check_endpoint)
+        self.transport = transport
+        self.api = SidecarApi(
+            self.state,
+            members_fn=self._members,
+            cluster_name=self.config.sidecar.cluster_name)
+        self.haproxy: Optional[HAProxy] = None
+        if not self.config.haproxy.disable:
+            self.haproxy = HAProxy(
+                config_file=self.config.haproxy.config_file,
+                pid_file=self.config.haproxy.pid_file,
+                bind_ip=self.config.haproxy.bind_ip,
+                user=self.config.haproxy.user,
+                group=self.config.haproxy.group,
+                use_hostnames=self.config.haproxy.use_hostnames,
+                reload_cmd=self.config.haproxy.reload_cmd,
+                verify_cmd=self.config.haproxy.verify_cmd)
+        self.xds = XdsServer(self.state, self.config.envoy.bind_ip,
+                             self.config.envoy.use_hostnames)
+        self._loopers: list[TimedLooper] = []
+        self._http_server = None
+        self._xds_server = None
+
+    def _members(self) -> list[str]:
+        if self.transport is not None:
+            return self.transport.members()
+        return sorted(self.state.servers)
+
+    def _looper(self, interval: float) -> TimedLooper:
+        looper = TimedLooper(interval)
+        self._loopers.append(looper)
+        return looper
+
+    def start(self, http_port: int = 7777, xds_port: int = 7776,
+              serve: bool = True) -> None:
+        """Bring the node up (main.go:284-414 order)."""
+        cfg = self.config.sidecar
+        log.info("%s", format_config(self.config))
+
+        # Single-writer state mutation loop (main.go:296-299).
+        threading.Thread(
+            target=self.state.process_service_msgs,
+            args=(self._looper(0),), name="state-writer",
+            daemon=True).start()
+
+        # Static listener URLs from config (main.go:277-282).
+        for url in self.config.listeners.urls:
+            listener = UrlListener(url, managed=False)
+            listener.watch(self.state)
+
+        # Gossip transport (memberlist equivalent; main.go:239-274,308-316).
+        if self.transport is not None:
+            self.transport.start(self.state, seeds=cfg.seeds)
+
+        # Discovery → health → catalog loops (main.go:318-385).
+        self.disco.run(self._looper(cfg.discovery_sleep_interval))
+        run_in_thread(self._looper(WATCH_INTERVAL),
+                      self._watch_once, name="monitor-watch")
+        self._monitor_watch_looper = self._loopers[-1]
+        monitor_run_looper = self._looper(HEALTH_INTERVAL)
+        threading.Thread(target=self.monitor.run,
+                         args=(monitor_run_looper,),
+                         name="monitor-run", daemon=True).start()
+
+        threading.Thread(
+            target=self.state.broadcast_services,
+            args=(self.monitor.services, self._looper(1.0)),
+            name="broadcast-services", daemon=True).start()
+        threading.Thread(
+            target=self.state.broadcast_tombstones,
+            args=(self.monitor.services, self._looper(2.0)),
+            name="broadcast-tombstones", daemon=True).start()
+        # Local services flow into the catalog via the single-writer queue
+        # (state.TrackNewServices, main.go:382).
+        threading.Thread(
+            target=self.state.track_new_services,
+            args=(self.monitor.services, self._looper(1.0)),
+            name="track-services", daemon=True).start()
+        threading.Thread(
+            target=self.state.track_local_listeners,
+            args=(self._discovered_listeners, self._looper(5.0)),
+            name="track-listeners", daemon=True).start()
+
+        # HTTP API (main.go:387-390).
+        if serve:
+            self._http_server = serve_http(
+                self.api, port=http_port, ui_dir="ui/app",
+                static_dir="views/static")
+
+        # Initial HAProxy write (main.go:392-395).
+        if self.haproxy is not None:
+            self.haproxy.watch(self.state)
+            try:
+                self.haproxy.write_and_reload(self.state)
+            except (RuntimeError, OSError, ValueError) as exc:
+                log.error("Initial HAProxy write failed: %s", exc)
+
+        # Envoy xDS (main.go:397-411).
+        if serve and self.config.envoy.use_grpc_api:
+            self._xds_server = self.xds.serve(
+                port=int(self.config.envoy.grpc_port))
+
+    # The monitor.watch loop body needs the discoverer; wrap it so the
+    # looper drives one sync per tick.
+    def _watch_once(self) -> None:
+        from sidecar_tpu.runtime.looper import FreeLooper
+        self.monitor.watch(self.disco, FreeLooper(1))
+
+    def _discovered_listeners(self):
+        out = []
+        for cl in self.disco.listeners():
+            listener = UrlListener(cl.url, managed=True)
+            listener.set_name(cl.name)
+            out.append(listener)
+        return out
+
+    def stop(self) -> None:
+        for looper in self._loopers:
+            looper.quit()
+        self.state.stop_processing()
+        if self.transport is not None:
+            self.transport.stop()
+        if self._http_server is not None:
+            self._http_server.shutdown()
+        if self._xds_server is not None:
+            self._xds_server.shutdown()
+        if self.haproxy is not None:
+            self.haproxy.stop()
+
+
+def parse_command_line(argv=None) -> argparse.Namespace:
+    """cli.go:25-41."""
+    parser = argparse.ArgumentParser("sidecar-tpu")
+    parser.add_argument("-a", "--advertise-ip", default=None,
+                        help="The address to advertise to the cluster")
+    parser.add_argument("-c", "--cluster-ip", action="append", default=[],
+                        help="The cluster seed addresses")
+    parser.add_argument("-n", "--cluster-name", default=None,
+                        help="The cluster we're part of")
+    parser.add_argument("-p", "--cpuprofile", action="store_true",
+                        help="Enable CPU profiling")
+    parser.add_argument("-d", "--discover", action="append", default=[],
+                        help="Method of discovery")
+    parser.add_argument("-l", "--logging-level", default=None,
+                        help="Set the logging level")
+    parser.add_argument("--http-port", type=int, default=7777)
+    parser.add_argument("--hostname", default=None,
+                        help="Override this node's identity (defaults to "
+                             "the machine hostname)")
+    return parser.parse_args(argv)
+
+
+def apply_cli_overrides(config: Config,
+                        opts: argparse.Namespace) -> None:
+    """main.go:44-60."""
+    if opts.advertise_ip:
+        config.sidecar.advertise_ip = opts.advertise_ip
+    if opts.cluster_ip:
+        config.sidecar.seeds = opts.cluster_ip
+    if opts.cluster_name:
+        config.sidecar.cluster_name = opts.cluster_name
+    if opts.discover:
+        config.sidecar.discovery = opts.discover
+    if opts.logging_level:
+        config.sidecar.logging_level = opts.logging_level
+
+
+def main(argv=None) -> int:
+    import os
+
+    opts = parse_command_line(argv)
+    config = parse_config()
+    apply_cli_overrides(config, opts)
+    # Node identity defaults to the machine hostname (as the reference
+    # does via memberlist); SIDECAR_HOSTNAME or --hostname overrides it so
+    # multiple nodes can share a host outside containers.
+    hostname = opts.hostname or os.environ.get("SIDECAR_HOSTNAME") or None
+    configure_logging(config.sidecar.logging_level,
+                      config.sidecar.logging_format)
+
+    profiler = None
+    if opts.cpuprofile:
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
+
+    from sidecar_tpu.transport import GossipTransport
+
+    # Resolve the advertise address before building the transport — the
+    # cluster must learn our published IP, never the loopback fallback
+    # (the reference wires memberlist.AdvertiseAddr the same way,
+    # main.go:267-271).
+    published_ip = get_published_ip(config.sidecar.exclude_ips,
+                                    config.sidecar.advertise_ip)
+    node = SidecarNode(config=config, hostname=hostname,
+                       transport=GossipTransport(
+                           node_name=hostname,
+                           bind_port=config.sidecar.bind_port,
+                           advertise_ip=published_ip,
+                           cluster_name=config.sidecar.cluster_name,
+                           gossip_interval=config.sidecar.gossip_interval,
+                           push_pull_interval=config.sidecar
+                           .push_pull_interval,
+                           gossip_messages=config.sidecar.gossip_messages))
+    node.start(http_port=opts.http_port)
+    log.info("Sidecar node %s up on %s", node.hostname, node.advertise_ip)
+    try:
+        threading.Event().wait()  # select {} (main.go:413)
+    except KeyboardInterrupt:
+        node.stop()
+        if profiler is not None:
+            profiler.disable()
+            profiler.dump_stats("sidecar.cpu.prof")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+"""Proxy drivers: HAProxy config writer and Envoy xDS control plane
+(reference: haproxy/ and envoy/ packages)."""
+
+from sidecar_tpu.proxy.haproxy import HAProxy
+from sidecar_tpu.proxy.envoy import EnvoyResources, resources_from_state
+
+__all__ = ["HAProxy", "EnvoyResources", "resources_from_state"]

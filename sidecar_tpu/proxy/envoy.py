@@ -1,0 +1,453 @@
+"""Envoy control plane: xDS resource generation + serving.
+
+Reference: envoy/adapter/adapter.go:33-390 (resource generation),
+envoy/server.go:22-139 (ADS server with a 1 s LastChanged poll), and
+sidecarhttp/envoy_api.go:25-438 (legacy V1 REST SDS/CDS/LDS).
+
+The reference builds go-control-plane v2 protobufs and pushes them over
+an ADS gRPC stream.  Here resources are generated as **v3 proto-JSON**
+dicts — the JSON encoding Envoy itself accepts — and served through
+Envoy's REST xDS transport (``api_type: REST`` fetch), which needs no
+gRPC stack; the same resource-generation logic (port-collision guard
+with oldest-wins via the sorted state walk, EDS-type clusters,
+per-ProxyMode filter chains incl. websocket upgrade) is preserved.
+A gRPC ADS server can be layered on the same ``resources_from_state``
+output when grpcio is available."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from sidecar_tpu.catalog.state import ServicesState
+from sidecar_tpu.service import Service, ns_to_rfc3339
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME_SEPARATOR = ":"          # adapter.go:33
+PORT_COLLISION_LOGGING_BACKOFF = 60.0  # adapter.go:37
+LOOPER_UPDATE_INTERVAL = 1.0          # server.go:25
+
+TYPE_CLUSTER = "type.googleapis.com/envoy.config.cluster.v3.Cluster"
+TYPE_ENDPOINT = ("type.googleapis.com/"
+                 "envoy.config.endpoint.v3.ClusterLoadAssignment")
+TYPE_LISTENER = "type.googleapis.com/envoy.config.listener.v3.Listener"
+
+_last_logged_port_collision = 0.0
+
+
+def svc_name(name: str, port: int) -> str:
+    """adapter.go:52-55."""
+    return f"{name}{SERVICE_NAME_SEPARATOR}{port}"
+
+
+def svc_name_split(name: str) -> tuple[str, int]:
+    """adapter.go:57-70; raises ValueError on bad input."""
+    parts = name.split(SERVICE_NAME_SEPARATOR)
+    if len(parts) < 2:
+        raise ValueError("Unable to split service name and port!")
+    try:
+        return parts[0], int(parts[1])
+    except ValueError as exc:
+        raise ValueError("Unable to parse port!") from exc
+
+
+def lookup_host(hostname: str) -> str:
+    """adapter.go:73-82 — dev-mode-only DNS resolution."""
+    return socket.gethostbyname(hostname)
+
+
+@dataclasses.dataclass
+class EnvoyResources:
+    """adapter.go:45-49 — v3 proto-JSON resource dicts."""
+
+    endpoints: list[dict]
+    clusters: list[dict]
+    listeners: list[dict]
+
+
+def _lb_endpoints(svc: Service, svc_port: int,
+                  use_hostnames: bool) -> list[dict]:
+    """adapter.go:355-390."""
+    out = []
+    for port in svc.ports:
+        if port.service_port != svc_port:
+            continue
+        address = port.ip
+        if use_hostnames:
+            try:
+                address = lookup_host(svc.hostname)
+            except OSError:
+                log.warning("Unable to resolve %s, using IP address",
+                            svc.hostname)
+        out.append({
+            "endpoint": {
+                "address": {"socket_address": {
+                    "address": address, "port_value": port.port}},
+            }
+        })
+    return out
+
+
+def _http_connection_manager(svc: Service, envoy_name: str,
+                             websocket: bool) -> dict:
+    """adapter.go:218-296 (v3 shape)."""
+    manager = {
+        "@type": ("type.googleapis.com/envoy.extensions.filters.network."
+                  "http_connection_manager.v3.HttpConnectionManager"),
+        "stat_prefix": "ingress_http",
+        "http_filters": [{
+            "name": "envoy.filters.http.router",
+            "typed_config": {
+                "@type": ("type.googleapis.com/envoy.extensions.filters."
+                          "http.router.v3.Router")},
+        }],
+        "route_config": {
+            "validate_clusters": False,
+            "virtual_hosts": [{
+                "name": svc.name,
+                "domains": ["*"],
+                "routes": [{
+                    "match": {"prefix": "/"},
+                    "route": {"cluster": envoy_name, "timeout": "0s"},
+                }],
+            }],
+        },
+    }
+    if websocket:
+        manager["upgrade_configs"] = [{"upgrade_type": "websocket"}]
+    return manager
+
+
+def _connection_manager(svc: Service, envoy_name: str) -> tuple[str, dict]:
+    """adapter.go:216-304; raises ValueError on unknown proxy mode."""
+    if svc.proxy_mode == "http":
+        return ("envoy.filters.network.http_connection_manager",
+                _http_connection_manager(svc, envoy_name, websocket=False))
+    if svc.proxy_mode == "tcp":
+        return ("envoy.filters.network.tcp_proxy", {
+            "@type": ("type.googleapis.com/envoy.extensions.filters."
+                      "network.tcp_proxy.v3.TcpProxy"),
+            "stat_prefix": "ingress_tcp",
+            "cluster": envoy_name,
+        })
+    if svc.proxy_mode == "ws":
+        return ("envoy.filters.network.http_connection_manager",
+                _http_connection_manager(svc, envoy_name, websocket=True))
+    raise ValueError(f"unrecognised proxy mode: {svc.proxy_mode}")
+
+
+def _listener_from_service(svc: Service, envoy_name: str, svc_port: int,
+                           bind_ip: str) -> dict:
+    """adapter.go:320-351."""
+    manager_name, manager = _connection_manager(svc, envoy_name)
+    return {
+        "name": envoy_name,
+        "address": {"socket_address": {
+            "address": bind_ip, "port_value": svc_port}},
+        "filter_chains": [{
+            "filters": [{"name": manager_name,
+                         "typed_config": manager}],
+        }],
+    }
+
+
+def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
+                         use_hostnames: bool = False) -> EnvoyResources:
+    """Full resource set from the catalog (adapter.go:108-212).
+
+    The port-collision guard gives each ServicePort to the first (oldest,
+    via the sorted state walk) service claiming it — multiple listeners
+    on one port make Envoy melt down (adapter.go:87-103)."""
+    global _last_logged_port_collision
+    endpoint_map: dict[str, dict] = {}
+    cluster_map: dict[str, dict] = {}
+    listener_map: dict[str, dict] = {}
+    ports_map: dict[int, str] = {}
+
+    with state._lock:
+        walk = list(state.each_service_sorted())
+    for _, _, svc in walk:
+        if not svc.is_alive():
+            continue
+        for port in svc.ports:
+            if port.service_port < 1:
+                continue
+            owner = ports_map.setdefault(port.service_port, svc.name)
+            if owner != svc.name:
+                now = time.monotonic()
+                if now - _last_logged_port_collision > \
+                        PORT_COLLISION_LOGGING_BACKOFF:
+                    log.warning(
+                        "Port collision! %s is attempting to squat on port "
+                        "%d owned by %s", svc.name, port.service_port,
+                        owner)
+                    _last_logged_port_collision = now
+                continue
+
+            envoy_name = svc_name(svc.name, port.service_port)
+            lbs = _lb_endpoints(svc, port.service_port, use_hostnames)
+            if envoy_name in endpoint_map:
+                endpoint_map[envoy_name]["endpoints"][0][
+                    "lb_endpoints"].extend(lbs)
+            else:
+                endpoint_map[envoy_name] = {
+                    "@type": TYPE_ENDPOINT,
+                    "cluster_name": envoy_name,
+                    "endpoints": [{"lb_endpoints": lbs}],
+                }
+                cluster_map[envoy_name] = {
+                    "@type": TYPE_CLUSTER,
+                    "name": envoy_name,
+                    "connect_timeout": "0.500s",
+                    "type": "EDS",
+                    "eds_cluster_config": {
+                        "eds_config": {
+                            "ads": {},
+                            "resource_api_version": "V3",
+                        },
+                    },
+                }
+            if envoy_name not in listener_map:
+                try:
+                    listener_map[envoy_name] = _listener_from_service(
+                        svc, envoy_name, port.service_port, bind_ip)
+                except ValueError as exc:
+                    log.error("Failed to create Envoy listener for service "
+                              "%r and port %d: %s", svc.name,
+                              port.service_port, exc)
+                    continue
+
+    return EnvoyResources(
+        endpoints=list(endpoint_map.values()),
+        clusters=list(cluster_map.values()),
+        listeners=list(listener_map.values()),
+    )
+
+
+# -- V1 REST API (deprecated in the reference, kept for parity) ------------
+
+class EnvoyApiV1:
+    """sidecarhttp/envoy_api.go:25-438: SDS /v1/registration/{service},
+    CDS /v1/clusters, LDS /v1/listeners."""
+
+    def __init__(self, state: ServicesState, bind_ip: str = "0.0.0.0",
+                 use_hostnames: bool = False, cluster_name: str = "") -> None:
+        self.state = state
+        self.bind_ip = bind_ip
+        self.use_hostnames = use_hostnames
+        self.cluster_name = cluster_name
+
+    def _service_entry(self, svc: Service,
+                       svc_port: int) -> Optional[dict]:
+        for port in svc.ports:
+            if port.service_port != svc_port:
+                continue
+            address = port.ip
+            if self.use_hostnames:
+                try:
+                    address = lookup_host(svc.hostname)
+                except OSError:
+                    log.warning("Unable to resolve %s, using IP address",
+                                svc.hostname)
+            return {
+                "ip_address": address,
+                "last_check_in": ns_to_rfc3339(svc.updated),
+                "port": port.port,
+                "revision": svc.version(),
+                "service": svc_name(svc.name, svc_port),
+                "service_repo_name": svc.image,
+                "tags": {},
+            }
+        return None
+
+    def registration(self, name: str):
+        """SDS (envoy_api.go:114-176)."""
+        try:
+            wanted, port = svc_name_split(name)
+        except ValueError as exc:
+            return 404, {"status": "error",
+                         "message": f"Not Found - {exc}"}
+        hosts = []
+        with self.state._lock:
+            for _, _, svc in self.state.each_service():
+                if svc.name == wanted and svc.is_alive():
+                    entry = self._service_entry(svc, port)
+                    if entry is not None:
+                        hosts.append(entry)
+        return 200, {"env": self.cluster_name, "hosts": hosts,
+                     "service": name}
+
+    def clusters(self):
+        """CDS (envoy_api.go:180-208, 280-310)."""
+        out = []
+        seen: dict[int, str] = {}
+        with self.state._lock:
+            walk = list(self.state.each_service_sorted())
+        for _, _, svc in walk:
+            if not svc.is_alive():
+                continue
+            for port in svc.ports:
+                if port.service_port < 1:
+                    continue
+                if seen.setdefault(port.service_port, svc.name) != svc.name:
+                    continue
+                name = svc_name(svc.name, port.service_port)
+                if any(c["name"] == name for c in out):
+                    continue
+                out.append({
+                    "name": name,
+                    "type": "sds",
+                    "connect_timeout_ms": 500,
+                    "lb_type": "round_robin",
+                    "service_name": name,
+                })
+        return 200, {"clusters": out}
+
+    def listeners(self):
+        """LDS (envoy_api.go:212-276, 314-424)."""
+        out = []
+        seen: dict[int, str] = {}
+        with self.state._lock:
+            walk = list(self.state.each_service_sorted())
+        for _, _, svc in walk:
+            if not svc.is_alive():
+                continue
+            for port in svc.ports:
+                if port.service_port < 1:
+                    continue
+                if seen.setdefault(port.service_port, svc.name) != svc.name:
+                    continue
+                name = svc_name(svc.name, port.service_port)
+                if any(l["name"] == name for l in out):
+                    continue
+                address = f"tcp://{self.bind_ip}:{port.service_port}"
+                if svc.proxy_mode == "tcp":
+                    filters = [{
+                        "name": "tcp_proxy",
+                        "config": {
+                            "stat_prefix": "ingress_tcp",
+                            "route_config": {
+                                "routes": [{"cluster": name}],
+                            },
+                        },
+                    }]
+                else:
+                    filters = [{
+                        "name": "http_connection_manager",
+                        "config": {
+                            "codec_type": "auto",
+                            "stat_prefix": "ingress_http",
+                            "route_config": {
+                                "virtual_hosts": [{
+                                    "name": svc.name,
+                                    "domains": ["*"],
+                                    "routes": [{
+                                        "timeout_ms": 0,
+                                        "prefix": "/",
+                                        "host_rewrite": "",
+                                        "cluster": name,
+                                    }],
+                                }],
+                            },
+                        },
+                    }]
+                out.append({"name": name, "address": address,
+                            "filters": filters})
+        return 200, {"listeners": out}
+
+
+# -- REST xDS v3 server ----------------------------------------------------
+
+class XdsServer:
+    """Serves v3 resources over Envoy's REST xDS transport and keeps a
+    versioned snapshot refreshed on a LastChanged poll (server.go:61-124;
+    versions are UnixNano, server.go:54-59)."""
+
+    def __init__(self, state: ServicesState, bind_ip: str = "0.0.0.0",
+                 use_hostnames: bool = False) -> None:
+        self.state = state
+        self.bind_ip = bind_ip
+        self.use_hostnames = use_hostnames
+        self._snapshot: Optional[EnvoyResources] = None
+        self._version = "0"
+        self._last_changed = -1
+        self._lock = threading.Lock()
+
+    def refresh(self) -> bool:
+        """Rebuild the snapshot if the state changed; True when updated."""
+        if self.state.last_changed == self._last_changed:
+            return False
+        resources = resources_from_state(
+            self.state, self.bind_ip, self.use_hostnames)
+        with self._lock:
+            self._snapshot = resources
+            self._version = str(time.time_ns())
+            self._last_changed = self.state.last_changed
+        return True
+
+    def discovery_response(self, type_url: str):
+        """One REST xDS fetch (DiscoveryRequest → DiscoveryResponse)."""
+        self.refresh()
+        with self._lock:
+            snap = self._snapshot
+            version = self._version
+        if snap is None:
+            return {"version_info": "0", "resources": [],
+                    "type_url": type_url}
+        resources = {
+            TYPE_CLUSTER: snap.clusters,
+            TYPE_ENDPOINT: snap.endpoints,
+            TYPE_LISTENER: snap.listeners,
+        }.get(type_url)
+        if resources is None:
+            raise KeyError(type_url)
+        return {"version_info": version, "resources": resources,
+                "type_url": type_url}
+
+    def serve(self, bind: str = "0.0.0.0", port: int = 7776,
+              background: bool = True) -> ThreadingHTTPServer:
+        """REST xDS endpoints: POST /v3/discovery:{clusters,endpoints,
+        listeners} (the reference's gRPC ADS server binds 7776,
+        config/config.go:32)."""
+        xds = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                log.debug("xds: " + a[0], *a[1:])
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                if length:
+                    self.rfile.read(length)
+                route = self.path.split("?")[0]
+                type_url = {
+                    "/v3/discovery:clusters": TYPE_CLUSTER,
+                    "/v3/discovery:endpoints": TYPE_ENDPOINT,
+                    "/v3/discovery:listeners": TYPE_LISTENER,
+                }.get(route)
+                if type_url is None:
+                    body = b'{"message": "unknown discovery type"}'
+                    self.send_response(404)
+                else:
+                    body = json.dumps(
+                        xds.discovery_response(type_url)).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        server = ThreadingHTTPServer((bind, port), Handler)
+        if background:
+            threading.Thread(target=server.serve_forever,
+                             name="xds-server", daemon=True).start()
+        else:
+            server.serve_forever()
+        return server

@@ -1,0 +1,14 @@
+"""Receiver library — the client side for downstream consumers of
+Sidecar state events (reference: receiver/ package, the haproxy-api
+pattern)."""
+
+from sidecar_tpu.receiver.receiver import (
+    RELOAD_HOLD_DOWN,
+    Receiver,
+    fetch_state,
+    should_notify,
+    update_handler,
+)
+
+__all__ = ["Receiver", "should_notify", "fetch_state", "update_handler",
+           "RELOAD_HOLD_DOWN"]

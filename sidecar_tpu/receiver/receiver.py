@@ -1,0 +1,171 @@
+"""Receiver: accepts POSTed StateChangedEvents, filters them through the
+state-transition table, and batches bursts before invoking the consumer
+callback (reference: receiver/receiver.go:17-202, receiver/http.go:17-63)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.request
+from typing import Callable, Optional
+
+from sidecar_tpu import service as svc_mod
+from sidecar_tpu.catalog import ServicesState, decode
+from sidecar_tpu.catalog.state import ChangeEvent
+from sidecar_tpu.runtime.looper import Looper, TimedLooper
+from sidecar_tpu.service import Service
+
+log = logging.getLogger(__name__)
+
+RELOAD_HOLD_DOWN = 5.0  # receiver.go:18 — reload at worst every 5 s
+
+
+def should_notify(old_status: int, new_status: int) -> bool:
+    """The significant-transition table (receiver.go:41-69): ALIVE,
+    TOMBSTONE and DRAINING always notify; UNKNOWN/UNHEALTHY only when the
+    service was ALIVE."""
+    if new_status in (svc_mod.ALIVE, svc_mod.TOMBSTONE, svc_mod.DRAINING):
+        return True
+    if new_status in (svc_mod.UNKNOWN, svc_mod.UNHEALTHY):
+        return old_status == svc_mod.ALIVE
+    log.error("Got unknown service change status: %d", new_status)
+    return False
+
+
+def fetch_state(url: str, timeout: float = 5.0) -> ServicesState:
+    """Fetch a full state dump from a Sidecar /state.json endpoint
+    (receiver.go:73-95)."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        if not (200 <= resp.status < 300):
+            raise OSError(f"Bad status code on state fetch: {resp.status}")
+        return decode(resp.read())
+
+
+class Receiver:
+    """receiver.go:21-37."""
+
+    def __init__(self, capacity: int = 10,
+                 on_update: Optional[Callable[[ServicesState],
+                                              None]] = None,
+                 looper: Optional[Looper] = None) -> None:
+        self.state_lock = threading.Lock()
+        self.reload_chan: "queue.Queue[float]" = queue.Queue(
+            maxsize=capacity)
+        self.current_state: Optional[ServicesState] = None
+        self.last_svc_changed: Optional[Service] = None
+        self.on_update = on_update
+        self.looper = looper if looper is not None else TimedLooper(
+            RELOAD_HOLD_DOWN)
+        self.subscriptions: list[str] = []
+
+    # -- subscriptions -----------------------------------------------------
+
+    def is_subscribed(self, svc_name: str) -> bool:
+        """No subscriptions means everything (receiver.go:98-111)."""
+        return not self.subscriptions or svc_name in self.subscriptions
+
+    def subscribe(self, svc_name: str) -> None:
+        if svc_name not in self.subscriptions:
+            self.subscriptions.append(svc_name)
+
+    # -- update intake -----------------------------------------------------
+
+    def enqueue_update(self) -> None:
+        try:
+            self.reload_chan.put_nowait(time.time())
+        except queue.Full:
+            pass  # already saturated; the pending flush covers us
+
+    def handle_update(self, payload: bytes | str) -> None:
+        """Accept one POSTed StateChangedEvent (receiver/http.go:17-63):
+        keep the newest state by LastChanged, filter via should_notify +
+        subscriptions, then enqueue a batched reload."""
+        evt = json.loads(payload)
+        state = decode(json.dumps(evt.get("State") or {}))
+        change = ChangeEvent.from_json(evt.get("ChangeEvent") or {})
+
+        with self.state_lock:
+            if self.current_state is not None and \
+                    self.current_state.last_changed >= state.last_changed:
+                return
+            self.current_state = state
+            self.last_svc_changed = change.service
+
+            if not should_notify(change.previous_status,
+                                 change.service.status):
+                return
+            if not self.is_subscribed(change.service.name):
+                return
+            if self.on_update is None:
+                log.error("No on_update() callback registered!")
+                return
+        self.enqueue_update()
+
+    # -- the reload loop ---------------------------------------------------
+
+    def process_updates(self) -> None:
+        """Batch bursts into single reloads with the 5 s hold-down
+        (receiver.go:130-174)."""
+        if self.looper is None:
+            log.error("Unable to process_updates(), looper is nil!")
+            return
+
+        def one() -> None:
+            first = self.reload_chan.get()
+            if first is None:
+                raise StopIteration
+            pending = self.reload_chan.qsize()
+            if self.on_update is None:
+                log.error("on_update() callback not defined!")
+            else:
+                with self.state_lock:
+                    # Deep-copy so the callback can't race the handler
+                    # (receiver.go:147-152).
+                    snapshot = (decode(self.current_state.encode())
+                                if self.current_state is not None else None)
+                if snapshot is not None:
+                    self.on_update(snapshot)
+            for _ in range(pending):
+                try:
+                    self.reload_chan.get_nowait()
+                except queue.Empty:
+                    break
+            if pending > 0:
+                log.info("Skipped %d grouped updates", pending)
+
+        try:
+            self.looper.loop(one)
+        except StopIteration:
+            pass
+
+    def stop(self) -> None:
+        self.looper.quit()
+        self.reload_chan.put(None)  # type: ignore[arg-type]
+
+    # -- bootstrap ---------------------------------------------------------
+
+    def fetch_initial_state(self, state_url: str) -> None:
+        """receiver.go:183-202."""
+        with self.state_lock:
+            log.info("Fetching initial state on startup...")
+            state = fetch_state(state_url)
+            log.info("Successfully retrieved state")
+            self.current_state = state
+            on_update = self.on_update
+        if on_update is None:
+            log.error("on_update() callback not defined!")
+        else:
+            on_update(state)
+
+
+def update_handler(rcvr: Receiver, payload: bytes):
+    """WSGI-ish wrapper for mounting the receiver in an HTTP server:
+    returns (status, body_bytes) like receiver/http.go:17-63."""
+    try:
+        rcvr.handle_update(payload)
+    except (json.JSONDecodeError, KeyError, TypeError, ValueError) as exc:
+        return 500, json.dumps({"errors": [str(exc)]}).encode()
+    return 200, b"{}"

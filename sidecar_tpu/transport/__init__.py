@@ -1,0 +1,7 @@
+"""Gossip transport: the native (C++) memberlist-equivalent engine plus
+the Python delegate bridging it to the catalog (reference:
+services_delegate.go + the NinesStack/memberlist dependency)."""
+
+from sidecar_tpu.transport.gossip import GossipTransport, load_native
+
+__all__ = ["GossipTransport", "load_native"]

@@ -1,0 +1,235 @@
+"""ctypes bridge to the native gossip engine + the delegate loop.
+
+The C++ core (native/transport.cc) owns the sockets and IO threads:
+UDP gossip with first-fit ~1398 B packet packing, SWIM-lite ping/ack
+failure detection, and TCP full-state push-pull.  This module is the
+Python half of the reference's ``servicesDelegate``
+(services_delegate.go:16-223):
+
+* outbound — drains ``state.broadcasts`` into the native queue
+  (GetBroadcasts feeding the gossip timer) and keeps the engine's
+  local-state snapshot fresh for push-pull replies (LocalState);
+* inbound — polls received service records into
+  ``state.update_service`` (NotifyMsg → the single-writer merge queue),
+  full push-pull payloads into ``state.merge`` (MergeRemoteState), and
+  membership leave events into ``state.expire_server`` (NotifyLeave).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import pathlib
+import subprocess
+import threading
+from typing import Optional
+
+from sidecar_tpu import service as svc_mod
+from sidecar_tpu.catalog import ServicesState, decode
+
+log = logging.getLogger(__name__)
+
+_REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+_NATIVE_DIR = _REPO_ROOT / "native"
+_SO_PATH = _NATIVE_DIR / "build" / "libsidecar_transport.so"
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def load_native() -> ctypes.CDLL:
+    """Load (building if needed) the native transport library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not _SO_PATH.exists():
+            log.info("Building native transport library...")
+            subprocess.run(["make"], cwd=str(_NATIVE_DIR),
+                           capture_output=True, check=True)
+        lib = ctypes.CDLL(str(_SO_PATH))
+        lib.st_create.restype = ctypes.c_void_p
+        lib.st_create.argtypes = [ctypes.c_char_p] * 3 + [ctypes.c_int] + \
+            [ctypes.c_char_p] + [ctypes.c_int] * 4
+        lib.st_start.restype = ctypes.c_int
+        lib.st_start.argtypes = [ctypes.c_void_p]
+        lib.st_join.restype = ctypes.c_int
+        lib.st_join.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int]
+        lib.st_broadcast.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int]
+        lib.st_set_local_state.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p, ctypes.c_int]
+        for fn in (lib.st_poll_msg, lib.st_poll_state, lib.st_poll_event,
+                   lib.st_members):
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.st_port.restype = ctypes.c_int
+        lib.st_port.argtypes = [ctypes.c_void_p]
+        lib.st_stop.argtypes = [ctypes.c_void_p]
+        lib.st_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class GossipTransport:
+    """The memberlist-equivalent: owns a native engine instance and the
+    delegate threads wiring it to a ServicesState."""
+
+    def __init__(self, node_name: Optional[str] = None,
+                 cluster_name: str = "default",
+                 bind_ip: str = "0.0.0.0", bind_port: int = 7946,
+                 advertise_ip: str = "127.0.0.1",
+                 gossip_interval: float = 0.2,
+                 push_pull_interval: float = 20.0,
+                 gossip_nodes: int = 3,
+                 gossip_messages: int = 15) -> None:
+        import socket
+
+        self.node_name = node_name or socket.gethostname()
+        self.cluster_name = cluster_name
+        self.bind_ip = bind_ip
+        self.bind_port = bind_port
+        self.advertise_ip = advertise_ip or "127.0.0.1"
+        self.gossip_interval = gossip_interval
+        self.push_pull_interval = push_pull_interval
+        self.gossip_nodes = gossip_nodes
+        self.gossip_messages = gossip_messages
+        self._lib = load_native()
+        self._handle: Optional[int] = None
+        self._quit = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self.state: Optional[ServicesState] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, state: ServicesState,
+              seeds: Optional[list[str]] = None) -> int:
+        """Bind sockets, start IO + delegate threads, join seeds.
+        Returns the bound port."""
+        self.state = state
+        self._handle = self._lib.st_create(
+            self.node_name.encode(), self.cluster_name.encode(),
+            self.bind_ip.encode(), self.bind_port,
+            self.advertise_ip.encode(),
+            int(self.gossip_interval * 1000),
+            int(self.push_pull_interval * 1000),
+            self.gossip_nodes, self.gossip_messages)
+        port = self._lib.st_start(self._handle)
+        if port < 0:
+            raise OSError(
+                f"failed to start gossip transport on "
+                f"{self.bind_ip}:{self.bind_port}")
+        self.bind_port = port
+        self._push_local_state()
+
+        for name, fn in [("gossip-outbound", self._outbound_loop),
+                         ("gossip-inbound", self._inbound_loop)]:
+            t = threading.Thread(target=fn, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+        for seed in seeds or []:
+            host, _, port_s = seed.partition(":")
+            try:
+                self.join(host, int(port_s) if port_s else 7946)
+            except OSError as exc:
+                log.warning("Failed to join seed %s: %s", seed, exc)
+        return port
+
+    def join(self, host: str, port: int = 7946) -> None:
+        """TCP dial + full-state exchange (memberlist.Join)."""
+        if self._lib.st_join(self._handle, host.encode(), port) != 0:
+            raise OSError(f"join {host}:{port} failed")
+
+    def stop(self) -> None:
+        self._quit.set()
+        # The delegate threads poll the native handle; join them before
+        # destroying it or st_poll_* races a freed Transport.
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads.clear()
+        if self._handle is not None:
+            self._lib.st_stop(self._handle)
+            self._lib.st_destroy(self._handle)
+            self._handle = None
+
+    def members(self) -> list[str]:
+        """memberlist.Members — node names incl. ourselves."""
+        if self._handle is None:
+            return [self.node_name]
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.st_members(self._handle, buf, len(buf))
+        return [m for m in buf.raw[:n].decode().split("\n") if m]
+
+    # -- delegate loops ----------------------------------------------------
+
+    def _push_local_state(self) -> None:
+        """Refresh the engine's LocalState snapshot
+        (services_delegate.go:146-151)."""
+        if self.state is not None and self._handle is not None:
+            data = self.state.encode()
+            self._lib.st_set_local_state(self._handle, data, len(data))
+
+    def _outbound_loop(self) -> None:
+        """state.broadcasts → native queue (GetBroadcasts feed)."""
+        import queue as queue_mod
+
+        last_state_push = 0.0
+        import time as time_mod
+
+        while not self._quit.is_set():
+            try:
+                prepared = self.state.broadcasts.get(timeout=0.2)
+            except queue_mod.Empty:
+                prepared = None
+            if self._quit.is_set():
+                return
+            if prepared:
+                for payload in prepared:
+                    self._lib.st_broadcast(self._handle, payload,
+                                           len(payload))
+            now = time_mod.monotonic()
+            if now - last_state_push > 1.0:
+                self._push_local_state()
+                last_state_push = now
+
+    def _inbound_loop(self) -> None:
+        """Native queues → catalog (NotifyMsg / MergeRemoteState /
+        NotifyLeave)."""
+        buf = ctypes.create_string_buffer(1 << 22)
+        while not self._quit.is_set():
+            busy = False
+
+            n = self._lib.st_poll_msg(self._handle, buf, len(buf))
+            if n > 0:
+                busy = True
+                try:
+                    svc = svc_mod.decode(buf.raw[:n])
+                    self.state.update_service(svc)
+                except ValueError as exc:
+                    log.warning("Error decoding gossip message: %s", exc)
+
+            n = self._lib.st_poll_state(self._handle, buf, len(buf))
+            if n > 0:
+                busy = True
+                try:
+                    remote = decode(buf.raw[:n])
+                    self.state.merge(remote)
+                except (ValueError, KeyError) as exc:
+                    log.warning("Error merging remote state: %s", exc)
+
+            n = self._lib.st_poll_event(self._handle, buf, len(buf))
+            if n > 0:
+                busy = True
+                parts = buf.raw[:n].decode().split()
+                if parts and parts[0] == "leave" and len(parts) > 1:
+                    log.info("Member left: %s", parts[1])
+                    threading.Thread(
+                        target=self.state.expire_server, args=(parts[1],),
+                        daemon=True).start()
+                elif parts and parts[0] == "join" and len(parts) > 1:
+                    log.info("Member joined: %s", parts[1])
+
+            if not busy:
+                self._quit.wait(0.05)

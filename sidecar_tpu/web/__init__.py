@@ -1,0 +1,6 @@
+"""HTTP API + UI server (reference: sidecarhttp/ package)."""
+
+from sidecar_tpu.web.api import ApiServer, HttpListener, SidecarApi
+from sidecar_tpu.web.server import serve_http
+
+__all__ = ["SidecarApi", "ApiServer", "HttpListener", "serve_http"]

@@ -1,0 +1,220 @@
+"""The Sidecar HTTP API (reference: sidecarhttp/http_api.go:18-371,
+http_listener.go:12-38).
+
+Route logic is a transport-independent object returning
+``(status, content_type, body)`` tuples so tests drive it directly
+(the reference tests its handlers with httptest ResponseRecorders);
+``sidecar_tpu.web.server`` mounts it on a threading HTTP server.
+
+Routes (http.go:64-76, http_api.go:35-45):
+  GET  /api/services.json           grouped-by-service + cluster members
+  GET  /api/state.json              raw state dump
+  GET  /api/services/{name}.json    one service's instances
+  POST /api/services/{id}/drain     set local instance DRAINING
+  GET  /api/watch (+ /watch)        long-poll state stream
+  GET  /servers                     human-readable state
+  OPTIONS                            CORS headers
+Deprecated aliases /services.json and /state.json are also served.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import time
+from typing import Callable, Optional
+
+from sidecar_tpu import service as svc_mod
+from sidecar_tpu.catalog.state import Listener, ServicesState
+from sidecar_tpu.service import DRAINING, ns_to_rfc3339
+
+log = logging.getLogger(__name__)
+
+
+class HttpListener(Listener):
+    """Listener for /watch (http_listener.go:12-38): larger buffer for
+    the slow-HTTP-link problem."""
+
+    def __init__(self) -> None:
+        self._name = f"httpListener-{time.time_ns()}"
+        self._chan: "queue.Queue" = queue.Queue(maxsize=50)
+
+    def chan(self):
+        return self._chan
+
+    def name(self) -> str:
+        return self._name
+
+    def managed(self) -> bool:
+        return False
+
+
+class ApiServer:
+    """Cluster-member info in /services.json (http_api.go:18-22)."""
+
+    def __init__(self, name: str, last_updated: int,
+                 service_count: int) -> None:
+        self.name = name
+        self.last_updated = last_updated
+        self.service_count = service_count
+
+    def to_json(self) -> dict:
+        return {"Name": self.name,
+                "LastUpdated": ns_to_rfc3339(self.last_updated),
+                "ServiceCount": self.service_count}
+
+
+CORS_HEADERS = {
+    "Access-Control-Allow-Origin": "*",
+    "Access-Control-Allow-Methods": "GET",
+}
+
+
+class SidecarApi:
+    """http_api.go:30-32 — state + cluster membership view."""
+
+    def __init__(self, state: ServicesState,
+                 members_fn: Optional[Callable[[], list[str]]] = None,
+                 cluster_name: str = "") -> None:
+        self.state = state
+        self.members_fn = members_fn
+        self.cluster_name = cluster_name
+
+    # -- route dispatch ----------------------------------------------------
+
+    def dispatch(self, method: str, path: str,
+                 query: Optional[dict] = None):
+        """Returns (status, content_type, body_bytes) or a stream marker
+        ("watch", by_service) for the long-poll route."""
+        query = query or {}
+        parts = [p for p in path.split("/") if p]
+        # Strip the /api prefix; deprecated unprefixed aliases hit the
+        # same handlers (http.go:72-75).
+        if parts and parts[0] == "api":
+            parts = parts[1:]
+
+        if method == "OPTIONS":
+            return 200, "application/json", b"", CORS_HEADERS
+
+        if parts == ["watch"] and method == "GET":
+            by_service = query.get("by_service", ["true"])[0] != "false"
+            return ("watch", by_service)
+
+        if method == "POST":
+            if len(parts) == 3 and parts[0] == "services" \
+                    and parts[2] == "drain":
+                return self.drain_service(parts[1])
+            return self._error(404, "Not Found")
+
+        if parts == ["servers"]:
+            return self.servers_page()
+
+        if len(parts) == 1 and parts[0].startswith("services."):
+            return self.services(parts[0].rsplit(".", 1)[1])
+        if len(parts) == 1 and parts[0].startswith("state."):
+            return self.state_dump(parts[0].rsplit(".", 1)[1])
+        if len(parts) == 2 and parts[0] == "services":
+            name, _, ext = parts[1].rpartition(".")
+            return self.one_service(name, ext)
+        return self._error(404, "Not Found")
+
+    # -- handlers ----------------------------------------------------------
+
+    def _members(self) -> list[str]:
+        return sorted(self.members_fn()) if self.members_fn else []
+
+    def services(self, extension: str):
+        """Grouped-by-service + cluster members
+        (http_api.go:202-268)."""
+        if extension != "json":
+            return self._error(
+                404, "Not Found - Invalid content type extension")
+        members = {}
+        for name in self._members():
+            server = self.state.servers.get(name)
+            members[name] = ApiServer(
+                name=name,
+                last_updated=server.last_updated if server else 0,
+                service_count=len(server.services) if server else 0,
+            ).to_json()
+        result = {
+            "Services": {name: [svc.to_json() for svc in instances]
+                         for name, instances
+                         in self.state.by_service().items()},
+            "ClusterName": self.cluster_name,
+        }
+        if members:
+            result["ClusterMembers"] = members
+        body = json.dumps(result, indent=2).encode()
+        return 200, "application/json", body, CORS_HEADERS
+
+    def state_dump(self, extension: str):
+        """Raw state dump (http_api.go:272-291) — the bootstrap source
+        for receivers (receiver.FetchInitialState)."""
+        if extension != "json":
+            return self._error(
+                404, "Not Found - Invalid content type extension")
+        return 200, "application/json", self.state.encode(), CORS_HEADERS
+
+    def one_service(self, name: str, extension: str):
+        """One service's instances (http_api.go:135-199)."""
+        if extension != "json":
+            return self._error(
+                404, "Not Found - Invalid content type extension")
+        if not name:
+            return self._error(404, "Not Found - No service name provided")
+        instances = []
+        with self.state._lock:
+            for _, _, svc in self.state.each_service():
+                if svc.name == name:
+                    instances.append(svc.to_json())
+        if not instances:
+            return self._error(404, f"no instances of {name} found")
+        body = json.dumps({
+            "Services": {name: instances},
+            "ClusterName": self.cluster_name,
+        }, indent=2).encode()
+        return 200, "application/json", body, CORS_HEADERS
+
+    def drain_service(self, service_id: str):
+        """Set a local instance DRAINING (http_api.go:297-343); re-enters
+        the merge path, where DRAINING is sticky
+        (services_state.go:329-331)."""
+        if not service_id:
+            return self._error(404, "Not Found - No service ID provided")
+        try:
+            svc = self.state.get_local_service_by_id(service_id)
+        except KeyError:
+            return self._error(
+                404, f'Not Found - Service ID "{service_id}" not found')
+        svc.updated = svc_mod.now_ns()
+        svc.status = DRAINING
+        self.state.update_service(svc)
+        body = json.dumps({
+            "Message": f'Service "{svc.name}" instance "{svc.id}" set to '
+                       "DRAINING"
+        }, indent=2).encode()
+        return 202, "application/json", body, {}
+
+    def servers_page(self):
+        """Auto-refreshing human-readable dump (http.go:28-45)."""
+        body = ("\n \t\t\t<head>\n \t\t\t<meta http-equiv=\"refresh\" "
+                "content=\"4\">\n \t\t\t</head>\n\t    \t<pre>"
+                + self.state.format(self._members())
+                + "</pre>").encode()
+        return 200, "text/html", body, {}
+
+    # -- watch plumbing ----------------------------------------------------
+
+    def watch_snapshot(self, by_service: bool) -> bytes:
+        if by_service:
+            with self.state._lock:
+                doc = {name: [svc.to_json() for svc in instances]
+                       for name, instances in self.state.by_service().items()}
+            return json.dumps(doc).encode()
+        return self.state.encode()
+
+    def _error(self, status: int, message: str):
+        body = json.dumps({"status": "error", "message": message}).encode()
+        return status, "application/json", body, {}

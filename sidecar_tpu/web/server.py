@@ -1,0 +1,147 @@
+"""Threading HTTP server mounting the Sidecar API, UI static files, and
+the /watch long-poll (reference: sidecarhttp/http.go:56-84)."""
+
+from __future__ import annotations
+
+import logging
+import mimetypes
+import pathlib
+import queue
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from sidecar_tpu.web.api import HttpListener, SidecarApi
+
+log = logging.getLogger(__name__)
+
+
+def make_handler(api: SidecarApi, ui_dir: Optional[str],
+                 static_dir: Optional[str]):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # route through logging
+            log.debug("http: " + fmt, *args)
+
+        # -- plumbing ------------------------------------------------------
+
+        def _send(self, status: int, content_type: str, body: bytes,
+                  extra: Optional[dict] = None) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _serve_file(self, root: str, rel: str) -> None:
+            base = pathlib.Path(root).resolve()
+            target = (base / rel.lstrip("/")).resolve()
+            if not target.is_relative_to(base):
+                self._send(403, "text/plain", b"Forbidden")
+                return
+            if target.is_dir():
+                target = target / "index.html"
+            if not target.is_file():
+                self._send(404, "text/plain", b"Not Found")
+                return
+            ctype = mimetypes.guess_type(str(target))[0] or \
+                "application/octet-stream"
+            self._send(200, ctype, target.read_bytes())
+
+        def _watch(self, by_service: bool) -> None:
+            """Long-poll stream: a fresh snapshot on every ChangeEvent
+            (http_api.go:56-131)."""
+            listener = HttpListener()
+            api.state.add_listener(listener)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def push() -> None:
+                    payload = api.watch_snapshot(by_service)
+                    self.wfile.write(b"%x\r\n%s\r\n"
+                                     % (len(payload), payload))
+                    self.wfile.flush()
+
+                push()
+                while True:
+                    try:
+                        listener.chan().get(timeout=30.0)
+                    except queue.Empty:
+                        continue  # keep the connection; no change yet
+                    # Coalesce bursts before pushing.
+                    while True:
+                        try:
+                            listener.chan().get_nowait()
+                        except queue.Empty:
+                            break
+                    push()
+            except OSError:
+                pass  # client went away
+            finally:
+                try:
+                    api.state.remove_listener(listener.name())
+                except KeyError:
+                    pass
+
+        # -- methods -------------------------------------------------------
+
+        def do_GET(self) -> None:  # noqa: N802 — http.server API
+            parsed = urllib.parse.urlparse(self.path)
+            path = parsed.path
+            query = urllib.parse.parse_qs(parsed.query)
+
+            if path == "/":
+                self.send_response(301)
+                self.send_header("Location", "/ui/")
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            if path.startswith("/ui") and ui_dir:
+                self._serve_file(ui_dir, path[len("/ui"):])
+                return
+            if path.startswith("/static") and static_dir:
+                self._serve_file(static_dir, path[len("/static"):])
+                return
+
+            result = api.dispatch("GET", path, query)
+            if isinstance(result, tuple) and result and result[0] == "watch":
+                self._watch(result[1])
+                return
+            status, ctype, body, extra = result
+            self._send(status, ctype, body, extra)
+
+        def do_POST(self) -> None:  # noqa: N802
+            parsed = urllib.parse.urlparse(self.path)
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                self.rfile.read(length)
+            status, ctype, body, extra = api.dispatch("POST", parsed.path)
+            self._send(status, ctype, body, extra)
+
+        def do_OPTIONS(self) -> None:  # noqa: N802
+            status, ctype, body, extra = api.dispatch("OPTIONS", self.path)
+            self._send(status, ctype, body, extra)
+
+    return Handler
+
+
+def serve_http(api: SidecarApi, bind: str = "0.0.0.0", port: int = 7777,
+               ui_dir: Optional[str] = None,
+               static_dir: Optional[str] = None,
+               background: bool = True) -> ThreadingHTTPServer:
+    """Start the API server (http.go:56-84; default port 7777)."""
+    server = ThreadingHTTPServer(
+        (bind, port), make_handler(api, ui_dir, static_dir))
+    if background:
+        threading.Thread(target=server.serve_forever, name="http-server",
+                         daemon=True).start()
+    else:
+        server.serve_forever()
+    return server

@@ -206,10 +206,13 @@ class TestExpireServer:
         for _ in range(TOMBSTONE_COUNT):
             batches.append(state.broadcasts.get(timeout=5))
         assert all(len(b) == 2 for b in batches)
-        # +50 ns skew per round so peers retransmit.
+        # +50 ns LINEAR skew per round from the original stamp so peers
+        # retransmit (compounding the mutated copy would give 0,50,150...).
         first = S.decode(batches[0][0]).updated
         second = S.decode(batches[1][0]).updated
+        third = S.decode(batches[2][0]).updated
         assert second - first == 50
+        assert third - first == 100
 
     def test_no_live_services_noop(self):
         state = make_state()

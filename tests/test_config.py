@@ -1,0 +1,98 @@
+"""Config system tests: env-layered parsing with reference-compatible
+prefixes, Go duration syntax, CLI overrides."""
+
+import pytest
+
+from sidecar_tpu.addresses import get_published_ip, is_private_ip
+from sidecar_tpu.config import parse_config, parse_duration
+from sidecar_tpu.main import apply_cli_overrides, parse_command_line
+
+
+class TestParseDuration:
+    @pytest.mark.parametrize("text,want", [
+        ("200ms", 0.2),
+        ("20s", 20.0),
+        ("1m", 60.0),
+        ("3h", 10800.0),
+        ("1m20s", 80.0),
+        ("1.5s", 1.5),
+        ("5", 5.0),
+    ])
+    def test_values(self, text, want):
+        assert parse_duration(text) == pytest.approx(want)
+
+    def test_rejects_garbage(self):
+        with pytest.raises(ValueError):
+            parse_duration("5 parsecs")
+
+
+class TestEnvParsing:
+    def test_defaults(self, monkeypatch):
+        for var in list(__import__("os").environ):
+            if var.startswith(("SIDECAR_", "DOCKER_", "STATIC_", "K8S_",
+                               "HAPROXY_", "ENVOY_", "SERVICES_",
+                               "LISTENERS_")):
+                monkeypatch.delenv(var, raising=False)
+        config = parse_config()
+        assert config.sidecar.gossip_interval == pytest.approx(0.2)
+        assert config.sidecar.push_pull_interval == pytest.approx(20.0)
+        assert config.sidecar.gossip_messages == 15
+        assert config.sidecar.bind_port == 7946
+        assert config.sidecar.cluster_name == "default"
+        assert config.sidecar.discovery == ["docker"]
+        assert config.docker_discovery.docker_url == \
+            "unix:///var/run/docker.sock"
+        assert config.haproxy.bind_ip == "192.168.168.168"
+        assert config.envoy.grpc_port == "7776"
+        assert config.k8s_api_discovery.kube_timeout == pytest.approx(3.0)
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("SIDECAR_CLUSTER_NAME", "prod")
+        monkeypatch.setenv("SIDECAR_SEEDS", "10.0.0.1,10.0.0.2")
+        monkeypatch.setenv("SIDECAR_GOSSIP_INTERVAL", "500ms")
+        monkeypatch.setenv("SIDECAR_DISCOVERY", "static,docker")
+        monkeypatch.setenv("HAPROXY_DISABLE", "true")
+        monkeypatch.setenv("LISTENERS_URLS",
+                           "http://a/update,http://b/update")
+        config = parse_config()
+        assert config.sidecar.cluster_name == "prod"
+        assert config.sidecar.seeds == ["10.0.0.1", "10.0.0.2"]
+        assert config.sidecar.gossip_interval == pytest.approx(0.5)
+        assert config.sidecar.discovery == ["static", "docker"]
+        assert config.haproxy.disable is True
+        assert config.listeners.urls == ["http://a/update",
+                                         "http://b/update"]
+
+    def test_cli_overrides_env(self, monkeypatch):
+        monkeypatch.setenv("SIDECAR_CLUSTER_NAME", "from-env")
+        config = parse_config()
+        opts = parse_command_line([
+            "-n", "from-cli", "-c", "10.1.1.1:7946", "-d", "static",
+            "-a", "192.168.1.50", "-l", "debug"])
+        apply_cli_overrides(config, opts)
+        assert config.sidecar.cluster_name == "from-cli"
+        assert config.sidecar.seeds == ["10.1.1.1:7946"]
+        assert config.sidecar.discovery == ["static"]
+        assert config.sidecar.advertise_ip == "192.168.1.50"
+        assert config.sidecar.logging_level == "debug"
+
+
+class TestAddresses:
+    def test_private_blocks(self):
+        assert is_private_ip("10.1.2.3")
+        assert is_private_ip("172.16.9.9")
+        assert is_private_ip("192.168.0.1")
+        assert not is_private_ip("8.8.8.8")
+        assert not is_private_ip("172.32.0.1")
+        assert not is_private_ip("not-an-ip")
+
+    def test_advertise_wins(self):
+        assert get_published_ip([], "1.2.3.4") == "1.2.3.4"
+
+    def test_excluded_skipped(self):
+        # With everything excluded and no advertise, lookup must fail.
+        from sidecar_tpu.addresses import find_private_addresses
+        everything = find_private_addresses()
+        if everything:
+            with pytest.raises(RuntimeError):
+                get_published_ip(everything, "")

@@ -1,0 +1,192 @@
+"""Health monitor tests — mock Checkers incl. timeout behavior, the
+HEALTHY/SICKLY/FAILED state machine, and the discovery bridge
+(reference: healthy/healthy_test.go, service_bridge_test.go)."""
+
+import time
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.discovery.base import Discoverer
+from sidecar_tpu.health import (
+    AlwaysSuccessfulCmd,
+    Check,
+    Checker,
+    FAILED,
+    HEALTHY,
+    Monitor,
+    SICKLY,
+    UNKNOWN,
+)
+from sidecar_tpu.runtime.looper import FreeLooper
+
+
+class MockCommand(Checker):
+    def __init__(self, status=HEALTHY, err=None):
+        self.status = status
+        self.err = err
+        self.runs = 0
+        self.last_args = None
+
+    def run(self, args):
+        self.runs += 1
+        self.last_args = args
+        return self.status, self.err
+
+
+class SlowCommand(Checker):
+    def run(self, args):
+        time.sleep(5)
+        return HEALTHY, None
+
+
+def make_svc(sid="s1", ports=None):
+    return S.Service(id=sid, name="web", hostname="container-host",
+                     updated=S.now_ns(), status=S.ALIVE,
+                     ports=ports if ports is not None else
+                     [S.Port("tcp", 32768, 8080, "10.0.0.1")])
+
+
+class FakeDisco(Discoverer):
+    def __init__(self, services=None, check=("", "")):
+        self._services = services if services is not None else [make_svc()]
+        self._check = check
+
+    def services(self):
+        return [s.copy() for s in self._services]
+
+    def health_check(self, svc):
+        return self._check
+
+    def listeners(self):
+        return []
+
+    def run(self, looper):
+        pass
+
+
+class TestCheckStateMachine:
+    def test_healthy_resets_count(self):
+        check = Check("c1", max_count=3)
+        check.update_status(SICKLY, None)
+        assert check.count == 1
+        check.update_status(HEALTHY, None)
+        assert check.count == 0
+        assert check.status == HEALTHY
+
+    def test_max_count_escalates_to_failed(self):
+        check = Check("c1", max_count=2)
+        check.update_status(SICKLY, None)
+        assert check.status == SICKLY
+        check.update_status(SICKLY, None)
+        assert check.status == FAILED
+
+    def test_error_means_unknown(self):
+        check = Check("c1", max_count=5)
+        err = RuntimeError("boom")
+        check.update_status(HEALTHY, err)
+        assert check.status == UNKNOWN
+        assert check.last_error is err
+
+    def test_service_status_mapping(self):
+        check = Check("c1")
+        for st, want in [(HEALTHY, S.ALIVE), (SICKLY, S.ALIVE),
+                         (UNKNOWN, S.UNKNOWN), (FAILED, S.UNHEALTHY)]:
+            check.status = st
+            assert check.service_status() == want
+
+
+class TestMonitorRun:
+    def test_runs_checks_and_updates(self):
+        mon = Monitor("10.0.0.1")
+        cmd = MockCommand(HEALTHY)
+        mon.add_check(Check("c1", command=cmd, args="x"))
+        mon.run(FreeLooper(2))
+        assert cmd.runs == 2
+        assert mon.checks["c1"].status == HEALTHY
+
+    def test_timeout_marks_unknown(self):
+        mon = Monitor("10.0.0.1")
+        mon.check_interval = 0.1
+        mon.add_check(Check("slow", command=SlowCommand(), max_count=5))
+        start = time.monotonic()
+        mon.run(FreeLooper(1))
+        assert time.monotonic() - start < 2
+        assert mon.checks["slow"].status == UNKNOWN
+
+    def test_raising_command_is_unknown(self):
+        class Exploding(Checker):
+            def run(self, args):
+                raise RuntimeError("kaboom")
+
+        mon = Monitor("10.0.0.1")
+        mon.add_check(Check("c1", command=Exploding(), max_count=9))
+        mon.run(FreeLooper(1))
+        assert mon.checks["c1"].status == UNKNOWN
+
+
+class TestWatch:
+    def test_adds_checks_for_new_services(self):
+        mon = Monitor("10.0.0.1")
+        disco = FakeDisco(check=("AlwaysSuccessful", ""))
+        mon.watch(disco, FreeLooper(1))
+        assert "s1" in mon.checks
+        assert isinstance(mon.checks["s1"].command, AlwaysSuccessfulCmd)
+
+    def test_removes_checks_for_vanished_services(self):
+        mon = Monitor("10.0.0.1")
+        disco = FakeDisco(check=("AlwaysSuccessful", ""))
+        mon.watch(disco, FreeLooper(1))
+        disco._services = []
+        mon.watch(disco, FreeLooper(1))
+        assert mon.checks == {}
+
+    def test_default_check_first_tcp_port(self):
+        mon = Monitor("192.168.5.5", default_check_endpoint="/status")
+        check = mon.check_for_service(make_svc(), FakeDisco())
+        assert check.type == "HttpGet"
+        assert check.args == "http://192.168.5.5:32768/status"
+
+    def test_default_check_no_tcp_port(self):
+        mon = Monitor("192.168.5.5")
+        svc = make_svc(ports=[S.Port("udp", 9999, 53, "10.0.0.1")])
+        check = mon.check_for_service(svc, FakeDisco())
+        assert isinstance(check.command, AlwaysSuccessfulCmd)
+
+    def test_template_args(self):
+        mon = Monitor("10.9.9.9")
+        svc = make_svc()
+        args = mon.template_check_args(
+            "http://{{ host }}:{{ tcp 8080 }}/x?c={{ container }}", svc)
+        assert args == "http://10.9.9.9:32768/x?c=container-host"
+
+    def test_template_unmapped_port(self):
+        mon = Monitor("h")
+        assert mon.template_check_args("{{ tcp 9 }}", make_svc()) == "-1"
+
+
+class TestServicesBridge:
+    def test_services_marked_with_check_status(self):
+        mon = Monitor("10.0.0.1")
+        disco = FakeDisco()
+        mon.discovery_fn = disco.services
+        mon.add_check(Check("s1", command=MockCommand()))
+        mon.checks["s1"].status = FAILED
+        services = mon.services()
+        assert services[0].status == S.UNHEALTHY
+
+    def test_unknown_service_marked_unknown(self):
+        mon = Monitor("10.0.0.1")
+        disco = FakeDisco()
+        mon.discovery_fn = disco.services
+        assert mon.services()[0].status == S.UNKNOWN
+
+    def test_no_discovery_fn(self):
+        mon = Monitor("10.0.0.1")
+        assert mon.services() == []
+
+    def test_empty_id_skipped(self):
+        mon = Monitor("10.0.0.1")
+        disco = FakeDisco(services=[S.Service(id="")])
+        mon.discovery_fn = disco.services
+        assert mon.services() == []

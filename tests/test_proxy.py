@@ -1,0 +1,229 @@
+"""Proxy driver tests: HAProxy config rendering + verify/reload gating,
+and Envoy resource generation incl. the port-collision guard
+(reference: haproxy/haproxy_test.go, envoy/adapter/adapter_test.go)."""
+
+import io
+import json
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.proxy.envoy import (
+    EnvoyApiV1,
+    XdsServer,
+    TYPE_CLUSTER,
+    TYPE_ENDPOINT,
+    TYPE_LISTENER,
+    resources_from_state,
+    svc_name,
+    svc_name_split,
+)
+from sidecar_tpu.proxy.haproxy import (
+    HAProxy,
+    make_portmap,
+    sanitize_name,
+    services_with_ports,
+)
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+
+def make_state():
+    state = ServicesState(hostname="h1")
+    state.set_clock(lambda: T0)
+    state.add_service_entry(S.Service(
+        id="aaa111", name="web", image="site/web:1.2", hostname="h1",
+        updated=T0, status=S.ALIVE, proxy_mode="http",
+        ports=[S.Port("tcp", 32768, 8080, "10.0.0.1")]))
+    state.add_service_entry(S.Service(
+        id="bbb222", name="web", image="site/web:1.2", hostname="h2",
+        updated=T0, status=S.ALIVE, proxy_mode="http",
+        ports=[S.Port("tcp", 32769, 8080, "10.0.0.2")]))
+    state.add_service_entry(S.Service(
+        id="ccc333", name="raw-tcp", image="tcp/x:9", hostname="h2",
+        updated=T0, status=S.ALIVE, proxy_mode="tcp",
+        ports=[S.Port("tcp", 32770, 9000, "10.0.0.2")]))
+    state.add_service_entry(S.Service(
+        id="ddd444", name="dead", image="d:1", hostname="h2",
+        updated=T0, status=S.UNHEALTHY,
+        ports=[S.Port("tcp", 32771, 9100, "10.0.0.2")]))
+    return state
+
+
+class TestHAProxyRender:
+    def test_sanitize(self):
+        assert sanitize_name("site/web:1.2") == "site-web-1-2"
+
+    def test_services_with_ports_filters(self):
+        svcs = services_with_ports(make_state())
+        assert set(svcs) == {"web", "raw-tcp"}  # dead filtered out
+        assert len(svcs["web"]) == 2
+
+    def test_mismatched_ports_skipped(self):
+        state = make_state()
+        state.add_service_entry(S.Service(
+            id="eee555", name="web", image="site/web:1.2", hostname="h3",
+            updated=T0, status=S.ALIVE,
+            ports=[S.Port("tcp", 32780, 9999, "10.0.0.3")]))
+        svcs = services_with_ports(state)
+        assert len(svcs["web"]) == 2  # the 9999 imposter is skipped
+
+    def test_portmap(self):
+        ports = make_portmap(services_with_ports(make_state()))
+        assert ports["web"] == {"8080": "32769"} or \
+            ports["web"] == {"8080": "32768"}
+        assert ports["raw-tcp"] == {"9000": "32770"}
+
+    def test_config_structure(self):
+        proxy = HAProxy(bind_ip="192.168.1.1", user="hap", group="hap")
+        buf = io.StringIO()
+        proxy.write_config(make_state(), buf)
+        cfg = buf.getvalue()
+        assert "frontend web-8080" in cfg
+        assert "bind 192.168.1.1:8080" in cfg
+        assert "mode tcp" in cfg and "mode http" in cfg
+        assert "server h1-aaa111 10.0.0.1:32768 cookie h1-32768" in cfg
+        assert "server h2-bbb222 10.0.0.2:32769 cookie h2-32769" in cfg
+        assert "user hap" in cfg and "group hap" in cfg
+        assert "dead" not in cfg
+
+    def test_use_hostnames(self):
+        proxy = HAProxy(use_hostnames=True)
+        buf = io.StringIO()
+        proxy.write_config(make_state(), buf)
+        assert "server h1-aaa111 h1:32768" in buf.getvalue()
+
+    def test_write_and_reload_gated_on_verify(self, tmp_path):
+        cfg_file = tmp_path / "haproxy.cfg"
+        marker = tmp_path / "reloaded"
+        proxy = HAProxy(config_file=str(cfg_file),
+                        verify_cmd="exit 1",
+                        reload_cmd=f"touch {marker}")
+        with pytest.raises(RuntimeError, match="verify"):
+            proxy.write_and_reload(make_state())
+        assert cfg_file.exists()       # config was written...
+        assert not marker.exists()     # ...but reload never ran
+
+    def test_write_and_reload_success(self, tmp_path):
+        cfg_file = tmp_path / "haproxy.cfg"
+        marker = tmp_path / "reloaded"
+        proxy = HAProxy(config_file=str(cfg_file),
+                        verify_cmd="true",
+                        reload_cmd=f"touch {marker}")
+        proxy.write_and_reload(make_state())
+        assert marker.exists()
+
+
+class TestEnvoyNames:
+    def test_round_trip(self):
+        assert svc_name("web", 8080) == "web:8080"
+        assert svc_name_split("web:8080") == ("web", 8080)
+
+    def test_bad_names(self):
+        with pytest.raises(ValueError):
+            svc_name_split("nocolon")
+        with pytest.raises(ValueError):
+            svc_name_split("web:nanport")
+
+
+class TestEnvoyResources:
+    def test_resources_shape(self):
+        res = resources_from_state(make_state(), bind_ip="0.0.0.0")
+        names = {c["name"] for c in res.clusters}
+        assert names == {"web:8080", "raw-tcp:9000"}  # dead excluded
+        eps = {e["cluster_name"]: e for e in res.endpoints}
+        lbs = eps["web:8080"]["endpoints"][0]["lb_endpoints"]
+        addrs = {lb["endpoint"]["address"]["socket_address"]["address"]
+                 for lb in lbs}
+        assert addrs == {"10.0.0.1", "10.0.0.2"}
+        listeners = {l["name"]: l for l in res.listeners}
+        web_listener = listeners["web:8080"]
+        assert web_listener["address"]["socket_address"]["port_value"] == 8080
+        http_filter = web_listener["filter_chains"][0]["filters"][0]
+        assert http_filter["name"] == \
+            "envoy.filters.network.http_connection_manager"
+        tcp_filter = listeners["raw-tcp:9000"]["filter_chains"][0][
+            "filters"][0]
+        assert tcp_filter["name"] == "envoy.filters.network.tcp_proxy"
+
+    def test_websocket_upgrade(self):
+        state = make_state()
+        state.add_service_entry(S.Service(
+            id="fff666", name="wss", image="w:1", hostname="h1",
+            updated=T0, status=S.ALIVE, proxy_mode="ws",
+            ports=[S.Port("tcp", 32790, 9300, "10.0.0.1")]))
+        res = resources_from_state(state)
+        ws = next(l for l in res.listeners if l["name"] == "wss:9300")
+        manager = ws["filter_chains"][0]["filters"][0]["typed_config"]
+        assert manager["upgrade_configs"] == [{"upgrade_type": "websocket"}]
+
+    def test_port_collision_oldest_wins(self):
+        state = make_state()
+        # "aaa-imposter" sorts before "web"'s instances by hostname/id —
+        # collision resolution is by the sorted walk (oldest/stable), so
+        # build a fresh state where two services claim port 7000.
+        state2 = ServicesState(hostname="h1")
+        state2.set_clock(lambda: T0)
+        state2.add_service_entry(S.Service(
+            id="a1", name="first", image="f:1", hostname="h1", updated=T0,
+            status=S.ALIVE,
+            ports=[S.Port("tcp", 31000, 7000, "10.0.0.1")]))
+        state2.add_service_entry(S.Service(
+            id="z9", name="squatter", image="s:1", hostname="h2",
+            updated=T0, status=S.ALIVE,
+            ports=[S.Port("tcp", 31001, 7000, "10.0.0.2")]))
+        res = resources_from_state(state2)
+        names = {c["name"] for c in res.clusters}
+        assert names == {"first:7000"}
+
+    def test_xds_server_versions(self):
+        state = make_state()
+        xds = XdsServer(state)
+        resp1 = xds.discovery_response(TYPE_CLUSTER)
+        assert {r["name"] for r in resp1["resources"]} == \
+            {"web:8080", "raw-tcp:9000"}
+        resp2 = xds.discovery_response(TYPE_LISTENER)
+        assert resp2["version_info"] == resp1["version_info"]  # no change
+        # State change bumps the version on next fetch.
+        state.add_service_entry(S.Service(
+            id="ggg777", name="new", image="n:1", hostname="h3",
+            updated=T0 + NS, status=S.ALIVE,
+            ports=[S.Port("tcp", 31002, 9400, "10.0.0.3")]))
+        resp3 = xds.discovery_response(TYPE_ENDPOINT)
+        assert resp3["version_info"] != resp1["version_info"]
+        assert any(e["cluster_name"] == "new:9400"
+                   for e in resp3["resources"])
+
+
+class TestEnvoyV1Api:
+    def test_registration(self):
+        api = EnvoyApiV1(make_state(), cluster_name="c1")
+        status, doc = api.registration("web:8080")
+        assert status == 200
+        assert doc["env"] == "c1"
+        assert len(doc["hosts"]) == 2
+        assert doc["hosts"][0]["service"] == "web:8080"
+        assert {h["port"] for h in doc["hosts"]} == {32768, 32769}
+
+    def test_registration_bad_name(self):
+        status, doc = EnvoyApiV1(make_state()).registration("nope")
+        assert status == 404
+
+    def test_clusters(self):
+        status, doc = EnvoyApiV1(make_state()).clusters()
+        assert status == 200
+        assert {c["name"] for c in doc["clusters"]} == \
+            {"web:8080", "raw-tcp:9000"}
+        assert all(c["type"] == "sds" for c in doc["clusters"])
+
+    def test_listeners(self):
+        status, doc = EnvoyApiV1(make_state(),
+                                 bind_ip="192.168.1.1").listeners()
+        assert status == 200
+        by_name = {l["name"]: l for l in doc["listeners"]}
+        assert by_name["web:8080"]["address"] == "tcp://192.168.1.1:8080"
+        assert by_name["web:8080"]["filters"][0]["name"] == \
+            "http_connection_manager"
+        assert by_name["raw-tcp:9000"]["filters"][0]["name"] == "tcp_proxy"

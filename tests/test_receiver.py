@@ -1,0 +1,240 @@
+"""UrlListener + receiver tests — outbound POSTs captured by a local
+HTTP server (the reference's httpmock technique, url_listener_test.go)
+and the ShouldNotify transition table (receiver_test.go)."""
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.catalog.state import ChangeEvent
+from sidecar_tpu.catalog.url_listener import (
+    UrlListener,
+    state_changed_event_json,
+    with_retries,
+)
+from sidecar_tpu.receiver import (
+    Receiver,
+    should_notify,
+    update_handler,
+)
+from sidecar_tpu.runtime.looper import FreeLooper
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+
+def make_state():
+    state = ServicesState(hostname="h1")
+    state.set_clock(lambda: T0)
+    state.add_service_entry(S.Service(
+        id="aaa", name="web", image="i:1", hostname="h1", updated=T0,
+        status=S.ALIVE))
+    return state
+
+
+def make_event(status=S.ALIVE, previous=S.UNKNOWN, updated=T0, name="web"):
+    return ChangeEvent(
+        service=S.Service(id="aaa", name=name, hostname="h1",
+                          updated=updated, status=status),
+        previous_status=previous, time=updated)
+
+
+class CapturingServer:
+    """Captures POST bodies; optionally fails the first N requests."""
+
+    def __init__(self, fail_first=0, status=200):
+        self.posts = queue.Queue()
+        self.fail_remaining = fail_first
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                if outer.fail_remaining > 0:
+                    outer.fail_remaining -= 1
+                    self.send_response(500)
+                else:
+                    outer.posts.put((self.path, dict(self.headers), body))
+                    self.send_response(status)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.server.server_address[1]}/update"
+
+    def shutdown(self):
+        self.server.shutdown()
+
+
+class TestWithRetries:
+    def test_succeeds_eventually(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("nope")
+
+        assert with_retries(5, flaky) is None
+        assert len(calls) == 3
+
+    def test_gives_up(self):
+        def always():
+            raise OSError("nope")
+
+        err = with_retries(2, always)
+        assert isinstance(err, OSError)
+
+
+class TestUrlListener:
+    def test_posts_state_changed_event(self):
+        server = CapturingServer()
+        try:
+            state = make_state()
+            listener = UrlListener(server.url)
+            listener.watch(state)
+            state.notify_listeners(
+                state.servers["h1"].services["aaa"], S.UNKNOWN, T0)
+            path, headers, body = server.posts.get(timeout=5)
+            doc = json.loads(body)
+            assert "State" in doc and "ChangeEvent" in doc
+            assert doc["ChangeEvent"]["Service"]["ID"] == "aaa"
+            assert doc["State"]["Hostname"] == "h1"
+            assert "sidecar-session-host=" in headers.get("Cookie", "")
+            listener.stop()
+        finally:
+            server.shutdown()
+
+    def test_retries_500s(self):
+        server = CapturingServer(fail_first=2)
+        try:
+            state = make_state()
+            listener = UrlListener(server.url)
+            listener.watch(state)
+            state.notify_listeners(
+                state.servers["h1"].services["aaa"], S.UNKNOWN, T0)
+            path, _, body = server.posts.get(timeout=10)
+            assert json.loads(body)["ChangeEvent"]["PreviousStatus"] == \
+                S.UNKNOWN
+            listener.stop()
+        finally:
+            server.shutdown()
+
+    def test_wire_shape(self):
+        state = make_state()
+        data = state_changed_event_json(state, make_event())
+        doc = json.loads(data)
+        assert set(doc) == {"State", "ChangeEvent"}
+        assert set(doc["ChangeEvent"]) == {"Service", "PreviousStatus",
+                                           "Time"}
+
+
+class TestShouldNotify:
+    @pytest.mark.parametrize("old,new,want", [
+        (S.UNKNOWN, S.ALIVE, True),
+        (S.ALIVE, S.TOMBSTONE, True),
+        (S.ALIVE, S.DRAINING, True),
+        (S.ALIVE, S.UNHEALTHY, True),
+        (S.ALIVE, S.UNKNOWN, True),
+        (S.UNHEALTHY, S.UNKNOWN, False),
+        (S.TOMBSTONE, S.UNHEALTHY, False),
+        (S.UNKNOWN, 99, False),
+    ])
+    def test_transition_table(self, old, new, want):
+        assert should_notify(old, new) == want
+
+
+class TestReceiver:
+    def payload(self, state, event):
+        return state_changed_event_json(state, event)
+
+    def test_accepts_newer_state(self):
+        rcvr = Receiver(on_update=lambda s: None)
+        state = make_state()
+        status, _ = update_handler(
+            rcvr, self.payload(state, make_event()))
+        assert status == 200
+        assert rcvr.current_state is not None
+        assert rcvr.current_state.servers["h1"].services["aaa"].name == "web"
+        assert rcvr.reload_chan.qsize() == 1
+
+    def test_rejects_older_state(self):
+        rcvr = Receiver(on_update=lambda s: None)
+        newer = make_state()
+        newer.last_changed = T0 + NS
+        update_handler(rcvr, self.payload(newer, make_event()))
+        older = make_state()
+        older.last_changed = T0
+        update_handler(rcvr, self.payload(older, make_event()))
+        assert rcvr.current_state.last_changed == T0 + NS
+        assert rcvr.reload_chan.qsize() == 1  # only the first enqueued
+
+    def test_subscription_filter(self):
+        rcvr = Receiver(on_update=lambda s: None)
+        rcvr.subscribe("other")
+        state = make_state()
+        state.last_changed = T0 + NS
+        update_handler(rcvr, self.payload(state, make_event(name="web")))
+        assert rcvr.reload_chan.qsize() == 0
+        state.last_changed = T0 + 2 * NS
+        update_handler(rcvr, self.payload(state, make_event(name="other")))
+        assert rcvr.reload_chan.qsize() == 1
+
+    def test_insignificant_transition_not_enqueued(self):
+        rcvr = Receiver(on_update=lambda s: None)
+        state = make_state()
+        state.last_changed = T0 + NS
+        update_handler(rcvr, self.payload(
+            state, make_event(status=S.UNKNOWN, previous=S.UNHEALTHY)))
+        assert rcvr.reload_chan.qsize() == 0
+        assert rcvr.current_state is not None  # state still kept
+
+    def test_bad_payload_500(self):
+        status, body = update_handler(Receiver(), b"{not json")
+        assert status == 500
+        assert json.loads(body)["errors"]
+
+    def test_process_updates_batches(self):
+        seen = []
+        rcvr = Receiver(on_update=lambda s: seen.append(s),
+                        looper=FreeLooper(1))
+        state = make_state()
+        update_handler(rcvr, self.payload(state, make_event()))
+        rcvr.enqueue_update()
+        rcvr.enqueue_update()  # burst of 3 → one callback
+        rcvr.process_updates()
+        assert len(seen) == 1
+        assert seen[0].servers["h1"].services["aaa"].name == "web"
+        assert rcvr.reload_chan.qsize() == 0
+
+    def test_fetch_initial_state(self):
+        from sidecar_tpu.web import SidecarApi, serve_http
+        state = make_state()
+        api = SidecarApi(state)
+        srv = serve_http(api, bind="127.0.0.1", port=0)
+        try:
+            port = srv.server_address[1]
+            seen = []
+            rcvr = Receiver(on_update=lambda s: seen.append(s))
+            rcvr.fetch_initial_state(
+                f"http://127.0.0.1:{port}/api/state.json")
+            assert rcvr.current_state.servers["h1"].services["aaa"].id == \
+                "aaa"
+            assert len(seen) == 1
+        finally:
+            srv.shutdown()

@@ -1,0 +1,135 @@
+"""Two-process-grade gossip integration: two in-process nodes with real
+UDP/TCP sockets on localhost converge to the same catalog — the
+multi-node coverage the reference never had (SURVEY.md §4)."""
+
+import time
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.runtime.looper import FreeLooper
+from sidecar_tpu.transport import GossipTransport
+
+
+def make_node(name, cluster="test"):
+    state = ServicesState(hostname=name)
+    transport = GossipTransport(
+        node_name=name, cluster_name=cluster,
+        bind_ip="127.0.0.1", bind_port=0, advertise_ip="127.0.0.1",
+        gossip_interval=0.05, push_pull_interval=1.0)
+    return state, transport
+
+
+def start_writer(state):
+    import threading
+    from sidecar_tpu.runtime.looper import TimedLooper
+
+    looper = TimedLooper(0.0)
+
+    def drive():
+        state.process_service_msgs(looper)
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    return looper
+
+
+def add_local(state, sid, name, now=None):
+    svc = S.Service(id=sid, name=name, image="i:1",
+                    hostname=state.hostname,
+                    updated=now or S.now_ns(), status=S.ALIVE,
+                    ports=[S.Port("tcp", 1000, 80, "127.0.0.1")])
+    state.add_service_entry(svc.copy())
+    return svc
+
+
+def wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.1)
+    return False
+
+
+class TestTwoNodeGossip:
+    def test_join_pushpull_and_gossip_converge(self):
+        state_a, ta = make_node("node-a")
+        state_b, tb = make_node("node-b")
+        la = start_writer(state_a)
+        lb = start_writer(state_b)
+        try:
+            # Pre-existing service on A before B joins: arrives via the
+            # join push-pull (anti-entropy).
+            add_local(state_a, "aaa111", "web")
+
+            port_a = ta.start(state_a)
+            tb.start(state_b)
+            tb.join("127.0.0.1", port_a)
+
+            assert wait_for(lambda: state_b.has_server("node-a") and
+                            "aaa111" in state_b.servers["node-a"].services)
+
+            # Both see each other in membership.
+            assert wait_for(lambda: "node-b" in ta.members() and
+                            "node-a" in tb.members())
+
+            # New service on B after join: arrives at A via UDP gossip
+            # (SendServices → broadcasts → packPacket → NotifyMsg).
+            svc = add_local(state_b, "bbb222", "db")
+            state_b.send_services([svc], FreeLooper(3))
+            assert wait_for(lambda: state_a.has_server("node-b") and
+                            "bbb222" in state_a.servers["node-b"].services)
+
+            got = state_a.servers["node-b"].services["bbb222"]
+            assert got.name == "db"
+            assert got.status == S.ALIVE
+        finally:
+            ta.stop()
+            tb.stop()
+            la.quit()
+            lb.quit()
+            state_a.stop_processing()
+            state_b.stop_processing()
+
+    def test_cluster_name_isolation(self):
+        state_a, ta = make_node("iso-a", cluster="one")
+        state_b, tb = make_node("iso-b", cluster="two")
+        try:
+            port_a = ta.start(state_a)
+            tb.start(state_b)
+            with pytest.raises(OSError):
+                tb.join("127.0.0.1", port_a)  # cross-cluster join refused
+        finally:
+            ta.stop()
+            tb.stop()
+
+    def test_three_node_relay(self):
+        """A record born on A reaches C which never talks to A directly —
+        epidemic relay through B (retransmit, services_state.go:377-392)."""
+        state_a, ta = make_node("relay-a")
+        state_b, tb = make_node("relay-b")
+        state_c, tc = make_node("relay-c")
+        loopers = [start_writer(s) for s in (state_a, state_b, state_c)]
+        transports = [ta, tb, tc]
+        try:
+            port_a = ta.start(state_a)
+            port_b = tb.start(state_b)
+            tc.start(state_c)
+            tb.join("127.0.0.1", port_a)
+            tc.join("127.0.0.1", port_b)
+
+            svc = add_local(state_a, "ccc333", "relay-test")
+            state_a.send_services([svc], FreeLooper(5))
+
+            assert wait_for(lambda: state_c.has_server("relay-a") and
+                            "ccc333" in state_c.servers["relay-a"].services,
+                            timeout=15)
+        finally:
+            for t in transports:
+                t.stop()
+            for l in loopers:
+                l.quit()
+            for s in (state_a, state_b, state_c):
+                s.stop_processing()

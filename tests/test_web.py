@@ -1,0 +1,177 @@
+"""HTTP API tests — the pure dispatcher driven directly (the reference's
+httptest-recorder technique) plus one real-server round-trip including
+the /watch long-poll."""
+
+import json
+import queue
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.runtime.looper import FreeLooper
+from sidecar_tpu.web import SidecarApi, serve_http
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+
+def make_state():
+    state = ServicesState(hostname="h1")
+    state.set_clock(lambda: T0)
+    state.add_service_entry(S.Service(
+        id="aaa111", name="web", image="img:1", hostname="h1",
+        updated=T0, status=S.ALIVE,
+        ports=[S.Port("tcp", 32768, 8080, "10.0.0.1")]))
+    state.add_service_entry(S.Service(
+        id="bbb222", name="web", image="img:1", hostname="h2",
+        updated=T0, status=S.ALIVE))
+    state.add_service_entry(S.Service(
+        id="ccc333", name="db", image="db:9", hostname="h2",
+        updated=T0, status=S.UNHEALTHY))
+    return state
+
+
+def make_api(state=None):
+    return SidecarApi(state if state is not None else make_state(),
+                      members_fn=lambda: ["h1", "h2"],
+                      cluster_name="test-cluster")
+
+
+class TestServicesEndpoint:
+    def test_groups_by_name_with_members(self):
+        status, ctype, body, _ = make_api().dispatch(
+            "GET", "/api/services.json")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert set(doc["Services"]) == {"web", "db"}
+        assert len(doc["Services"]["web"]) == 2
+        assert doc["ClusterName"] == "test-cluster"
+        assert doc["ClusterMembers"]["h1"]["ServiceCount"] == 1
+        assert doc["ClusterMembers"]["h2"]["ServiceCount"] == 2
+
+    def test_wrong_extension_404(self):
+        status, _, body, _ = make_api().dispatch("GET", "/api/services.xml")
+        assert status == 404
+        assert json.loads(body)["status"] == "error"
+
+    def test_deprecated_unprefixed_alias(self):
+        status, _, body, _ = make_api().dispatch("GET", "/services.json")
+        assert status == 200
+        assert "web" in json.loads(body)["Services"]
+
+
+class TestStateEndpoint:
+    def test_state_round_trips_through_decode(self):
+        from sidecar_tpu.catalog import decode
+        status, _, body, _ = make_api().dispatch("GET", "/api/state.json")
+        assert status == 200
+        back = decode(body)
+        assert set(back.servers) == {"h1", "h2"}
+
+
+class TestOneService:
+    def test_single_service(self):
+        status, _, body, _ = make_api().dispatch(
+            "GET", "/api/services/web.json")
+        doc = json.loads(body)
+        assert status == 200
+        assert len(doc["Services"]["web"]) == 2
+
+    def test_missing_service_404(self):
+        status, _, body, _ = make_api().dispatch(
+            "GET", "/api/services/nope.json")
+        assert status == 404
+        assert "no instances of nope" in json.loads(body)["message"]
+
+
+class TestDrain:
+    def test_drain_local_service(self):
+        state = make_state()
+        api = make_api(state)
+        status, _, body, _ = api.dispatch(
+            "POST", "/api/services/aaa111/drain")
+        assert status == 202
+        assert "DRAINING" in json.loads(body)["Message"]
+        # The drain flows through the single-writer queue.
+        state.process_service_msgs(FreeLooper(1))
+        assert state.servers["h1"].services["aaa111"].status == S.DRAINING
+
+    def test_drain_remote_service_404(self):
+        # bbb222 lives on h2; we are h1 — drains are local-only.
+        status, _, body, _ = make_api().dispatch(
+            "POST", "/api/services/bbb222/drain")
+        assert status == 404
+
+    def test_drain_needs_post(self):
+        status, _, _, _ = make_api().dispatch(
+            "GET", "/api/services/aaa111/drain")
+        assert status == 404
+
+
+class TestServersPage:
+    def test_html_dump(self):
+        status, ctype, body, _ = make_api().dispatch("GET", "/servers")
+        assert status == 200 and ctype == "text/html"
+        assert b"web" in body and b"h1" in body
+
+
+class TestRealServer:
+    @pytest.fixture
+    def server(self):
+        state = make_state()
+        api = make_api(state)
+        srv = serve_http(api, bind="127.0.0.1", port=0)
+        yield state, srv
+        srv.shutdown()
+
+    def get(self, srv, path):
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read()
+
+    def test_services_over_http(self, server):
+        state, srv = server
+        status, body = self.get(srv, "/api/services.json")
+        assert status == 200
+        assert "web" in json.loads(body)["Services"]
+
+    def test_watch_streams_updates(self, server):
+        state, srv = server
+        port = srv.server_address[1]
+        chunks = queue.Queue()
+
+        def reader():
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/watch", timeout=10)
+            # read1 returns de-chunked data as it arrives without
+            # blocking for the (never-ending) full body.
+            while True:
+                data = resp.read1(65536)
+                if not data:
+                    return
+                chunks.put(data)
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        first = chunks.get(timeout=5)
+        assert b"web" in first
+
+        # A state change pushes a fresh snapshot.
+        state.add_service_entry(S.Service(
+            id="ddd444", name="cache", image="c:1", hostname="h3",
+            updated=T0 + NS, status=S.ALIVE))
+        found = b""
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            try:
+                found += chunks.get(timeout=1)
+            except queue.Empty:
+                continue
+            if b"cache" in found:
+                break
+        assert b"cache" in found
