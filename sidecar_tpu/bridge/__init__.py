@@ -1,0 +1,6 @@
+"""The Delegate-shaped simulation bridge: a live node asks "simulate my
+cluster forward N rounds" (BASELINE.json north star)."""
+
+from sidecar_tpu.bridge.sim_bridge import SimBridge, serve_bridge
+
+__all__ = ["SimBridge", "serve_bridge"]

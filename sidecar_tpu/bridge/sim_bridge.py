@@ -1,0 +1,226 @@
+"""Live-catalog ⇄ simulator bridge.
+
+The BASELINE.json north star: expose the TPU gossip simulator behind the
+existing Delegate-shaped state interface so a live node (or operator
+tooling) can ask "simulate this cluster forward N rounds" — what-if
+convergence forecasting the Go reference could never do.
+
+Mapping:
+
+* each catalog server becomes a simulator node; each (server, service)
+  becomes a slot (slots padded to a uniform per-node width);
+* wall-clock nanosecond ``Updated`` stamps are quantized onto the
+  simulator's logical tick clock, preserving order;
+* simulated results map back as per-node convergence plus a projected
+  catalog view (which records every node would know after N rounds).
+
+The bridge is pull-based (one RPC = one simulation run) so it never
+blocks the live gossip path; state is snapshotted at request time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import jax
+import numpy as np
+
+from sidecar_tpu import service as svc_mod
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.models.exact import ExactSim, SimParams, SimState
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology as topo_mod
+from sidecar_tpu.ops.status import pack, unpack_status, unpack_ts
+
+log = logging.getLogger(__name__)
+
+# Catalog Status values already match the simulator's 3-bit codes
+# (service/service.go:17-23 ↔ ops/status.py), so statuses map through
+# unchanged.
+
+
+@dataclasses.dataclass
+class BridgeMapping:
+    """Index maps from a catalog snapshot."""
+
+    hostnames: list[str]                   # node index → hostname
+    slots: list[list[Optional[str]]]       # node index → slot → service id
+    t0_ns: int                             # wall-clock origin
+    tick_ns: int                           # ns per simulator tick
+
+
+@dataclasses.dataclass
+class SimulationReport:
+    rounds: int
+    seconds_simulated: float
+    convergence: list[float]               # per-round cluster-wide fraction
+    eps_round: Optional[int]               # first round ≥ 1-eps
+    node_agreement: dict[str, float]       # hostname → final agreement
+    projected: dict                        # hostname → {svc id → status str}
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SimBridge:
+    def __init__(self, state: ServicesState,
+                 timecfg: TimeConfig = TimeConfig()) -> None:
+        self.state = state
+        self.t = timecfg
+
+    # -- state mapping -----------------------------------------------------
+
+    def snapshot(self) -> tuple[SimState, SimParams, BridgeMapping,
+                                ExactSim]:
+        """Freeze the live catalog into simulator tensors.
+
+        Every node starts knowing the full snapshot (the live catalog IS
+        the local node's view, already converged from its perspective);
+        callers can blank rows to model cold joiners."""
+        with self.state._lock:
+            servers = {h: dict(server.services)
+                       for h, server in self.state.servers.items()}
+        if not servers:
+            raise ValueError("empty catalog: nothing to simulate")
+
+        hostnames = sorted(servers)
+        spn = max(len(svcs) for svcs in servers.values())
+        n = len(hostnames)
+
+        all_updates = [svc.updated
+                       for svcs in servers.values()
+                       for svc in svcs.values()]
+        t0 = min(all_updates)
+        tick_ns = int(self.t.round_ticks / self.t.ticks_per_second * 1e9
+                      / self.t.round_ticks)  # 1 tick in ns (1 ms default)
+
+        slots: list[list[Optional[str]]] = []
+        owned_vals = np.zeros((n, spn), dtype=np.int64)
+        for ni, hostname in enumerate(hostnames):
+            row: list[Optional[str]] = []
+            for si, (sid, svc) in enumerate(sorted(servers[hostname]
+                                                   .items())):
+                # Ticks start at 1 (0 is the unknown sentinel).
+                tick = max(1, (svc.updated - t0) // tick_ns + 1)
+                owned_vals[ni, si] = int(pack(int(tick), svc.status))
+                row.append(sid)
+            row.extend([None] * (spn - len(row)))
+            slots.append(row)
+
+        params = SimParams(n=n, services_per_node=spn)
+        sim = ExactSim(params, topo_mod.complete(n), self.t)
+        state = sim.init_state()
+        # Overwrite the cold-start rows: every node knows the snapshot.
+        known = np.tile(owned_vals.reshape(-1).astype(np.int32), (n, 1))
+        state = dataclasses.replace(
+            state, known=jax.numpy.asarray(known))
+        mapping = BridgeMapping(hostnames=hostnames, slots=slots,
+                                t0_ns=t0, tick_ns=tick_ns)
+        return state, params, mapping, sim
+
+    # -- the RPC -----------------------------------------------------------
+
+    def simulate(self, rounds: int, seed: int = 0,
+                 cold_nodes: Optional[list[str]] = None,
+                 eps: float = 0.01) -> SimulationReport:
+        """Run the catalog forward ``rounds`` gossip rounds.
+
+        ``cold_nodes``: hostnames whose knowledge is blanked to their own
+        records first — models fresh joiners (the join push-pull and
+        epidemic spread then have to re-teach them)."""
+        state, params, mapping, sim = self.snapshot()
+
+        if cold_nodes:
+            known = np.asarray(state.known).copy()
+            spn = params.services_per_node
+            for hostname in cold_nodes:
+                if hostname not in mapping.hostnames:
+                    raise KeyError(hostname)
+                ni = mapping.hostnames.index(hostname)
+                own = known[ni, ni * spn:(ni + 1) * spn].copy()
+                known[ni, :] = 0
+                known[ni, ni * spn:(ni + 1) * spn] = own
+            state = dataclasses.replace(state,
+                                        known=jax.numpy.asarray(known))
+
+        final, conv = sim.run(state, jax.random.PRNGKey(seed), rounds)
+        conv = np.asarray(jax.device_get(conv))
+        known = np.asarray(final.known)
+
+        truth = known.max(axis=0)
+        agree = (known == truth[None, :]).mean(axis=1)
+        node_agreement = {h: float(agree[i])
+                          for i, h in enumerate(mapping.hostnames)}
+
+        projected: dict = {}
+        spn = params.services_per_node
+        for ni, hostname in enumerate(mapping.hostnames):
+            view = {}
+            for oi, owner_host in enumerate(mapping.hostnames):
+                for si, sid in enumerate(mapping.slots[oi]):
+                    if sid is None:
+                        continue
+                    cell = int(known[ni, oi * spn + si])
+                    if unpack_ts(np.int32(cell)) > 0:
+                        view[sid] = svc_mod.status_string(
+                            int(unpack_status(np.int32(cell))))
+            projected[hostname] = view
+
+        hits = np.nonzero(conv >= 1.0 - eps)[0]
+        return SimulationReport(
+            rounds=rounds,
+            seconds_simulated=rounds * self.t.round_ticks
+            / self.t.ticks_per_second,
+            convergence=[float(c) for c in conv],
+            eps_round=int(hits[0]) + 1 if hits.size else None,
+            node_agreement=node_agreement,
+            projected=projected,
+        )
+
+
+def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
+                 port: int = 7778,
+                 background: bool = True) -> ThreadingHTTPServer:
+    """POST /simulate {"rounds": N, "seed": S, "cold_nodes": [...]}."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            log.debug("bridge: " + a[0], *a[1:])
+
+        def _reply(self, status: int, doc: dict) -> None:
+            body = json.dumps(doc).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            if self.path.split("?")[0] != "/simulate":
+                self._reply(404, {"message": "not found"})
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                req = json.loads(self.rfile.read(length) or b"{}")
+                report = bridge.simulate(
+                    rounds=int(req.get("rounds", 50)),
+                    seed=int(req.get("seed", 0)),
+                    cold_nodes=req.get("cold_nodes"),
+                    eps=float(req.get("eps", 0.01)))
+            except (ValueError, KeyError, json.JSONDecodeError) as exc:
+                self._reply(400, {"message": str(exc)})
+                return
+            self._reply(200, report.to_json())
+
+    server = ThreadingHTTPServer((bind, port), Handler)
+    if background:
+        threading.Thread(target=server.serve_forever, name="sim-bridge",
+                         daemon=True).start()
+    else:
+        server.serve_forever()
+    return server

@@ -12,24 +12,26 @@ One simulated round = one GossipInterval (200 ms):
 
 1. **select** — sample fan-out peers; take each node's top-``budget``
    freshest *eligible* records (ops/gossip.py; eligibility is the int8
-   round-stamp queue ``acc`` — the vectorized TransmitLimited broadcast
-   queue).
+   transmit-count queue ``sent`` — the vectorized TransmitLimited
+   broadcast queue, count-based so backlogged records wait instead of
+   expiring).
 2. **deliver + announce** — expand messages into update triples with the
    merge semantics (staleness gate, DRAINING stickiness vs the pre-round
    state), fold in the announce path's re-stamps (``BroadcastServices``'s
    1-minute refresh, services_state.go:547-549, staggered per node), and
-   apply them all in ONE scatter-max on ``known`` plus ONE stamp scatter
-   on ``acc``.  Scatters on the big tensors each cost a full buffer
-   rewrite on TPU — one per tensor per round is the performance budget.
-   Announce re-stamps therefore land at the END of a round and become
-   broadcastable the following round (the reference's 5×/10× @ 1 Hz
-   announce repeats are subsumed by the eligibility window, which keeps a
-   fresh version offered for ~limit/fanout rounds).
+   apply them all in ONE scatter-max on ``known`` plus ONE reset scatter
+   on ``sent``.  Scatters on the big tensors each cost a full buffer
+   rewrite on TPU — one per tensor per round (plus the small
+   transmit-count bump) is the performance budget.  Announce re-stamps
+   land at the END of a round and become broadcastable the following
+   round (the reference's 5×/10× @ 1 Hz announce repeats are subsumed by
+   the transmit-count queue, which keeps a fresh version offered until
+   it has had its ~limit transmissions).
 3. **push-pull** — every 20 s, full two-way anti-entropy with one random
    peer (services_delegate.go:146-167).
 4. **sweep** — every 2 s, the lifespan/tombstone-GC sweep (ops/ttl.py);
-   expired cells get stamped eligible, the vectorized analog of the 10×
-   tombstone rebroadcast (services_state.go:620-624).
+   expired cells get their counts reset, the vectorized analog of the
+   10× tombstone rebroadcast (services_state.go:620-624).
 
 Everything is shape-static and scan-compatible; ``run`` drives N rounds
 under ``jax.lax.scan`` and reports a per-round convergence fraction.
@@ -60,7 +62,7 @@ class SimState:
     """Pytree carried through the round scan."""
 
     known: jax.Array       # int32 [N, M] packed (ts<<3|status)
-    acc: jax.Array         # int8 [N, M] round-stamp of last change (mod 256)
+    sent: jax.Array        # int8 [N, M] transmit counts (TransmitLimited)
     node_alive: jax.Array  # bool [N] — cluster membership (churn/SWIM)
     round_idx: jax.Array   # int32 scalar — completed rounds
 
@@ -87,13 +89,6 @@ class SimParams:
             return self.retransmit_limit
         return 4 * math.ceil(math.log10(self.n + 1))
 
-    def eligible_window(self) -> int:
-        """Rounds a freshly-changed record stays in the broadcast queue:
-        TransmitLimited's ``limit`` transmissions at ``fanout`` per round
-        (capped below the mod-256 stamp wrap; eligible_mask uses
-        ``diff <= window``)."""
-        return min(254, max(1, -(-self.resolved_retransmit_limit()
-                                 // self.fanout)))
 
 
 # A perturbation hook: (state, key, now_tick) -> state, applied before each
@@ -143,7 +138,7 @@ class ExactSim:
         known = known.at[rows, cols].set(vals)
         return SimState(
             known=known,
-            acc=jnp.zeros((p.n, p.m), dtype=jnp.int8),
+            sent=jnp.zeros((p.n, p.m), dtype=jnp.int8),
             node_alive=jnp.ones((p.n,), dtype=bool),
             round_idx=jnp.zeros((), jnp.int32),
         )
@@ -172,14 +167,14 @@ class ExactSim:
 
     def _step(self, state: SimState, key: jax.Array) -> SimState:
         p, t = self.p, self.t
-        window = p.eligible_window()
+        limit = p.resolved_retransmit_limit()
         round_idx = state.round_idx + 1
         now = round_idx * t.round_ticks
         k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
 
         if self.perturb is not None:
             state = self.perturb(state, k_perturb, now)
-        known, acc, node_alive = state.known, state.acc, state.node_alive
+        known, sent, node_alive = state.known, state.sent, state.node_alive
 
         # 1. select + gossip deliveries (from the pre-round state).
         dst = gossip_ops.sample_peers(
@@ -188,7 +183,9 @@ class ExactSim:
             node_alive=node_alive, cut_mask=self._cut,
         )
         svc_idx, msg = gossip_ops.select_messages(
-            known, acc, round_idx, p.budget, window)
+            known, sent, p.budget, limit)
+        sent = gossip_ops.record_transmissions(
+            sent, svc_idx, msg, p.fanout, limit)
         d_rows, d_cols, d_vals, d_adv = gossip_ops.prepare_deliveries(
             known, dst, svc_idx, msg,
             now_tick=now, stale_ticks=t.stale_ticks,
@@ -204,8 +201,8 @@ class ExactSim:
         cols = jnp.concatenate([d_cols, a_cols])
         vals = jnp.concatenate([d_vals, a_vals])
         advanced = jnp.concatenate([d_adv, a_due])
-        known, acc = gossip_ops.apply_updates(
-            known, acc, rows, cols, vals, advanced, round_idx)
+        known, sent = gossip_ops.apply_updates(
+            known, sent, rows, cols, vals, advanced)
 
         # 3. anti-entropy push-pull (amortized: every push_pull_rounds).
         pp_partner = gossip_ops.sample_peers(
@@ -214,38 +211,36 @@ class ExactSim:
             node_alive=node_alive, cut_mask=self._cut,
         )[:, 0]
 
-        def do_push_pull(kn_ac):
-            kn, ac = kn_ac
+        def do_push_pull(kn_se):
+            kn, se = kn_se
             merged = gossip_ops.push_pull(
                 kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
                 node_alive=node_alive)
-            stamp = (round_idx & 255).astype(jnp.int8)
-            ac = jnp.where(merged != kn, stamp, ac)
-            return merged, ac
+            se = jnp.where(merged != kn, jnp.int8(0), se)
+            return merged, se
 
-        known, acc = lax.cond(
+        known, sent = lax.cond(
             round_idx % t.push_pull_rounds == 0,
-            do_push_pull, lambda kn_ac: kn_ac, (known, acc))
+            do_push_pull, lambda kn_se: kn_se, (known, sent))
 
         # 4. lifespan sweep (amortized: every sweep_rounds).  Expired
-        # cells are stamped eligible — the 10× tombstone rebroadcast.
-        def do_sweep(kn_ac):
-            kn, ac = kn_ac
+        # cells get their counts reset — the 10× tombstone rebroadcast.
+        def do_sweep(kn_se):
+            kn, se = kn_se
             swept, expired = ttl_sweep(
                 kn, now,
                 alive_lifespan=t.alive_lifespan,
                 draining_lifespan=t.draining_lifespan,
                 tombstone_lifespan=t.tombstone_lifespan,
                 one_second=t.one_second)
-            stamp = (round_idx & 255).astype(jnp.int8)
-            ac = jnp.where(swept != kn, stamp, ac)
-            return swept, ac
+            se = jnp.where(swept != kn, jnp.int8(0), se)
+            return swept, se
 
-        known, acc = lax.cond(
+        known, sent = lax.cond(
             round_idx % t.sweep_rounds == 0,
-            do_sweep, lambda kn_ac: kn_ac, (known, acc))
+            do_sweep, lambda kn_se: kn_se, (known, sent))
 
-        return SimState(known=known, acc=acc, node_alive=node_alive,
+        return SimState(known=known, sent=sent, node_alive=node_alive,
                         round_idx=round_idx)
 
     def convergence(self, state: SimState) -> jax.Array:
@@ -286,20 +281,23 @@ class ExactSim:
     def _step_jit(self, state: SimState, key: jax.Array) -> SimState:
         return self._step(state, key)
 
+    # Per-round keys are derived by folding the round index into the base
+    # key (not by splitting over num_rounds), so a checkpointed run
+    # resumed in chunks replays the exact same randomness as a straight
+    # run: run(s0, k, a+b) == run(run(s0, k, a), k, b).
+
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _run_jit(self, state: SimState, key: jax.Array, num_rounds: int):
-        def body(st, k):
-            st = self._step(st, k)
+        def body(st, _):
+            st = self._step(st, jax.random.fold_in(key, st.round_idx))
             return st, self.convergence(st)
 
-        keys = jax.random.split(key, num_rounds)
-        return lax.scan(body, state, keys)
+        return lax.scan(body, state, None, length=num_rounds)
 
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _run_fast_jit(self, state: SimState, key: jax.Array, num_rounds: int):
-        def body(st, k):
-            return self._step(st, k), None
+        def body(st, _):
+            return self._step(st, jax.random.fold_in(key, st.round_idx)), None
 
-        keys = jax.random.split(key, num_rounds)
-        final, _ = lax.scan(body, state, keys)
+        final, _ = lax.scan(body, state, None, length=num_rounds)
         return final

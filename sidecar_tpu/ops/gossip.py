@@ -12,32 +12,32 @@ This is the TPU recast of the reference's broadcast loop:
   ``packPacket``, services_delegate.go:85-144,182-223), so each round
   carries a bounded number of the *freshest* records.  Here:
   :func:`select_messages` takes the top-``budget`` packed keys per node
-  among *eligible* records — those whose cell changed within the last
-  ``window`` rounds, tracked by an int8 round-stamp tensor ``acc``
-  (the vectorized broadcast queue; see below).  Records a node just
-  accepted have both a fresh stamp and the newest timestamps, so epidemic
-  relay (``retransmit``, services_state.go:342-345,377-392) emerges from
-  the same top-k without explicit queues.
+  among *eligible* records — those whose int8 transmit count ``sent`` is
+  below the TransmitLimited limit (the vectorized broadcast queue; see
+  below).  Records a node just accepted have a zero count and the newest
+  timestamps, so epidemic relay (``retransmit``,
+  services_state.go:342-345,377-392) emerges from the same top-k without
+  explicit queues.
 * Delivery — ONE scatter-max over (target, service) cells — the batched
   ``AddServiceEntry`` merge — with DRAINING stickiness applied to the
   message values *before* the scatter (against the pre-round state), and
-  ONE int8 scatter stamping accepted cells into ``acc``.  Scatters on
-  the big state tensors dominate the round on TPU (each costs a full
-  buffer rewrite), so the kernel is built around exactly one scatter per
-  tensor per round; the announce path's updates are folded into the same
-  scatter via the ``extra_*`` operands.
+  ONE int8 scatter zeroing ``sent`` at accepted cells.  Scatters on the
+  big state tensors dominate the round on TPU (each costs a full buffer
+  rewrite), so the round's budget is one scatter per big tensor plus the
+  small transmit-count bump; the announce path's updates are folded into
+  the same scatters.
 
-Eligibility bookkeeping (the ``acc`` tensor): the reference's
-TransmitLimited queue lets each record version be transmitted
-``RetransmitMult × ⌈log10(n+1)⌉`` times at ``fanout`` sends per round —
-i.e. a version stays in the queue ~limit/fanout rounds after (re-)entry,
-and acceptance of a newer version re-enqueues it.  The vectorized
-equivalent stamps ``acc[cell] = round & 255`` whenever the cell changes;
-a record is eligible while ``(round - acc) mod 256 < window``.  The mod-
-256 wrap can make long-idle cells spuriously eligible for ``window``
-rounds every 256 rounds — those stale offers lose the freshest-first
-top-k to any real traffic, and delivering an old record a peer already
-knows is a merge no-op (at worst it is bonus anti-entropy).
+Eligibility bookkeeping (the ``sent`` tensor): memberlist's
+TransmitLimited queue keeps a record until it has actually been
+transmitted ``RetransmitMult × ⌈log10(n+1)⌉`` times, and acceptance of a
+newer version re-enqueues it at count zero.  The count-based form is
+essential under backlog: when a node holds more fresh records than
+``budget`` slots per round, records WAIT in the queue rather than
+expiring — a time-window approximation silently drops them, which
+stalls recovery in split-heal scenarios where thousands of records
+funnel through the partition boundary.  Ties in the freshest-first
+top-k saturate their counts after a few rounds and rotate out, so
+backlogged records drain in index waves.
 
 * Anti-entropy — every PushPullInterval (20 s) each memberlist node does a
   full two-way state exchange with one random peer
@@ -100,35 +100,28 @@ def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
     return dst
 
 
-def eligible_mask(acc, round_idx, window):
-    """True where a cell changed within the last ``window`` rounds.
-
-    ``acc`` is the int8 round-stamp tensor (round & 255 at last change);
-    see the module docstring for the TransmitLimited mapping.  A cell
-    stamped during round r is first observable by round r+1's select
-    (diff == 1), so eligibility is ``diff <= window`` — the record is
-    offered for exactly ``window`` rounds."""
-    acc32 = acc.astype(jnp.int32) & 255
-    diff = ((jnp.asarray(round_idx, jnp.int32) & 255) - acc32) & 255
-    return diff <= window
+def eligible_mask(sent, limit):
+    """True where a record still has transmissions left
+    (TransmitLimited; see the module docstring)."""
+    return sent.astype(jnp.int32) < limit
 
 
-def select_messages(known, acc, round_idx, budget, window):
+def select_messages(known, sent, budget, limit):
     """Top-``budget`` freshest *eligible* records per node.
 
     The reference's broadcast queue (``GetBroadcasts`` draining
     ``state.Broadcasts`` + pending leftovers into a ~1398 B packet,
-    services_delegate.go:85-144) holds only recently-announced or
-    recently-relayed records; eligibility here is "cell changed within
-    ``window`` rounds" (see module docstring).  Eligible records are
-    offered freshest-first (packed keys sort by timestamp), up to
+    services_delegate.go:85-144) holds only records with transmissions
+    remaining (count < limit; see module docstring).  Eligible records
+    are offered freshest-first (packed keys sort by timestamp), up to
     ``budget`` per round.
 
     Returns (svc_idx[N, B], msg[N, B]) — ``msg`` is 0 (merge no-op) in
     slots where a node has fewer than ``budget`` eligible records.
     """
-    priority = jnp.where(eligible_mask(acc, round_idx, window), known, 0)
+    priority = jnp.where(eligible_mask(sent, limit), known, 0)
     n, m = priority.shape
+    budget = min(budget, m)  # tiny catalogs: can't offer more than exists
 
     if m <= 4 * 1024:
         msg, svc_idx = lax.top_k(priority, budget)
@@ -214,25 +207,36 @@ def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
     return rows, cols, val, advanced
 
 
-def apply_updates(known, acc, rows, cols, vals, advanced, round_idx,
+def apply_updates(known, sent, rows, cols, vals, advanced,
                   num_rows=None):
     """The two scatters of a gossip round: merge ``vals`` into ``known``
-    (scatter-max) and stamp ``acc`` at advanced cells.
+    (scatter-max) and zero ``sent`` at advanced cells (the re-enqueue of
+    a freshly accepted/announced record version).
 
     Callers concatenate ALL of a round's updates (gossip deliveries +
     announce re-stamps) into one call — scatters on the big tensors cost
     a full buffer rewrite each on TPU, so one per tensor per round is the
     budget.  ``num_rows`` overrides the out-of-bounds row used to drop
-    non-advancing stamps (defaults to known's row count; sharded callers
-    pass their local block height).
+    non-advancing entries (defaults to known's row count; sharded
+    callers pass their local block height).
     """
     oob = known.shape[0] if num_rows is None else num_rows
     known = known.at[rows, cols].max(vals, mode="drop")
-    stamp_rows = jnp.where(advanced, rows, oob)
-    stamp = ((jnp.asarray(round_idx, jnp.int32) & 255)
-             .astype(acc.dtype))
-    acc = acc.at[stamp_rows, cols].set(stamp, mode="drop")
-    return known, acc
+    reset_rows = jnp.where(advanced, rows, oob)
+    sent = sent.at[reset_rows, cols].set(jnp.int8(0), mode="drop")
+    return known, sent
+
+
+def record_transmissions(sent, svc_idx, msg, fanout, limit):
+    """Bump transmit counts for the records offered this round —
+    ``fanout`` sends each — saturating at ``limit`` (TransmitLimited's
+    per-message accounting)."""
+    n = sent.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    bump = jnp.where(msg > 0, fanout, 0).astype(jnp.int32)
+    current = sent[rows, svc_idx].astype(jnp.int32)
+    capped = jnp.minimum(current + bump, limit).astype(sent.dtype)
+    return sent.at[rows, svc_idx].set(capped, mode="drop")
 
 
 def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None):

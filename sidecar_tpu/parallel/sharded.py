@@ -26,9 +26,10 @@ Cross-device traffic, by construction, is only:
   visible only in the tail of convergence curves.
 
 Like the single-chip model, the round is built around ONE scatter-max on
-``known`` and ONE stamp scatter on ``acc`` per shard per round (scatters
+``known`` and ONE reset scatter on ``sent`` per shard per round (scatters
 on the big tensors cost a full buffer rewrite each on TPU); announce
-updates ride the same scatter.
+updates ride the same scatter, and the transmit-count bump is a small
+extra scatter.
 
 Partitions: pass ``node_side`` (int[N] side assignment) — gossip edges are
 cut via ``cut_mask`` exactly as in the single-chip model, and the stride
@@ -108,7 +109,7 @@ class ShardedSim:
         repl = NamedSharding(self.mesh, P())
         return SimState(
             known=jax.device_put(jnp.asarray(known), shard),
-            acc=jax.device_put(jnp.zeros((p.n, p.m), jnp.int8), shard),
+            sent=jax.device_put(jnp.zeros((p.n, p.m), jnp.int8), shard),
             node_alive=jax.device_put(jnp.ones((p.n,), bool), repl),
             round_idx=jax.device_put(jnp.zeros((), jnp.int32), repl),
         )
@@ -135,12 +136,12 @@ class ShardedSim:
             dst = jnp.where(cut, gi[:, None], dst)
         return jnp.where(alive[gi][:, None], dst, gi[:, None])
 
-    def _gossip_shard(self, known_l, acc_l, alive, key, round_idx,
+    def _gossip_shard(self, known_l, sent_l, alive, key, round_idx,
                       nbrs_l=None, deg_l=None, cut_l=None):
         """One shard's gossip round: select → all-gather offers → local
         combined scatter (deliveries + announce) → sweep."""
         p, t = self.p, self.t
-        window = p.eligible_window()
+        limit = p.resolved_retransmit_limit()
         s = p.services_per_node
         nl = known_l.shape[0]
         ax = lax.axis_index(NODE_AXIS)
@@ -156,9 +157,11 @@ class ShardedSim:
             dst = self._sample_dst_nbrs(k_peers, gi, alive, nl,
                                         nbrs_l, deg_l, cut_l)
 
-        # Select offers from the local block.
+        # Select offers from the local block + transmit accounting.
         svc_idx, msg = gossip_ops.select_messages(
-            known_l, acc_l, round_idx, p.budget, window)
+            known_l, sent_l, p.budget, limit)
+        sent_l = gossip_ops.record_transmissions(
+            sent_l, svc_idx, msg, p.fanout, limit)
 
         # The only cross-shard gossip traffic: the message offers.
         dst_all = lax.all_gather(dst, NODE_AXIS, tiled=True)        # [N, F]
@@ -208,30 +211,29 @@ class ShardedSim:
         cols = jnp.concatenate([cols, a_cols])
         vals = jnp.concatenate([val, a_vals])
         adv = jnp.concatenate([advanced, due])
-        known_l, acc_l = gossip_ops.apply_updates(
-            known_l, acc_l, rows, cols, vals, adv, round_idx, num_rows=nl)
+        known_l, sent_l = gossip_ops.apply_updates(
+            known_l, sent_l, rows, cols, vals, adv, num_rows=nl)
 
         # Lifespan sweep (local, amortized).
-        def do_sweep(kn_ac):
-            kn, ac = kn_ac
+        def do_sweep(kn_se):
+            kn, se = kn_se
             swept, _ = ttl_sweep(
                 kn, now,
                 alive_lifespan=t.alive_lifespan,
                 draining_lifespan=t.draining_lifespan,
                 tombstone_lifespan=t.tombstone_lifespan,
                 one_second=t.one_second)
-            ac = jnp.where(swept != kn,
-                           (round_idx & 255).astype(jnp.int8), ac)
-            return swept, ac
+            se = jnp.where(swept != kn, jnp.int8(0), se)
+            return swept, se
 
-        known_l, acc_l = lax.cond(
+        known_l, sent_l = lax.cond(
             round_idx % t.sweep_rounds == 0,
-            do_sweep, lambda kn_ac: kn_ac, (known_l, acc_l))
-        return known_l, acc_l
+            do_sweep, lambda kn_se: kn_se, (known_l, sent_l))
+        return known_l, sent_l
 
     # -- anti-entropy stride exchange (jit level, sharding-propagated) -----
 
-    def _push_pull_stride(self, known, acc, alive, key, now, round_idx):
+    def _push_pull_stride(self, known, sent, alive, key, now, round_idx):
         """Two-way full-state exchange with the node `stride` positions
         away on the ring; jnp.roll on the sharded axis becomes an XLA
         collective-permute."""
@@ -253,9 +255,8 @@ class ShardedSim:
         back = jnp.where(ok_back[:, None], jnp.roll(offered, stride, axis=0), 0)
         back = sticky_adjust(back, known, back > known)
         merged = jnp.maximum(pulled, back)
-        acc = jnp.where(merged != known,
-                        (round_idx & 255).astype(jnp.int8), acc)
-        return merged, acc
+        sent = jnp.where(merged != known, jnp.int8(0), sent)
+        return merged, sent
 
     # -- drivers -----------------------------------------------------------
 
@@ -276,41 +277,41 @@ class ShardedSim:
                 out_specs=(spec_row, spec_row),
                 check_rep=False,
             )
-            known, acc = fn(state.known, state.acc, state.node_alive,
-                            k_round, round_idx)
+            known, sent = fn(state.known, state.sent, state.node_alive,
+                             k_round, round_idx)
         elif self._cut is not None:
-            def wrapper(kn, ac, al, nb, dg, ct, k, r):
-                return self._gossip_shard(kn, ac, al, k, r, nbrs_l=nb,
+            def wrapper(kn, se, al, nb, dg, ct, k, r):
+                return self._gossip_shard(kn, se, al, k, r, nbrs_l=nb,
                                           deg_l=dg, cut_l=ct)
             fn = shard_map(
                 wrapper, mesh=self.mesh,
                 in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 3
                          + (spec_repl, spec_repl),
                 out_specs=(spec_row, spec_row), check_rep=False)
-            known, acc = fn(state.known, state.acc, state.node_alive,
-                            self._nbrs, self._deg, self._cut, k_round,
-                            round_idx)
+            known, sent = fn(state.known, state.sent, state.node_alive,
+                             self._nbrs, self._deg, self._cut, k_round,
+                             round_idx)
         else:
-            def wrapper_nocut(kn, ac, al, nb, dg, k, r):
-                return self._gossip_shard(kn, ac, al, k, r, nbrs_l=nb,
+            def wrapper_nocut(kn, se, al, nb, dg, k, r):
+                return self._gossip_shard(kn, se, al, k, r, nbrs_l=nb,
                                           deg_l=dg, cut_l=None)
             fn = shard_map(
                 wrapper_nocut, mesh=self.mesh,
                 in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 2
                          + (spec_repl, spec_repl),
                 out_specs=(spec_row, spec_row), check_rep=False)
-            known, acc = fn(state.known, state.acc, state.node_alive,
-                            self._nbrs, self._deg, k_round, round_idx)
+            known, sent = fn(state.known, state.sent, state.node_alive,
+                             self._nbrs, self._deg, k_round, round_idx)
 
-        known, acc = lax.cond(
+        known, sent = lax.cond(
             round_idx % t.push_pull_rounds == 0,
-            lambda kn_ac: self._push_pull_stride(
-                kn_ac[0], kn_ac[1], state.node_alive, k_pp, now, round_idx),
-            lambda kn_ac: kn_ac,
-            (known, acc),
+            lambda kn_se: self._push_pull_stride(
+                kn_se[0], kn_se[1], state.node_alive, k_pp, now, round_idx),
+            lambda kn_se: kn_se,
+            (known, sent),
         )
 
-        return SimState(known=known, acc=acc, node_alive=state.node_alive,
+        return SimState(known=known, sent=sent, node_alive=state.node_alive,
                         round_idx=round_idx)
 
     def convergence(self, state: SimState) -> jax.Array:
@@ -337,18 +338,19 @@ class ShardedSim:
     def _step_jit(self, state, key):
         return self._step(state, key)
 
+    # Per-round keys fold the round index into the base key so chunked/
+    # resumed runs replay identical randomness (see ExactSim).
+
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _run_jit(self, state, key, num_rounds):
-        def body(st, k):
-            st = self._step(st, k)
+        def body(st, _):
+            st = self._step(st, jax.random.fold_in(key, st.round_idx))
             return st, self.convergence(st)
-        keys = jax.random.split(key, num_rounds)
-        return lax.scan(body, state, keys)
+        return lax.scan(body, state, None, length=num_rounds)
 
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _run_fast_jit(self, state, key, num_rounds):
-        def body(st, k):
-            return self._step(st, k), None
-        keys = jax.random.split(key, num_rounds)
-        final, _ = lax.scan(body, state, keys)
+        def body(st, _):
+            return self._step(st, jax.random.fold_in(key, st.round_idx)), None
+        final, _ = lax.scan(body, state, None, length=num_rounds)
         return final

@@ -1,0 +1,58 @@
+"""Checkpoint/resume for simulated cluster state.
+
+The reference needs no checkpointing (state rebuilds from peers on
+rejoin, SURVEY.md §5); the simulator does — long convergence studies
+should survive preemption.  Chunk-resumability is exact: the scan
+derives per-round PRNG keys from the round index, so a resumed run
+replays the same randomness as an uninterrupted one."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+import jax.numpy as jnp
+import numpy as np
+
+from sidecar_tpu.models.exact import SimParams, SimState
+
+FORMAT_VERSION = 1
+
+
+def save_state(path: str | pathlib.Path, state: SimState,
+               params: SimParams) -> None:
+    """Write state + params to a compressed npz."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez_compressed(
+        path,
+        version=FORMAT_VERSION,
+        known=np.asarray(state.known),
+        sent=np.asarray(state.sent),
+        node_alive=np.asarray(state.node_alive),
+        round_idx=np.asarray(state.round_idx),
+        params=json.dumps(dataclasses.asdict(params)),
+    )
+
+
+def load_state(path: str | pathlib.Path) -> tuple[SimState, SimParams]:
+    """Load a checkpoint; raises ValueError on version/shape mismatch."""
+    with np.load(pathlib.Path(path), allow_pickle=False) as data:
+        version = int(data["version"])
+        if version != FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint version {version} unsupported "
+                f"(expected {FORMAT_VERSION})")
+        params = SimParams(**json.loads(str(data["params"])))
+        state = SimState(
+            known=jnp.asarray(data["known"]),
+            sent=jnp.asarray(data["sent"]),
+            node_alive=jnp.asarray(data["node_alive"]),
+            round_idx=jnp.asarray(data["round_idx"]),
+        )
+    if state.known.shape != (params.n, params.m):
+        raise ValueError(
+            f"checkpoint shape {state.known.shape} does not match params "
+            f"({params.n}, {params.m})")
+    return state, params

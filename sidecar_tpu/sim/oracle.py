@@ -14,8 +14,8 @@ Peer/message *sampling* is shared with the kernels (the oracle calls the
 same deterministic ``sample_peers`` / ``select_messages`` with the same
 PRNG keys); what the oracle re-implements independently is every state
 *transition*: announce scheduling, per-record LWW merge with stickiness
-and staleness, eligibility stamping, the lifespan sweep with the +1 s
-rule, and push-pull.
+and staleness, transmit-count accounting, the lifespan sweep with the
++1 s rule, and push-pull.
 
 Batch-resolution note: the reference applies same-round messages
 sequentially, so a round where one cell receives both a DRAINING-sticky
@@ -56,7 +56,7 @@ def _pack(ts: int, st: int) -> int:
 
 class OracleSim:
     """Sequential mirror of :class:`ExactSim`. Evolves its own NumPy state
-    using the same PRNG keys; `known`/`acc` should match the kernel
+    using the same PRNG keys; `known`/`sent` should match the kernel
     bit-for-bit."""
 
     def __init__(self, sim: ExactSim, state: SimState):
@@ -64,18 +64,16 @@ class OracleSim:
         self.p = sim.p
         self.t = sim.t
         self.known = np.asarray(state.known).copy()
-        # uint8 view of the kernel's int8 stamps — same bits, and the
-        # 0..255 round-stamp domain stays printable.
-        self.acc = np.asarray(state.acc).astype(np.uint8).copy()
+        self.sent = np.asarray(state.sent).astype(np.int32).copy()
         self.node_alive = np.asarray(state.node_alive).copy()
         self.round_idx = int(state.round_idx)
         self.owner = np.asarray(sim.owner)
-        self.window = sim.p.eligible_window()
+        self.limit = sim.p.resolved_retransmit_limit()
 
     # -- one delivered/announced value, vs the pre-round snapshot ----------
 
     def apply_one(self, node: int, svc: int, incoming: int,
-                  pre: np.ndarray, stamp: int) -> None:
+                  pre: np.ndarray) -> None:
         """One update through the merge semantics
         (services_state.go:293-347 recast to the kernel's batch
         resolution): staleness was already gated at prepare time; accept
@@ -91,9 +89,9 @@ class OracleSim:
                 incoming = _pack(_ts(incoming), DRAINING)
             if incoming > int(self.known[node, svc]):
                 self.known[node, svc] = incoming
-            # Any advancing update marks the cell accepted this round
-            # (re-enqueue for relay, services_state.go:377-392).
-            self.acc[node, svc] = stamp
+            # Any advancing update re-enqueues the cell for relay
+            # (services_state.go:377-392): transmit count back to zero.
+            self.sent[node, svc] = 0
 
     # -- full round, mirroring ExactSim._step ------------------------------
 
@@ -101,7 +99,6 @@ class OracleSim:
         p, t = self.p, self.t
         self.round_idx += 1
         now = self.round_idx * t.round_ticks
-        stamp = self.round_idx & 255
         _k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
 
         pre = self.known.copy()
@@ -115,14 +112,23 @@ class OracleSim:
         ))
         svc_idx, msg = gossip_ops.select_messages(
             jax.numpy.asarray(self.known),
-            jax.numpy.asarray(self.acc),
-            jax.numpy.asarray(self.round_idx), p.budget, self.window)
+            jax.numpy.asarray(self.sent.astype(np.int8)),
+            p.budget, self.limit)
         svc_idx, msg = np.asarray(svc_idx), np.asarray(msg)
+
+        # Transmit accounting (TransmitLimited: fanout sends per offer).
+        budget = msg.shape[1]
+        for node in range(p.n):
+            for b in range(budget):
+                if msg[node, b] > 0:
+                    s = int(svc_idx[node, b])
+                    self.sent[node, s] = min(self.sent[node, s] + p.fanout,
+                                             self.limit)
 
         drop = None
         if p.drop_prob > 0:
             keep = jax.random.bernoulli(
-                k_drop, 1.0 - p.drop_prob, (p.n, p.fanout, p.budget))
+                k_drop, 1.0 - p.drop_prob, (p.n, p.fanout, budget))
             drop = ~np.asarray(keep)
 
         stale_floor = now - t.stale_ticks
@@ -133,14 +139,14 @@ class OracleSim:
                 tgt = int(dst[s, f])
                 if not self.node_alive[tgt]:
                     continue
-                for b in range(p.budget):
+                for b in range(budget):
                     if drop is not None and drop[s, f, b]:
                         continue
                     val = int(msg[s, b])
                     ts = val >> STATUS_BITS
                     if ts > 0 and ts < stale_floor:  # staleness gate
                         continue
-                    self.apply_one(tgt, int(svc_idx[s, b]), val, pre, stamp)
+                    self.apply_one(tgt, int(svc_idx[s, b]), val, pre)
 
         # 2. announce re-stamps (end of round, same scatter in the kernel).
         for m in range(p.m):
@@ -153,7 +159,7 @@ class OracleSim:
                 continue
             phase = o % t.refresh_rounds
             if (self.round_idx % t.refresh_rounds) == phase:
-                self.apply_one(o, m, _pack(now, st), pre, stamp)
+                self.apply_one(o, m, _pack(now, st), pre)
 
         # 3. anti-entropy push-pull.
         if self.round_idx % t.push_pull_rounds == 0:
@@ -166,15 +172,15 @@ class OracleSim:
             alive = self.node_alive
             partner = np.where(alive & alive[partner], partner,
                                np.arange(p.n))
-            self.push_pull(partner, now, stamp)
+            self.push_pull(partner, now)
 
         # 4. lifespan sweep.
         if self.round_idx % t.sweep_rounds == 0:
-            self.sweep(now, stamp)
+            self.sweep(now)
 
     # -- anti-entropy ------------------------------------------------------
 
-    def push_pull(self, partner: np.ndarray, now: int, stamp: int) -> None:
+    def push_pull(self, partner: np.ndarray, now: int) -> None:
         """Two-way full-state exchange per initiator (LocalState/
         MergeRemoteState, services_delegate.go:146-167). All exchanged
         payloads are read from the pre-exchange snapshot — in the kernel
@@ -194,11 +200,11 @@ class OracleSim:
                     ts = val >> STATUS_BITS
                     if ts == 0 or ts < stale_floor:
                         continue
-                    self.apply_one(node, m, val, pre, stamp)
+                    self.apply_one(node, m, val, pre)
 
     # -- lifespan sweep ----------------------------------------------------
 
-    def sweep(self, now: int, stamp: int) -> None:
+    def sweep(self, now: int) -> None:
         """TombstoneOthersServices per node (services_state.go:635-683)."""
         t = self.t
         n, m_tot = self.known.shape
@@ -211,14 +217,15 @@ class OracleSim:
                 if st == TOMBSTONE:
                     if ts < now - t.tombstone_lifespan:
                         self.known[node, m] = 0  # GC (:645-653)
-                        self.acc[node, m] = stamp
+                        self.sent[node, m] = 0
                     continue
                 lifespan = (t.draining_lifespan if st == DRAINING
                             else t.alive_lifespan)
                 if ts < now - lifespan:
-                    # +1 s rule (:667-675); stamp for the 10× rebroadcast.
+                    # +1 s rule (:667-675); re-enqueue for the 10×
+                    # rebroadcast.
                     self.known[node, m] = _pack(ts + t.one_second, TOMBSTONE)
-                    self.acc[node, m] = stamp
+                    self.sent[node, m] = 0
 
     def convergence(self) -> float:
         alive = self.node_alive

@@ -1,0 +1,259 @@
+"""The BASELINE.json validation scenarios, runnable end to end.
+
+Five configs (BASELINE.md):
+  1. static single node, 3 services — CPU-grade merge reference
+  2. 32-node ring, fanout 3, 10 services/node — convergence vs oracle
+  3. 4,096-node Erdős–Rényi with 5% service churn + tombstone propagation
+  4. 65,536-node Barabási–Albert with periodic anti-entropy
+  5. 1M-node partitioned mesh, 2-way split + heal, sharded over the mesh
+
+Each scenario returns a :class:`ScenarioResult` with the convergence
+curve, ε-convergence round/wall-clock, and rounds/sec.  Configs 4 and 5
+are declared at full scale; ``scale`` shrinks them proportionally for
+hardware that cannot hold the dense exact-model state (the dense row is
+O(N²·spn) — full-scale configs 4/5 need the compressed large-cluster
+model; until that lands they run scaled-down and say so in the result).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from sidecar_tpu.models.exact import ExactSim, SimParams, SimState
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology as topo_mod
+from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, pack, unpack_status, unpack_ts
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    name: str
+    n: int
+    services_per_node: int
+    rounds_run: int
+    convergence: np.ndarray          # per-round fraction
+    eps_round: Optional[int]         # first round with conv >= 1 - eps
+    eps_seconds_simulated: Optional[float]
+    wall_seconds: float
+    rounds_per_sec: float
+    scaled_from: Optional[int] = None  # declared full-scale N, if reduced
+    notes: str = ""
+
+    def summary(self) -> dict:
+        return {
+            "scenario": self.name,
+            "n": self.n,
+            "rounds": self.rounds_run,
+            "final_convergence": float(self.convergence[-1])
+            if len(self.convergence) else None,
+            "eps_round": self.eps_round,
+            "eps_seconds_simulated": self.eps_seconds_simulated,
+            "wall_seconds": round(self.wall_seconds, 3),
+            "rounds_per_sec": round(self.rounds_per_sec, 2),
+            "scaled_from": self.scaled_from,
+            "notes": self.notes,
+        }
+
+
+def _eps_round(conv: np.ndarray, eps: float) -> Optional[int]:
+    hits = np.nonzero(conv >= 1.0 - eps)[0]
+    return int(hits[0]) + 1 if hits.size else None
+
+
+def _run(sim: ExactSim, state: SimState, rounds: int, seed: int,
+         name: str, eps: float, scaled_from: Optional[int] = None,
+         notes: str = "") -> ScenarioResult:
+    key = jax.random.PRNGKey(seed)
+    t0 = time.perf_counter()
+    state, conv = sim.run(state, key, rounds)
+    conv = np.asarray(jax.device_get(conv))
+    wall = time.perf_counter() - t0
+    er = _eps_round(conv, eps)
+    return ScenarioResult(
+        name=name, n=sim.p.n, services_per_node=sim.p.services_per_node,
+        rounds_run=rounds, convergence=conv, eps_round=er,
+        eps_seconds_simulated=(er * sim.t.round_ticks /
+                               sim.t.ticks_per_second
+                               if er is not None else None),
+        wall_seconds=wall, rounds_per_sec=rounds / wall,
+        scaled_from=scaled_from, notes=notes)
+
+
+# Cold-start studies pin the refresh far out so convergence measures pure
+# epidemic spread, not the refresh chase.
+_STUDY_CFG = TimeConfig(refresh_interval_s=10_000.0)
+
+
+def config1_static_merge(eps: float = 0.0) -> ScenarioResult:
+    """Single node, 3 services: the merge-kernel sanity config."""
+    sim = ExactSim(SimParams(n=1, services_per_node=3, fanout=1, budget=3),
+                   topo_mod.complete(1), _STUDY_CFG)
+    return _run(sim, sim.init_state(), rounds=10, seed=1,
+                name="config1-static", eps=eps,
+                notes="single node: converged by construction")
+
+
+def config2_ring(eps: float = 0.0, rounds: int = 120) -> ScenarioResult:
+    """32-node ring, fanout 3, 10 services/node."""
+    sim = ExactSim(SimParams(n=32, services_per_node=10, fanout=3,
+                             budget=15),
+                   topo_mod.ring(32), _STUDY_CFG)
+    return _run(sim, sim.init_state(), rounds=rounds, seed=2,
+                name="config2-ring32", eps=eps)
+
+
+def _churn_perturb(params: SimParams, timecfg: TimeConfig,
+                   churn_prob_per_round: float):
+    """Service churn: each round a Bernoulli subset of slots restarts —
+    old instance tombstoned by its owner, a successor announced with a
+    fresh timestamp (the owner-side analog of Docker die/start events)."""
+    spn = params.services_per_node
+
+    def perturb(state: SimState, key: jax.Array, now):
+        import jax.numpy as jnp
+
+        owner = jnp.arange(params.m, dtype=jnp.int32) // spn
+        cols = jnp.arange(params.m, dtype=jnp.int32)
+        churn = jax.random.bernoulli(key, churn_prob_per_round,
+                                     (params.m,))
+        own = state.known[owner, cols]
+        live = unpack_ts(own) > 0
+        flip = churn & live & state.node_alive[owner]
+        st = unpack_status(own)
+        # Restart: the record's status flips through TOMBSTONE half the
+        # time, else it re-announces ALIVE at now (a redeploy).
+        new_status = jnp.where(st == ALIVE, TOMBSTONE, ALIVE)
+        new_val = jnp.where(flip, pack(now, new_status), own)
+        known = state.known.at[owner, cols].set(new_val)
+        reset_rows = jnp.where(flip, owner, params.n)
+        sent = state.sent.at[reset_rows, cols].set(jnp.int8(0),
+                                                   mode="drop")
+        return dataclasses.replace(state, known=known, sent=sent)
+
+    return perturb
+
+
+def config3_er_churn(eps: float = 0.01, rounds: int = 400,
+                     scale: float = 1.0) -> ScenarioResult:
+    """4,096-node Erdős–Rényi, 5% churn over the run, tombstones
+    propagating."""
+    n = max(64, int(4096 * scale))
+    params = SimParams(n=n, services_per_node=10, fanout=3, budget=15)
+    # 5% of services churn across the run.
+    churn_per_round = 0.05 / rounds
+    sim = ExactSim(params, topo_mod.erdos_renyi(n, avg_degree=8, seed=3),
+                   _STUDY_CFG,
+                   perturb=_churn_perturb(params, _STUDY_CFG,
+                                          churn_per_round))
+    return _run(sim, sim.init_state(), rounds=rounds, seed=3,
+                name="config3-er4096-churn", eps=eps,
+                scaled_from=4096 if n != 4096 else None,
+                notes="5% service churn across the run; convergence "
+                      "chases a moving target")
+
+
+def config4_ba_antientropy(eps: float = 0.01, rounds: int = 400,
+                           scale: float = 1.0) -> ScenarioResult:
+    """65,536-node Barabási–Albert with periodic anti-entropy.
+
+    Full scale needs the compressed large-cluster model (dense exact
+    state is O(N²·spn)); ``scale`` shrinks N proportionally."""
+    n = max(128, int(65_536 * scale))
+    cfg = dataclasses.replace(_STUDY_CFG, push_pull_interval_s=4.0)
+    sim = ExactSim(SimParams(n=n, services_per_node=10, fanout=3,
+                             budget=15),
+                   topo_mod.barabasi_albert(n, m=3, seed=4), cfg)
+    return _run(sim, sim.init_state(), rounds=rounds, seed=4,
+                name="config4-ba-antientropy", eps=eps,
+                scaled_from=65_536 if n != 65_536 else None,
+                notes="anti-entropy every 4 s simulated")
+
+
+def config5_split_heal(eps: float = 0.01, split_rounds: int = 150,
+                       heal_rounds: int = 250,
+                       scale: float = 1.0) -> ScenarioResult:
+    """Partitioned 2-D mesh: run split, verify convergence stalls, heal,
+    verify full convergence.  Declared at 1M nodes; runs scaled."""
+    side = max(8, int(1000 * math.sqrt(scale)))
+    n = side * side
+    topo = topo_mod.mesh2d(side, side)
+    halves = (np.arange(n) % side >= side // 2).astype(np.int32)
+    cut = topo_mod.partition_mask(topo, halves)
+
+    params = SimParams(n=n, services_per_node=4, fanout=3, budget=15)
+    # Frequent anti-entropy: healing a partition is seeded by push-pull
+    # at the boundary, then drained by gossip relay.
+    cfg = dataclasses.replace(_STUDY_CFG, push_pull_interval_s=2.0)
+
+    split_sim = ExactSim(params, topo, cfg, cut_mask=cut)
+    key = jax.random.PRNGKey(5)
+    t0 = time.perf_counter()
+    state, conv_split = split_sim.run(split_sim.init_state(), key,
+                                      split_rounds)
+    conv_split = np.asarray(jax.device_get(conv_split))
+
+    heal_sim = ExactSim(params, topo, cfg)  # cut removed: healed
+    state, conv_heal = heal_sim.run(state, key, heal_rounds)
+    conv_heal = np.asarray(jax.device_get(conv_heal))
+    wall = time.perf_counter() - t0
+
+    conv = np.concatenate([conv_split, conv_heal])
+    rounds = split_rounds + heal_rounds
+    er = _eps_round(conv, eps)
+    split_peak = float(conv_split.max())
+    return ScenarioResult(
+        name="config5-split-heal", n=n,
+        services_per_node=params.services_per_node, rounds_run=rounds,
+        convergence=conv, eps_round=er,
+        eps_seconds_simulated=(er * cfg.round_ticks / cfg.ticks_per_second
+                               if er is not None else None),
+        wall_seconds=wall, rounds_per_sec=rounds / wall,
+        scaled_from=1_000_000 if n != 1_000_000 else None,
+        notes=f"convergence while split peaked at {split_peak:.3f} "
+              "(must stay < 1); heal completes it")
+
+
+ALL_SCENARIOS: dict[str, Callable[..., ScenarioResult]] = {
+    "config1": config1_static_merge,
+    "config2": config2_ring,
+    "config3": config3_er_churn,
+    "config4": config4_ba_antientropy,
+    "config5": config5_split_heal,
+}
+
+
+def run_all(scale: float = 1.0) -> list[ScenarioResult]:
+    out = []
+    for name, fn in ALL_SCENARIOS.items():
+        if name in ("config3", "config4", "config5"):
+            out.append(fn(scale=scale))
+        else:
+            out.append(fn())
+    return out
+
+
+if __name__ == "__main__":
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser("scenarios")
+    parser.add_argument("--scale", type=float, default=0.05,
+                        help="scale factor for the large configs")
+    parser.add_argument("--only", default=None,
+                        help="run a single config (config1..config5)")
+    args = parser.parse_args()
+    if args.only:
+        fn = ALL_SCENARIOS[args.only]
+        results = [fn(scale=args.scale)
+                   if args.only in ("config3", "config4", "config5")
+                   else fn()]
+    else:
+        results = run_all(scale=args.scale)
+    for result in results:
+        print(json.dumps(result.summary()))

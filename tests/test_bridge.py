@@ -1,0 +1,116 @@
+"""Sim-bridge tests: a live catalog snapshot runs forward under the
+simulator and the results map back to hostnames/service IDs."""
+
+import json
+import urllib.request
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.bridge import SimBridge, serve_bridge
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.models.timecfg import TimeConfig
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+CFG = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=2.0)
+
+
+def make_state(hosts=("h1", "h2", "h3"), spn=2):
+    state = ServicesState(hostname=hosts[0])
+    state.set_clock(lambda: T0)
+    for hi, host in enumerate(hosts):
+        for si in range(spn):
+            state.add_service_entry(S.Service(
+                id=f"{host}-svc{si}", name=f"app{si}", image="i:1",
+                hostname=host, updated=T0 + hi * NS + si,
+                status=S.ALIVE))
+    return state
+
+
+class TestSnapshot:
+    def test_mapping_round_trip(self):
+        bridge = SimBridge(make_state(), CFG)
+        state, params, mapping, sim = bridge.snapshot()
+        assert params.n == 3
+        assert params.services_per_node == 2
+        assert mapping.hostnames == ["h1", "h2", "h3"]
+        # Warm snapshot: everyone already knows everything.
+        assert float(sim.convergence(state)) == 1.0
+
+    def test_empty_catalog_rejected(self):
+        bridge = SimBridge(ServicesState(hostname="x"), CFG)
+        with pytest.raises(ValueError, match="empty"):
+            bridge.snapshot()
+
+
+class TestSimulate:
+    def test_warm_cluster_stays_converged(self):
+        bridge = SimBridge(make_state(), CFG)
+        report = bridge.simulate(rounds=20)
+        assert report.convergence[-1] == 1.0
+        assert report.eps_round == 1
+        assert set(report.node_agreement) == {"h1", "h2", "h3"}
+        # Every node's projected view carries every service.
+        assert all(len(view) == 6 for view in report.projected.values())
+        assert report.projected["h2"]["h1-svc0"] == "Alive"
+
+    def test_cold_joiner_reconverges(self):
+        # 7 hosts × 3 services = 21 records > the 15-record packet
+        # budget, so one round cannot finish the re-teach.
+        state = make_state(hosts=tuple(f"h{i}" for i in range(1, 8)),
+                           spn=3)
+        bridge = SimBridge(state, CFG)
+        report = bridge.simulate(rounds=60, cold_nodes=["h3"])
+        # h3 starts knowing only itself, so round 1 is not converged...
+        assert report.convergence[0] < 1.0
+        # ...but epidemic spread re-teaches it.
+        assert report.convergence[-1] == 1.0
+        assert report.node_agreement["h3"] == 1.0
+        assert report.eps_round is not None
+
+    def test_unknown_cold_node(self):
+        bridge = SimBridge(make_state(), CFG)
+        with pytest.raises(KeyError):
+            bridge.simulate(rounds=5, cold_nodes=["ghost"])
+
+    def test_seconds_simulated(self):
+        bridge = SimBridge(make_state(), CFG)
+        report = bridge.simulate(rounds=50)
+        assert report.seconds_simulated == pytest.approx(10.0)  # 50×200ms
+
+
+class TestBridgeServer:
+    def test_simulate_over_http(self):
+        bridge = SimBridge(make_state(), CFG)
+        server = serve_bridge(bridge, port=0)
+        try:
+            port = server.server_address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/simulate",
+                data=json.dumps({"rounds": 10,
+                                 "cold_nodes": ["h2"]}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                doc = json.loads(resp.read())
+            assert doc["rounds"] == 10
+            assert len(doc["convergence"]) == 10
+            assert "h2" in doc["node_agreement"]
+        finally:
+            server.shutdown()
+
+    def test_bad_request(self):
+        bridge = SimBridge(make_state(), CFG)
+        server = serve_bridge(bridge, port=0)
+        try:
+            port = server.server_address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/simulate",
+                data=b"{not json", method="POST")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=10)
+            assert err.value.code == 400
+        finally:
+            server.shutdown()

@@ -1,0 +1,100 @@
+"""Scenario + checkpoint tests: small-scale versions of the BASELINE
+configs, and exact chunked-resume equivalence."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology
+from sidecar_tpu.sim import scenarios
+from sidecar_tpu.sim.checkpoint import load_state, save_state
+
+FAST = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=2.0)
+
+
+class TestScenarios:
+    def test_config1_trivially_converged(self):
+        result = scenarios.config1_static_merge()
+        assert result.convergence[-1] == 1.0
+        assert result.eps_round == 1
+
+    def test_config2_ring_converges(self):
+        result = scenarios.config2_ring(rounds=120)
+        assert result.convergence[-1] == 1.0
+        assert result.eps_round is not None
+        assert result.eps_seconds_simulated == pytest.approx(
+            result.eps_round * 0.2)
+
+    def test_config3_er_churn_small(self):
+        result = scenarios.config3_er_churn(rounds=120, scale=0.02)
+        assert result.n == 81 or result.n == 64  # max(64, 4096*0.02)
+        assert result.scaled_from == 4096
+        # Churn chases a moving target; should still be near-converged.
+        assert result.convergence[-1] > 0.95
+
+    def test_config4_ba_small(self):
+        result = scenarios.config4_ba_antientropy(rounds=250, scale=0.002)
+        assert result.scaled_from == 65_536
+        # ε-convergence (1%) must be reached; the last stragglers drain
+        # through periodic anti-entropy.
+        assert result.eps_round is not None
+        assert result.convergence[-1] >= 0.995
+
+    def test_config5_split_heal_small(self):
+        result = scenarios.config5_split_heal(
+            split_rounds=80, heal_rounds=320, scale=0.0001)
+        assert result.scaled_from == 1_000_000
+        # While split, convergence must NOT complete; healing drains the
+        # backlog through the boundary (throughput-bound, hence ε).
+        split_part = result.convergence[:80]
+        assert split_part.max() < 1.0
+        assert result.eps_round is not None
+        assert result.eps_round > 80  # ε reached only after the heal
+        assert result.convergence[-1] >= 0.99
+
+
+class TestCheckpoint:
+    def make_sim(self):
+        params = SimParams(n=8, services_per_node=3, fanout=2, budget=6)
+        return ExactSim(params, topology.ring(8), FAST)
+
+    def test_round_trip(self, tmp_path):
+        sim = self.make_sim()
+        state = sim.run_fast(sim.init_state(), jax.random.PRNGKey(0), 10)
+        path = tmp_path / "ckpt.npz"
+        save_state(path, state, sim.p)
+        loaded, params = load_state(path)
+        assert params == sim.p
+        np.testing.assert_array_equal(np.asarray(loaded.known),
+                                      np.asarray(state.known))
+        assert int(loaded.round_idx) == 10
+
+    def test_chunked_resume_equals_straight_run(self, tmp_path):
+        sim = self.make_sim()
+        key = jax.random.PRNGKey(7)
+
+        straight = sim.run_fast(sim.init_state(), key, 30)
+
+        half = sim.run_fast(sim.init_state(), key, 15)
+        save_state(tmp_path / "mid.npz", half, sim.p)
+        resumed_state, params = load_state(tmp_path / "mid.npz")
+        sim2 = ExactSim(params, topology.ring(8), FAST)
+        resumed = sim2.run_fast(resumed_state, key, 15)
+
+        np.testing.assert_array_equal(np.asarray(straight.known),
+                                      np.asarray(resumed.known))
+        np.testing.assert_array_equal(np.asarray(straight.sent),
+                                      np.asarray(resumed.sent))
+
+    def test_shape_mismatch_rejected(self, tmp_path):
+        sim = self.make_sim()
+        state = sim.init_state()
+        bad = dataclasses.replace(
+            state, known=state.known[:, :4], sent=state.sent[:, :4])
+        save_state(tmp_path / "bad.npz", bad, sim.p)
+        with pytest.raises(ValueError, match="shape"):
+            load_state(tmp_path / "bad.npz")

@@ -15,18 +15,18 @@ WIDE_M = 8192  # > the 4096 threshold, forcing the two-stage branch
 BUDGET = 15
 
 
-def flat_reference(known, acc, round_idx, budget, window):
+def flat_reference(known, sent, budget, limit):
     priority = jnp.where(
-        gossip_ops.eligible_mask(acc, round_idx, window), known, 0)
+        gossip_ops.eligible_mask(sent, limit), known, 0)
     msg, svc = lax.top_k(priority, budget)
     return svc, msg
 
 
-def check_equivalent(known, acc, round_idx=5, window=4):
+def check_equivalent(known, sent, limit=8):
     svc2, msg2 = gossip_ops.select_messages(
-        jnp.asarray(known), jnp.asarray(acc), round_idx, BUDGET, window)
+        jnp.asarray(known), jnp.asarray(sent), BUDGET, limit)
     svc1, msg1 = flat_reference(
-        jnp.asarray(known), jnp.asarray(acc), round_idx, BUDGET, window)
+        jnp.asarray(known), jnp.asarray(sent), BUDGET, limit)
     # Same multiset of selected values...
     np.testing.assert_array_equal(np.sort(np.asarray(msg2), axis=1),
                                   np.sort(np.asarray(msg1), axis=1))
@@ -34,7 +34,7 @@ def check_equivalent(known, acc, round_idx=5, window=4):
     gathered = np.take_along_axis(np.asarray(known), np.asarray(svc2),
                                   axis=1)
     eligible = np.asarray(gossip_ops.eligible_mask(
-        jnp.asarray(acc), round_idx, window))
+        jnp.asarray(sent), limit))
     pri = np.where(eligible, np.asarray(known), 0)
     gathered_pri = np.take_along_axis(pri, np.asarray(svc2), axis=1)
     np.testing.assert_array_equal(
@@ -46,27 +46,28 @@ def check_equivalent(known, acc, round_idx=5, window=4):
 def test_two_stage_matches_flat_random():
     rng = np.random.default_rng(0)
     known = rng.permutation(64 * WIDE_M).astype(np.int32).reshape(64, WIDE_M)
-    acc = np.zeros((64, WIDE_M), np.int8)
-    check_equivalent(known, acc)
+    sent = np.zeros((64, WIDE_M), np.int8)
+    check_equivalent(known, sent)
 
 
 def test_two_stage_matches_flat_heavy_ties():
     rng = np.random.default_rng(1)
     # Few distinct values → massive tie pressure across groups.
     known = rng.integers(0, 7, size=(32, WIDE_M)).astype(np.int32)
-    acc = np.zeros((32, WIDE_M), np.int8)
-    check_equivalent(known, acc)
+    sent = np.zeros((32, WIDE_M), np.int8)
+    check_equivalent(known, sent)
 
 
 def test_two_stage_respects_eligibility():
     rng = np.random.default_rng(2)
     known = rng.permutation(8 * WIDE_M).astype(np.int32).reshape(8, WIDE_M)
-    acc = np.full((8, WIDE_M), 100, np.int8)  # stale stamps: ineligible
-    # Stamp exactly 7 cells per row as fresh; only those may be selected.
+    sent = np.full((8, WIDE_M), 8, np.int8)  # saturated: ineligible
+    # Keep exactly 7 cells per row below the limit; only those may be
+    # selected.
     fresh_cols = rng.choice(WIDE_M, size=7, replace=False)
-    acc[:, fresh_cols] = 5
+    sent[:, fresh_cols] = 3
     svc, msg = gossip_ops.select_messages(
-        jnp.asarray(known), jnp.asarray(acc), 6, BUDGET, 4)
+        jnp.asarray(known), jnp.asarray(sent), BUDGET, 8)
     svc, msg = np.asarray(svc), np.asarray(msg)
     for row in range(8):
         got = {int(c) for c, v in zip(svc[row], msg[row]) if v > 0}
@@ -78,19 +79,31 @@ def test_two_stage_respects_eligibility():
 def test_sparse_rows_pad_with_zero():
     known = np.zeros((4, WIDE_M), np.int32)
     known[0, 123] = 999
-    acc = np.zeros((4, WIDE_M), np.int8)
+    sent = np.zeros((4, WIDE_M), np.int8)
     svc, msg = gossip_ops.select_messages(
-        jnp.asarray(known), jnp.asarray(acc), 1, BUDGET, 4)
+        jnp.asarray(known), jnp.asarray(sent), BUDGET, 8)
     msg = np.asarray(msg)
     assert msg[0].max() == 999
     assert (msg[1:] == 0).all()
 
 
-def test_eligibility_window_boundary():
-    """A cell stamped at round r is offered for exactly `window` rounds:
-    rounds r+1 .. r+window (eligible_mask uses diff <= window)."""
-    acc = np.full((1, 8), 10, np.int8)
-    for r, want in [(11, True), (10 + 4, True), (10 + 5, False)]:
-        got = bool(np.asarray(gossip_ops.eligible_mask(
-            jnp.asarray(acc), r, 4))[0, 0])
-        assert got == want, (r, want)
+def test_transmit_accounting_saturates_and_rotates():
+    """Offered records accumulate fanout sends per round and saturate at
+    the limit, rotating fresh records into the budget (TransmitLimited)."""
+    known = jnp.asarray(
+        np.arange(1, 33, dtype=np.int32).reshape(1, 32) << 3)
+    sent = jnp.zeros((1, 32), jnp.int8)
+    limit, fanout, budget = 4, 2, 4
+    offered_rounds = []
+    for _ in range(6):
+        svc, msg = gossip_ops.select_messages(known, sent, budget, limit)
+        offered_rounds.append(set(np.asarray(svc)[0][
+            np.asarray(msg)[0] > 0].tolist()))
+        sent = gossip_ops.record_transmissions(sent, svc, msg, fanout,
+                                               limit)
+    # Top-4 freshest offered first; after limit/fanout = 2 rounds they
+    # saturate and the NEXT four freshest rotate in.
+    assert offered_rounds[0] == {28, 29, 30, 31}
+    assert offered_rounds[1] == {28, 29, 30, 31}
+    assert offered_rounds[2] == {24, 25, 26, 27}
+    assert offered_rounds[4] == {20, 21, 22, 23}

@@ -36,7 +36,7 @@ def run_both(sim, rounds, seed=0, mutate=None):
             state, changed = mutate(i, state)
             if changed:
                 oracle.known = np.asarray(state.known).copy()
-                oracle.acc = np.asarray(state.acc).astype(np.uint8).copy()
+                oracle.sent = np.asarray(state.sent).astype(np.int32).copy()
                 oracle.node_alive = np.asarray(state.node_alive).copy()
         state = sim.step(state, keys[i])
         oracle.step(keys[i])
@@ -44,8 +44,8 @@ def run_both(sim, rounds, seed=0, mutate=None):
             np.asarray(state.known), oracle.known,
             err_msg=f"known diverged at round {i + 1}")
         np.testing.assert_array_equal(
-            np.asarray(state.acc).astype(np.uint8), oracle.acc,
-            err_msg=f"acc diverged at round {i + 1}")
+            np.asarray(state.sent).astype(np.int32), oracle.sent,
+            err_msg=f"sent diverged at round {i + 1}")
     return state, oracle
 
 
