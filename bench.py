@@ -8,9 +8,10 @@ full cluster-wide gossip rounds one chip simulates per second, and
 ``vs_baseline`` is the speedup over the reference's 5 rounds/sec
 wall-clock rate (BASELINE.md north-star table).
 
-Default config: 4,096-node Erdős–Rényi-class cluster (BASELINE.json
-config 3 scale) with 10 services/node — 4096 × 40,960 packed-int32 state
-(~670 MB), fanout 3, budget 15.
+Default config: 4,096-node Erdős–Rényi cluster (BASELINE.json config 3's
+graph: avg degree 8, seed 3 — matching sim/scenarios.py) with 10
+services/node — 4096 × 40,960 packed-int32 state (~670 MB), fanout 3,
+budget 15.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -30,7 +31,7 @@ def main() -> None:
     import jax
 
     from sidecar_tpu.models.exact import ExactSim, SimParams
-    from sidecar_tpu.ops.topology import complete
+    from sidecar_tpu.ops.topology import erdos_renyi
 
     n = int(os.environ.get("BENCH_NODES", "4096"))
     spn = int(os.environ.get("BENCH_SERVICES_PER_NODE", "10"))
@@ -42,7 +43,7 @@ def main() -> None:
         n, rounds = 512, 50
 
     params = SimParams(n=n, services_per_node=spn, fanout=3, budget=15)
-    sim = ExactSim(params, complete(n))
+    sim = ExactSim(params, erdos_renyi(n, avg_degree=8.0, seed=3))
     state = sim.init_state()
     key = jax.random.PRNGKey(0)
 
