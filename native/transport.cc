@@ -2,18 +2,34 @@
 //
 // Capability mirror of the reference's external dependency
 // NinesStack/memberlist as used by Sidecar (main.go:239-274,
-// services_delegate.go): SWIM-style UDP failure detection (ping/ack with
-// suspicion), piggybacked gossip broadcast every GossipInterval packed
-// first-fit into ~1398-byte UDP packets (services_delegate.go:182-223),
-// TCP full-state push-pull anti-entropy on join and every
-// PushPullInterval (services_delegate.go:146-167), and ClusterName
-// isolation (services_delegate.go:29-32).
+// services_delegate.go): full SWIM failure detection (direct ping/ack,
+// indirect probes through k proxies, incarnation numbers with
+// refutation, membership dissemination piggybacked on gossip —
+// README.md:83-96), piggybacked gossip broadcast every GossipInterval
+// packed first-fit into ~1398-byte UDP packets
+// (services_delegate.go:182-223), TCP full-state push-pull anti-entropy
+// on join and every PushPullInterval (services_delegate.go:146-167),
+// and ClusterName isolation (services_delegate.go:29-32).
 //
 // Design: the engine runs its own threads for network IO and exposes a
 // poll-based C API (create/start/join/broadcast/poll_*) consumed from
 // Python via ctypes — no callbacks cross the language boundary, so there
 // are no GIL-reentrancy hazards.  Inbound user messages, full-state
-// payloads, and membership events are queued until the host drains them.
+// payloads, membership events, and engine diagnostics (the logging
+// bridge, logging_bridge.go:25-53) are queued until the host drains
+// them.
+//
+// Wire format v2 ("SC02").  Every packet starts with
+//   [magic u32][type u8][cluster str8][name str8][ip str8][port u16]
+//   [incarnation u32]
+// followed by a type-specific body:
+//   GOSSIP   frames: ([kind u8][len u16][payload])*   kind 0 = user
+//            payload (a service record), kind 1 = membership update
+//            [mstate u8][incarnation u32][name str8][ip str8][port u16]
+//   PING     [seq u32]
+//   ACK      [seq u32]
+//   PING_REQ [seq u32][target name str8][target ip str8][target port u16]
+//   ACK_FWD  [seq u32][target name str8]
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -29,6 +45,7 @@
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <random>
 #include <string>
@@ -41,20 +58,28 @@ using Clock = std::chrono::steady_clock;
 using Millis = std::chrono::milliseconds;
 
 // Wire constants.
-constexpr uint32_t kMagic = 0x53433031;  // "SC01"
+constexpr uint32_t kMagic = 0x53433032;  // "SC02"
 constexpr size_t kMaxPacket = 1398;      // single-UDP-packet budget
 constexpr uint8_t kTypeGossip = 0;
 constexpr uint8_t kTypePing = 1;
 constexpr uint8_t kTypeAck = 2;
+constexpr uint8_t kTypePingReq = 3;
+constexpr uint8_t kTypeAckFwd = 4;
 
-constexpr int kProbeTimeoutMs = 1000;    // ack deadline
-constexpr int kSuspectTimeoutMs = 3000;  // suspect -> dead
+constexpr uint8_t kFrameUser = 0;
+constexpr uint8_t kFrameMembership = 1;
+
+constexpr uint8_t kMemberAlive = 0;
+constexpr uint8_t kMemberSuspect = 1;
+constexpr uint8_t kMemberDead = 2;
+
 constexpr int kRetransmitMult = 4;       // memberlist RetransmitMult
 
 struct Member {
   std::string name;
   std::string ip;
   uint16_t port = 0;
+  uint32_t incarnation = 0;
   bool suspect = false;
   Clock::time_point last_heard = Clock::now();
   Clock::time_point suspect_since;
@@ -63,6 +88,23 @@ struct Member {
 struct Broadcast {
   std::string payload;
   int transmits_left = 0;
+};
+
+// Origin-side bookkeeping for an in-flight probe of one member.
+struct PendingProbe {
+  std::string target;
+  Clock::time_point direct_deadline;
+  bool indirect_sent = false;
+  Clock::time_point indirect_deadline;
+};
+
+// Proxy-side bookkeeping for one relayed ping (SWIM ping-req).
+struct Forward {
+  uint32_t origin_seq = 0;
+  std::string origin_ip;
+  uint16_t origin_port = 0;
+  std::string target_name;
+  Clock::time_point expires;
 };
 
 void put_u16(std::string* out, uint16_t v) {
@@ -102,9 +144,13 @@ bool get_str8(const uint8_t*& p, const uint8_t* end, std::string* out) {
   return true;
 }
 
-bool read_full(int fd, void* buf, size_t len) {
+// Reads with an overall deadline: the 5 s socket timeout is per-recv, so
+// a drip-feeding peer could otherwise pin a connection (and stop()'s
+// handler join) indefinitely.
+bool read_full(int fd, void* buf, size_t len, Clock::time_point deadline) {
   auto* p = static_cast<uint8_t*>(buf);
   while (len > 0) {
+    if (Clock::now() > deadline) return false;
     ssize_t n = recv(fd, p, len, 0);
     if (n <= 0) return false;
     p += n;
@@ -124,6 +170,12 @@ bool write_full(int fd, const void* buf, size_t len) {
   return true;
 }
 
+struct UdpSend {
+  std::string ip;
+  uint16_t port;
+  std::string pkt;
+};
+
 class Transport {
  public:
   Transport(std::string name, std::string cluster, std::string bind_ip,
@@ -138,16 +190,43 @@ class Transport {
         pushpull_ms_(pushpull_ms),
         gossip_nodes_(gossip_nodes),
         gossip_messages_(gossip_messages),
+        probe_interval_ms_(std::max(gossip_ms * 5, 500)),
+        probe_timeout_ms_(1000),
+        suspect_timeout_ms_(3000),
+        indirect_k_(3),
         rng_(std::random_device{}()) {}
 
   ~Transport() { stop(); }
+
+  // SWIM probe tuning (memberlist ProbeInterval/ProbeTimeout analogs).
+  void configure_probe(int interval_ms, int timeout_ms, int suspect_ms,
+                       int indirect_k) {
+    if (interval_ms > 0) probe_interval_ms_ = interval_ms;
+    if (timeout_ms > 0) probe_timeout_ms_ = timeout_ms;
+    if (suspect_ms > 0) suspect_timeout_ms_ = suspect_ms;
+    if (indirect_k >= 0) indirect_k_ = indirect_k;
+  }
+
+  // Test-only fault injection: drop received packets of the given types
+  // (bitmask by packet type) when they come from `node` — models a
+  // one-way partition without touching the network stack.
+  void test_drop_types(const std::string& node, uint32_t type_mask) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (type_mask == 0)
+      test_drops_.erase(node);
+    else
+      test_drops_[node] = type_mask;
+  }
 
   // Binds sockets and launches the IO threads.  Returns the actual bound
   // port (0 input picks an ephemeral port) or -1 on failure.
   int start() {
     udp_fd_ = socket(AF_INET, SOCK_DGRAM, 0);
     tcp_fd_ = socket(AF_INET, SOCK_STREAM, 0);
-    if (udp_fd_ < 0 || tcp_fd_ < 0) return -1;
+    if (udp_fd_ < 0 || tcp_fd_ < 0) {
+      logf('E', "socket() failed");
+      return -1;
+    }
     int one = 1;
     setsockopt(tcp_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
 
@@ -156,16 +235,20 @@ class Transport {
     addr.sin_port = htons(bind_port_);
     addr.sin_addr.s_addr =
         bind_ip_.empty() ? INADDR_ANY : inet_addr(bind_ip_.c_str());
-    if (bind(udp_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+    if (bind(udp_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+      logf('E', "udp bind failed on " + bind_ip_);
       return -1;
+    }
 
     socklen_t len = sizeof(addr);
     getsockname(udp_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
     bind_port_ = ntohs(addr.sin_port);  // both protocols share the port
 
     sockaddr_in taddr = addr;
-    if (bind(tcp_fd_, reinterpret_cast<sockaddr*>(&taddr), sizeof(taddr)) < 0)
+    if (bind(tcp_fd_, reinterpret_cast<sockaddr*>(&taddr), sizeof(taddr)) < 0) {
+      logf('E', "tcp bind failed");
       return -1;
+    }
     if (listen(tcp_fd_, 16) < 0) return -1;
 
     // 500 ms recv timeout so loops notice quit_.
@@ -173,7 +256,14 @@ class Transport {
     setsockopt(udp_fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     setsockopt(tcp_fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
 
+    header_overhead_ = packet_header(kTypeGossip).size();
     quit_ = false;
+    // Announce ourselves so dissemination introduces us transitively.
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      queue_membership_locked(kMemberAlive, incarnation_, name_,
+                              advertise_ip_, bind_port_);
+    }
     threads_.emplace_back(&Transport::udp_loop, this);
     threads_.emplace_back(&Transport::gossip_loop, this);
     threads_.emplace_back(&Transport::probe_loop, this);
@@ -184,9 +274,22 @@ class Transport {
 
   void stop() {
     if (quit_.exchange(true)) return;
+    // Unblock accept() promptly; the loops also poll quit_ on their
+    // 500 ms socket timeouts.
+    if (tcp_fd_ >= 0) shutdown(tcp_fd_, SHUT_RDWR);
     for (auto& t : threads_)
       if (t.joinable()) t.join();
     threads_.clear();
+    // Join in-flight push-pull connection handlers: they reference this
+    // object (mutex, queues, local_state_), so the Transport must not be
+    // torn down under them.  Shut their sockets down first so a
+    // mid-exchange peer can't pin the join (recv returns immediately).
+    {
+      std::lock_guard<std::mutex> lk(handlers_mu_);
+      for (auto& h : handlers_)
+        if (!h.done->load() && h.fd >= 0) shutdown(h.fd, SHUT_RDWR);
+    }
+    reap_handlers(/*join_all=*/true);
     if (udp_fd_ >= 0) close(udp_fd_);
     if (tcp_fd_ >= 0) close(tcp_fd_);
     udp_fd_ = tcp_fd_ = -1;
@@ -199,12 +302,17 @@ class Transport {
 
   void broadcast(const uint8_t* data, size_t len) {
     std::lock_guard<std::mutex> lk(mu_);
-    int n_members = static_cast<int>(members_.size()) + 1;
-    int limit = kRetransmitMult *
-                static_cast<int>(std::ceil(std::log10(n_members + 1)));
+    // A frame that can never fit in one packet would sit in the queue
+    // forever without ever being transmitted (its transmit count never
+    // moved) — drop it loudly instead; push-pull still carries it.
+    if (header_overhead_ + 3 + len > kMaxPacket) {
+      logf('W', "dropping oversized broadcast (" + std::to_string(len) +
+                    " bytes > packet budget); push-pull will carry it");
+      return;
+    }
     queue_.push_back(
         {std::string(reinterpret_cast<const char*>(data), len),
-         std::max(limit, 1)});
+         transmit_limit_locked()});
     // MAX_PENDING-ish bound so a partitioned node doesn't grow forever.
     while (queue_.size() > 4096) queue_.pop_front();
   }
@@ -227,6 +335,22 @@ class Transport {
   std::string poll_state() { return poll(&states_); }
   std::string poll_event() { return poll(&events_); }
 
+  std::string poll_log() {
+    std::lock_guard<std::mutex> lk(log_mu_);
+    if (logs_.empty()) return {};
+    std::string out = std::move(logs_.front());
+    logs_.pop_front();
+    return out;
+  }
+
+  // Size of the next queued full-state payload (0 when drained) so the
+  // host can size its buffer — a fixed cap would silently truncate a
+  // large cluster's push-pull and fail every decode.
+  int next_state_len() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return states_.empty() ? 0 : static_cast<int>(states_.front().size());
+  }
+
   std::string members_list() {
     std::lock_guard<std::mutex> lk(mu_);
     std::string out = name_ + "\n";
@@ -237,6 +361,21 @@ class Transport {
   uint16_t port() const { return bind_port_; }
 
  private:
+  // -- diagnostics (the logging bridge) -----------------------------------
+
+  void logf(char level, const std::string& msg) {
+    std::lock_guard<std::mutex> lk(log_mu_);
+    logs_.push_back(std::string(1, level) + "|" + msg);
+    while (logs_.size() > 4096) logs_.pop_front();
+  }
+
+  int transmit_limit_locked() const {
+    int n_members = static_cast<int>(members_.size()) + 1;
+    int limit = kRetransmitMult *
+                static_cast<int>(std::ceil(std::log10(n_members + 1)));
+    return std::max(limit, 1);
+  }
+
   // -- packet building ---------------------------------------------------
 
   std::string packet_header(uint8_t type) {
@@ -247,29 +386,35 @@ class Transport {
     put_str8(&out, name_);
     put_str8(&out, advertise_ip_);
     put_u16(&out, bind_port_);
+    put_u32(&out, incarnation_.load());
     return out;
   }
 
   // First-fit packing of queued broadcasts into one UDP packet
-  // (packPacket, services_delegate.go:182-223).
+  // (packPacket, services_delegate.go:182-223).  Membership updates go
+  // first — failure information must not queue behind catalog traffic.
   std::string build_gossip_packet() {
     std::string pkt = packet_header(kTypeGossip);
     std::lock_guard<std::mutex> lk(mu_);
     int packed = 0;
-    for (auto it = queue_.begin();
-         it != queue_.end() && packed < gossip_messages_;) {
-      size_t frame = 2 + it->payload.size();
-      if (pkt.size() + frame > kMaxPacket) {
-        ++it;
-        continue;  // first-fit: try a smaller one
+    for (std::deque<Broadcast>* q : {&mqueue_, &queue_}) {
+      uint8_t kind = (q == &mqueue_) ? kFrameMembership : kFrameUser;
+      for (auto it = q->begin();
+           it != q->end() && packed < gossip_messages_;) {
+        size_t frame = 3 + it->payload.size();
+        if (pkt.size() + frame > kMaxPacket) {
+          ++it;
+          continue;  // first-fit: try a smaller one
+        }
+        pkt.push_back(static_cast<char>(kind));
+        put_u16(&pkt, static_cast<uint16_t>(it->payload.size()));
+        pkt += it->payload;
+        ++packed;
+        if (--it->transmits_left <= 0)
+          it = q->erase(it);
+        else
+          ++it;
       }
-      put_u16(&pkt, static_cast<uint16_t>(it->payload.size()));
-      pkt += it->payload;
-      ++packed;
-      if (--it->transmits_left <= 0)
-        it = queue_.erase(it);
-      else
-        ++it;
     }
     if (packed == 0) return {};
     return pkt;
@@ -285,35 +430,137 @@ class Transport {
            reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
   }
 
-  std::vector<Member> pick_members(int k) {
+  std::vector<Member> pick_members(int k, const std::string& exclude = "") {
     std::lock_guard<std::mutex> lk(mu_);
     std::vector<Member> all;
     all.reserve(members_.size());
-    for (auto& kv : members_) all.push_back(kv.second);
+    for (auto& kv : members_)
+      if (kv.first != exclude) all.push_back(kv.second);
     std::shuffle(all.begin(), all.end(), rng_);
     if (static_cast<int>(all.size()) > k) all.resize(k);
     return all;
   }
 
-  // -- member accounting -------------------------------------------------
+  // -- member accounting --------------------------------------------------
 
   void heard_from(const std::string& node, const std::string& ip,
-                  uint16_t port) {
+                  uint16_t port, uint32_t incarnation) {
     if (node == name_) return;
     std::lock_guard<std::mutex> lk(mu_);
+    // Direct traffic from a node we declared dead is authoritative (the
+    // node itself is provably back — e.g. a restart rejoining via
+    // push-pull); only third-party gossip is watermark-gated.
+    dead_.erase(node);
     auto it = members_.find(node);
     if (it == members_.end()) {
-      members_[node] = {node, ip, port, false, Clock::now(), {}};
+      Member m{node, ip, port, incarnation, false, Clock::now(), {}};
+      members_[node] = m;
       events_.push_back("join " + node + " " + ip);
+      // Disseminate the discovery so the rest of the cluster learns the
+      // new member transitively (memberlist aliveNode broadcast).
+      queue_membership_locked(kMemberAlive, incarnation, node, ip, port);
     } else {
       it->second.last_heard = Clock::now();
-      it->second.suspect = false;
+      it->second.suspect = false;  // direct traffic: clearly alive
       it->second.ip = ip;
       it->second.port = port;
+      if (incarnation > it->second.incarnation)
+        it->second.incarnation = incarnation;
     }
   }
 
-  // -- IO loops ----------------------------------------------------------
+  void mark_dead_locked(const std::string& node, uint32_t inc) {
+    auto& wm = dead_[node];
+    wm = std::max(wm, inc);
+    while (dead_.size() > 4096) dead_.erase(dead_.begin());
+  }
+
+  void queue_membership_locked(uint8_t mstate, uint32_t inc,
+                               const std::string& node,
+                               const std::string& ip, uint16_t port) {
+    std::string pl;
+    pl.push_back(static_cast<char>(mstate));
+    put_u32(&pl, inc);
+    put_str8(&pl, node);
+    put_str8(&pl, ip);
+    put_u16(&pl, port);
+    mqueue_.push_back({std::move(pl), transmit_limit_locked()});
+    while (mqueue_.size() > 1024) mqueue_.pop_front();
+  }
+
+  // SWIM membership state machine (alive/suspect/dead with incarnation
+  // ordering; refutation for claims about ourselves).
+  void handle_membership(uint8_t mstate, uint32_t inc,
+                         const std::string& node, const std::string& ip,
+                         uint16_t port) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (node == name_) {
+      // A claim about US.  Suspect/dead with a current-or-newer
+      // incarnation must be refuted: bump our incarnation and broadcast
+      // alive (memberlist refutation, README.md:83-96).
+      if ((mstate == kMemberSuspect || mstate == kMemberDead) &&
+          inc >= incarnation_.load()) {
+        incarnation_.store(inc + 1);
+        queue_membership_locked(kMemberAlive, inc + 1, name_,
+                                advertise_ip_, bind_port_);
+        logf('I', "refuting " +
+                      std::string(mstate == kMemberDead ? "death"
+                                                        : "suspicion") +
+                      " with incarnation " + std::to_string(inc + 1));
+      }
+      return;
+    }
+
+    auto it = members_.find(node);
+    switch (mstate) {
+      case kMemberAlive:
+        if (it == members_.end()) {
+          // Incarnation watermark: stale alive frames still circulating
+          // after a death must not resurrect the member (ghost churn);
+          // only an alive NEWER than the death certificate readmits.
+          auto dit = dead_.find(node);
+          if (dit != dead_.end()) {
+            if (inc <= dit->second) break;
+            dead_.erase(dit);
+          }
+          members_[node] = {node, ip, port, inc, false, Clock::now(), {}};
+          events_.push_back("join " + node + " " + ip);
+          queue_membership_locked(kMemberAlive, inc, node, ip, port);
+        } else if (inc > it->second.incarnation) {
+          it->second.incarnation = inc;
+          it->second.last_heard = Clock::now();
+          if (it->second.suspect) {
+            it->second.suspect = false;
+            logf('I', node + " refuted suspicion (incarnation " +
+                          std::to_string(inc) + ")");
+          }
+          queue_membership_locked(kMemberAlive, inc, node, ip, port);
+        }
+        break;
+      case kMemberSuspect:
+        if (it != members_.end() && inc >= it->second.incarnation &&
+            !it->second.suspect) {
+          it->second.suspect = true;
+          it->second.suspect_since = Clock::now();
+          queue_membership_locked(kMemberSuspect, inc, node,
+                                  it->second.ip, it->second.port);
+        }
+        break;
+      case kMemberDead:
+        if (it != members_.end() && inc >= it->second.incarnation) {
+          members_.erase(it);
+          mark_dead_locked(node, inc);
+          events_.push_back("leave " + node);
+          queue_membership_locked(kMemberDead, inc, node, ip, port);
+          logf('I', node + " declared dead via gossip");
+        }
+        break;
+      default:
+        break;
+    }
+  }
+
+  // -- IO loops -----------------------------------------------------------
 
   void udp_loop() {
     std::vector<uint8_t> buf(65536);
@@ -330,75 +577,281 @@ class Transport {
       p += 5;
       std::string cluster, node, ip;
       if (!get_str8(p, end, &cluster) || !get_str8(p, end, &node) ||
-          !get_str8(p, end, &ip) || p + 2 > end)
+          !get_str8(p, end, &ip) || p + 6 > end)
         continue;
       uint16_t port = get_u16(p);
       p += 2;
+      uint32_t inc = get_u32(p);
+      p += 4;
       // ClusterName isolation (services_delegate.go:29-32).
       if (cluster != cluster_) continue;
-      heard_from(node, ip, port);
-
-      if (type == kTypePing) {
-        std::string ack = packet_header(kTypeAck);
-        send_to(ip, port, ack);
-      } else if (type == kTypeGossip) {
-        while (p + 2 <= end) {
-          uint16_t flen = get_u16(p);
-          p += 2;
-          if (p + flen > end) break;
-          std::lock_guard<std::mutex> lk(mu_);
-          inbound_.emplace_back(reinterpret_cast<const char*>(p), flen);
-          if (inbound_.size() > 65536) inbound_.pop_front();
-          p += flen;
-        }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto dit = test_drops_.find(node);
+        if (dit != test_drops_.end() && (dit->second >> type) & 1u)
+          continue;
       }
-      // kTypeAck: heard_from already refreshed liveness.
+      heard_from(node, ip, port, inc);
+
+      std::vector<UdpSend> sends;
+      switch (type) {
+        case kTypePing: {
+          if (p + 4 > end) break;
+          uint32_t seq = get_u32(p);
+          std::string ack = packet_header(kTypeAck);
+          put_u32(&ack, seq);
+          sends.push_back({ip, port, std::move(ack)});
+          break;
+        }
+        case kTypeAck: {
+          if (p + 4 > end) break;
+          uint32_t seq = get_u32(p);
+          std::lock_guard<std::mutex> lk(mu_);
+          // The ack proves its SENDER is alive: clear every outstanding
+          // probe of that node, not just the acked seq — overlapping
+          // probes of one target would otherwise fire a stale suspicion
+          // after a successful rescue.
+          for (auto it = pending_.begin(); it != pending_.end();)
+            it = (it->second.target == node || it->first == seq)
+                     ? pending_.erase(it)
+                     : ++it;
+          auto fit = forwards_.find(seq);
+          if (fit != forwards_.end()) {
+            // We relayed this ping for someone: forward the ack.
+            std::string fwd = packet_header(kTypeAckFwd);
+            put_u32(&fwd, fit->second.origin_seq);
+            put_str8(&fwd, fit->second.target_name);
+            sends.push_back({fit->second.origin_ip,
+                             fit->second.origin_port, std::move(fwd)});
+            forwards_.erase(fit);
+          }
+          break;
+        }
+        case kTypePingReq: {
+          if (p + 4 > end) break;
+          uint32_t origin_seq = get_u32(p);
+          p += 4;
+          std::string tname, tip;
+          if (!get_str8(p, end, &tname) || !get_str8(p, end, &tip) ||
+              p + 2 > end)
+            break;
+          uint16_t tport = get_u16(p);
+          uint32_t myseq = next_seq_++;
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            forwards_[myseq] = {origin_seq, ip, port, tname,
+                               Clock::now() + Millis(5000)};
+          }
+          std::string ping = packet_header(kTypePing);
+          put_u32(&ping, myseq);
+          sends.push_back({tip, tport, std::move(ping)});
+          break;
+        }
+        case kTypeAckFwd: {
+          if (p + 4 > end) break;
+          uint32_t seq = get_u32(p);
+          p += 4;
+          std::string tname;
+          if (!get_str8(p, end, &tname)) break;
+          std::lock_guard<std::mutex> lk(mu_);
+          // The relayed ack proves the TARGET is alive: clear all of its
+          // outstanding probes (same reasoning as the direct-ack case).
+          for (auto it = pending_.begin(); it != pending_.end();)
+            it = (it->second.target == tname || it->first == seq)
+                     ? pending_.erase(it)
+                     : ++it;
+          auto mit = members_.find(tname);
+          if (mit != members_.end()) {
+            mit->second.last_heard = Clock::now();
+            mit->second.suspect = false;
+          }
+          break;
+        }
+        case kTypeGossip: {
+          while (p + 3 <= end) {
+            uint8_t kind = *p++;
+            uint16_t flen = get_u16(p);
+            p += 2;
+            if (p + flen > end) break;
+            if (kind == kFrameUser) {
+              std::lock_guard<std::mutex> lk(mu_);
+              inbound_.emplace_back(reinterpret_cast<const char*>(p), flen);
+              if (inbound_.size() > 65536) inbound_.pop_front();
+            } else if (kind == kFrameMembership) {
+              const uint8_t* fp = p;
+              const uint8_t* fend = p + flen;
+              if (fp + 5 <= fend) {
+                uint8_t mstate = *fp++;
+                uint32_t minc = get_u32(fp);
+                fp += 4;
+                std::string mnode, mip;
+                if (get_str8(fp, fend, &mnode) &&
+                    get_str8(fp, fend, &mip) && fp + 2 <= fend) {
+                  uint16_t mport = get_u16(fp);
+                  handle_membership(mstate, minc, mnode, mip, mport);
+                }
+              }
+            }
+            p += flen;
+          }
+          break;
+        }
+        default:
+          break;
+      }
+      for (auto& s : sends) send_to(s.ip, s.port, s.pkt);
     }
   }
 
   void gossip_loop() {
     while (!quit_) {
       std::this_thread::sleep_for(Millis(gossip_ms_));
+      // Building a packet consumes transmit counts — don't burn queued
+      // broadcasts (e.g. our own join announcement) into the void while
+      // the member list is still empty.
+      auto targets = pick_members(gossip_nodes_);
+      if (targets.empty()) continue;
       std::string pkt = build_gossip_packet();
       if (pkt.empty()) continue;
-      for (auto& m : pick_members(gossip_nodes_)) send_to(m.ip, m.port, pkt);
+      for (auto& m : targets) send_to(m.ip, m.port, pkt);
     }
   }
 
+  // The SWIM probe cycle: direct ping → (timeout) → indirect ping-req
+  // through up to k proxies → (timeout) → suspect + broadcast →
+  // (suspect timeout without refutation) → dead + broadcast.
   void probe_loop() {
     while (!quit_) {
-      std::this_thread::sleep_for(Millis(std::max(gossip_ms_ * 5, 500)));
-      auto targets = pick_members(1);
-      if (!targets.empty()) {
-        std::string ping = packet_header(kTypePing);
-        send_to(targets[0].ip, targets[0].port, ping);
-      }
-      // Sweep: probe timeouts -> suspect -> dead (SWIM-lite; the
-      // reference's NotifyLeave -> ExpireServer path).
-      std::vector<std::string> dead;
+      std::this_thread::sleep_for(Millis(probe_interval_ms_));
+      auto now = Clock::now();
+      std::vector<UdpSend> sends;
+      std::vector<std::pair<std::string, Member>> need_indirect;
+
       {
         std::lock_guard<std::mutex> lk(mu_);
-        auto now = Clock::now();
-        for (auto it = members_.begin(); it != members_.end();) {
-          auto& m = it->second;
-          auto quiet = std::chrono::duration_cast<Millis>(
-                           now - m.last_heard).count();
-          if (!m.suspect && quiet > kProbeTimeoutMs + gossip_ms_ * 10) {
-            m.suspect = true;
-            m.suspect_since = now;
+        // Expire stale proxy bookkeeping.
+        for (auto it = forwards_.begin(); it != forwards_.end();)
+          it = (now > it->second.expires) ? forwards_.erase(it) : ++it;
+
+        for (auto it = pending_.begin(); it != pending_.end();) {
+          PendingProbe& pr = it->second;
+          auto mit = members_.find(pr.target);
+          if (mit == members_.end()) {
+            it = pending_.erase(it);
+            continue;
           }
+          if (!pr.indirect_sent && now > pr.direct_deadline) {
+            pr.indirect_sent = true;
+            pr.indirect_deadline = now + Millis(probe_timeout_ms_);
+            need_indirect.push_back({pr.target, mit->second});
+            ++it;
+          } else if (pr.indirect_sent && now > pr.indirect_deadline) {
+            // No direct or relayed ack: suspicion.
+            Member& m = mit->second;
+            if (!m.suspect) {
+              m.suspect = true;
+              m.suspect_since = now;
+              queue_membership_locked(kMemberSuspect, m.incarnation,
+                                      m.name, m.ip, m.port);
+              logf('I', "suspecting " + m.name +
+                            " (no ack, direct or indirect)");
+            }
+            it = pending_.erase(it);
+          } else {
+            ++it;
+          }
+        }
+
+        // Suspect → dead after the (refutable) suspicion window.
+        std::vector<std::string> dead;
+        for (auto it = members_.begin(); it != members_.end();) {
+          Member& m = it->second;
           if (m.suspect &&
               std::chrono::duration_cast<Millis>(now - m.suspect_since)
-                      .count() > kSuspectTimeoutMs) {
-            dead.push_back(it->first);
+                      .count() > suspect_timeout_ms_) {
+            dead.push_back(m.name);
+            mark_dead_locked(m.name, m.incarnation);
+            queue_membership_locked(kMemberDead, m.incarnation, m.name,
+                                    m.ip, m.port);
             it = members_.erase(it);
             continue;
           }
           ++it;
         }
-        for (auto& d : dead) events_.push_back("leave " + d);
+        for (auto& d : dead) {
+          events_.push_back("leave " + d);
+          logf('I', d + " failed (suspect timeout); declared dead");
+        }
+      }
+
+      // Fire the queued indirect probes (pick proxies outside the probe
+      // bookkeeping pass; sends happen outside the lock).
+      for (auto& [tname, target] : need_indirect) {
+        uint32_t origin_seq = 0;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          for (auto& kv : pending_)
+            if (kv.second.target == tname) origin_seq = kv.first;
+        }
+        for (auto& proxy : pick_members(indirect_k_, tname)) {
+          std::string req = packet_header(kTypePingReq);
+          put_u32(&req, origin_seq);
+          put_str8(&req, target.name);
+          put_str8(&req, target.ip);
+          put_u16(&req, target.port);
+          sends.push_back({proxy.ip, proxy.port, std::move(req)});
+        }
+      }
+
+      // Start a fresh direct probe of one random member — unless that
+      // member already has a probe in flight (overlapping probes of one
+      // target confuse the rescue bookkeeping and double suspicion).
+      auto targets = pick_members(1);
+      if (!targets.empty()) {
+        bool already = false;
+        uint32_t seq = next_seq_++;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          for (auto& kv : pending_)
+            if (kv.second.target == targets[0].name) already = true;
+          if (!already)
+            pending_[seq] = {targets[0].name,
+                             now + Millis(probe_timeout_ms_), false, {}};
+        }
+        if (!already) {
+          std::string ping = packet_header(kTypePing);
+          put_u32(&ping, seq);
+          sends.push_back(
+              {targets[0].ip, targets[0].port, std::move(ping)});
+        }
+      }
+      for (auto& s : sends) send_to(s.ip, s.port, s.pkt);
+    }
+  }
+
+  // -- TCP push-pull ------------------------------------------------------
+
+  struct Handler {
+    std::thread thread;
+    std::shared_ptr<std::atomic<bool>> done;
+    int fd = -1;
+  };
+
+  void reap_handlers(bool join_all) {
+    std::vector<std::thread> to_join;
+    {
+      std::lock_guard<std::mutex> lk(handlers_mu_);
+      for (auto it = handlers_.begin(); it != handlers_.end();) {
+        if (join_all || it->done->load()) {
+          to_join.push_back(std::move(it->thread));
+          it = handlers_.erase(it);
+        } else {
+          ++it;
+        }
       }
     }
+    for (auto& t : to_join)
+      if (t.joinable()) t.join();
   }
 
   void tcp_accept_loop() {
@@ -406,17 +859,29 @@ class Transport {
       sockaddr_in src{};
       socklen_t slen = sizeof(src);
       int fd = accept(tcp_fd_, reinterpret_cast<sockaddr*>(&src), &slen);
+      reap_handlers(/*join_all=*/false);
       if (fd < 0) continue;
-      std::thread([this, fd] {
+      // Bound the handler's lifetime: a peer that stalls mid-exchange
+      // times out instead of pinning the thread (and stop()'s join).
+      timeval tv{5, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      auto done = std::make_shared<std::atomic<bool>>(false);
+      std::thread t([this, fd, done] {
         handle_pushpull_conn(fd);
+        done->store(true);
+        // Close under handlers_mu_ so stop()'s shutdown of still-running
+        // handlers can never race a reused descriptor.
+        std::lock_guard<std::mutex> lk(handlers_mu_);
         close(fd);
-      }).detach();
+      });
+      std::lock_guard<std::mutex> lk(handlers_mu_);
+      handlers_.push_back({std::move(t), std::move(done), fd});
     }
   }
 
   // Framed state exchange: both sides send
-  //   [magic u32][cluster str8][name str8][ip str8][port u16]
-  //   [state_len u32][state bytes]
+  //   [header][state_len u32][state bytes]
   void send_state_frame(int fd) {
     std::string hdr = packet_header(kTypeGossip);
     std::string state;
@@ -433,28 +898,37 @@ class Transport {
   }
 
   bool recv_state_frame(int fd) {
+    // Whole-exchange deadline (see read_full).
+    auto deadline = Clock::now() + Millis(30000);
     uint8_t fixed[5];
-    if (!read_full(fd, fixed, 5) || get_u32(fixed) != kMagic) return false;
+    if (!read_full(fd, fixed, 5, deadline) || get_u32(fixed) != kMagic)
+      return false;
     auto read_str8 = [&](std::string* out) {
       uint8_t n;
-      if (!read_full(fd, &n, 1)) return false;
+      if (!read_full(fd, &n, 1, deadline)) return false;
       out->resize(n);
-      return n == 0 || read_full(fd, &(*out)[0], n);
+      return n == 0 || read_full(fd, &(*out)[0], n, deadline);
     };
     std::string cluster, node, ip;
-    uint8_t pbuf[2];
+    uint8_t pbuf[6];
     if (!read_str8(&cluster) || !read_str8(&node) || !read_str8(&ip) ||
-        !read_full(fd, pbuf, 2))
+        !read_full(fd, pbuf, 6, deadline))
       return false;
-    uint16_t port = get_u16(pbuf);
-    uint8_t lbuf[4];
-    if (!read_full(fd, lbuf, 4)) return false;
-    uint32_t slen = get_u32(lbuf);
-    if (slen > (64u << 20)) return false;  // sanity cap: 64 MB
-    std::string state(slen, '\0');
-    if (slen > 0 && !read_full(fd, &state[0], slen)) return false;
+    // Cluster isolation BEFORE the payload: a foreign (or hostile) peer
+    // must not get to size our allocation.
     if (cluster != cluster_) return false;
-    heard_from(node, ip, port);
+    uint16_t port = get_u16(pbuf);
+    uint32_t inc = get_u32(pbuf + 2);
+    uint8_t lbuf[4];
+    if (!read_full(fd, lbuf, 4, deadline)) return false;
+    uint32_t slen = get_u32(lbuf);
+    if (slen > (64u << 20)) {  // sanity cap: 64 MB
+      logf('E', "push-pull state from " + node + " exceeds 64 MB; dropped");
+      return false;
+    }
+    std::string state(slen, '\0');
+    if (slen > 0 && !read_full(fd, &state[0], slen, deadline)) return false;
+    heard_from(node, ip, port, inc);
     if (!state.empty()) {
       std::lock_guard<std::mutex> lk(mu_);
       states_.push_back(std::move(state));
@@ -481,6 +955,7 @@ class Transport {
     addr.sin_addr.s_addr = inet_addr(host.c_str());
     if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
       close(fd);
+      logf('W', "push-pull connect to " + host + " failed");
       return false;
     }
     send_state_frame(fd);
@@ -507,15 +982,29 @@ class Transport {
   std::string name_, cluster_, bind_ip_, advertise_ip_;
   uint16_t bind_port_;
   int gossip_ms_, pushpull_ms_, gossip_nodes_, gossip_messages_;
+  int probe_interval_ms_, probe_timeout_ms_, suspect_timeout_ms_,
+      indirect_k_;
+  size_t header_overhead_ = 64;
   int udp_fd_ = -1, tcp_fd_ = -1;
   std::atomic<bool> quit_{true};
+  std::atomic<uint32_t> incarnation_{1};
+  std::atomic<uint32_t> next_seq_{1};
   std::vector<std::thread> threads_;
   std::mutex mu_;
   std::map<std::string, Member> members_;
-  std::deque<Broadcast> queue_;
+  std::deque<Broadcast> queue_;    // user payloads
+  std::deque<Broadcast> mqueue_;   // membership updates (priority)
   std::deque<std::string> inbound_, states_, events_;
+  std::map<uint32_t, PendingProbe> pending_;
+  std::map<uint32_t, Forward> forwards_;
+  std::map<std::string, uint32_t> dead_;  // death-cert incarnation marks
+  std::map<std::string, uint32_t> test_drops_;
   std::string local_state_;
   std::mt19937 rng_;
+  std::mutex handlers_mu_;
+  std::vector<Handler> handlers_;
+  std::mutex log_mu_;
+  std::deque<std::string> logs_;
 };
 
 int copy_out(const std::string& s, uint8_t* buf, int cap) {
@@ -557,9 +1046,26 @@ void st_set_local_state(void* h, const uint8_t* data, int len) {
   static_cast<Transport*>(h)->set_local_state(data, (size_t)len);
 }
 
+void st_configure_probe(void* h, int interval_ms, int timeout_ms,
+                        int suspect_ms, int indirect_k) {
+  if (!h) return;
+  static_cast<Transport*>(h)->configure_probe(interval_ms, timeout_ms,
+                                              suspect_ms, indirect_k);
+}
+
+void st_test_drop_types(void* h, const char* node, unsigned type_mask) {
+  if (!h) return;
+  static_cast<Transport*>(h)->test_drop_types(node, type_mask);
+}
+
 int st_poll_msg(void* h, uint8_t* buf, int cap) {
   if (!h) return 0;
   return copy_out(static_cast<Transport*>(h)->poll_msg(), buf, cap);
+}
+
+int st_next_state_len(void* h) {
+  if (!h) return 0;
+  return static_cast<Transport*>(h)->next_state_len();
 }
 
 int st_poll_state(void* h, uint8_t* buf, int cap) {
@@ -570,6 +1076,11 @@ int st_poll_state(void* h, uint8_t* buf, int cap) {
 int st_poll_event(void* h, uint8_t* buf, int cap) {
   if (!h) return 0;
   return copy_out(static_cast<Transport*>(h)->poll_event(), buf, cap);
+}
+
+int st_poll_log(void* h, uint8_t* buf, int cap) {
+  if (!h) return 0;
+  return copy_out(static_cast<Transport*>(h)->poll_log(), buf, cap);
 }
 
 int st_members(void* h, uint8_t* buf, int cap) {

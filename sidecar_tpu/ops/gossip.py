@@ -148,11 +148,14 @@ def select_messages(known, sent, budget, limit):
     gsel = pos // sub
     off = pos % sub
     svc_idx = jnp.take_along_axis(top_g, gsel, axis=1) * sub + off
-    # Padding cells carry priority 0 (merge no-op); their indices may lie
-    # past M-1, which every consumer drops (scatter mode="drop") or
-    # ignores (msg == 0 short-circuits).  Clamp anyway so gathers stay in
-    # bounds.
-    svc_idx = jnp.minimum(svc_idx, m - 1)
+    # Padded slots (priority 0 — merge no-ops) must not alias a real
+    # column: clamping them to m-1 would let a padded .set land on the
+    # same cell as a genuine selection of column m-1 (duplicate scatter
+    # indices resolve nondeterministically), silently losing that cell's
+    # transmit-count bump.  Map them PAST the row end instead — scatters
+    # drop them (mode="drop") and gathers clamp to a value the 0 msg
+    # never beats.  Genuine selections (msg > 0) always index < m.
+    svc_idx = jnp.where(msg > 0, svc_idx, m)
     return svc_idx.astype(jnp.int32), msg
 
 

@@ -39,6 +39,30 @@ TYPE_ENDPOINT = ("type.googleapis.com/"
                  "envoy.config.endpoint.v3.ClusterLoadAssignment")
 TYPE_LISTENER = "type.googleapis.com/envoy.config.listener.v3.Listener"
 
+# Name of the static cluster an Envoy bootstrap must define pointing at
+# this control plane; generated REST eds_configs reference it.
+XDS_CLUSTER_NAME = "sidecar_xds"
+
+
+def _eds_config(eds_mode: str) -> dict:
+    """EDS source stanza matching the serving transport.  A cluster that
+    declares ``{"ads": {}}`` but is served over REST never resolves its
+    endpoints (Envoy waits for an ADS stream that doesn't exist), so the
+    REST path must emit an api_config_source instead."""
+    if eds_mode == "ads":
+        return {"ads": {}, "resource_api_version": "V3"}
+    if eds_mode == "rest":
+        return {
+            "resource_api_version": "V3",
+            "api_config_source": {
+                "api_type": "REST",
+                "transport_api_version": "V3",
+                "cluster_names": [XDS_CLUSTER_NAME],
+                "refresh_delay": "1s",
+            },
+        }
+    raise ValueError(f"unknown eds_mode {eds_mode!r} (want 'ads' or 'rest')")
+
 _last_logged_port_collision = 0.0
 
 
@@ -159,7 +183,8 @@ def _listener_from_service(svc: Service, envoy_name: str, svc_port: int,
 
 
 def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
-                         use_hostnames: bool = False) -> EnvoyResources:
+                         use_hostnames: bool = False,
+                         eds_mode: str = "rest") -> EnvoyResources:
     """Full resource set from the catalog (adapter.go:108-212).
 
     The port-collision guard gives each ServicePort to the first (oldest,
@@ -208,10 +233,7 @@ def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
                     "connect_timeout": "0.500s",
                     "type": "EDS",
                     "eds_cluster_config": {
-                        "eds_config": {
-                            "ads": {},
-                            "resource_api_version": "V3",
-                        },
+                        "eds_config": _eds_config(eds_mode),
                     },
                 }
             if envoy_name not in listener_map:
@@ -385,7 +407,7 @@ class XdsServer:
         if self.state.last_changed == self._last_changed:
             return False
         resources = resources_from_state(
-            self.state, self.bind_ip, self.use_hostnames)
+            self.state, self.bind_ip, self.use_hostnames, eds_mode="rest")
         with self._lock:
             self._snapshot = resources
             self._version = str(time.time_ns())

@@ -143,7 +143,18 @@ class Receiver:
 
     def stop(self) -> None:
         self.looper.quit()
-        self.reload_chan.put(None)  # type: ignore[arg-type]
+        # Non-blocking sentinel delivery: a full queue means process_updates
+        # has work pending (or already stopped) — drain one entry and retry
+        # so stop() can never hang on the bounded channel.
+        while True:
+            try:
+                self.reload_chan.put_nowait(None)  # type: ignore[arg-type]
+                return
+            except queue.Full:
+                try:
+                    self.reload_chan.get_nowait()
+                except queue.Empty:
+                    pass
 
     # -- bootstrap ---------------------------------------------------------
 

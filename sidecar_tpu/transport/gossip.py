@@ -38,15 +38,29 @@ _lib_lock = threading.Lock()
 
 
 def load_native() -> ctypes.CDLL:
-    """Load (building if needed) the native transport library."""
+    """Load (building or rebuilding if stale) the native transport
+    library."""
     global _lib
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not _SO_PATH.exists():
+        src = _NATIVE_DIR / "transport.cc"
+        if not _SO_PATH.exists() or (
+                src.exists()
+                and src.stat().st_mtime > _SO_PATH.stat().st_mtime):
             log.info("Building native transport library...")
-            subprocess.run(["make"], cwd=str(_NATIVE_DIR),
-                           capture_output=True, check=True)
+            result = subprocess.run(["make"], cwd=str(_NATIVE_DIR),
+                                    capture_output=True)
+            if result.returncode != 0:
+                err = result.stderr.decode(errors="replace")
+                if _SO_PATH.exists():
+                    # Toolchain-less host with a prebuilt (if stale-
+                    # looking) library: warn and use what's there.
+                    log.warning("Native transport rebuild failed; using "
+                                "existing library. Build output:\n%s", err)
+                else:
+                    raise RuntimeError(
+                        f"native transport build failed:\n{err}")
         lib = ctypes.CDLL(str(_SO_PATH))
         lib.st_create.restype = ctypes.c_void_p
         lib.st_create.argtypes = [ctypes.c_char_p] * 3 + [ctypes.c_int] + \
@@ -60,16 +74,34 @@ def load_native() -> ctypes.CDLL:
                                      ctypes.c_int]
         lib.st_set_local_state.argtypes = [ctypes.c_void_p,
                                            ctypes.c_char_p, ctypes.c_int]
+        lib.st_configure_probe.argtypes = [ctypes.c_void_p] + \
+            [ctypes.c_int] * 4
+        lib.st_test_drop_types.argtypes = [ctypes.c_void_p,
+                                           ctypes.c_char_p, ctypes.c_uint]
         for fn in (lib.st_poll_msg, lib.st_poll_state, lib.st_poll_event,
-                   lib.st_members):
+                   lib.st_poll_log, lib.st_members):
             fn.restype = ctypes.c_int
             fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.st_next_state_len.restype = ctypes.c_int
+        lib.st_next_state_len.argtypes = [ctypes.c_void_p]
         lib.st_port.restype = ctypes.c_int
         lib.st_port.argtypes = [ctypes.c_void_p]
         lib.st_stop.argtypes = [ctypes.c_void_p]
         lib.st_destroy.argtypes = [ctypes.c_void_p]
         _lib = lib
         return lib
+
+
+# Packet-type bits for the engine's test-only one-way packet-drop hook
+# (st_test_drop_types masks received packets by type).
+DROP_GOSSIP = 1 << 0
+DROP_PING = 1 << 1
+DROP_ACK = 1 << 2
+DROP_PING_REQ = 1 << 3
+DROP_ACK_FWD = 1 << 4
+
+_LOG_LEVELS = {"E": logging.ERROR, "W": logging.WARNING,
+               "I": logging.INFO, "D": logging.DEBUG}
 
 
 class GossipTransport:
@@ -83,7 +115,11 @@ class GossipTransport:
                  gossip_interval: float = 0.2,
                  push_pull_interval: float = 20.0,
                  gossip_nodes: int = 3,
-                 gossip_messages: int = 15) -> None:
+                 gossip_messages: int = 15,
+                 probe_interval: float = 0.0,
+                 probe_timeout: float = 0.0,
+                 suspect_timeout: float = 0.0,
+                 indirect_probes: int = -1) -> None:
         import socket
 
         self.node_name = node_name or socket.gethostname()
@@ -95,6 +131,10 @@ class GossipTransport:
         self.push_pull_interval = push_pull_interval
         self.gossip_nodes = gossip_nodes
         self.gossip_messages = gossip_messages
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.suspect_timeout = suspect_timeout
+        self.indirect_probes = indirect_probes
         self._lib = load_native()
         self._handle: Optional[int] = None
         self._quit = threading.Event()
@@ -115,6 +155,10 @@ class GossipTransport:
             int(self.gossip_interval * 1000),
             int(self.push_pull_interval * 1000),
             self.gossip_nodes, self.gossip_messages)
+        self._lib.st_configure_probe(
+            self._handle, int(self.probe_interval * 1000),
+            int(self.probe_timeout * 1000),
+            int(self.suspect_timeout * 1000), self.indirect_probes)
         port = self._lib.st_start(self._handle)
         if port < 0:
             raise OSError(
@@ -162,6 +206,13 @@ class GossipTransport:
         n = self._lib.st_members(self._handle, buf, len(buf))
         return [m for m in buf.raw[:n].decode().split("\n") if m]
 
+    def test_drop_types(self, node: str, type_mask: int) -> None:
+        """Test-only one-way fault injection: drop received packets of
+        the masked types (DROP_* bits) coming from ``node``."""
+        if self._handle is not None:
+            self._lib.st_test_drop_types(self._handle, node.encode(),
+                                         type_mask)
+
     # -- delegate loops ----------------------------------------------------
 
     def _push_local_state(self) -> None:
@@ -196,7 +247,8 @@ class GossipTransport:
 
     def _inbound_loop(self) -> None:
         """Native queues → catalog (NotifyMsg / MergeRemoteState /
-        NotifyLeave)."""
+        NotifyLeave) + the engine-diagnostics log bridge
+        (logging_bridge.go:25-53)."""
         buf = ctypes.create_string_buffer(1 << 22)
         while not self._quit.is_set():
             busy = False
@@ -210,14 +262,29 @@ class GossipTransport:
                 except ValueError as exc:
                     log.warning("Error decoding gossip message: %s", exc)
 
-            n = self._lib.st_poll_state(self._handle, buf, len(buf))
+            # Full-state payloads are unbounded (LocalState is the whole
+            # catalog) — size the read from the engine's queue so a large
+            # cluster's push-pull can't be silently truncated.
+            need = self._lib.st_next_state_len(self._handle)
+            if need > 0:
+                sbuf = buf if need <= len(buf) else \
+                    ctypes.create_string_buffer(need)
+                n = self._lib.st_poll_state(self._handle, sbuf, len(sbuf))
+                if n > 0:
+                    busy = True
+                    try:
+                        remote = decode(sbuf.raw[:n])
+                        self.state.merge(remote)
+                    except (ValueError, KeyError) as exc:
+                        log.warning("Error merging remote state: %s", exc)
+
+            n = self._lib.st_poll_log(self._handle, buf, len(buf))
             if n > 0:
                 busy = True
-                try:
-                    remote = decode(buf.raw[:n])
-                    self.state.merge(remote)
-                except (ValueError, KeyError) as exc:
-                    log.warning("Error merging remote state: %s", exc)
+                line = buf.raw[:n].decode(errors="replace")
+                level, _, msg = line.partition("|")
+                log.log(_LOG_LEVELS.get(level, logging.INFO),
+                        "engine: %s", msg)
 
             n = self._lib.st_poll_event(self._handle, buf, len(buf))
             if n > 0:

@@ -30,17 +30,20 @@ def check_equivalent(known, sent, limit=8):
     # Same multiset of selected values...
     np.testing.assert_array_equal(np.sort(np.asarray(msg2), axis=1),
                                   np.sort(np.asarray(msg1), axis=1))
-    # ...and every returned index points at the value it claims.
-    gathered = np.take_along_axis(np.asarray(known), np.asarray(svc2),
-                                  axis=1)
+    # ...padded slots (msg == 0) sit past the row end so they can't alias
+    # a real column in the scatters...
+    svc2, msg2 = np.asarray(svc2), np.asarray(msg2)
+    m = known.shape[1]
+    assert (svc2[msg2 > 0] < m).all()
+    assert (svc2[msg2 == 0] == m).all() or (msg2 > 0).all()
+    # ...and every genuine index points at the value it claims.
     eligible = np.asarray(gossip_ops.eligible_mask(
         jnp.asarray(sent), limit))
     pri = np.where(eligible, np.asarray(known), 0)
-    gathered_pri = np.take_along_axis(pri, np.asarray(svc2), axis=1)
+    safe_idx = np.minimum(svc2, m - 1)
+    gathered_pri = np.take_along_axis(pri, safe_idx, axis=1)
     np.testing.assert_array_equal(
-        np.where(np.asarray(msg2) > 0, gathered_pri, np.asarray(msg2)),
-        np.asarray(msg2))
-    assert gathered.shape == (known.shape[0], BUDGET)
+        np.where(msg2 > 0, gathered_pri, msg2), msg2)
 
 
 def test_two_stage_matches_flat_random():
@@ -85,6 +88,29 @@ def test_sparse_rows_pad_with_zero():
     msg = np.asarray(msg)
     assert msg[0].max() == 999
     assert (msg[1:] == 0).all()
+
+
+def test_padded_slots_cannot_clobber_last_column_bump():
+    """Regression: a genuine selection of column m-1 alongside padded
+    slots.  Padded indices used to be clamped to m-1, racing the real
+    entry's transmit-count .set nondeterministically; they must now land
+    out of bounds and drop, leaving the genuine bump intact."""
+    known = np.zeros((2, WIDE_M), np.int32)
+    known[0, WIDE_M - 1] = 500 << 3   # the ONLY record in row 0: col m-1
+    known[1, 7] = 300 << 3
+    sent = np.zeros((2, WIDE_M), np.int8)
+    limit, fanout = 8, 3
+    svc, msg = gossip_ops.select_messages(
+        jnp.asarray(known), jnp.asarray(sent), BUDGET, limit)
+    svc_np, msg_np = np.asarray(svc), np.asarray(msg)
+    # Row 0 offers exactly its one record at m-1; all other slots padded.
+    assert (msg_np[0] > 0).sum() == 1
+    assert svc_np[0][msg_np[0] > 0][0] == WIDE_M - 1
+    assert (svc_np[0][msg_np[0] == 0] == WIDE_M).all()
+    new_sent = np.asarray(gossip_ops.record_transmissions(
+        jnp.asarray(sent), svc, msg, fanout, limit))
+    assert new_sent[0, WIDE_M - 1] == fanout  # the bump survived
+    assert (new_sent[0, :WIDE_M - 1] == 0).all()
 
 
 def test_transmit_accounting_saturates_and_rotates():

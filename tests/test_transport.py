@@ -12,12 +12,12 @@ from sidecar_tpu.runtime.looper import FreeLooper
 from sidecar_tpu.transport import GossipTransport
 
 
-def make_node(name, cluster="test"):
+def make_node(name, cluster="test", **kw):
     state = ServicesState(hostname=name)
     transport = GossipTransport(
         node_name=name, cluster_name=cluster,
         bind_ip="127.0.0.1", bind_port=0, advertise_ip="127.0.0.1",
-        gossip_interval=0.05, push_pull_interval=1.0)
+        gossip_interval=0.05, push_pull_interval=1.0, **kw)
     return state, transport
 
 
@@ -133,3 +133,174 @@ class TestTwoNodeGossip:
                 l.quit()
             for s in (state_a, state_b, state_c):
                 s.stop_processing()
+
+
+# Fast SWIM tuning so failure-detection scenarios complete in seconds.
+SWIM_KW = dict(probe_interval=0.1, probe_timeout=0.15,
+               suspect_timeout=0.6, indirect_probes=3)
+
+
+def hold_for(predicate, seconds, step=0.15):
+    """True iff predicate stays true for the whole window."""
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        if not predicate():
+            return False
+        time.sleep(step)
+    return True
+
+
+class TestSwim:
+    """Full SWIM semantics in the native engine: indirect probes,
+    incarnation numbers with refutation, and membership dissemination
+    (memberlist behavior per the reference's README.md:83-96)."""
+
+    def test_indirect_probe_saves_one_way_partitioned_node(self):
+        """A cannot hear B's pings/acks (one-way loss), but B is healthy:
+        A's ping-req through C must keep B alive — no suspicion, no
+        leave."""
+        from sidecar_tpu.transport.gossip import DROP_ACK, DROP_PING
+
+        state_a, ta = make_node("swim-a", **SWIM_KW)
+        state_b, tb = make_node("swim-b", **SWIM_KW)
+        state_c, tc = make_node("swim-c", **SWIM_KW)
+        try:
+            port_a = ta.start(state_a)
+            tb.start(state_b)
+            tc.start(state_c)
+            tb.join("127.0.0.1", port_a)
+            tc.join("127.0.0.1", port_a)
+            assert wait_for(lambda: len(ta.members()) == 3 and
+                            len(tb.members()) == 3 and
+                            len(tc.members()) == 3)
+
+            # One-way partition: A drops B's direct probe traffic.  The
+            # relayed ack arrives from C and is unaffected.
+            ta.test_drop_types("swim-b", DROP_PING | DROP_ACK)
+
+            # Several suspect-timeout windows: without the indirect path
+            # B would be declared dead well within this.
+            assert hold_for(lambda: "swim-b" in ta.members(), 3.0), \
+                "one-way-partitioned node was declared dead despite " \
+                "healthy indirect path"
+        finally:
+            for t in (ta, tb, tc):
+                t.stop()
+
+    def test_falsely_suspected_node_refutes(self):
+        """Two-node cluster, so no proxies exist: A's probes of B all
+        fail and A broadcasts suspicion — but B hears the suspicion via
+        gossip, increments its incarnation, and refutes.  B must never be
+        declared dead."""
+        from sidecar_tpu.transport.gossip import (
+            DROP_ACK, DROP_ACK_FWD, DROP_PING)
+
+        state_a, ta = make_node("ref-a", **SWIM_KW)
+        state_b, tb = make_node("ref-b", **SWIM_KW)
+        try:
+            port_a = ta.start(state_a)
+            tb.start(state_b)
+            tb.join("127.0.0.1", port_a)
+            assert wait_for(lambda: len(ta.members()) == 2 and
+                            len(tb.members()) == 2)
+
+            ta.test_drop_types("ref-b",
+                               DROP_PING | DROP_ACK | DROP_ACK_FWD)
+
+            # Suspicion fires repeatedly; each time B's refutation (a
+            # gossiped alive with a bumped incarnation) must cancel it
+            # before the suspect timeout.
+            assert hold_for(lambda: "ref-b" in ta.members(), 4.0), \
+                "falsely-suspected node could not refute"
+        finally:
+            ta.stop()
+            tb.stop()
+
+    def test_actually_dead_node_is_detected(self):
+        """Control: when B really dies (engine stopped), A must emit the
+        leave event within a few probe+suspect windows."""
+        state_a, ta = make_node("dead-a", **SWIM_KW)
+        state_b, tb = make_node("dead-b", **SWIM_KW)
+        try:
+            port_a = ta.start(state_a)
+            tb.start(state_b)
+            tb.join("127.0.0.1", port_a)
+            assert wait_for(lambda: len(ta.members()) == 2)
+
+            tb.stop()
+            assert wait_for(lambda: "dead-b" not in ta.members(),
+                            timeout=10.0)
+        finally:
+            ta.stop()
+            tb.stop()
+
+
+class TestLargeStatePushPull:
+    def test_multi_megabyte_state_survives_push_pull(self):
+        """A large cluster's LocalState is the full catalog — far past
+        any fixed poll buffer.  The length-prefixed poll protocol
+        (st_next_state_len) must deliver a >4 MB payload bit-exact, where
+        the old fixed 4 MB cap silently truncated it."""
+        import ctypes
+        import os
+        from sidecar_tpu.transport.gossip import load_native
+
+        lib = load_native()
+        blob = os.urandom(5 << 20)  # 5 MB, > the 4 MB python-side buffer
+
+        ha = lib.st_create(b"big-a", b"big", b"127.0.0.1", 0,
+                           b"127.0.0.1", 50, 1000, 3, 15)
+        hb = lib.st_create(b"big-b", b"big", b"127.0.0.1", 0,
+                           b"127.0.0.1", 50, 1000, 3, 15)
+        try:
+            port_a = lib.st_start(ha)
+            assert port_a > 0
+            assert lib.st_start(hb) > 0
+            lib.st_set_local_state(ha, blob, len(blob))
+            assert lib.st_join(hb, b"127.0.0.1", port_a) == 0
+
+            def drain_state(h):
+                need = lib.st_next_state_len(h)
+                if need <= 0:
+                    return None
+                buf = ctypes.create_string_buffer(need)
+                n = lib.st_poll_state(h, buf, need)
+                return buf.raw[:n]
+
+            got: list = []
+
+            def try_drain():
+                data = drain_state(hb)
+                if data is not None:
+                    got.append(data)
+                return bool(got)
+
+            assert wait_for(try_drain, timeout=15)
+            assert len(got[0]) == len(blob)
+            assert got[0] == blob
+        finally:
+            lib.st_stop(ha)
+            lib.st_stop(hb)
+            lib.st_destroy(ha)
+            lib.st_destroy(hb)
+
+
+class TestLogBridge:
+    def test_engine_diagnostics_reach_python_logging(self, caplog):
+        """The native engine's diagnostics channel is polled into Python
+        logging (the reference re-levels memberlist logs through its
+        LoggingBridge, logging_bridge.go:25-53).  An oversized broadcast
+        is dropped loudly — that warning must surface here."""
+        import logging
+
+        state, t = make_node("logb-a")
+        try:
+            t.start(state)
+            with caplog.at_level(logging.WARNING,
+                                 logger="sidecar_tpu.transport.gossip"):
+                t._lib.st_broadcast(t._handle, b"x" * 4000, 4000)
+                assert wait_for(
+                    lambda: any("oversized" in r.message
+                                for r in caplog.records), timeout=5)
+        finally:
+            t.stop()
