@@ -1,0 +1,561 @@
+"""The compressed large-cluster gossip model — bounded memory per node.
+
+The exact model's ``known[N, N·spn]`` belief matrix is O(N²·spn): at the
+north-star scale (100k nodes × 1M services, BASELINE.md) that is 4×10¹¹
+cells — physically impossible on any chip.  This model replaces it with
+three structures totalling O(N·K + M) (SURVEY.md §7 "Sparsity +
+raggedness" names this the hard part):
+
+* ``own[N, S]`` — owner-authoritative records for each node's own
+  service slots (the reference keeps local services authoritative in the
+  same state map, catalog/services_state.go:70-80).
+* ``cache_{slot,val,sent}[N, K]`` — each node's bounded **in-flight
+  belief cache**: a direct-mapped table of the records the node has
+  recently learned and is still relaying.  This mirrors reality better
+  than the dense matrix does: memberlist's TransmitLimited broadcast
+  queue is itself bounded (the native engine caps it at 4096), and a
+  real node's "interesting" state at any moment is the small delta
+  against the converged catalog.  The line index is a global
+  multiplicative hash of the slot id, so one slot occupies the SAME
+  line on every node — cross-node exchange is line-aligned.
+* ``floor[M]`` — the shared **converged baseline**: the record version
+  every alive node is known to hold.  In the real cluster each of N
+  hosts stores the full O(M) catalog; simulating N identical copies of
+  the converged part is pure waste, so the model stores it once and
+  advances it only when a per-slot census proves every alive node has
+  caught up.  belief(i, m) = max(floor[m], cache hit, own if owner).
+
+Line competition: the freshest record (largest packed key) wins a cache
+line, ties broken by larger slot id; a line's value never regresses.
+Evicting a still-live belief loses information — the model counts those
+evictions (``state.evictions``) so an under-provisioned K is visible —
+and liveness is restored by the owners' recovery re-offer plus the
+line-aligned anti-entropy.
+
+Scale regime: this model starts CONVERGED (floor = the boot catalog)
+and measures how injected churn — the steady-state workload —
+propagates back to full convergence.  Cold-start full-catalog sync is
+the push-pull regime the exact model covers at small N; at 65k+ nodes
+the physically meaningful question is delta propagation, which is what
+bounded caches represent.
+
+Round structure (mirrors models/exact.py):
+1. select + deliver — top-``budget`` freshest eligible cache entries to
+   ``fanout`` sampled peers; deliveries resolve through ONE
+   line-competition scatter pass (two scatter-maxes: value, then
+   winning slot on value ties) with merge semantics — staleness gate,
+   acceptance against the pre-round belief, DRAINING stickiness —
+   applied to the values first, exactly like ops/gossip.py.
+2. announce — staggered owner re-stamps (the 1-minute refresh,
+   services_state.go:547-549) minting a new version, plus **recovery**
+   re-offers: own slots still above the floor re-enter the owner's
+   cache with a fresh transmit budget WITHOUT a new version (the
+   changed-service re-broadcast, services_state.go:538) — this is what
+   makes convergence immune to cache evictions.
+3. anti-entropy — every push-pull cadence, a two-way full-cache +
+   own-rows exchange with the node ``stride`` positions away, routed
+   through the same merge path.
+4. floor advance + sweep — per-slot census (truth = freshest belief,
+   hits = #alive nodes at truth); slots where every alive node agrees
+   fold into the floor and their cache lines free; the TTL sweep
+   (ops/ttl.py) runs over own + cache + floor — one shared floor sweep
+   models every node's identical deterministic sweep.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops.merge import staleness_mask, sticky_adjust
+from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, is_known, pack, unpack_status
+from sidecar_tpu.ops.topology import Topology
+from sidecar_tpu.ops.ttl import ttl_sweep
+
+_KNUTH = np.uint32(2654435761)
+
+
+def hash_line(slot, cache_lines: int):
+    """Global multiplicative (Knuth) hash: slot id → cache line.  The
+    same slot maps to the same line on every node, so caches are
+    line-aligned across the cluster."""
+    u = jnp.asarray(slot).astype(jnp.uint32) * _KNUTH
+    shift = 32 - int(math.log2(cache_lines))
+    return (u >> np.uint32(shift)).astype(jnp.int32)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class CompressedState:
+    """Pytree carried through the round scan."""
+
+    own: jax.Array         # int32 [N, S] owner-authoritative packed keys
+    cache_slot: jax.Array  # int32 [N, K] slot id per line (-1 = empty)
+    cache_val: jax.Array   # int32 [N, K] packed belief
+    cache_sent: jax.Array  # int8 [N, K] transmit counts
+    floor: jax.Array       # int32 [M] shared converged baseline
+    node_alive: jax.Array  # bool [N]
+    round_idx: jax.Array   # int32 scalar
+    evictions: jax.Array   # int32 scalar — live beliefs lost to capacity
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressedParams:
+    n: int
+    services_per_node: int = 10
+    cache_lines: int = 256       # K — must be a power of two
+    fanout: int = 3
+    budget: int = 15
+    drop_prob: float = 0.0
+    retransmit_limit: int = 0    # 0 = auto (RetransmitMult semantics)
+    recover_rounds: int = 50     # unconverged-own re-offer cadence
+
+    def __post_init__(self):
+        if self.cache_lines & (self.cache_lines - 1):
+            raise ValueError("cache_lines must be a power of two")
+        if self.budget > self.cache_lines:
+            raise ValueError("budget cannot exceed cache_lines")
+
+    @property
+    def m(self) -> int:
+        return self.n * self.services_per_node
+
+    def resolved_retransmit_limit(self) -> int:
+        if self.retransmit_limit > 0:
+            return self.retransmit_limit
+        return 4 * math.ceil(math.log10(self.n + 1))
+
+
+PerturbFn = Callable[["CompressedState", jax.Array, jax.Array],
+                     "CompressedState"]
+
+
+class CompressedSim:
+    """Single-chip compressed simulator (multi-chip:
+    ``sidecar_tpu.parallel.sharded_compressed``)."""
+
+    def __init__(self, params: CompressedParams, topo: Topology,
+                 timecfg: TimeConfig = TimeConfig(),
+                 perturb: Optional[PerturbFn] = None,
+                 cut_mask: Optional[np.ndarray] = None,
+                 node_side: Optional[np.ndarray] = None):
+        if topo.n != params.n:
+            raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
+        if cut_mask is not None and topo.nbrs is None:
+            raise ValueError("cut_mask requires a neighbor-list topology")
+        self.p = params
+        self.t = timecfg
+        self.topo = topo
+        self.perturb = perturb
+        self._nbrs = None if topo.nbrs is None else jnp.asarray(topo.nbrs)
+        self._deg = None if topo.deg is None else jnp.asarray(topo.deg)
+        self._cut = None if cut_mask is None else jnp.asarray(cut_mask)
+        self._side = None if node_side is None else \
+            jnp.asarray(node_side, jnp.int32)
+
+    # -- state construction -------------------------------------------------
+
+    def init_state(self) -> CompressedState:
+        """Converged boot state: the whole catalog sits in the floor at
+        tick 1, owners hold matching authoritative records, caches are
+        empty.  Scenario perturbations (mint/churn) create the in-flight
+        work this model measures."""
+        p = self.p
+        boot = jnp.full((p.n, p.services_per_node), pack(1, ALIVE),
+                        dtype=jnp.int32)
+        return CompressedState(
+            own=boot,
+            cache_slot=jnp.full((p.n, p.cache_lines), -1, jnp.int32),
+            cache_val=jnp.zeros((p.n, p.cache_lines), jnp.int32),
+            cache_sent=jnp.zeros((p.n, p.cache_lines), jnp.int8),
+            floor=jnp.full((p.m,), pack(1, ALIVE), dtype=jnp.int32),
+            node_alive=jnp.ones((p.n,), bool),
+            round_idx=jnp.zeros((), jnp.int32),
+            evictions=jnp.zeros((), jnp.int32),
+        )
+
+    # -- perturbation helper ------------------------------------------------
+
+    def mint(self, state: CompressedState, slots, now_tick,
+             status=ALIVE) -> CompressedState:
+        """Inject new record versions at the given global slots: owners
+        re-stamp their authoritative copy and seed their cache line (the
+        changed-service broadcast, services_state.go:538-549).  The
+        scenario-facing churn hook."""
+        p = self.p
+        slots = jnp.asarray(slots, jnp.int32)
+        owner = slots // p.services_per_node
+        col = slots % p.services_per_node
+        val = jnp.broadcast_to(
+            pack(jnp.asarray(now_tick, jnp.int32), status), slots.shape)
+        val = jnp.where(state.node_alive[owner], val, 0)
+        rows = jnp.where(val > 0, owner, p.n)
+        own = state.own.at[rows, col].max(val, mode="drop")
+        cs, cv, se, ev = _line_compete(
+            state.cache_slot, state.cache_val, state.cache_sent,
+            owner, slots, val, p.cache_lines, state.floor)
+        return dataclasses.replace(
+            state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
+            evictions=state.evictions + ev)
+
+    # -- kernels ------------------------------------------------------------
+
+    def _select(self, state: CompressedState, limit: int):
+        """Top-``budget`` freshest eligible cache entries per node.
+        Eligible = transmits left AND still above the floor (entries the
+        whole cluster already knows are dead weight)."""
+        p = self.p
+        slot, val = state.cache_slot, state.cache_val
+        live = (slot >= 0) & (val > state.floor[jnp.maximum(slot, 0)])
+        eligible = live & (state.cache_sent.astype(jnp.int32) < limit)
+        priority = jnp.where(eligible, val, 0)
+        msg, line_idx = lax.top_k(priority, min(p.budget, p.cache_lines))
+        sel_slot = jnp.take_along_axis(slot, line_idx, axis=1)
+        sel_slot = jnp.where(msg > 0, sel_slot, -1)
+        # Padded lines index past K so scatters drop them (see
+        # ops/gossip.select_messages for the aliasing hazard).
+        line_idx = jnp.where(msg > 0, line_idx, p.cache_lines)
+        return line_idx.astype(jnp.int32), sel_slot, msg
+
+    def _apply(self, state: CompressedState, sent, rows, slots, vals,
+               now):
+        """Merge flat (node, slot, val) updates with full merge
+        semantics: staleness gate, acceptance against the pre-batch
+        belief, DRAINING stickiness.  Own-slot updates also land in
+        ``own``; every accepted update enters the cache via line
+        competition (an accepted record re-offers — the relay,
+        services_state.go:377-392)."""
+        p, t = self.p, self.t
+        s = p.services_per_node
+        safe_slots = jnp.maximum(slots, 0)
+        owner_of = safe_slots // s
+        col = safe_slots % s
+        valid = (slots >= 0) & (vals > 0)
+        is_own = (owner_of == rows) & valid
+
+        vals = jnp.where(staleness_mask(vals, now, t.stale_ticks), 0, vals)
+
+        # Pre-batch belief of (rows, slots).
+        line = hash_line(safe_slots, p.cache_lines)
+        safe_rows = jnp.where(valid, rows, 0)
+        line_slot = state.cache_slot[safe_rows, line]
+        line_val = state.cache_val[safe_rows, line]
+        pre = jnp.where(valid, state.floor[safe_slots], 0)
+        pre = jnp.maximum(pre, jnp.where(line_slot == slots, line_val, 0))
+        own_pre = state.own[safe_rows, col]
+        pre = jnp.maximum(pre, jnp.where(is_own, own_pre, 0))
+
+        advanced = (vals > pre) & valid
+        vals = sticky_adjust(vals, pre, advanced)
+        vals = jnp.where(advanced, vals, 0)
+
+        own_rows = jnp.where(is_own & advanced, rows, p.n)
+        own = state.own.at[own_rows, col].max(vals, mode="drop")
+
+        cs, cv, se, ev = _line_compete(
+            state.cache_slot, state.cache_val, sent,
+            rows, slots, vals, p.cache_lines, state.floor)
+        return dataclasses.replace(
+            state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
+            evictions=state.evictions + ev)
+
+    def _announce(self, state: CompressedState, round_idx, now):
+        """Owner refresh + recovery.  Refresh (staggered) mints a fresh
+        version of every present, non-tombstone own record.  Recovery
+        (staggered) re-seeds the cache line of own slots still above the
+        floor without minting — restoring the transmit budget of a
+        stalled/evicted record."""
+        p, t = self.p, self.t
+        n, s = p.n, p.services_per_node
+        node = jnp.arange(n, dtype=jnp.int32)[:, None]          # [N, 1]
+        slots = jnp.arange(p.m, dtype=jnp.int32).reshape(n, s)  # [N, S]
+
+        st = unpack_status(state.own)
+        present = is_known(state.own) & state.node_alive[:, None]
+
+        phase = node % t.refresh_rounds
+        refresh_due = ((round_idx % t.refresh_rounds) == phase) & present \
+            & (st != TOMBSTONE)
+        own = jnp.where(refresh_due, pack(now, st), state.own)
+
+        rphase = node % p.recover_rounds
+        recover_due = ((round_idx % p.recover_rounds) == rphase) & present \
+            & (own > state.floor[slots])
+
+        offer = refresh_due | recover_due
+        vals = jnp.where(offer, own, 0).reshape(-1)
+        nodes = jnp.broadcast_to(node, (n, s)).reshape(-1)
+        flat_slots = jnp.where(offer, slots, -1).reshape(-1)
+
+        # Owner-authoritative insert: straight line competition, then a
+        # transmit-budget reset wherever the line now holds the offer.
+        cs, cv, se, ev = _line_compete(
+            state.cache_slot, state.cache_val, state.cache_sent,
+            nodes, flat_slots, vals, p.cache_lines, state.floor)
+        line = hash_line(jnp.maximum(flat_slots, 0), p.cache_lines)
+        holds = (vals > 0) & \
+            (cs[jnp.where(vals > 0, nodes, 0), line] == flat_slots)
+        reset_rows = jnp.where(holds, nodes, n)
+        se = se.at[reset_rows, line].set(jnp.int8(0), mode="drop")
+        return dataclasses.replace(
+            state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
+            evictions=state.evictions + ev)
+
+    def _push_pull_stride(self, state: CompressedState, key, now):
+        """Anti-entropy: two-way exchange with the node ``stride``
+        positions away — each side's full cache plus its own rows, all
+        routed through the standard merge path.  Split scenarios mask
+        the exchange where the two sides differ (a partition severs TCP
+        push-pull too)."""
+        p = self.p
+        stride = jax.random.randint(key, (), 1, p.n, dtype=jnp.int32)
+        alive = state.node_alive
+        my_node = jnp.arange(p.n, dtype=jnp.int32)
+        own_slots = jnp.arange(p.m, dtype=jnp.int32).reshape(
+            p.n, p.services_per_node)
+
+        all_rows, all_slots, all_vals = [], [], []
+        for roll_amt in (-stride, stride):
+            ok = alive & jnp.roll(alive, roll_amt)
+            if self._side is not None:
+                ok = ok & (self._side == jnp.roll(self._side, roll_amt))
+            okc = ok[:, None]
+            # Partner's cache entries land on my aligned rows.
+            p_slot = jnp.roll(state.cache_slot, roll_amt, 0)
+            p_val = jnp.roll(state.cache_val, roll_amt, 0)
+            p_val = jnp.where(okc & (p_slot >= 0), p_val, 0)
+            all_rows.append(jnp.broadcast_to(
+                my_node[:, None], p_slot.shape).reshape(-1))
+            all_slots.append(jnp.where(p_val > 0, p_slot, -1).reshape(-1))
+            all_vals.append(p_val.reshape(-1))
+            # Partner's own rows (their authoritative records).
+            t_slot = jnp.roll(own_slots, roll_amt, 0)
+            t_val = jnp.where(okc, jnp.roll(state.own, roll_amt, 0), 0)
+            all_rows.append(jnp.broadcast_to(
+                my_node[:, None], t_slot.shape).reshape(-1))
+            all_slots.append(jnp.where(t_val > 0, t_slot, -1).reshape(-1))
+            all_vals.append(t_val.reshape(-1))
+
+        return self._apply(
+            state, state.cache_sent,
+            jnp.concatenate(all_rows), jnp.concatenate(all_slots),
+            jnp.concatenate(all_vals), now)
+
+    def _floor_advance_and_sweep(self, state: CompressedState, now):
+        """Census → floor advance → line free → TTL sweep."""
+        p, t = self.p, self.t
+        truth, hits, n_alive = _census(state, p)
+        caught_up = hits >= n_alive
+        floor = jnp.where(caught_up, jnp.maximum(state.floor, truth),
+                          state.floor)
+
+        below = (state.cache_slot >= 0) & (
+            state.cache_val <= floor[jnp.maximum(state.cache_slot, 0)])
+        cache_slot = jnp.where(below, -1, state.cache_slot)
+        cache_val = jnp.where(below, 0, state.cache_val)
+        cache_sent = jnp.where(below, jnp.int8(0), state.cache_sent)
+
+        kw = dict(alive_lifespan=t.alive_lifespan,
+                  draining_lifespan=t.draining_lifespan,
+                  tombstone_lifespan=t.tombstone_lifespan,
+                  one_second=t.one_second)
+        own, _ = ttl_sweep(state.own, now, **kw)
+        floor, _ = ttl_sweep(floor, now, **kw)
+        swept_val, _ = ttl_sweep(cache_val, now, **kw)
+        cache_sent = jnp.where(swept_val != cache_val, jnp.int8(0),
+                               cache_sent)
+        return dataclasses.replace(
+            state, own=own, floor=floor, cache_slot=cache_slot,
+            cache_val=swept_val, cache_sent=cache_sent)
+
+    def _step(self, state: CompressedState,
+              key: jax.Array) -> CompressedState:
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+
+        # 1. select (pre-round snapshot) + gossip deliveries.
+        dst = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
+            node_alive=state.node_alive, cut_mask=self._cut)
+        line_idx, sel_slot, msg = self._select(state, limit)
+        sent = _bump_transmits(state.cache_sent, line_idx, msg, p.fanout,
+                               limit)
+
+        n, fanout = dst.shape
+        budget = msg.shape[1]
+        v = jnp.broadcast_to(msg[:, None, :], (n, fanout, budget))
+        tgt = jnp.broadcast_to(dst[:, :, None], (n, fanout, budget))
+        sl = jnp.broadcast_to(sel_slot[:, None, :], (n, fanout, budget))
+        v = jnp.where(state.node_alive[:, None, None], v, 0)
+        v = jnp.where(state.node_alive[tgt], v, 0)
+        if p.drop_prob > 0.0:
+            keep = jax.random.bernoulli(k_drop, 1.0 - p.drop_prob, v.shape)
+            v = jnp.where(keep, v, 0)
+        self_tgt = tgt == jnp.arange(n, dtype=jnp.int32)[:, None, None]
+        v = jnp.where(self_tgt, 0, v)  # self-sends are merge no-ops
+
+        state = self._apply(state, sent, tgt.reshape(-1), sl.reshape(-1),
+                            v.reshape(-1), now)
+
+        # 2. announce re-stamps + recovery offers (end of round, like the
+        # exact model: broadcastable the following round).
+        state = self._announce(state, round_idx, now)
+
+        # 3. anti-entropy.
+        state = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            lambda st: self._push_pull_stride(st, k_pp, now),
+            lambda st: st, state)
+
+        # 4. floor advance + sweep.
+        state = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            lambda st: self._floor_advance_and_sweep(st, now),
+            lambda st: st, state)
+
+        return dataclasses.replace(state, round_idx=round_idx)
+
+    # -- metrics ------------------------------------------------------------
+
+    def convergence(self, state: CompressedState) -> jax.Array:
+        """Fraction of (alive node, slot) beliefs agreeing with the
+        freshest belief — the exact model's metric, computed from the
+        compressed representation in O(N·K + M)."""
+        truth, hits, n_alive = _census(state, self.p)
+        behind = jnp.maximum(n_alive - hits, 0)
+        frac_behind = jnp.sum(behind.astype(jnp.float32)) / \
+            jnp.maximum(n_alive * self.p.m, 1).astype(jnp.float32)
+        return 1.0 - frac_behind
+
+    # -- drivers ------------------------------------------------------------
+
+    def _check_horizon(self, state, num_rounds):
+        self.t.validate_horizon(int(state.round_idx) + num_rounds)
+
+    def step(self, state, key):
+        self._check_horizon(state, 1)
+        return self._step_jit(state, key)
+
+    def run(self, state, key, num_rounds: int):
+        self._check_horizon(state, num_rounds)
+        return self._run_jit(state, key, num_rounds)
+
+    def run_fast(self, state, key, num_rounds: int):
+        self._check_horizon(state, num_rounds)
+        return self._run_fast_jit(state, key, num_rounds)
+
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_jit(self, state, key):
+        return self._step(state, key)
+
+    # Per-round keys fold the round index into the base key so chunked/
+    # resumed runs replay identical randomness (see ExactSim).
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _run_jit(self, state, key, num_rounds):
+        def body(st, _):
+            st = self._step(st, jax.random.fold_in(key, st.round_idx))
+            return st, self.convergence(st)
+        return lax.scan(body, state, None, length=num_rounds)
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _run_fast_jit(self, state, key, num_rounds):
+        def body(st, _):
+            return self._step(st, jax.random.fold_in(key, st.round_idx)), None
+        final, _ = lax.scan(body, state, None, length=num_rounds)
+        return final
+
+
+# -- shared kernels (also used by the sharded twin) -------------------------
+
+def _line_compete(cache_slot, cache_val, cache_sent, rows, slots, vals,
+                  cache_lines, floor):
+    """Resolve a batch of (node-row, slot, val) cache insertions: the
+    largest val wins each line (value ties broken by larger slot id),
+    existing content included.  Entries with val ≤ 0 or slot < 0 are
+    no-ops; floor-dead entries are filtered.  Returns
+    (slot, val, sent, evicted-live-count)."""
+    n = cache_slot.shape[0]
+    valid = (vals > 0) & (slots >= 0)
+    valid = valid & (vals > floor[jnp.where(valid, slots, 0)])
+    line = jnp.where(valid, hash_line(jnp.maximum(slots, 0), cache_lines),
+                     cache_lines)
+    rows = jnp.where(valid, rows, n)
+
+    val1 = cache_val.at[rows, line].max(vals, mode="drop")
+    got = val1[jnp.where(valid, rows, 0), jnp.where(valid, line, 0)]
+    won = valid & (vals == got)
+    cand_slot = jnp.where(won, slots, -1)
+    slot1 = jnp.where(cache_val == val1, cache_slot, -1)
+    slot1 = slot1.at[rows, line].max(cand_slot, mode="drop")
+
+    changed = (val1 != cache_val) | (slot1 != cache_slot)
+    sent1 = jnp.where(changed, jnp.int8(0), cache_sent)
+
+    # Eviction accounting: a line whose slot changed while the OLD entry
+    # was still above the floor lost live information.
+    old_live = (cache_slot >= 0) & \
+        (cache_val > floor[jnp.maximum(cache_slot, 0)])
+    evicted = old_live & (slot1 != cache_slot)
+    return slot1, val1, sent1, jnp.sum(evicted.astype(jnp.int32))
+
+
+def _bump_transmits(cache_sent, line_idx, msg, fanout, limit):
+    n, k = cache_sent.shape
+    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    bump = jnp.where(msg > 0, fanout, 0).astype(jnp.int32)
+    current = cache_sent[rows, jnp.minimum(line_idx, k - 1)]
+    capped = jnp.minimum(current.astype(jnp.int32) + bump,
+                         limit).astype(cache_sent.dtype)
+    return cache_sent.at[rows, line_idx].set(capped, mode="drop")
+
+
+def _census(state: CompressedState, p: CompressedParams):
+    """Per-slot truth (freshest belief among alive nodes) and hit count
+    (#alive nodes whose belief is at truth).  O(N·K + M)."""
+    s, m = p.services_per_node, p.m
+    alive = state.node_alive
+    n_alive = jnp.sum(alive.astype(jnp.int32))
+
+    own_flat = state.own.reshape(m)
+    owner_alive = jnp.repeat(alive, s)
+    own_val = jnp.where(owner_alive, own_flat, 0)
+
+    # Truth: floor ∨ owners ∨ every live cache entry of an alive node.
+    truth = jnp.maximum(state.floor, own_val)
+    cslot = state.cache_slot.reshape(-1)
+    cval = state.cache_val.reshape(-1)
+    centry_alive = jnp.repeat(alive, p.cache_lines)
+    cval = jnp.where((cslot >= 0) & centry_alive, cval, 0)
+    cidx = jnp.where(cslot >= 0, cslot, m)
+    truth = truth.at[cidx].max(cval, mode="drop")
+
+    # Hits: nodes whose belief ≥ truth.  floor ≥ truth ⇒ everyone.
+    all_know = state.floor >= truth
+    # Cache hits — own slots excluded (owners are counted via ``own`` so
+    # a cached copy of one's own record can't double-count).
+    node_of_entry = jnp.repeat(jnp.arange(p.n, dtype=jnp.int32),
+                               p.cache_lines)
+    entry_owner = jnp.where(cslot >= 0, cslot // s, -1)
+    counts = (cval >= truth[jnp.maximum(cslot, 0)]) & (cslot >= 0) \
+        & centry_alive & (entry_owner != node_of_entry)
+    hits = jnp.zeros((m,), jnp.int32).at[cidx].add(
+        counts.astype(jnp.int32), mode="drop")
+    hits = hits + (owner_alive & (own_flat >= truth)).astype(jnp.int32)
+    hits = jnp.where(all_know, n_alive, hits)
+    return truth, hits, n_alive
