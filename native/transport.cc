@@ -529,6 +529,14 @@ class Transport {
         } else if (inc > it->second.incarnation) {
           it->second.incarnation = inc;
           it->second.last_heard = Clock::now();
+          // A newer incarnation is authoritative for the address too: a
+          // member that restarted on a new ip/port (same name, bumped
+          // incarnation) must not keep its stale address here, or probes
+          // and gossip keep going to the dead port until a full
+          // dead-declare/rejoin cycle (memberlist aliveNode updates the
+          // address on a newer incarnation).
+          it->second.ip = ip;
+          it->second.port = port;
           if (it->second.suspect) {
             it->second.suspect = false;
             logf('I', node + " refuted suspicion (incarnation " +
@@ -553,6 +561,13 @@ class Transport {
           events_.push_back("leave " + node);
           queue_membership_locked(kMemberDead, inc, node, ip, port);
           logf('I', node + " declared dead via gossip");
+        } else if (it == members_.end()) {
+          // Unknown member: record the death certificate anyway, so a
+          // node that joined after the member (or never learned of it)
+          // won't readmit it from stale alive frames still circulating
+          // (inc <= death inc) and then have to rediscover the failure
+          // through its own probe cycle.
+          mark_dead_locked(node, inc);
         }
         break;
       default:

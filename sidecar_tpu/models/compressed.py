@@ -17,7 +17,12 @@ raggedness" names this the hard part):
   real node's "interesting" state at any moment is the small delta
   against the converged catalog.  The line index is a global
   multiplicative hash of the slot id, so one slot occupies the SAME
-  line on every node — cross-node exchange is line-aligned.
+  line on every node — deliberately: the floor census folds "every
+  line's unanimously-held winner" per sweep, and winners are only
+  unanimous because freshness order and line assignment are both
+  global (see :func:`hash_line` for why the salted alternative was
+  measured and rejected).  Colliding live slots drain newest-first,
+  losers re-entering via the owners' recovery re-offer.
 * ``floor[M]`` — the shared **converged baseline**: the record version
   every alive node is known to hold.  In the real cluster each of N
   hosts stores the full O(M) catalog; simulating N identical copies of
@@ -30,7 +35,7 @@ line, ties broken by larger slot id; a line's value never regresses.
 Evicting a still-live belief loses information — the model counts those
 evictions (``state.evictions``) so an under-provisioned K is visible —
 and liveness is restored by the owners' recovery re-offer plus the
-line-aligned anti-entropy.
+anti-entropy cache/own exchange.
 
 Scale regime: this model starts CONVERGED (floor = the boot catalog)
 and measures how injected churn — the steady-state workload —
@@ -81,14 +86,27 @@ from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, is_known, pack, unpack_stat
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
 
-_KNUTH = np.uint32(2654435761)
+_K1 = np.uint32(2654435761)   # Knuth multiplicative
+_K3 = np.uint32(0xC2B2AE35)   # murmur3 finalizer constant
 
 
 def hash_line(slot, cache_lines: int):
-    """Global multiplicative (Knuth) hash: slot id → cache line.  The
-    same slot maps to the same line on every node, so caches are
-    line-aligned across the cluster."""
-    u = jnp.asarray(slot).astype(jnp.uint32) * _KNUTH
+    """Global multiplicative hash: slot id → cache line, the SAME line on
+    every node.
+
+    Cross-node alignment is load-bearing for the unanimity census: the
+    fold throughput of the floor is "every line's current winner", and a
+    winner can only be unanimously held if it wins its line on EVERY
+    node — which the global hash guarantees (freshness order is global).
+    A per-node-salted hash was measured and rejected: collisions become
+    independent across nodes, so under capacity pressure only the
+    globally-freshest few records are ever held by all nodes at once and
+    fold throughput collapses (convergence wedged at ~0.4 on a 256-node
+    default-refresh run).  With the global hash a line with several live
+    slots drains newest-first, and evicted losers re-enter through the
+    owners' recovery re-offer (``recover_rounds``) once the line frees."""
+    u = jnp.asarray(slot).astype(jnp.uint32) * _K1
+    u = (u ^ (u >> np.uint32(15))) * _K3
     shift = 32 - int(math.log2(cache_lines))
     return (u >> np.uint32(shift)).astype(jnp.int32)
 
@@ -117,7 +135,9 @@ class CompressedParams:
     budget: int = 15
     drop_prob: float = 0.0
     retransmit_limit: int = 0    # 0 = auto (RetransmitMult semantics)
-    recover_rounds: int = 50     # unconverged-own re-offer cadence
+    recover_rounds: int = 10     # unconverged-own re-offer cadence — the
+                                 # drain rate of collision chains (losers
+                                 # of a shared line re-enter this often)
 
     def __post_init__(self):
         if self.cache_lines & (self.cache_lines - 1):
@@ -245,8 +265,8 @@ class CompressedSim:
         vals = jnp.where(staleness_mask(vals, now, t.stale_ticks), 0, vals)
 
         # Pre-batch belief of (rows, slots).
-        line = hash_line(safe_slots, p.cache_lines)
         safe_rows = jnp.where(valid, rows, 0)
+        line = hash_line(safe_slots, p.cache_lines)
         line_slot = state.cache_slot[safe_rows, line]
         line_val = state.cache_val[safe_rows, line]
         pre = jnp.where(valid, state.floor[safe_slots], 0)
@@ -269,11 +289,28 @@ class CompressedSim:
             evictions=state.evictions + ev)
 
     def _announce(self, state: CompressedState, round_idx, now):
-        """Owner refresh + recovery.  Refresh (staggered) mints a fresh
-        version of every present, non-tombstone own record.  Recovery
-        (staggered) re-seeds the cache line of own slots still above the
-        floor without minting — restoring the transmit budget of a
-        stalled/evicted record."""
+        """Owner refresh + recovery.
+
+        Refresh (staggered per record, ops/gossip.refresh_due) mints a
+        fresh version of every present, non-tombstone own record.  A
+        refresh of a record the whole cluster already holds (own ==
+        floor, status unchanged) folds STRAIGHT into the floor: in the
+        reference, refresh delivery is guaranteed by the 20 s full-state
+        anti-entropy (PushPullInterval ≪ the 80 s ALIVE_LIFESPAN,
+        main.go:252-256) rather than by gossip luck, and the floor is
+        precisely this model's compression of "state every node holds" —
+        simulating N copies of a timestamp bump nothing can invalidate
+        would be pure cache pressure with no information content (the
+        whole catalog would wash through the bounded caches once per
+        refresh interval and drown real churn).  Refreshes of records
+        still in flight (own > floor) mint normally and re-earn
+        convergence through the census.
+
+        Recovery (staggered per node) re-seeds the cache line of own
+        slots still above the floor without minting — restoring the
+        transmit budget of a stalled/evicted record, which is what
+        drains collision chains (the changed-service re-broadcast,
+        services_state.go:538)."""
         p, t = self.p, self.t
         n, s = p.n, p.services_per_node
         node = jnp.arange(n, dtype=jnp.int32)[:, None]          # [N, 1]
@@ -282,16 +319,21 @@ class CompressedSim:
         st = unpack_status(state.own)
         present = is_known(state.own) & state.node_alive[:, None]
 
-        phase = node % t.refresh_rounds
-        refresh_due = ((round_idx % t.refresh_rounds) == phase) & present \
+        refresh_due = gossip_ops.refresh_due(
+            state.own, slots, round_idx, refresh_rounds=t.refresh_rounds,
+            round_ticks=t.round_ticks, now=now) & present \
             & (st != TOMBSTONE)
-        own = jnp.where(refresh_due, pack(now, st), state.own)
+        new_val = pack(now, st)
+        fold = refresh_due & (state.own == state.floor[slots])
+        own = jnp.where(refresh_due, new_val, state.own)
+        floor = state.floor.at[jnp.where(fold, slots, p.m)].max(
+            jnp.where(fold, new_val, 0), mode="drop")
 
         rphase = node % p.recover_rounds
         recover_due = ((round_idx % p.recover_rounds) == rphase) & present \
-            & (own > state.floor[slots])
+            & (own > floor[slots])
 
-        offer = refresh_due | recover_due
+        offer = (refresh_due & ~fold) | recover_due
         vals = jnp.where(offer, own, 0).reshape(-1)
         nodes = jnp.broadcast_to(node, (n, s)).reshape(-1)
         flat_slots = jnp.where(offer, slots, -1).reshape(-1)
@@ -300,15 +342,15 @@ class CompressedSim:
         # transmit-budget reset wherever the line now holds the offer.
         cs, cv, se, ev = _line_compete(
             state.cache_slot, state.cache_val, state.cache_sent,
-            nodes, flat_slots, vals, p.cache_lines, state.floor)
+            nodes, flat_slots, vals, p.cache_lines, floor)
         line = hash_line(jnp.maximum(flat_slots, 0), p.cache_lines)
         holds = (vals > 0) & \
             (cs[jnp.where(vals > 0, nodes, 0), line] == flat_slots)
         reset_rows = jnp.where(holds, nodes, n)
         se = se.at[reset_rows, line].set(jnp.int8(0), mode="drop")
         return dataclasses.replace(
-            state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
-            evictions=state.evictions + ev)
+            state, own=own, floor=floor, cache_slot=cs, cache_val=cv,
+            cache_sent=se, evictions=state.evictions + ev)
 
     def _push_pull_stride(self, state: CompressedState, key, now):
         """Anti-entropy: two-way exchange with the node ``stride``
@@ -438,8 +480,11 @@ class CompressedSim:
         compressed representation in O(N·K + M)."""
         truth, hits, n_alive = _census(state, self.p)
         behind = jnp.maximum(n_alive - hits, 0)
+        # Denominator in float: n_alive·m overflows int32 at the scales
+        # this model exists for (65,536 × 655,360 ≈ 4.3e10).
+        denom = n_alive.astype(jnp.float32) * jnp.float32(self.p.m)
         frac_behind = jnp.sum(behind.astype(jnp.float32)) / \
-            jnp.maximum(n_alive * self.p.m, 1).astype(jnp.float32)
+            jnp.maximum(denom, 1.0)
         return 1.0 - frac_behind
 
     # -- drivers ------------------------------------------------------------

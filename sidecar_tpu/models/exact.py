@@ -148,8 +148,9 @@ class ExactSim:
     def _announce_updates(self, known, node_alive, round_idx, now_tick):
         """Update triples for the owners' refresh re-stamps
         (``BroadcastServices``'s 1-minute path, services_state.go:547-549,
-        staggered per node).  Non-due cells are masked to val 0 / row OOB
-        so the combined scatter drops them.  Tombstones are never
+        staggered per record — hash-spread phase + elapsed-time guard,
+        ops/gossip.refresh_due).  Non-due cells are masked to val 0 / row
+        OOB so the combined scatter drops them.  Tombstones are never
         refreshed — they age out via the 3 h GC."""
         p, t = self.p, self.t
         cols = jnp.arange(p.m, dtype=jnp.int32)
@@ -157,8 +158,9 @@ class ExactSim:
         st = unpack_status(own)
         present = is_known(own) & node_alive[self.owner]
 
-        phase = self.owner % t.refresh_rounds
-        due = ((round_idx % t.refresh_rounds) == phase) & present \
+        due = gossip_ops.refresh_due(
+            own, cols, round_idx, refresh_rounds=t.refresh_rounds,
+            round_ticks=t.round_ticks, now=now_tick) & present \
             & (st != TOMBSTONE)
 
         vals = jnp.where(due, pack(now_tick, st), 0)

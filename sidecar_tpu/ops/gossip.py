@@ -64,6 +64,54 @@ from sidecar_tpu.ops.merge import (
     staleness_mask,
     sticky_adjust,
 )
+from sidecar_tpu.ops.status import unpack_ts
+
+# Knuth's multiplicative constant — the slot-phase spreader for the
+# refresh stagger (and the cache-line hash in models/compressed.py).
+PHASE_MULT = 2654435761
+
+
+def refresh_phase(slots, refresh_rounds: int):
+    """Deterministic per-slot refresh phase, uniform over the whole
+    refresh interval.  Hash-spread (multiplicative) so consecutive slots
+    of one owner don't refresh in one burst."""
+    u = jnp.asarray(slots).astype(jnp.uint32) * jnp.uint32(PHASE_MULT)
+    return (u % jnp.uint32(refresh_rounds)).astype(jnp.int32)
+
+
+def refresh_due(own, slots, round_idx, *, refresh_rounds: int,
+                round_ticks: int, now):
+    """True where an owner's record hits its periodic re-announce this
+    round (``BroadcastServices``'s 1-minute refresh path).
+
+    The reference re-stamps a service when its *own elapsed time* exceeds
+    ALIVE_BROADCAST_INTERVAL, checked on a 1 s loop per service
+    (services_state.go:547-549) — staggering follows each record's own
+    history, never the node index.  The vectorized form keeps both
+    properties:
+
+    * a record is only due on its hash-spread phase round (one slot in
+      ``refresh_rounds`` per round — uniform across the interval), and
+    * only once ``now - ts`` clears a quarter of the interval, so a
+      freshly minted/churned version is never double-announced, and a
+      config that pins the interval far out (the cold-start studies,
+      sim/scenarios.py) is genuinely quiet — zero re-stamps — for any
+      run shorter than interval/4.
+
+    Steady-state period is exactly ``refresh_rounds`` (phase rounds recur
+    every interval and the elapsed guard is then always met); a record
+    minted mid-interval waits between ¼ and 1¼ intervals — within the
+    80 s ALIVE_LIFESPAN for the default 60 s interval, like the
+    reference's interval..interval+1s jitter.
+
+    ``own`` is the owner's packed record, ``slots`` its global slot ids.
+    Callers AND the result with their own present/non-tombstone masks.
+    """
+    at_phase = (round_idx % refresh_rounds) == refresh_phase(
+        slots, refresh_rounds)
+    guard = (refresh_rounds * round_ticks) // 4
+    elapsed = jnp.asarray(now, jnp.int32) - unpack_ts(own)
+    return at_phase & (elapsed >= guard)
 
 
 def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
@@ -106,7 +154,7 @@ def eligible_mask(sent, limit):
     return sent.astype(jnp.int32) < limit
 
 
-def select_messages(known, sent, budget, limit):
+def select_messages(known, sent, budget, limit, row_offset=0):
     """Top-``budget`` freshest *eligible* records per node.
 
     The reference's broadcast queue (``GetBroadcasts`` draining
@@ -116,15 +164,40 @@ def select_messages(known, sent, budget, limit):
     are offered freshest-first (packed keys sort by timestamp), up to
     ``budget`` per round.
 
+    **Tie-break decorrelation**: ``top_k`` breaks value ties by column
+    index, which on a tie-heavy state (a cold-start catalog where every
+    record is ts=1) would make every node offer the SAME lowest-index
+    records each round — a cluster-wide herd that drains the catalog in
+    serialized index waves.  Real nodes have no such alignment: a
+    memberlist broadcast queue is ordered by each node's own
+    transmit/arrival history.  So ties are broken through a per-node
+    rotation of the column (or group) order — node *i* starts its scan
+    at a hashed offset — which spreads cold-start coverage across the
+    cluster.  Values are untouched; only equal-value ordering varies by
+    node.  ``row_offset`` is the global id of row 0 (sharded callers
+    pass their block offset so rotation follows global node identity).
+
     Returns (svc_idx[N, B], msg[N, B]) — ``msg`` is 0 (merge no-op) in
-    slots where a node has fewer than ``budget`` eligible records.
+    slots where a node has fewer than ``budget`` eligible records, and
+    ``svc_idx`` is ``m`` (one past the row end) there, so scatters drop
+    padded entries and gathers read a value the 0 msg never beats.
+    Clamping them to m-1 instead would alias a genuine selection of the
+    last column (duplicate scatter indices resolve nondeterministically).
     """
     priority = jnp.where(eligible_mask(sent, limit), known, 0)
     n, m = priority.shape
     budget = min(budget, m)  # tiny catalogs: can't offer more than exists
+    rows = jnp.arange(n, dtype=jnp.int32) + row_offset
+    rot = rows.astype(jnp.uint32) * jnp.uint32(PHASE_MULT)
 
     if m <= 4 * 1024:
-        msg, svc_idx = lax.top_k(priority, budget)
+        # Full per-row rotation (cheap at this width).
+        r = (rot % jnp.uint32(m)).astype(jnp.int32)
+        idx = (jnp.arange(m, dtype=jnp.int32)[None, :] + r[:, None]) % m
+        pr = jnp.take_along_axis(priority, idx, axis=1)
+        msg, pos = lax.top_k(pr, budget)
+        svc_idx = (pos + r[:, None]) % m
+        svc_idx = jnp.where(msg > 0, svc_idx, m)
         return svc_idx.astype(jnp.int32), msg
 
     # Two-stage exact top-k for wide rows: a flat top_k over M dominates
@@ -134,7 +207,13 @@ def select_messages(known, sent, budget, limit):
     # Any true top-``budget`` element has at most budget-1 elements above
     # it, hence at most budget-1 groups with a strictly larger max, so its
     # group is always among the gathered ones (ties resolve to an
-    # equal-valued — i.e. identical — record).
+    # equal-valued record).  Tie decorrelation here rotates the GROUP
+    # order per node before the group ranking.  A per-row index gather
+    # would be the obvious spelling, but arbitrary-index take_along_axis
+    # on [N, G] measures ~30 ms on TPU v5e (gathers lower badly) — so the
+    # per-row circular shift is done as log2(G) conditional jnp.rolls
+    # (binary shift decomposition), each a fused bandwidth-bound pass
+    # over [N, G] — ~1 ms total.
     sub = max(8, math.isqrt(m // budget) + 1)
     g = -(-m // sub)  # ceil
     pad = g * sub - m
@@ -142,19 +221,29 @@ def select_messages(known, sent, budget, limit):
         priority = jnp.pad(priority, ((0, 0), (0, pad)))
     pr = priority.reshape(n, g, sub)
     gmax = jnp.max(pr, axis=2)
-    _, top_g = lax.top_k(gmax, budget)                         # [N, budget]
+
+    gp = 1 << (g - 1).bit_length()          # pad groups to a power of two
+    gmax_p = jnp.pad(gmax, ((0, 0), (0, gp - g)))
+    r = (rot & jnp.uint32(gp - 1)).astype(jnp.int32)           # [N]
+    rot_view = gmax_p                       # rot_view[i, j] = gmax_p[i, (j+r_i) % gp]
+    for k in range(gp.bit_length() - 1):
+        bit = ((r >> k) & 1)[:, None] == 1
+        rot_view = jnp.where(bit, jnp.roll(rot_view, -(1 << k), axis=1),
+                             rot_view)
+    gval, top_g_rot = lax.top_k(rot_view, budget)              # [N, budget]
+    top_g = (top_g_rot + r[:, None]) % gp
+    # A zero group-max never maps to a real record (priority 0 = merge
+    # no-op), and under-full rows may rank padded groups (index ≥ g):
+    # clamp those to group 0 and zero their candidate values so the
+    # padding contract (msg == 0 ⇒ svc_idx == m) holds without aliasing.
+    keep = gval > 0
+    top_g = jnp.where(keep, top_g, 0)
     cand = jnp.take_along_axis(pr, top_g[:, :, None], axis=1)  # [N, budget, sub]
+    cand = jnp.where(keep[:, :, None], cand, 0)
     msg, pos = lax.top_k(cand.reshape(n, budget * sub), budget)
     gsel = pos // sub
     off = pos % sub
     svc_idx = jnp.take_along_axis(top_g, gsel, axis=1) * sub + off
-    # Padded slots (priority 0 — merge no-ops) must not alias a real
-    # column: clamping them to m-1 would let a padded .set land on the
-    # same cell as a genuine selection of column m-1 (duplicate scatter
-    # indices resolve nondeterministically), silently losing that cell's
-    # transmit-count bump.  Map them PAST the row end instead — scatters
-    # drop them (mode="drop") and gathers clamp to a value the 0 msg
-    # never beats.  Genuine selections (msg > 0) always index < m.
     svc_idx = jnp.where(msg > 0, svc_idx, m)
     return svc_idx.astype(jnp.int32), msg
 

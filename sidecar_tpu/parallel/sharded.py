@@ -158,8 +158,10 @@ class ShardedSim:
                                         nbrs_l, deg_l, cut_l)
 
         # Select offers from the local block + transmit accounting.
+        # row_offset ties the tie-break rotation to GLOBAL node ids so
+        # the selection matches ExactSim bit-for-bit.
         svc_idx, msg = gossip_ops.select_messages(
-            known_l, sent_l, p.budget, limit)
+            known_l, sent_l, p.budget, limit, row_offset=r0)
         sent_l = gossip_ops.record_transmissions(
             sent_l, svc_idx, msg, p.fanout, limit)
 
@@ -196,13 +198,16 @@ class ShardedSim:
         d_rows = jnp.where(local, tgt_local, nl)
 
         # Announce (owners of my rows' slots are exactly my rows).
+        # Phase/guard arithmetic is over GLOBAL slot ids, so it matches
+        # ExactSim._announce_updates bit-for-bit.
         lr = jnp.arange(nl * s, dtype=jnp.int32) // s
         a_cols = r0 * s + jnp.arange(nl * s, dtype=jnp.int32)
         own = known_l[lr, a_cols]
         st = unpack_status(own)
         present = is_known(own) & alive[r0 + lr]
-        phase = (r0 + lr) % t.refresh_rounds
-        due = ((round_idx % t.refresh_rounds) == phase) & present \
+        due = gossip_ops.refresh_due(
+            own, a_cols, round_idx, refresh_rounds=t.refresh_rounds,
+            round_ticks=t.round_ticks, now=now) & present \
             & (st != TOMBSTONE)
         a_vals = jnp.where(due, pack(now, st), 0)
         a_rows = jnp.where(due, lr, nl)

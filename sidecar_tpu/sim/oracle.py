@@ -148,7 +148,12 @@ class OracleSim:
                         continue
                     self.apply_one(tgt, int(svc_idx[s, b]), val, pre)
 
-        # 2. announce re-stamps (end of round, same scatter in the kernel).
+        # 2. announce re-stamps (end of round, same scatter in the
+        # kernel).  Independent sequential mirror of the kernel's
+        # refresh stagger (ops/gossip.refresh_due): hash-spread per-slot
+        # phase + per-record elapsed-time guard — the reference refreshes
+        # on each service's own elapsed time (services_state.go:547-549).
+        guard = (t.refresh_rounds * t.round_ticks) // 4
         for m in range(p.m):
             o = int(self.owner[m])
             if not self.node_alive[o]:
@@ -157,8 +162,9 @@ class OracleSim:
             ts, st = _ts(cur), _st(cur)
             if ts == 0 or st == TOMBSTONE:
                 continue
-            phase = o % t.refresh_rounds
-            if (self.round_idx % t.refresh_rounds) == phase:
+            phase = ((m * 2654435761) & 0xFFFFFFFF) % t.refresh_rounds
+            if (self.round_idx % t.refresh_rounds) == phase \
+                    and (now - ts) >= guard:
                 self.apply_one(o, m, _pack(now, st), pre)
 
         # 3. anti-entropy push-pull.

@@ -53,9 +53,25 @@ def load_native() -> ctypes.CDLL:
                                     capture_output=True)
             if result.returncode != 0:
                 err = result.stderr.decode(errors="replace")
+                usable_prebuilt = False
                 if _SO_PATH.exists():
                     # Toolchain-less host with a prebuilt (if stale-
-                    # looking) library: warn and use what's there.
+                    # looking) library: usable only if it already has the
+                    # full current ABI — probe the newest symbol, else the
+                    # argtypes setup below would die with a confusing
+                    # AttributeError instead of the build error.
+                    try:
+                        probe = ctypes.CDLL(str(_SO_PATH))
+                        usable_prebuilt = \
+                            hasattr(probe, "st_next_state_len") \
+                            and hasattr(probe, "st_configure_probe") \
+                            and hasattr(probe, "st_poll_log")
+                    except OSError:
+                        # Unloadable (corrupt/wrong-arch) prebuilt: fall
+                        # through to the RuntimeError that carries the
+                        # actionable compiler output.
+                        usable_prebuilt = False
+                if usable_prebuilt:
                     log.warning("Native transport rebuild failed; using "
                                 "existing library. Build output:\n%s", err)
                 else:

@@ -39,22 +39,23 @@ class TestScenarios:
     def test_config4_ba_small(self):
         result = scenarios.config4_ba_antientropy(rounds=250, scale=0.002)
         assert result.scaled_from == 65_536
-        # ε-convergence (1%) must be reached; the last stragglers drain
-        # through periodic anti-entropy.
-        assert result.eps_round is not None
-        assert result.convergence[-1] >= 0.995
+        # Compressed model: churn burst must fully drain; ε (0.1%) is
+        # scaled to the 1% churn magnitude and must be genuinely reached
+        # (not at round 1 — the burst starts ~1% behind).
+        assert result.eps_round is not None and result.eps_round > 1
+        assert result.convergence[-1] == 1.0
 
     def test_config5_split_heal_small(self):
         result = scenarios.config5_split_heal(
             split_rounds=80, heal_rounds=320, scale=0.0001)
         assert result.scaled_from == 1_000_000
-        # While split, convergence must NOT complete; healing drains the
-        # backlog through the boundary (throughput-bound, hence ε).
+        # While split, the one-side churn must NOT drain (cross-side
+        # gossip and anti-entropy are severed); healing completes it.
         split_part = result.convergence[:80]
         assert split_part.max() < 1.0
         assert result.eps_round is not None
         assert result.eps_round > 80  # ε reached only after the heal
-        assert result.convergence[-1] >= 0.99
+        assert result.convergence[-1] == 1.0
 
 
 class TestCheckpoint:

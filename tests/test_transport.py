@@ -158,13 +158,44 @@ class TestSwim:
     def test_indirect_probe_saves_one_way_partitioned_node(self):
         """A cannot hear B's pings/acks (one-way loss), but B is healthy:
         A's ping-req through C must keep B alive — no suspicion, no
-        leave."""
+        leave.
+
+        Membership alone can't isolate the ping-req path: were it broken,
+        A's suspicion broadcast would reach B via gossip and B's
+        refutation would clear it before the timeout (the mechanism
+        test_falsely_suspected_node_refutes covers).  So this test also
+        listens to the engine log bridge and requires that A NEVER
+        suspects B at all — the relayed ack must answer the probe before
+        suspicion ever fires."""
+        import logging
+
+        from sidecar_tpu.transport import gossip as gossip_transport
         from sidecar_tpu.transport.gossip import DROP_ACK, DROP_PING
 
-        state_a, ta = make_node("swim-a", **SWIM_KW)
-        state_b, tb = make_node("swim-b", **SWIM_KW)
-        state_c, tc = make_node("swim-c", **SWIM_KW)
+        captured: list[str] = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                captured.append(record.getMessage())
+
+        handler = _Capture()
+        logger = logging.getLogger(gossip_transport.__name__)
+        old_level = logger.level
+        transports = []
         try:
+            logger.addHandler(handler)
+            # The bridge re-emits engine lines at INFO; without forcing
+            # the level, the default WARNING threshold would filter them
+            # before any handler runs and the no-suspicion assertion
+            # below would be vacuously true.
+            logger.setLevel(logging.INFO)
+
+            state_a, ta = make_node("swim-a", **SWIM_KW)
+            transports.append(ta)
+            state_b, tb = make_node("swim-b", **SWIM_KW)
+            transports.append(tb)
+            state_c, tc = make_node("swim-c", **SWIM_KW)
+            transports.append(tc)
             port_a = ta.start(state_a)
             tb.start(state_b)
             tc.start(state_c)
@@ -183,8 +214,14 @@ class TestSwim:
             assert hold_for(lambda: "swim-b" in ta.members(), 3.0), \
                 "one-way-partitioned node was declared dead despite " \
                 "healthy indirect path"
+            suspicions = [m for m in captured if "suspecting swim-b" in m]
+            assert not suspicions, (
+                "A suspected B — membership survived only via "
+                f"refutation, not the ping-req path: {suspicions}")
         finally:
-            for t in (ta, tb, tc):
+            logger.setLevel(old_level)
+            logger.removeHandler(handler)
+            for t in transports:
                 t.stop()
 
     def test_falsely_suspected_node_refutes(self):
