@@ -1,4 +1,4 @@
-"""Headline benchmark: simulated gossip rounds/sec/chip.
+"""Headline benchmark: simulated gossip rounds/sec/chip + the north star.
 
 The reference runs gossip in real time — one round per GossipInterval
 (200 ms, config/config.go:47), i.e. 5 rounds/sec regardless of hardware.
@@ -8,13 +8,36 @@ full cluster-wide gossip rounds one chip simulates per second, and
 ``vs_baseline`` is the speedup over the reference's 5 rounds/sec
 wall-clock rate (BASELINE.md north-star table).
 
-Default config: 4,096-node Erdős–Rényi cluster (BASELINE.json config 3's
-graph: avg degree 8, seed 3 — matching sim/scenarios.py) with 10
-services/node — 4096 × 40,960 packed-int32 state (~670 MB), fanout 3,
-budget 15.
+Two models are measured on the same 4,096-node Erdős–Rényi cluster
+(BASELINE.json config 3's graph: avg degree 8, seed 3; 10 services/node,
+fanout 3, budget 15):
+
+* ``value`` — the DENSE exact model (``known[N, N·spn]``, oracle-grade
+  record-level semantics).  Roofline: the dense round is bound by its
+  two full-tensor scatters (known 671 MB + sent 168 MB rewritten per
+  round); measured v5e scatter cost at these shapes is 10-18 ms per
+  buffer touch nearly independent of update count, so ~40 ms/round ≈
+  25 rounds/sec sits within ~2× of the scatter-imposed floor — more
+  speed requires a different state representation, not a faster kernel.
+* ``compressed_rounds_per_sec`` — the bounded-memory large-cluster model
+  (models/compressed.py) on the SAME cluster: O(N·K + M) state with the
+  global line-aligned cache, whose board/pull delivery is pure
+  elementwise compute (zero per-round scatters) — ~9× the dense model
+  at equal N, and the only representation that reaches 100k+ nodes.
+
+``north_star`` reports BASELINE.md's second target: wall-clock to
+ε-convergence of a churn burst on a 100k-node / 1M-service cluster.
+The burst drains through the real protocol budget (15 records per
+~1398 B packet per peer, fanout 3), so SIMULATED time is
+bandwidth-bound exactly as the reference would be; the benchmark
+measures how fast one chip crunches those rounds.  The <10 s target is
+set for a v5e-8; this runs on the driver's single chip — the sharded
+twin (parallel/sharded_compressed.py, validated on the virtual 8-device
+mesh) is the scaling path.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+   "compressed_rounds_per_sec": N, "north_star": {...}}
 """
 
 from __future__ import annotations
@@ -25,22 +48,11 @@ import sys
 import time
 
 
-def main() -> None:
-    # Keep the virtual-CPU test config out of the way: bench runs on
-    # whatever real platform the driver provides.
+def _bench_dense(n, spn, rounds):
     import jax
 
     from sidecar_tpu.models.exact import ExactSim, SimParams
     from sidecar_tpu.ops.topology import erdos_renyi
-
-    n = int(os.environ.get("BENCH_NODES", "4096"))
-    spn = int(os.environ.get("BENCH_SERVICES_PER_NODE", "10"))
-    rounds = int(os.environ.get("BENCH_ROUNDS", "200"))
-
-    platform = jax.devices()[0].platform
-    if platform == "cpu" and "BENCH_NODES" not in os.environ:
-        # CPU fallback (no TPU attached): shrink so the bench still runs.
-        n, rounds = 512, 50
 
     params = SimParams(n=n, services_per_node=spn, fanout=3, budget=15)
     sim = ExactSim(params, erdos_renyi(n, avg_degree=8.0, seed=3))
@@ -55,17 +67,112 @@ def main() -> None:
     t0 = time.perf_counter()
     final = sim.run_fast(state, key, rounds)
     jax.device_get(final.known[0, :4])
-    dt = time.perf_counter() - t0
+    return rounds / (time.perf_counter() - t0)
 
-    rounds_per_sec = rounds / dt
-    # Reference wall-clock rate: 1 round / 200 ms gossip interval.
-    baseline_rounds_per_sec = 5.0
 
+def _bench_compressed(n, spn, rounds):
+    import jax
+
+    from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+    from sidecar_tpu.models.timecfg import TimeConfig
+    from sidecar_tpu.ops.topology import erdos_renyi
+
+    cfg = TimeConfig(refresh_interval_s=10_000.0, push_pull_interval_s=4.0)
+    params = CompressedParams(n=n, services_per_node=spn, fanout=3,
+                              budget=15, cache_lines=256)
+    sim = CompressedSim(params, erdos_renyi(n, avg_degree=8.0, seed=3), cfg)
+    state = sim.init_state()
+    key = jax.random.PRNGKey(0)
+
+    warm = sim.run_fast(state, key, rounds)
+    jax.device_get(warm.own[0, :4])
+    t0 = time.perf_counter()
+    final = sim.run_fast(state, key, rounds)
+    jax.device_get(final.own[0, :4])
+    return rounds / (time.perf_counter() - t0)
+
+
+def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds):
+    """Wall-clock for one chip to simulate a ``churn_frac`` burst on an
+    n-node / n·spn-service cluster to ε-convergence (compressed model;
+    the churn workload of BASELINE config 4 at north-star scale)."""
+    import jax
+    import numpy as np
+
+    from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+    from sidecar_tpu.models.timecfg import TimeConfig
+    from sidecar_tpu.ops.topology import erdos_renyi
+
+    cfg = TimeConfig(refresh_interval_s=10_000.0, push_pull_interval_s=4.0)
+    params = CompressedParams(n=n, services_per_node=spn, fanout=3,
+                              budget=15, cache_lines=256)
+    sim = CompressedSim(params, erdos_renyi(n, avg_degree=8.0, seed=3), cfg)
+    rng = np.random.default_rng(7)
+    slots = np.sort(
+        rng.choice(params.m, size=max(1, int(params.m * churn_frac)),
+                   replace=False)).astype(np.int32)
+    state = sim.mint(sim.init_state(), slots, 10)
+    key = jax.random.PRNGKey(0)
+
+    chunk = 25
+    warm, c = sim.run(state, key, chunk, conv_every)
+    jax.device_get(c)
+
+    t0 = time.perf_counter()
+    total, conv_last, conv_max = 0, 0.0, 0.0
+    while total < max_rounds:
+        state, conv = sim.run(state, key, chunk, conv_every)
+        conv = np.asarray(jax.device_get(conv))
+        total += chunk
+        conv_last = float(conv[-1])
+        conv_max = max(conv_max, float(conv.max()))
+        if conv_max >= 1.0 - eps:
+            break
+    wall = time.perf_counter() - t0
+    reached = conv_max >= 1.0 - eps
+    return {
+        "n": n,
+        "services": n * spn,
+        "churn_frac": churn_frac,
+        "eps": eps,
+        "rounds_to_eps": total if reached else None,
+        "sim_seconds_to_eps": round(total * 0.2, 1) if reached else None,
+        "final_convergence": round(conv_last, 6),
+        "wall_seconds_single_chip": round(wall, 2),
+        "wall_ms_per_round": round(wall / total * 1000, 1),
+        "target": "<10 s on v5e-8 (this is 1 chip; scaling path: "
+                  "parallel/sharded_compressed.py)",
+    }
+
+
+def main() -> None:
+    import jax
+
+    n = int(os.environ.get("BENCH_NODES", "4096"))
+    spn = int(os.environ.get("BENCH_SERVICES_PER_NODE", "10"))
+    rounds = int(os.environ.get("BENCH_ROUNDS", "200"))
+    ns_n = int(os.environ.get("BENCH_NORTH_STAR_NODES", "100000"))
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and "BENCH_NODES" not in os.environ:
+        # CPU fallback (no TPU attached): shrink so the bench still runs.
+        n, rounds, ns_n = 512, 50, 4096
+
+    dense_rps = _bench_dense(n, spn, rounds)
+    compressed_rps = _bench_compressed(n, spn, rounds)
+    north_star = _bench_north_star(ns_n, spn, churn_frac=0.001, eps=1e-4,
+                                   conv_every=25, max_rounds=400)
+
+    # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
+    # (GossipInterval 200 ms), hardware-independent.
     print(json.dumps({
-        "metric": f"simulated gossip rounds/sec/chip (n={n}, spn={spn}, {platform})",
-        "value": round(rounds_per_sec, 3),
+        "metric": f"simulated gossip rounds/sec/chip (n={n}, spn={spn}, "
+                  f"{platform})",
+        "value": round(dense_rps, 3),
         "unit": "rounds/sec/chip",
-        "vs_baseline": round(rounds_per_sec / baseline_rounds_per_sec, 3),
+        "vs_baseline": round(dense_rps / 5.0, 3),
+        "compressed_rounds_per_sec": round(compressed_rps, 3),
+        "north_star": north_star,
     }))
 
 

@@ -45,26 +45,54 @@ the physically meaningful question is delta propagation, which is what
 bounded caches represent.
 
 Round structure (mirrors models/exact.py):
-1. select + deliver — top-``budget`` freshest eligible cache entries to
-   ``fanout`` sampled peers; deliveries resolve through ONE
-   line-competition scatter pass (two scatter-maxes: value, then
-   winning slot on value ties) with merge semantics — staleness gate,
-   acceptance against the pre-round belief, DRAINING stickiness —
-   applied to the values first, exactly like ops/gossip.py.
+1. publish + pull — each node publishes its top-``budget`` freshest
+   eligible cache lines as a message **board**, and pulls the boards of
+   ``fanout`` sampled peers.  Because the line hash is GLOBAL, every
+   board is line-ALIGNED with every cache: delivery is a pure
+   elementwise lexicographic max over ``[N, fanout, K]`` — no scatters.
+   Merge semantics ride along elementwise: staleness gate, acceptance
+   against the pre-round line, same-slot DRAINING stickiness.
 2. announce — staggered owner re-stamps (the 1-minute refresh,
    services_state.go:547-549) minting a new version, plus **recovery**
    re-offers: own slots still above the floor re-enter the owner's
    cache with a fresh transmit budget WITHOUT a new version (the
    changed-service re-broadcast, services_state.go:538) — this is what
-   makes convergence immune to cache evictions.
+   makes convergence immune to cache evictions.  Owner slots are
+   row-aligned with the floor (``floor.reshape(N, S)``), so the
+   refresh fold is elementwise; cache inserts are S broadcast-compare
+   passes (one per service column), again scatter-free.
 3. anti-entropy — every push-pull cadence, a two-way full-cache +
-   own-rows exchange with the node ``stride`` positions away, routed
-   through the same merge path.
+   own-rows exchange with the node ``stride`` positions away.  Caches
+   are line-aligned across nodes, so the exchange is ``jnp.roll`` +
+   elementwise merge; own rows ride the same S-pass insert.
 4. floor advance + sweep — per-slot census (truth = freshest belief,
    hits = #alive nodes at truth); slots where every alive node agrees
    fold into the floor and their cache lines free; the TTL sweep
    (ops/ttl.py) runs over own + cache + floor — one shared floor sweep
    models every node's identical deterministic sweep.
+
+TPU cost model (measured on v5e; the reason for the board form): XLA
+scatters with dynamic duplicate indices cost ~10-130 ms at these shapes
+while the equivalent elementwise/row-gather passes cost ~1-15 ms, so
+the round keeps ZERO per-round scatters — the only scattered paths left
+are the (amortized) census and the host-side ``mint``.  Two documented
+semantic refinements come with the form, both self-consistent across
+this model, its oracle uses, and the sharded twin:
+
+* **Pull, not push**: peers pull ``fanout`` boards instead of pushing
+  to ``fanout`` targets — the same expected edge set per round on the
+  same topology (reversed direction), the same per-packet budget, the
+  standard epidemic-dissemination dual (push ≈ pull to first order;
+  pull is in fact stronger in the drain tail).
+* **Floor-mediated stickiness folds at the census**: a DRAINING belief
+  held only in the floor sticks when the census folds a newer ALIVE
+  version (``apply_stickiness`` at the fold) rather than per delivery —
+  beliefs may transiently read ALIVE in between (the reference applies
+  it per message against each host's full catalog,
+  services_state.go:329-331; the floor IS that catalog here, and the
+  observable outcome — the converged status — is identical).  Same-slot
+  stickiness (a cached DRAINING belief) still applies per delivery,
+  elementwise.
 """
 
 from __future__ import annotations
@@ -81,8 +109,19 @@ from jax import lax
 
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
-from sidecar_tpu.ops.merge import staleness_mask, sticky_adjust
-from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, is_known, pack, unpack_status
+from sidecar_tpu.ops.merge import (
+    apply_stickiness,
+    staleness_mask,
+    sticky_adjust,
+)
+from sidecar_tpu.ops.status import (
+    ALIVE,
+    TOMBSTONE,
+    is_known,
+    pack,
+    unpack_status,
+    unpack_ts,
+)
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
 
@@ -138,12 +177,18 @@ class CompressedParams:
     recover_rounds: int = 10     # unconverged-own re-offer cadence — the
                                  # drain rate of collision chains (losers
                                  # of a shared line re-enter this often)
+    fold_quorum: float = 0.995   # census fold threshold; < 1.0 models the
+                                 # anti-entropy delivery guarantee for the
+                                 # straggler tail (see
+                                 # _floor_advance_and_sweep)
 
     def __post_init__(self):
         if self.cache_lines & (self.cache_lines - 1):
             raise ValueError("cache_lines must be a power of two")
         if self.budget > self.cache_lines:
             raise ValueError("budget cannot exceed cache_lines")
+        if not 0.0 < self.fold_quorum <= 1.0:
+            raise ValueError("fold_quorum must be in (0, 1]")
 
     @property
     def m(self) -> int:
@@ -229,67 +274,146 @@ class CompressedSim:
 
     # -- kernels ------------------------------------------------------------
 
-    def _select(self, state: CompressedState, limit: int):
-        """Top-``budget`` freshest eligible cache entries per node.
-        Eligible = transmits left AND still above the floor (entries the
-        whole cluster already knows are dead weight)."""
+    def _publish(self, state: CompressedState, limit: int,
+                 row_offset=0):
+        """The message board: each node's top-``budget`` freshest
+        eligible cache lines, in place (``[N, K]``, unselected lines
+        zeroed).  Eligible = occupied with transmits left.
+
+        Budget selection is ``top_k``-exact but materialized as an
+        elementwise mask: values strictly above the B-th largest are in;
+        ties at the threshold fill the remaining slots in a PER-NODE
+        rotated line order (a cumsum rank over a rotated view).  The
+        rotation is load-bearing: a churn burst mints many records at
+        one tick — equal packed values on every node — and a fixed tie
+        order would make the whole cluster publish the SAME ``budget``
+        lines while the rest never spread (the cluster-aligned index
+        herd the dense model's select_messages also rotates away).  The
+        rotation is implemented as log2(K) conditional ``jnp.roll``
+        passes (arbitrary per-row gathers measure ~100× slower than
+        rolls on TPU v5e, ops/gossip.select_messages).  Entries at or
+        below the floor cannot linger here: census line-freeing and the
+        insert filters maintain that invariant (see ``_pull_merge``)."""
         p = self.p
-        slot, val = state.cache_slot, state.cache_val
-        live = (slot >= 0) & (val > state.floor[jnp.maximum(slot, 0)])
-        eligible = live & (state.cache_sent.astype(jnp.int32) < limit)
-        priority = jnp.where(eligible, val, 0)
-        msg, line_idx = lax.top_k(priority, min(p.budget, p.cache_lines))
-        sel_slot = jnp.take_along_axis(slot, line_idx, axis=1)
-        sel_slot = jnp.where(msg > 0, sel_slot, -1)
-        # Padded lines index past K so scatters drop them (see
-        # ops/gossip.select_messages for the aliasing hazard).
-        line_idx = jnp.where(msg > 0, line_idx, p.cache_lines)
-        return line_idx.astype(jnp.int32), sel_slot, msg
+        k = p.cache_lines
+        eligible = (state.cache_slot >= 0) & \
+            (state.cache_sent.astype(jnp.int32) < limit)
+        priority = jnp.where(eligible, state.cache_val, 0)
+        budget = min(p.budget, k)
+        top = lax.top_k(priority, budget)[0]
+        thresh = top[:, -1:]
+        above = priority > thresh
+        tie = (priority == thresh) & (priority > 0)
+        n_above = jnp.sum(above, axis=1, keepdims=True)
 
-    def _apply(self, state: CompressedState, sent, rows, slots, vals,
-               now):
-        """Merge flat (node, slot, val) updates with full merge
-        semantics: staleness gate, acceptance against the pre-batch
-        belief, DRAINING stickiness.  Own-slot updates also land in
-        ``own``; every accepted update enters the cache via line
-        competition (an accepted record re-offers — the relay,
-        services_state.go:377-392)."""
+        n = priority.shape[0]
+        rows = jnp.arange(n, dtype=jnp.int32) + row_offset
+        rot = (rows.astype(jnp.uint32) * jnp.uint32(gossip_ops.PHASE_MULT)
+               & jnp.uint32(k - 1)).astype(jnp.int32)
+        view = tie
+        for b in range(k.bit_length() - 1):
+            bit = ((rot >> b) & 1)[:, None] == 1
+            view = jnp.where(bit, jnp.roll(view, -(1 << b), axis=1), view)
+        rank = jnp.cumsum(view.astype(jnp.int32), axis=1)
+        admit_rot = view & (rank <= budget - n_above)
+        for b in range(k.bit_length() - 1):
+            bit = ((rot >> b) & 1)[:, None] == 1
+            admit_rot = jnp.where(
+                bit, jnp.roll(admit_rot, 1 << b, axis=1), admit_rot)
+
+        selected = above | admit_rot
+        bval = jnp.where(selected, state.cache_val, 0)
+        bslot = jnp.where(selected, state.cache_slot, -1)
+        sent = jnp.minimum(
+            state.cache_sent.astype(jnp.int32)
+            + jnp.where(selected, p.fanout, 0),
+            limit).astype(jnp.int8)
+        return bval, bslot, sent
+
+    @staticmethod
+    def _lex_max(wv, ws, cv, cs):
+        """Line competition, elementwise: largest val wins, value ties
+        break to the larger slot id (the _line_compete rule)."""
+        adv = (cv > wv) | ((cv == wv) & (cs > ws))
+        return jnp.where(adv, cv, wv), jnp.where(adv, cs, ws)
+
+    def _pull_merge(self, state: CompressedState, sent, bval, bslot, src,
+                    alive, now, drop_key=None):
+        """Deliver: each receiver pulls the boards of its ``src`` peers
+        and lex-merges them into its cache, entirely elementwise — the
+        global line hash aligns every board with every cache, so slot
+        competition happens within each line position.
+
+        Merge semantics per candidate (vs the PRE-round line, one
+        consistent batch resolution like ops/gossip.prepare_deliveries):
+        staleness gate; dead sources/receivers contribute/accept
+        nothing; ``drop_prob`` models UDP loss; same-slot DRAINING
+        stickiness rewrites an advancing ALIVE to DRAINING.  ``state``
+        may be a shard-local view; ``bval``/``bslot`` are the full
+        board, ``src`` holds global peer ids."""
         p, t = self.p, self.t
-        s = p.services_per_node
-        safe_slots = jnp.maximum(slots, 0)
-        owner_of = safe_slots // s
-        col = safe_slots % s
-        valid = (slots >= 0) & (vals > 0)
-        is_own = (owner_of == rows) & valid
+        cv0, cs0 = state.cache_val, state.cache_slot
+        pv = bval[src]    # [nl, F, K] — row gathers, contiguous in K
+        ps = bslot[src]
+        ok = alive[src] & state.node_alive[:, None]      # [nl, F]
+        pv = jnp.where(ok[:, :, None], pv, 0)
+        if p.drop_prob > 0.0:
+            keep = jax.random.bernoulli(drop_key, 1.0 - p.drop_prob,
+                                        pv.shape)
+            pv = jnp.where(keep, pv, 0)
+        pv = jnp.where(staleness_mask(pv, now, t.stale_ticks), 0, pv)
+        ps = jnp.where(pv > 0, ps, -1)
 
-        vals = jnp.where(staleness_mask(vals, now, t.stale_ticks), 0, vals)
+        wv, ws = cv0, cs0
+        for f in range(pv.shape[1]):
+            cand_v, cand_s = pv[:, f], ps[:, f]
+            cand_v = sticky_adjust(cand_v, cv0,
+                                   (cand_s == cs0) & (cand_v > cv0))
+            wv, ws = self._lex_max(wv, ws, cand_v, cand_s)
 
-        # Pre-batch belief of (rows, slots).
-        safe_rows = jnp.where(valid, rows, 0)
-        line = hash_line(safe_slots, p.cache_lines)
-        line_slot = state.cache_slot[safe_rows, line]
-        line_val = state.cache_val[safe_rows, line]
-        pre = jnp.where(valid, state.floor[safe_slots], 0)
-        pre = jnp.maximum(pre, jnp.where(line_slot == slots, line_val, 0))
-        own_pre = state.own[safe_rows, col]
-        pre = jnp.maximum(pre, jnp.where(is_own, own_pre, 0))
-
-        advanced = (vals > pre) & valid
-        vals = sticky_adjust(vals, pre, advanced)
-        vals = jnp.where(advanced, vals, 0)
-
-        own_rows = jnp.where(is_own & advanced, rows, p.n)
-        own = state.own.at[own_rows, col].max(vals, mode="drop")
-
-        cs, cv, se, ev = _line_compete(
-            state.cache_slot, state.cache_val, sent,
-            rows, slots, vals, p.cache_lines, state.floor)
+        changed = (wv != cv0) | (ws != cs0)
+        sent = jnp.where(changed, jnp.int8(0), sent)
+        evicted = (cs0 >= 0) & (ws != cs0)
         return dataclasses.replace(
-            state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
-            evictions=state.evictions + ev)
+            state, cache_slot=ws, cache_val=wv, cache_sent=sent,
+            evictions=state.evictions
+            + jnp.sum(evicted.astype(jnp.int32)))
 
-    def _announce(self, state: CompressedState, round_idx, now):
-        """Owner refresh + recovery.
+    def _insert_own_offers(self, cache_val, cache_slot, cache_sent,
+                           offer_val, slots, lines, reset_on_hold=False):
+        """Insert owner offers (``[nl, S]`` values at their global slots
+        / precomputed lines) into the cache via S broadcast-compare
+        passes — one elementwise pass per service column instead of a
+        scatter.  With ``reset_on_hold`` (the OWNER's announce path
+        only), a line that ends up holding the offered slot gets its
+        transmit budget reset even if nothing changed — the recovery
+        re-offer's whole point (services_state.go:538); third parties
+        (the push-pull exchange) reset only on change, like any merge
+        accept.  Returns the cache triple + evictions."""
+        k_idx = jnp.arange(self.p.cache_lines, dtype=jnp.int32)[None, :]
+        cv0, cs0 = cache_val, cache_slot
+        for s in range(slots.shape[1]):
+            at_line = k_idx == lines[:, s:s + 1]
+            cand_v = jnp.where(at_line, offer_val[:, s:s + 1], 0)
+            cand_s = jnp.where(cand_v > 0, slots[:, s:s + 1], -1)
+            cand_v = sticky_adjust(cand_v, cv0,
+                                   (cand_s == cs0) & (cand_v > cv0))
+            cache_val, cache_slot = self._lex_max(
+                cache_val, cache_slot, cand_v, cand_s)
+            if reset_on_hold:
+                holds = at_line & (cand_v > 0) & (cache_slot == cand_s)
+                cache_sent = jnp.where(holds, jnp.int8(0), cache_sent)
+        changed = (cache_slot != cs0) | (cache_val != cv0)
+        cache_sent = jnp.where(changed, jnp.int8(0), cache_sent)
+        ev = jnp.sum(((cache_slot != cs0) & (cs0 >= 0)).astype(jnp.int32))
+        return cache_val, cache_slot, cache_sent, ev
+
+    def _announce(self, state: CompressedState, round_idx, now,
+                  row_offset=0):
+        """Owner refresh + recovery — fully elementwise: owner slots are
+        row-aligned with the floor (``floor.reshape(N, S)``), so the
+        refresh fold needs no scatter, and cache inserts go through the
+        S-pass broadcast compare (``_insert_own_offers``).
 
         Refresh (staggered per record, ops/gossip.refresh_due) mints a
         fresh version of every present, non-tombstone own record.  A
@@ -312,9 +436,14 @@ class CompressedSim:
         drains collision chains (the changed-service re-broadcast,
         services_state.go:538)."""
         p, t = self.p, self.t
-        n, s = p.n, p.services_per_node
+        s = p.services_per_node
+        n = state.own.shape[0]        # local row count (= p.n single-chip)
         node = jnp.arange(n, dtype=jnp.int32)[:, None]          # [N, 1]
-        slots = jnp.arange(p.m, dtype=jnp.int32).reshape(n, s)  # [N, S]
+        gnode = node + row_offset                               # global ids
+        slots = row_offset * s + \
+            jnp.arange(n * s, dtype=jnp.int32).reshape(n, s)    # [N, S]
+        floor_l = lax.dynamic_slice(
+            state.floor, (row_offset * s,), (n * s,)).reshape(n, s)
 
         st = unpack_status(state.own)
         present = is_known(state.own) & state.node_alive[:, None]
@@ -324,81 +453,122 @@ class CompressedSim:
             round_ticks=t.round_ticks, now=now) & present \
             & (st != TOMBSTONE)
         new_val = pack(now, st)
-        fold = refresh_due & (state.own == state.floor[slots])
+        fold = refresh_due & (state.own == floor_l)
         own = jnp.where(refresh_due, new_val, state.own)
-        floor = state.floor.at[jnp.where(fold, slots, p.m)].max(
-            jnp.where(fold, new_val, 0), mode="drop")
+        floor_l = jnp.where(fold, new_val, floor_l)
+        floor = lax.dynamic_update_slice(
+            state.floor, floor_l.reshape(-1), (row_offset * s,))
 
-        rphase = node % p.recover_rounds
+        rphase = gnode % p.recover_rounds
         recover_due = ((round_idx % p.recover_rounds) == rphase) & present \
-            & (own > floor[slots])
+            & (own > floor_l)
 
         offer = (refresh_due & ~fold) | recover_due
-        vals = jnp.where(offer, own, 0).reshape(-1)
-        nodes = jnp.broadcast_to(node, (n, s)).reshape(-1)
-        flat_slots = jnp.where(offer, slots, -1).reshape(-1)
-
-        # Owner-authoritative insert: straight line competition, then a
-        # transmit-budget reset wherever the line now holds the offer.
-        cs, cv, se, ev = _line_compete(
-            state.cache_slot, state.cache_val, state.cache_sent,
-            nodes, flat_slots, vals, p.cache_lines, floor)
-        line = hash_line(jnp.maximum(flat_slots, 0), p.cache_lines)
-        holds = (vals > 0) & \
-            (cs[jnp.where(vals > 0, nodes, 0), line] == flat_slots)
-        reset_rows = jnp.where(holds, nodes, n)
-        se = se.at[reset_rows, line].set(jnp.int8(0), mode="drop")
+        offer_val = jnp.where(offer, own, 0)
+        lines = hash_line(slots, p.cache_lines)
+        cv, cs, se, ev = self._insert_own_offers(
+            state.cache_val, state.cache_slot, state.cache_sent,
+            offer_val, slots, lines, reset_on_hold=True)
         return dataclasses.replace(
             state, own=own, floor=floor, cache_slot=cs, cache_val=cv,
             cache_sent=se, evictions=state.evictions + ev)
 
     def _push_pull_stride(self, state: CompressedState, key, now):
         """Anti-entropy: two-way exchange with the node ``stride``
-        positions away — each side's full cache plus its own rows, all
-        routed through the standard merge path.  Split scenarios mask
-        the exchange where the two sides differ (a partition severs TCP
-        push-pull too)."""
-        p = self.p
+        positions away — each side's full cache plus its own rows.
+        Caches are line-aligned across nodes, so the cache half is
+        ``jnp.roll`` + elementwise lex-merge (on the sharded twin the
+        roll lowers to a collective-permute); own rows (their slot ids
+        and floor rows roll along with them) go through the S-pass
+        insert.  Split scenarios mask the exchange where the two sides
+        differ (a partition severs TCP push-pull too)."""
+        p, t = self.p, self.t
+        s = p.services_per_node
         stride = jax.random.randint(key, (), 1, p.n, dtype=jnp.int32)
         alive = state.node_alive
-        my_node = jnp.arange(p.n, dtype=jnp.int32)
-        own_slots = jnp.arange(p.m, dtype=jnp.int32).reshape(
-            p.n, p.services_per_node)
+        own_slots = jnp.arange(p.m, dtype=jnp.int32).reshape(p.n, s)
+        floor_rs = state.floor.reshape(p.n, s)
 
-        all_rows, all_slots, all_vals = [], [], []
+        cv0, cs0 = state.cache_val, state.cache_slot
+        wv, ws = cv0, cs0
+        sent = state.cache_sent
+        ev = state.evictions
         for roll_amt in (-stride, stride):
             ok = alive & jnp.roll(alive, roll_amt)
             if self._side is not None:
                 ok = ok & (self._side == jnp.roll(self._side, roll_amt))
             okc = ok[:, None]
-            # Partner's cache entries land on my aligned rows.
-            p_slot = jnp.roll(state.cache_slot, roll_amt, 0)
-            p_val = jnp.roll(state.cache_val, roll_amt, 0)
+            # Partner's cache lines, aligned with mine.
+            p_slot = jnp.roll(cs0, roll_amt, 0)
+            p_val = jnp.roll(cv0, roll_amt, 0)
             p_val = jnp.where(okc & (p_slot >= 0), p_val, 0)
-            all_rows.append(jnp.broadcast_to(
-                my_node[:, None], p_slot.shape).reshape(-1))
-            all_slots.append(jnp.where(p_val > 0, p_slot, -1).reshape(-1))
-            all_vals.append(p_val.reshape(-1))
-            # Partner's own rows (their authoritative records).
+            p_val = jnp.where(staleness_mask(p_val, now, t.stale_ticks),
+                              0, p_val)
+            p_slot = jnp.where(p_val > 0, p_slot, -1)
+            p_val = sticky_adjust(p_val, cv0,
+                                  (p_slot == cs0) & (p_val > cv0))
+            wv, ws = self._lex_max(wv, ws, p_val, p_slot)
+            # Partner's own rows (their authoritative records), filtered
+            # against the (rolled, row-aligned) floor like any owner
+            # offer.
             t_slot = jnp.roll(own_slots, roll_amt, 0)
             t_val = jnp.where(okc, jnp.roll(state.own, roll_amt, 0), 0)
-            all_rows.append(jnp.broadcast_to(
-                my_node[:, None], t_slot.shape).reshape(-1))
-            all_slots.append(jnp.where(t_val > 0, t_slot, -1).reshape(-1))
-            all_vals.append(t_val.reshape(-1))
+            t_floor = jnp.roll(floor_rs, roll_amt, 0)
+            t_val = jnp.where(t_val > t_floor, t_val, 0)
+            t_val = jnp.where(staleness_mask(t_val, now, t.stale_ticks),
+                              0, t_val)
+            wv, ws, sent, _ = self._insert_own_offers(
+                wv, ws, sent, t_val, t_slot,
+                hash_line(t_slot, p.cache_lines))
 
-        return self._apply(
-            state, state.cache_sent,
-            jnp.concatenate(all_rows), jnp.concatenate(all_slots),
-            jnp.concatenate(all_vals), now)
+        # One eviction count against the pre-exchange cache (the whole
+        # exchange is one batch, like the delivery path).
+        changed = (wv != cv0) | (ws != cs0)
+        sent = jnp.where(changed, jnp.int8(0), sent)
+        ev = ev + jnp.sum(((cs0 >= 0) & (ws != cs0)).astype(jnp.int32))
+        return dataclasses.replace(
+            state, cache_slot=ws, cache_val=wv, cache_sent=sent,
+            evictions=ev)
 
     def _floor_advance_and_sweep(self, state: CompressedState, now):
         """Census → floor advance → line free → TTL sweep."""
         p, t = self.p, self.t
         truth, hits, n_alive = _census(state, p)
         caught_up = hits >= n_alive
+        if p.fold_quorum < 1.0 and self._cut is None:
+            # Quorum folds are DISABLED while a partition is modeled
+            # (cut_mask active): the anti-entropy guarantee below cannot
+            # reach across a cut, and a minority side smaller than the
+            # quorum complement would otherwise be "delivered" records
+            # through the shared floor it could never have received.
+            # Quorum fold — the straggler-tail model: once ≥ quorum of
+            # the alive population holds a record AND a full push-pull
+            # interval has elapsed since it was minted (every node has
+            # had an anti-entropy exchange opportunity, and a random
+            # partner holds it w.p. ≥ quorum), cluster-wide delivery is
+            # guaranteed by the full-state TCP anti-entropy — the same
+            # argument the reference leans on for refresh delivery
+            # (PushPullInterval 20 s ≪ ALIVE_LIFESPAN 80 s,
+            # main.go:252-256; memberlist push-pull exchanges complete
+            # state, services_delegate.go:146-167).  The epidemic
+            # simulation still has to carry every record to quorum; only
+            # the last-straggler tail — which the wire protocol handles
+            # out-of-band of gossip packets — is folded analytically.
+            q_hits = jnp.ceil(
+                jnp.float32(p.fold_quorum)
+                * n_alive.astype(jnp.float32)).astype(jnp.int32)
+            age_ok = now - unpack_ts(truth) >= \
+                t.push_pull_rounds * t.round_ticks
+            caught_up = caught_up | \
+                ((hits >= q_hits) & age_ok & (truth > state.floor))
         floor = jnp.where(caught_up, jnp.maximum(state.floor, truth),
                           state.floor)
+        # Floor-mediated DRAINING stickiness (see the module docstring):
+        # a fold that would flip a DRAINING floor slot to a newer ALIVE
+        # keeps DRAINING at the new timestamp — the per-host catalog
+        # stickiness (services_state.go:329-331) applied at the point
+        # where this model materializes the catalog.
+        floor = apply_stickiness(state.floor, floor)
 
         below = (state.cache_slot >= 0) & (
             state.cache_val <= floor[jnp.maximum(state.cache_slot, 0)])
@@ -430,29 +600,13 @@ class CompressedSim:
         if self.perturb is not None:
             state = self.perturb(state, k_perturb, now)
 
-        # 1. select (pre-round snapshot) + gossip deliveries.
-        dst = gossip_ops.sample_peers(
+        # 1. publish the board (pre-round snapshot) + pull deliveries.
+        src = gossip_ops.sample_peers(
             k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
             node_alive=state.node_alive, cut_mask=self._cut)
-        line_idx, sel_slot, msg = self._select(state, limit)
-        sent = _bump_transmits(state.cache_sent, line_idx, msg, p.fanout,
-                               limit)
-
-        n, fanout = dst.shape
-        budget = msg.shape[1]
-        v = jnp.broadcast_to(msg[:, None, :], (n, fanout, budget))
-        tgt = jnp.broadcast_to(dst[:, :, None], (n, fanout, budget))
-        sl = jnp.broadcast_to(sel_slot[:, None, :], (n, fanout, budget))
-        v = jnp.where(state.node_alive[:, None, None], v, 0)
-        v = jnp.where(state.node_alive[tgt], v, 0)
-        if p.drop_prob > 0.0:
-            keep = jax.random.bernoulli(k_drop, 1.0 - p.drop_prob, v.shape)
-            v = jnp.where(keep, v, 0)
-        self_tgt = tgt == jnp.arange(n, dtype=jnp.int32)[:, None, None]
-        v = jnp.where(self_tgt, 0, v)  # self-sends are merge no-ops
-
-        state = self._apply(state, sent, tgt.reshape(-1), sl.reshape(-1),
-                            v.reshape(-1), now)
+        bval, bslot, sent = self._publish(state, limit)
+        state = self._pull_merge(state, sent, bval, bslot, src,
+                                 state.node_alive, now, drop_key=k_drop)
 
         # 2. announce re-stamps + recovery offers (end of round, like the
         # exact model: broadcastable the following round).
@@ -477,14 +631,17 @@ class CompressedSim:
     def convergence(self, state: CompressedState) -> jax.Array:
         """Fraction of (alive node, slot) beliefs agreeing with the
         freshest belief — the exact model's metric, computed from the
-        compressed representation in O(N·K + M)."""
+        compressed representation in O(N·K + M).  Scatter-bound (~3
+        protocol rounds at 65k nodes on v5e), which is why ``run``
+        samples it on the ``conv_every`` cadence rather than computing
+        it inline every round."""
         truth, hits, n_alive = _census(state, self.p)
         behind = jnp.maximum(n_alive - hits, 0)
         # Denominator in float: n_alive·m overflows int32 at the scales
         # this model exists for (65,536 × 655,360 ≈ 4.3e10).
-        denom = n_alive.astype(jnp.float32) * jnp.float32(self.p.m)
-        frac_behind = jnp.sum(behind.astype(jnp.float32)) / \
-            jnp.maximum(denom, 1.0)
+        denom = jnp.maximum(
+            n_alive.astype(jnp.float32) * jnp.float32(self.p.m), 1.0)
+        frac_behind = jnp.sum(behind.astype(jnp.float32)) / denom
         return 1.0 - frac_behind
 
     # -- drivers ------------------------------------------------------------
@@ -496,9 +653,20 @@ class CompressedSim:
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
 
-    def run(self, state, key, num_rounds: int):
+    def run(self, state, key, num_rounds: int, conv_every: int = 1):
+        """Run ``num_rounds``, sampling the convergence metric every
+        ``conv_every`` rounds (the returned curve has
+        ``num_rounds // conv_every`` points, at rounds ``conv_every,
+        2·conv_every, …``).  The census behind the metric costs ~3
+        protocol rounds at 65k nodes on TPU v5e (scatter-bound), so
+        large-N studies sample it on a cadence; tests and small N keep
+        per-round resolution."""
+        if num_rounds % conv_every:
+            raise ValueError(
+                f"num_rounds={num_rounds} not divisible by "
+                f"conv_every={conv_every}")
         self._check_horizon(state, num_rounds)
-        return self._run_jit(state, key, num_rounds)
+        return self._run_jit(state, key, num_rounds, conv_every)
 
     def run_fast(self, state, key, num_rounds: int):
         self._check_horizon(state, num_rounds)
@@ -511,12 +679,16 @@ class CompressedSim:
     # Per-round keys fold the round index into the base key so chunked/
     # resumed runs replay identical randomness (see ExactSim).
 
-    @functools.partial(jax.jit, static_argnums=(0, 3))
-    def _run_jit(self, state, key, num_rounds):
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+    def _run_jit(self, state, key, num_rounds, conv_every=1):
+        def inner(st, _):
+            return self._step(st, jax.random.fold_in(key, st.round_idx)), \
+                None
         def body(st, _):
-            st = self._step(st, jax.random.fold_in(key, st.round_idx))
+            st, _ = lax.scan(inner, st, None, length=conv_every)
             return st, self.convergence(st)
-        return lax.scan(body, state, None, length=num_rounds)
+        return lax.scan(body, state, None,
+                        length=num_rounds // conv_every)
 
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _run_fast_jit(self, state, key, num_rounds):
@@ -558,16 +730,6 @@ def _line_compete(cache_slot, cache_val, cache_sent, rows, slots, vals,
         (cache_val > floor[jnp.maximum(cache_slot, 0)])
     evicted = old_live & (slot1 != cache_slot)
     return slot1, val1, sent1, jnp.sum(evicted.astype(jnp.int32))
-
-
-def _bump_transmits(cache_sent, line_idx, msg, fanout, limit):
-    n, k = cache_sent.shape
-    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
-    bump = jnp.where(msg > 0, fanout, 0).astype(jnp.int32)
-    current = cache_sent[rows, jnp.minimum(line_idx, k - 1)]
-    capped = jnp.minimum(current.astype(jnp.int32) + bump,
-                         limit).astype(cache_sent.dtype)
-    return cache_sent.at[rows, line_idx].set(capped, mode="drop")
 
 
 def _census(state: CompressedState, p: CompressedParams):

@@ -9,3 +9,6 @@ mesh, annotate shardings, let XLA insert collectives.
 
 from sidecar_tpu.parallel.mesh import make_mesh, node_sharding  # noqa: F401
 from sidecar_tpu.parallel.sharded import ShardedSim  # noqa: F401
+from sidecar_tpu.parallel.sharded_compressed import (  # noqa: F401
+    ShardedCompressedSim,
+)

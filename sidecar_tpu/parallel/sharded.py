@@ -47,7 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from sidecar_tpu.models.exact import SimParams, SimState
 from sidecar_tpu.models.timecfg import TimeConfig
@@ -280,7 +280,7 @@ class ShardedSim:
                 in_specs=(spec_row, spec_row, spec_repl, spec_repl,
                           spec_repl),
                 out_specs=(spec_row, spec_row),
-                check_rep=False,
+                check_vma=False,
             )
             known, sent = fn(state.known, state.sent, state.node_alive,
                              k_round, round_idx)
@@ -292,7 +292,7 @@ class ShardedSim:
                 wrapper, mesh=self.mesh,
                 in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 3
                          + (spec_repl, spec_repl),
-                out_specs=(spec_row, spec_row), check_rep=False)
+                out_specs=(spec_row, spec_row), check_vma=False)
             known, sent = fn(state.known, state.sent, state.node_alive,
                              self._nbrs, self._deg, self._cut, k_round,
                              round_idx)
@@ -304,7 +304,7 @@ class ShardedSim:
                 wrapper_nocut, mesh=self.mesh,
                 in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 2
                          + (spec_repl, spec_repl),
-                out_specs=(spec_row, spec_row), check_rep=False)
+                out_specs=(spec_row, spec_row), check_vma=False)
             known, sent = fn(state.known, state.sent, state.node_alive,
                              self._nbrs, self._deg, k_round, round_idx)
 

@@ -1,0 +1,271 @@
+"""Node-axis-sharded compressed gossip simulator — the north-star-scale
+model on a multi-chip mesh.
+
+This is the sharded twin of :class:`sidecar_tpu.models.compressed.
+CompressedSim` (promised there), combining the two scale mechanisms:
+
+* **Bounded memory per node** (the compressed model): own[N, S] +
+  direct-mapped cache[N, K] + one shared floor[M] — O(N·K + M) instead of
+  the dense model's O(N²·S).
+* **Node-axis sharding** (the ShardedSim design, parallel/sharded.py):
+  each device owns a contiguous block of nodes; a node's own rows and
+  cache lines stay device-local, so select / line-competition / announce
+  are embarrassingly parallel.
+
+Cross-device traffic per round — all riding ICI collectives:
+
+* **The message board** — each shard publishes its rows' top-``budget``
+  cache lines (the ~1398 B-packet analog) and the boards are
+  ``all_gather``-ed; each shard then PULLS the board rows its own nodes
+  sampled and lex-merges them elementwise (the line-aligned delivery,
+  models/compressed.py).  Per-shard merge work is O(N/d · fanout · K);
+  the gather traffic is O(N·K) int32 — ~100 MB at the 100k-node north
+  star, a few ms on ICI.  Messages cross the interconnect, state stays
+  put — exactly the real network's economics.
+* **Floor maintenance** — the shared converged baseline is REPLICATED
+  across devices.  Owner-refresh folds touch only shard-owned slots, so
+  an ``lax.pmax`` after the announce phase re-merges the replicas; the
+  unanimity census (every ``sweep_rounds``) runs as local truth/hit
+  contributions combined with ``pmax``/``psum`` under GSPMD sharding
+  propagation.  floor is O(M) int32 — 4 MB at the 1M-service north star,
+  trivially replicable.
+* **Anti-entropy** — the same random-stride ring exchange as the dense
+  sharded model: ``jnp.roll`` along the sharded node axis lowers to an
+  XLA collective-permute.
+
+Protocol semantics are IDENTICAL to the single-chip ``CompressedSim`` —
+the merge/announce/push-pull kernels are literally the same methods
+(called per-shard with ``row_offset``), so a deterministic lockstep run
+matches bit-for-bit including the stride push-pull (both models draw the
+same stride from the same key); see tests/test_sharded_compressed.py.
+The divergences are the PRNG streams drawn per shard (``fold_in(key,
+shard)``, like ShardedSim): *random* peer sampling and the ``drop_prob``
+loss mask — with a pinned peer rule and ``drop_prob=0`` nothing random
+remains and the lockstep is exact.
+
+Scaling note: every per-round phase is O(N/d) per device (publish,
+pull-merge, announce); the board all_gather replicates O(N·K) transient
+bytes per device, which bounds single-pod reach to a few hundred
+thousand nodes at K=256.  Past that, the upgrade path is gathering only
+the board rows each shard's nodes actually sampled (an ``all_to_all``
+keyed by source shard) instead of the full board.
+
+Reference scale envelope this design answers: one Go process holds the
+whole O(M) catalog per host (catalog/services_state.go:70-80); at the
+north star (100k nodes / 1M services < 10 s, BASELINE.md) simulating
+that requires both compression and sharding at once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from sidecar_tpu.models.compressed import (
+    CompressedParams,
+    CompressedSim,
+    CompressedState,
+)
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops.topology import Topology
+from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh
+
+
+class ShardedCompressedSim(CompressedSim):
+    """Multi-device compressed simulator.  Drop-in for CompressedSim
+    (same driver contract: init_state / step / run / run_fast / mint /
+    convergence), state sharded along the node axis."""
+
+    def __init__(self, params: CompressedParams, topo: Topology,
+                 timecfg: TimeConfig = TimeConfig(),
+                 mesh=None,
+                 perturb=None,
+                 cut_mask: Optional[np.ndarray] = None,
+                 node_side: Optional[np.ndarray] = None):
+        super().__init__(params, topo, timecfg, perturb=perturb,
+                         cut_mask=cut_mask, node_side=node_side)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.d = self.mesh.devices.size
+        if params.n % self.d != 0:
+            raise ValueError(
+                f"n={params.n} must divide the {self.d}-device mesh")
+
+        row = NamedSharding(self.mesh, P(NODE_AXIS))
+        repl = NamedSharding(self.mesh, P())
+        self._row_sharding = row
+        self._repl_sharding = repl
+        if self._nbrs is not None:
+            self._nbrs = jax.device_put(self._nbrs, row)
+            self._deg = jax.device_put(self._deg, row)
+        if self._cut is not None:
+            self._cut = jax.device_put(self._cut, row)
+        if self._side is not None:
+            self._side = jax.device_put(self._side, repl)
+
+    # -- state --------------------------------------------------------------
+
+    def init_state(self) -> CompressedState:
+        st = super().init_state()
+        return self._constrain(st, place=True)
+
+    def _constrain(self, st: CompressedState, place=False) -> CompressedState:
+        """Pin the canonical layout: per-node arrays sharded on the node
+        axis, floor/alive/scalars replicated.  ``place=True`` moves host
+        arrays (init); inside jit the sharding-constraint form keeps the
+        scan carry layout stable."""
+        row, repl = self._row_sharding, self._repl_sharding
+        put = jax.device_put if place else lax.with_sharding_constraint
+        return CompressedState(
+            own=put(st.own, row),
+            cache_slot=put(st.cache_slot, row),
+            cache_val=put(st.cache_val, row),
+            cache_sent=put(st.cache_sent, row),
+            floor=put(st.floor, repl),
+            node_alive=put(st.node_alive, repl),
+            round_idx=put(st.round_idx, repl),
+            evictions=put(st.evictions, repl),
+        )
+
+    # -- peer sampling (global ids; overridable for deterministic tests) ----
+
+    def _sample_dst_complete(self, k_peers, gi, alive, nl):
+        p = self.p
+        r = jax.random.randint(k_peers, (nl, p.fanout), 0, p.n - 1,
+                               dtype=jnp.int32)
+        dst = r + (r >= gi[:, None]).astype(jnp.int32)
+        return jnp.where(alive[gi][:, None], dst, gi[:, None])
+
+    def _sample_dst_nbrs(self, k_peers, gi, alive, nl, nbrs_l, deg_l, cut_l):
+        p = self.p
+        slot = jax.random.randint(k_peers, (nl, p.fanout), 0,
+                                  jnp.maximum(deg_l, 1)[:, None],
+                                  dtype=jnp.int32)
+        dst = jnp.take_along_axis(nbrs_l, slot, axis=1)
+        if cut_l is not None:
+            cut = jnp.take_along_axis(cut_l, slot, axis=1)
+            dst = jnp.where(cut, gi[:, None], dst)
+        return jnp.where(alive[gi][:, None], dst, gi[:, None])
+
+    # -- the per-shard gossip + announce phase (inside shard_map) -----------
+
+    def _gossip_shard(self, own_l, cslot_l, cval_l, csent_l, floor, alive,
+                      key, round_idx, nbrs_l=None, deg_l=None, cut_l=None):
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        nl = own_l.shape[0]
+        ax = lax.axis_index(NODE_AXIS)
+        r0 = (ax * nl).astype(jnp.int32)
+        gi = r0 + jnp.arange(nl, dtype=jnp.int32)
+        now = round_idx * t.round_ticks
+
+        k_peers, k_drop = jax.random.split(jax.random.fold_in(key, ax))
+        if nbrs_l is None:
+            dst = self._sample_dst_complete(k_peers, gi, alive, nl)
+        else:
+            dst = self._sample_dst_nbrs(k_peers, gi, alive, nl,
+                                        nbrs_l, deg_l, cut_l)
+
+        # Local view of this shard: the inherited single-chip kernels run
+        # on it unchanged (row_offset maps local rows to global identity),
+        # which is what makes the twin bit-exact by construction.
+        local = CompressedState(
+            own=own_l, cache_slot=cslot_l, cache_val=cval_l,
+            cache_sent=csent_l, floor=floor, node_alive=alive[gi],
+            round_idx=round_idx, evictions=jnp.zeros((), jnp.int32))
+
+        # 1. publish local board rows + transmit accounting (elementwise;
+        # row_offset ties the tie rotation to global node ids).
+        bval_l, bslot_l, sent = self._publish(local, limit, row_offset=r0)
+
+        # The only cross-shard gossip traffic: the board (bounded offers,
+        # line-aligned — each row is the ≤budget records its node would
+        # pack into one ~1398 B datagram).
+        bval = lax.all_gather(bval_l, NODE_AXIS, tiled=True)   # [N, K]
+        bslot = lax.all_gather(bslot_l, NODE_AXIS, tiled=True)  # [N, K]
+
+        # 2. pull-merge into my rows (src holds global peer ids).
+        local = self._pull_merge(local, sent, bval, bslot, dst, alive,
+                                 now, drop_key=k_drop)
+
+        # 3. announce re-stamps + recovery offers (local rows own exactly
+        # this shard's slot range; the refresh fold raises only shard-owned
+        # floor entries, re-merged via pmax below).
+        local = self._announce(local, round_idx, now, row_offset=r0)
+
+        floor = lax.pmax(local.floor, NODE_AXIS)
+        ev = lax.psum(local.evictions, NODE_AXIS)
+        return (local.own, local.cache_slot, local.cache_val,
+                local.cache_sent, floor, ev)
+
+    # -- the round ----------------------------------------------------------
+
+    def _step(self, state: CompressedState,
+              key: jax.Array) -> CompressedState:
+        p, t = self.p, self.t
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        # Same split as CompressedSim._step: lockstep runs draw the same
+        # push-pull stride.
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+        del k_drop  # folded per-shard inside _gossip_shard
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+
+        spec_row, spec_repl = P(NODE_AXIS), P()
+        topo_args, topo_specs = (), ()
+        if self._nbrs is not None:
+            topo_args = (self._nbrs, self._deg)
+            topo_specs = (spec_row, spec_row)
+            if self._cut is not None:
+                topo_args += (self._cut,)
+                topo_specs += (spec_row,)
+
+        def body(own, cs, cv, se, floor, alive, k, r, *topo):
+            if not topo:
+                return self._gossip_shard(own, cs, cv, se, floor, alive,
+                                          k, r)
+            if len(topo) == 2:
+                nb, dg = topo
+                return self._gossip_shard(own, cs, cv, se, floor, alive,
+                                          k, r, nbrs_l=nb, deg_l=dg)
+            nb, dg, ct = topo
+            return self._gossip_shard(own, cs, cv, se, floor, alive, k, r,
+                                      nbrs_l=nb, deg_l=dg, cut_l=ct)
+
+        fn = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(spec_row,) * 4 + (spec_repl,) * 4 + topo_specs,
+            out_specs=(spec_row,) * 4 + (spec_repl, spec_repl),
+            check_vma=False)
+        own, cs, cv, se, floor, ev = fn(
+            state.own, state.cache_slot, state.cache_val, state.cache_sent,
+            state.floor, state.node_alive, k_peers, round_idx, *topo_args)
+        state = dataclasses.replace(
+            state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
+            floor=floor, evictions=state.evictions + ev)
+
+        # 3. anti-entropy — the inherited stride exchange; jnp.roll along
+        # the sharded axis lowers to a collective-permute.
+        state = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            lambda st: self._push_pull_stride(st, k_pp, now),
+            lambda st: st, state)
+
+        # 4. floor advance + sweep — inherited; the census scatter-adds
+        # run under GSPMD propagation (local contributions + all-reduce).
+        state = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            lambda st: self._floor_advance_and_sweep(st, now),
+            lambda st: st, state)
+
+        state = dataclasses.replace(state, round_idx=round_idx)
+        return self._constrain(state)

@@ -44,12 +44,13 @@ class ScenarioResult:
     n: int
     services_per_node: int
     rounds_run: int
-    convergence: np.ndarray          # per-round fraction
+    convergence: np.ndarray          # sampled convergence curve
     eps_round: Optional[int]         # first round with conv >= 1 - eps
     eps_seconds_simulated: Optional[float]
     wall_seconds: float
     rounds_per_sec: float
     scaled_from: Optional[int] = None  # declared full-scale N, if reduced
+    conv_every: int = 1              # rounds between convergence samples
     notes: str = ""
 
     def summary(self) -> dict:
@@ -64,26 +65,33 @@ class ScenarioResult:
             "wall_seconds": round(self.wall_seconds, 3),
             "rounds_per_sec": round(self.rounds_per_sec, 2),
             "scaled_from": self.scaled_from,
+            "conv_every": self.conv_every,
             "notes": self.notes,
         }
 
 
-def _eps_round(conv: np.ndarray, eps: float) -> Optional[int]:
+def _eps_round(conv: np.ndarray, eps: float,
+               conv_every: int = 1) -> Optional[int]:
     hits = np.nonzero(conv >= 1.0 - eps)[0]
-    return int(hits[0]) + 1 if hits.size else None
+    return (int(hits[0]) + 1) * conv_every if hits.size else None
 
 
 def _run(sim, state, rounds: int, seed: int,
          name: str, eps: float, scaled_from: Optional[int] = None,
-         notes: str = "") -> ScenarioResult:
+         conv_every: int = 1, notes: str = "") -> ScenarioResult:
     """Drive any sim exposing run(state, key, rounds) -> (state, conv)
-    (ExactSim and CompressedSim share the driver contract)."""
+    (ExactSim and CompressedSim share the driver contract).
+    ``conv_every`` samples the metric on a cadence (compressed sims
+    only) — the census is scatter-bound at large N."""
     key = jax.random.PRNGKey(seed)
     t0 = time.perf_counter()
-    state, conv = sim.run(state, key, rounds)
+    if conv_every > 1:
+        state, conv = sim.run(state, key, rounds, conv_every)
+    else:
+        state, conv = sim.run(state, key, rounds)
     conv = np.asarray(jax.device_get(conv))
     wall = time.perf_counter() - t0
-    er = _eps_round(conv, eps)
+    er = _eps_round(conv, eps, conv_every)
     return ScenarioResult(
         name=name, n=sim.p.n, services_per_node=sim.p.services_per_node,
         rounds_run=rounds, convergence=conv, eps_round=er,
@@ -91,7 +99,7 @@ def _run(sim, state, rounds: int, seed: int,
                                sim.t.ticks_per_second
                                if er is not None else None),
         wall_seconds=wall, rounds_per_sec=rounds / wall,
-        scaled_from=scaled_from, notes=notes)
+        scaled_from=scaled_from, conv_every=conv_every, notes=notes)
 
 
 # Cold-start studies pin the refresh far out so convergence measures pure
@@ -182,9 +190,21 @@ def _mint_churn(sim: CompressedSim, state, frac: float, tick: int,
     return sim.mint(state, np.sort(slots).astype(np.int32), tick)
 
 
+def _compressed_sim(params, topo, cfg, sharded: bool, **kw):
+    """CompressedSim, or its multi-device twin when ``sharded`` (the
+    8-device virtual mesh in tests / a real TPU mesh in production)."""
+    if sharded:
+        from sidecar_tpu.parallel.sharded_compressed import (
+            ShardedCompressedSim,
+        )
+        return ShardedCompressedSim(params, topo, cfg, **kw)
+    return CompressedSim(params, topo, cfg, **kw)
+
+
 def config4_ba_antientropy(eps: float = 0.001, rounds: int = 400,
                            scale: float = 1.0,
-                           churn_frac: float = 0.01) -> ScenarioResult:
+                           churn_frac: float = 0.01,
+                           sharded: bool = False) -> ScenarioResult:
     """65,536-node Barabási–Albert with periodic anti-entropy, at the
     DECLARED scale on the compressed large-cluster model: the cluster
     boots converged, 1% of all services churn at once, and the scenario
@@ -192,28 +212,40 @@ def config4_ba_antientropy(eps: float = 0.001, rounds: int = 400,
     anti-entropy cadence.  ``eps`` is scaled to the churn magnitude
     (the burst itself only unsettles ~``churn_frac`` of beliefs)."""
     n = max(128, int(65_536 * scale))
+    if sharded:  # the node axis must divide the device mesh
+        d = jax.device_count()
+        n = -(-n // d) * d
     cfg = dataclasses.replace(_STUDY_CFG, push_pull_interval_s=4.0)
     params = CompressedParams(n=n, services_per_node=10, fanout=3,
                               budget=15, cache_lines=256)
-    sim = CompressedSim(params, topo_mod.barabasi_albert(n, m=3, seed=4),
-                        cfg)
+    sim = _compressed_sim(params, topo_mod.barabasi_albert(n, m=3, seed=4),
+                          cfg, sharded)
+    conv_every = 5 if n >= 16_384 else 1
+    rounds = -(-rounds // conv_every) * conv_every
     state = _mint_churn(sim, sim.init_state(), churn_frac, tick=10, seed=4)
     return _run(sim, state, rounds=rounds, seed=4,
                 name="config4-ba-antientropy", eps=eps,
+                conv_every=conv_every,
                 scaled_from=65_536 if n != 65_536 else None,
                 notes=f"compressed model; {churn_frac:.0%} service churn "
-                      "burst; anti-entropy every 4 s simulated")
+                      "burst; anti-entropy every 4 s simulated"
+                      + ("; node-axis sharded" if sharded else ""))
 
 
 def config5_split_heal(eps: float = 0.0005, split_rounds: int = 150,
                        heal_rounds: int = 250,
                        scale: float = 1.0,
-                       churn_frac: float = 0.002) -> ScenarioResult:
+                       churn_frac: float = 0.002,
+                       sharded: bool = False) -> ScenarioResult:
     """Partitioned 2-D mesh at the DECLARED 1M nodes (compressed model):
     churn is injected on ONE side of the split, convergence stalls while
     the partition holds (cross-side gossip AND stride anti-entropy are
     severed), then the cut is removed and the backlog drains to ε."""
     side = max(8, int(1000 * math.sqrt(scale)))
+    if sharded:  # the node axis must divide the device mesh
+        d = jax.device_count()
+        while (side * side) % d:
+            side += 1
     n = side * side
     topo = topo_mod.mesh2d(side, side)
     halves = (np.arange(n) % side >= side // 2).astype(np.int32)
@@ -225,23 +257,26 @@ def config5_split_heal(eps: float = 0.0005, split_rounds: int = 150,
     # at the boundary, then drained by gossip relay.
     cfg = dataclasses.replace(_STUDY_CFG, push_pull_interval_s=2.0)
 
-    split_sim = CompressedSim(params, topo, cfg, cut_mask=cut,
-                              node_side=halves)
+    conv_every = 5 if n >= 16_384 else 1
+    split_rounds = -(-split_rounds // conv_every) * conv_every
+    heal_rounds = -(-heal_rounds // conv_every) * conv_every
+    split_sim = _compressed_sim(params, topo, cfg, sharded, cut_mask=cut,
+                                node_side=halves)
     key = jax.random.PRNGKey(5)
     t0 = time.perf_counter()
     state = _mint_churn(split_sim, split_sim.init_state(), churn_frac,
                         tick=10, seed=5, owner_mask=halves == 0)
-    state, conv_split = split_sim.run(state, key, split_rounds)
+    state, conv_split = split_sim.run(state, key, split_rounds, conv_every)
     conv_split = np.asarray(jax.device_get(conv_split))
 
-    heal_sim = CompressedSim(params, topo, cfg)  # cut removed: healed
-    state, conv_heal = heal_sim.run(state, key, heal_rounds)
+    heal_sim = _compressed_sim(params, topo, cfg, sharded)  # cut removed
+    state, conv_heal = heal_sim.run(state, key, heal_rounds, conv_every)
     conv_heal = np.asarray(jax.device_get(conv_heal))
     wall = time.perf_counter() - t0
 
     conv = np.concatenate([conv_split, conv_heal])
     rounds = split_rounds + heal_rounds
-    er = _eps_round(conv, eps)
+    er = _eps_round(conv, eps, conv_every)
     split_peak = float(conv_split.max())
     return ScenarioResult(
         name="config5-split-heal", n=n,
@@ -251,6 +286,7 @@ def config5_split_heal(eps: float = 0.0005, split_rounds: int = 150,
                                if er is not None else None),
         wall_seconds=wall, rounds_per_sec=rounds / wall,
         scaled_from=1_000_000 if n != 1_000_000 else None,
+        conv_every=conv_every,
         notes=f"compressed model; churn on one side of the split; "
               f"convergence while split peaked at {split_peak:.4f} "
               "(must stay < 1); heal completes it")

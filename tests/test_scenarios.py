@@ -45,6 +45,26 @@ class TestScenarios:
         assert result.eps_round is not None and result.eps_round > 1
         assert result.convergence[-1] == 1.0
 
+    def test_config4_ba_small_sharded(self):
+        """config4 on the multi-device twin: same drain-to-convergence
+        contract on the 8-device virtual mesh."""
+        result = scenarios.config4_ba_antientropy(rounds=250, scale=0.002,
+                                                  sharded=True)
+        assert result.scaled_from == 65_536
+        assert "sharded" in result.notes
+        assert result.eps_round is not None and result.eps_round > 1
+        assert result.convergence[-1] == 1.0
+
+    def test_config5_split_heal_small_sharded(self):
+        """config5 on the multi-device twin: split holds, heal drains;
+        the mesh side is bumped so n divides the 8-device mesh."""
+        result = scenarios.config5_split_heal(
+            split_rounds=80, heal_rounds=320, scale=0.0001, sharded=True)
+        assert result.scaled_from == 1_000_000
+        assert result.n % 8 == 0
+        assert result.convergence[:80].max() < 1.0
+        assert result.convergence[-1] == 1.0
+
     def test_config5_split_heal_small(self):
         result = scenarios.config5_split_heal(
             split_rounds=80, heal_rounds=320, scale=0.0001)

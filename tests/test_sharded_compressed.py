@@ -1,0 +1,198 @@
+"""ShardedCompressedSim test suite on the 8-device virtual CPU mesh.
+
+Centerpiece: deterministic bit-exact lockstep against the single-chip
+CompressedSim — INCLUDING the stride push-pull, which both models drive
+from the same key (unlike the dense pair, where the sharded stride
+exchange is a documented model divergence).  With peer selection pinned
+to the next-k ring walk, a round has no remaining randomness except the
+shared stride draw, so the sharded machinery (shard-local publish with
+global-id tie rotation, all-gather of the board, pull via global src
+ids into local rows, announce ``row_offset`` arithmetic, floor pmax
+re-merge, census under GSPMD) must reproduce the single-chip model
+bit-for-bit across own/cache/floor/evictions at every round.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.parallel.sharded_compressed import ShardedCompressedSim
+
+from tests.test_sharded import det_sample_peers
+
+# Refresh pinned out (quiet catalogs), push-pull ON at a short cadence so
+# lockstep covers the collective-permute path; sweep every round so the
+# census/floor path is exercised constantly.
+DET = TimeConfig(refresh_interval_s=10_000.0, push_pull_interval_s=1.0,
+                 sweep_interval_s=0.4)
+LIVE = TimeConfig(push_pull_interval_s=4.0, sweep_interval_s=2.0)
+
+
+class DetShardedCompressedSim(ShardedCompressedSim):
+    """Deterministic peer rule over global ids (next-k ring walk /
+    first-k neighbor slots) — mirrors tests/test_sharded.DetShardedSim."""
+
+    def _sample_dst_complete(self, k_peers, gi, alive, nl):
+        step = jnp.arange(1, self.p.fanout + 1, dtype=jnp.int32)[None, :]
+        dst = (gi[:, None] + step) % self.p.n
+        return jnp.where(alive[gi][:, None], dst, gi[:, None])
+
+    def _sample_dst_nbrs(self, k_peers, gi, alive, nl, nbrs_l, deg_l, cut_l):
+        slot = jnp.broadcast_to(
+            jnp.arange(self.p.fanout, dtype=jnp.int32)[None, :],
+            (nl, self.p.fanout))
+        slot = slot % jnp.maximum(deg_l, 1)[:, None]
+        dst = jnp.take_along_axis(nbrs_l, slot, axis=1)
+        if cut_l is not None:
+            cut = jnp.take_along_axis(cut_l, slot, axis=1)
+            dst = jnp.where(cut, gi[:, None], dst)
+        return jnp.where(alive[gi][:, None], dst, gi[:, None])
+
+
+def assert_states_equal(a, b, round_no):
+    for field in ("own", "cache_slot", "cache_val", "cache_sent", "floor"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, field)), np.asarray(getattr(b, field)),
+            err_msg=f"{field} diverged at round {round_no}")
+    assert int(a.evictions) == int(b.evictions), (
+        f"evictions diverged at round {round_no}: "
+        f"{int(a.evictions)} vs {int(b.evictions)}")
+
+
+def run_lockstep(single, sharded, rounds, mint_at=(), kill=None, seed=0):
+    ss = single.init_state()
+    sh = sharded.init_state()
+    rng = np.random.default_rng(7)
+    for i in range(rounds):
+        key = jax.random.PRNGKey(seed + i)  # det samplers ignore it;
+        # the push-pull stride draw is shared — part of the lockstep.
+        if i in mint_at:
+            slots = np.sort(rng.choice(single.p.m, size=5, replace=False))
+            tick = int(ss.round_idx) * single.t.round_ticks + 7
+            ss = single.mint(ss, slots.astype(np.int32), tick)
+            sh = sharded.mint(sh, slots.astype(np.int32), tick)
+        if kill is not None and i == kill[0]:
+            alive = np.ones(single.p.n, bool)
+            alive[kill[1]] = False
+            ss = dataclasses.replace(ss, node_alive=jnp.asarray(alive))
+            sh = dataclasses.replace(sh, node_alive=jnp.asarray(alive))
+        ss = single.step(ss, key)
+        sh = sharded.step(sh, key)
+        assert_states_equal(ss, sh, i + 1)
+    return ss, sh
+
+
+def eps_round(conv, eps=0.001):
+    hits = np.nonzero(np.asarray(conv) >= 1.0 - eps)[0]
+    return None if hits.size == 0 else int(hits[0]) + 1
+
+
+class TestBitExactVsSingleChip:
+    def test_complete_with_churn_and_pushpull(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=3, fanout=2,
+                                  budget=6, cache_lines=64)
+        single = CompressedSim(params, topology.complete(16), DET)
+        sharded = DetShardedCompressedSim(params, topology.complete(16), DET)
+        run_lockstep(single, sharded, rounds=24, mint_at=(0, 5, 11))
+
+    def test_ring_with_cut_mask(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        topo = topology.ring(16, hops=2)
+        side = (np.arange(16) >= 8).astype(np.int32)
+        cut = topology.partition_mask(topo, side)
+        single = CompressedSim(params, topo, DET, cut_mask=cut,
+                               node_side=side)
+        sharded = DetShardedCompressedSim(params, topo, DET, cut_mask=cut,
+                                          node_side=side)
+        run_lockstep(single, sharded, rounds=20, mint_at=(0, 3))
+
+    def test_node_death_mid_run(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        t = dataclasses.replace(DET, alive_lifespan_s=2.0)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=6, cache_lines=32)
+        single = CompressedSim(params, topology.complete(16), t)
+        sharded = DetShardedCompressedSim(params, topology.complete(16), t)
+        run_lockstep(single, sharded, rounds=30, mint_at=(0,), kill=(5, 3))
+
+
+class TestConvergence:
+    def test_churn_burst_drains_to_one(self):
+        """A 1% churn burst on the 8-device mesh drains to full
+        convergence under the default refresh interval."""
+        params = CompressedParams(n=256, services_per_node=10, fanout=3,
+                                  budget=15, cache_lines=256)
+        sim = ShardedCompressedSim(params, topology.complete(256), LIVE)
+        state = sim.init_state()
+        rng = np.random.default_rng(3)
+        slots = np.sort(rng.choice(params.m, size=params.m // 100,
+                                   replace=False))
+        state = sim.mint(state, slots.astype(np.int32), 10)
+        state, conv = sim.run(state, jax.random.PRNGKey(0), 120)
+        conv = np.asarray(conv)
+        assert conv[-1] == 1.0, conv[-20:]
+        assert eps_round(conv) is not None
+
+    def test_split_holds_then_heals(self):
+        """Config-5 shape at test size: churn on one side of a mesh
+        split; convergence must hold below 1 while cut, then heal."""
+        side_len = 16
+        n = side_len * side_len
+        topo = topology.mesh2d(side_len, side_len)
+        halves = (np.arange(n) % side_len >= side_len // 2).astype(np.int32)
+        cut = topology.partition_mask(topo, halves)
+        params = CompressedParams(n=n, services_per_node=4, fanout=3,
+                                  budget=15, cache_lines=64)
+        cfg = dataclasses.replace(LIVE, push_pull_interval_s=2.0,
+                                  refresh_interval_s=10_000.0)
+
+        split = ShardedCompressedSim(params, topo, cfg, cut_mask=cut,
+                                     node_side=halves)
+        state = split.init_state()
+        rng = np.random.default_rng(5)
+        pool = np.nonzero(np.repeat(halves == 0, params.services_per_node))[0]
+        slots = np.sort(rng.choice(pool, size=20, replace=False))
+        state = split.mint(state, slots.astype(np.int32), 10)
+        state, conv = split.run(state, jax.random.PRNGKey(1), 80)
+        conv = np.asarray(conv)
+        assert conv.max() < 1.0, "cross-side records leaked through the cut"
+
+        healed = ShardedCompressedSim(params, topo, cfg)
+        state, conv2 = healed.run(state, jax.random.PRNGKey(2), 160)
+        assert np.asarray(conv2)[-1] == 1.0
+
+
+class TestShardingLayout:
+    def test_layout(self):
+        params = CompressedParams(n=32, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        sim = ShardedCompressedSim(params, topology.complete(32), LIVE)
+        state = sim.init_state()
+        assert len(jax.devices()) == 8
+        assert len(state.own.addressable_shards) == 8
+        assert {s.data.shape for s in state.own.addressable_shards} == \
+            {(4, params.services_per_node)}
+        assert {s.data.shape for s in state.cache_val.addressable_shards} \
+            == {(4, params.cache_lines)}
+        # floor replicated: every shard holds the full M row.
+        assert {s.data.shape for s in state.floor.addressable_shards} == \
+            {(params.m,)}
+        state = sim.step(state, jax.random.PRNGKey(0))
+        assert len(state.own.addressable_shards) == 8
+        assert {s.data.shape for s in state.floor.addressable_shards} == \
+            {(params.m,)}
+
+    def test_n_must_divide_mesh(self):
+        params = CompressedParams(n=30, services_per_node=2, cache_lines=32)
+        with pytest.raises(ValueError, match="divide"):
+            ShardedCompressedSim(params, topology.complete(30), LIVE)
