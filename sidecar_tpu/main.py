@@ -116,8 +116,18 @@ class SidecarNode:
                 use_hostnames=self.config.haproxy.use_hostnames,
                 reload_cmd=self.config.haproxy.reload_cmd,
                 verify_cmd=self.config.haproxy.verify_cmd)
-        self.xds = XdsServer(self.state, self.config.envoy.bind_ip,
-                             self.config.envoy.use_hostnames)
+        # use_grpc_api selects the transport for the SAME resource set:
+        # the gRPC ADS stream (the reference's production path,
+        # envoy/server.go:61-124) or REST xDS polling (main.go:397-411).
+        self.xds = None
+        self.ads = None
+        if self.config.envoy.use_grpc_api:
+            from sidecar_tpu.proxy.ads import AdsServer
+            self.ads = AdsServer(self.state, self.config.envoy.bind_ip,
+                                 self.config.envoy.use_hostnames)
+        else:
+            self.xds = XdsServer(self.state, self.config.envoy.bind_ip,
+                                 self.config.envoy.use_hostnames)
         self._loopers: list[TimedLooper] = []
         self._http_server = None
         self._xds_server = None
@@ -196,10 +206,14 @@ class SidecarNode:
             except (RuntimeError, OSError, ValueError) as exc:
                 log.error("Initial HAProxy write failed: %s", exc)
 
-        # Envoy xDS (main.go:397-411).
-        if serve and self.config.envoy.use_grpc_api:
-            self._xds_server = self.xds.serve(
-                port=int(self.config.envoy.grpc_port))
+        # Envoy xDS (main.go:397-411): gRPC ADS when use_grpc_api, else
+        # the REST xDS poll transport, both on grpc_port.
+        if serve:
+            if self.ads is not None:
+                self.ads.serve(port=int(self.config.envoy.grpc_port))
+            else:
+                self._xds_server = self.xds.serve(
+                    port=int(self.config.envoy.grpc_port))
 
     # The monitor.watch loop body needs the discoverer; wrap it so the
     # looper drives one sync per tick.
@@ -225,6 +239,8 @@ class SidecarNode:
             self._http_server.shutdown()
         if self._xds_server is not None:
             self._xds_server.shutdown()
+        if self.ads is not None:
+            self.ads.shutdown()
         if self.haproxy is not None:
             self.haproxy.stop()
 

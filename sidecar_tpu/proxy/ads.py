@@ -1,0 +1,219 @@
+"""Envoy v3 ADS control plane — the SotW gRPC stream.
+
+The reference's production Envoy path is a push-based gRPC ADS server
+built on go-control-plane: a 1 s looper compares ``state.LastChanged``
+to the cached value and publishes a full versioned snapshot on change
+(envoy/server.go:61-124, versions are UnixNano, :54-59); the stream
+layer replays the xDS state-of-the-world protocol — every
+DiscoveryResponse carries a version + nonce, the client ACKs by echoing
+both (or NACKs by echoing the nonce with an error_detail), and a new
+snapshot triggers a push (envoy/server_test.go:138-205 drives exactly
+this with a mock ADS client).
+
+This implementation serves the same protocol with grpcio generic
+handlers (no generated service stubs) over the shared resource
+generation in proxy/envoy.py.  Ordering on snapshot push follows
+go-control-plane's make-before-break: clusters → endpoints →
+listeners."""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from concurrent import futures
+from typing import Optional
+
+import grpc
+
+from sidecar_tpu.catalog.state import ServicesState
+from sidecar_tpu.proxy import xds_proto
+from sidecar_tpu.proxy.envoy import (
+    LOOPER_UPDATE_INTERVAL,
+    TYPE_CLUSTER,
+    TYPE_ENDPOINT,
+    TYPE_LISTENER,
+    resources_from_state,
+)
+
+log = logging.getLogger(__name__)
+
+ADS_METHOD = ("/envoy.service.discovery.v3.AggregatedDiscoveryService/"
+              "StreamAggregatedResources")
+
+# Make-before-break push order (go-control-plane's ADS ordering).
+PUSH_ORDER = (TYPE_CLUSTER, TYPE_ENDPOINT, TYPE_LISTENER)
+
+
+class Snapshot:
+    """One immutable versioned resource set (server.go:54-59)."""
+
+    def __init__(self, version: str, by_type: dict[str, list]):
+        self.version = version
+        self.by_type = by_type
+
+
+class AdsServer:
+    """Snapshot cache + LastChanged poll + the ADS stream service."""
+
+    def __init__(self, state: ServicesState, bind_ip: str = "0.0.0.0",
+                 use_hostnames: bool = False) -> None:
+        self.state = state
+        self.bind_ip = bind_ip
+        self.use_hostnames = use_hostnames
+        self._snapshot = Snapshot("0", {t: [] for t in PUSH_ORDER})
+        self._last_changed = -1
+        self._cond = threading.Condition()
+        self._stop = threading.Event()
+        self._server: Optional[grpc.Server] = None
+        self._poll_thread: Optional[threading.Thread] = None
+
+    # -- snapshot maintenance ----------------------------------------------
+
+    def refresh(self) -> bool:
+        """Rebuild + publish a snapshot if the catalog changed
+        (server.go:70-110).  True when a new snapshot was set."""
+        if self.state.last_changed == self._last_changed:
+            return False
+        last_changed = self.state.last_changed
+        res = resources_from_state(self.state, self.bind_ip,
+                                   self.use_hostnames, eds_mode="ads")
+        by_type = {
+            TYPE_CLUSTER: [xds_proto.cluster_to_any(c)
+                           for c in res.clusters],
+            TYPE_ENDPOINT: [xds_proto.endpoint_to_any(e)
+                            for e in res.endpoints],
+            TYPE_LISTENER: [xds_proto.listener_to_any(li)
+                            for li in res.listeners],
+        }
+        with self._cond:
+            self._snapshot = Snapshot(str(time.time_ns()), by_type)
+            self._last_changed = last_changed
+            self._cond.notify_all()
+        log.debug("ads: published snapshot %s", self._snapshot.version)
+        return True
+
+    def snapshot(self) -> Snapshot:
+        with self._cond:
+            return self._snapshot
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(LOOPER_UPDATE_INTERVAL):
+            try:
+                self.refresh()
+            except Exception:
+                log.exception("ads: snapshot refresh failed")
+
+    # -- the stream handler -------------------------------------------------
+
+    def stream_aggregated_resources(self, request_iterator, context):
+        """One ADS stream: per-type version/nonce bookkeeping, pushes on
+        snapshot change, ACK/NACK handling (the SotW protocol)."""
+        requests: queue.Queue = queue.Queue()
+        done = threading.Event()
+
+        def reader():
+            try:
+                for req in request_iterator:
+                    requests.put(req)
+            except Exception:
+                pass
+            finally:
+                done.set()
+
+        threading.Thread(target=reader, daemon=True,
+                         name="ads-stream-reader").start()
+
+        nonce_counter = 0
+        # type_url → {"sent_version", "nonce", "acked"}
+        subs: dict[str, dict] = {}
+
+        def respond(snap: Snapshot, type_url: str):
+            nonlocal nonce_counter
+            nonce_counter += 1
+            nonce = str(nonce_counter)
+            resp = xds_proto.pb().DiscoveryResponse(
+                version_info=snap.version, type_url=type_url,
+                nonce=nonce)
+            resp.resources.extend(snap.by_type.get(type_url, []))
+            subs[type_url].update(sent_version=snap.version, nonce=nonce)
+            return resp
+
+        while not done.is_set() and not self._stop.is_set():
+            try:
+                req = requests.get(timeout=0.1)
+            except queue.Empty:
+                # Push path: a new snapshot goes to every subscribed
+                # type that has ACKed (or at least been sent) an older
+                # version, in make-before-break order.
+                snap = self.snapshot()
+                for type_url in PUSH_ORDER:
+                    sub = subs.get(type_url)
+                    if sub is None:
+                        continue
+                    if sub["sent_version"] != snap.version and \
+                            not sub.get("nacked_version") == snap.version:
+                        yield respond(snap, type_url)
+                continue
+
+            type_url = req.type_url
+            if not type_url:
+                log.warning("ads: request with empty type_url ignored")
+                continue
+            sub = subs.setdefault(
+                type_url, {"sent_version": None, "nonce": None,
+                           "acked": None})
+
+            if req.response_nonce and req.response_nonce != sub["nonce"]:
+                # Stale nonce: response to a superseded push — ignore
+                # (the xDS spec's stale-response rule).
+                continue
+            if req.response_nonce and req.HasField("error_detail"):
+                # NACK: the client rejected sent_version; remember so the
+                # push loop doesn't hammer it with the same snapshot.
+                log.warning("ads: NACK for %s version %s: %s", type_url,
+                            req.version_info, req.error_detail.message)
+                sub["nacked_version"] = sub["sent_version"]
+                continue
+            if req.response_nonce:
+                # ACK of sent_version.
+                sub["acked"] = req.version_info
+                continue
+
+            # Initial subscription request for this type.
+            yield respond(self.snapshot(), type_url)
+
+    # -- serving ------------------------------------------------------------
+
+    def _handlers(self):
+        x = xds_proto.pb()
+        rpc = grpc.stream_stream_rpc_method_handler(
+            self.stream_aggregated_resources,
+            request_deserializer=x.DiscoveryRequest.FromString,
+            response_serializer=x.DiscoveryResponse.SerializeToString)
+        service, method = ADS_METHOD.lstrip("/").split("/")
+        return grpc.method_handlers_generic_handler(service, {method: rpc})
+
+    def serve(self, bind: str = "0.0.0.0", port: int = 7776) -> int:
+        """Start the gRPC server (reference binds :7776,
+        config/config.go:32).  Returns the bound port (0 → ephemeral)."""
+        self.refresh()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8,
+                                       thread_name_prefix="ads"))
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        bound = self._server.add_insecure_port(f"{bind}:{port}")
+        self._server.start()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="ads-poll", daemon=True)
+        self._poll_thread.start()
+        log.info("ads: gRPC control plane on %s:%d", bind, bound)
+        return bound
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._server is not None:
+            self._server.stop(grace=0.5)
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=2.0)
