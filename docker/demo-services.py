@@ -1,0 +1,41 @@
+"""Demo backends for the compose cluster — tiny HTTP/TCP listeners on
+the ports fixtures/static.json announces (the reference's run-services
+script starts nginx containers for the same purpose), so HAProxy has
+something real to route to and health checks can hit a live port."""
+
+import http.server
+import socketserver
+import threading
+
+
+class Version(http.server.BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def do_GET(self):
+        body = b'{"service": "static-web", "version": "0.3"}'
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class Echo(socketserver.BaseRequestHandler):
+    def handle(self):
+        data = self.request.recv(4096)
+        if data:
+            self.request.sendall(data)
+
+
+def main():
+    web = socketserver.ThreadingTCPServer(("0.0.0.0", 18080), Version)
+    tcp = socketserver.ThreadingTCPServer(("0.0.0.0", 18081), Echo)
+    for srv in (web, tcp):
+        srv.daemon_threads = True
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+    threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Container entry: start the demo backend services the static discovery
+# announces (the reference's run-services analog), then the sidecar
+# node itself.  SIDECAR_SEEDS / SIDECAR_HOSTNAME come from compose.
+set -e
+
+python docker/demo-services.py &
+
+exec python -m sidecar_tpu.main --hostname "${SIDECAR_HOSTNAME:-$(hostname)}"

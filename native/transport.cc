@@ -428,7 +428,25 @@ class Transport {
     addr.sin_addr.s_addr = inet_addr(ip.c_str());
     sendto(udp_fd_, pkt.data(), pkt.size(), 0,
            reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    udp_out_.fetch_add(1, std::memory_order_relaxed);
+    udp_bytes_out_.fetch_add(pkt.size(), std::memory_order_relaxed);
   }
+
+ public:
+  // Stats snapshot for the host-side metrics poll (the go-metrics
+  // analog, main.go:156-166): [udp_out, udp_bytes_out, udp_in,
+  // udp_bytes_in, pushpull_out, pushpull_in].
+  int stats(unsigned long long* out, int n) {
+    const unsigned long long vals[] = {
+        udp_out_.load(),      udp_bytes_out_.load(), udp_in_.load(),
+        udp_bytes_in_.load(), pushpull_out_.load(),  pushpull_in_.load()};
+    int count = static_cast<int>(sizeof(vals) / sizeof(vals[0]));
+    if (n < count) count = n;
+    for (int i = 0; i < count; i++) out[i] = vals[i];
+    return count;
+  }
+
+ private:
 
   std::vector<Member> pick_members(int k, const std::string& exclude = "") {
     std::lock_guard<std::mutex> lk(mu_);
@@ -585,6 +603,8 @@ class Transport {
       ssize_t n = recvfrom(udp_fd_, buf.data(), buf.size(), 0,
                            reinterpret_cast<sockaddr*>(&src), &slen);
       if (n <= 0) continue;
+      udp_in_.fetch_add(1, std::memory_order_relaxed);
+      udp_bytes_in_.fetch_add(n, std::memory_order_relaxed);
       const uint8_t* p = buf.data();
       const uint8_t* end = p + n;
       if (n < 5 || get_u32(p) != kMagic) continue;
@@ -954,6 +974,7 @@ class Transport {
 
   void handle_pushpull_conn(int fd) {
     // Remote sends first, then we reply (LocalState/MergeRemoteState).
+    pushpull_in_.fetch_add(1, std::memory_order_relaxed);
     if (!recv_state_frame(fd)) return;
     send_state_frame(fd);
   }
@@ -973,6 +994,7 @@ class Transport {
       logf('W', "push-pull connect to " + host + " failed");
       return false;
     }
+    pushpull_out_.fetch_add(1, std::memory_order_relaxed);
     send_state_frame(fd);
     bool ok = recv_state_frame(fd);
     close(fd);
@@ -1004,6 +1026,8 @@ class Transport {
   std::atomic<bool> quit_{true};
   std::atomic<uint32_t> incarnation_{1};
   std::atomic<uint32_t> next_seq_{1};
+  std::atomic<unsigned long long> udp_out_{0}, udp_bytes_out_{0},
+      udp_in_{0}, udp_bytes_in_{0}, pushpull_out_{0}, pushpull_in_{0};
   std::vector<std::thread> threads_;
   std::mutex mu_;
   std::map<std::string, Member> members_;
@@ -1101,6 +1125,11 @@ int st_poll_log(void* h, uint8_t* buf, int cap) {
 int st_members(void* h, uint8_t* buf, int cap) {
   if (!h) return 0;
   return copy_out(static_cast<Transport*>(h)->members_list(), buf, cap);
+}
+
+int st_stats(void* h, unsigned long long* out, int n) {
+  if (!h) return 0;
+  return static_cast<Transport*>(h)->stats(out, n);
 }
 
 int st_port(void* h) {

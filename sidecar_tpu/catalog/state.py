@@ -22,8 +22,10 @@ import json
 import logging
 import queue
 import threading
+import time
 from typing import Callable, Iterable, Iterator, Optional
 
+from sidecar_tpu import metrics
 from sidecar_tpu import service as svc_mod
 from sidecar_tpu.output import time_ago
 from sidecar_tpu.runtime.looper import Looper, TimedLooper
@@ -230,7 +232,14 @@ class ServicesState:
         """THE merge kernel — latest-timestamp-wins with DRAINING
         stickiness and staleness rejection (services_state.go:293-347).
         This is the host-side scalar twin of ops/merge.py's vectorized
-        kernel."""
+        kernel.  Timed like the reference (services_state.go:294)."""
+        t0 = time.perf_counter()
+        try:
+            self._add_service_entry(new_svc)
+        finally:
+            metrics.measure_since("addServiceEntry", t0)
+
+    def _add_service_entry(self, new_svc: Service) -> None:
         with self._lock:
             now = self._now()
             if new_svc.is_stale(TOMBSTONE_LIFESPAN, now=now):

@@ -14,6 +14,7 @@ import sys
 import threading
 from typing import Optional
 
+from sidecar_tpu import metrics
 from sidecar_tpu import service as svc_mod
 from sidecar_tpu.addresses import get_published_ip
 from sidecar_tpu.catalog import ServicesState
@@ -90,6 +91,8 @@ class SidecarNode:
 
         self.config = config if config is not None else parse_config()
         self.hostname = hostname or socket.gethostname()
+        # statsd export when SIDECAR_STATS_ADDR is set (main.go:156-166).
+        metrics.configure_statsd(self.config.sidecar.stats_addr)
         self.advertise_ip = get_published_ip(
             self.config.sidecar.exclude_ips,
             self.config.sidecar.advertise_ip)

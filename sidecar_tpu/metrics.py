@@ -1,0 +1,105 @@
+"""Metrics: counters / gauges / timers with a statsd sink.
+
+The reference instruments its hot paths with armon/go-metrics —
+``MeasureSince`` timers on the delegate and catalog merge paths
+(services_delegate.go:73,86,154; services_state.go:294), a
+``pendingBroadcasts`` gauge (services_delegate.go:87) — and exports to
+statsd when ``SIDECAR_STATS_ADDR`` is set (main.go:156-166).  This is
+the same shape: a process-global registry that always aggregates
+in-memory (so tests and operators can read ``snapshot()``) and
+additionally emits standard statsd datagrams (``name:v|c``, ``|g``,
+``|ms``) over UDP when a sink address is configured.
+
+Emission is fire-and-forget UDP on the caller's thread — one
+``sendto`` per event, no buffering, errors swallowed — the same
+trade statsite/statsd clients make on hot paths."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from typing import Optional
+
+PREFIX = "sidecar"
+
+
+class Metrics:
+    def __init__(self, prefix: str = PREFIX) -> None:
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+        self._gauges: dict[str, float] = {}
+        self._timers: dict[str, list] = {}  # name → [count, total_ms, last]
+        self._sock: Optional[socket.socket] = None
+        self._addr: Optional[tuple[str, int]] = None
+
+    # -- configuration ------------------------------------------------------
+
+    def configure_statsd(self, addr: Optional[str]) -> None:
+        """``host:port`` enables the statsd sink; None/'' disables it
+        (SIDECAR_STATS_ADDR, main.go:156-166).  Ordered so concurrent
+        hot-path emitters never observe an address without a socket."""
+        if not addr:
+            self._addr = None
+            self._sock = None
+            return
+        host, _, port = addr.partition(":")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._addr = (host or "127.0.0.1", int(port or 8125))
+
+    def _emit(self, name: str, value, kind: str) -> None:
+        # Snapshot the pair: reconfiguration races must never kill a
+        # delegate thread mid-emit.
+        addr, sock = self._addr, self._sock
+        if addr is None or sock is None:
+            return
+        try:
+            payload = f"{self.prefix}.{name}:{value}|{kind}".encode()
+            sock.sendto(payload, addr)
+        except OSError:
+            pass
+
+    # -- instruments --------------------------------------------------------
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+        self._emit(name, n, "c")
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = value
+        self._emit(name, value, "g")
+
+    def measure_since(self, name: str, t0: float) -> None:
+        """Record elapsed time from ``t0`` (a ``time.perf_counter()``
+        stamp) — the go-metrics MeasureSince analog."""
+        ms = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            agg = self._timers.setdefault(name, [0, 0.0, 0.0])
+            agg[0] += 1
+            agg[1] += ms
+            agg[2] = ms
+        self._emit(name, round(ms, 3), "ms")
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timers": {k: {"count": v[0],
+                               "total_ms": round(v[1], 3),
+                               "last_ms": round(v[2], 3)}
+                           for k, v in self._timers.items()},
+            }
+
+
+# The process-global registry (go-metrics' global sink analog).
+registry = Metrics()
+
+incr = registry.incr
+set_gauge = registry.set_gauge
+measure_since = registry.measure_since
+snapshot = registry.snapshot
+configure_statsd = registry.configure_statsd

@@ -126,7 +126,12 @@ class AdsServer:
                          name="ads-stream-reader").start()
 
         nonce_counter = 0
-        # type_url → {"sent_version", "nonce", "acked"}
+        # type_url → {"sent_version", "nonce"} — the whole SotW
+        # per-stream state.  A NACKed version needs no extra flag: the
+        # push loop only re-sends when sent_version differs from the
+        # current snapshot, and a NACK leaves sent_version at the
+        # rejected (= current) one, so nothing re-fires until a NEW
+        # snapshot exists — exactly the protocol's intent.
         subs: dict[str, dict] = {}
 
         def respond(snap: Snapshot, type_url: str):
@@ -150,10 +155,8 @@ class AdsServer:
                 snap = self.snapshot()
                 for type_url in PUSH_ORDER:
                     sub = subs.get(type_url)
-                    if sub is None:
-                        continue
-                    if sub["sent_version"] != snap.version and \
-                            not sub.get("nacked_version") == snap.version:
+                    if sub is not None and \
+                            sub["sent_version"] != snap.version:
                         yield respond(snap, type_url)
                 continue
 
@@ -162,24 +165,20 @@ class AdsServer:
                 log.warning("ads: request with empty type_url ignored")
                 continue
             sub = subs.setdefault(
-                type_url, {"sent_version": None, "nonce": None,
-                           "acked": None})
+                type_url, {"sent_version": None, "nonce": None})
 
             if req.response_nonce and req.response_nonce != sub["nonce"]:
                 # Stale nonce: response to a superseded push — ignore
                 # (the xDS spec's stale-response rule).
                 continue
             if req.response_nonce and req.HasField("error_detail"):
-                # NACK: the client rejected sent_version; remember so the
-                # push loop doesn't hammer it with the same snapshot.
+                # NACK: the client rejected sent_version; the push loop
+                # stays quiet until a NEW snapshot version exists.
                 log.warning("ads: NACK for %s version %s: %s", type_url,
                             req.version_info, req.error_detail.message)
-                sub["nacked_version"] = sub["sent_version"]
                 continue
             if req.response_nonce:
-                # ACK of sent_version.
-                sub["acked"] = req.version_info
-                continue
+                continue  # ACK of sent_version — nothing more to do.
 
             # Initial subscription request for this type.
             yield respond(self.snapshot(), type_url)
@@ -199,8 +198,11 @@ class AdsServer:
         """Start the gRPC server (reference binds :7776,
         config/config.go:32).  Returns the bound port (0 → ephemeral)."""
         self.refresh()
+        # Each open ADS stream occupies one worker for its lifetime;
+        # size the pool well past any realistic same-host Envoy count so
+        # an extra client never hangs waiting for a slot.
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=8,
+            futures.ThreadPoolExecutor(max_workers=64,
                                        thread_name_prefix="ads"))
         self._server.add_generic_rpc_handlers((self._handlers(),))
         bound = self._server.add_insecure_port(f"{bind}:{port}")

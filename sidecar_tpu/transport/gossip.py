@@ -22,8 +22,10 @@ import logging
 import pathlib
 import subprocess
 import threading
+import time
 from typing import Optional
 
+from sidecar_tpu import metrics
 from sidecar_tpu import service as svc_mod
 from sidecar_tpu.catalog import ServicesState, decode
 
@@ -65,7 +67,8 @@ def load_native() -> ctypes.CDLL:
                         usable_prebuilt = \
                             hasattr(probe, "st_next_state_len") \
                             and hasattr(probe, "st_configure_probe") \
-                            and hasattr(probe, "st_poll_log")
+                            and hasattr(probe, "st_poll_log") \
+                            and hasattr(probe, "st_stats")
                     except OSError:
                         # Unloadable (corrupt/wrong-arch) prebuilt: fall
                         # through to the RuntimeError that carries the
@@ -100,6 +103,10 @@ def load_native() -> ctypes.CDLL:
             fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
         lib.st_next_state_len.restype = ctypes.c_int
         lib.st_next_state_len.argtypes = [ctypes.c_void_p]
+        lib.st_stats.restype = ctypes.c_int
+        lib.st_stats.argtypes = [ctypes.c_void_p,
+                                 ctypes.POINTER(ctypes.c_ulonglong),
+                                 ctypes.c_int]
         lib.st_port.restype = ctypes.c_int
         lib.st_port.argtypes = [ctypes.c_void_p]
         lib.st_stop.argtypes = [ctypes.c_void_p]
@@ -238,13 +245,24 @@ class GossipTransport:
             data = self.state.encode()
             self._lib.st_set_local_state(self._handle, data, len(data))
 
+    # Engine stats order (native/transport.cc Transport::stats).
+    _STAT_NAMES = ("engine.udpOut", "engine.udpBytesOut", "engine.udpIn",
+                   "engine.udpBytesIn", "engine.pushPullOut",
+                   "engine.pushPullIn")
+
+    def _poll_engine_stats(self) -> None:
+        vals = (ctypes.c_ulonglong * len(self._STAT_NAMES))()
+        n = self._lib.st_stats(self._handle, vals, len(vals))
+        for name, val in zip(self._STAT_NAMES[:n], vals[:n]):
+            metrics.set_gauge(name, int(val))
+
     def _outbound_loop(self) -> None:
-        """state.broadcasts → native queue (GetBroadcasts feed)."""
+        """state.broadcasts → native queue (GetBroadcasts feed).  Timed
+        + gauged like the reference delegate
+        (services_delegate.go:86-87)."""
         import queue as queue_mod
 
         last_state_push = 0.0
-        import time as time_mod
-
         while not self._quit.is_set():
             try:
                 prepared = self.state.broadcasts.get(timeout=0.2)
@@ -253,12 +271,17 @@ class GossipTransport:
             if self._quit.is_set():
                 return
             if prepared:
+                t0 = time.perf_counter()
                 for payload in prepared:
                     self._lib.st_broadcast(self._handle, payload,
                                            len(payload))
-            now = time_mod.monotonic()
+                metrics.measure_since("getBroadcasts", t0)
+            metrics.set_gauge("pendingBroadcasts",
+                              self.state.broadcasts.qsize())
+            now = time.monotonic()
             if now - last_state_push > 1.0:
                 self._push_local_state()
+                self._poll_engine_stats()
                 last_state_push = now
 
     def _inbound_loop(self) -> None:
@@ -272,11 +295,13 @@ class GossipTransport:
             n = self._lib.st_poll_msg(self._handle, buf, len(buf))
             if n > 0:
                 busy = True
+                t0 = time.perf_counter()
                 try:
                     svc = svc_mod.decode(buf.raw[:n])
                     self.state.update_service(svc)
                 except ValueError as exc:
                     log.warning("Error decoding gossip message: %s", exc)
+                metrics.measure_since("notifyMsg", t0)
 
             # Full-state payloads are unbounded (LocalState is the whole
             # catalog) — size the read from the engine's queue so a large
@@ -288,11 +313,13 @@ class GossipTransport:
                 n = self._lib.st_poll_state(self._handle, sbuf, len(sbuf))
                 if n > 0:
                     busy = True
+                    t0 = time.perf_counter()
                     try:
                         remote = decode(sbuf.raw[:n])
                         self.state.merge(remote)
                     except (ValueError, KeyError) as exc:
                         log.warning("Error merging remote state: %s", exc)
+                    metrics.measure_since("mergeRemoteState", t0)
 
             n = self._lib.st_poll_log(self._handle, buf, len(buf))
             if n > 0:

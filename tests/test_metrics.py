@@ -1,0 +1,163 @@
+"""Metrics tests: registry semantics, statsd wire format, and the
+VERDICT contract — timers firing on the real gossip path observed
+through a fake statsd UDP socket (the go-metrics + statsite analog,
+services_delegate.go:73-87, services_state.go:294, main.go:156-166)."""
+
+import socket
+import time
+
+import pytest
+
+from sidecar_tpu import metrics
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+
+@pytest.fixture
+def statsd():
+    """A fake statsd: bound UDP socket + a registry emitting to it."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(5.0)
+    port = sock.getsockname()[1]
+    reg = metrics.registry
+    reg.configure_statsd(f"127.0.0.1:{port}")
+    yield sock
+    reg.configure_statsd(None)
+    sock.close()
+
+
+def drain(sock, min_count=1, timeout=5.0):
+    """Read statsd datagrams until at least ``min_count`` arrive."""
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < min_count and time.monotonic() < deadline:
+        try:
+            data, _ = sock.recvfrom(4096)
+        except socket.timeout:
+            break
+        got.extend(data.decode().split("\n"))
+    return got
+
+
+class TestRegistry:
+    def test_counter_gauge_timer_aggregate(self):
+        reg = metrics.Metrics()
+        reg.incr("x")
+        reg.incr("x", 2)
+        reg.set_gauge("g", 7)
+        t0 = time.perf_counter()
+        reg.measure_since("t", t0)
+        snap = reg.snapshot()
+        assert snap["counters"]["x"] == 3
+        assert snap["gauges"]["g"] == 7
+        assert snap["timers"]["t"]["count"] == 1
+        assert snap["timers"]["t"]["last_ms"] >= 0
+
+    def test_statsd_formats(self, statsd):
+        metrics.incr("hits", 2)
+        metrics.set_gauge("depth", 5)
+        metrics.measure_since("op", time.perf_counter())
+        grams = drain(statsd, min_count=3)
+        kinds = {g.rsplit("|", 1)[-1] for g in grams}
+        assert kinds == {"c", "g", "ms"}
+        assert any(g.startswith("sidecar.hits:2|c") for g in grams)
+        assert any(g.startswith("sidecar.depth:5|g") for g in grams)
+
+    def test_disabled_sink_is_silent_and_safe(self):
+        reg = metrics.Metrics()
+        reg.configure_statsd(None)
+        reg.incr("still_counts")
+        assert reg.snapshot()["counters"]["still_counts"] == 1
+
+
+class TestCatalogTimers:
+    def test_add_service_entry_timer(self, statsd):
+        state = ServicesState(hostname="h1")
+        state.set_clock(lambda: T0)
+        state.add_service_entry(S.Service(
+            id="aaa111", name="web", image="w:1", hostname="h1",
+            updated=T0, status=S.ALIVE,
+            ports=[S.Port("tcp", 32768, 8080, "10.0.0.1")]))
+        grams = drain(statsd)
+        assert any(g.startswith("sidecar.addServiceEntry:")
+                   and g.endswith("|ms") for g in grams)
+        assert metrics.snapshot()["timers"]["addServiceEntry"]["count"] >= 1
+
+
+class TestGossipPathTimers:
+    def test_timers_fire_across_two_live_nodes(self, statsd):
+        """End to end: a record broadcast by node A reaches node B over
+        the real UDP engine; the delegate's notifyMsg timer, the catalog
+        addServiceEntry timer, the pendingBroadcasts gauge, and the
+        engine packet-count gauges must all show up at the fake
+        statsd."""
+        import threading
+
+        from sidecar_tpu.runtime.looper import TimedLooper
+        from sidecar_tpu.transport.gossip import GossipTransport
+
+        state_a = ServicesState(hostname="node-a")
+        state_b = ServicesState(hostname="node-b")
+        for st in (state_a, state_b):
+            threading.Thread(target=st.process_service_msgs,
+                             args=(TimedLooper(0.0),), daemon=True).start()
+        ta = GossipTransport(node_name="node-a", bind_ip="127.0.0.1",
+                             bind_port=0, advertise_ip="127.0.0.1",
+                             gossip_interval=0.05)
+        tb = GossipTransport(node_name="node-b", bind_ip="127.0.0.1",
+                             bind_port=0, advertise_ip="127.0.0.1",
+                             gossip_interval=0.05)
+        try:
+            port_a = ta.start(state_a)
+            tb.start(state_b, seeds=[f"127.0.0.1:{port_a}"])
+
+            svc = S.Service(
+                id="m111", name="metricsvc", image="m:1",
+                hostname="node-a", updated=S.now_ns(), status=S.ALIVE,
+                ports=[S.Port("tcp", 31000, 9000, "127.0.0.1")])
+            state_a.add_service_entry(svc)
+            state_a.broadcasts.put([svc.encode()])
+
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                with state_b._lock:
+                    if state_b.has_server("node-a") and \
+                            "m111" in state_b.servers["node-a"].services:
+                        break
+                time.sleep(0.1)
+            else:
+                pytest.fail("record never reached node B over gossip")
+
+            snap = metrics.snapshot()
+            assert snap["timers"]["notifyMsg"]["count"] >= 1
+            assert snap["timers"]["addServiceEntry"]["count"] >= 1
+            assert snap["timers"]["getBroadcasts"]["count"] >= 1
+            assert "pendingBroadcasts" in snap["gauges"]
+            # Engine counters: node A sent at least one packet, node B
+            # received at least one (both engines feed one registry).
+            time.sleep(1.2)  # one stats-poll cycle
+            snap = metrics.snapshot()
+            assert snap["gauges"].get("engine.udpOut", 0) >= 1
+            assert snap["gauges"].get("engine.udpIn", 0) >= 1
+
+            # Drain everything buffered on the fake statsd socket.
+            grams = []
+            statsd.settimeout(0.5)
+            while True:
+                try:
+                    data, _ = statsd.recvfrom(4096)
+                except socket.timeout:
+                    break
+                grams.extend(data.decode().split("\n"))
+                if any(g.startswith("sidecar.notifyMsg:") for g in grams):
+                    break
+            assert any(g.startswith("sidecar.notifyMsg:") for g in grams)
+        finally:
+            ta.stop()
+            tb.stop()
+            state_a.stop_processing()
+            state_b.stop_processing()

@@ -119,6 +119,38 @@ class TestServersPage:
         assert b"web" in body and b"h1" in body
 
 
+class TestUi:
+    """The operator surface (L9): /ui serves the static app wired in
+    main.py (reference: ui/app/services/services.html + services.js)."""
+
+    @pytest.fixture
+    def server(self):
+        state = make_state()
+        api = make_api(state)
+        srv = serve_http(api, bind="127.0.0.1", port=0, ui_dir="ui/app")
+        yield srv
+        srv.shutdown()
+
+    def get(self, srv, path):
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.headers.get_content_type(), resp.read()
+
+    def test_index_and_app_served(self, server):
+        status, ctype, body = self.get(server, "/ui/")
+        assert status == 200 and ctype == "text/html"
+        assert b"Sidecar" in body and b"app.js" in body
+        status, ctype, body = self.get(server, "/ui/app.js")
+        assert status == 200
+        assert b"/api/services.json" in body and b"/watch" in body
+
+    def test_root_redirects_to_ui(self, server):
+        # urlopen follows the 301; final document is the UI index.
+        status, ctype, body = self.get(server, "/")
+        assert status == 200 and b"Sidecar" in body
+
+
 class TestRealServer:
     @pytest.fixture
     def server(self):

@@ -1,0 +1,172 @@
+// Live catalog view. Primary feed: the /watch long-poll stream (a fresh
+// services.json-shaped snapshot per ChangeEvent, http_api.go:56-131);
+// fallback: polling /api/services.json every 2 s, the reference UI's
+// only mode (ui/app/services/services.js:12-33).
+"use strict";
+
+const STATUS = ["Alive", "Tombstone", "Unhealthy", "Unknown", "Draining"];
+
+function el(tag, attrs, ...children) {
+  const node = document.createElement(tag);
+  for (const [k, v] of Object.entries(attrs || {})) {
+    if (k === "class") node.className = v;
+    else node.setAttribute(k, v);
+  }
+  for (const child of children) {
+    node.append(child);
+  }
+  return node;
+}
+
+function timeAgo(ns) {
+  if (!ns) return "never";
+  const s = Math.max(0, Date.now() / 1000 - ns / 1e9);
+  if (s < 60) return `${Math.round(s)}s ago`;
+  if (s < 3600) return `${Math.round(s / 60)}m ago`;
+  if (s < 86400) return `${Math.round(s / 3600)}h ago`;
+  return `${Math.round(s / 86400)}d ago`;
+}
+
+function chip(status) {
+  const idx = (status >= 0 && status < STATUS.length) ? status : 3;
+  return el("span", { class: `chip s${idx}` }, STATUS[idx]);
+}
+
+function render(data) {
+  document.getElementById("cluster").textContent =
+    data.ClusterName ? `· ${data.ClusterName}` : "";
+
+  const members = document.getElementById("members");
+  members.replaceChildren();
+  const byName = data.ClusterMembers || {};
+  for (const name of Object.keys(byName).sort()) {
+    const m = byName[name];
+    members.append(el("div", { class: "member" }, name,
+      el("span", { class: "count" }, `${m.ServiceCount ?? 0} svc`)));
+  }
+  if (!members.children.length) {
+    members.append(el("div", { class: "member" }, "no members known"));
+  }
+
+  const wrap = document.getElementById("services");
+  const services = data.Services || {};
+  const names = Object.keys(services).sort();
+  if (!names.length) {
+    wrap.replaceChildren(el("div", { class: "empty" },
+      "No services in the catalog yet."));
+    return;
+  }
+  const table = el("table", {},
+    el("thead", {}, el("tr", {},
+      el("th", {}, "Service"), el("th", {}, "Host"),
+      el("th", {}, "Status"), el("th", {}, "Ports"),
+      el("th", {}, "Updated"))));
+  const body = el("tbody", {});
+  for (const name of names) {
+    const instances = services[name];
+    instances.forEach((svc, i) => {
+      const ports = (svc.Ports || [])
+        .map(p => p.ServicePort ? `${p.ServicePort}→${p.Port}` : `${p.Port}`)
+        .join(", ");
+      const row = el("tr", {});
+      const label = i === 0
+        ? el("td", { class: "svc", rowspan: String(instances.length) },
+            name, el("div", { class: "img" }, svc.Image || ""))
+        : null;
+      if (label) row.append(label);
+      row.append(
+        el("td", {}, svc.Hostname || "?"),
+        el("td", {}, chip(svc.Status)),
+        el("td", { class: "ports" }, ports),
+        el("td", {}, timeAgo(svc.Updated)));
+      body.append(row);
+    });
+  }
+  table.append(body);
+  wrap.replaceChildren(table);
+}
+
+function setStatus(text, err) {
+  const node = document.getElementById("status");
+  node.textContent = text;
+  node.className = err ? "err" : "";
+}
+
+async function pollLoop() {
+  for (;;) {
+    try {
+      const resp = await fetch("/api/services.json");
+      render(await resp.json());
+      setStatus(`polling · ${new Date().toLocaleTimeString()}`);
+    } catch (err) {
+      setStatus(`poll failed: ${err}`, true);
+    }
+    await new Promise(resolve => setTimeout(resolve, 2000));
+  }
+}
+
+// /watch snapshots carry only the {service: [instances]} map; the
+// member list + cluster name come from the full envelope, refreshed on
+// a slow cadence.
+let envelope = { Services: {} };
+
+async function refreshEnvelope() {
+  const resp = await fetch("/api/services.json");
+  envelope = await resp.json();
+  render(envelope);
+}
+
+async function watchLoop() {
+  // /watch streams chunked JSON snapshots; consume incrementally and
+  // render each complete JSON document (snapshots are newline-free
+  // single objects, so brace-depth framing is enough).
+  setInterval(() => refreshEnvelope().catch(() => {}), 10000);
+  for (;;) {
+    try {
+      await refreshEnvelope().catch(() => {});
+      const resp = await fetch("/watch");
+      if (!resp.ok || !resp.body) throw new Error(`HTTP ${resp.status}`);
+      const reader = resp.body.getReader();
+      const decoder = new TextDecoder();
+      let buf = "";
+      for (;;) {
+        const { done, value } = await reader.read();
+        if (done) break;
+        buf += decoder.decode(value, { stream: true });
+        let depth = 0, start = -1, inStr = false, esc = false;
+        for (let i = 0; i < buf.length; i++) {
+          const c = buf[i];
+          if (esc) { esc = false; continue; }
+          if (c === "\\") { esc = inStr; continue; }
+          if (c === '"') { inStr = !inStr; continue; }
+          if (inStr) continue;
+          if (c === "{") { if (depth === 0) start = i; depth++; }
+          else if (c === "}") {
+            depth--;
+            if (depth === 0 && start >= 0) {
+              envelope.Services = JSON.parse(buf.slice(start, i + 1));
+              render(envelope);
+              setStatus(`live · ${new Date().toLocaleTimeString()}`);
+              buf = buf.slice(i + 1);
+              i = -1;
+            }
+          }
+        }
+      }
+      throw new Error("stream ended");
+    } catch (err) {
+      setStatus(`watch lost (${err}); retrying…`, true);
+      try {
+        const resp = await fetch("/api/services.json");
+        render(await resp.json());
+      } catch (_) { /* keep the last view */ }
+      await new Promise(resolve => setTimeout(resolve, 2000));
+    }
+  }
+}
+
+if (window.ReadableStream) {
+  watchLoop();
+} else {
+  pollLoop();
+}
