@@ -32,10 +32,10 @@ raggedness" names this the hard part):
 
 Line competition: the freshest record (largest packed key) wins a cache
 line, ties broken by larger slot id; a line's value never regresses.
-Evicting a still-live belief loses information — the model counts those
-evictions (``state.evictions``) so an under-provisioned K is visible —
-and liveness is restored by the owners' recovery re-offer plus the
-anti-entropy cache/own exchange.
+Displacing an occupied line loses that belief — the model counts those
+displacements (``state.evictions``) so an under-provisioned K is
+visible — and liveness is restored by the owners' recovery re-offer
+plus the anti-entropy cache/own exchange.
 
 Scale regime: this model starts CONVERGED (floor = the boot catalog)
 and measures how injected churn — the steady-state workload —
@@ -698,11 +698,15 @@ class CompressedSim:
         return final
 
 
-# -- shared kernels (also used by the sharded twin) -------------------------
+# -- host-path kernels ------------------------------------------------------
 
 def _line_compete(cache_slot, cache_val, cache_sent, rows, slots, vals,
                   cache_lines, floor):
-    """Resolve a batch of (node-row, slot, val) cache insertions: the
+    """Scatter-based line competition — retained ONLY for the host-side
+    ``mint`` path (arbitrary slot lists, once per scenario event); the
+    per-round paths are the scatter-free board/announce kernels above.
+
+    Resolves a batch of (node-row, slot, val) cache insertions: the
     largest val wins each line (value ties broken by larger slot id),
     existing content included.  Entries with val ≤ 0 or slot < 0 are
     no-ops; floor-dead entries are filtered.  Returns

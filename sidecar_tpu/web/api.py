@@ -110,6 +110,14 @@ class SidecarApi:
         if parts == ["servers"]:
             return self.servers_page()
 
+        # Observability surface — the go-metrics + net/http/pprof analog
+        # (sidecarhttp/http.go:5, main.go:156-166): live hot-path
+        # counters/timers and thread stack dumps.
+        if parts == ["metrics.json"]:
+            return self.metrics_dump()
+        if parts == ["debug", "stacks"]:
+            return self.debug_stacks()
+
         if len(parts) == 1 and parts[0].startswith("services."):
             return self.services(parts[0].rsplit(".", 1)[1])
         if len(parts) == 1 and parts[0].startswith("state."):
@@ -206,6 +214,31 @@ class SidecarApi:
         return 200, "text/html", body, {}
 
     # -- watch plumbing ----------------------------------------------------
+
+    def metrics_dump(self):
+        """Hot-path counters/gauges/timers (the statsd registry's
+        in-memory view) — the observability read the reference only had
+        via an external statsd pipeline."""
+        from sidecar_tpu import metrics
+
+        body = json.dumps(metrics.snapshot(), indent=2).encode()
+        return 200, "application/json", body, CORS_HEADERS
+
+    def debug_stacks(self):
+        """Per-thread stack dump — the live-pprof analog the reference
+        gets from net/http/pprof's side-effect import."""
+        import sys
+        import threading
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in sys._current_frames().items():
+            out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+            out.extend(line.rstrip()
+                       for line in traceback.format_stack(frame))
+        body = "\n".join(out).encode()
+        return 200, "text/plain", body, CORS_HEADERS
 
     def watch_snapshot(self, by_service: bool) -> bytes:
         if by_service:

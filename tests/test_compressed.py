@@ -234,6 +234,29 @@ class TestProtocolSemantics:
         st, conv2 = healed.run(st, jax.random.PRNGKey(6), 150)
         assert np.asarray(conv2)[-1] == 1.0
 
+    def test_quorum_fold_disabled_under_partition(self):
+        """A minority partition SMALLER than the quorum complement must
+        still hold convergence below 1: with a cut modeled, the census
+        falls back to unanimity (the anti-entropy guarantee behind the
+        quorum fold cannot reach across a partition), so majority-side
+        churn can never fold into the shared floor while the cut
+        stands."""
+        n = 1024
+        topo = topology.ring(n, hops=3)
+        side = (np.arange(n) >= n - 4).astype(np.int32)  # 4 nodes ≈ 0.4%
+        cut = topology.partition_mask(topo, side)
+        p = CompressedParams(n=n, services_per_node=4, cache_lines=128)
+        assert (1.0 - p.fold_quorum) * n > 4 * 0.9  # minority < complement
+        sim = CompressedSim(p, topo, PINNED, cut_mask=cut, node_side=side)
+        slots = jnp.arange(24, dtype=jnp.int32) * 7  # majority-owned
+        st = sim.mint(sim.init_state(), slots, 10)
+        st, conv = sim.run(st, jax.random.PRNGKey(9), 150)
+        # The floor never advances for the minted slots (isolated nodes
+        # can't have heard them) and convergence stays below 1.
+        boot = int(pack(1, ALIVE))
+        assert (np.asarray(st.floor[slots]) == boot).all()
+        assert np.asarray(conv).max() < 1.0
+
     def test_chunked_run_is_deterministic(self):
         """run(s0, k, a+b) == run(run(s0, k, a), k, b) — fold-in PRNG
         chunking, the checkpoint/resume contract (same as ExactSim)."""

@@ -119,6 +119,28 @@ class TestServersPage:
         assert b"web" in body and b"h1" in body
 
 
+class TestObservability:
+    def test_metrics_json(self):
+        from sidecar_tpu import metrics
+
+        api = make_api()  # building the state times addServiceEntry
+        status, ctype, body, _ = api.dispatch("GET", "/api/metrics.json")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert set(doc) == {"counters", "gauges", "timers"}
+        assert doc["timers"]["addServiceEntry"]["count"] >= 1
+        assert metrics.snapshot()["timers"]["addServiceEntry"]["count"] \
+            == doc["timers"]["addServiceEntry"]["count"]
+
+    def test_debug_stacks(self):
+        status, ctype, body, _ = make_api().dispatch(
+            "GET", "/api/debug/stacks")
+        assert status == 200 and ctype == "text/plain"
+        # Our own frame is in the dump.
+        assert b"test_debug_stacks" in body
+        assert b"--- thread MainThread" in body
+
+
 class TestUi:
     """The operator surface (L9): /ui serves the static app wired in
     main.py (reference: ui/app/services/services.html + services.js)."""
