@@ -125,7 +125,16 @@ class SidecarNode:
         self.xds = None
         self.ads = None
         if self.config.envoy.use_grpc_api:
-            from sidecar_tpu.proxy.ads import AdsServer
+            try:
+                from sidecar_tpu.proxy.ads import AdsServer
+            except ImportError as exc:
+                # Fail fast: an Envoy fleet bootstrapped for a gRPC ADS
+                # stream gets nothing from a silent REST fallback.
+                raise RuntimeError(
+                    "ENVOY_USE_GRPC_API=true but the gRPC stack is "
+                    f"unavailable ({exc}); install grpcio/protobuf or "
+                    "set ENVOY_USE_GRPC_API=false for REST xDS"
+                ) from exc
             self.ads = AdsServer(self.state, self.config.envoy.bind_ip,
                                  self.config.envoy.use_hostnames)
         else:
