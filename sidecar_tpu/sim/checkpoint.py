@@ -1,10 +1,15 @@
-"""Checkpoint/resume for simulated cluster state.
+"""Checkpoint/resume for simulated cluster state — both models.
 
 The reference needs no checkpointing (state rebuilds from peers on
 rejoin, SURVEY.md §5); the simulator does — long convergence studies
 should survive preemption.  Chunk-resumability is exact: the scan
 derives per-round PRNG keys from the round index, so a resumed run
-replays the same randomness as an uninterrupted one."""
+replays the same randomness as an uninterrupted one.
+
+Supports the dense ``ExactSim`` state and the compressed large-cluster
+``CompressedSim`` state (both single-chip and their sharded twins —
+the arrays are gathered to host on save and re-placed by the target
+sim's ``init``-style sharding on the next ``run``)."""
 
 from __future__ import annotations
 
@@ -15,44 +20,79 @@ import pathlib
 import jax.numpy as jnp
 import numpy as np
 
+from sidecar_tpu.models.compressed import CompressedParams, CompressedState
 from sidecar_tpu.models.exact import SimParams, SimState
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2
+
+_KINDS = {
+    "exact": (SimState, SimParams),
+    "compressed": (CompressedState, CompressedParams),
+}
 
 
-def save_state(path: str | pathlib.Path, state: SimState,
-               params: SimParams) -> None:
+def _kind_of(state) -> str:
+    for kind, (state_cls, _) in _KINDS.items():
+        if isinstance(state, state_cls):
+            return kind
+    raise TypeError(f"unsupported state type {type(state).__name__}")
+
+
+def save_state(path: str | pathlib.Path, state, params) -> None:
     """Write state + params to a compressed npz."""
+    kind = _kind_of(state)
+    _, params_cls = _KINDS[kind]
+    if not isinstance(params, params_cls):
+        raise TypeError(
+            f"{type(state).__name__} must be saved with "
+            f"{params_cls.__name__}, got {type(params).__name__}")
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
+    arrays = {f.name: np.asarray(getattr(state, f.name))
+              for f in dataclasses.fields(state)}
     np.savez_compressed(
         path,
         version=FORMAT_VERSION,
-        known=np.asarray(state.known),
-        sent=np.asarray(state.sent),
-        node_alive=np.asarray(state.node_alive),
-        round_idx=np.asarray(state.round_idx),
+        kind=kind,
         params=json.dumps(dataclasses.asdict(params)),
+        **arrays,
     )
 
 
-def load_state(path: str | pathlib.Path) -> tuple[SimState, SimParams]:
-    """Load a checkpoint; raises ValueError on version/shape mismatch."""
+def load_state(path: str | pathlib.Path):
+    """Load a checkpoint → (state, params); raises ValueError on
+    version/shape mismatch.  Version-1 files (exact model only, no
+    ``kind`` field) load transparently."""
     with np.load(pathlib.Path(path), allow_pickle=False) as data:
         version = int(data["version"])
-        if version != FORMAT_VERSION:
+        if version == 1:
+            kind = "exact"
+        elif version == FORMAT_VERSION:
+            kind = str(data["kind"])
+        else:
             raise ValueError(
                 f"checkpoint version {version} unsupported "
-                f"(expected {FORMAT_VERSION})")
-        params = SimParams(**json.loads(str(data["params"])))
-        state = SimState(
-            known=jnp.asarray(data["known"]),
-            sent=jnp.asarray(data["sent"]),
-            node_alive=jnp.asarray(data["node_alive"]),
-            round_idx=jnp.asarray(data["round_idx"]),
-        )
-    if state.known.shape != (params.n, params.m):
-        raise ValueError(
-            f"checkpoint shape {state.known.shape} does not match params "
-            f"({params.n}, {params.m})")
+                f"(expected <= {FORMAT_VERSION})")
+        if kind not in _KINDS:
+            raise ValueError(f"unknown checkpoint kind {kind!r}")
+        state_cls, params_cls = _KINDS[kind]
+        params = params_cls(**json.loads(str(data["params"])))
+        state = state_cls(**{
+            f.name: jnp.asarray(data[f.name])
+            for f in dataclasses.fields(state_cls)})
+
+    if kind == "exact":
+        expect = {"known": (params.n, params.m)}
+    else:
+        expect = {
+            "own": (params.n, params.services_per_node),
+            "cache_val": (params.n, params.cache_lines),
+            "floor": (params.m,),
+        }
+    for name, shape in expect.items():
+        got = getattr(state, name).shape
+        if got != shape:
+            raise ValueError(
+                f"checkpoint shape {name}={got} does not match params "
+                f"{shape}")
     return state, params

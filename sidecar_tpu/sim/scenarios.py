@@ -328,7 +328,8 @@ def config5_split_heal(eps: float = 1e-5, split_rounds: int = 150,
     conv = np.concatenate([conv_split, conv_heal])
     rounds = split_rounds + heal_rounds
     er = _eps_round(conv, eps, conv_every)
-    split_peak = float(conv_split.max())
+    split_peak = float(conv_split.max()) if conv_split.size else \
+        float("nan")
     return ScenarioResult(
         name="config5-split-heal", n=n,
         services_per_node=params.services_per_node, rounds_run=rounds,
