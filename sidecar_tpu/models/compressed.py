@@ -65,17 +65,24 @@ Round structure (mirrors models/exact.py):
    own-rows exchange with the node ``stride`` positions away.  Caches
    are line-aligned across nodes, so the exchange is ``jnp.roll`` +
    elementwise merge; own rows ride the same S-pass insert.
-4. floor advance + sweep — per-slot census (truth = freshest belief,
-   hits = #alive nodes at truth); slots where every alive node agrees
-   fold into the floor and their cache lines free; the TTL sweep
-   (ops/ttl.py) runs over own + cache + floor — one shared floor sweep
-   models every node's identical deterministic sweep.
+4. floor advance + sweep — per-LINE census (each line's winning
+   (slot, version) and its holder count, a column reduction over the
+   node axis — O(N·K) elementwise, no scatters); lines where every
+   alive node holds the winner fold it into the floor and free
+   elementwise; the TTL sweep (ops/ttl.py) runs over own + cache +
+   floor — one shared floor sweep models every node's identical
+   deterministic sweep.  (The winner count equals the per-slot census
+   hit count exactly — see ``_line_census``; the per-slot scatter
+   census ``_census`` remains as the exact convergence-metric
+   fallback.)
 
 TPU cost model (measured on v5e; the reason for the board form): XLA
 scatters with dynamic duplicate indices cost ~10-130 ms at these shapes
-while the equivalent elementwise/row-gather passes cost ~1-15 ms, so
-the round keeps ZERO per-round scatters — the only scattered paths left
-are the (amortized) census and the host-side ``mint``.  Two documented
+while the equivalent elementwise/row-gather passes cost ~1-15 ms
+(benchmarks/scatter_costs.py), so the round keeps ZERO per-round
+scatters — the only scattered paths left are the exact convergence
+census (the metric fallback; the common fast path is one gather), the
+amortized deep below-floor sweep, and the host-side ``mint``.  Two documented
 semantic refinements come with the form, both self-consistent across
 this model, its oracle uses, and the sharded twin:
 
@@ -116,6 +123,7 @@ from sidecar_tpu.ops.merge import (
 )
 from sidecar_tpu.ops.status import (
     ALIVE,
+    DRAINING,
     TOMBSTONE,
     is_known,
     pack,
@@ -181,6 +189,17 @@ class CompressedParams:
                                  # anti-entropy delivery guarantee for the
                                  # straggler tail (see
                                  # _floor_advance_and_sweep)
+    deep_sweep_every: int = 1    # every k-th sweep also runs the exact
+                                 # below-floor line free (an O(N·K) gather
+                                 # from floor[M] — the only sweep-path op
+                                 # whose cost scales with M).  Its job is
+                                 # clearing refresh-fold residue: line
+                                 # folds free their copies inline, and
+                                 # TTL-driven floor moves trigger the
+                                 # exact free automatically regardless of
+                                 # this cadence.  North-star-scale configs
+                                 # with refresh pinned out raise it or set
+                                 # 0 = periodic pass off entirely.
 
     def __post_init__(self):
         if self.cache_lines & (self.cache_lines - 1):
@@ -189,6 +208,8 @@ class CompressedParams:
             raise ValueError("budget cannot exceed cache_lines")
         if not 0.0 < self.fold_quorum <= 1.0:
             raise ValueError("fold_quorum must be in (0, 1]")
+        if self.deep_sweep_every < 0:
+            raise ValueError("deep_sweep_every must be >= 0 (0 = never)")
 
     @property
     def m(self) -> int:
@@ -292,8 +313,12 @@ class CompressedSim:
         rotation is implemented as log2(K) conditional ``jnp.roll``
         passes (arbitrary per-row gathers measure ~100× slower than
         rolls on TPU v5e, ops/gossip.select_messages).  Entries at or
-        below the floor cannot linger here: census line-freeing and the
-        insert filters maintain that invariant (see ``_pull_merge``)."""
+        below the floor are cleared by the census line-freeing and the
+        deferred deep sweep (``deep_sweep_every``); between deep sweeps
+        a refresh-fold orphan may stay publish-eligible for a few
+        sweeps — stale-but-harmless traffic that loses every line
+        competition against in-flight records (see
+        ``_floor_advance_and_sweep``)."""
         p = self.p
         k = p.cache_lines
         eligible = (state.cache_slot >= 0) & \
@@ -530,11 +555,72 @@ class CompressedSim:
             state, cache_slot=ws, cache_val=wv, cache_sent=sent,
             evictions=ev)
 
+    def _line_census(self, state: CompressedState):
+        """Per-line winner and holder count across alive nodes — the
+        O(N·K)-elementwise census (plus [K]-sized gathers) behind the
+        floor fold.
+
+        Because the line hash is global, every copy of a record sits at
+        the same line position on every node, so "who holds slot s at
+        version v" is a column question: the line's winner (ws, wv) is a
+        lex-max reduction over the node axis, and its holder count is an
+        equality-match sum down the same column.  The owner is counted
+        through its authoritative ``own`` record (its cache copy of its
+        own slot, if any, is excluded — same double-count guard as
+        :func:`_census`).  For winner slots this computes EXACTLY the
+        per-slot census hit count: a cache entry for slot s can only
+        live at line hash(s), and only entries at the winning version
+        match.  (The sharded twin inherits this at the jit level: the
+        node-axis reductions become all-reduces under GSPMD.)"""
+        p = self.p
+        alive_c = state.node_alive[:, None]
+        occupied = (state.cache_slot >= 0) & alive_c
+        val = jnp.where(occupied, state.cache_val, 0)
+        wv = jnp.max(val, axis=0)                               # [K]
+        ws = jnp.max(jnp.where(occupied & (val == wv[None, :]),
+                               state.cache_slot, -1), axis=0)   # [K]
+
+        node = jnp.arange(p.n, dtype=jnp.int32)[:, None]
+        holder = occupied & (state.cache_slot == ws[None, :]) & \
+            (state.cache_val == wv[None, :])
+        owner_of_ws = jnp.where(ws >= 0, ws // p.services_per_node, -1)
+        holder = holder & (node != owner_of_ws[None, :])
+        count = jnp.sum(holder.astype(jnp.int32), axis=0)       # [K]
+
+        own_flat = state.own.reshape(p.m)
+        owner_alive = state.node_alive[jnp.maximum(owner_of_ws, 0)]
+        own_at = own_flat[jnp.maximum(ws, 0)]
+        owner_holds = (ws >= 0) & owner_alive & (own_at >= wv)
+        return ws, wv, count + owner_holds.astype(jnp.int32)
+
     def _floor_advance_and_sweep(self, state: CompressedState, now):
-        """Census → floor advance → line free → TTL sweep."""
+        """Per-line census → floor advance → line free → TTL sweep.
+
+        The fold is per cache line: each line's winning (slot, version)
+        folds into the floor when every alive node holds it (or the
+        quorum + anti-entropy-age rule below fires), and the folded
+        entries free elementwise in the same pass.  Folding is per-LINE
+        rather than per-slot — a line's non-winning slots wait for the
+        line to drain (winner folds → line frees → losers re-enter via
+        the owners' recovery re-offer) instead of being quorum-folded
+        mid-displacement; for winner slots the count is identical to the
+        per-slot census (see :func:`_line_census`).  This keeps the
+        whole fold path O(N·K): the old per-slot census's three
+        ~N·K-index scatter/gathers against [M] measured ~680 ms at the
+        100k-node north star (scatter cost model:
+        benchmarks/scatter_costs.py) — charged every sweep — vs ~2 ms
+        for the column reductions here.
+
+        The only remaining M-scaled sweep op — the exact below-floor
+        line free, whose job is clearing stale cache copies orphaned by
+        REFRESH folds (fold-freed lines are already handled inline) —
+        runs every ``deep_sweep_every``-th sweep."""
         p, t = self.p, self.t
-        truth, hits, n_alive = _census(state, p)
-        caught_up = hits >= n_alive
+        ws, wv, hits = self._line_census(state)
+        n_alive = jnp.sum(state.node_alive.astype(jnp.int32))
+        safe_ws = jnp.maximum(ws, 0)
+        above = (ws >= 0) & (wv > state.floor[safe_ws])
+        caught_up = above & (hits >= n_alive)
         if p.fold_quorum < 1.0 and self._cut is None:
             # Quorum folds are DISABLED while a partition is modeled
             # (cut_mask active): the anti-entropy guarantee below cannot
@@ -557,12 +643,13 @@ class CompressedSim:
             q_hits = jnp.ceil(
                 jnp.float32(p.fold_quorum)
                 * n_alive.astype(jnp.float32)).astype(jnp.int32)
-            age_ok = now - unpack_ts(truth) >= \
+            age_ok = now - unpack_ts(wv) >= \
                 t.push_pull_rounds * t.round_ticks
-            caught_up = caught_up | \
-                ((hits >= q_hits) & age_ok & (truth > state.floor))
-        floor = jnp.where(caught_up, jnp.maximum(state.floor, truth),
-                          state.floor)
+            caught_up = caught_up | (above & (hits >= q_hits) & age_ok)
+
+        fold_idx = jnp.where(caught_up, safe_ws, p.m)
+        fold_val = jnp.where(caught_up, wv, 0)
+        floor = state.floor.at[fold_idx].max(fold_val, mode="drop")
         # Floor-mediated DRAINING stickiness (see the module docstring):
         # a fold that would flip a DRAINING floor slot to a newer ALIVE
         # keeps DRAINING at the new timestamp — the per-host catalog
@@ -570,8 +657,11 @@ class CompressedSim:
         # where this model materializes the catalog.
         floor = apply_stickiness(state.floor, floor)
 
-        below = (state.cache_slot >= 0) & (
-            state.cache_val <= floor[jnp.maximum(state.cache_slot, 0)])
+        # Free folded lines elementwise: every copy of a just-folded
+        # winner is at its line position at ≤ the folded version.
+        below = (state.cache_slot == ws[None, :]) & caught_up[None, :] & \
+            (state.cache_val <= wv[None, :])
+
         cache_slot = jnp.where(below, -1, state.cache_slot)
         cache_val = jnp.where(below, 0, state.cache_val)
         cache_sent = jnp.where(below, jnp.int8(0), state.cache_sent)
@@ -581,12 +671,41 @@ class CompressedSim:
                   tombstone_lifespan=t.tombstone_lifespan,
                   one_second=t.one_second)
         own, _ = ttl_sweep(state.own, now, **kw)
-        floor, _ = ttl_sweep(floor, now, **kw)
+        floor_swept, _ = ttl_sweep(floor, now, **kw)
         swept_val, _ = ttl_sweep(cache_val, now, **kw)
         cache_sent = jnp.where(swept_val != cache_val, jnp.int8(0),
                                cache_sent)
+
+        # Exact below-floor free (the O(N·K) gather from floor[M]):
+        # catches cache copies orphaned by floor advances that aren't
+        # line folds — refresh folds (the periodic cadence below), and
+        # TTL transitions of floor entries (tombstone bumps to ts+1 s
+        # can leap over copies of a version minted within that second;
+        # detected by comparing the floor across its sweep, so the
+        # gather runs only on rounds where expiry actually moved it).
+        # deep_sweep_every == 0 disables only the periodic cadence —
+        # sound when refresh folds cannot occur (pinned refresh).
+        deep_due = floor_swept != floor
+        deep_due = jnp.any(deep_due)
+        if p.deep_sweep_every > 0:
+            round_idx = now // t.round_ticks
+            deep_rounds = t.sweep_rounds * p.deep_sweep_every
+            deep_due = deep_due | (round_idx % deep_rounds == 0)
+
+        def deep_free(args):
+            cs, cv, se = args
+            orphaned = (cs >= 0) & (
+                cv <= floor_swept[jnp.maximum(cs, 0)])
+            return (jnp.where(orphaned, -1, cs),
+                    jnp.where(orphaned, 0, cv),
+                    jnp.where(orphaned, jnp.int8(0), se))
+
+        cache_slot, swept_val, cache_sent = lax.cond(
+            deep_due, deep_free, lambda a: a,
+            (cache_slot, swept_val, cache_sent))
+
         return dataclasses.replace(
-            state, own=own, floor=floor, cache_slot=cache_slot,
+            state, own=own, floor=floor_swept, cache_slot=cache_slot,
             cache_val=swept_val, cache_sent=cache_sent)
 
     def _step(self, state: CompressedState,
@@ -631,18 +750,71 @@ class CompressedSim:
     def convergence(self, state: CompressedState) -> jax.Array:
         """Fraction of (alive node, slot) beliefs agreeing with the
         freshest belief — the exact model's metric, computed from the
-        compressed representation in O(N·K + M).  Scatter-bound (~3
-        protocol rounds at 65k nodes on v5e), which is why ``run``
-        samples it on the ``conv_every`` cadence rather than computing
-        it inline every round."""
-        truth, hits, n_alive = _census(state, self.p)
-        behind = jnp.maximum(n_alive - hits, 0)
-        # Denominator in float: n_alive·m overflows int32 at the scales
-        # this model exists for (65,536 × 655,360 ≈ 4.3e10).
-        denom = jnp.maximum(
-            n_alive.astype(jnp.float32) * jnp.float32(self.p.m), 1.0)
-        frac_behind = jnp.sum(behind.astype(jnp.float32)) / denom
-        return 1.0 - frac_behind
+        compressed representation.
+
+        Fast path (the common measurement regime — every node alive, no
+        DRAINING records anywhere): circulating versions originate from
+        their owners and only move forward, so the global truth is
+        simply ``max(floor, own)`` elementwise and a slot is in flight
+        iff its owner is ahead of the floor.  The per-slot behind count
+        then collapses to one O(N·K) gather (cache entries at truth)
+        plus elementwise passes — no scatters.  The invariant breaks
+        only for DRAINING: a sticky-adjusted delivery re-packs an
+        advancing ALIVE as DRAINING at the same tick, which outranks the
+        owner's own copy (ops/status.py tie order), and a dead owner's
+        cached copies outlive ``own``'s alive-mask — both cases (plus
+        any dead node) fall back to the exact scatter census
+        (:func:`_census`), which this fast path reproduces bit-for-bit
+        otherwise (tests/test_compressed.py pins the equality).
+
+        Cost: the exact census is three ~N·K-index scatter/gathers
+        against [M] — ~680 ms at the 100k-node north star — vs ~230 ms
+        for the fast path's single gather, which is why ``run`` samples
+        the metric on the ``conv_every`` cadence rather than inline
+        every round."""
+        p = self.p
+
+        def exact(st):
+            truth, hits, n_alive = _census(st, p)
+            behind = jnp.maximum(n_alive - hits, 0)
+            # Denominator in float: n_alive·m overflows int32 at the
+            # scales this model exists for (65,536 × 655,360 ≈ 4.3e10).
+            denom = jnp.maximum(
+                n_alive.astype(jnp.float32) * jnp.float32(p.m), 1.0)
+            return 1.0 - jnp.sum(behind.astype(jnp.float32)) / denom
+
+        def fast(st):
+            own_flat = st.own.reshape(p.m)
+            truth = jnp.maximum(st.floor, own_flat)
+            in_flight = truth > st.floor
+            # Sentinel so folded slots can't collect hits through the
+            # single gather (their behind is 0 by definition): packed
+            # keys are < 2^31 - 1 (MAX_TICK), so nothing matches it.
+            aux = jnp.where(in_flight, truth, jnp.int32(2**31 - 1))
+            node = jnp.arange(p.n, dtype=jnp.int32)[:, None]
+            occ = st.cache_slot >= 0
+            not_own = jnp.where(
+                occ, st.cache_slot // p.services_per_node, -1) != node
+            at_truth = occ & not_own & (
+                st.cache_val >= aux[jnp.maximum(st.cache_slot, 0)])
+            n_inflight = jnp.sum(in_flight.astype(jnp.int32))
+            # Owners of in-flight slots always hold truth (= their own
+            # record); everyone else counts through the cache.
+            sum_hits = jnp.sum(at_truth.astype(jnp.int32)) + n_inflight
+            behind = jnp.float32(p.n) * n_inflight.astype(jnp.float32) \
+                - sum_hits.astype(jnp.float32)
+            denom = jnp.maximum(jnp.float32(p.n) * jnp.float32(p.m), 1.0)
+            return 1.0 - behind / denom
+
+        draining = is_known(state.own) & \
+            (unpack_status(state.own) == DRAINING)
+        draining_f = is_known(state.floor) & \
+            (unpack_status(state.floor) == DRAINING)
+        draining_c = (state.cache_slot >= 0) & \
+            (unpack_status(state.cache_val) == DRAINING)
+        fast_ok = jnp.all(state.node_alive) & ~jnp.any(draining) & \
+            ~jnp.any(draining_f) & ~jnp.any(draining_c)
+        return lax.cond(fast_ok, fast, exact, state)
 
     # -- drivers ------------------------------------------------------------
 

@@ -214,7 +214,13 @@ def select_messages(known, sent, budget, limit, row_offset=0):
     # per-row circular shift is done as log2(G) conditional jnp.rolls
     # (binary shift decomposition), each a fused bandwidth-bound pass
     # over [N, G] — ~1 ms total.
-    sub = max(8, math.isqrt(m // budget) + 1)
+    # Group width: prefer an exact divisor of M near the ideal √(M/budget)
+    # so the reshape needs NO padding — padding materializes a full copy
+    # of the [N, M] priority tensor (a ~3 ms barrier at the bench shapes,
+    # measured v5e) that XLA otherwise fuses away into the group-max.
+    ideal = max(8, math.isqrt(m // budget) + 1)
+    sub = next((d for d in range(ideal, min(4 * ideal, m) + 1) if m % d == 0),
+               ideal)
     g = -(-m // sub)  # ceil
     pad = g * sub - m
     if pad:

@@ -82,12 +82,17 @@ def load_state(path: str | pathlib.Path):
             for f in dataclasses.fields(state_cls)})
 
     if kind == "exact":
-        expect = {"known": (params.n, params.m)}
+        expect = {"known": (params.n, params.m),
+                  "sent": (params.n, params.m),
+                  "node_alive": (params.n,)}
     else:
         expect = {
             "own": (params.n, params.services_per_node),
+            "cache_slot": (params.n, params.cache_lines),
             "cache_val": (params.n, params.cache_lines),
+            "cache_sent": (params.n, params.cache_lines),
             "floor": (params.m,),
+            "node_alive": (params.n,),
         }
     for name, shape in expect.items():
         got = getattr(state, name).shape

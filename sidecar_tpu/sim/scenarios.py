@@ -260,7 +260,8 @@ def config4_ba_antientropy(eps: float = 2e-4, rounds: int = 400,
         n = -(-n // d) * d
     cfg = dataclasses.replace(_STUDY_CFG, push_pull_interval_s=4.0)
     params = CompressedParams(n=n, services_per_node=10, fanout=3,
-                              budget=15, cache_lines=256)
+                              budget=15, cache_lines=256,
+                              deep_sweep_every=5)
     sim = _compressed_sim(params, topo_mod.barabasi_albert(n, m=3, seed=4),
                           cfg, sharded)
     conv_every = 5 if n >= 16_384 else 1
@@ -303,7 +304,8 @@ def config5_split_heal(eps: float = 1e-5, split_rounds: int = 150,
     cut = topo_mod.partition_mask(topo, halves)
 
     params = CompressedParams(n=n, services_per_node=4, fanout=3,
-                              budget=15, cache_lines=64)
+                              budget=15, cache_lines=64,
+                              deep_sweep_every=5)
     # Frequent anti-entropy: healing a partition is seeded by push-pull
     # at the boundary, then drained by gossip relay.
     cfg = dataclasses.replace(_STUDY_CFG, push_pull_interval_s=2.0)

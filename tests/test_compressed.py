@@ -289,3 +289,93 @@ class TestProtocolSemantics:
         # value itself to DRAINING, so the fold preserves it.
         floor_st = int(unpack_status(st.floor[5]))
         assert floor_st == DRAINING
+
+
+class TestMetricFastPath:
+    """convergence() picks a scatter-free fast path when every node is
+    alive and no DRAINING exists (models/compressed.py); these tests pin
+    that both paths compute the SAME number, and that the gates route to
+    the exact census when the fast path's invariant breaks."""
+
+    @staticmethod
+    def _exact_metric(sim, st):
+        from sidecar_tpu.models.compressed import _census
+        truth, hits, n_alive = _census(st, sim.p)
+        behind = np.maximum(np.asarray(n_alive - hits), 0)
+        denom = max(float(n_alive) * float(sim.p.m), 1.0)
+        return 1.0 - behind.astype(np.float64).sum() / denom
+
+    def test_fast_equals_exact_mid_flight(self):
+        p = CompressedParams(n=128, services_per_node=4, cache_lines=64)
+        sim = CompressedSim(p, topology.complete(128), PINNED)
+        st = mint_random(sim, sim.init_state(), 60, 10, seed=3)
+        for rounds in (0, 7, 23, 60):
+            run = sim.run_fast(st, jax.random.PRNGKey(4), rounds) \
+                if rounds else st
+            got = float(sim.convergence(run))
+            want = self._exact_metric(sim, run)
+            np.testing.assert_allclose(got, want, rtol=0, atol=1e-6,
+                                       err_msg=f"rounds={rounds}")
+
+    def test_fast_equals_exact_under_eviction_pressure(self):
+        # Working set ≫ cache lines: evictions, recovery re-offers, and
+        # partially-spread records all in flight at once.
+        p = CompressedParams(n=64, services_per_node=8, cache_lines=16)
+        sim = CompressedSim(p, topology.complete(64), PINNED)
+        st = mint_random(sim, sim.init_state(), 200, 10, seed=5)
+        st = sim.run_fast(st, jax.random.PRNGKey(6), 40)
+        got = float(sim.convergence(st))
+        want = self._exact_metric(sim, st)
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+
+    def test_dead_node_routes_to_exact_census(self):
+        p = CompressedParams(n=32, services_per_node=4, cache_lines=64)
+        sim = CompressedSim(p, topology.complete(32), PINNED)
+        st = mint_random(sim, sim.init_state(), 20, 10, seed=7)
+        st = sim.run_fast(st, jax.random.PRNGKey(8), 10)
+        dead = st.node_alive.at[3].set(False)
+        st = dataclasses.replace(st, node_alive=dead)
+        got = float(sim.convergence(st))
+        want = self._exact_metric(sim, st)
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+
+    def test_draining_routes_to_exact_census(self):
+        from sidecar_tpu.ops.status import DRAINING
+        p = CompressedParams(n=32, services_per_node=4, cache_lines=64)
+        sim = CompressedSim(p, topology.complete(32), PINNED)
+        st = sim.mint(sim.init_state(), jnp.asarray([9], jnp.int32), 10,
+                      status=DRAINING)
+        st = sim.run_fast(st, jax.random.PRNGKey(9), 15)
+        # A sticky-adjusted DRAINING copy can outrank `own` at the same
+        # tick, so max(floor, own) is no longer the truth — the gate
+        # must route to the exact census, which handles it.
+        got = float(sim.convergence(st))
+        want = self._exact_metric(sim, st)
+        np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
+
+
+class TestTtlOrphanFree:
+    def test_ttl_floor_bump_frees_leaped_copies(self):
+        """A floor entry expiring to TOMBSTONE at ts+1 s leaps over
+        still-circulating copies of a version minted within that second;
+        the sweep must free those orphans even with the periodic deep
+        sweep disabled (the TTL-change-triggered exact free)."""
+        cfg = TimeConfig(refresh_interval_s=10_000.0)
+        p = CompressedParams(n=64, services_per_node=4, cache_lines=64,
+                             deep_sweep_every=0)
+        sim = CompressedSim(p, topology.complete(64), cfg)
+        # Mint at tick 500 (0.5 s): the boot floor (ts=1) expires at
+        # 1 + 80 s → tombstone at ts ≈ 1 s + 1, ABOVE this version.
+        slots = jax.random.choice(jax.random.PRNGKey(11), sim.p.m, (30,),
+                                  replace=False)
+        st = sim.mint(sim.init_state(), slots, 500)
+        # Run past the alive lifespan (80 s = 400 rounds) plus a sweep.
+        st = sim.run_fast(st, jax.random.PRNGKey(12), 420)
+        cs = np.asarray(st.cache_slot)
+        cv = np.asarray(st.cache_val)
+        floor = np.asarray(st.floor)
+        occ = cs >= 0
+        orphan = occ & (cv <= floor[np.maximum(cs, 0)])
+        assert not orphan.any(), (
+            f"{orphan.sum()} cache entries at/below the floor survived "
+            "the TTL-triggered deep free")

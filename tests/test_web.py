@@ -202,13 +202,20 @@ class TestRealServer:
         def reader():
             resp = urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/watch", timeout=10)
-            # read1 returns de-chunked data as it arrives without
-            # blocking for the (never-ending) full body.
-            while True:
-                data = resp.read1(65536)
-                if not data:
-                    return
-                chunks.put(data)
+            # The long-poll stream never ends; the daemon thread outlives
+            # the test and its socket times out during teardown — swallow
+            # that (but NOT urlopen errors: a failing /watch should still
+            # surface) instead of dumping a traceback on interpreter exit.
+            try:
+                # read1 returns de-chunked data as it arrives without
+                # blocking for the (never-ending) full body.
+                while True:
+                    data = resp.read1(65536)
+                    if not data:
+                        return
+                    chunks.put(data)
+            except OSError:
+                return
 
         t = threading.Thread(target=reader, daemon=True)
         t.start()
