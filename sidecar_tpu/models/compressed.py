@@ -59,12 +59,12 @@ Round structure (mirrors models/exact.py):
    changed-service re-broadcast, services_state.go:538) — this is what
    makes convergence immune to cache evictions.  Owner slots are
    row-aligned with the floor (``floor.reshape(N, S)``), so the
-   refresh fold is elementwise; cache inserts are S broadcast-compare
-   passes (one per service column), again scatter-free.
+   refresh fold is elementwise; cache inserts are one broadcast-compare
+   lex reduction over the service axis, again scatter-free.
 3. anti-entropy — every push-pull cadence, a two-way full-cache +
    own-rows exchange with the node ``stride`` positions away.  Caches
    are line-aligned across nodes, so the exchange is ``jnp.roll`` +
-   elementwise merge; own rows ride the same S-pass insert.
+   elementwise merge; own rows ride the same broadcast-compare insert.
 4. floor advance + sweep — per-LINE census (each line's winning
    (slot, version) and its holder count, a column reduction over the
    node axis — O(N·K) elementwise, no scatters); lines where every
@@ -407,27 +407,40 @@ class CompressedSim:
     def _insert_own_offers(self, cache_val, cache_slot, cache_sent,
                            offer_val, slots, lines, reset_on_hold=False):
         """Insert owner offers (``[nl, S]`` values at their global slots
-        / precomputed lines) into the cache via S broadcast-compare
-        passes — one elementwise pass per service column instead of a
-        scatter.  With ``reset_on_hold`` (the OWNER's announce path
-        only), a line that ends up holding the offered slot gets its
-        transmit budget reset even if nothing changed — the recovery
-        re-offer's whole point (services_state.go:538); third parties
-        (the push-pull exchange) reset only on change, like any merge
-        accept.  Returns the cache triple + evictions."""
-        k_idx = jnp.arange(self.p.cache_lines, dtype=jnp.int32)[None, :]
+        / precomputed lines) into the cache — one lex-max reduction over
+        the service axis of a broadcast-compare ``[nl, K, S]`` (XLA
+        fuses the masked reduce; no scatter, no S sequential passes).
+        Candidates are sticky-adjusted against the PRE-insert line and
+        intra-batch ties between two own slots on one line resolve by
+        the same lex order as the line competition, so the result equals
+        applying the offers one at a time.  With ``reset_on_hold`` (the
+        OWNER's announce path only), a line that ends up holding the
+        offered slot gets its transmit budget reset even if nothing
+        changed — the recovery re-offer's whole point
+        (services_state.go:538); third parties (the push-pull exchange)
+        reset only on change, like any merge accept.  Returns the cache
+        triple + evictions."""
+        k_idx = jnp.arange(self.p.cache_lines, dtype=jnp.int32)[None, :, None]
         cv0, cs0 = cache_val, cache_slot
-        for s in range(slots.shape[1]):
-            at_line = k_idx == lines[:, s:s + 1]
-            cand_v = jnp.where(at_line, offer_val[:, s:s + 1], 0)
-            cand_s = jnp.where(cand_v > 0, slots[:, s:s + 1], -1)
-            cand_v = sticky_adjust(cand_v, cv0,
-                                   (cand_s == cs0) & (cand_v > cv0))
-            cache_val, cache_slot = self._lex_max(
-                cache_val, cache_slot, cand_v, cand_s)
-            if reset_on_hold:
-                holds = at_line & (cand_v > 0) & (cache_slot == cand_s)
-                cache_sent = jnp.where(holds, jnp.int8(0), cache_sent)
+        at_line = lines[:, None, :] == k_idx                  # [nl, K, S]
+        cand_v = jnp.where(at_line, offer_val[:, None, :], 0)
+        cand_s = jnp.where(cand_v > 0, slots[:, None, :], -1)
+        cand_v = sticky_adjust(
+            cand_v, cv0[:, :, None],
+            (cand_s == cs0[:, :, None]) & (cand_v > cv0[:, :, None]))
+        best_v = jnp.max(cand_v, axis=2)                      # [nl, K]
+        best_s = jnp.max(jnp.where((cand_v == best_v[:, :, None])
+                                   & (best_v[:, :, None] > 0),
+                                   cand_s, -1), axis=2)
+        cache_val, cache_slot = self._lex_max(cv0, cs0, best_v, best_s)
+        if reset_on_hold:
+            # The line holds an offered slot (not necessarily the batch's
+            # lex-best candidate: a weaker same-slot re-offer of the
+            # line's standing content also counts, exactly as applying
+            # the offers one at a time would).
+            holds = jnp.any((cand_v > 0)
+                            & (cand_s == cache_slot[:, :, None]), axis=2)
+            cache_sent = jnp.where(holds, jnp.int8(0), cache_sent)
         changed = (cache_slot != cs0) | (cache_val != cv0)
         cache_sent = jnp.where(changed, jnp.int8(0), cache_sent)
         ev = jnp.sum(((cache_slot != cs0) & (cs0 >= 0)).astype(jnp.int32))
@@ -438,7 +451,7 @@ class CompressedSim:
         """Owner refresh + recovery — fully elementwise: owner slots are
         row-aligned with the floor (``floor.reshape(N, S)``), so the
         refresh fold needs no scatter, and cache inserts go through the
-        S-pass broadcast compare (``_insert_own_offers``).
+        broadcast-compare lex reduction (``_insert_own_offers``).
 
         Refresh (staggered per record, ops/gossip.refresh_due) mints a
         fresh version of every present, non-tombstone own record.  A
@@ -504,8 +517,8 @@ class CompressedSim:
         Caches are line-aligned across nodes, so the cache half is
         ``jnp.roll`` + elementwise lex-merge (on the sharded twin the
         roll lowers to a collective-permute); own rows (their slot ids
-        and floor rows roll along with them) go through the S-pass
-        insert.  Split scenarios mask the exchange where the two sides
+        and floor rows roll along with them) go through the
+        broadcast-compare insert (``_insert_own_offers``).  Split scenarios mask the exchange where the two sides
         differ (a partition severs TCP push-pull too)."""
         p, t = self.p, self.t
         s = p.services_per_node

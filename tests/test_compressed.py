@@ -379,3 +379,60 @@ class TestTtlOrphanFree:
         assert not orphan.any(), (
             f"{orphan.sum()} cache entries at/below the floor survived "
             "the TTL-triggered deep free")
+
+
+class TestInsertOffersEquivalence:
+    def test_vectorized_insert_equals_sequential(self):
+        """_insert_own_offers (one lex-max reduction over the service
+        axis) must equal applying the offers one at a time — including
+        on adversarial states that cannot arise in-model (cache above
+        own, weaker same-slot re-offers, line collisions)."""
+        from sidecar_tpu.ops.merge import sticky_adjust
+
+        def sequential(sim, cache_val, cache_slot, cache_sent, offer_val,
+                       slots, lines, reset_on_hold):
+            k_idx = jnp.arange(sim.p.cache_lines, dtype=jnp.int32)[None, :]
+            cv0, cs0 = cache_val, cache_slot
+            for s in range(slots.shape[1]):
+                at_line = k_idx == lines[:, s:s + 1]
+                cand_v = jnp.where(at_line, offer_val[:, s:s + 1], 0)
+                cand_s = jnp.where(cand_v > 0, slots[:, s:s + 1], -1)
+                cand_v = sticky_adjust(cand_v, cv0,
+                                       (cand_s == cs0) & (cand_v > cv0))
+                cache_val, cache_slot = sim._lex_max(
+                    cache_val, cache_slot, cand_v, cand_s)
+                if reset_on_hold:
+                    holds = at_line & (cand_v > 0) & (cache_slot == cand_s)
+                    cache_sent = jnp.where(holds, jnp.int8(0), cache_sent)
+            changed = (cache_slot != cs0) | (cache_val != cv0)
+            cache_sent = jnp.where(changed, jnp.int8(0), cache_sent)
+            ev = jnp.sum(((cache_slot != cs0) & (cs0 >= 0)).astype(jnp.int32))
+            return cache_val, cache_slot, cache_sent, ev
+
+        p = CompressedParams(n=64, services_per_node=8, cache_lines=16)
+        sim = CompressedSim(p, topology.complete(64), DEFAULT)
+        rng = np.random.default_rng(0)
+        for trial in range(12):
+            cs = jnp.asarray(rng.integers(-1, p.m,
+                                          size=(p.n, p.cache_lines),
+                                          dtype=np.int32))
+            cv = jnp.where(cs >= 0, jnp.asarray(
+                rng.integers(1, 1 << 20, size=(p.n, p.cache_lines),
+                             dtype=np.int32)), 0)
+            se = jnp.asarray(rng.integers(0, 16,
+                                          size=(p.n, p.cache_lines),
+                                          dtype=np.int8))
+            slots = jnp.asarray(rng.integers(
+                0, p.m, size=(p.n, p.services_per_node), dtype=np.int32))
+            ov = jnp.asarray(rng.integers(
+                0, 1 << 20, size=(p.n, p.services_per_node),
+                dtype=np.int32))
+            lines = hash_line(slots, p.cache_lines)
+            for hold in (False, True):
+                a = sim._insert_own_offers(cv, cs, se, ov, slots, lines,
+                                           hold)
+                b = sequential(sim, cv, cs, se, ov, slots, lines, hold)
+                for x, y, name in zip(a, b, ("val", "slot", "sent", "ev")):
+                    np.testing.assert_array_equal(
+                        np.asarray(x), np.asarray(y),
+                        err_msg=f"trial={trial} hold={hold} {name}")
