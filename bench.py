@@ -20,7 +20,7 @@ fanout 3, budget 15):
   1k updates vs a 5.4 ms copy), and no formulation escapes it —
   1D/sorted/unique-flagged/row-aligned/donated/in-scan variants all
   measure the same (benchmarks/scatter_costs.py re-runs the whole
-  cost model).  ~40 ms/round ≈ 25 rounds/sec sits within ~2× of the
+  cost model).  ~36 ms/round ≈ 28 rounds/sec sits within ~2× of the
   scatter-imposed floor — more speed requires a different state
   representation, not a faster kernel.
 * ``compressed_rounds_per_sec`` — the bounded-memory large-cluster model
@@ -189,10 +189,19 @@ def main() -> None:
         if "BENCH_NORTH_STAR_NODES" not in os.environ:
             ns_n = 4096
 
-    dense_rps = _bench_dense(n, spn, rounds)
-    compressed_rps = _bench_compressed(n, spn, rounds)
-    north_star = _bench_north_star(ns_n, spn, churn_frac=0.001, eps=1e-4,
-                                   conv_every=25, max_rounds=400)
+    # Device-level tracing (SURVEY.md §5): BENCH_TRACE=<dir> wraps the
+    # measured runs in a jax.profiler trace (TensorBoard/xprof format) —
+    # the per-kernel timeline behind the roofline numbers above.
+    import contextlib
+    trace_dir = os.environ.get("BENCH_TRACE")
+    trace = (jax.profiler.trace(trace_dir) if trace_dir
+             else contextlib.nullcontext())
+    with trace:
+        dense_rps = _bench_dense(n, spn, rounds)
+        compressed_rps = _bench_compressed(n, spn, rounds)
+        north_star = _bench_north_star(ns_n, spn, churn_frac=0.001,
+                                       eps=1e-4, conv_every=25,
+                                       max_rounds=400)
 
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.

@@ -210,6 +210,13 @@ class CompressedParams:
             raise ValueError("fold_quorum must be in (0, 1]")
         if self.deep_sweep_every < 0:
             raise ValueError("deep_sweep_every must be >= 0 (0 = never)")
+        # int8 cache_sent counters must hold limit + fanout - 1 (the
+        # unclamped-accounting bound, ops/gossip.record_transmissions).
+        if self.resolved_retransmit_limit() + self.fanout - 1 > 127:
+            raise ValueError(
+                f"retransmit_limit={self.resolved_retransmit_limit()} + "
+                f"fanout={self.fanout} - 1 exceeds the int8 transmit "
+                "counter range (127)")
 
     @property
     def m(self) -> int:

@@ -80,6 +80,16 @@ class SimParams:
                                 # transmissions per record version (memberlist
                                 # TransmitLimited semantics)
 
+    def __post_init__(self):
+        # The int8 transmit counters are unclamped scatter-adds bounded
+        # by limit + fanout - 1 (ops/gossip.record_transmissions) — the
+        # limit must leave that bound representable.
+        if self.resolved_retransmit_limit() + self.fanout - 1 > 127:
+            raise ValueError(
+                f"retransmit_limit={self.resolved_retransmit_limit()} + "
+                f"fanout={self.fanout} - 1 exceeds the int8 transmit "
+                "counter range (127)")
+
     @property
     def m(self) -> int:
         return self.n * self.services_per_node

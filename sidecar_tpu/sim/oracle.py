@@ -117,13 +117,15 @@ class OracleSim:
         svc_idx, msg = np.asarray(svc_idx), np.asarray(msg)
 
         # Transmit accounting (TransmitLimited: fanout sends per offer).
+        # Unclamped, mirroring ops/gossip.record_transmissions: counts
+        # stop growing the round a record crosses the limit (it is never
+        # offered again), so the value is bounded by limit + fanout - 1.
         budget = msg.shape[1]
         for node in range(p.n):
             for b in range(budget):
                 if msg[node, b] > 0:
                     s = int(svc_idx[node, b])
-                    self.sent[node, s] = min(self.sent[node, s] + p.fanout,
-                                             self.limit)
+                    self.sent[node, s] += p.fanout
 
         drop = None
         if p.drop_prob > 0:

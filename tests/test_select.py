@@ -133,3 +133,19 @@ def test_transmit_accounting_saturates_and_rotates():
     assert offered_rounds[1] == {28, 29, 30, 31}
     assert offered_rounds[2] == {24, 25, 26, 27}
     assert offered_rounds[4] == {20, 21, 22, 23}
+
+
+def test_transmit_counts_bounded_without_clamp():
+    """record_transmissions is an unclamped scatter-add; the bound that
+    makes that safe — a record stops being offered the round it crosses
+    the limit, so counts never exceed limit + fanout - 1 — must hold
+    across many rounds of rotation."""
+    known = jnp.asarray(
+        np.arange(1, 65, dtype=np.int32).reshape(1, 64) << 3)
+    sent = jnp.zeros((1, 64), jnp.int8)
+    limit, fanout, budget = 5, 3, 8
+    for _ in range(20):
+        svc, msg = gossip_ops.select_messages(known, sent, budget, limit)
+        sent = gossip_ops.record_transmissions(sent, svc, msg, fanout,
+                                               limit)
+    assert int(np.asarray(sent).max()) <= limit + fanout - 1
