@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import pathlib
 import sys
 import threading
 from typing import Optional
@@ -30,7 +31,7 @@ from sidecar_tpu.discovery.kubernetes import (
 from sidecar_tpu.discovery.namer import DockerLabelNamer, RegexpNamer
 from sidecar_tpu.health import Monitor
 from sidecar_tpu.health.monitor import HEALTH_INTERVAL, WATCH_INTERVAL
-from sidecar_tpu.proxy.envoy import XdsServer
+from sidecar_tpu.proxy.envoy import EnvoyApiV1, XdsServer
 from sidecar_tpu.proxy.haproxy import HAProxy
 from sidecar_tpu.runtime.looper import TimedLooper, run_in_thread
 from sidecar_tpu.web import SidecarApi, serve_http
@@ -107,7 +108,13 @@ class SidecarNode:
         self.api = SidecarApi(
             self.state,
             members_fn=self._members,
-            cluster_name=self.config.sidecar.cluster_name)
+            cluster_name=self.config.sidecar.cluster_name,
+            # The deprecated V1 REST SDS/CDS/LDS rides on the main HTTP
+            # server, like the reference's mux (envoy_api.go:428-438).
+            envoy_v1=EnvoyApiV1(
+                self.state, bind_ip=self.config.envoy.bind_ip,
+                use_hostnames=self.config.envoy.use_hostnames,
+                cluster_name=self.config.sidecar.cluster_name))
         self.haproxy: Optional[HAProxy] = None
         if not self.config.haproxy.disable:
             self.haproxy = HAProxy(
@@ -204,11 +211,20 @@ class SidecarNode:
             args=(self._discovered_listeners, self._looper(5.0)),
             name="track-listeners", daemon=True).start()
 
-        # HTTP API (main.go:387-390).
+        # HTTP API (main.go:387-390).  Asset paths resolve against the
+        # repo root (the sidecar_tpu package's parent) so the node works
+        # from any working directory — cwd-relative paths still win if
+        # they exist (an operator's own ui/ override).
         if serve:
+            repo_root = pathlib.Path(__file__).resolve().parent.parent
+
+            def _asset(rel: str) -> str:
+                return rel if pathlib.Path(rel).is_dir() \
+                    else str(repo_root / rel)
+
             self._http_server = serve_http(
-                self.api, port=http_port, ui_dir="ui/app",
-                static_dir="views/static")
+                self.api, port=http_port, ui_dir=_asset("ui/app"),
+                static_dir=_asset("views/static"))
 
         # Initial HAProxy write (main.go:392-395).
         if self.haproxy is not None:
@@ -219,13 +235,26 @@ class SidecarNode:
                 log.error("Initial HAProxy write failed: %s", exc)
 
         # Envoy xDS (main.go:397-411): gRPC ADS when use_grpc_api, else
-        # the REST xDS poll transport, both on grpc_port.
+        # the REST xDS poll transport, both on grpc_port.  A bind
+        # failure (port taken — e.g. several nodes on one dev host)
+        # must not kill the node: gossip, the catalog, HAProxy, and the
+        # HTTP API are all still useful without a control plane.
         if serve:
-            if self.ads is not None:
-                self.ads.serve(port=int(self.config.envoy.grpc_port))
-            else:
-                self._xds_server = self.xds.serve(
-                    port=int(self.config.envoy.grpc_port))
+            try:
+                if self.ads is not None:
+                    self.ads.serve(port=int(self.config.envoy.grpc_port))
+                else:
+                    self._xds_server = self.xds.serve(
+                        port=int(self.config.envoy.grpc_port))
+            except (OSError, RuntimeError) as exc:
+                # OSError from the REST ThreadingHTTPServer; RuntimeError
+                # from grpc's port-binding validation (ads.py disables
+                # so_reuseport precisely so this surfaces).
+                log.error(
+                    "Envoy xDS server failed to start on port %s: %s — "
+                    "continuing without a control plane "
+                    "(set ENVOY_GRPC_PORT to a free port)",
+                    self.config.envoy.grpc_port, exc)
 
     # The monitor.watch loop body needs the discoverer; wrap it so the
     # looper drives one sync per tick.

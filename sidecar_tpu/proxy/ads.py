@@ -201,11 +201,25 @@ class AdsServer:
         # Each open ADS stream occupies one worker for its lifetime;
         # size the pool well past any realistic same-host Envoy count so
         # an extra client never hangs waiting for a slot.
+        # so_reuseport off: grpc's default lets several servers silently
+        # SHARE a port on Linux — two nodes on one host would each get a
+        # random subset of Envoy streams instead of one of them failing
+        # loudly (the conflict check below).
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=64,
-                                       thread_name_prefix="ads"))
+                                       thread_name_prefix="ads"),
+            options=(("grpc.so_reuseport", 0),))
         self._server.add_generic_rpc_handlers((self._handlers(),))
         bound = self._server.add_insecure_port(f"{bind}:{port}")
+        if bound == 0 and port != 0:
+            # grpc reports a bind conflict by returning port 0 instead
+            # of raising; surface it like any other server would so
+            # callers can degrade deliberately (main.py logs and runs
+            # on without a control plane).
+            self._server.stop(grace=0)
+            self._server = None
+            raise OSError(f"ads: failed to bind {bind}:{port} "
+                          "(address in use?)")
         self._server.start()
         self._poll_thread = threading.Thread(
             target=self._poll_loop, name="ads-poll", daemon=True)

@@ -296,13 +296,18 @@ class EnvoyApiV1:
         except ValueError as exc:
             return 404, {"status": "error",
                          "message": f"Not Found - {exc}"}
-        hosts = []
+        # Snapshot matches under the lock, build entries after: with
+        # use_hostnames the entry builder does DNS lookups, which must
+        # not stall catalog writers (the clusters/listeners walks use
+        # the same copy-then-process pattern).
         with self.state._lock:
-            for _, _, svc in self.state.each_service():
-                if svc.name == wanted and svc.is_alive():
-                    entry = self._service_entry(svc, port)
-                    if entry is not None:
-                        hosts.append(entry)
+            matched = [svc for _, _, svc in self.state.each_service()
+                       if svc.name == wanted and svc.is_alive()]
+        hosts = []
+        for svc in matched:
+            entry = self._service_entry(svc, port)
+            if entry is not None:
+                hosts.append(entry)
         return 200, {"env": self.cluster_name, "hosts": hosts,
                      "service": name}
 

@@ -76,10 +76,15 @@ class SidecarApi:
 
     def __init__(self, state: ServicesState,
                  members_fn: Optional[Callable[[], list[str]]] = None,
-                 cluster_name: str = "") -> None:
+                 cluster_name: str = "",
+                 envoy_v1=None) -> None:
         self.state = state
         self.members_fn = members_fn
         self.cluster_name = cluster_name
+        # The deprecated Envoy V1 REST API (an EnvoyApiV1) rides on the
+        # main HTTP server, like the reference's sidecarhttp mux
+        # (envoy_api.go:428-438 mounted in http.go:64-76).
+        self.envoy_v1 = envoy_v1
 
     # -- route dispatch ----------------------------------------------------
 
@@ -109,6 +114,23 @@ class SidecarApi:
 
         if parts == ["servers"]:
             return self.servers_page()
+
+        # Envoy V1 REST: SDS /v1/registration/{service}, CDS
+        # /v1/clusters[/{x}/{y}], LDS /v1/listeners[/{x}/{y}]
+        # (envoy_api.go:428-438 — the trailing segments of the cluster/
+        # listener routes are Envoy-supplied and unused).
+        if self.envoy_v1 is not None and parts[:1] == ["v1"] \
+                and method == "GET":
+            if len(parts) == 3 and parts[1] == "registration":
+                status, doc = self.envoy_v1.registration(parts[2])
+                return self._json(status, doc)
+            if parts[1] == "clusters" and len(parts) in (2, 4):
+                status, doc = self.envoy_v1.clusters()
+                return self._json(status, doc)
+            if parts[1] == "listeners" and len(parts) in (2, 4):
+                status, doc = self.envoy_v1.listeners()
+                return self._json(status, doc)
+            return self._error(404, "Not Found")
 
         # Observability surface — the go-metrics + net/http/pprof analog
         # (sidecarhttp/http.go:5, main.go:156-166): live hot-path
@@ -247,6 +269,10 @@ class SidecarApi:
                        for name, instances in self.state.by_service().items()}
             return json.dumps(doc).encode()
         return self.state.encode()
+
+    def _json(self, status: int, doc: dict):
+        body = json.dumps(doc, indent=2).encode()
+        return status, "application/json", body, {}
 
     def _error(self, status: int, message: str):
         body = json.dumps({"status": "error", "message": message}).encode()

@@ -243,3 +243,19 @@ class TestAdsStream:
             ports=[S.Port("tcp", 31002, 9292, "10.0.0.3")]))
         pushed = mock.recv()
         assert pushed.version_info != resp.version_info
+
+
+def test_port_conflict_raises_not_shared():
+    """grpc's default so_reuseport would let two ADS servers silently
+    SHARE one port (each getting a random subset of Envoy streams); the
+    server disables it so the second bind fails loudly and the node can
+    degrade deliberately (main.py continues without a control plane)."""
+    state = ServicesState(hostname="h1")
+    first = AdsServer(state, "127.0.0.1", False)
+    port = first.serve(bind="127.0.0.1", port=0)
+    try:
+        second = AdsServer(state, "127.0.0.1", False)
+        with pytest.raises((OSError, RuntimeError)):
+            second.serve(bind="127.0.0.1", port=port)
+    finally:
+        first.shutdown()

@@ -236,3 +236,40 @@ class TestRealServer:
             if b"cache" in found:
                 break
         assert b"cache" in found
+
+
+class TestEnvoyV1Routes:
+    """The deprecated V1 REST SDS/CDS/LDS rides on the main HTTP API
+    (envoy_api.go:428-438 mounted in http.go:64-76)."""
+
+    def make_api(self):
+        from sidecar_tpu.proxy.envoy import EnvoyApiV1
+        state = make_state()
+        return SidecarApi(state, cluster_name="demo",
+                          envoy_v1=EnvoyApiV1(state, cluster_name="demo"))
+
+    def test_registration_route(self):
+        api = self.make_api()
+        status, ctype, body, _ = api.dispatch("GET", "/v1/registration/web:8080")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["service"] == "web:8080" and doc["env"] == "demo"
+
+    def test_clusters_and_listeners_routes(self):
+        api = self.make_api()
+        for path in ("/v1/clusters", "/v1/clusters/c/n",
+                     "/v1/listeners", "/v1/listeners/c/n"):
+            status, _, body, _ = api.dispatch("GET", path)
+            assert status == 200, path
+            key = "clusters" if "clusters" in path else "listeners"
+            assert key in json.loads(body), path
+
+    def test_v1_unknown_route_404s(self):
+        api = self.make_api()
+        status, *_ = api.dispatch("GET", "/v1/bogus")
+        assert status == 404
+
+    def test_v1_absent_when_not_mounted(self):
+        api = SidecarApi(make_state())
+        status, *_ = api.dispatch("GET", "/v1/clusters")
+        assert status == 404
