@@ -37,11 +37,11 @@ def make_config(static_file="fixtures/static.json"):
     )
 
 
-def make_node(name):
+def make_node(name, **transport_kwargs):
     transport = GossipTransport(
         node_name=name, cluster_name="node-test", bind_ip="127.0.0.1",
         bind_port=0, advertise_ip="127.0.0.1",
-        gossip_interval=0.05, push_pull_interval=1.0)
+        gossip_interval=0.05, push_pull_interval=1.0, **transport_kwargs)
     return SidecarNode(config=make_config(), hostname=name,
                        transport=transport)
 
@@ -114,3 +114,44 @@ class TestSingleNode:
         finally:
             a.stop()
             b.stop()
+
+
+class TestNodeDeathExpiry:
+    def test_dead_node_services_get_tombstoned(self):
+        """The reference's headline failure-recovery chain, end-to-end:
+        SWIM probes declare a silently-killed node dead → the membership
+        leave event drives ExpireServer → the victim's services turn
+        TOMBSTONE in the survivor's catalog (services_delegate.go:173-176
+        → services_state.go:150-192)."""
+        from sidecar_tpu import service as S
+
+        swim = dict(probe_interval=0.1, probe_timeout=0.15,
+                    suspect_timeout=0.6, indirect_probes=3)
+        survivor = make_node("expire-a", **swim)
+        victim = make_node("expire-b", **swim)
+        try:
+            survivor.start(serve=False)
+            victim.start(serve=False)
+            victim.transport.join("127.0.0.1",
+                                  survivor.transport.bind_port)
+            assert wait_for(
+                lambda: survivor.state.has_server("expire-b") and
+                len(survivor.state.servers["expire-b"].services) == 2)
+
+            # Kill the victim abruptly (no graceful goodbye): SWIM
+            # probing must detect the death.
+            victim.stop()
+
+            def victim_tombstoned():
+                server = survivor.state.servers.get("expire-b")
+                if server is None or not server.services:
+                    return False
+                return all(svc.status == S.TOMBSTONE
+                           for svc in server.services.values())
+
+            assert wait_for(victim_tombstoned, timeout=20.0), {
+                sid: svc.status for sid, svc in survivor.state.servers
+                .get("expire-b").services.items()}
+        finally:
+            survivor.stop()
+            victim.stop()
