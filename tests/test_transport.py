@@ -341,3 +341,78 @@ class TestLogBridge:
                                 for r in caplog.records), timeout=5)
         finally:
             t.stop()
+
+
+class TestHostileInput:
+    """The native engine parses untrusted network bytes; a garbage storm
+    on both ports must neither crash it nor stop the protocol (every
+    frame parser bounds-checks and the TCP path caps/cluster-gates
+    before sizing any allocation, transport.cc)."""
+
+    def test_garbage_storm_then_converges(self):
+        import os
+        import random
+        import socket
+        import struct
+
+        state_a, ta = make_node("hostile-a")
+        state_b, tb = make_node("hostile-b")
+        la, lb = start_writer(state_a), start_writer(state_b)
+        try:
+            port_a = ta.start(state_a)
+            rnd = random.Random(0)
+            magic = struct.pack(">I", 0x53433032)
+
+            udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            payloads = [
+                b"",                               # empty
+                b"\x00" * 4,                       # short, wrong magic
+                os.urandom(1400),                  # pure noise
+                magic,                             # magic only
+                magic + b"\xff",                   # unknown type
+                magic + b"\x00" + b"\xff",         # str8 len > remaining
+                magic + b"\x00\x04test\x09hostile-x",  # truncated mid-frame
+                magic + b"\x02\x05wrong\x01x\x091.2.3.4:1" + b"\x00" * 6,
+            ]
+            for _ in range(50):
+                for p in payloads:
+                    udp.sendto(p, ("127.0.0.1", port_a))
+                udp.sendto(os.urandom(rnd.randrange(1, 1400)),
+                           ("127.0.0.1", port_a))
+            udp.close()
+
+            # TCP: garbage, a giant length prefix behind a valid-looking
+            # header, and half-open connections that say nothing.
+            def tcp(data=None, linger=0.0):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.settimeout(2.0)
+                try:
+                    s.connect(("127.0.0.1", port_a))
+                    if data:
+                        s.sendall(data)
+                    if linger:
+                        time.sleep(linger)
+                except OSError:
+                    pass
+                finally:
+                    s.close()
+
+            tcp(os.urandom(512))
+            tcp(magic + b"\x00" * 64)
+            # valid magic + empty cluster/node/ip + port/inc + 4 GB len
+            tcp(magic + b"\x00\x00\x00" + b"\x00" * 6 +
+                struct.pack(">I", 0xFFFFFFFF))
+            tcp(None, linger=0.2)  # connect, say nothing, go away
+
+            # The engine is still alive and the protocol still works:
+            # a legitimate peer joins and catalogs converge both ways.
+            tb.start(state_b)
+            add_local(state_a, "aaa111", "web-a")
+            add_local(state_b, "bbb222", "web-b")
+            tb.join("127.0.0.1", port_a)
+            assert wait_for(lambda: state_b.has_server("hostile-a"))
+            assert wait_for(lambda: state_a.has_server("hostile-b"))
+            assert wait_for(lambda: len(ta.members()) == 2)
+        finally:
+            la.quit(); lb.quit()
+            ta.stop(); tb.stop()
