@@ -399,9 +399,11 @@ class TestHostileInput:
 
             tcp(os.urandom(512))
             tcp(magic + b"\x00" * 64)
-            # valid magic + empty cluster/node/ip + port/inc + 4 GB len
-            tcp(magic + b"\x00\x00\x00" + b"\x00" * 6 +
-                struct.pack(">I", 0xFFFFFFFF))
+            # A well-formed frame from the RIGHT cluster declaring a
+            # 4 GB payload: must reach (and trip) the 64 MB allocation
+            # cap, not allocate.
+            tcp(magic + b"\x00" + b"\x04test" + b"\x00\x00"
+                + b"\x00" * 6 + struct.pack(">I", 0xFFFFFFFF))
             tcp(None, linger=0.2)  # connect, say nothing, go away
 
             # The engine is still alive and the protocol still works:
@@ -415,4 +417,5 @@ class TestHostileInput:
             assert wait_for(lambda: len(ta.members()) == 2)
         finally:
             la.quit(); lb.quit()
+            state_a.stop_processing(); state_b.stop_processing()
             ta.stop(); tb.stop()
