@@ -493,16 +493,24 @@ class Transport {
     while (dead_.size() > 4096) dead_.erase(dead_.begin());
   }
 
-  void queue_membership_locked(uint8_t mstate, uint32_t inc,
-                               const std::string& node,
-                               const std::string& ip, uint16_t port) {
+  static std::string membership_payload(uint8_t mstate, uint32_t inc,
+                                        const std::string& node,
+                                        const std::string& ip,
+                                        uint16_t port) {
     std::string pl;
     pl.push_back(static_cast<char>(mstate));
     put_u32(&pl, inc);
     put_str8(&pl, node);
     put_str8(&pl, ip);
     put_u16(&pl, port);
-    mqueue_.push_back({std::move(pl), transmit_limit_locked()});
+    return pl;
+  }
+
+  void queue_membership_locked(uint8_t mstate, uint32_t inc,
+                               const std::string& node,
+                               const std::string& ip, uint16_t port) {
+    mqueue_.push_back({membership_payload(mstate, inc, node, ip, port),
+                       transmit_limit_locked()});
     while (mqueue_.size() > 1024) mqueue_.pop_front();
   }
 
@@ -510,7 +518,8 @@ class Transport {
   // ordering; refutation for claims about ourselves).
   void handle_membership(uint8_t mstate, uint32_t inc,
                          const std::string& node, const std::string& ip,
-                         uint16_t port) {
+                         uint16_t port,
+                         std::vector<UdpSend>* sends = nullptr) {
     std::lock_guard<std::mutex> lk(mu_);
     if (node == name_) {
       // A claim about US.  Suspect/dead with a current-or-newer
@@ -538,7 +547,44 @@ class Transport {
           // only an alive NEWER than the death certificate readmits.
           auto dit = dead_.find(node);
           if (dit != dead_.end()) {
-            if (inc <= dit->second) break;
+            if (inc <= dit->second) {
+              // Send the death certificate straight to the claimed
+              // address instead of dropping silently: a RESTARTED node
+              // rejoins with a fresh low incarnation, and nodes it
+              // contacts directly readmit it (heard_from) and
+              // re-disseminate that low-inc alive — which third parties
+              // holding the certificate would veto forever, and since
+              // the veto blocks the membership entry itself, the vetoing
+              // node never gossips toward the ghost either.  The unicast
+              // carries the death news to the rejoined node itself,
+              // whose self-claim handler above then refutes with inc+1 >
+              // watermark, and the refutation's higher incarnation
+              // readmits it everywhere (memberlist: a rejoining node
+              // learns of its own death from cluster state and refutes).
+              // Bounded: rate-limited to one echo per ghost per second
+              // (the claimed address is attacker-forgeable — without the
+              // limit a packet stuffed with stale alive frames would
+              // reflect a packet per frame at a spoofed victim), and
+              // delivered via the caller's deferred-send list so no
+              // syscall runs under the lock.
+              auto now = Clock::now();
+              auto eit = echo_last_.find(node);
+              if (sends != nullptr &&
+                  (eit == echo_last_.end() ||
+                   now - eit->second >= Millis(1000))) {
+                echo_last_[node] = now;
+                while (echo_last_.size() > 4096)
+                  echo_last_.erase(echo_last_.begin());
+                std::string pl = membership_payload(
+                    kMemberDead, dit->second, node, ip, port);
+                std::string pkt = packet_header(kTypeGossip);
+                pkt.push_back(static_cast<char>(kFrameMembership));
+                put_u16(&pkt, static_cast<uint16_t>(pl.size()));
+                pkt += pl;
+                sends->push_back({ip, port, std::move(pkt)});
+              }
+              break;
+            }
             dead_.erase(dit);
           }
           members_[node] = {node, ip, port, inc, false, Clock::now(), {}};
@@ -723,7 +769,8 @@ class Transport {
                 if (get_str8(fp, fend, &mnode) &&
                     get_str8(fp, fend, &mip) && fp + 2 <= fend) {
                   uint16_t mport = get_u16(fp);
-                  handle_membership(mstate, minc, mnode, mip, mport);
+                  handle_membership(mstate, minc, mnode, mip, mport,
+                                    &sends);
                 }
               }
             }
@@ -1037,6 +1084,7 @@ class Transport {
   std::map<uint32_t, PendingProbe> pending_;
   std::map<uint32_t, Forward> forwards_;
   std::map<std::string, uint32_t> dead_;  // death-cert incarnation marks
+  std::map<std::string, Clock::time_point> echo_last_;  // echo rate limit
   std::map<std::string, uint32_t> test_drops_;
   std::string local_state_;
   std::mt19937 rng_;

@@ -419,3 +419,146 @@ class TestHostileInput:
             la.quit(); lb.quit()
             state_a.stop_processing(); state_b.stop_processing()
             ta.stop(); tb.stop()
+
+
+class TestRejoinAfterDeath:
+    def test_two_restarted_nodes_find_each_other(self):
+        """Two killed nodes restart with FRESH (low) incarnations and
+        each rejoins via the seed only.  Each fresh node has already
+        absorbed the OTHER's circulating death certificate, so both veto
+        the seed's gossiped alive frames about the other — and since the
+        veto blocks the membership entry itself, no direct contact can
+        ever heal it.  The engine must echo vetoed certificates back
+        into circulation so each rejoined node learns of its own death
+        and refutes past the watermark (memberlist's rejoin-refute);
+        without that the two rejoined nodes never see each other."""
+        state_a, ta = make_node("rej-a", **SWIM_KW)
+        state_c, tc = make_node("rej-c", **SWIM_KW)
+        state_d, td = make_node("rej-d", **SWIM_KW)
+        stop = [ta, tc, td]
+        try:
+            port_a = ta.start(state_a)
+            tc.start(state_c)
+            td.start(state_d)
+            tc.join("127.0.0.1", port_a)
+            td.join("127.0.0.1", port_a)
+            assert wait_for(lambda: len(ta.members()) == 3)
+
+            # Kill BOTH abruptly; the seed declares them dead and the
+            # death certificates circulate.
+            tc.stop()
+            td.stop()
+            assert wait_for(lambda: len(ta.members()) == 1, timeout=15.0)
+
+            # Restart both (fresh incarnations), each joining the seed.
+            # Their join push-pulls and the seed's gossip carry the
+            # OTHER's death certificate to each of them first.
+            state_c2, tc2 = make_node("rej-c", **SWIM_KW)
+            state_d2, td2 = make_node("rej-d", **SWIM_KW)
+            stop += [tc2, td2]
+            tc2.start(state_c2)
+            td2.start(state_d2)
+            tc2.join("127.0.0.1", port_a)
+            td2.join("127.0.0.1", port_a)
+
+            assert wait_for(
+                lambda: "rej-d" in tc2.members()
+                and "rej-c" in td2.members(), timeout=20.0), (
+                f"rejoined nodes never found each other: "
+                f"C sees {tc2.members()}, D sees {td2.members()}")
+            assert wait_for(lambda: len(ta.members()) == 3, timeout=10.0)
+        finally:
+            for t in stop:
+                t.stop()
+
+
+class TestDeathCertificateEcho:
+    def test_vetoed_alive_reechoes_certificate(self):
+        """Deterministic wire-level check of the rejoin-heal mechanism:
+        a node that vetoes a stale low-incarnation alive frame (death
+        watermark) must re-circulate the death certificate rather than
+        drop silently — that echo is what carries the death news to a
+        restarted node so it can refute past the watermark (see
+        TestRejoinAfterDeath; the race there depends on gossip transmit
+        budgets, this pins the mechanism itself).
+
+        A fake peer speaking raw frames registers itself with a real
+        engine, plants a death certificate for a ghost node, offers a
+        STALER alive for it, and then must observe the certificate come
+        back in the engine's gossip."""
+        import socket
+        import struct
+
+        state_b, tb = make_node("echo-b", **SWIM_KW)
+        try:
+            port_b = tb.start(state_b)
+
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            sock.bind(("127.0.0.1", 0))
+            sock.settimeout(0.5)
+            my_port = sock.getsockname()[1]
+
+            def str8(b):
+                return bytes([len(b)]) + b
+
+            def header(type_):
+                return (struct.pack(">I", 0x53433032) + bytes([type_])
+                        + str8(b"test") + str8(b"fake-x")
+                        + str8(b"127.0.0.1")
+                        + struct.pack(">HI", my_port, 1))
+
+            def membership_frame(mstate, inc, node, ip=b"10.9.9.9",
+                                 port=9):
+                pl = (bytes([mstate]) + struct.pack(">I", inc)
+                      + str8(node) + str8(ip) + struct.pack(">H", port))
+                return bytes([1]) + struct.pack(">H", len(pl)) + pl
+
+            def send(frames=b""):
+                sock.sendto(header(0) + frames, ("127.0.0.1", port_b))
+
+            # Register as a member so the engine gossips back to us.
+            send()
+            assert wait_for(lambda: "fake-x" in tb.members())
+
+            # Plant a death certificate for a ghost, then offer a staler
+            # alive for it AT OUR OWN ADDRESS: the engine must veto (no
+            # new member) AND unicast the certificate to that address.
+            send(membership_frame(2, 5, b"ghost-c"))   # dead, inc 5
+            send(membership_frame(0, 3, b"ghost-c",    # alive, inc 3
+                                  ip=b"127.0.0.1", port=my_port))
+
+            def saw_echo():
+                try:
+                    data, _ = sock.recvfrom(65536)
+                except socket.timeout:
+                    return False
+                # Scan gossip frames for dead(ghost-c, 5).
+                if len(data) < 5 or data[4] != 0:
+                    return False
+                p = 5
+                for _ in range(3):           # skip cluster/name/ip str8s
+                    p += 1 + data[p]
+                p += 6                       # port + inc
+                while p + 3 <= len(data):
+                    kind, flen = data[p], struct.unpack(
+                        ">H", data[p + 1:p + 3])[0]
+                    fp = p + 3
+                    if kind == 1 and flen >= 5:
+                        mstate = data[fp]
+                        minc = struct.unpack(">I", data[fp + 1:fp + 5])[0]
+                        nlen = data[fp + 5]
+                        node = data[fp + 6:fp + 6 + nlen]
+                        if mstate == 2 and node == b"ghost-c" \
+                                and minc == 5:
+                            return True
+                    p = fp + flen
+                return False
+
+            # The echo proves the stale alive was processed; only then
+            # is the absence of ghost-c a meaningful veto check.
+            assert wait_for(saw_echo, timeout=10.0), \
+                "vetoed alive was dropped silently (no certificate echo)"
+            assert "ghost-c" not in tb.members()   # the veto held
+            sock.close()
+        finally:
+            tb.stop()
