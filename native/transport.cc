@@ -561,17 +561,26 @@ class Transport {
               // watermark, and the refutation's higher incarnation
               // readmits it everywhere (memberlist: a rejoining node
               // learns of its own death from cluster state and refutes).
-              // Bounded: rate-limited to one echo per ghost per second
-              // (the claimed address is attacker-forgeable — without the
-              // limit a packet stuffed with stale alive frames would
-              // reflect a packet per frame at a spoofed victim), and
-              // delivered via the caller's deferred-send list so no
-              // syscall runs under the lock.
+              // Bounded two ways (the claimed address is attacker-
+              // forgeable, so echoes are a reflection vector): one echo
+              // per ghost per second, AND a global token budget across
+              // all ghosts — per-ghost limiting alone would still let a
+              // packet stuffed with stale alives for DISTINCT minted
+              // ghost names reflect one unicast per frame.  Legitimate
+              // rejoins involve a handful of ghosts at a time, so the
+              // small global budget never bites in practice.  Delivered
+              // via the caller's deferred-send list so no syscall runs
+              // under the lock.
               auto now = Clock::now();
+              if (now - echo_window_ >= Millis(1000)) {
+                echo_window_ = now;
+                echo_budget_ = 32;
+              }
               auto eit = echo_last_.find(node);
-              if (sends != nullptr &&
+              if (sends != nullptr && echo_budget_ > 0 &&
                   (eit == echo_last_.end() ||
                    now - eit->second >= Millis(1000))) {
+                echo_budget_--;
                 echo_last_[node] = now;
                 while (echo_last_.size() > 4096)
                   echo_last_.erase(echo_last_.begin());
@@ -1084,7 +1093,9 @@ class Transport {
   std::map<uint32_t, PendingProbe> pending_;
   std::map<uint32_t, Forward> forwards_;
   std::map<std::string, uint32_t> dead_;  // death-cert incarnation marks
-  std::map<std::string, Clock::time_point> echo_last_;  // echo rate limit
+  std::map<std::string, Clock::time_point> echo_last_;  // per-ghost limit
+  Clock::time_point echo_window_{};  // global echo token window
+  int echo_budget_ = 32;             // echoes left in the window
   std::map<std::string, uint32_t> test_drops_;
   std::string local_state_;
   std::mt19937 rng_;

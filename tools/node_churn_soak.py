@@ -1,0 +1,131 @@
+"""Live-cluster churn soak: N full SidecarNodes on localhost, random
+abrupt kills and fresh-incarnation rejoins, then a convergence audit.
+
+This is the harness that exposed the permanent-membership-split bug
+fixed by the death-certificate unicast (native/transport.cc): two nodes
+that both restarted could stay invisible to each other forever.  It
+drives the REAL stack — native SWIM engine, catalog, discovery, health,
+broadcast loops — with timing chaos no unit test reproduces, so keep
+running it after membership/engine changes:
+
+    python tools/node_churn_soak.py [seed] [duration_s]
+
+Exit 0 = every alive node agrees on membership, sees every alive peer's
+services ALIVE, and holds no ALIVE records from dead nodes.  Not a
+pytest test on purpose: wall-clock heavy (~80 s) and timing-sensitive.
+"""
+import os
+import pathlib
+import random
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from sidecar_tpu import service as S
+from sidecar_tpu.config import (
+    Config, DockerConfig, EnvoyConfig, HAproxyConfig, K8sAPIConfig,
+    ListenerUrlsConfig, ServicesConfig, SidecarConfig, StaticConfig)
+from sidecar_tpu.main import SidecarNode
+from sidecar_tpu.transport import GossipTransport
+
+SWIM = dict(probe_interval=0.1, probe_timeout=0.15,
+            suspect_timeout=0.6, indirect_probes=3)
+
+
+def make_config():
+    return Config(
+        sidecar=SidecarConfig(discovery=["static"],
+                              advertise_ip="127.0.0.1", seeds=[],
+                              cluster_name="soak"),
+        docker_discovery=DockerConfig(),
+        static_discovery=StaticConfig(
+            config_file=str(pathlib.Path(__file__).resolve().parent.parent
+                            / "fixtures" / "static.json")),
+        k8s_api_discovery=K8sAPIConfig(),
+        services=ServicesConfig(),
+        haproxy=HAproxyConfig(disable=True),
+        envoy=EnvoyConfig(use_grpc_api=False),
+        listeners=ListenerUrlsConfig(),
+    )
+
+
+def make_node(name):
+    t = GossipTransport(node_name=name, cluster_name="soak",
+                        bind_ip="127.0.0.1", bind_port=0,
+                        advertise_ip="127.0.0.1",
+                        gossip_interval=0.05, push_pull_interval=0.5,
+                        **SWIM)
+    n = SidecarNode(config=make_config(), hostname=name, transport=t)
+    n.start(serve=False)
+    return n
+
+
+rnd = random.Random(int(sys.argv[1]) if len(sys.argv) > 1 else 7)
+DURATION = float(sys.argv[2]) if len(sys.argv) > 2 else 50.0
+nodes = {}
+seed_port = None
+for i in range(5):
+    name = f"soak-{i}"
+    n = make_node(name)
+    if seed_port is None:
+        seed_port = n.transport.bind_port
+    else:
+        n.transport.join("127.0.0.1", seed_port)
+    nodes[name] = n
+
+time.sleep(4)
+alive = set(nodes)
+print("members on seed:", sorted(nodes["soak-0"].transport.members()),
+      flush=True)
+
+t_end = time.monotonic() + DURATION
+events = 0
+while time.monotonic() < t_end:
+    time.sleep(rnd.uniform(1.5, 3.5))
+    killable = [n for n in alive if n != "soak-0"]
+    dead = [n for n in nodes if n not in alive]
+    if rnd.random() < 0.5 and len(killable) > 1:
+        victim = rnd.choice(killable)
+        nodes[victim].stop()
+        alive.discard(victim)
+        events += 1
+        print(f"killed {victim}", flush=True)
+    elif dead:
+        name = rnd.choice(dead)
+        nodes[name] = make_node(name)
+        nodes[name].transport.join("127.0.0.1", seed_port)
+        alive.add(name)
+        events += 1
+        print(f"rejoined {name}", flush=True)
+
+print(f"{events} churn events; settling...", flush=True)
+time.sleep(12)
+
+ok = True
+for name in sorted(alive):
+    node = nodes[name]
+    members = set(node.transport.members())
+    if members != alive:
+        print(f"{name}: membership {sorted(members)} != {sorted(alive)}",
+              flush=True)
+        ok = False
+    for other in sorted(nodes):
+        server = node.state.servers.get(other)
+        recs = list(server.services.values()) if server else []
+        live_names = {svc.name for svc in recs if svc.status == S.ALIVE}
+        if other in alive:
+            if live_names != {"static-tcp", "static-web"}:
+                print(f"{name}: {other} ALIVE set wrong: {live_names} "
+                      f"({[(r.name, r.status) for r in recs]})",
+                      flush=True)
+                ok = False
+        else:
+            if live_names:
+                print(f"{name}: dead {other} still ALIVE: {live_names}",
+                      flush=True)
+                ok = False
+print("SOAK", "PASS" if ok else "FAIL", flush=True)
+for name in nodes:
+    nodes[name].stop()
+sys.exit(0 if ok else 1)
