@@ -365,3 +365,107 @@ class TestMultiDiscovery:
         multi.run(looper)
         looper.wait(2)
         assert a.ran and b.ran
+
+
+class TestEngineAPIClientLive:
+    """Drive the real stdlib Engine-API HTTP client against a live fake
+    Docker daemon — listing, label/port parsing, and the chunked
+    /events stream (die ⇒ immediate removal).  The StubDockerClient
+    tests above cover discovery logic; this covers the HTTP client the
+    stub bypasses (docker_discovery.go talks to the same REST API via
+    go-dockerclient)."""
+
+    def test_listing_and_die_event_over_http(self):
+        import json as json_mod
+        import threading
+        import time
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from sidecar_tpu.discovery.docker import DockerDiscovery
+        from sidecar_tpu.discovery.namer import DockerLabelNamer
+        from sidecar_tpu.runtime.looper import TimedLooper
+
+        stop = threading.Event()
+        containers = [{
+            "Id": "c1deadbeef99aabbccdd",
+            "Image": "registry/web:2.0",
+            "Names": ["/web-1"],
+            "Created": int(time.time()),
+            "Labels": {"ServiceName": "web", "ServicePort_8080": "10080"},
+            "Ports": [{"Type": "tcp", "PrivatePort": 8080,
+                       "PublicPort": 32768, "IP": "0.0.0.0"}],
+            "State": "running",
+        }]
+        events_clients = []
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/containers/json"):
+                    body = json_mod.dumps(containers).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/events":
+                    self.send_response(200)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    events_clients.append(self.wfile)
+                    while not stop.is_set():
+                        time.sleep(0.05)
+                else:
+                    body = b"OK"
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        port = srv.server_address[1]
+
+        disco = DockerDiscovery(f"tcp://127.0.0.1:{port}",
+                                DockerLabelNamer("ServiceName"),
+                                "10.0.0.9", hostname="dockerhost")
+        looper = TimedLooper(0.1)
+        threading.Thread(target=disco.run, args=(looper,),
+                         daemon=True).start()
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not disco.services():
+                time.sleep(0.1)
+            svcs = disco.services()
+            assert svcs and svcs[0].name == "web"
+            assert svcs[0].id == "c1deadbeef99"   # 12-char Docker ID
+            assert any(p.service_port == 10080 and p.port == 32768
+                       for p in svcs[0].ports)
+
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not events_clients:
+                time.sleep(0.1)
+            assert events_clients, "client never subscribed to /events"
+            # The die event and the listing must agree (a dead container
+            # disappears from /containers/json too) or the next poll
+            # would legitimately re-add the service.
+            evt = json_mod.dumps({"status": "die",
+                                  "id": containers[0]["Id"]}).encode()
+            del containers[:]
+            for w in events_clients:
+                w.write(hex(len(evt))[2:].encode() + b"\r\n" + evt
+                        + b"\r\n")
+                w.flush()
+
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline and disco.services():
+                time.sleep(0.1)
+            assert not disco.services(), "die event did not remove service"
+        finally:
+            looper.quit()
+            stop.set()
+            srv.shutdown()
+            srv.server_close()
