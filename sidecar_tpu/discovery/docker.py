@@ -128,13 +128,20 @@ class EngineAPIClient(DockerClient):
                 conn.request("GET", "/events")
                 resp = conn.getresponse()
                 while not stop.is_set():
-                    line = resp.fp.readline()
+                    # Read through the HTTPResponse so chunked
+                    # transfer-encoding is decoded — reading resp.fp raw
+                    # would hand chunk-size lines to the JSON parser,
+                    # and an all-hex-digit size ("22") parses as an int
+                    # that would crash the event handler downstream.
+                    line = resp.readline()
                     if not line:
                         break
                     try:
-                        listener.put(json.loads(line))
+                        event = json.loads(line)
                     except json.JSONDecodeError:
                         continue
+                    if isinstance(event, dict):
+                        listener.put(event)
             except OSError as exc:
                 log.debug("Docker event stream ended: %s", exc)
             finally:

@@ -449,16 +449,31 @@ class TestEngineAPIClientLive:
             while time.monotonic() < deadline and not events_clients:
                 time.sleep(0.1)
             assert events_clients, "client never subscribed to /events"
+
+            def send_event(evt):
+                for w in events_clients:
+                    w.write(hex(len(evt))[2:].encode() + b"\r\n" + evt
+                            + b"\r\n")
+                    w.flush()
+
+            # First: an event whose chunk size is all hex DIGITS (0x22 =
+            # 34 bytes, size line "22").  A client reading the raw socket
+            # instead of the de-chunked response would json-parse the
+            # size line as the int 22 and crash the discovery loop.
+            pad = 0x22 - len(json_mod.dumps(
+                {"status": "noop", "id": ""}))
+            noop = json_mod.dumps({"status": "noop",
+                                   "id": "x" * pad}).encode()
+            assert len(noop) == 0x22, len(noop)
+            send_event(noop)
+
             # The die event and the listing must agree (a dead container
             # disappears from /containers/json too) or the next poll
             # would legitimately re-add the service.
             evt = json_mod.dumps({"status": "die",
                                   "id": containers[0]["Id"]}).encode()
             del containers[:]
-            for w in events_clients:
-                w.write(hex(len(evt))[2:].encode() + b"\r\n" + evt
-                        + b"\r\n")
-                w.flush()
+            send_event(evt)
 
             deadline = time.monotonic() + 8
             while time.monotonic() < deadline and disco.services():
@@ -467,5 +482,72 @@ class TestEngineAPIClientLive:
         finally:
             looper.quit()
             stop.set()
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestKubeAPICommandLive:
+    """Drive the real KubeAPIDiscoveryCommand HTTP caller against a live
+    fake K8s API server — bearer-token header and the full parse through
+    K8sAPIDiscoverer (the MockK8sCommand tests above bypass the HTTP
+    layer).  The calls are CLUSTER-scoped exactly like the reference's
+    (kubernetes_support.go:198-202 — the configured namespace is stored
+    but both implementations list /api/v1/services/ unscoped)."""
+
+    def test_bearer_token_and_end_to_end_parse(self, tmp_path):
+        import threading
+        import time
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from sidecar_tpu.discovery.kubernetes import (
+            K8sAPIDiscoverer,
+            KubeAPIDiscoveryCommand,
+        )
+
+        (tmp_path / "token").write_text("sekrit-token\n")
+        seen_auth = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                seen_auth.append((self.path,
+                                  self.headers.get("Authorization")))
+                if self.path == "/api/v1/services/":
+                    body = json.dumps(K8S_SERVICES).encode()
+                elif self.path == "/api/v1/nodes/":
+                    body = json.dumps(K8S_NODES).encode()
+                else:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            cmd = KubeAPIDiscoveryCommand(
+                "127.0.0.1", srv.server_address[1], "default", 5.0,
+                str(tmp_path))
+            disco = K8sAPIDiscoverer(cmd, hostname="node-a")
+            disco.run(FreeLooper(1))
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not disco.services():
+                time.sleep(0.1)
+            services = disco.services()
+            assert len(services) == 1 and services[0].name == "api"
+            assert services[0].ports[0].ip == "10.2.0.1"  # node-a's IP
+            # The serviceaccount token rode along as a bearer header on
+            # every call (kubernetes_support.go:148-151).
+            assert seen_auth and all(
+                a == "Bearer sekrit-token" for _, a in seen_auth)
+            assert {p for p, _ in seen_auth} == {"/api/v1/services/",
+                                                 "/api/v1/nodes/"}
+        finally:
             srv.shutdown()
             srv.server_close()
