@@ -2,3 +2,8 @@
 
 from sidecar_tpu.models.timecfg import TimeConfig  # noqa: F401
 from sidecar_tpu.models.exact import ExactSim, SimParams, SimState  # noqa: F401
+from sidecar_tpu.models.compressed import (  # noqa: F401
+    CompressedParams,
+    CompressedSim,
+    CompressedState,
+)

@@ -381,7 +381,10 @@ class TestEngineAPIClientLive:
         import time
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-        from sidecar_tpu.discovery.docker import DockerDiscovery
+        from sidecar_tpu.discovery.docker import (
+            DockerDiscovery,
+            EngineAPIClient,
+        )
         from sidecar_tpu.discovery.namer import DockerLabelNamer
         from sidecar_tpu.runtime.looper import TimedLooper
 
@@ -451,6 +454,10 @@ class TestEngineAPIClientLive:
             assert events_clients, "client never subscribed to /events"
 
             def send_event(evt):
+                # Real Docker streams newline-delimited JSON; the \n is
+                # part of the chunk payload (it is what readline() on
+                # the de-chunked response returns on).
+                evt += b"\n"
                 for w in events_clients:
                     w.write(hex(len(evt))[2:].encode() + b"\r\n" + evt
                             + b"\r\n")
@@ -460,11 +467,25 @@ class TestEngineAPIClientLive:
             # 34 bytes, size line "22").  A client reading the raw socket
             # instead of the de-chunked response would json-parse the
             # size line as the int 22 and crash the discovery loop.
-            pad = 0x22 - len(json_mod.dumps(
+            pad = 0x22 - 1 - len(json_mod.dumps(
                 {"status": "noop", "id": ""}))
             noop = json_mod.dumps({"status": "noop",
                                    "id": "x" * pad}).encode()
-            assert len(noop) == 0x22, len(noop)
+            assert len(noop) + 1 == 0x22, len(noop)
+
+            # Observe stream delivery directly at the client layer too,
+            # so a broken event path can't hide behind the poll loop:
+            # both events must arrive as DECODED DICTS (the 0x22-sized
+            # one would arrive as the int 22 if chunk framing leaked).
+            import queue as queue_mod
+            tap = queue_mod.Queue()
+            tap_client = EngineAPIClient(f"tcp://127.0.0.1:{port}")
+            tap_client.add_event_listener(tap)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline \
+                    and len(events_clients) < 2:
+                time.sleep(0.1)
+            assert len(events_clients) >= 2
             send_event(noop)
 
             # The die event and the listing must agree (a dead container
@@ -474,6 +495,10 @@ class TestEngineAPIClientLive:
                                   "id": containers[0]["Id"]}).encode()
             del containers[:]
             send_event(evt)
+
+            got = [tap.get(timeout=5), tap.get(timeout=5)]
+            assert all(isinstance(e, dict) for e in got), got
+            assert {e.get("status") for e in got} == {"noop", "die"}, got
 
             deadline = time.monotonic() + 8
             while time.monotonic() < deadline and disco.services():
