@@ -190,3 +190,67 @@ class TestServicesBridge:
         disco = FakeDisco(services=[S.Service(id="")])
         mon.discovery_fn = disco.services
         assert mon.services() == []
+
+
+class TestRealCheckers:
+    """The shipped checkers against real targets — live HTTP statuses
+    and real subprocess exits (the Monitor tests above use mock
+    commands; commands.go:19-55 is what these mirror)."""
+
+    def test_http_get_statuses_live(self, monkeypatch):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from sidecar_tpu.health.checks import HttpGetCmd
+
+        # urllib honors proxy env vars; a CI proxy would intercept the
+        # loopback requests and turn every status below into the
+        # proxy's answer.
+        for var in ("http_proxy", "https_proxy", "HTTP_PROXY",
+                    "HTTPS_PROXY"):
+            monkeypatch.delenv(var, raising=False)
+        monkeypatch.setenv("no_proxy", "127.0.0.1")
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                code = int(self.path.strip("/"))
+                self.send_response(code)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        port = srv.server_address[1]
+        try:
+            cmd = HttpGetCmd(timeout=3.0)
+            assert cmd.run(f"http://127.0.0.1:{port}/200")[0] == HEALTHY
+            assert cmd.run(f"http://127.0.0.1:{port}/204")[0] == HEALTHY
+            status, exc = cmd.run(f"http://127.0.0.1:{port}/500")
+            assert status == SICKLY and exc is not None
+            status, exc = cmd.run(f"http://127.0.0.1:{port}/404")
+            assert status == SICKLY
+        finally:
+            srv.shutdown()
+            srv.server_close()
+        # Connection refused (nothing listening) is UNKNOWN, not SICKLY:
+        # the reference treats transport errors as "can't tell"
+        # (commands.go:24-27).
+        status, exc = HttpGetCmd(timeout=1.0).run(
+            f"http://127.0.0.1:{port}/200")
+        assert status == UNKNOWN and exc is not None
+
+    def test_external_cmd_real_subprocess(self):
+        from sidecar_tpu.health.checks import ExternalCmd
+
+        cmd = ExternalCmd(timeout=5.0)
+        assert cmd.run("true")[0] == HEALTHY
+        status, exc = cmd.run("false")
+        assert status == SICKLY and "exit code 1" in str(exc)
+        status, exc = ExternalCmd(timeout=0.3).run("sleep 5")
+        assert status == SICKLY  # timeout
+        status, exc = cmd.run("/no/such/binary-xyz")
+        assert status == SICKLY and exc is not None
+        assert cmd.run("")[0] == UNKNOWN
