@@ -13,6 +13,11 @@ running it after membership/engine changes:
 Exit 0 = every alive node agrees on membership, sees every alive peer's
 services ALIVE, and holds no ALIVE records from dead nodes.  Not a
 pytest test on purpose: wall-clock heavy (~80 s) and timing-sensitive.
+Note: the audit verdict prints BEFORE teardown; after long/heavy churn
+the graceful stop of every node ever created can take a further minute
+or two (listener drains), so give external timeouts headroom past
+duration_s + ~60 s — a timeout after "SOAK PASS" printed is teardown,
+not a failed soak.
 """
 import os
 import pathlib
