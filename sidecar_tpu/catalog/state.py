@@ -37,6 +37,9 @@ from sidecar_tpu.service import (
     TOMBSTONE,
     TOMBSTONE_LIFESPAN,
     UNKNOWN,
+    _as_int,
+    _as_str,
+    _parse_ts,
     ns_to_rfc3339,
     rfc3339_to_ns,
 )
@@ -70,16 +73,13 @@ class ChangeEvent:
     @classmethod
     def from_json(cls, d: dict) -> "ChangeEvent":
         return cls(service=Service.from_json(d.get("Service") or {}),
-                   previous_status=int(d.get("PreviousStatus", UNKNOWN)),
+                   previous_status=_as_int(d.get("PreviousStatus"),
+                                           UNKNOWN),
                    time=_ts(d.get("Time")))
 
 
-def _ts(v) -> int:
-    if v is None:
-        return 0
-    if isinstance(v, (int, float)):
-        return int(v)
-    return rfc3339_to_ns(v)
+# One wire-timestamp rule for both decoders (service.py owns it).
+_ts = _parse_ts
 
 
 class Listener:
@@ -139,7 +139,7 @@ class Server:
 
     @classmethod
     def from_json(cls, d: dict) -> "Server":
-        server = cls(d.get("Name", ""))
+        server = cls(_as_str(d.get("Name", ""), ""))
         for sid, sd in (d.get("Services") or {}).items():
             server.services[sid] = Service.from_json(sd)
         server.last_updated = _ts(d.get("LastUpdated"))
@@ -601,14 +601,27 @@ class ServicesState:
 
 
 def decode(data: bytes | str) -> ServicesState:
-    """Rebuild a state from its JSON wire form (services_state.go:774-782)."""
-    d = json.loads(data)
-    state = ServicesState(hostname=d.get("Hostname", ""))
-    state.cluster_name = d.get("ClusterName", "") or ""
-    state.last_changed = _ts(d.get("LastChanged"))
-    for hostname, sd in (d.get("Servers") or {}).items():
-        state.servers[hostname] = Server.from_json(sd)
-    return state
+    """Rebuild a state from its JSON wire form (services_state.go:774-782).
+
+    Raises ValueError on ANY malformed payload — push-pull bodies come
+    from (same-cluster but untrusted) peers, and a TypeError or
+    AttributeError leaking from a shape surprise would kill the caller's
+    merge loop, silently ending anti-entropy."""
+    try:
+        d = json.loads(data)
+        if not isinstance(d, dict):
+            raise ValueError("state JSON: not an object")
+        state = ServicesState(
+            hostname=_as_str(d.get("Hostname"), "") or "")
+        state.cluster_name = _as_str(d.get("ClusterName"), "") or ""
+        state.last_changed = _ts(d.get("LastChanged"))
+        for hostname, sd in (d.get("Servers") or {}).items():
+            state.servers[hostname] = Server.from_json(sd)
+        return state
+    except (json.JSONDecodeError, UnicodeDecodeError, TypeError,
+            AttributeError, KeyError, OverflowError) as exc:
+        raise ValueError(
+            f"failed to decode state JSON: {exc}") from exc
 
 
 def decode_stream(stream, callback) -> None:

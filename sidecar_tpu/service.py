@@ -105,9 +105,13 @@ class Port:
 
     @classmethod
     def from_json(cls, d: dict) -> "Port":
-        return cls(type=d.get("Type", "tcp"), port=int(d.get("Port", 0) or 0),
-                   service_port=int(d.get("ServicePort", 0) or 0),
-                   ip=d.get("IP", "") or "")
+        # Typed like the reference's json.Unmarshal into Port: wrong-typed
+        # fields are decode errors, not junk values stored for later
+        # (int() before falsy-normalization, so [] can't launder to 0).
+        return cls(type=_as_str(d.get("Type", "tcp"), "tcp"),
+                   port=_as_int(d.get("Port")),
+                   service_port=_as_int(d.get("ServicePort")),
+                   ip=_as_str(d.get("IP", ""), ""))
 
 
 @dataclasses.dataclass
@@ -194,17 +198,24 @@ class Service:
 
     @classmethod
     def from_json(cls, d: dict) -> "Service":
+        # Typed like the reference's json.Unmarshal into Service: a
+        # wrong-typed field is a decode error (the Go side would reject
+        # it too), never a junk value that detonates later in the merge
+        # or encode hot paths.
         ports = d.get("Ports") or []
+        if not isinstance(ports, list):
+            raise TypeError("Ports: not a list")
         return cls(
-            id=d.get("ID", ""),
-            name=d.get("Name", ""),
-            image=d.get("Image", ""),
+            id=_as_str(d.get("ID", ""), ""),
+            name=_as_str(d.get("Name", ""), ""),
+            image=_as_str(d.get("Image", ""), ""),
             created=_parse_ts(d.get("Created")),
-            hostname=d.get("Hostname", ""),
+            hostname=_as_str(d.get("Hostname", ""), ""),
             ports=[Port.from_json(p) for p in ports],
             updated=_parse_ts(d.get("Updated")),
-            proxy_mode=d.get("ProxyMode", "http") or "http",
-            status=int(d.get("Status", UNKNOWN)),
+            proxy_mode=_as_str(d.get("ProxyMode", "http"), "http")
+            or "http",
+            status=_as_int(d.get("Status"), UNKNOWN),
         )
 
     def copy(self) -> "Service":
@@ -212,23 +223,54 @@ class Service:
                                                 for p in self.ports])
 
 
+def _as_int(v: Any, default: int = 0) -> int:
+    if v is None:
+        return default
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise TypeError(f"expected number, got {type(v).__name__}")
+    return int(v)
+
+
+def _as_str(v: Any, default: str) -> str:
+    if v is None:
+        return default
+    if not isinstance(v, str):
+        raise TypeError(f"expected string, got {type(v).__name__}")
+    return v
+
+
 def _parse_ts(v: Any) -> int:
     if v is None:
         return 0
-    if isinstance(v, (int, float)):
+    if isinstance(v, (int, float)) and not isinstance(v, bool):
         return int(v)
-    return rfc3339_to_ns(v)
+    if isinstance(v, str):
+        return rfc3339_to_ns(v)
+    raise TypeError(f"timestamp: expected int or RFC3339 string, "
+                    f"got {type(v).__name__}")
 
 
 def decode(data: bytes | str) -> Service:
-    """service/service.go:127-136."""
+    """service/service.go:127-136.
+
+    Raises ValueError on ANY malformed payload: this is a wire boundary
+    fed by untrusted peers, and shape surprises deeper in the walk
+    (a list where a dict belongs, a dict where a string belongs) must
+    not escape as TypeError/AttributeError — callers catch ValueError
+    and a leaked exception kills their receive loop.
+    """
     try:
         d = json.loads(data)
     except (json.JSONDecodeError, UnicodeDecodeError) as exc:
         raise ValueError(f"failed to decode service JSON: {exc}") from exc
     if not isinstance(d, dict):
         raise ValueError("failed to decode service JSON: not an object")
-    return Service.from_json(d)
+    try:
+        return Service.from_json(d)
+    except (TypeError, AttributeError, KeyError, OverflowError) as exc:
+        raise ValueError(
+            f"failed to decode service JSON: malformed shape ({exc})"
+        ) from exc
 
 
 def to_service(container: dict, ip: str, hostname: Optional[str] = None,

@@ -338,3 +338,38 @@ class TestMergeAndViews:
         assert state.is_new_service(changed)
         tomb = make_svc(status=S.TOMBSTONE)
         assert not state.is_new_service(tomb)
+
+
+class TestDecodeHostilePayloads:
+    """Both wire decoders must reject ANY malformed payload with
+    ValueError: they are fed by untrusted peers, and a TypeError or
+    AttributeError leaking from a shape surprise would kill the
+    caller's receive/merge loop (anti-entropy silently ends)."""
+
+    CATALOG_PAYLOADS = [
+        b"123", b'"str"', b"[]", b"null",
+        b'{"Servers": 5}',
+        b'{"Servers": {"h": 5}}',
+        b'{"Servers": {"h": {"Services": [1, 2]}}}',
+        b'{"Servers": {"h": {"Services": {"x": 7}}}}',
+        b'{"LastChanged": {}}',
+        b'{"Hostname": []}',
+        b"\xff\xfe garbage",
+    ]
+
+    SERVICE_PAYLOADS = [
+        b"123", b"[]", b'{"Ports": 5}', b'{"Ports": [5]}',
+        b'{"Ports": [{"Port": []}]}', b'{"Updated": []}',
+        b'{"Created": {}}', b'{"Status": "alive-ish"}',
+    ]
+
+    def test_catalog_decode_rejects_with_valueerror(self):
+        from sidecar_tpu.catalog import decode
+        for payload in self.CATALOG_PAYLOADS:
+            with pytest.raises(ValueError):
+                decode(payload)
+
+    def test_service_decode_rejects_with_valueerror(self):
+        for payload in self.SERVICE_PAYLOADS:
+            with pytest.raises(ValueError):
+                S.decode(payload)
