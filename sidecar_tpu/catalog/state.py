@@ -635,7 +635,13 @@ def decode_stream(stream, callback) -> None:
             doc = json.loads(line)
             mapping = {name: [Service.from_json(s) for s in svcs]
                        for name, svcs in doc.items()}
-            callback(mapping, None)
-        except (json.JSONDecodeError, AttributeError, TypeError) as exc:
+        except (json.JSONDecodeError, AttributeError, TypeError,
+                ValueError, KeyError, OverflowError) as exc:
+            # Same wire-boundary rule as decode(): any malformed document
+            # becomes the callback's error, never an exception that
+            # kills the reader of a long-lived /watch stream.  Only the
+            # parse sits inside the try — a consumer callback's own
+            # exceptions must propagate, not masquerade as wire errors.
             callback(None, exc)
             return
+        callback(mapping, None)

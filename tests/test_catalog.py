@@ -373,3 +373,34 @@ class TestDecodeHostilePayloads:
         for payload in self.SERVICE_PAYLOADS:
             with pytest.raises(ValueError):
                 S.decode(payload)
+
+
+def test_decode_stream_reports_malformed_documents_via_callback():
+    """decode_stream feeds long-lived /watch readers; any malformed
+    document must surface through the callback's error slot, never as
+    an exception that kills the stream reader."""
+    from sidecar_tpu.catalog import decode_stream
+
+    for bad in (b'{"web": [{"Updated": "not-a-timestamp"}]}\n',
+                b'{"web": [{"Ports": [5]}]}\n',
+                b'{"web": 5}\n', b'[1,2]\n'):
+        got = []
+        decode_stream([bad], lambda m, e: got.append((m, e)))
+        assert got and got[0][0] is None and got[0][1] is not None, bad
+
+
+def test_decode_stream_propagates_callback_exceptions():
+    """A consumer callback's own exception on a VALID document must
+    propagate to the stream reader (a consumer bug), not be misreported
+    as a wire error and re-invoke the callback."""
+    from sidecar_tpu.catalog import decode_stream
+
+    calls = []
+
+    def bad_consumer(mapping, err):
+        calls.append((mapping, err))
+        raise KeyError("consumer bug")
+
+    with pytest.raises(KeyError):
+        decode_stream([b'{"web": []}\n'], bad_consumer)
+    assert len(calls) == 1 and calls[0][1] is None
