@@ -204,15 +204,18 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
             if self.path.split("?")[0] != "/simulate":
                 self._reply(404, {"message": "not found"})
                 return
-            length = int(self.headers.get("Content-Length") or 0)
             try:
+                length = int(self.headers.get("Content-Length") or 0)
                 req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError("request body: not an object")
                 report = bridge.simulate(
                     rounds=int(req.get("rounds", 50)),
                     seed=int(req.get("seed", 0)),
                     cold_nodes=req.get("cold_nodes"),
                     eps=float(req.get("eps", 0.01)))
-            except (ValueError, KeyError, json.JSONDecodeError) as exc:
+            except (ValueError, KeyError, TypeError,
+                    json.JSONDecodeError) as exc:
                 self._reply(400, {"message": str(exc)})
                 return
             self._reply(200, report.to_json())

@@ -84,8 +84,16 @@ class Receiver:
         keep the newest state by LastChanged, filter via should_notify +
         subscriptions, then enqueue a batched reload."""
         evt = json.loads(payload)
-        state = decode(json.dumps(evt.get("State") or {}))
-        change = ChangeEvent.from_json(evt.get("ChangeEvent") or {})
+        if not isinstance(evt, dict):
+            raise ValueError("StateChangedEvent: not an object")
+        state_doc = evt.get("State") or {}
+        change_doc = evt.get("ChangeEvent") or {}
+        if not isinstance(state_doc, dict) \
+                or not isinstance(change_doc, dict):
+            raise ValueError("StateChangedEvent: State/ChangeEvent "
+                             "not objects")
+        state = decode(json.dumps(state_doc))
+        change = ChangeEvent.from_json(change_doc)
 
         with self.state_lock:
             if self.current_state is not None and \
@@ -177,6 +185,10 @@ def update_handler(rcvr: Receiver, payload: bytes):
     returns (status, body_bytes) like receiver/http.go:17-63."""
     try:
         rcvr.handle_update(payload)
-    except (json.JSONDecodeError, KeyError, TypeError, ValueError) as exc:
+    except (json.JSONDecodeError, AttributeError, KeyError, TypeError,
+            ValueError) as exc:
+        # AttributeError included: nested shape surprises (.get on a
+        # non-dict inside ChangeEvent/Service) are wire errors here,
+        # same boundary rule as catalog/service decode().
         return 500, json.dumps({"errors": [str(exc)]}).encode()
     return 200, b"{}"

@@ -238,3 +238,18 @@ class TestReceiver:
             assert len(seen) == 1
         finally:
             srv.shutdown()
+
+
+def test_update_handler_rejects_non_object_payloads():
+    """POSTed bodies are untrusted; non-object JSON at any level must
+    produce a clean 500 from the wrapper, not an uncaught
+    AttributeError that kills the consumer's HTTP handler."""
+    from sidecar_tpu.receiver.receiver import Receiver, update_handler
+
+    rcvr = Receiver()
+    for payload in (b"[1, 2]", b'"str"', b"5",
+                    b'{"State": 5}', b'{"ChangeEvent": [1]}',
+                    b'{"ChangeEvent": {"Service": 5}}',
+                    b'{"ChangeEvent": {"Service": {"Ports": [5]}}}'):
+        status, _doc = update_handler(rcvr, payload)
+        assert status == 500, payload
