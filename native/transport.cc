@@ -582,8 +582,28 @@ class Transport {
                    now - eit->second >= Millis(1000))) {
                 echo_budget_--;
                 echo_last_[node] = now;
-                while (echo_last_.size() > 4096)
-                  echo_last_.erase(echo_last_.begin());
+                if (echo_last_.size() > 4096) {
+                  // Evict by AGE, not map order: entries older than the
+                  // 1 s per-ghost window no longer constrain anything,
+                  // and erasing begin() (the lexicographically-smallest
+                  // name) would let a flood of minted ghost names push
+                  // out a legitimate ghost's limiter state so it could
+                  // echo more than once per second.
+                  for (auto it2 = echo_last_.begin();
+                       it2 != echo_last_.end();) {
+                    if (now - it2->second >= Millis(1000))
+                      it2 = echo_last_.erase(it2);
+                    else
+                      ++it2;
+                  }
+                  while (echo_last_.size() > 4096) {
+                    auto oldest = echo_last_.begin();
+                    for (auto it2 = echo_last_.begin();
+                         it2 != echo_last_.end(); ++it2)
+                      if (it2->second < oldest->second) oldest = it2;
+                    echo_last_.erase(oldest);
+                  }
+                }
                 std::string pl = membership_payload(
                     kMemberDead, dit->second, node, ip, port);
                 std::string pkt = packet_header(kTypeGossip);

@@ -678,8 +678,17 @@ class CompressedSim:
         floor = apply_stickiness(state.floor, floor)
 
         # Free folded lines elementwise: every copy of a just-folded
-        # winner is at its line position at ≤ the folded version.
-        below = (state.cache_slot == ws[None, :]) & caught_up[None, :] & \
+        # winner is at its line position at ≤ the folded version.  A
+        # winner already at-or-below the floor frees the same way —
+        # without it, a below-floor copy delivered in flight just before
+        # a fold (the pull/push-pull merges don't floor-filter
+        # candidates) could re-occupy an empty line permanently when the
+        # deep sweep is off (deep_sweep_every=0).  A colliding
+        # below-floor loser behind such a winner surfaces as the line's
+        # winner at the next census and frees then.
+        stale_win = (ws >= 0) & ~above         # winner at/below the floor
+        below = (state.cache_slot == ws[None, :]) & \
+            (caught_up | stale_win)[None, :] & \
             (state.cache_val <= wv[None, :])
 
         cache_slot = jnp.where(below, -1, state.cache_slot)

@@ -47,11 +47,25 @@ PUSH_ORDER = (TYPE_CLUSTER, TYPE_ENDPOINT, TYPE_LISTENER)
 
 
 class Snapshot:
-    """One immutable versioned resource set (server.go:54-59)."""
+    """One immutable versioned resource set (server.go:54-59).
+
+    ``by_type`` maps type_url → list of ``(name, Any)`` pairs so the
+    stream can scope a response to a request's ``resource_names``
+    (go-control-plane's sotw responder filters by name — the semantics
+    behind envoy/server.go:61-124)."""
 
     def __init__(self, version: str, by_type: dict[str, list]):
         self.version = version
         self.by_type = by_type
+
+    def resources(self, type_url: str, names) -> list:
+        """The Any payloads for one response: everything for a wildcard
+        subscription (empty ``names``), else only the requested names —
+        in sotw, names the snapshot doesn't have are simply omitted."""
+        pairs = self.by_type.get(type_url, [])
+        if not names:
+            return [res for _, res in pairs]
+        return [res for name, res in pairs if name in names]
 
 
 class AdsServer:
@@ -80,11 +94,12 @@ class AdsServer:
         res = resources_from_state(self.state, self.bind_ip,
                                    self.use_hostnames, eds_mode="ads")
         by_type = {
-            TYPE_CLUSTER: [xds_proto.cluster_to_any(c)
+            TYPE_CLUSTER: [(c["name"], xds_proto.cluster_to_any(c))
                            for c in res.clusters],
-            TYPE_ENDPOINT: [xds_proto.endpoint_to_any(e)
+            TYPE_ENDPOINT: [(e["cluster_name"],
+                             xds_proto.endpoint_to_any(e))
                             for e in res.endpoints],
-            TYPE_LISTENER: [xds_proto.listener_to_any(li)
+            TYPE_LISTENER: [(li["name"], xds_proto.listener_to_any(li))
                             for li in res.listeners],
         }
         with self._cond:
@@ -126,12 +141,18 @@ class AdsServer:
                          name="ads-stream-reader").start()
 
         nonce_counter = 0
-        # type_url → {"sent_version", "nonce"} — the whole SotW
+        # type_url → {"sent_version", "nonce", "names"} — the whole SotW
         # per-stream state.  A NACKed version needs no extra flag: the
         # push loop only re-sends when sent_version differs from the
         # current snapshot, and a NACK leaves sent_version at the
         # rejected (= current) one, so nothing re-fires until a NEW
-        # snapshot exists — exactly the protocol's intent.
+        # snapshot exists — exactly the protocol's intent.  ``names`` is
+        # the type's current resource_names subscription (empty =
+        # wildcard): responses are scoped to it (Envoy subscribes to EDS
+        # per cluster name; go-control-plane's sotw server honors
+        # DiscoveryRequest.ResourceNames, the layer behind
+        # envoy/server.go:61-124), and a request that changes it gets an
+        # immediate re-response even at an ACKed version.
         subs: dict[str, dict] = {}
 
         def respond(snap: Snapshot, type_url: str):
@@ -141,7 +162,8 @@ class AdsServer:
             resp = xds_proto.pb().DiscoveryResponse(
                 version_info=snap.version, type_url=type_url,
                 nonce=nonce)
-            resp.resources.extend(snap.by_type.get(type_url, []))
+            resp.resources.extend(
+                snap.resources(type_url, subs[type_url]["names"]))
             subs[type_url].update(sent_version=snap.version, nonce=nonce)
             return resp
 
@@ -165,7 +187,9 @@ class AdsServer:
                 log.warning("ads: request with empty type_url ignored")
                 continue
             sub = subs.setdefault(
-                type_url, {"sent_version": None, "nonce": None})
+                type_url, {"sent_version": None, "nonce": None,
+                           "names": frozenset()})
+            names = frozenset(req.resource_names)
 
             if req.response_nonce and req.response_nonce != sub["nonce"]:
                 # Stale nonce: response to a superseded push — ignore
@@ -173,14 +197,23 @@ class AdsServer:
                 continue
             if req.response_nonce and req.HasField("error_detail"):
                 # NACK: the client rejected sent_version; the push loop
-                # stays quiet until a NEW snapshot version exists.
+                # stays quiet until a NEW snapshot version exists.  A
+                # NACK can still legally carry a changed subscription.
                 log.warning("ads: NACK for %s version %s: %s", type_url,
                             req.version_info, req.error_detail.message)
+                sub["names"] = names
                 continue
             if req.response_nonce:
-                continue  # ACK of sent_version — nothing more to do.
+                # ACK of sent_version.  If the subscription set changed
+                # (e.g. Envoy adds an EDS cluster name), answer it at
+                # the current version with the re-scoped resource set.
+                if names != sub["names"]:
+                    sub["names"] = names
+                    yield respond(self.snapshot(), type_url)
+                continue
 
             # Initial subscription request for this type.
+            sub["names"] = names
             yield respond(self.snapshot(), type_url)
 
     # -- serving ------------------------------------------------------------

@@ -197,7 +197,8 @@ def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
     ports_map: dict[int, str] = {}
 
     with state._lock:
-        walk = list(state.each_service_sorted())
+        walk = [(c, h, svc.copy())
+                for c, h, svc in state.each_service_sorted()]
     for _, _, svc in walk:
         if not svc.is_alive():
             continue
@@ -299,9 +300,12 @@ class EnvoyApiV1:
         # Snapshot matches under the lock, build entries after: with
         # use_hostnames the entry builder does DNS lookups, which must
         # not stall catalog writers (the clusters/listeners walks use
-        # the same copy-then-process pattern).
+        # the same copy-then-process pattern).  Copies, not references:
+        # catalog writers mutate Service in place (catalog/state.py
+        # AddServiceEntry sets status/updated), so a live reference read
+        # after lock release could serve a half-updated record.
         with self.state._lock:
-            matched = [svc for _, _, svc in self.state.each_service()
+            matched = [svc.copy() for _, _, svc in self.state.each_service()
                        if svc.name == wanted and svc.is_alive()]
         hosts = []
         for svc in matched:
@@ -316,7 +320,8 @@ class EnvoyApiV1:
         out = []
         seen: dict[int, str] = {}
         with self.state._lock:
-            walk = list(self.state.each_service_sorted())
+            walk = [(c, h, svc.copy())
+                    for c, h, svc in self.state.each_service_sorted()]
         for _, _, svc in walk:
             if not svc.is_alive():
                 continue
@@ -342,7 +347,8 @@ class EnvoyApiV1:
         out = []
         seen: dict[int, str] = {}
         with self.state._lock:
-            walk = list(self.state.each_service_sorted())
+            walk = [(c, h, svc.copy())
+                    for c, h, svc in self.state.each_service_sorted()]
         for _, _, svc in walk:
             if not svc.is_alive():
                 continue

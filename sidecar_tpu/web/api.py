@@ -13,6 +13,7 @@ Routes (http.go:64-76, http_api.go:35-45):
   POST /api/services/{id}/drain     set local instance DRAINING
   GET  /api/watch (+ /watch)        long-poll state stream
   GET  /servers                     human-readable state
+  GET  /api/debug/profile           live sampling CPU profile (pprof analog)
   OPTIONS                            CORS headers
 Deprecated aliases /services.json and /state.json are also served.
 """
@@ -78,9 +79,12 @@ class SidecarApi:
                  members_fn: Optional[Callable[[], list[str]]] = None,
                  cluster_name: str = "",
                  envoy_v1=None) -> None:
+        import threading
+
         self.state = state
         self.members_fn = members_fn
         self.cluster_name = cluster_name
+        self._profile_gate = threading.Semaphore(1)
         # The deprecated Envoy V1 REST API (an EnvoyApiV1) rides on the
         # main HTTP server, like the reference's sidecarhttp mux
         # (envoy_api.go:428-438 mounted in http.go:64-76).
@@ -139,6 +143,8 @@ class SidecarApi:
             return self.metrics_dump()
         if parts == ["debug", "stacks"]:
             return self.debug_stacks()
+        if parts == ["debug", "profile"]:
+            return self.debug_profile(query)
 
         if len(parts) == 1 and parts[0].startswith("services."):
             return self.services(parts[0].rsplit(".", 1)[1])
@@ -261,6 +267,76 @@ class SidecarApi:
                        for line in traceback.format_stack(frame))
         body = "\n".join(out).encode()
         return 200, "text/plain", body, CORS_HEADERS
+
+    def debug_profile(self, query: dict):
+        """On-demand CPU profile of the LIVE node —
+        ``/api/debug/profile?seconds=N`` (the net/http/pprof CPU-profile
+        analog, sidecarhttp/http.go:5; offline profiling stays behind
+        ``--cpuprofile``).
+
+        Like pprof's, this is a SAMPLING profile: every thread's stack
+        is captured at ~100 Hz for N seconds and aggregated into
+        flamegraph-collapsed lines (``frame;frame;frame count``) plus a
+        self-time leaderboard.  cProfile is deliberately not used here —
+        its tracer only hooks threads started after enabling, so it
+        cannot see a running node's loops, and its per-call overhead
+        would distort the hot paths it's meant to measure."""
+        import math
+        import sys
+        import threading
+        import time as time_mod
+
+        try:
+            seconds = float(query.get("seconds", ["5"])[0])
+        except ValueError:
+            return self._error(400, "seconds must be a number")
+        if not math.isfinite(seconds):
+            return self._error(400, "seconds must be finite")
+        seconds = min(max(seconds, 0.1), 60.0)
+        interval = 0.01                       # 100 Hz, pprof's default
+        # One profile at a time, like net/http/pprof: concurrent
+        # samplers would multiply CPU burn and record each other.
+        if not self._profile_gate.acquire(blocking=False):
+            return self._error(409, "a CPU profile is already running")
+        me = threading.get_ident()
+
+        stacks: dict[tuple, int] = {}
+        self_time: dict[str, int] = {}
+        samples = 0
+        try:
+            deadline = time_mod.monotonic() + seconds
+            while time_mod.monotonic() < deadline:
+                for ident, frame in sys._current_frames().items():
+                    if ident == me:
+                        continue              # the sampler itself
+                    stack = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        stack.append(
+                            f"{code.co_name} "
+                            f"({code.co_filename.rsplit('/', 1)[-1]}"
+                            f":{f.f_lineno})")
+                        f = f.f_back
+                    stack.reverse()
+                    stacks[tuple(stack)] = stacks.get(tuple(stack), 0) + 1
+                    self_time[stack[-1]] = self_time.get(stack[-1], 0) + 1
+                samples += 1
+                time_mod.sleep(interval)
+        finally:
+            self._profile_gate.release()
+
+        top = sorted(self_time.items(), key=lambda kv: -kv[1])[:25]
+        lines = [f"# CPU profile: {samples} sampling passes over "
+                 f"{seconds:g}s at ~{1 / interval:.0f} Hz "
+                 f"(all threads; counts are samples observed)",
+                 "", "# -- self time (leaf frame) --"]
+        lines += [f"{count:8d}  {frame}" for frame, count in top]
+        lines += ["", "# -- collapsed stacks (flamegraph format) --"]
+        lines += [f"{';'.join(stack)} {count}"
+                  for stack, count in
+                  sorted(stacks.items(), key=lambda kv: -kv[1])]
+        return 200, "text/plain", "\n".join(lines).encode(), CORS_HEADERS
 
     def watch_snapshot(self, by_service: bool) -> bytes:
         if by_service:

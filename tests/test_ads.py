@@ -76,11 +76,12 @@ class EnvoyMock:
                 return
             yield req
 
-    def send(self, type_url, version="", nonce="", error=None):
+    def send(self, type_url, version="", nonce="", error=None, names=()):
         req = self.x.DiscoveryRequest(
             version_info=version, type_url=type_url, response_nonce=nonce)
         req.node.id = "envoy-mock"
         req.node.cluster = "cluster-0"
+        req.resource_names.extend(names)
         if error is not None:
             req.error_detail.code = 13
             req.error_detail.message = error
@@ -224,6 +225,70 @@ class TestAdsStream:
         t.join(timeout=10)
         assert got, "no push after the state changed"
         assert got[0].version_info != resp.version_info
+
+    def test_eds_scoped_to_resource_names(self, ads):
+        """Envoy subscribes to EDS per cluster name; the sotw responder
+        must scope the response to the requested names
+        (go-control-plane semantics behind envoy/server.go:61-124)."""
+        state, server, mock = ads
+        x = mock.x
+        mock.send(TYPE_ENDPOINT, names=["web:8080"])
+        resp = mock.recv()
+        names = {x.ClusterLoadAssignment.FromString(r.value).cluster_name
+                 for r in resp.resources}
+        assert names == {"web:8080"}
+
+        # ACK with a GROWN subscription (Envoy adds a cluster): the
+        # server answers immediately at the current version with the
+        # re-scoped set.
+        mock.send(TYPE_ENDPOINT, version=resp.version_info,
+                  nonce=resp.nonce, names=["web:8080", "raw-tcp:9000"])
+        resp2 = mock.recv()
+        assert resp2.version_info == resp.version_info
+        names2 = {x.ClusterLoadAssignment.FromString(r.value).cluster_name
+                  for r in resp2.resources}
+        assert names2 == {"web:8080", "raw-tcp:9000"}
+
+        # A plain ACK (same names) triggers nothing until state changes.
+        mock.send(TYPE_ENDPOINT, version=resp2.version_info,
+                  nonce=resp2.nonce, names=["web:8080", "raw-tcp:9000"])
+
+        # Push path honors the subscription: a new service appears, and
+        # the pushed EDS response still contains only subscribed names.
+        state.set_clock(lambda: T0 + NS)
+        state.add_service_entry(S.Service(
+            id="hhh888", name="other", image="o:1", hostname="h3",
+            updated=T0 + NS, status=S.ALIVE, proxy_mode="http",
+            ports=[S.Port("tcp", 31003, 9393, "10.0.0.3")]))
+        pushed = mock.recv()
+        assert pushed.version_info != resp.version_info
+        names3 = {x.ClusterLoadAssignment.FromString(r.value).cluster_name
+                  for r in pushed.resources}
+        assert names3 == {"web:8080", "raw-tcp:9000"}
+
+    def test_eds_unknown_name_omitted_and_nack_keeps_subscription(self, ads):
+        """sotw omits names the snapshot doesn't have, and a NACK that
+        carries a changed subscription still updates it."""
+        state, server, mock = ads
+        x = mock.x
+        mock.send(TYPE_ENDPOINT, names=["web:8080", "ghost:1"])
+        resp = mock.recv()
+        names = {x.ClusterLoadAssignment.FromString(r.value).cluster_name
+                 for r in resp.resources}
+        assert names == {"web:8080"}
+
+        # NACK while narrowing to the ghost only; the next snapshot push
+        # must be scoped to the NACK's subscription (empty resources).
+        mock.send(TYPE_ENDPOINT, version="", nonce=resp.nonce,
+                  error="bad", names=["ghost:1"])
+        state.set_clock(lambda: T0 + NS)
+        state.add_service_entry(S.Service(
+            id="iii999", name="new", image="n:1", hostname="h3",
+            updated=T0 + NS, status=S.ALIVE, proxy_mode="http",
+            ports=[S.Port("tcp", 31004, 9494, "10.0.0.3")]))
+        pushed = mock.recv()
+        assert pushed.version_info != resp.version_info
+        assert len(pushed.resources) == 0
 
     def test_stale_nonce_ignored(self, ads):
         state, server, mock = ads

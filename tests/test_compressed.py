@@ -381,6 +381,36 @@ class TestTtlOrphanFree:
             "the TTL-triggered deep free")
 
 
+class TestBelowFloorWinnerFreed:
+    def test_census_frees_below_floor_line_without_deep_sweep(self):
+        """A below-floor copy that re-occupies an empty line (e.g. an
+        in-flight board published just before a fold) must be freed by
+        the census itself — the ordinary sweep, not just the deep sweep
+        — or with deep_sweep_every=0 and a static floor it would be a
+        permanent cache-line and publish-budget leak (advisor finding,
+        round 3)."""
+        cfg = TimeConfig(refresh_interval_s=10_000.0)
+        p = CompressedParams(n=16, services_per_node=4, cache_lines=32,
+                             deep_sweep_every=0)
+        sim = CompressedSim(p, topology.complete(16), cfg)
+        st = sim.init_state()
+        # Plant a stale copy by hand: slot 5 at the boot-floor version
+        # (== floor, i.e. at-or-below) on node 3's matching line.
+        line = int(hash_line(jnp.asarray(5), p.cache_lines))
+        boot = int(pack(1, ALIVE))
+        st = dataclasses.replace(
+            st,
+            cache_slot=st.cache_slot.at[3, line].set(5),
+            cache_val=st.cache_val.at[3, line].set(boot),
+            cache_sent=st.cache_sent.at[3, line].set(jnp.int8(0)))
+        # One sweep cadence is enough; the floor never moves (no mints,
+        # refresh pinned), so only the census path can free it.
+        st = sim.run_fast(st, jax.random.PRNGKey(0), sim.t.sweep_rounds)
+        assert int(st.cache_slot[3, line]) == -1, (
+            "below-floor winner survived the census free")
+        assert int(st.cache_val[3, line]) == 0
+
+
 class TestInsertOffersEquivalence:
     def test_vectorized_insert_equals_sequential(self):
         """_insert_own_offers (one lex-max reduction over the service

@@ -140,6 +140,62 @@ class TestObservability:
         assert b"test_debug_stacks" in body
         assert b"--- thread MainThread" in body
 
+    def test_debug_profile_samples_running_threads(self):
+        """/api/debug/profile?seconds=N — the live pprof-CPU analog: a
+        thread busy during the window shows up in the collapsed
+        stacks."""
+        import threading
+
+        stop = threading.Event()
+
+        def spin_hot_loop():
+            while not stop.is_set():
+                sum(range(200))
+
+        t = threading.Thread(target=spin_hot_loop, daemon=True)
+        t.start()
+        try:
+            status, ctype, body, _ = make_api().dispatch(
+                "GET", "/api/debug/profile", {"seconds": ["0.3"]})
+            assert status == 200 and ctype == "text/plain"
+            assert b"CPU profile" in body
+            assert b"spin_hot_loop" in body
+            assert b"flamegraph" in body
+        finally:
+            stop.set()
+
+    def test_debug_profile_rejects_bad_seconds(self):
+        api = make_api()
+        for bad in ("soon", "nan", "inf"):
+            status, _, _, _ = api.dispatch(
+                "GET", "/api/debug/profile", {"seconds": [bad]})
+            assert status == 400, bad
+
+    def test_debug_profile_single_flight(self):
+        """Concurrent profiles would sample each other and multiply CPU
+        burn; the second request gets 409 (net/http/pprof behavior)."""
+        import threading
+
+        api = make_api()
+        results = []
+
+        def run_long_profile():
+            results.append(api.dispatch(
+                "GET", "/api/debug/profile", {"seconds": ["0.5"]}))
+
+        t = threading.Thread(target=run_long_profile, daemon=True)
+        t.start()
+        time.sleep(0.15)  # first profile is mid-flight
+        status, _, _, _ = api.dispatch(
+            "GET", "/api/debug/profile", {"seconds": ["0.1"]})
+        assert status == 409
+        t.join(timeout=5)
+        assert results and results[0][0] == 200
+        # The gate releases: a later profile succeeds again.
+        status, _, _, _ = api.dispatch(
+            "GET", "/api/debug/profile", {"seconds": ["0.1"]})
+        assert status == 200
+
 
 class TestUi:
     """The operator surface (L9): /ui serves the static app wired in
