@@ -1,0 +1,241 @@
+"""Measure the LIVE node against the reference's footprint claims.
+
+The reference's README row this answers: a Sidecar node runs in
+**< 20 MB resident** with a "few execution threads"
+(/root/reference/README.md:54-56).  The repo's live half is Python
+orchestrating a C++ gossip engine, so the honest comparison needs both
+the absolute numbers and the breakdown:
+
+* **RSS per node process** — absolute, plus the Python-interpreter
+  baseline (this image's ``sitecustomize`` imports JAX into every
+  interpreter, so a do-nothing ``python -c pass`` process already
+  carries tens of MB that have nothing to do with the node).  The
+  framework's own working set is the delta.
+* **Gossip packets/sec in+out** — from the native engine's counters
+  (``engine.udpIn``/``udpOut``, /api/metrics.json) over a steady-state
+  window at the reference protocol constants (200 ms gossip interval,
+  push-pull on, static discovery announcing real services).
+* **Merge latency** — the ``addServiceEntry`` timer (the reference
+  instruments the same hot path with MeasureSince,
+  services_state.go:294).
+* **Thread count** — /proc Threads (the "few execution threads" row).
+* **Churn phase** — SIGKILL one node, wait for SWIM detection and the
+  tombstone storm (ExpireServer 10×, services_state.go:150-192), and
+  verify the survivors tombstone the dead node's services.
+
+Run: ``python benchmarks/live_node.py [nodes] [spn] [steady_seconds]``
+(defaults 3 nodes x 10 services, 30 s).  Prints one JSON document.
+Wants a quiet host — CPU contention skews the latency numbers.
+"""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+BASE_GOSSIP = 18700   # bind ports BASE..BASE+n-1
+BASE_HTTP = 18760
+
+
+def make_static_fixture(tmpdir: str, spn: int) -> str:
+    """A static.json with ``spn`` services (the per-node service load;
+    shape of fixtures/static.json)."""
+    doc = [{
+        "Service": {
+            "Name": f"bench-svc-{i}",
+            "Image": f"example/bench:{i}",
+            "Ports": [{"Type": "tcp", "Port": 21000 + i,
+                       "ServicePort": 9000 + i}],
+            "ProxyMode": "http",
+        },
+        "Check": {"Type": "AlwaysSuccessful", "Args": ""},
+    } for i in range(spn)]
+    path = os.path.join(tmpdir, "static.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return path
+
+
+def spawn_node(i: int, static_file: str, tmpdir: str) -> subprocess.Popen:
+    env = dict(os.environ,
+               SIDECAR_DISCOVERY="static",
+               STATIC_CONFIG_FILE=static_file,
+               SIDECAR_ADVERTISE_IP="127.0.0.1",
+               HAPROXY_DISABLE="true",
+               ENVOY_USE_GRPC_API="false",
+               SIDECAR_BIND_PORT=str(BASE_GOSSIP + i),
+               SIDECAR_CLUSTER_NAME="bench")
+    if i > 0:
+        env["SIDECAR_SEEDS"] = f"127.0.0.1:{BASE_GOSSIP}"
+    log = open(os.path.join(tmpdir, f"node-{i}.log"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "sidecar_tpu.main",
+         "--http-port", str(BASE_HTTP + i), "--hostname", f"bench-{i}"],
+        cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT)
+
+
+def fetch_json(port: int, path: str):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return json.load(resp)
+
+
+def proc_status(pid: int) -> dict:
+    out = {}
+    with open(f"/proc/{pid}/status") as fh:
+        for line in fh:
+            key, _, val = line.partition(":")
+            out[key] = val.strip()
+    return out
+
+
+def rss_mb(pid: int) -> float:
+    return int(proc_status(pid)["VmRSS"].split()[0]) / 1024.0
+
+
+def interpreter_baseline() -> tuple[float, int]:
+    """(RSS MB, thread count) of a do-nothing interpreter in this
+    environment — whatever sitecustomize drags in (JAX here) charges
+    every Python process before a single line of the framework runs."""
+    probe = subprocess.Popen([sys.executable, "-c",
+                              "import time; time.sleep(30)"])
+    try:
+        time.sleep(3.0)
+        st = proc_status(probe.pid)
+        return rss_mb(probe.pid), int(st["Threads"])
+    finally:
+        probe.kill()
+        probe.wait()
+
+
+def engine_rates(port: int):
+    m = fetch_json(port, "/api/metrics.json")
+    g, t = m["gauges"], m["timers"]
+    entry = t.get("addServiceEntry", {"count": 0, "total_ms": 0.0})
+    return {
+        "udp_in": g.get("engine.udpIn", 0),
+        "udp_out": g.get("engine.udpOut", 0),
+        "udp_bytes_in": g.get("engine.udpBytesIn", 0),
+        "udp_bytes_out": g.get("engine.udpBytesOut", 0),
+        "merge_count": entry["count"],
+        "merge_total_ms": entry["total_ms"],
+    }
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    spn = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    steady = float(sys.argv[3]) if len(sys.argv) > 3 else 30.0
+
+    tmpdir = tempfile.mkdtemp(prefix="live-node-bench-")
+    static_file = make_static_fixture(tmpdir, spn)
+    procs = []
+    try:
+        procs.append(spawn_node(0, static_file, tmpdir))
+        time.sleep(2.5)                     # let the seed bind first
+        for i in range(1, n):
+            procs.append(spawn_node(i, static_file, tmpdir))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                view = fetch_json(BASE_HTTP, "/api/state.json")
+                if len(view["Servers"]) == n:
+                    break
+            except OSError:
+                pass
+            time.sleep(1.0)
+        else:
+            raise SystemExit(
+                f"cluster never converged to {n} members "
+                f"(logs in {tmpdir})")
+
+        # -- steady state at protocol rate --------------------------------
+        t0 = {i: engine_rates(BASE_HTTP + i) for i in range(n)}
+        start = time.monotonic()
+        time.sleep(steady)
+        elapsed = time.monotonic() - start
+        t1 = {i: engine_rates(BASE_HTTP + i) for i in range(n)}
+
+        baseline, baseline_threads = interpreter_baseline()
+        per_node = []
+        for i, proc in enumerate(procs):
+            st = proc_status(proc.pid)
+            d0, d1 = t0[i], t1[i]
+            merges = d1["merge_count"] - d0["merge_count"]
+            merge_ms = d1["merge_total_ms"] - d0["merge_total_ms"]
+            per_node.append({
+                "node": f"bench-{i}",
+                "rss_mb": round(rss_mb(proc.pid), 1),
+                "threads": int(st["Threads"]),
+                "pkts_in_per_s": round(
+                    (d1["udp_in"] - d0["udp_in"]) / elapsed, 1),
+                "pkts_out_per_s": round(
+                    (d1["udp_out"] - d0["udp_out"]) / elapsed, 1),
+                "bytes_out_per_s": round(
+                    (d1["udp_bytes_out"] - d0["udp_bytes_out"]) / elapsed),
+                "merges_per_s": round(merges / elapsed, 1),
+                "merge_mean_ms": round(merge_ms / merges, 3)
+                if merges else None,
+            })
+
+        # -- churn: kill the last node, survivors must tombstone it -------
+        victim = procs[-1]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+        c0 = engine_rates(BASE_HTTP)
+        churn_start = time.monotonic()
+        tombstoned = False
+        while time.monotonic() - churn_start < 30:
+            view = fetch_json(BASE_HTTP, "/api/state.json")
+            dead = view["Servers"].get(f"bench-{n - 1}", {})
+            svcs = dead.get("Services", {})
+            if svcs and all(s["Status"] == 1 for s in svcs.values()):
+                tombstoned = True
+                break
+            time.sleep(0.5)
+        churn_elapsed = time.monotonic() - churn_start
+        c1 = engine_rates(BASE_HTTP)
+        churn_merges = c1["merge_count"] - c0["merge_count"]
+        churn_ms = c1["merge_total_ms"] - c0["merge_total_ms"]
+
+        print(json.dumps({
+            "config": {"nodes": n, "services_per_node": spn,
+                       "steady_seconds": steady,
+                       "gossip_interval_ms": 200},
+            "interpreter_baseline_rss_mb": round(baseline, 1),
+            "interpreter_baseline_threads": baseline_threads,
+            "per_node": per_node,
+            "framework_rss_mb_minus_baseline": [
+                round(p["rss_mb"] - baseline, 1) for p in per_node],
+            "churn": {
+                "victim_tombstoned_on_survivor": tombstoned,
+                "seconds_to_tombstones": round(churn_elapsed, 1),
+                "merges": churn_merges,
+                "merge_mean_ms": round(churn_ms / churn_merges, 3)
+                if churn_merges else None,
+            },
+            "reference_rows": {
+                "rss": "< 20 MB resident (README.md:55-56)",
+                "threads": "a few execution threads (README.md:54-56)",
+            },
+        }, indent=2))
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
+if __name__ == "__main__":
+    main()

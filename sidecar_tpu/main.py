@@ -114,7 +114,13 @@ class SidecarNode:
             envoy_v1=EnvoyApiV1(
                 self.state, bind_ip=self.config.envoy.bind_ip,
                 use_hostnames=self.config.envoy.use_hostnames,
-                cluster_name=self.config.sidecar.cluster_name))
+                cluster_name=self.config.sidecar.cluster_name),
+            # The UI reads the managed HAProxy's stats CSV through the
+            # API (reference UI hits :3212 directly, services.js:21-33);
+            # ";norefresh" stops HAProxy's auto-refresh meta tag.
+            haproxy_stats_url=(
+                None if self.config.haproxy.disable
+                else "http://127.0.0.1:3212/;csv;norefresh"))
         self.haproxy: Optional[HAProxy] = None
         if not self.config.haproxy.disable:
             self.haproxy = HAProxy(

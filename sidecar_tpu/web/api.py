@@ -14,6 +14,7 @@ Routes (http.go:64-76, http_api.go:35-45):
   GET  /api/watch (+ /watch)        long-poll state stream
   GET  /servers                     human-readable state
   GET  /api/debug/profile           live sampling CPU profile (pprof analog)
+  GET  /api/haproxy/stats.csv       relay of the managed HAProxy's stats CSV
   OPTIONS                            CORS headers
 Deprecated aliases /services.json and /state.json are also served.
 """
@@ -78,13 +79,20 @@ class SidecarApi:
     def __init__(self, state: ServicesState,
                  members_fn: Optional[Callable[[], list[str]]] = None,
                  cluster_name: str = "",
-                 envoy_v1=None) -> None:
+                 envoy_v1=None,
+                 haproxy_stats_url: Optional[str] = None) -> None:
         import threading
 
         self.state = state
         self.members_fn = members_fn
         self.cluster_name = cluster_name
         self._profile_gate = threading.Semaphore(1)
+        # When the node manages an HAProxy, the UI reads its stats CSV
+        # THROUGH this API (GET /api/haproxy/stats.csv) instead of
+        # hitting :3212 directly like the reference UI does
+        # (ui/app/services/services.js:21-33) — same data, no
+        # cross-origin fetch to a second port.  None = no HAProxy.
+        self.haproxy_stats_url = haproxy_stats_url
         # The deprecated Envoy V1 REST API (an EnvoyApiV1) rides on the
         # main HTTP server, like the reference's sidecarhttp mux
         # (envoy_api.go:428-438 mounted in http.go:64-76).
@@ -145,6 +153,8 @@ class SidecarApi:
             return self.debug_stacks()
         if parts == ["debug", "profile"]:
             return self.debug_profile(query)
+        if parts == ["haproxy", "stats.csv"]:
+            return self.haproxy_stats()
 
         if len(parts) == 1 and parts[0].startswith("services."):
             return self.services(parts[0].rsplit(".", 1)[1])
@@ -266,6 +276,25 @@ class SidecarApi:
             out.extend(line.rstrip()
                        for line in traceback.format_stack(frame))
         body = "\n".join(out).encode()
+        return 200, "text/plain", body, CORS_HEADERS
+
+    def haproxy_stats(self):
+        """Relay the managed HAProxy's stats CSV (the reference UI's
+        second data source, fetched straight off :3212 —
+        ui/app/services/services.js:21-33).  404 when this node runs no
+        HAProxy; 502 when HAProxy is expected but unreachable (the UI
+        treats both as "no proxy data", like the reference's catch)."""
+        import urllib.error
+        import urllib.request
+
+        if not self.haproxy_stats_url:
+            return self._error(404, "this node manages no HAProxy")
+        try:
+            with urllib.request.urlopen(self.haproxy_stats_url,
+                                        timeout=1.0) as resp:
+                body = resp.read(4 << 20)
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            return self._error(502, f"HAProxy stats unreachable: {exc}")
         return 200, "text/plain", body, CORS_HEADERS
 
     def debug_profile(self, query: dict):

@@ -171,6 +171,51 @@ class TestObservability:
                 "GET", "/api/debug/profile", {"seconds": [bad]})
             assert status == 400, bad
 
+    def test_haproxy_stats_relay(self):
+        """/api/haproxy/stats.csv relays the stats CSV same-origin (the
+        reference UI fetches :3212 cross-origin,
+        ui/app/services/services.js:21-33)."""
+        import http.server
+        import threading
+
+        csv = (b"# pxname,svname,qcur,scur,status,stot\n"
+               b"web-8080,h1-aaa111,0,3,UP,120\n")
+
+        class StatsStub(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(csv)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(("127.0.0.1", 0), StatsStub)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            api = SidecarApi(
+                make_state(), cluster_name="t",
+                haproxy_stats_url=f"http://127.0.0.1:{srv.server_port}/;csv")
+            status, ctype, body, _ = api.dispatch(
+                "GET", "/api/haproxy/stats.csv")
+            assert status == 200 and ctype == "text/plain"
+            assert body == csv
+        finally:
+            srv.shutdown()
+
+    def test_haproxy_stats_absent_and_unreachable(self):
+        # No HAProxy on this node → 404.
+        status, _, _, _ = make_api().dispatch(
+            "GET", "/api/haproxy/stats.csv")
+        assert status == 404
+        # Configured but down → 502, not an exception.
+        api = SidecarApi(make_state(), cluster_name="t",
+                         haproxy_stats_url="http://127.0.0.1:1/;csv")
+        status, _, body, _ = api.dispatch(
+            "GET", "/api/haproxy/stats.csv")
+        assert status == 502
+        assert b"unreachable" in body
+
     def test_debug_profile_single_flight(self):
         """Concurrent profiles would sample each other and multiply CPU
         burn; the second request gets 409 (net/http/pprof behavior)."""
@@ -219,9 +264,16 @@ class TestUi:
         status, ctype, body = self.get(server, "/ui/")
         assert status == 200 and ctype == "text/html"
         assert b"Sidecar" in body and b"app.js" in body
+        # The HAProxy backends panel (reference UI's second data
+        # source, services.js:21-33) ships with the page.
+        assert b"haproxy-section" in body
         status, ctype, body = self.get(server, "/ui/app.js")
         assert status == 200
         assert b"/api/services.json" in body and b"/watch" in body
+        # Stats come through the same-origin API relay, and the drain
+        # action posts to the drain route.
+        assert b"/api/haproxy/stats.csv" in body
+        assert b"/drain" in body
 
     def test_root_redirects_to_ui(self, server):
         # urlopen follows the 301; final document is the UI index.

@@ -20,6 +20,13 @@ function el(tag, attrs, ...children) {
 
 function timeAgo(ns) {
   if (!ns) return "never";
+  // The wire format ships RFC3339 strings (Service.to_json); accept
+  // raw nanoseconds too for older payloads.
+  if (typeof ns === "string") {
+    const ms = Date.parse(ns);
+    if (Number.isNaN(ms)) return "never";
+    ns = ms * 1e6;
+  }
   const s = Math.max(0, Date.now() / 1000 - ns / 1e9);
   if (s < 60) return `${Math.round(s)}s ago`;
   if (s < 3600) return `${Math.round(s / 60)}m ago`;
@@ -30,6 +37,127 @@ function timeAgo(ns) {
 function chip(status) {
   const idx = (status >= 0 && status < STATUS.length) ? status : 3;
   return el("span", { class: `chip s${idx}` }, STATUS[idx]);
+}
+
+// -- HAProxy stats (reference UI's second data source: the stats CSV,
+// ui/app/services/services.js:21-33 + the transform at :139-158 — here
+// read through the sidecar API to stay same-origin) -------------------
+
+// svcName → hostname → containerID → csv row, plus the raw backend rows.
+let haproxy = { map: {}, rows: [], ok: false };
+
+function parseHaproxyCsv(text) {
+  const lines = text.split("\n").filter(l => l.trim());
+  if (!lines.length) return { map: {}, rows: [], ok: false };
+  const header = lines[0].replace(/^# /, "").split(",");
+  const map = {}, rows = [];
+  for (const line of lines.slice(1)) {
+    const cells = line.split(",");
+    const item = {};
+    header.forEach((h, i) => { item[h] = cells[i]; });
+    const px = item.pxname || "";
+    if (item.svname === "FRONTEND" || item.svname === "BACKEND" ||
+        px === "stats" || px === "stats_proxy" || px === "") continue;
+    rows.push(item);
+    // pxname = "<svcName>-<port>", svname = "<hostname>-<containerID>"
+    // (the template's naming, views/haproxy.cfg:56-58).
+    let f = px.split("-");
+    const svcName = f.slice(0, f.length - 1).join("-");
+    f = item.svname.split("-");
+    const hostname = f.slice(0, f.length - 1).join("-");
+    const id = f[f.length - 1];
+    ((map[svcName] ||= {})[hostname] ||= {})[id] = item;
+  }
+  return { map, rows, ok: true };
+}
+
+// The HAProxy template writes sanitized backend names
+// (sanitize_name: [^a-z0-9-] → "-", haproxy.go:86-89), so catalog
+// names must be transformed the same way before lookup.
+function sanitizeName(name) {
+  return (name || "").replace(/[^a-z0-9-]/g, "-");
+}
+
+function haproxyHas(svc) {
+  const byHost = haproxy.map[sanitizeName(svc.Name)];
+  return !!(byHost && byHost[svc.Hostname] && byHost[svc.Hostname][svc.ID]);
+}
+
+function renderHaproxy() {
+  const section = document.getElementById("haproxy-section");
+  const wrap = document.getElementById("haproxy");
+  if (!haproxy.ok) { section.style.display = "none"; return; }
+  section.style.display = "";
+  if (!haproxy.rows.length) {
+    wrap.replaceChildren(el("div", { class: "empty" },
+      "HAProxy is up but serves no backends."));
+    return;
+  }
+  const table = el("table", {},
+    el("thead", {}, el("tr", {},
+      el("th", {}, "Backend"), el("th", {}, "Server"),
+      el("th", {}, "State"), el("th", {}, "Sessions"),
+      el("th", {}, "Total"))));
+  const body = el("tbody", {});
+  for (const row of haproxy.rows) {
+    const up = (row.status || "").startsWith("UP");
+    body.append(el("tr", {},
+      el("td", { class: "svc" }, row.pxname),
+      el("td", {}, row.svname),
+      el("td", {}, el("span", { class: `chip ${up ? "s0" : "s2"}` },
+        row.status || "?")),
+      el("td", {}, row.scur || "0"),
+      el("td", {}, row.stot || "0")));
+  }
+  table.append(body);
+  wrap.replaceChildren(table);
+}
+
+async function haproxyLoop() {
+  for (;;) {
+    let delay = 4000;
+    const wasOk = haproxy.ok;
+    try {
+      const resp = await fetch("/api/haproxy/stats.csv");
+      if (resp.status === 404) {
+        // This node manages no HAProxy — a static fact for the
+        // process lifetime; re-check lazily in case of operator lore.
+        haproxy = { map: {}, rows: [], ok: false };
+        delay = 60000;
+      } else {
+        haproxy = resp.ok ? parseHaproxyCsv(await resp.text())
+                          : { map: {}, rows: [], ok: false };
+      }
+    } catch (err) {
+      haproxy = { map: {}, rows: [], ok: false };
+    }
+    if (haproxy.ok || wasOk) {
+      renderHaproxy();
+      render(envelope);   // refresh the per-instance proxy ticks
+    }
+    await new Promise(resolve => setTimeout(resolve, delay));
+  }
+}
+
+// -- operator action: drain (POST /api/services/{id}/drain;
+// local-only by design, like the reference http_api.go:297-343) -------
+
+async function drain(svc) {
+  try {
+    const resp = await fetch(`/api/services/${svc.ID}/drain`,
+                             { method: "POST" });
+    const doc = await resp.json();
+    if (resp.ok) {
+      setStatus(`drained: ${doc.Message || svc.ID}`);
+    } else if (resp.status === 404) {
+      setStatus(`drain refused: ${svc.ID} is not local to this node ` +
+                "(drains are local-only)", true);
+    } else {
+      setStatus(`drain failed: ${doc.message || resp.status}`, true);
+    }
+  } catch (err) {
+    setStatus(`drain failed: ${err}`, true);
+  }
 }
 
 function render(data) {
@@ -56,11 +184,13 @@ function render(data) {
       "No services in the catalog yet."));
     return;
   }
-  const table = el("table", {},
-    el("thead", {}, el("tr", {},
-      el("th", {}, "Service"), el("th", {}, "Host"),
-      el("th", {}, "Status"), el("th", {}, "Ports"),
-      el("th", {}, "Updated"))));
+  const head = el("tr", {},
+    el("th", {}, "Service"), el("th", {}, "Host"),
+    el("th", {}, "Status"), el("th", {}, "Ports"),
+    el("th", {}, "Updated"));
+  if (haproxy.ok) head.append(el("th", {}, "Proxy"));
+  head.append(el("th", {}, ""));
+  const table = el("table", {}, el("thead", {}, head));
   const body = el("tbody", {});
   for (const name of names) {
     const instances = services[name];
@@ -79,6 +209,22 @@ function render(data) {
         el("td", {}, chip(svc.Status)),
         el("td", { class: "ports" }, ports),
         el("td", {}, timeAgo(svc.Updated)));
+      if (haproxy.ok) {
+        // The reference's per-instance "is it in HAProxy" tick
+        // (services.html:102-103).
+        row.append(el("td", { class: haproxyHas(svc) ? "ok" : "miss" },
+                      haproxyHas(svc) ? "✓" : "✗"));
+      }
+      const actions = el("td", { class: "actions" });
+      if (svc.Status === 0) {   // only a live instance can drain
+        const btn = el("button", { class: "drain", type: "button",
+                                   title: "Set this instance DRAINING " +
+                                          "(local instances only)" },
+                       "drain");
+        btn.addEventListener("click", () => drain(svc));
+        actions.append(btn);
+      }
+      row.append(actions);
       body.append(row);
     });
   }
@@ -96,7 +242,10 @@ async function pollLoop() {
   for (;;) {
     try {
       const resp = await fetch("/api/services.json");
-      render(await resp.json());
+      // Keep the shared envelope current: haproxyLoop re-renders from
+      // it, and a stale empty one would wipe the table every 4 s.
+      envelope = await resp.json();
+      render(envelope);
       setStatus(`polling · ${new Date().toLocaleTimeString()}`);
     } catch (err) {
       setStatus(`poll failed: ${err}`, true);
@@ -170,3 +319,4 @@ if (window.ReadableStream) {
 } else {
   pollLoop();
 }
+haproxyLoop();
