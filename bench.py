@@ -36,15 +36,23 @@ The burst drains through the real protocol budget (15 records per
 ~1398 B packet per peer, fanout 3), so SIMULATED time is
 bandwidth-bound exactly as the reference would be; the benchmark
 measures how fast one chip crunches those rounds.  The <10 s target is
-set for a v5e-8; this runs on the driver's SINGLE chip and — after the
-scatter-free per-line census — beats it there (measured 9.6 s,
-225 rounds at ~43 ms).  The sharded twin
-(parallel/sharded_compressed.py, validated on the virtual 8-device
-mesh) scales it further.
+set for a v5e-8; this runs on the driver's SINGLE chip.
+
+``north_star_faithful`` reruns the same burst under the REFERENCE'S
+protocol constants (20 s PushPullInterval instead of the headline's
+4 s) with ``fold_quorum=1.0`` — no analytic straggler fold; every
+delivery, including to the ~40 isolated nodes of the ER graph, is
+carried by simulated gossip + anti-entropy and the run ends at
+convergence == 1.0 exactly.  Both blocks report ε against BOTH
+denominators: the total belief space (the easy bar — a 0.1% burst
+unsettles ~10⁻³ of beliefs, so ε=10⁻⁴ ≈ 90% of the unsettled
+delivered) and the burst's own unsettled set (the strict bar — 99.99%
+of demanded deliveries done), with wall-clock at each crossing.
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-   "compressed_rounds_per_sec": N, "north_star": {...}}
+   "compressed_rounds_per_sec": N, "north_star": {...},
+   "north_star_faithful": {...}}
 """
 
 from __future__ import annotations
@@ -103,10 +111,29 @@ def _bench_compressed(n, spn, rounds):
     return rounds / (time.perf_counter() - t0)
 
 
-def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds):
+def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
+                      timecfg=None, fold_quorum=0.995, deep_sweep_every=0,
+                      cache_lines=256, sharded=False, note=""):
     """Wall-clock for one chip to simulate a ``churn_frac`` burst on an
     n-node / n·spn-service cluster to ε-convergence (compressed model;
-    the churn workload of BASELINE config 4 at north-star scale)."""
+    the churn workload of BASELINE config 4 at north-star scale).
+
+    ε is reported against BOTH denominators:
+
+    * ``rounds_to_eps`` — ε over the TOTAL belief space (n·m cells, the
+      convergence metric's native denominator).  A 0.1% burst unsettles
+      ~10⁻³ of all beliefs, so ε=10⁻⁴ here means delivering ~90% of the
+      unsettled beliefs — the easier bar.
+    * ``rounds_to_eps_unsettled`` — ε over the burst's own unsettled
+      set (burst·(n−1) beliefs that actually need delivery): 1−ε of the
+      deliveries the churn demanded have happened — the strict bar.
+
+    The default protocol constants here (4 s push-pull, quorum folds)
+    are the HEADLINE configuration; ``north_star_faithful`` in the
+    output reruns with the reference's own constants
+    (PushPullInterval 20 s — config/config.go:45, main.go:252-256 —
+    1-minute refresh live, ``fold_quorum=1.0`` so every delivery is
+    carried by simulated gossip, no analytic straggler fold)."""
     import jax
     import numpy as np
 
@@ -114,14 +141,23 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds):
     from sidecar_tpu.models.timecfg import TimeConfig
     from sidecar_tpu.ops.topology import erdos_renyi
 
-    cfg = TimeConfig(refresh_interval_s=10_000.0, push_pull_interval_s=4.0)
+    cfg = timecfg if timecfg is not None else \
+        TimeConfig(refresh_interval_s=10_000.0, push_pull_interval_s=4.0)
     params = CompressedParams(n=n, services_per_node=spn, fanout=3,
-                              budget=15, cache_lines=256,
-                              # Refresh is pinned out (cfg above), so no
-                              # refresh folds can occur and the exact
-                              # below-floor sweep has nothing to do.
-                              deep_sweep_every=0)
-    sim = CompressedSim(params, erdos_renyi(n, avg_degree=8.0, seed=3), cfg)
+                              budget=15, cache_lines=cache_lines,
+                              fold_quorum=fold_quorum,
+                              deep_sweep_every=deep_sweep_every)
+    topo = erdos_renyi(n, avg_degree=8.0, seed=3)
+    if sharded:
+        from sidecar_tpu.parallel.sharded_compressed import (
+            ShardedCompressedSim,
+        )
+        sim = ShardedCompressedSim(
+            params, topo, cfg,
+            board_exchange=os.environ.get("BENCH_BOARD_EXCHANGE",
+                                          "all_gather"))
+    else:
+        sim = CompressedSim(params, topo, cfg)
     rng = np.random.default_rng(7)
     slots = np.sort(
         rng.choice(params.m, size=max(1, int(params.m * churn_frac)),
@@ -129,47 +165,82 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds):
     state = sim.mint(sim.init_state(), slots, 10)
     key = jax.random.PRNGKey(0)
 
+    # ε thresholds as raw BEHIND counts (the device samples the count,
+    # not the normalized fraction: near 1.0 one float32 ulp of the
+    # ratio spans thousands of cells at this denominator, which would
+    # quantize the crossings).  Total-space: behind ≤ eps·n·m.
+    # Unsettled-set: behind ≤ eps·behind₀, behind₀ = burst·(n−1)
+    # (every non-owner starts behind).
+    behind0 = float(len(slots)) * (n - 1)
+    nm = float(n) * float(n * spn)
+    thr_total = eps * nm
+    thr_unsettled = eps * behind0
+
     # Chunk is 3 metric samples per dispatch: the ε check still has
     # conv_every granularity (the returned curve is scanned per sample)
     # while the host↔device round-trip — ~100 ms on a tunneled chip —
     # amortizes over 3× more rounds.
     chunk = 3 * conv_every
-    warm, c = sim.run(state, key, chunk, conv_every)
+    warm, c = sim.run_behind(state, key, chunk, conv_every)
     jax.device_get(c)
 
     t0 = time.perf_counter()
-    total, executed, conv_last, conv_max = 0, 0, 0.0, 0.0
+    executed, behind_last = 0, float("inf")
+    hit_total, hit_unsettled = None, None
+    wall_total, wall_unsettled = None, None
     while executed < max_rounds:
-        state, conv = sim.run(state, key, chunk, conv_every)
-        conv = np.asarray(jax.device_get(conv))
+        state, behind = sim.run_behind(state, key, chunk, conv_every)
+        behind = np.asarray(jax.device_get(behind), dtype=np.float64)
+        for j, b in enumerate(behind):
+            at = executed + (j + 1) * conv_every
+            if hit_total is None and b <= thr_total:
+                hit_total = at
+            if hit_unsettled is None and b <= thr_unsettled:
+                hit_unsettled = at
         executed += chunk
-        conv_last = float(conv[-1])
-        conv_max = max(conv_max, float(conv.max()))
-        if conv_max >= 1.0 - eps:
-            # rounds_to_eps at conv_every granularity: the first sample
-            # in this chunk that crossed ε (the full chunk still ran —
-            # per-round cost divides by `executed`, not `total`).
-            hit = int(np.argmax(conv >= 1.0 - eps)) + 1
-            total += hit * conv_every
+        behind_last = float(behind[-1])
+        # Wall-clock at each crossing, measured at the end of the chunk
+        # that crossed (the whole chunk ran on-device either way).
+        now_wall = time.perf_counter() - t0
+        if hit_total is not None and wall_total is None:
+            wall_total = now_wall
+        if hit_unsettled is not None and wall_unsettled is None:
+            wall_unsettled = now_wall
+        if hit_unsettled is not None and hit_total is not None:
             break
-        total += chunk
     wall = time.perf_counter() - t0
-    reached = conv_max >= 1.0 - eps
+    conv_last = 1.0 - behind_last / nm
     round_s = cfg.round_ticks / cfg.ticks_per_second
-    return {
+    out = {
         "n": n,
         "services": n * spn,
         "churn_frac": churn_frac,
         "eps": eps,
-        "rounds_to_eps": total if reached else None,
-        "sim_seconds_to_eps": round(total * round_s, 1)
-        if reached else None,
-        "final_convergence": round(conv_last, 6),
+        "push_pull_interval_s": cfg.push_pull_interval_s,
+        "refresh_interval_s": cfg.refresh_interval_s,
+        "fold_quorum": fold_quorum,
+        "cache_lines": cache_lines,
+        "rounds_to_eps": hit_total,
+        "sim_seconds_to_eps": round(hit_total * round_s, 1)
+        if hit_total else None,
+        "wall_seconds_to_eps": round(wall_total, 2)
+        if wall_total is not None else None,
+        "rounds_to_eps_unsettled": hit_unsettled,
+        "sim_seconds_to_eps_unsettled": round(hit_unsettled * round_s, 1)
+        if hit_unsettled else None,
+        "wall_seconds_to_eps_unsettled": round(wall_unsettled, 2)
+        if wall_unsettled is not None else None,
+        "final_convergence": round(conv_last, 9),
+        "final_behind_count": round(behind_last),
+        "rounds_executed": executed,
         "wall_seconds_single_chip": round(wall, 2),
         "wall_ms_per_round": round(wall / executed * 1000, 1),
         "target": "<10 s on v5e-8 (this is 1 chip; scaling path: "
-                  "parallel/sharded_compressed.py)",
+                  "parallel/sharded_compressed.py, BENCH_SHARDED=1)",
     }
+    if note:
+        out["note"] = note
+    return out
 
 
 def main() -> None:
@@ -199,9 +270,64 @@ def main() -> None:
     with trace:
         dense_rps = _bench_dense(n, spn, rounds)
         compressed_rps = _bench_compressed(n, spn, rounds)
-        north_star = _bench_north_star(ns_n, spn, churn_frac=0.001,
-                                       eps=1e-4, conv_every=25,
-                                       max_rounds=400)
+        north_star = _bench_north_star(
+            ns_n, spn, churn_frac=0.001, eps=1e-4, conv_every=25,
+            max_rounds=600,
+            note="headline protocol: 4 s push-pull, refresh pinned, "
+                 "quorum straggler fold (0.995) — the builder-chosen "
+                 "constants")
+        # The reference-faithful rerun: the reference's OWN anti-entropy
+        # cadence (PushPullInterval 20 s, config/config.go:45,
+        # main.go:252-256) and NO quorum fold — every delivery carried
+        # by simulated gossip to every node, stragglers and the ~40
+        # ER-isolated nodes included.  Identical model capacity
+        # (cache_lines=256) so the ONLY deltas vs the headline are
+        # protocol constants.  Refresh stays pinned in both: with it
+        # live, the convergence metric chases re-mint churn — every
+        # refresh of a still-in-flight record resets its cluster-wide
+        # agreement, so the metric equilibrates at (re-mint rate ×
+        # delivery latency) ≈ 1e-5 disagreement instead of reaching 1.0
+        # (measured: conv plateaus ~0.99999 at round 1650, never 1.0),
+        # exactly as a real 1M-service cluster never sits at 100%
+        # instantaneous agreement while refreshes fire.  The pinned runs
+        # measure the burst in isolation; both ε denominators are
+        # reported.
+        from sidecar_tpu.models.timecfg import TimeConfig
+        faithful_cfg = TimeConfig(refresh_interval_s=10_000.0)
+        north_star_faithful = _bench_north_star(
+            ns_n, spn, churn_frac=0.001, eps=1e-4, conv_every=25,
+            max_rounds=1500, timecfg=faithful_cfg, fold_quorum=1.0,
+            deep_sweep_every=0,
+            note="reference-faithful: PushPullInterval 20 s "
+                 "(config/config.go:45), fold_quorum=1.0 (no analytic "
+                 "straggler fold), same capacity as headline")
+        # Optional capacity-sensitivity rerun (BENCH_FAITHFUL_K1024=1):
+        # quantifies how much of the faithful drain is direct-mapped
+        # cache-collision serialization (1000 same-tick records hash
+        # into 256 lines, λ≈3.9; chains drain one generation per
+        # push-pull cycle).  Measured 2026-07: K=1024 cuts
+        # rounds_to_eps 525→325 and unsettled 1125→625 at ~4× the
+        # per-round cost (32→135 ms) — wall-clock favors K=256, sim
+        # time favors K=1024.
+        # BENCH_SHARDED=1: the same north star on the sharded twin over
+        # EVERY attached device (jax.sharding.Mesh) — on a v5e-8 this
+        # is the real 8-chip target run in one command; board exchange
+        # via BENCH_BOARD_EXCHANGE (all_gather | all_to_all).
+        north_star_sharded = None
+        if os.environ.get("BENCH_SHARDED"):
+            north_star_sharded = _bench_north_star(
+                ns_n, spn, churn_frac=0.001, eps=1e-4, conv_every=25,
+                max_rounds=600, sharded=True,
+                note=f"sharded twin over {len(jax.devices())} device(s), "
+                     "headline protocol constants")
+        north_star_k1024 = None
+        if os.environ.get("BENCH_FAITHFUL_K1024"):
+            north_star_k1024 = _bench_north_star(
+                ns_n, spn, churn_frac=0.001, eps=1e-4, conv_every=25,
+                max_rounds=1500, timecfg=faithful_cfg, fold_quorum=1.0,
+                deep_sweep_every=0, cache_lines=1024,
+                note="faithful at 4x cache capacity — collision-"
+                     "serialization sensitivity")
 
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.
@@ -213,6 +339,11 @@ def main() -> None:
         "vs_baseline": round(dense_rps / 5.0, 3),
         "compressed_rounds_per_sec": round(compressed_rps, 3),
         "north_star": north_star,
+        "north_star_faithful": north_star_faithful,
+        **({"north_star_sharded": north_star_sharded}
+           if north_star_sharded else {}),
+        **({"north_star_faithful_k1024": north_star_k1024}
+           if north_star_k1024 else {}),
     }))
 
 

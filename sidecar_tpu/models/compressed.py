@@ -171,6 +171,9 @@ class CompressedState:
     node_alive: jax.Array  # bool [N]
     round_idx: jax.Array   # int32 scalar
     evictions: jax.Array   # int32 scalar — live beliefs lost to capacity
+    dropped: jax.Array     # int32 scalar — pulls dropped by bounded
+                           # exchange capacity (sharded all_to_all
+                           # bucket overflow; always 0 single-chip)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -274,6 +277,7 @@ class CompressedSim:
             node_alive=jnp.ones((p.n,), bool),
             round_idx=jnp.zeros((), jnp.int32),
             evictions=jnp.zeros((), jnp.int32),
+            dropped=jnp.zeros((), jnp.int32),
         )
 
     # -- perturbation helper ------------------------------------------------
@@ -283,7 +287,18 @@ class CompressedSim:
         """Inject new record versions at the given global slots: owners
         re-stamp their authoritative copy and seed their cache line (the
         changed-service broadcast, services_state.go:538-549).  The
-        scenario-facing churn hook."""
+        scenario-facing churn hook.
+
+        DRAINING stickiness applies here too: the reference's
+        AddServiceEntry rewrites an advancing ALIVE on a DRAINING record
+        regardless of origin — local updates included
+        (services_state.go:329-331), so an owner's re-announce cannot
+        resurrect a draining instance.  The owner's belief of its own
+        slot is max(own, floor), so stickiness is evaluated against
+        both.  (Found by the ExactSim cross-validation suite: without
+        it, ``own`` stays ALIVE while the cluster converges to the
+        sticky DRAINING, and the fold census — which counts the owner
+        through ``own`` — can never reach unanimity.)"""
         p = self.p
         slots = jnp.asarray(slots, jnp.int32)
         owner = slots // p.services_per_node
@@ -291,6 +306,8 @@ class CompressedSim:
         val = jnp.broadcast_to(
             pack(jnp.asarray(now_tick, jnp.int32), status), slots.shape)
         val = jnp.where(state.node_alive[owner], val, 0)
+        cur = jnp.maximum(state.own[owner, col], state.floor[slots])
+        val = sticky_adjust(val, cur, val > cur)
         rows = jnp.where(val > 0, owner, p.n)
         own = state.own.at[rows, col].max(val, mode="drop")
         cs, cv, se, ev = _line_compete(
@@ -374,20 +391,31 @@ class CompressedSim:
         """Deliver: each receiver pulls the boards of its ``src`` peers
         and lex-merges them into its cache, entirely elementwise — the
         global line hash aligns every board with every cache, so slot
-        competition happens within each line position.
+        competition happens within each line position.  ``state`` may
+        be a shard-local view; ``bval``/``bslot`` are the full board,
+        ``src`` holds global peer ids.  (The sharded twin's
+        ``all_to_all`` exchange gathers the same peer rows without
+        materializing the full board and enters at
+        :meth:`_merge_pulled`.)"""
+        pv = bval[src]    # [nl, F, K] — row gathers, contiguous in K
+        ps = bslot[src]
+        ok = alive[src] & state.node_alive[:, None]      # [nl, F]
+        return self._merge_pulled(state, sent, pv, ps, ok, now,
+                                  drop_key=drop_key)
+
+    def _merge_pulled(self, state: CompressedState, sent, pv, ps, ok,
+                      now, drop_key=None):
+        """Merge pre-gathered peer board rows ``pv``/``ps`` ([nl, F, K])
+        into the cache.
 
         Merge semantics per candidate (vs the PRE-round line, one
         consistent batch resolution like ops/gossip.prepare_deliveries):
         staleness gate; dead sources/receivers contribute/accept
-        nothing; ``drop_prob`` models UDP loss; same-slot DRAINING
-        stickiness rewrites an advancing ALIVE to DRAINING.  ``state``
-        may be a shard-local view; ``bval``/``bslot`` are the full
-        board, ``src`` holds global peer ids."""
+        nothing (the ``ok`` mask); ``drop_prob`` models UDP loss;
+        same-slot DRAINING stickiness rewrites an advancing ALIVE to
+        DRAINING."""
         p, t = self.p, self.t
         cv0, cs0 = state.cache_val, state.cache_slot
-        pv = bval[src]    # [nl, F, K] — row gathers, contiguous in K
-        ps = bslot[src]
-        ok = alive[src] & state.node_alive[:, None]      # [nl, F]
         pv = jnp.where(ok[:, :, None], pv, 0)
         if p.drop_prob > 0.0:
             keep = jax.random.bernoulli(drop_key, 1.0 - p.drop_prob,
@@ -801,6 +829,24 @@ class CompressedSim:
         for the fast path's single gather, which is why ``run`` samples
         the metric on the ``conv_every`` cadence rather than inline
         every round."""
+        behind, denom = self._behind_and_denom(state)
+        return 1.0 - behind / denom
+
+    def behind(self, state: CompressedState) -> jax.Array:
+        """The raw behind COUNT — #(alive node, slot) beliefs not at the
+        freshest version, as a float32 count (same census as
+        :meth:`convergence`, unnormalized).
+
+        Exists because ``1 - behind/denom`` destroys resolution near
+        convergence: at the north star denom = 10¹¹, so one float32 ulp
+        below 1.0 (≈6e-8) already spans ~6,000 behind cells — an
+        ε-threshold over a small unsettled set cannot be detected on
+        the ratio.  The count itself is exact to ~1 part in 10⁶ (tree-
+        reduced float32 sums of unit terms), so thresholds like
+        "behind ≤ 10⁴" are sharp."""
+        return self._behind_and_denom(state)[0]
+
+    def _behind_and_denom(self, state: CompressedState):
         p = self.p
 
         def exact(st):
@@ -810,7 +856,7 @@ class CompressedSim:
             # scales this model exists for (65,536 × 655,360 ≈ 4.3e10).
             denom = jnp.maximum(
                 n_alive.astype(jnp.float32) * jnp.float32(p.m), 1.0)
-            return 1.0 - jnp.sum(behind.astype(jnp.float32)) / denom
+            return jnp.sum(behind.astype(jnp.float32)), denom
 
         def fast(st):
             own_flat = st.own.reshape(p.m)
@@ -833,7 +879,7 @@ class CompressedSim:
             behind = jnp.float32(p.n) * n_inflight.astype(jnp.float32) \
                 - sum_hits.astype(jnp.float32)
             denom = jnp.maximum(jnp.float32(p.n) * jnp.float32(p.m), 1.0)
-            return 1.0 - behind / denom
+            return behind, denom
 
         draining = is_known(state.own) & \
             (unpack_status(state.own) == DRAINING)
@@ -869,6 +915,17 @@ class CompressedSim:
         self._check_horizon(state, num_rounds)
         return self._run_jit(state, key, num_rounds, conv_every)
 
+    def run_behind(self, state, key, num_rounds: int, every: int = 1):
+        """Like :meth:`run` but sampling the raw behind COUNT
+        (:meth:`behind`) instead of the normalized fraction — the
+        bench's ε-crossing detector, immune to float32 resolution loss
+        near 1.0."""
+        if num_rounds % every:
+            raise ValueError(
+                f"num_rounds={num_rounds} not divisible by every={every}")
+        self._check_horizon(state, num_rounds)
+        return self._run_behind_jit(state, key, num_rounds, every)
+
     def run_fast(self, state, key, num_rounds: int):
         self._check_horizon(state, num_rounds)
         return self._run_fast_jit(state, key, num_rounds)
@@ -890,6 +947,16 @@ class CompressedSim:
             return st, self.convergence(st)
         return lax.scan(body, state, None,
                         length=num_rounds // conv_every)
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+    def _run_behind_jit(self, state, key, num_rounds, every):
+        def inner(st, _):
+            return self._step(st, jax.random.fold_in(key, st.round_idx)), \
+                None
+        def body(st, _):
+            st, _ = lax.scan(inner, st, None, length=every)
+            return st, self.behind(st)
+        return lax.scan(body, state, None, length=num_rounds // every)
 
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _run_fast_jit(self, state, key, num_rounds):

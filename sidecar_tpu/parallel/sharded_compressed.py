@@ -44,11 +44,30 @@ loss mask — with a pinned peer rule and ``drop_prob=0`` nothing random
 remains and the lockstep is exact.
 
 Scaling note: every per-round phase is O(N/d) per device (publish,
-pull-merge, announce); the board all_gather replicates O(N·K) transient
-bytes per device, which bounds single-pod reach to a few hundred
-thousand nodes at K=256.  Past that, the upgrade path is gathering only
-the board rows each shard's nodes actually sampled (an ``all_to_all``
-keyed by source shard) instead of the full board.
+pull-merge, announce).  Two board-exchange modes
+(``board_exchange=``):
+
+* ``"all_gather"`` — replicate the full O(N·K) board per device.
+  Simple, zero per-message bookkeeping, but the transient bytes per
+  device grow with N regardless of d (~1 GB at 1M nodes, K=256),
+  bounding single-pod reach.
+* ``"all_to_all"`` — gather ONLY the board rows each shard's nodes
+  sampled, keyed by source shard: per destination shard, requests are
+  bucketed by source shard (rank-compaction into fixed per-pair
+  capacity ``C = a2a_slack · ceil(nl·F/d)``), row ids ride one
+  ``all_to_all``, each shard serves its requested rows from the local
+  board, and a second ``all_to_all`` returns them.  Per-device
+  transient is O(a2a_slack · (N/d) · F · K) — it SHRINKS with d, so
+  the mode wins whenever ``a2a_slack·F < d`` and removes the O(N·K)
+  replication bound entirely.  A request landing beyond a bucket's
+  capacity is a DROPPED pull (the peer's board simply isn't seen that
+  round — bounded-capacity behavior the loss-tolerant protocol absorbs,
+  identical in kind to ``drop_prob``); with random peer sampling the
+  per-pair load is Binomial(nl·F, 1/d), so at the default slack of 2
+  an overflow is a many-sigma tail event (Chernoff: P ≲ e^{-μ/3} per
+  pair, μ = nl·F/d ≈ 4.7k at the north star) — and the deterministic
+  lockstep suite pins the mode bit-exact against the single-chip model
+  precisely because no drop ever fires there.
 
 Reference scale envelope this design answers: one Go process holds the
 whole O(M) catalog per host (catalog/services_state.go:70-80); at the
@@ -89,14 +108,30 @@ class ShardedCompressedSim(CompressedSim):
                  mesh=None,
                  perturb=None,
                  cut_mask: Optional[np.ndarray] = None,
-                 node_side: Optional[np.ndarray] = None):
+                 node_side: Optional[np.ndarray] = None,
+                 board_exchange: str = "all_gather",
+                 a2a_slack: int = 2):
         super().__init__(params, topo, timecfg, perturb=perturb,
                          cut_mask=cut_mask, node_side=node_side)
+        if board_exchange not in ("all_gather", "all_to_all"):
+            raise ValueError(
+                f"board_exchange must be 'all_gather' or 'all_to_all', "
+                f"got {board_exchange!r}")
+        if a2a_slack < 1:
+            raise ValueError("a2a_slack must be >= 1")
+        self.board_exchange = board_exchange
+        self.a2a_slack = a2a_slack
         self.mesh = mesh if mesh is not None else make_mesh()
         self.d = self.mesh.devices.size
         if params.n % self.d != 0:
             raise ValueError(
                 f"n={params.n} must divide the {self.d}-device mesh")
+        # Fixed per-(src shard, dst shard) request capacity for the
+        # all_to_all mode (see the module docstring); the floor keeps
+        # tiny test meshes from starving deterministic ring-walk peers.
+        nl = params.n // self.d
+        self._a2a_cap = max(16, -(-nl * params.fanout // self.d)
+                            * a2a_slack)
 
         row = NamedSharding(self.mesh, P(NODE_AXIS))
         repl = NamedSharding(self.mesh, P())
@@ -132,6 +167,7 @@ class ShardedCompressedSim(CompressedSim):
             node_alive=put(st.node_alive, repl),
             round_idx=put(st.round_idx, repl),
             evictions=put(st.evictions, repl),
+            dropped=put(st.dropped, repl),
         )
 
     # -- peer sampling (global ids; overridable for deterministic tests) ----
@@ -153,6 +189,70 @@ class ShardedCompressedSim(CompressedSim):
             cut = jnp.take_along_axis(cut_l, slot, axis=1)
             dst = jnp.where(cut, gi[:, None], dst)
         return jnp.where(alive[gi][:, None], dst, gi[:, None])
+
+    # -- the all_to_all board exchange (inside shard_map) -------------------
+
+    def _a2a_exchange(self, bval_l, bslot_l, dst, ax, nl):
+        """Fetch exactly the board rows this shard's nodes sampled
+        (``dst``: [nl, F] global peer ids) from their home shards.
+
+        Request routing: each sampled peer id splits into (source
+        shard, source row); own-shard rows read the local board
+        directly; cross-shard rows are rank-compacted into per-source-
+        shard buckets of static capacity ``C``, the row ids cross in
+        one ``all_to_all``, every shard serves its requested rows from
+        its local board, and the rows come back in a second
+        ``all_to_all``.  Requests past a bucket's capacity become empty
+        pulls, COUNTED in the returned drop total (see the module
+        docstring for why dropping is sound and why it never fires at
+        the default slack; tests assert the count stays 0).  Returns
+        (pv, ps, n_dropped): [nl, F, K] board rows identical to
+        ``bval[dst]``/``bslot[dst]`` of the all_gather path whenever
+        ``n_dropped == 0``."""
+        d, C = self.d, self._a2a_cap
+        flat = dst.reshape(-1)                       # [R], R = nl·F
+        src_shard = flat // nl
+        src_row = flat % nl
+        is_local = src_shard == ax
+
+        # Rank of each cross-shard request within its source-shard
+        # bucket, via one stable sort — O(R log R), independent of d
+        # (an earlier form used d sequential cumsum passes, which
+        # re-serializes at exactly the large d this mode exists for).
+        src_eff = jnp.where(is_local, d, src_shard)  # locals → bucket d
+        order = jnp.argsort(src_eff, stable=True)    # [R]
+        counts = jnp.zeros((d + 1,), jnp.int32).at[src_eff].add(1)
+        starts = jnp.cumsum(counts) - counts         # exclusive prefix
+        rank_sorted = jnp.arange(flat.shape[0], dtype=jnp.int32) \
+            - starts[src_eff[order]]
+        rank = jnp.zeros(flat.shape, jnp.int32).at[order].set(rank_sorted)
+        valid = ~is_local & (rank < C)
+        n_dropped = jnp.sum((~is_local & (rank >= C)).astype(jnp.int32))
+
+        req = jnp.zeros((d, C), jnp.int32)
+        req = req.at[jnp.where(valid, src_shard, d),
+                     jnp.where(valid, rank, 0)].set(src_row, mode="drop")
+        req_in = lax.all_to_all(req, NODE_AXIS, 0, 0)   # [d, C] rows
+                                                        # to serve
+        rows = jnp.clip(req_in, 0, nl - 1)
+        resp_v = lax.all_to_all(bval_l[rows], NODE_AXIS, 0, 0)
+        resp_s = lax.all_to_all(bslot_l[rows], NODE_AXIS, 0, 0)
+
+        # Assemble [R, K]: local rows from the local board, served rows
+        # from the responses, overflowed requests empty.
+        safe_shard = jnp.where(valid, src_shard, 0)
+        safe_rank = jnp.where(valid, rank, 0)
+        cross_v = resp_v[safe_shard, safe_rank]
+        cross_s = resp_s[safe_shard, safe_rank]
+        local_v = bval_l[jnp.where(is_local, src_row, 0)]
+        local_s = bslot_l[jnp.where(is_local, src_row, 0)]
+        pv = jnp.where(is_local[:, None], local_v,
+                       jnp.where(valid[:, None], cross_v, 0))
+        ps = jnp.where(is_local[:, None], local_s,
+                       jnp.where(valid[:, None], cross_s, -1))
+        k = self.p.cache_lines
+        return (pv.reshape(nl, self.p.fanout, k),
+                ps.reshape(nl, self.p.fanout, k), n_dropped)
 
     # -- the per-shard gossip + announce phase (inside shard_map) -----------
 
@@ -179,7 +279,8 @@ class ShardedCompressedSim(CompressedSim):
         local = CompressedState(
             own=own_l, cache_slot=cslot_l, cache_val=cval_l,
             cache_sent=csent_l, floor=floor, node_alive=alive[gi],
-            round_idx=round_idx, evictions=jnp.zeros((), jnp.int32))
+            round_idx=round_idx, evictions=jnp.zeros((), jnp.int32),
+            dropped=jnp.zeros((), jnp.int32))
 
         # 1. publish local board rows + transmit accounting (elementwise;
         # row_offset ties the tie rotation to global node ids).
@@ -188,12 +289,20 @@ class ShardedCompressedSim(CompressedSim):
         # The only cross-shard gossip traffic: the board (bounded offers,
         # line-aligned — each row is the ≤budget records its node would
         # pack into one ~1398 B datagram).
-        bval = lax.all_gather(bval_l, NODE_AXIS, tiled=True)   # [N, K]
-        bslot = lax.all_gather(bslot_l, NODE_AXIS, tiled=True)  # [N, K]
-
-        # 2. pull-merge into my rows (src holds global peer ids).
-        local = self._pull_merge(local, sent, bval, bslot, dst, alive,
-                                 now, drop_key=k_drop)
+        if self.board_exchange == "all_gather":
+            bval = lax.all_gather(bval_l, NODE_AXIS, tiled=True)  # [N, K]
+            bslot = lax.all_gather(bslot_l, NODE_AXIS, tiled=True)
+            # 2. pull-merge into my rows (src holds global peer ids).
+            local = self._pull_merge(local, sent, bval, bslot, dst,
+                                     alive, now, drop_key=k_drop)
+        else:
+            pv, ps, n_drop = self._a2a_exchange(bval_l, bslot_l, dst,
+                                                ax, nl)
+            ok = alive[dst] & alive[gi][:, None]
+            local = self._merge_pulled(local, sent, pv, ps, ok, now,
+                                       drop_key=k_drop)
+            local = dataclasses.replace(
+                local, dropped=local.dropped + n_drop)
 
         # 3. announce re-stamps + recovery offers (local rows own exactly
         # this shard's slot range; the refresh fold raises only shard-owned
@@ -202,8 +311,9 @@ class ShardedCompressedSim(CompressedSim):
 
         floor = lax.pmax(local.floor, NODE_AXIS)
         ev = lax.psum(local.evictions, NODE_AXIS)
+        dr = lax.psum(local.dropped, NODE_AXIS)
         return (local.own, local.cache_slot, local.cache_val,
-                local.cache_sent, floor, ev)
+                local.cache_sent, floor, ev, dr)
 
     # -- the round ----------------------------------------------------------
 
@@ -244,14 +354,15 @@ class ShardedCompressedSim(CompressedSim):
         fn = shard_map(
             body, mesh=self.mesh,
             in_specs=(spec_row,) * 4 + (spec_repl,) * 4 + topo_specs,
-            out_specs=(spec_row,) * 4 + (spec_repl, spec_repl),
+            out_specs=(spec_row,) * 4 + (spec_repl,) * 3,
             check_vma=False)
-        own, cs, cv, se, floor, ev = fn(
+        own, cs, cv, se, floor, ev, dr = fn(
             state.own, state.cache_slot, state.cache_val, state.cache_sent,
             state.floor, state.node_alive, k_peers, round_idx, *topo_args)
         state = dataclasses.replace(
             state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
-            floor=floor, evictions=state.evictions + ev)
+            floor=floor, evictions=state.evictions + ev,
+            dropped=state.dropped + dr)
 
         # 3. anti-entropy — the inherited stride exchange; jnp.roll along
         # the sharded axis lowers to a collective-permute.

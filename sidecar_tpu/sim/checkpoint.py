@@ -77,8 +77,12 @@ def load_state(path: str | pathlib.Path):
             raise ValueError(f"unknown checkpoint kind {kind!r}")
         state_cls, params_cls = _KINDS[kind]
         params = params_cls(**json.loads(str(data["params"])))
+        # Fields added after a checkpoint was written default to zero
+        # scalars (e.g. ``dropped``, introduced with the sharded
+        # all_to_all exchange) — a v2 compressed file stays loadable.
         state = state_cls(**{
-            f.name: jnp.asarray(data[f.name])
+            f.name: jnp.asarray(data[f.name]) if f.name in data
+            else jnp.zeros((), jnp.int32)
             for f in dataclasses.fields(state_cls)})
 
     if kind == "exact":
