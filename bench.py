@@ -16,12 +16,17 @@ fanout 3, budget 15):
   record-level semantics).  Roofline: the dense round is bound by its
   two full-tensor scatters (known 671 MB + sent 168 MB rewritten per
   round); measured v5e scatter cost at these shapes is 10-18 ms per
-  buffer touch nearly independent of update count (~7.5 ms even at
-  1k updates vs a 5.4 ms copy), and no formulation escapes it —
-  1D/sorted/unique-flagged/row-aligned/donated/in-scan variants all
-  measure the same (benchmarks/scatter_costs.py re-runs the whole
-  cost model).  ~36 ms/round ≈ 28 rounds/sec sits within ~2× of the
-  scatter-imposed floor — more speed requires a different state
+  buffer touch nearly independent of update count, and no formulation
+  escapes it: 1D/sorted/unique-flagged/row-aligned/donated/in-scan XLA
+  variants all measure the same (benchmarks/scatter_costs.py), and a
+  hand-written Pallas scatter-apply kernel — dense per-row-block
+  buckets, masked segment RMW, in-place via input_output_aliases —
+  lands at 13.3 ms vs XLA's 14.2 at the headline shape, against a
+  measured 9.0 ms zero-index in-place-RMW ceiling
+  (benchmarks/pallas_scatter.py; every 8-row tile is dirty at this
+  update density, so the full buffer must stream regardless of
+  indexing).  ~36 ms/round ≈ 28 rounds/sec therefore sits within ~1.6×
+  of the physical floor — more speed requires a different state
   representation, not a faster kernel.
 * ``compressed_rounds_per_sec`` — the bounded-memory large-cluster model
   (models/compressed.py) on the SAME cluster: O(N·K + M) state with the
@@ -238,6 +243,13 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
         "target": "<10 s on v5e-8 (this is 1 chip; scaling path: "
                   "parallel/sharded_compressed.py, BENCH_SHARDED=1)",
     }
+    if sharded:
+        # No silent caps: an all_to_all run with bucket overflows must
+        # be distinguishable from a drop-free one.
+        out["devices"] = len(jax.devices())
+        out["board_exchange"] = sim.board_exchange
+        out["a2a_slack"] = sim.a2a_slack
+        out["dropped_pulls"] = int(jax.device_get(state.dropped))
     if note:
         out["note"] = note
     return out

@@ -1,0 +1,232 @@
+"""Pallas scatter-merge: is the dense model's XLA scatter floor real?
+
+The dense exact model's round is bound by two full-tensor scatters
+(``known`` 671 MB + ``sent`` 168 MB rewritten per round, models/
+exact.py); ``benchmarks/scatter_costs.py`` showed every XLA scatter
+formulation costs the same ~13 ms at these shapes.  SURVEY.md §7 named
+a hand-written Pallas kernel as the remaining escape hatch; this
+experiment runs it, bounding the question from both sides:
+
+1. **The bandwidth floor** — a full-buffer elementwise pass.  No
+   in-place merge kernel can beat this: at the dense model's update
+   density (~225k random rows over 4,096) every 8-row tile is dirty,
+   so the whole buffer streams through the chip regardless of indexing.
+2. **Pallas RMW ceiling** — the same full-buffer max-merge as a Pallas
+   kernel with ``input_output_aliases`` (zero index work): what a
+   PERFECT index-applying kernel could at best approach.
+3. **The real thing** — a Pallas scatter-apply kernel.  Mosaic imposed
+   the shape of this thing: scalar stores to VMEM don't exist (each
+   update is a masked (8, 1024)-lane segment RMW), dynamic lane bases
+   must be provably 1024-aligned, and dynamic scalar loads from VMEM
+   don't lower — so updates are pre-bucketed DENSELY per row block
+   ([num_blocks, U_max], zero-padded; a val-0 update never wins a max)
+   and each grid step receives its own bucket as an SMEM block.  The
+   bucketing itself (sort + gather) runs inside the measured region —
+   it's part of what the kernel costs the model per round.
+4. **The XLA baseline** — ``known.at[rows, cols].max(vals)`` exactly
+   as the model issues it.
+
+Run: ``python benchmarks/pallas_scatter.py [n] [spn]`` (default
+4096×10, the headline dense shape).  Prints one JSON line; the dense
+model only changes if (3) beats (4) meaningfully — and either way the
+"no formulation escapes the scatter floor" claim becomes a measured
+statement.
+
+Timing uses the chained-loop recipe (LOOP iterations inside one
+dispatch): on the tunneled chip a single dispatch is dominated by the
+~100 ms host↔device round-trip.
+"""
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS_PER_BLOCK = 8
+# Mosaic can only prove alignment of a dynamic lane base at the block's
+# internal tiling granularity (1024 at these shapes).
+LANES = 1024
+LOOP = 20
+
+
+# -- the scatter-apply kernel ------------------------------------------------
+
+def _scatter_kernel(rows_ref, cols_ref, vals_ref, known_ref, out_ref,
+                    *, u_max):
+    """Apply this row block's (dense, zero-padded) update bucket."""
+    i = pl.program_id(0)
+    out_ref[:, :] = known_ref[:, :]
+
+    def body(j, _):
+        r = rows_ref[0, 0, j] - i * ROWS_PER_BLOCK
+        c = cols_ref[0, 0, j]
+        v = vals_ref[0, 0, j]
+        # No scalar VMEM stores on TPU: RMW the aligned (8, LANES)
+        # segment containing the element, selected by a 2D mask.  A
+        # padding update (v == 0) never advances a packed key.
+        base = pl.multiple_of((c // LANES) * LANES, LANES)
+        seg = out_ref[:, pl.ds(base, LANES)]
+        row = jax.lax.broadcasted_iota(
+            jnp.int32, (ROWS_PER_BLOCK, LANES), 0)
+        lane = jax.lax.broadcasted_iota(
+            jnp.int32, (ROWS_PER_BLOCK, LANES), 1) + base
+        seg = jnp.where((row == r) & (lane == c),
+                        jnp.maximum(seg, v), seg)
+        out_ref[:, pl.ds(base, LANES)] = seg
+        return 0
+
+    jax.lax.fori_loop(0, u_max, body, 0)
+
+
+def _bucket_updates(rows, cols, vals, num_blocks, u_max):
+    """Dense per-row-block buckets [num_blocks, u_max], zero-padded."""
+    block = rows // ROWS_PER_BLOCK
+    order = jnp.argsort(block, stable=True)
+    rows_s, cols_s, vals_s = rows[order], cols[order], vals[order]
+    offs = jnp.searchsorted(
+        block[order], jnp.arange(num_blocks + 1, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    idx = offs[:num_blocks, None] + jnp.arange(u_max, dtype=jnp.int32)
+    valid = idx < offs[1:num_blocks + 1, None]
+    idx = jnp.clip(idx, 0, rows.shape[0] - 1)
+    # [num_blocks, 1, u_max]: the singleton middle dim satisfies the
+    # lowering's last-two-dims block rule for the SMEM specs.
+    rb = jnp.where(valid, rows_s[idx], 0)[:, None, :]
+    cb = jnp.where(valid, cols_s[idx], 0)[:, None, :]
+    vb = jnp.where(valid, vals_s[idx], 0)[:, None, :]
+    return rb, cb, vb
+
+
+def make_pallas_scatter(n, m, u_max):
+    num_blocks = n // ROWS_PER_BLOCK
+
+    def apply(known, rows, cols, vals):
+        rb, cb, vb = _bucket_updates(rows, cols, vals, num_blocks, u_max)
+        smem = functools.partial(pl.BlockSpec, (1, 1, u_max),
+                                 lambda i: (i, 0, 0),
+                                 memory_space=pltpu.SMEM)
+        return pl.pallas_call(
+            functools.partial(_scatter_kernel, u_max=u_max),
+            grid=(num_blocks,),
+            in_specs=[
+                smem(), smem(), smem(),
+                pl.BlockSpec((ROWS_PER_BLOCK, m), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((ROWS_PER_BLOCK, m),
+                                   lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n, m), jnp.int32),
+            input_output_aliases={3: 0},
+        )(rb, cb, vb, known)
+
+    return apply
+
+
+# -- comparison points -------------------------------------------------------
+
+def _rmw_kernel(known_ref, other_ref, out_ref):
+    out_ref[:, :] = jnp.maximum(known_ref[:, :], other_ref[:, :])
+
+
+def pallas_rmw_max(known, other):
+    n, m = known.shape
+    return pl.pallas_call(
+        _rmw_kernel,
+        grid=(n // ROWS_PER_BLOCK,),
+        in_specs=[
+            pl.BlockSpec((ROWS_PER_BLOCK, m), lambda i: (i, 0)),
+            pl.BlockSpec((ROWS_PER_BLOCK, m), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((ROWS_PER_BLOCK, m), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(known.shape, known.dtype),
+        input_output_aliases={0: 0},
+    )(known, other)
+
+
+def _time_looped(fn, known, *rest, reps=3):
+    @jax.jit
+    def looped(k, *r):
+        return jax.lax.fori_loop(0, LOOP, lambda i, kk: fn(kk, *r), k)
+
+    out = looped(known, *rest)           # compile + warm
+    jax.device_get(out[:1, :1])
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = looped(out, *rest)
+        jax.device_get(out[:1, :1])
+        times.append((time.perf_counter() - t0) / LOOP)
+    return float(np.median(times))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    spn = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    m = n * spn
+    n_updates = n * 3 * 15 + m            # deliveries + announce batch
+    rng = np.random.default_rng(0)
+
+    def fresh_known():
+        return jnp.asarray(
+            rng.integers(1, 1 << 20, size=(n, m), dtype=np.int32))
+
+    rows_np = rng.integers(0, n, size=n_updates, dtype=np.int32)
+    rows = jnp.asarray(rows_np)
+    cols = jnp.asarray(rng.integers(0, m, size=n_updates, dtype=np.int32))
+    vals = jnp.asarray(
+        rng.integers(1, 1 << 22, size=n_updates, dtype=np.int32))
+    other = jnp.asarray(
+        rng.integers(1, 1 << 20, size=(n, m), dtype=np.int32))
+
+    # Static bucket capacity from the actual data (a model integration
+    # would size it once from n_updates/num_blocks + slack).
+    counts = np.bincount(rows_np // ROWS_PER_BLOCK,
+                         minlength=n // ROWS_PER_BLOCK)
+    u_max = int(counts.max())
+    pallas_scatter = make_pallas_scatter(n, m, u_max)
+
+    out = {"shape": [n, m], "updates": int(n_updates),
+           "buffer_mb": round(n * m * 4 / 1e6, 1),
+           "u_max_per_block": u_max}
+
+    # Correctness first: pallas scatter == XLA scatter.
+    k0 = np.asarray(fresh_known())
+    want = np.asarray(jax.jit(
+        lambda k, r, c, v: k.at[r, c].max(v))(
+            jnp.asarray(k0), rows, cols, vals))
+    try:
+        got = np.asarray(pallas_scatter(jnp.asarray(k0), rows, cols,
+                                        vals))
+        np.testing.assert_array_equal(got, want)
+        out["pallas_scatter_correct"] = True
+    except Exception as exc:                      # noqa: BLE001
+        out["pallas_scatter_correct"] = False
+        out["pallas_scatter_error"] = str(exc).split("\n")[0][:200]
+
+    out["elementwise_pass_ms"] = round(
+        _time_looped(lambda k: k + 1, fresh_known()) * 1e3, 2)
+    out["pallas_rmw_ceiling_ms"] = round(
+        _time_looped(pallas_rmw_max, fresh_known(), other) * 1e3, 2)
+    out["xla_scatter_ms"] = round(
+        _time_looped(lambda k, r, c, v: k.at[r, c].max(v),
+                     fresh_known(), rows, cols, vals) * 1e3, 2)
+    if out["pallas_scatter_correct"]:
+        out["pallas_scatter_ms"] = round(
+            _time_looped(pallas_scatter, fresh_known(), rows, cols,
+                         vals) * 1e3, 2)
+        ratio = out["xla_scatter_ms"] / out["pallas_scatter_ms"]
+        out["pallas_vs_xla"] = round(ratio, 2)
+        out["verdict"] = (
+            "pallas wins — consider wiring into the dense model"
+            if ratio > 1.25 else
+            "no meaningful win — the scatter floor stands")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

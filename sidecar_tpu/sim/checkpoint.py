@@ -77,9 +77,16 @@ def load_state(path: str | pathlib.Path):
             raise ValueError(f"unknown checkpoint kind {kind!r}")
         state_cls, params_cls = _KINDS[kind]
         params = params_cls(**json.loads(str(data["params"])))
-        # Fields added after a checkpoint was written default to zero
-        # scalars (e.g. ``dropped``, introduced with the sharded
-        # all_to_all exchange) — a v2 compressed file stays loadable.
+        # Fields ADDED after a format was frozen default to zero scalars
+        # so older files stay loadable — but only those exact fields: a
+        # file missing anything else (e.g. a truncated npz without
+        # round_idx) must still fail loudly, not resume at tick 0.
+        added_fields = {"dropped"}
+        missing = {f.name for f in dataclasses.fields(state_cls)
+                   if f.name not in data} - added_fields
+        if missing:
+            raise ValueError(
+                f"checkpoint is missing state field(s) {sorted(missing)}")
         state = state_cls(**{
             f.name: jnp.asarray(data[f.name]) if f.name in data
             else jnp.zeros((), jnp.int32)

@@ -200,11 +200,13 @@ class TestAliveBurst:
         """Mints landing mid-flight (rounds 0, 4, 9) keep the truth
         vectors bit-equal and both models converge."""
         rng = np.random.default_rng(11)
-        s1 = collision_free_slots(rng, 5)
-        rest = [s for s in collision_free_slots(rng, 15)
-                if s not in set(s1.tolist())]
-        s2 = np.asarray(rest[:5], np.int32)
-        s3 = np.asarray(rest[5:10], np.int32)
+        # One draw sliced three ways: collision-freedom (distinct cache
+        # lines) must hold ACROSS the batches, which a second
+        # independent draw would only give by seed luck.
+        all_slots = collision_free_slots(rng, 15)
+        s1 = all_slots[:5]
+        s2 = all_slots[5:10]
+        s3 = all_slots[10:15]
         conv_e, conv_c, es, cs = run_lockstep_compare(
             [(0, s1, 10, ALIVE), (4, s2, 900, ALIVE),
              (9, s3, 1900, ALIVE)], rounds=50)
