@@ -107,6 +107,11 @@ def make_pallas_scatter(n, m, u_max):
     num_blocks = n // ROWS_PER_BLOCK
 
     def apply(known, rows, cols, vals):
+        # Data dependency on the carry: without it, XLA hoists the
+        # loop-invariant bucketing out of the timing loop (LICM),
+        # understating the per-round cost the docstring promises to
+        # include (in the real model updates change every round).
+        vals = vals + (known[0, 0] & 0)
         rb, cb, vb = _bucket_updates(rows, cols, vals, num_blocks, u_max)
         smem = functools.partial(pl.BlockSpec, (1, 1, u_max),
                                  lambda i: (i, 0, 0),
@@ -168,6 +173,11 @@ def main():
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
     spn = int(sys.argv[2]) if len(sys.argv) > 2 else 10
     m = n * spn
+    # The grids/segments assume these; anything else would silently
+    # skip tail rows (rmw grid) or overrun the block (lane segments).
+    assert n % ROWS_PER_BLOCK == 0, f"n={n} must divide {ROWS_PER_BLOCK}"
+    assert m % LANES == 0 and m >= LANES, \
+        f"m={m} must be a positive multiple of {LANES}"
     n_updates = n * 3 * 15 + m            # deliveries + announce batch
     rng = np.random.default_rng(0)
 

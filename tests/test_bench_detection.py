@@ -1,0 +1,99 @@
+"""The north-star bench's ε-crossing detector, pinned with a scripted
+sim.
+
+The headline artifact (BENCH_r{N}.json) stands on `_bench_north_star`
+reading behind-count curves correctly: both denominators, crossing
+rounds at conv_every granularity, wall-clock at the crossing chunk, and
+loop termination.  A fake sim with a scripted behind schedule pins that
+logic without TPU time.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+
+import bench
+
+
+class ScriptedSim:
+    """Stands in for CompressedSim inside _bench_north_star: behind
+    follows a fixed schedule indexed by round."""
+
+    class _T:
+        round_ticks = 200
+        ticks_per_second = 1000
+        push_pull_interval_s = 4.0
+        refresh_interval_s = 10_000.0
+
+    def __init__(self, schedule):
+        self.t = self._T()
+        self.schedule = schedule
+        self.board_exchange = "all_gather"
+        self.a2a_slack = 2
+
+    def init_state(self):
+        return {"round": 0, "dropped": jnp.zeros((), jnp.int32)}
+
+    def mint(self, state, slots, tick):
+        return state
+
+    def run_behind(self, state, key, num_rounds, every):
+        rounds = np.arange(state["round"] + every,
+                           state["round"] + num_rounds + 1, every)
+        behind = np.asarray([self.schedule(r) for r in rounds],
+                            np.float32)
+        return ({"round": state["round"] + num_rounds,
+                 "dropped": state["dropped"]}, jnp.asarray(behind))
+
+
+def run_with_schedule(schedule, monkeypatch, n=1000, spn=10,
+                      churn_frac=0.01, max_rounds=300):
+    import sidecar_tpu.models.compressed as comp
+
+    monkeypatch.setattr(comp, "CompressedSim",
+                        lambda *a, **k: ScriptedSim(schedule))
+    # erdos_renyi at n=1000 is cheap; the sim ignores it anyway.
+    return bench._bench_north_star(
+        n, spn, churn_frac=churn_frac, eps=1e-4, conv_every=25,
+        max_rounds=max_rounds)
+
+
+class TestCrossingDetection:
+    def test_dual_thresholds_and_termination(self, monkeypatch):
+        # n=1000, m=10000: nm=1e7 → thr_total = 1e3.
+        # burst = 100 slots → behind0 = 100·999 = 99_900 →
+        # thr_unsettled = 9.99.
+        def schedule(r):
+            if r < 50:
+                return 50_000.0
+            if r < 100:
+                return 900.0          # ≤ thr_total, > thr_unsettled
+            return 0.0                # both crossed
+
+        out = run_with_schedule(schedule, monkeypatch)
+        assert out["rounds_to_eps"] == 50
+        assert out["rounds_to_eps_unsettled"] == 100
+        assert out["sim_seconds_to_eps"] == 50 * 0.2
+        assert out["final_convergence"] == 1.0
+        assert out["final_behind_count"] == 0
+        # Terminates at the chunk (75 rounds) containing both hits.
+        assert out["rounds_executed"] == 150
+        assert out["wall_seconds_to_eps"] is not None
+        assert out["wall_seconds_to_eps_unsettled"] >= \
+            out["wall_seconds_to_eps"]
+
+    def test_non_convergence_reports_none(self, monkeypatch):
+        out = run_with_schedule(lambda r: 5_000.0, monkeypatch,
+                                max_rounds=150)
+        assert out["rounds_to_eps"] is None
+        assert out["rounds_to_eps_unsettled"] is None
+        assert out["sim_seconds_to_eps"] is None
+        assert out["rounds_executed"] == 150
+        assert out["final_behind_count"] == 5000
+
+    def test_crossing_granularity_is_conv_every(self, monkeypatch):
+        # behind drops mid-chunk: detected at the NEXT sample multiple.
+        out = run_with_schedule(
+            lambda r: 0.0 if r >= 30 else 1e6, monkeypatch)
+        # First sample at/after round 30 on the 25-cadence is round 50.
+        assert out["rounds_to_eps"] == 50
+        assert out["rounds_to_eps_unsettled"] == 50
