@@ -21,8 +21,10 @@ fanout 3, budget 15):
   variants all measure the same (benchmarks/scatter_costs.py), and a
   hand-written Pallas scatter-apply kernel — dense per-row-block
   buckets, masked segment RMW, in-place via input_output_aliases —
-  lands at 13.3 ms vs XLA's 14.2 at the headline shape, against a
-  measured 9.0 ms zero-index in-place-RMW ceiling
+  LOSES outright at the headline shape: 28.3 ms/round including its
+  required per-round bucketing sort vs XLA's 13.4, with the kernel
+  body alone (~13 ms, bucketing amortized away) merely tying XLA,
+  against a measured ~8-9 ms zero-index in-place-RMW ceiling
   (benchmarks/pallas_scatter.py; every 8-row tile is dirty at this
   update density, so the full buffer must stream regardless of
   indexing).  ~36 ms/round ≈ 28 rounds/sec therefore sits within ~1.6×

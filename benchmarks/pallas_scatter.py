@@ -107,11 +107,14 @@ def make_pallas_scatter(n, m, u_max):
     num_blocks = n // ROWS_PER_BLOCK
 
     def apply(known, rows, cols, vals):
-        # Data dependency on the carry: without it, XLA hoists the
-        # loop-invariant bucketing out of the timing loop (LICM),
-        # understating the per-round cost the docstring promises to
-        # include (in the real model updates change every round).
-        vals = vals + (known[0, 0] & 0)
+        # Carry dependency via an optimization barrier: without it, XLA
+        # hoists the loop-invariant bucketing out of the timing loop
+        # (LICM), understating the per-round cost the docstring
+        # promises to include (in the real model updates change every
+        # round).  An arithmetic no-op like `vals + (known[0,0] & 0)`
+        # does NOT work — the algebraic simplifier folds it away before
+        # LICM runs.
+        vals, known = jax.lax.optimization_barrier((vals, known))
         rb, cb, vb = _bucket_updates(rows, cols, vals, num_blocks, u_max)
         smem = functools.partial(pl.BlockSpec, (1, 1, u_max),
                                  lambda i: (i, 0, 0),
@@ -175,7 +178,8 @@ def main():
     m = n * spn
     # The grids/segments assume these; anything else would silently
     # skip tail rows (rmw grid) or overrun the block (lane segments).
-    assert n % ROWS_PER_BLOCK == 0, f"n={n} must divide {ROWS_PER_BLOCK}"
+    assert n % ROWS_PER_BLOCK == 0, \
+        f"n={n} must be a multiple of {ROWS_PER_BLOCK}"
     assert m % LANES == 0 and m >= LANES, \
         f"m={m} must be a positive multiple of {LANES}"
     n_updates = n * 3 * 15 + m            # deliveries + announce batch

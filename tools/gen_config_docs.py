@@ -1,0 +1,154 @@
+"""Generate docs/CONFIGURATION.md from the config system itself.
+
+The reference documents its knob catalog by hand (README.md:155-237);
+hand-written tables drift.  This generator records every ``_env`` call
+each ``from_env`` constructor makes (env var name, default, inferred
+type) by temporarily swapping the resolver, so the doc IS the wiring:
+``tests/test_config_docs.py`` regenerates and diffs it, failing the
+suite whenever a knob is added without the doc.
+
+Run: ``python tools/gen_config_docs.py [--check]`` (``--check`` exits
+non-zero when docs/CONFIGURATION.md is stale instead of rewriting it).
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from sidecar_tpu import config as config_mod  # noqa: E402
+
+OUT = pathlib.Path(__file__).resolve().parent.parent / "docs" / \
+    "CONFIGURATION.md"
+
+SECTIONS = [
+    ("Core node (`SIDECAR_*`)", config_mod.SidecarConfig,
+     "config.go:41-59"),
+    ("Docker discovery (`DOCKER_*`)", config_mod.DockerConfig,
+     "config.go:15-18"),
+    ("Static discovery (`STATIC_*`)", config_mod.StaticConfig,
+     "config.go:20-23"),
+    ("Kubernetes API discovery (`K8S_*`)", config_mod.K8sAPIConfig,
+     "config.go:25-33 analog"),
+    ("Service naming (`SERVICES_*`)", config_mod.ServicesConfig,
+     "config.go:35-39"),
+    ("HAProxy driver (`HAPROXY_*`)", config_mod.HAproxyConfig,
+     "config.go:61-79"),
+    ("Envoy control plane (`ENVOY_*`)", config_mod.EnvoyConfig,
+     "config.go:27-33"),
+    ("Event listeners (`LISTENERS_*`)", config_mod.ListenerUrlsConfig,
+     "config.go:11-13"),
+]
+
+
+def _describe_default(value) -> str:
+    if isinstance(value, bool):
+        return "`true`" if value else "`false`"
+    if isinstance(value, list):
+        return "`" + ",".join(str(v) for v in value) + "`" if value \
+            else "(empty)"
+    if value == "":
+        return "(empty)"
+    return f"`{value}`"
+
+
+def _describe_type(default, cast) -> str:
+    if cast is not None:
+        return "duration" if cast is config_mod.parse_duration else \
+            getattr(cast, "__name__", "custom")
+    if isinstance(default, bool):
+        return "bool"
+    if isinstance(default, int):
+        return "int"
+    if isinstance(default, float):
+        return "duration (Go syntax: `200ms`, `20s`, `1m`)"
+    if isinstance(default, list):
+        return "comma-separated list"
+    return "string"
+
+
+def collect():
+    """(section, rows) pairs by recording each from_env's _env calls.
+
+    The caller's environment is irrelevant (rows record the DEFAULT
+    argument, not the resolved value), but a malformed exported var
+    (e.g. ``SIDECAR_BIND_PORT=abc``) would make from_env throw
+    mid-recording — so the prefixes are scrubbed for the duration."""
+    import os
+
+    saved = {k: os.environ.pop(k) for k in list(os.environ)
+             if k.split("_")[0] in ("SIDECAR", "DOCKER", "STATIC", "K8S",
+                                    "SERVICES", "HAPROXY", "ENVOY",
+                                    "LISTENERS")}
+    try:
+        return _collect_scrubbed()
+    finally:
+        os.environ.update(saved)
+
+
+def _collect_scrubbed():
+    out = []
+    real_env = config_mod._env
+    for title, cls, ref in SECTIONS:
+        rows = []
+
+        def recording(prefix, name, default, cast=None):
+            rows.append((f"{prefix}_{name}",
+                         _describe_type(default, cast),
+                         _describe_default(default)))
+            return real_env(prefix, name, default, cast)
+
+        config_mod._env = recording
+        try:
+            cls.from_env()
+        finally:
+            config_mod._env = real_env
+        out.append((title, ref, rows))
+    return out
+
+
+def render() -> str:
+    lines = [
+        "# Configuration reference",
+        "",
+        "Every knob, resolved exactly as `sidecar_tpu.config` resolves",
+        "it (this file is GENERATED — `python tools/gen_config_docs.py`",
+        "— and the test suite fails if it drifts from the code).  The",
+        "scheme mirrors the reference's envconfig catalog",
+        "(/root/reference/README.md:155-237, config/config.go); CLI",
+        "flags (`python -m sidecar_tpu.main --help`) override env vars",
+        "the same way the reference's kingpin flags do (cli.go:25-41).",
+        "",
+        "Durations accept Go syntax (`200ms`, `20s`, `1m`); booleans",
+        "accept `1/true/yes/on`; lists are comma-separated.",
+        "",
+    ]
+    for title, ref, rows in collect():
+        lines.append(f"## {title}")
+        lines.append("")
+        lines.append(f"Reference: {ref}")
+        lines.append("")
+        lines.append("| Variable | Type | Default |")
+        lines.append("|---|---|---|")
+        for var, typ, default in rows:
+            lines.append(f"| `{var}` | {typ} | {default} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    text = render()
+    if "--check" in sys.argv:
+        if not OUT.exists() or OUT.read_text() != text:
+            print(f"{OUT} is stale — run python tools/gen_config_docs.py",
+                  file=sys.stderr)
+            return 1
+        return 0
+    OUT.parent.mkdir(exist_ok=True)
+    OUT.write_text(text)
+    print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
