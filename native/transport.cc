@@ -207,6 +207,12 @@ class Transport {
     if (indirect_k >= 0) indirect_k_ = indirect_k;
   }
 
+  // Received-record queue bound (memberlist HandoffQueueDepth analog).
+  void set_handoff_depth(int depth) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (depth > 0) handoff_depth_ = static_cast<size_t>(depth);
+  }
+
   // Test-only fault injection: drop received packets of the given types
   // (bitmask by packet type) when they come from `node` — models a
   // one-way partition without touching the network stack.
@@ -786,7 +792,8 @@ class Transport {
             if (kind == kFrameUser) {
               std::lock_guard<std::mutex> lk(mu_);
               inbound_.emplace_back(reinterpret_cast<const char*>(p), flen);
-              if (inbound_.size() > 65536) inbound_.pop_front();
+              while (inbound_.size() > handoff_depth_)
+                inbound_.pop_front();
             } else if (kind == kFrameMembership) {
               const uint8_t* fp = p;
               const uint8_t* fend = p + flen;
@@ -1110,6 +1117,10 @@ class Transport {
   std::deque<Broadcast> queue_;    // user payloads
   std::deque<Broadcast> mqueue_;   // membership updates (priority)
   std::deque<std::string> inbound_, states_, events_;
+  // Received-record handoff queue bound (memberlist HandoffQueueDepth,
+  // config/config.go:48 — reference default 1024): a slow host-side
+  // consumer sheds the OLDEST records; anti-entropy re-delivers them.
+  size_t handoff_depth_ = 1024;
   std::map<uint32_t, PendingProbe> pending_;
   std::map<uint32_t, Forward> forwards_;
   std::map<std::string, uint32_t> dead_;  // death-cert incarnation marks
@@ -1162,6 +1173,11 @@ void st_broadcast(void* h, const uint8_t* data, int len) {
 void st_set_local_state(void* h, const uint8_t* data, int len) {
   if (!h) return;
   static_cast<Transport*>(h)->set_local_state(data, (size_t)len);
+}
+
+void st_set_handoff_depth(void* h, int depth) {
+  if (!h) return;
+  static_cast<Transport*>(h)->set_handoff_depth(depth);
 }
 
 void st_configure_probe(void* h, int interval_ms, int timeout_ms,

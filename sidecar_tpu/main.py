@@ -366,7 +366,9 @@ def main(argv=None) -> int:
                            gossip_interval=config.sidecar.gossip_interval,
                            push_pull_interval=config.sidecar
                            .push_pull_interval,
-                           gossip_messages=config.sidecar.gossip_messages))
+                           gossip_messages=config.sidecar.gossip_messages,
+                           handoff_queue_depth=config.sidecar
+                           .handoff_queue_depth))
     node.start(http_port=opts.http_port)
     log.info("Sidecar node %s up on %s", node.hostname, node.advertise_ip)
     try:

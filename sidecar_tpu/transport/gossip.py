@@ -68,7 +68,8 @@ def load_native() -> ctypes.CDLL:
                             hasattr(probe, "st_next_state_len") \
                             and hasattr(probe, "st_configure_probe") \
                             and hasattr(probe, "st_poll_log") \
-                            and hasattr(probe, "st_stats")
+                            and hasattr(probe, "st_stats") \
+                            and hasattr(probe, "st_set_handoff_depth")
                     except OSError:
                         # Unloadable (corrupt/wrong-arch) prebuilt: fall
                         # through to the RuntimeError that carries the
@@ -95,6 +96,8 @@ def load_native() -> ctypes.CDLL:
                                            ctypes.c_char_p, ctypes.c_int]
         lib.st_configure_probe.argtypes = [ctypes.c_void_p] + \
             [ctypes.c_int] * 4
+        lib.st_set_handoff_depth.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int]
         lib.st_test_drop_types.argtypes = [ctypes.c_void_p,
                                            ctypes.c_char_p, ctypes.c_uint]
         for fn in (lib.st_poll_msg, lib.st_poll_state, lib.st_poll_event,
@@ -142,7 +145,8 @@ class GossipTransport:
                  probe_interval: float = 0.0,
                  probe_timeout: float = 0.0,
                  suspect_timeout: float = 0.0,
-                 indirect_probes: int = -1) -> None:
+                 indirect_probes: int = -1,
+                 handoff_queue_depth: int = 1024) -> None:
         import socket
 
         self.node_name = node_name or socket.gethostname()
@@ -158,6 +162,17 @@ class GossipTransport:
         self.probe_timeout = probe_timeout
         self.suspect_timeout = suspect_timeout
         self.indirect_probes = indirect_probes
+        # memberlist HandoffQueueDepth (config/config.go:48): bound on
+        # the engine's received-record queue; a stalled consumer sheds
+        # the oldest records and anti-entropy re-delivers them.  Loud on
+        # nonsense: the engine would silently keep its default and an
+        # operator expecting "0 = unbounded" would be shedding at a
+        # bound they believe they disabled.
+        if handoff_queue_depth <= 0:
+            raise ValueError(
+                f"handoff_queue_depth must be positive, got "
+                f"{handoff_queue_depth} (there is no unbounded mode)")
+        self.handoff_queue_depth = handoff_queue_depth
         self._lib = load_native()
         self._handle: Optional[int] = None
         self._quit = threading.Event()
@@ -182,6 +197,8 @@ class GossipTransport:
             self._handle, int(self.probe_interval * 1000),
             int(self.probe_timeout * 1000),
             int(self.suspect_timeout * 1000), self.indirect_probes)
+        self._lib.st_set_handoff_depth(self._handle,
+                                       self.handoff_queue_depth)
         port = self._lib.st_start(self._handle)
         if port < 0:
             raise OSError(

@@ -343,6 +343,71 @@ class TestLogBridge:
             t.stop()
 
 
+class TestHandoffQueueDepth:
+    def test_engine_sheds_oldest_beyond_depth(self):
+        """SIDECAR_HANDOFF_QUEUE_DEPTH (memberlist HandoffQueueDepth,
+        config/config.go:48) bounds the engine's received-record queue:
+        with the host consumer stalled, records past the bound shed
+        OLDEST-first (anti-entropy re-delivers them).  Drives the raw
+        engine so nothing drains between frames."""
+        import ctypes
+        import socket
+        import struct
+
+        from sidecar_tpu.transport.gossip import load_native
+
+        lib = load_native()
+        h = lib.st_create(b"hq-a", b"test", b"127.0.0.1", 0,
+                          b"127.0.0.1", 100, 60000, 3, 15)
+        try:
+            lib.st_set_handoff_depth(h, 3)
+            port = lib.st_start(h)
+            assert port > 0
+
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            my_port = sock.getsockname()[1] or 1
+
+            def str8(b):
+                return bytes([len(b)]) + b
+
+            header = (struct.pack(">I", 0x53433032) + bytes([0])
+                      + str8(b"test") + str8(b"fake-hq")
+                      + str8(b"127.0.0.1")
+                      + struct.pack(">HI", my_port, 1))
+            frames = b"".join(
+                bytes([0]) + struct.pack(">H", 2) + f"r{i}".encode()
+                for i in range(6))
+            sock.sendto(header + frames, ("127.0.0.1", port))
+            sock.close()
+
+            buf = ctypes.create_string_buffer(4096)
+            got = []
+
+            def drain():
+                while True:
+                    n = lib.st_poll_msg(h, buf, 4096)
+                    if n <= 0:
+                        return bool(got)
+                    got.append(buf.raw[:n])
+
+            assert wait_for(drain, timeout=5.0)
+            drain()   # anything still in flight after the first hit
+            assert got == [b"r3", b"r4", b"r5"], got
+        finally:
+            lib.st_stop(h)
+            lib.st_destroy(h)
+
+
+class TestHandoffDepthValidation:
+    def test_non_positive_depth_rejected(self):
+        from sidecar_tpu.transport.gossip import GossipTransport
+
+        for bad in (0, -5):
+            with pytest.raises(ValueError, match="handoff_queue_depth"):
+                GossipTransport(node_name="x", bind_port=0,
+                                handoff_queue_depth=bad)
+
+
 class TestHostileInput:
     """The native engine parses untrusted network bytes; a garbage storm
     on both ports must neither crash it nor stop the protocol (every
