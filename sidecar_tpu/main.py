@@ -123,6 +123,31 @@ class SidecarNode:
                 else "http://127.0.0.1:3212/;csv;norefresh"))
         self.haproxy: Optional[HAProxy] = None
         if not self.config.haproxy.disable:
+            # HAPROXY_TEMPLATE_FILE: resolve against the cwd first (an
+            # operator's custom template), then the repo's stock
+            # views/haproxy.cfg (the reference's default path).  An
+            # EXPLICITLY configured template that's missing must fail
+            # LOUDLY at the render (the driver raises; write_and_reload
+            # renders before touching the file) — the operator's proxy
+            # must not silently run a config shape they didn't write.
+            # The unresolvable DEFAULT (e.g. a package-only install
+            # without views/) falls back to the embedded renderer,
+            # which produces the same config.
+            from sidecar_tpu.config import HAproxyConfig
+
+            tf = self.config.haproxy.template_file
+            explicit = tf != HAproxyConfig().template_file
+            if tf and not pathlib.Path(tf).is_file():
+                repo_tf = pathlib.Path(__file__).resolve().parent.parent \
+                    / tf
+                if repo_tf.is_file():
+                    tf = str(repo_tf)
+                elif explicit:
+                    log.error(
+                        "HAPROXY_TEMPLATE_FILE %r not found; config "
+                        "writes will fail until it exists", tf)
+                else:
+                    tf = ""     # default path absent → embedded renderer
             self.haproxy = HAProxy(
                 config_file=self.config.haproxy.config_file,
                 pid_file=self.config.haproxy.pid_file,
@@ -131,7 +156,8 @@ class SidecarNode:
                 group=self.config.haproxy.group,
                 use_hostnames=self.config.haproxy.use_hostnames,
                 reload_cmd=self.config.haproxy.reload_cmd,
-                verify_cmd=self.config.haproxy.verify_cmd)
+                verify_cmd=self.config.haproxy.verify_cmd,
+                template_file=tf)
         # use_grpc_api selects the transport for the SAME resource set:
         # the gRPC ADS stream (the reference's production path,
         # envoy/server.go:61-124) or REST xDS polling (main.go:397-411).

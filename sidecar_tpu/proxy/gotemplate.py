@@ -1,0 +1,268 @@
+"""A minimal Go ``text/template`` interpreter for proxy templates.
+
+The reference renders HAProxy configs through Go's template engine with
+a registered FuncMap (haproxy/haproxy.go:140-193), and operators point
+``HAPROXY_TEMPLATE_FILE`` at their own template (views/haproxy.cfg is
+the stock one).  For those custom templates to keep working against
+this implementation, this module interprets the dialect that proxy
+templates actually use:
+
+* ``{{ <expr> }}`` — evaluate and write (stringified).
+* ``{{ if <expr> }} … {{ end }}`` — Go truthiness (empty string/zero/
+  empty collection/None are false).  ``else`` is not supported (the
+  stock template doesn't use it; loud error if seen).
+* ``{{ range $v := <expr> }} … {{ end }}`` and
+  ``{{ range $k, $v := <expr> }} … {{ end }}`` — over lists (index,
+  item) or maps (key, value; keys iterated in sorted order, matching
+  Go's map range in templates).
+* Expressions: ``$var``, ``.Field``, ``$var.Field.Sub``, quoted
+  strings, integers, and function calls ``fname arg1 arg2`` resolved
+  against the caller's FuncMap (parenthesized sub-calls are not
+  supported — not used by proxy templates).
+* Field access maps Go's exported names onto this codebase's snake_case
+  attributes (``.ServicePort`` → ``service_port``, ``.ID`` → ``id``)
+  and falls back to dict keys verbatim.
+
+This is deliberately NOT a full text/template: unsupported constructs
+raise ``TemplateError`` at parse time rather than rendering something
+silently wrong.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+_ACTION = re.compile(r"\{\{(.*?)\}\}", re.DOTALL)
+_CAMEL = re.compile(r"(?<=[a-z0-9])(?=[A-Z])")
+
+
+class TemplateError(ValueError):
+    pass
+
+
+def _snake(name: str) -> str:
+    return _CAMEL.sub("_", name).lower()
+
+
+def _truthy(v: Any) -> bool:
+    """Go template truth: the zero value of the type is false."""
+    if v is None or v is False:
+        return False
+    if isinstance(v, (str, bytes, list, tuple, dict, set)):
+        return len(v) > 0
+    if isinstance(v, (int, float)):
+        return v != 0
+    return True
+
+
+def _stringify(v: Any) -> str:
+    if v is None:
+        return "<no value>"
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return str(v)
+
+
+class _Env:
+    def __init__(self, dot: Any, funcs: dict[str, Callable],
+                 parent: Optional["_Env"] = None):
+        self.dot = dot
+        self.funcs = funcs
+        self.vars: dict[str, Any] = {}
+        self.parent = parent
+
+    def lookup(self, name: str) -> Any:
+        env: Optional[_Env] = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        raise TemplateError(f"undefined variable ${name}")
+
+
+def _resolve_field(obj: Any, field: str) -> Any:
+    if isinstance(obj, dict):
+        # Go text/template: a missing map key yields the zero value
+        # (templates legitimately probe optional keys with `if`); only
+        # a missing struct field is an error.
+        return obj.get(field)
+    attr = _snake(field)
+    if hasattr(obj, attr):
+        return getattr(obj, attr)
+    raise TemplateError(
+        f"{type(obj).__name__} has no field .{field} (looked for "
+        f"attribute {attr!r})")
+
+
+# -- expression evaluation ---------------------------------------------------
+
+def _eval_primary(token: str, env: _Env) -> Any:
+    if token.startswith('"') and token.endswith('"') and len(token) >= 2:
+        return token[1:-1]
+    if re.fullmatch(r"-?\d+", token):
+        return int(token)
+    if token == ".":
+        return env.dot
+    if token.startswith("$"):
+        parts = token[1:].split(".")
+        val = env.lookup(parts[0])
+        for field in parts[1:]:
+            val = _resolve_field(val, field)
+        return val
+    if token.startswith("."):
+        val = env.dot
+        for field in token[1:].split("."):
+            val = _resolve_field(val, field)
+        return val
+    raise TemplateError(f"cannot evaluate {token!r}")
+
+
+def _eval_expr(tokens: list[str], env: _Env) -> Any:
+    if not tokens:
+        raise TemplateError("empty action")
+    head = tokens[0]
+    if head in env.funcs:
+        args = [_eval_primary(t, env) for t in tokens[1:]]
+        return env.funcs[head](*args)
+    if len(tokens) != 1:
+        raise TemplateError(
+            f"{head!r} is not a registered function but has arguments "
+            f"{tokens[1:]}")
+    return _eval_primary(head, env)
+
+
+# -- parsing -----------------------------------------------------------------
+
+class _Text:
+    def __init__(self, text: str):
+        self.text = text
+
+
+class _Action:
+    def __init__(self, tokens: list[str]):
+        self.tokens = tokens
+
+
+class _If:
+    def __init__(self, tokens: list[str], body: list):
+        self.tokens = tokens
+        self.body = body
+
+
+class _Range:
+    def __init__(self, kvar: Optional[str], vvar: str,
+                 tokens: list[str], body: list):
+        self.kvar = kvar
+        self.vvar = vvar
+        self.tokens = tokens
+        self.body = body
+
+
+def _tokenize_action(src: str) -> list[str]:
+    out = re.findall(r'"[^"]*"|\S+', src)
+    return out
+
+
+def _parse(text: str) -> list:
+    """Template → node tree (one pass with an explicit block stack)."""
+    root: list = []
+    stack: list[tuple[str, list, Any]] = [("root", root, None)]
+    pos = 0
+    for m in _ACTION.finditer(text):
+        if m.start() > pos:
+            stack[-1][1].append(_Text(text[pos:m.start()]))
+        pos = m.end()
+        tokens = _tokenize_action(m.group(1).strip())
+        if not tokens:
+            raise TemplateError("empty {{ }} action")
+        head = tokens[0]
+        if head == "end":
+            kind, body, node = stack.pop()
+            if kind == "root":
+                raise TemplateError("{{ end }} without an open block")
+            stack[-1][1].append(node)
+        elif head == "if":
+            node = _If(tokens[1:], [])
+            stack.append(("if", node.body, node))
+        elif head == "range":
+            rest = tokens[1:]
+            if ":=" in rest:
+                idx = rest.index(":=")
+                decl, expr = rest[:idx], rest[idx + 1:]
+                # `range $k, $v :=` tokenizes as ["$k,", "$v", ":=", …];
+                # the expr may itself be a function call's tokens.
+                decl = [d.rstrip(",") for d in decl]
+                if len(decl) == 1:
+                    kvar, vvar = None, decl[0]
+                elif len(decl) == 2:
+                    kvar, vvar = decl
+                else:
+                    raise TemplateError(
+                        f"range declares {len(decl)} variables")
+                if not vvar.startswith("$") or \
+                        (kvar is not None and not kvar.startswith("$")):
+                    raise TemplateError("range variables must be $names")
+                node = _Range(kvar[1:] if kvar else None, vvar[1:],
+                              expr, [])
+            else:
+                raise TemplateError(
+                    "only `range $v := expr` / `range $k, $v := expr` "
+                    "forms are supported")
+            stack.append(("range", node.body, node))
+        elif head in ("else", "with", "template", "block", "define"):
+            raise TemplateError(
+                f"{{{{ {head} }}}} is not supported by this renderer")
+        else:
+            stack[-1][1].append(_Action(tokens))
+    if len(stack) != 1:
+        raise TemplateError(f"unclosed {{{{ {stack[-1][0]} }}}} block")
+    if pos < len(text):
+        root.append(_Text(text[pos:]))
+    return root
+
+
+# -- rendering ---------------------------------------------------------------
+
+def _render_nodes(nodes: list, env: _Env, out: list[str]) -> None:
+    for node in nodes:
+        if isinstance(node, _Text):
+            out.append(node.text)
+        elif isinstance(node, _Action):
+            out.append(_stringify(_eval_expr(node.tokens, env)))
+        elif isinstance(node, _If):
+            if _truthy(_eval_expr(node.tokens, env)):
+                _render_nodes(node.body, env, out)
+        elif isinstance(node, _Range):
+            coll = _eval_expr(node.tokens, env)
+            if isinstance(coll, dict):
+                items = [(k, coll[k]) for k in sorted(coll)]
+            elif isinstance(coll, (list, tuple)):
+                items = list(enumerate(coll))
+            elif coll is None:
+                items = []
+            else:
+                raise TemplateError(
+                    f"cannot range over {type(coll).__name__}")
+            for k, v in items:
+                child = _Env(env.dot, env.funcs, parent=env)
+                if node.kvar is not None:
+                    child.vars[node.kvar] = k
+                child.vars[node.vvar] = v
+                _render_nodes(node.body, child, out)
+
+
+class Template:
+    """Parse once, execute many (text/template's lifecycle)."""
+
+    def __init__(self, text: str):
+        self.nodes = _parse(text)
+
+    def execute(self, data: Any, funcs: dict[str, Callable]) -> str:
+        out: list[str] = []
+        _render_nodes(self.nodes, _Env(data, funcs), out)
+        return "".join(out)
+
+
+def render(text: str, data: Any, funcs: dict[str, Callable]) -> str:
+    return Template(text).execute(data, funcs)

@@ -1,0 +1,143 @@
+"""The Go-text/template interpreter + the HAProxy custom-template path.
+
+HAPROXY_TEMPLATE_FILE is real operator surface in the reference
+(haproxy.go:170-176 parses the file with Go's template engine and the
+FuncMap at :158-170); these tests pin the dialect the interpreter
+supports, its loud failures on what it doesn't, and the equivalence of
+the stock views/haproxy.cfg rendering with the driver's embedded
+renderer on the same catalog.
+"""
+
+import io
+import pathlib
+
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.proxy.gotemplate import Template, TemplateError, render
+from sidecar_tpu.proxy.haproxy import HAProxy
+
+from tests.test_proxy import T0, make_state
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+class TestEngine:
+    def test_actions_fields_vars_funcs(self):
+        out = render(
+            "x={{ .X }} up={{ upper .Name }} lit={{ \"q\" }} n={{ 7 }}",
+            {"X": 3, "Name": "ab"}, {"upper": str.upper})
+        assert out == "x=3 up=AB lit=q n=7"
+
+    def test_if_truthiness(self):
+        tmpl = "{{ if .V }}yes{{ end }}|{{ if .W }}no{{ end }}"
+        assert render(tmpl, {"V": "x", "W": ""}, {}) == "yes|"
+        assert render(tmpl, {"V": [1], "W": 0}, {}) == "yes|"
+        assert render(tmpl, {"V": 1, "W": {}}, {}) == "yes|"
+
+    def test_range_map_sorted_and_list(self):
+        out = render("{{ range $k, $v := .M }}{{ $k }}={{ $v }};"
+                     "{{ end }}", {"M": {"b": 2, "a": 1}}, {})
+        assert out == "a=1;b=2;"
+        out = render("{{ range $v := .L }}[{{ $v }}]{{ end }}",
+                     {"L": ["x", "y"]}, {})
+        assert out == "[x][y]"
+
+    def test_range_over_function_result_and_nested_vars(self):
+        funcs = {"pair": lambda k: {"p1": k + "-a", "p2": k + "-b"}}
+        out = render(
+            "{{ range $k, $v := .M }}{{ range $p, $q := pair $k }}"
+            "{{ $k }}/{{ $p }}/{{ $q }};{{ end }}{{ end }}",
+            {"M": {"s": 0}}, funcs)
+        assert out == "s/p1/s-a;s/p2/s-b;"
+
+    def test_object_field_snake_mapping(self):
+        svc = S.Service(id="abc", hostname="h9",
+                        ports=[S.Port("tcp", 8, 9, "1.2.3.4")])
+        out = render("{{ .Svc.Hostname }}-{{ .Svc.ID }}", {"Svc": svc}, {})
+        assert out == "h9-abc"
+
+    def test_unsupported_constructs_fail_loudly(self):
+        for bad in ("{{ else }}", "{{ with .X }}{{ end }}",
+                    "{{ template \"x\" }}", "{{ block \"x\" }}"):
+            with pytest.raises(TemplateError):
+                Template(bad)
+        with pytest.raises(TemplateError, match="unclosed"):
+            Template("{{ if .X }}no end")
+        with pytest.raises(TemplateError, match="without an open"):
+            Template("{{ end }}")
+        with pytest.raises(TemplateError, match="undefined variable"):
+            render("{{ $nope }}", {}, {})
+        with pytest.raises(TemplateError, match="no field"):
+            render("{{ .Svc.Bogus }}",
+                   {"Svc": S.Service(id="x")}, {})
+
+
+def meaningful_lines(cfg: str) -> set:
+    return {" ".join(line.split()) for line in cfg.splitlines()
+            if line.strip() and not line.strip().startswith("#")}
+
+
+class TestHAProxyTemplateFile:
+    def test_stock_template_matches_embedded_renderer(self):
+        """views/haproxy.cfg through the interpreter produces the same
+        meaningful config lines as the driver's embedded renderer."""
+        embedded = HAProxy(bind_ip="192.168.1.1", user="hap",
+                           group="hap")
+        templated = HAProxy(bind_ip="192.168.1.1", user="hap",
+                            group="hap",
+                            template_file=str(REPO / "views"
+                                              / "haproxy.cfg"))
+        b1, b2 = io.StringIO(), io.StringIO()
+        embedded.write_config(make_state(), b1)
+        templated.write_config(make_state(), b2)
+        assert meaningful_lines(b1.getvalue()) == \
+            meaningful_lines(b2.getvalue())
+
+    def test_custom_template_rendered(self, tmp_path):
+        """An operator's own template: only their shape, reference
+        FuncMap available."""
+        tf = tmp_path / "mine.cfg"
+        tf.write_text(
+            "{{ range $name, $svcs := .Services }}"
+            "{{ range $port, $int := getPorts $name }}"
+            "listen {{ sanitizeName $name }} {{ bindIP }}:{{ $port }}\n"
+            "{{ range $svc := $svcs }}"
+            "  server {{ $svc.Hostname }} "
+            "{{ ipFor $port $svc }}:{{ portFor $port $svc }}\n"
+            "{{ end }}{{ end }}{{ end }}")
+        proxy = HAProxy(bind_ip="0.0.0.0", template_file=str(tf))
+        buf = io.StringIO()
+        proxy.write_config(make_state(), buf)
+        cfg = buf.getvalue()
+        assert "listen web 0.0.0.0:8080" in cfg
+        assert "listen raw-tcp 0.0.0.0:9000" in cfg
+        assert "server h1 10.0.0.1:32768" in cfg
+        assert "server h2 10.0.0.2:32769" in cfg
+        assert "dead" not in cfg
+
+    def test_missing_template_fails_loudly(self, tmp_path):
+        proxy = HAProxy(template_file=str(tmp_path / "nope.cfg"))
+        with pytest.raises(OSError):
+            proxy.write_config(make_state(), io.StringIO())
+
+    def test_missing_map_key_is_go_zero_value(self):
+        """Go text/template yields the zero value for a missing map key
+        (templates probe optional keys with `if`); only missing struct
+        fields are errors."""
+        out = render("{{ if .M.nope }}yes{{ end }}ok", {"M": {}}, {})
+        assert out == "ok"
+
+    def test_failed_render_does_not_truncate_live_config(self, tmp_path):
+        """write_and_reload must render BEFORE opening the config file:
+        a template failure mid-write would otherwise leave an empty
+        config for the next out-of-band haproxy restart."""
+        cfg = tmp_path / "haproxy.cfg"
+        cfg.write_text("# previous good config\n")
+        proxy = HAProxy(config_file=str(cfg),
+                        template_file=str(tmp_path / "gone.cfg"),
+                        verify_cmd="true", reload_cmd="true")
+        with pytest.raises(OSError):
+            proxy.write_and_reload(make_state())
+        assert cfg.read_text() == "# previous good config\n"
