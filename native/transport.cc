@@ -32,6 +32,7 @@
 //   ACK_FWD  [seq u32][target name str8]
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -1062,16 +1063,40 @@ class Transport {
     send_state_frame(fd);
   }
 
+  // Resolve a seed given by hostname or dotted quad to an IPv4 address.
+  // Seeds are normally names under compose/Kubernetes; the reference gets
+  // name-based joining for free from memberlist's Join (which resolves
+  // each seed, main.go:264) — here getaddrinfo fills the same role.
+  static bool resolve_ipv4(const std::string& host, in_addr* out) {
+    in_addr direct{};
+    if (inet_aton(host.c_str(), &direct)) {  // fast path: already an IP
+      *out = direct;
+      return true;
+    }
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
+      return false;
+    *out = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+    return true;
+  }
+
   bool pushpull_with(const std::string& host, uint16_t port) {
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (!resolve_ipv4(host, &addr.sin_addr)) {
+      logf('W', "cannot resolve seed host " + host);
+      return false;
+    }
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     if (fd < 0) return false;
     timeval tv{5, 0};
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
     setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-    sockaddr_in addr{};
-    addr.sin_family = AF_INET;
-    addr.sin_port = htons(port);
-    addr.sin_addr.s_addr = inet_addr(host.c_str());
     if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
       close(fd);
       logf('W', "push-pull connect to " + host + " failed");

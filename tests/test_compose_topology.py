@@ -1,0 +1,92 @@
+"""The docker-compose demo topology, in-process: three full nodes where
+two join the first by HOSTNAME seed (``localhost:<port>``, standing in
+for compose-DNS ``sidecar-seed:7946``), exactly the `SIDECAR_SEEDS` flow
+of docker-compose.yml.  All three must reach 3 cluster members with
+every static service Alive, observed through the real HTTP API — the
+claim the compose quick start makes (README.md "docker compose up").
+
+Regression context: round 4 shipped with an engine that resolved seeds
+via inet_addr() only, so this exact topology silently failed to form a
+cluster.  This test pins the whole chain: config seeds list → transport
+start() seed parsing → native getaddrinfo resolution → join push-pull →
+convergence → HTTP API view.
+"""
+
+import json
+import urllib.request
+
+from sidecar_tpu import service as S
+from sidecar_tpu.main import SidecarNode
+from sidecar_tpu.transport import GossipTransport
+
+from tests.test_node import make_config, wait_for
+
+
+def make_compose_node(name, seeds):
+    cfg = make_config()
+    cfg.sidecar.cluster_name = "demo"
+    cfg.sidecar.seeds = list(seeds)
+    transport = GossipTransport(
+        node_name=name, cluster_name="demo", bind_ip="127.0.0.1",
+        bind_port=0, advertise_ip="127.0.0.1",
+        gossip_interval=0.05, push_pull_interval=1.0)
+    return SidecarNode(config=cfg, hostname=name, transport=transport)
+
+
+def get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+        return json.loads(resp.read())
+
+
+class TestComposeTopology:
+    def test_three_nodes_seeded_by_hostname_converge(self):
+        seed = make_compose_node("sidecar-seed", seeds=[])
+        nodes = [seed]
+        try:
+            seed.start(http_port=0)
+            seed_port = seed.transport.bind_port
+            # sidecar-2 / sidecar-3 get SIDECAR_SEEDS=<hostname>:<port>,
+            # as the compose file writes it — NOT a dotted quad.
+            for name in ("sidecar-2", "sidecar-3"):
+                node = make_compose_node(
+                    name, seeds=[f"localhost:{seed_port}"])
+                node.start(http_port=0)
+                nodes.append(node)
+
+            http_ports = [n._http_server.server_address[1] for n in nodes]
+
+            def converged():
+                for port in http_ports:
+                    try:
+                        doc = get_json(port, "/api/services.json")
+                    except OSError:
+                        return False
+                    members = doc.get("ClusterMembers") or {}
+                    if set(members) != {"sidecar-seed", "sidecar-2",
+                                        "sidecar-3"}:
+                        return False
+                    # Each static fixture service appears once per node
+                    # and every instance reports Alive.
+                    svcs = doc.get("Services") or {}
+                    for svc_name in ("static-web", "static-tcp"):
+                        instances = svcs.get(svc_name) or []
+                        if len(instances) != 3:
+                            return False
+                        if any(inst["Status"] != S.ALIVE
+                               for inst in instances):
+                            return False
+                return True
+
+            if not wait_for(converged, timeout=30.0):
+                views = []
+                for p in http_ports:
+                    try:
+                        views.append(get_json(p, "/api/services.json")
+                                     .get("ClusterMembers"))
+                    except OSError as exc:
+                        views.append(f"unreachable: {exc}")
+                raise AssertionError(f"did not converge: {views}")
+        finally:
+            for node in nodes:
+                node.stop()

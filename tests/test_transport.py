@@ -105,6 +105,28 @@ class TestTwoNodeGossip:
             ta.stop()
             tb.stop()
 
+    def test_join_by_hostname_seed(self):
+        """Seeds are usually DNS names under compose/Kubernetes.  The
+        reference resolves them inside memberlist's Join (main.go:264);
+        our engine resolves with getaddrinfo (transport.cc resolve_ipv4).
+        Regression: round-4 engine did inet_addr() only, so the shipped
+        compose demo (SIDECAR_SEEDS: sidecar-seed:7946) never formed a
+        cluster."""
+        state_a, ta = make_node("dns-a")
+        state_b, tb = make_node("dns-b")
+        try:
+            port_a = ta.start(state_a)
+            tb.start(state_b)
+            tb.join("localhost", port_a)  # hostname, not dotted quad
+            assert wait_for(lambda: "dns-a" in tb.members() and
+                            "dns-b" in ta.members())
+            # An unresolvable seed fails cleanly, not silently.
+            with pytest.raises(OSError):
+                tb.join("no-such-host.invalid", port_a)
+        finally:
+            ta.stop()
+            tb.stop()
+
     def test_three_node_relay(self):
         """A record born on A reaches C which never talks to A directly —
         epidemic relay through B (retransmit, services_state.go:377-392)."""
