@@ -1,0 +1,144 @@
+"""Round 2 of hot-path experiments (int32-only; see hotpath_variants.py
+for the harness rationale).  Questions:
+
+* pub_approx  — does TPU-native ``lax.approx_max_k`` beat exact top_k
+               for the publish threshold?  (We only need the B-th
+               largest VALUE per row, not indices.)
+* g3x1row    — three [N]-row gathers vs one [N,3] row gather.
+* g_fused    — gather feeding straight into an F-axis max (no ps, no
+               merge): the lower bound if XLA fuses the reduce into
+               the gather consumer instead of materializing [N,F,K].
+* g_half     — val-only gather (no slot gather): what the ps gather
+               costs on top.
+
+Run: python benchmarks/hotpath_variants2.py
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+K = 256
+F = 3
+BUDGET = 15
+N = 100_000
+
+
+def make_inputs(seed=0):
+    rng = np.random.default_rng(seed)
+    occ = rng.random((N, K)) < 0.15
+    val = np.where(occ, rng.integers(1 << 6, 1 << 24, (N, K)), 0) \
+        .astype(np.int32)
+    slot = np.where(occ, rng.integers(0, N * 10, (N, K)), -1) \
+        .astype(np.int32)
+    return jnp.asarray(val), jnp.asarray(slot)
+
+
+def timed_scan(body, carry, iters=60, reps=3):
+    @jax.jit
+    def run(c):
+        return lax.scan(body, c, jnp.arange(iters, dtype=jnp.int32))[0]
+
+    out = run(carry)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(carry)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return best / iters * 1000.0
+
+
+def main():
+    val, slot = make_inputs()
+    key0 = jax.random.PRNGKey(1)
+    results = {}
+
+    # -- publish threshold: exact top_k vs approx_max_k ---------------------
+    def mk_thresh(kind):
+        def body(carry, i):
+            acc, v = carry
+            pv = v ^ (i & 1)
+            if kind == "exact":
+                top = lax.top_k(pv, BUDGET)[0]
+            else:
+                top = lax.approx_max_k(pv.astype(jnp.float32), BUDGET,
+                                       recall_target=0.95)[0] \
+                    .astype(jnp.int32)
+            thresh = top[:, -1:]
+            sel = jnp.where(pv >= thresh, pv, 0)
+            return (acc + jnp.sum(sel), v), None
+        return body
+
+    results["thresh_topk"] = round(
+        timed_scan(mk_thresh("exact"), (jnp.zeros((), jnp.int32), val)), 3)
+    print(json.dumps(results), flush=True)
+    results["thresh_approx"] = round(
+        timed_scan(mk_thresh("approx"), (jnp.zeros((), jnp.int32), val)),
+        3)
+    print(json.dumps(results), flush=True)
+
+    # approx quality at this shape: how far off is the returned B-th
+    # value, and how many rows get it exactly right?
+    exact_t = lax.top_k(val, BUDGET)[0][:, -1]
+    approx_t = lax.approx_max_k(val.astype(jnp.float32), BUDGET,
+                                recall_target=0.95)[0][:, -1] \
+        .astype(jnp.int32)
+    results["approx_rows_exact_pct"] = round(float(
+        jnp.mean((exact_t == approx_t).astype(jnp.float32))) * 100, 2)
+    print(json.dumps(results), flush=True)
+
+    # -- gather forms -------------------------------------------------------
+    def g_rows(carry, i):            # one [N, F] row gather, both arrays
+        acc, k = carry
+        k, sub = jax.random.split(k)
+        src = jax.random.randint(sub, (N, F), 0, N, dtype=jnp.int32)
+        pv = val[src]
+        ps = slot[src]
+        return (acc + jnp.sum(pv) + jnp.sum(ps), k), None
+
+    def g3x1row(carry, i):           # three [N] row gathers, both arrays
+        acc, k = carry
+        k, sub = jax.random.split(k)
+        src = jax.random.randint(sub, (N, F), 0, N, dtype=jnp.int32)
+        acc2 = acc
+        for f in range(F):
+            acc2 = acc2 + jnp.sum(val[src[:, f]]) \
+                + jnp.sum(slot[src[:, f]])
+        return (acc2, k), None
+
+    def g_fused(carry, i):           # gather → F-axis max, no slot
+        acc, k = carry
+        k, sub = jax.random.split(k)
+        src = jax.random.randint(sub, (N, F), 0, N, dtype=jnp.int32)
+        wv = jnp.max(val[src], axis=1)           # [N, K]
+        return (acc + jnp.sum(wv), k), None
+
+    def g_half(carry, i):            # val-only gather
+        acc, k = carry
+        k, sub = jax.random.split(k)
+        src = jax.random.randint(sub, (N, F), 0, N, dtype=jnp.int32)
+        pv = val[src]
+        return (acc + jnp.sum(pv), k), None
+
+    for name, fn in [("g_rows", g_rows), ("g3x1row", g3x1row),
+                     ("g_fused", g_fused), ("g_half", g_half)]:
+        results[name] = round(
+            timed_scan(fn, (jnp.zeros((), jnp.int32), key0)), 3)
+        print(json.dumps(results), flush=True)
+
+    print("FINAL " + json.dumps(
+        {"n": N, "platform": jax.devices()[0].platform, **results}))
+
+
+if __name__ == "__main__":
+    main()

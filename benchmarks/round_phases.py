@@ -1,0 +1,153 @@
+"""Per-phase cost of the compressed-model round at north-star scale.
+
+The faithful north-star run is per-round-cost bound (BENCH_r04: 525
+rounds x ~43 ms = 22.5 s vs the <10 s target), so optimization has to be
+guided by where the milliseconds actually are.  This script times the
+round's phases CUMULATIVELY — scan variants that add one phase at a
+time — so each phase's cost is the successive difference, measured the
+only way this tunneled chip measures reliably (inside one lax.scan
+dispatch, warmed at the same scan length, synced with device_get; see
+the measurement notes in benchmarks/scatter_costs.py).
+
+Usage:  python benchmarks/round_phases.py [--n 100000] [--rounds 60]
+
+Prints one JSON object with ms/round per cumulative variant and the
+derived per-phase deltas.
+"""
+
+import argparse
+import dataclasses
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops.topology import erdos_renyi
+
+PHASE_ORDER = ["base", "publish", "gather", "merge", "announce",
+               "push_pull", "sweep"]
+
+
+class PhasedSim(CompressedSim):
+    """CompressedSim with the round truncated after a chosen phase.
+
+    Phases not yet enabled are skipped; the last enabled partial phase
+    folds a cheap checksum into ``evictions`` so XLA cannot dead-code
+    the work under test."""
+
+    def __init__(self, *args, upto: str, **kw):
+        super().__init__(*args, **kw)
+        if upto not in PHASE_ORDER:
+            raise ValueError(f"unknown phase {upto}")
+        self._upto = PHASE_ORDER.index(upto)
+
+    def _on(self, phase: str) -> bool:
+        return self._upto >= PHASE_ORDER.index(phase)
+
+    def _step(self, state, key):
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        if self._on("publish"):
+            bval, bslot, sent = self._publish(state, limit)
+            if not self._on("gather"):
+                state = dataclasses.replace(
+                    state, evictions=state.evictions + jnp.sum(bval)
+                    + jnp.sum(sent.astype(jnp.int32)))
+        if self._on("gather"):
+            src = gossip_ops.sample_peers(
+                k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
+                node_alive=state.node_alive, cut_mask=self._cut)
+            pv = bval[src]
+            ps = bslot[src]
+            ok = state.node_alive[src] & state.node_alive[:, None]
+            if not self._on("merge"):
+                state = dataclasses.replace(
+                    state, evictions=state.evictions + jnp.sum(pv)
+                    + jnp.sum(ps) + jnp.sum(sent.astype(jnp.int32))
+                    + jnp.sum(ok.astype(jnp.int32)))
+        if self._on("merge"):
+            state = self._merge_pulled(state, sent, pv, ps, ok, now,
+                                       drop_key=k_drop)
+        if self._on("announce"):
+            state = self._announce(state, round_idx, now)
+        if self._on("push_pull"):
+            state = lax.cond(
+                round_idx % t.push_pull_rounds == 0,
+                lambda st: self._push_pull_stride(st, k_pp, now),
+                lambda st: st, state)
+        if self._on("sweep"):
+            state = lax.cond(
+                round_idx % t.sweep_rounds == 0,
+                lambda st: self._floor_advance_and_sweep(st, now),
+                lambda st: st, state)
+        return dataclasses.replace(state, round_idx=round_idx)
+
+
+def time_variant(sim, state, key, rounds, reps=3):
+    # Warm at the same scan length (scan length is a static argnum —
+    # timing a different length times a fresh compile).
+    out = sim.run_fast(state, key, rounds)
+    jax.device_get(out.round_idx)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = sim.run_fast(state, key, rounds)
+        jax.device_get(out.round_idx)
+        best = min(best, time.perf_counter() - t0)
+    return best / rounds * 1000.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=100_000)
+    ap.add_argument("--rounds", type=int, default=60)
+    ap.add_argument("--upto", default=None,
+                    help="time only this cumulative variant")
+    opts = ap.parse_args()
+
+    params = CompressedParams(n=opts.n, services_per_node=10, fanout=3,
+                              budget=15, cache_lines=256,
+                              fold_quorum=1.0, deep_sweep_every=0)
+    topo = erdos_renyi(opts.n, avg_degree=8.0, seed=3)
+    cfg = TimeConfig(refresh_interval_s=10_000.0)  # faithful constants
+    rng = np.random.default_rng(7)
+    slots = np.sort(rng.choice(params.m, size=params.m // 1000,
+                               replace=False)).astype(np.int32)
+    key = jax.random.PRNGKey(0)
+
+    names = [opts.upto] if opts.upto else PHASE_ORDER
+    results = {}
+    for upto in names:
+        sim = PhasedSim(params, topo, cfg, upto=upto)
+        state = sim.mint(sim.init_state(), slots, 10)
+        results[upto] = round(
+            time_variant(sim, state, key, opts.rounds), 3)
+
+    deltas = {}
+    for a, b in zip(PHASE_ORDER, PHASE_ORDER[1:]):
+        if a in results and b in results:
+            deltas[b] = round(results[b] - results[a], 3)
+    print(json.dumps({
+        "n": opts.n, "rounds_per_scan": opts.rounds,
+        "platform": jax.devices()[0].platform,
+        "cumulative_ms_per_round": results,
+        "phase_delta_ms": deltas,
+    }))
+
+
+if __name__ == "__main__":
+    main()

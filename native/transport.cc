@@ -32,8 +32,10 @@
 //   ACK_FWD  [seq u32][target name str8]
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -271,11 +273,13 @@ class Transport {
       queue_membership_locked(kMemberAlive, incarnation_, name_,
                               advertise_ip_, bind_port_);
     }
-    threads_.emplace_back(&Transport::udp_loop, this);
-    threads_.emplace_back(&Transport::gossip_loop, this);
-    threads_.emplace_back(&Transport::probe_loop, this);
-    threads_.emplace_back(&Transport::tcp_accept_loop, this);
-    threads_.emplace_back(&Transport::pushpull_loop, this);
+    // Thread model: ONE poll()-multiplexed IO+timer thread (the "few
+    // execution threads" budget the reference advertises, its
+    // README:54-56); push-pull exchanges — blocking TCP — run as
+    // tracked transient handler threads.
+    fcntl(udp_fd_, F_SETFL, O_NONBLOCK);
+    fcntl(tcp_fd_, F_SETFL, O_NONBLOCK);
+    threads_.emplace_back(&Transport::io_loop, this);
     return bind_port_;
   }
 
@@ -677,14 +681,15 @@ class Transport {
 
   // -- IO loops -----------------------------------------------------------
 
-  void udp_loop() {
-    std::vector<uint8_t> buf(65536);
-    while (!quit_) {
+  // Drain every datagram queued on the (non-blocking) UDP socket.
+  void handle_udp_ready() {
+    std::vector<uint8_t>& buf = udp_buf_;
+    for (;;) {
       sockaddr_in src{};
       socklen_t slen = sizeof(src);
       ssize_t n = recvfrom(udp_fd_, buf.data(), buf.size(), 0,
                            reinterpret_cast<sockaddr*>(&src), &slen);
-      if (n <= 0) continue;
+      if (n <= 0) return;
       udp_in_.fetch_add(1, std::memory_order_relaxed);
       udp_bytes_in_.fetch_add(n, std::memory_order_relaxed);
       const uint8_t* p = buf.data();
@@ -822,130 +827,181 @@ class Transport {
     }
   }
 
-  void gossip_loop() {
+  void gossip_once() {
+    // Building a packet consumes transmit counts — don't burn queued
+    // broadcasts (e.g. our own join announcement) into the void while
+    // the member list is still empty.
+    auto targets = pick_members(gossip_nodes_);
+    if (targets.empty()) return;
+    std::string pkt = build_gossip_packet();
+    if (pkt.empty()) return;
+    for (auto& m : targets) send_to(m.ip, m.port, pkt);
+  }
+
+  // ONE thread drives the whole engine: poll() multiplexes the UDP
+  // socket and the TCP accept socket, and the poll timeout doubles as
+  // the timer tick for every periodic duty (gossip sends, SWIM probe
+  // cycle, anti-entropy dispatch).  The tick bounds added timer jitter
+  // at +20 ms per cadence (test tunings run 50-100 ms intervals;
+  // production runs 200 ms+).  Only push-pull dials and inbound
+  // push-pull exchanges — blocking TCP with 5 s timeouts — leave this
+  // thread, as tracked transient handlers.
+  void io_loop() {
+    constexpr int kTick = 20;
+    auto last_gossip = Clock::now();
+    auto last_probe = last_gossip;
+    auto last_pp = last_gossip;
     while (!quit_) {
-      std::this_thread::sleep_for(Millis(gossip_ms_));
-      // Building a packet consumes transmit counts — don't burn queued
-      // broadcasts (e.g. our own join announcement) into the void while
-      // the member list is still empty.
-      auto targets = pick_members(gossip_nodes_);
-      if (targets.empty()) continue;
-      std::string pkt = build_gossip_packet();
-      if (pkt.empty()) continue;
-      for (auto& m : targets) send_to(m.ip, m.port, pkt);
+      pollfd fds[2] = {{udp_fd_, POLLIN, 0}, {tcp_fd_, POLLIN, 0}};
+      ::poll(fds, 2, kTick);
+      if (quit_) return;
+      if (fds[0].revents & POLLIN) handle_udp_ready();
+      if (fds[1].revents & POLLIN) handle_tcp_ready();
+      auto now = Clock::now();
+      if (now - last_gossip >= Millis(gossip_ms_)) {
+        last_gossip = now;
+        gossip_once();
+      }
+      if (now - last_probe >= Millis(probe_interval_ms_)) {
+        last_probe = now;
+        probe_once();
+      }
+      if (now - last_pp >= Millis(pushpull_ms_)) {
+        last_pp = now;
+        // Periodic anti-entropy with one random member
+        // (PushPullInterval, main.go:252-256), dispatched onto a
+        // tracked transient thread: pushpull_with can block up to 5 s
+        // on a dead peer and must not stall probes/gossip.  At most
+        // ONE periodic exchange in flight (the old loop's serialization
+        // — a dead peer at fast test cadences would otherwise pile up
+        // a dialer thread per tick).
+        auto targets = pick_members(1);
+        if (!targets.empty() && !pp_inflight_->load()) {
+          pp_inflight_->store(true);
+          auto done = std::make_shared<std::atomic<bool>>(false);
+          auto inflight = pp_inflight_;
+          std::string ip = targets[0].ip;
+          uint16_t port = targets[0].port;
+          std::thread t([this, ip, port, done, inflight] {
+            pushpull_with(ip, port);
+            inflight->store(false);
+            done->store(true);
+          });
+          std::lock_guard<std::mutex> lk(handlers_mu_);
+          handlers_.push_back({std::move(t), std::move(done), -1});
+        }
+        reap_handlers(/*join_all=*/false);
+      }
     }
   }
 
   // The SWIM probe cycle: direct ping → (timeout) → indirect ping-req
   // through up to k proxies → (timeout) → suspect + broadcast →
   // (suspect timeout without refutation) → dead + broadcast.
-  void probe_loop() {
-    while (!quit_) {
-      std::this_thread::sleep_for(Millis(probe_interval_ms_));
-      auto now = Clock::now();
-      std::vector<UdpSend> sends;
-      std::vector<std::pair<std::string, Member>> need_indirect;
+  void probe_once() {
+    auto now = Clock::now();
+    std::vector<UdpSend> sends;
+    std::vector<std::pair<std::string, Member>> need_indirect;
 
-      {
-        std::lock_guard<std::mutex> lk(mu_);
-        // Expire stale proxy bookkeeping.
-        for (auto it = forwards_.begin(); it != forwards_.end();)
-          it = (now > it->second.expires) ? forwards_.erase(it) : ++it;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      // Expire stale proxy bookkeeping.
+      for (auto it = forwards_.begin(); it != forwards_.end();)
+        it = (now > it->second.expires) ? forwards_.erase(it) : ++it;
 
-        for (auto it = pending_.begin(); it != pending_.end();) {
-          PendingProbe& pr = it->second;
-          auto mit = members_.find(pr.target);
-          if (mit == members_.end()) {
-            it = pending_.erase(it);
-            continue;
-          }
-          if (!pr.indirect_sent && now > pr.direct_deadline) {
-            pr.indirect_sent = true;
-            pr.indirect_deadline = now + Millis(probe_timeout_ms_);
-            need_indirect.push_back({pr.target, mit->second});
-            ++it;
-          } else if (pr.indirect_sent && now > pr.indirect_deadline) {
-            // No direct or relayed ack: suspicion.
-            Member& m = mit->second;
-            if (!m.suspect) {
-              m.suspect = true;
-              m.suspect_since = now;
-              queue_membership_locked(kMemberSuspect, m.incarnation,
-                                      m.name, m.ip, m.port);
-              logf('I', "suspecting " + m.name +
-                            " (no ack, direct or indirect)");
-            }
-            it = pending_.erase(it);
-          } else {
-            ++it;
-          }
+      for (auto it = pending_.begin(); it != pending_.end();) {
+        PendingProbe& pr = it->second;
+        auto mit = members_.find(pr.target);
+        if (mit == members_.end()) {
+          it = pending_.erase(it);
+          continue;
         }
-
-        // Suspect → dead after the (refutable) suspicion window.
-        std::vector<std::string> dead;
-        for (auto it = members_.begin(); it != members_.end();) {
-          Member& m = it->second;
-          if (m.suspect &&
-              std::chrono::duration_cast<Millis>(now - m.suspect_since)
-                      .count() > suspect_timeout_ms_) {
-            dead.push_back(m.name);
-            mark_dead_locked(m.name, m.incarnation);
-            queue_membership_locked(kMemberDead, m.incarnation, m.name,
-                                    m.ip, m.port);
-            it = members_.erase(it);
-            continue;
+        if (!pr.indirect_sent && now > pr.direct_deadline) {
+          pr.indirect_sent = true;
+          pr.indirect_deadline = now + Millis(probe_timeout_ms_);
+          need_indirect.push_back({pr.target, mit->second});
+          ++it;
+        } else if (pr.indirect_sent && now > pr.indirect_deadline) {
+          // No direct or relayed ack: suspicion.
+          Member& m = mit->second;
+          if (!m.suspect) {
+            m.suspect = true;
+            m.suspect_since = now;
+            queue_membership_locked(kMemberSuspect, m.incarnation,
+                                    m.name, m.ip, m.port);
+            logf('I', "suspecting " + m.name +
+                          " (no ack, direct or indirect)");
           }
+          it = pending_.erase(it);
+        } else {
           ++it;
         }
-        for (auto& d : dead) {
-          events_.push_back("leave " + d);
-          logf('I', d + " failed (suspect timeout); declared dead");
-        }
       }
 
-      // Fire the queued indirect probes (pick proxies outside the probe
-      // bookkeeping pass; sends happen outside the lock).
-      for (auto& [tname, target] : need_indirect) {
-        uint32_t origin_seq = 0;
-        {
-          std::lock_guard<std::mutex> lk(mu_);
-          for (auto& kv : pending_)
-            if (kv.second.target == tname) origin_seq = kv.first;
+      // Suspect → dead after the (refutable) suspicion window.
+      std::vector<std::string> dead;
+      for (auto it = members_.begin(); it != members_.end();) {
+        Member& m = it->second;
+        if (m.suspect &&
+            std::chrono::duration_cast<Millis>(now - m.suspect_since)
+                    .count() > suspect_timeout_ms_) {
+          dead.push_back(m.name);
+          mark_dead_locked(m.name, m.incarnation);
+          queue_membership_locked(kMemberDead, m.incarnation, m.name,
+                                  m.ip, m.port);
+          it = members_.erase(it);
+          continue;
         }
-        for (auto& proxy : pick_members(indirect_k_, tname)) {
-          std::string req = packet_header(kTypePingReq);
-          put_u32(&req, origin_seq);
-          put_str8(&req, target.name);
-          put_str8(&req, target.ip);
-          put_u16(&req, target.port);
-          sends.push_back({proxy.ip, proxy.port, std::move(req)});
-        }
+        ++it;
       }
-
-      // Start a fresh direct probe of one random member — unless that
-      // member already has a probe in flight (overlapping probes of one
-      // target confuse the rescue bookkeeping and double suspicion).
-      auto targets = pick_members(1);
-      if (!targets.empty()) {
-        bool already = false;
-        uint32_t seq = next_seq_++;
-        {
-          std::lock_guard<std::mutex> lk(mu_);
-          for (auto& kv : pending_)
-            if (kv.second.target == targets[0].name) already = true;
-          if (!already)
-            pending_[seq] = {targets[0].name,
-                             now + Millis(probe_timeout_ms_), false, {}};
-        }
-        if (!already) {
-          std::string ping = packet_header(kTypePing);
-          put_u32(&ping, seq);
-          sends.push_back(
-              {targets[0].ip, targets[0].port, std::move(ping)});
-        }
+      for (auto& d : dead) {
+        events_.push_back("leave " + d);
+        logf('I', d + " failed (suspect timeout); declared dead");
       }
-      for (auto& s : sends) send_to(s.ip, s.port, s.pkt);
     }
+
+    // Fire the queued indirect probes (pick proxies outside the probe
+    // bookkeeping pass; sends happen outside the lock).
+    for (auto& [tname, target] : need_indirect) {
+      uint32_t origin_seq = 0;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        for (auto& kv : pending_)
+          if (kv.second.target == tname) origin_seq = kv.first;
+      }
+      for (auto& proxy : pick_members(indirect_k_, tname)) {
+        std::string req = packet_header(kTypePingReq);
+        put_u32(&req, origin_seq);
+        put_str8(&req, target.name);
+        put_str8(&req, target.ip);
+        put_u16(&req, target.port);
+        sends.push_back({proxy.ip, proxy.port, std::move(req)});
+      }
+    }
+
+    // Start a fresh direct probe of one random member — unless that
+    // member already has a probe in flight (overlapping probes of one
+    // target confuse the rescue bookkeeping and double suspicion).
+    auto targets = pick_members(1);
+    if (!targets.empty()) {
+      bool already = false;
+      uint32_t seq = next_seq_++;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        for (auto& kv : pending_)
+          if (kv.second.target == targets[0].name) already = true;
+        if (!already)
+          pending_[seq] = {targets[0].name,
+                           now + Millis(probe_timeout_ms_), false, {}};
+      }
+      if (!already) {
+        std::string ping = packet_header(kTypePing);
+        put_u32(&ping, seq);
+        sends.push_back(
+            {targets[0].ip, targets[0].port, std::move(ping)});
+      }
+    }
+    for (auto& s : sends) send_to(s.ip, s.port, s.pkt);
   }
 
   // -- TCP push-pull ------------------------------------------------------
@@ -973,13 +1029,14 @@ class Transport {
       if (t.joinable()) t.join();
   }
 
-  void tcp_accept_loop() {
-    while (!quit_) {
+  // Accept every pending connection on the (non-blocking) TCP socket.
+  void handle_tcp_ready() {
+    for (;;) {
       sockaddr_in src{};
       socklen_t slen = sizeof(src);
       int fd = accept(tcp_fd_, reinterpret_cast<sockaddr*>(&src), &slen);
       reap_handlers(/*join_all=*/false);
-      if (fd < 0) continue;
+      if (fd < 0) return;
       // Bound the handler's lifetime: a peer that stalls mid-exchange
       // times out instead of pinning the thread (and stop()'s join).
       timeval tv{5, 0};
@@ -1109,21 +1166,6 @@ class Transport {
     return ok;
   }
 
-  void pushpull_loop() {
-    // Periodic anti-entropy with one random member
-    // (PushPullInterval, main.go:252-256).
-    int elapsed = 0;
-    while (!quit_) {
-      std::this_thread::sleep_for(Millis(250));
-      elapsed += 250;
-      if (elapsed < pushpull_ms_) continue;
-      elapsed = 0;
-      auto targets = pick_members(1);
-      if (!targets.empty())
-        pushpull_with(targets[0].ip, targets[0].port);
-    }
-  }
-
   std::string name_, cluster_, bind_ip_, advertise_ip_;
   uint16_t bind_port_;
   int gossip_ms_, pushpull_ms_, gossip_nodes_, gossip_messages_;
@@ -1137,6 +1179,9 @@ class Transport {
   std::atomic<unsigned long long> udp_out_{0}, udp_bytes_out_{0},
       udp_in_{0}, udp_bytes_in_{0}, pushpull_out_{0}, pushpull_in_{0};
   std::vector<std::thread> threads_;
+  std::vector<uint8_t> udp_buf_ = std::vector<uint8_t>(65536);
+  std::shared_ptr<std::atomic<bool>> pp_inflight_ =
+      std::make_shared<std::atomic<bool>>(false);
   std::mutex mu_;
   std::map<std::string, Member> members_;
   std::deque<Broadcast> queue_;    // user payloads

@@ -374,11 +374,10 @@ class ServicesState:
         return found is None or (not svc.is_tombstone()
                                  and svc.status != found.status)
 
-    def broadcast_services(self, fn: Callable[[], list[Service]],
-                           looper: Looper) -> None:
-        """Announce local services: new ⇒ ALIVE_COUNT× @ 1 Hz, else
-        re-announce on the 1-minute refresh window
-        (services_state.go:525-574)."""
+    def broadcast_services_step(
+            self, fn: Callable[[], list[Service]]) -> Callable[[], None]:
+        """One tick of :meth:`broadcast_services` — exposed so the node
+        scheduler can drive it without a dedicated thread."""
         last_time = 0
 
         def one() -> None:
@@ -404,7 +403,14 @@ class ServicesState:
             else:
                 self.broadcasts.put(None)
 
-        looper.loop(one)
+        return one
+
+    def broadcast_services(self, fn: Callable[[], list[Service]],
+                           looper: Looper) -> None:
+        """Announce local services: new ⇒ ALIVE_COUNT× @ 1 Hz, else
+        re-announce on the 1-minute refresh window
+        (services_state.go:525-574)."""
+        looper.loop(self.broadcast_services_step(fn))
 
     def send_services(self, services: list[Service], looper: Looper,
                       background: bool = True) -> Optional[threading.Thread]:
@@ -443,6 +449,11 @@ class ServicesState:
                              looper: Looper) -> None:
         """Tombstone vanished local services + expire remote state
         (services_state.go:606-633)."""
+        looper.loop(self.broadcast_tombstones_step(fn))
+
+    def broadcast_tombstones_step(
+            self, fn: Callable[[], list[Service]]) -> Callable[[], None]:
+        """One tick of :meth:`broadcast_tombstones` (scheduler form)."""
         def one() -> None:
             with self._lock:
                 container_list = fn()
@@ -456,7 +467,7 @@ class ServicesState:
             else:
                 self.broadcasts.put(None)
 
-        looper.loop(one)
+        return one
 
     def tombstone_others_services(self) -> list[Service]:
         """Lifespan sweep over the whole view: GC 3h-old tombstones, and
@@ -515,15 +526,25 @@ class ServicesState:
     def track_new_services(self, fn: Callable[[], list[Service]],
                            looper: Looper) -> None:
         """services_state.go:444-452."""
+        looper.loop(self.track_new_services_step(fn))
+
+    def track_new_services_step(
+            self, fn: Callable[[], list[Service]]) -> Callable[[], None]:
+        """One tick of :meth:`track_new_services` (scheduler form)."""
         def one() -> None:
             for svc in fn():
                 self.update_service(svc)
-        looper.loop(one)
+        return one
 
     def track_local_listeners(self, fn: Callable[[], list[Listener]],
                               looper: Looper) -> None:
         """Sync managed listeners with discovery
         (services_state.go:454-497)."""
+        looper.loop(self.track_local_listeners_step(fn))
+
+    def track_local_listeners_step(
+            self, fn: Callable[[], list[Listener]]) -> Callable[[], None]:
+        """One tick of :meth:`track_local_listeners` (scheduler form)."""
         def one() -> None:
             discovered = fn()
             names = {listener.name() for listener in discovered}
@@ -549,7 +570,7 @@ class ServicesState:
                         self.remove_listener(listener.name())
                     except KeyError as exc:
                         log.warning("Failed to remove listener: %s", exc)
-        looper.loop(one)
+        return one
 
     # -- iteration / views -------------------------------------------------
 
@@ -627,7 +648,16 @@ def decode(data: bytes | str) -> ServicesState:
 def decode_stream(stream, callback) -> None:
     """Newline-delimited JSON of by-service maps
     (services_state.go:766-772): calls ``callback(mapping, error)`` per
-    document."""
+    document.
+
+    Stop-on-first-error is DELIBERATE reference parity: the Go
+    DecodeStream returns on its first Decode error
+    (services_state.go:766-772), ending the stream.  The alternative
+    (skip the bad document and continue) would hide a desynced or
+    corrupted stream from a long-lived consumer; matching the
+    reference, the callback sees the error once and the reader stops —
+    reconnecting is the consumer's decision (the receiver library's
+    retry loop does exactly that)."""
     for line in stream:
         if not line.strip():
             continue

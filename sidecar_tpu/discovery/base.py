@@ -6,7 +6,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
-from sidecar_tpu.runtime.looper import FreeLooper, Looper, run_in_thread
+from sidecar_tpu.runtime.looper import Looper
 from sidecar_tpu.service import Service
 
 DEFAULT_SLEEP_INTERVAL = 1.0  # discovery.go:11
@@ -73,14 +73,18 @@ class MultiDiscovery(Discoverer):
             sub = TimedLooper(DEFAULT_SLEEP_INTERVAL)
             self._sub_loopers.append(sub)
             disco.run(sub)
+        # Propagate the controlling looper's quit to the plugins when
+        # the owner stops (discovery.go:86-102) — callback-based, so no
+        # idle watcher thread exists just to wait on an Event.  The
+        # controlling looper has no loop of its own anymore, so quit IS
+        # completion: mark it done so ``looper.wait()`` keeps its
+        # "block until finished" contract.
+        def on_quit() -> None:
+            self._stop_plugins()
+            looper._done.set()
 
-        # Idle on the controlling looper; when it quits, stop the plugins
-        # (discovery.go:86-102).
-        def watch() -> None:
-            looper.loop(lambda: None)
-            for sub in self._sub_loopers:
-                sub.quit()
+        looper.add_quit_callback(on_quit)
 
-        import threading
-        threading.Thread(target=watch, name="multi-discovery",
-                         daemon=True).start()
+    def _stop_plugins(self) -> None:
+        for sub in self._sub_loopers:
+            sub.quit()

@@ -14,6 +14,7 @@ import concurrent.futures
 import logging
 import re
 import threading
+import time
 from typing import Callable, Optional
 
 from sidecar_tpu.discovery.base import Discoverer
@@ -99,10 +100,14 @@ class Monitor:
         self.default_check_endpoint = default_check_endpoint
         self.discovery_fn: Optional[Callable[[], list[Service]]] = None
         self._lock = threading.RLock()
-        # One long-lived pool for the whole monitor; sized generously so a
-        # few hung checks can't starve the rest of a tick.
+        # One long-lived BOUNDED pool for the whole monitor (the "few
+        # execution threads" budget, reference README:54-56): checks are
+        # short IO waits, so 4 workers keep a tick concurrent while a
+        # hung check can stall at most one worker — wait() moves on at
+        # the tick timeout either way, cancelling queued-not-started
+        # checks (they score UNKNOWN/timeout that tick and retry next).
         self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=64, thread_name_prefix="health-check")
+            max_workers=4, thread_name_prefix="health-check")
 
     # -- check management --------------------------------------------------
 
@@ -189,6 +194,10 @@ class Monitor:
 
     def watch(self, disco: Discoverer, looper: Looper) -> None:
         """Sync the check set with discovery (service_bridge.go:146-187)."""
+        looper.loop(self.watch_step(disco))
+
+    def watch_step(self, disco: Discoverer) -> Callable[[], None]:
+        """One tick of :meth:`watch` (scheduler form)."""
         self.discovery_fn = disco.services
 
         def one() -> None:
@@ -209,18 +218,40 @@ class Monitor:
                     if cid not in live:
                         del self.checks[cid]
 
-        looper.loop(one)
+        return one
 
     def run(self, looper: Looper) -> None:
         """Run all checks concurrently each tick, per-check timeout
-        interval−1 ms (healthy.go:166-213)."""
+        interval−1 ms (healthy.go:166-213).
+
+        Bounded-pool fairness: the reference discards any result slower
+        than the tick (healthy.go:196-202), so a checker's own longer
+        IO timeout buys nothing — it only pins a pool worker past the
+        tick.  Each checker's timeout is therefore capped at the tick
+        (same observable status: UNKNOWN/timeout), and checks are
+        submitted fastest-history-first so a handful of hung endpoints
+        pin workers only AFTER every fast check has run — without the
+        ordering, the same 4 hung checks would grab all 4 workers every
+        tick and healthy services would flap to UNKNOWN."""
+        def timed_run(c: Check):
+            t0 = time.monotonic()
+            try:
+                return c.command.run(c.args)
+            finally:
+                c.last_duration = time.monotonic() - t0
+
         def one() -> None:
             with self._lock:
                 checks = list(self.checks.values())
             if not checks:
                 return
             timeout = max(self.check_interval - 0.001, 0.001)
-            futures = {self._pool.submit(c.command.run, c.args): c
+            for c in checks:
+                cmd_timeout = getattr(c.command, "timeout", None)
+                if cmd_timeout is not None and cmd_timeout > timeout:
+                    c.command.timeout = timeout
+            checks.sort(key=lambda c: getattr(c, "last_duration", 0.0))
+            futures = {self._pool.submit(timed_run, c): c
                        for c in checks}
             done, not_done = concurrent.futures.wait(
                 futures, timeout=timeout)

@@ -33,7 +33,8 @@ from sidecar_tpu.health import Monitor
 from sidecar_tpu.health.monitor import HEALTH_INTERVAL, WATCH_INTERVAL
 from sidecar_tpu.proxy.envoy import EnvoyApiV1, XdsServer
 from sidecar_tpu.proxy.haproxy import HAProxy
-from sidecar_tpu.runtime.looper import TimedLooper, run_in_thread
+from sidecar_tpu.runtime.looper import TimedLooper
+from sidecar_tpu.runtime.scheduler import Scheduler
 from sidecar_tpu.web import SidecarApi, serve_http
 
 log = logging.getLogger(__name__)
@@ -180,6 +181,7 @@ class SidecarNode:
             self.xds = XdsServer(self.state, self.config.envoy.bind_ip,
                                  self.config.envoy.use_hostnames)
         self._loopers: list[TimedLooper] = []
+        self._scheduler = Scheduler(name="node-scheduler")
         self._http_server = None
         self._xds_server = None
 
@@ -214,34 +216,41 @@ class SidecarNode:
         if self.transport is not None:
             self.transport.start(self.state, seeds=cfg.seeds)
 
-        # Discovery → health → catalog loops (main.go:318-385).
+        # Discovery → health → catalog loops (main.go:318-385), all
+        # driven by ONE scheduler thread (the reference multiplexes the
+        # same duties over goroutines; a thread per loop measured ~50
+        # threads/node in round 4).  Only genuinely blocking work keeps
+        # a dedicated thread: the state-writer queue drain above and the
+        # health-check tick (it waits up to interval−1 ms on its worker
+        # pool, which would starve sibling tasks).
         self.disco.run(self._looper(cfg.discovery_sleep_interval))
-        run_in_thread(self._looper(WATCH_INTERVAL),
-                      self._watch_once, name="monitor-watch")
-        self._monitor_watch_looper = self._loopers[-1]
+        sched = self._scheduler
+        watch_looper = self._looper(WATCH_INTERVAL)
+        sched.drive(watch_looper, self._watch_once, name="monitor-watch")
+        self._monitor_watch_looper = watch_looper
         monitor_run_looper = self._looper(HEALTH_INTERVAL)
         threading.Thread(target=self.monitor.run,
                          args=(monitor_run_looper,),
                          name="monitor-run", daemon=True).start()
 
-        threading.Thread(
-            target=self.state.broadcast_services,
-            args=(self.monitor.services, self._looper(1.0)),
-            name="broadcast-services", daemon=True).start()
-        threading.Thread(
-            target=self.state.broadcast_tombstones,
-            args=(self.monitor.services, self._looper(2.0)),
-            name="broadcast-tombstones", daemon=True).start()
+        sched.drive(self._looper(1.0),
+                    self.state.broadcast_services_step(
+                        self.monitor.services),
+                    name="broadcast-services")
+        sched.drive(self._looper(2.0),
+                    self.state.broadcast_tombstones_step(
+                        self.monitor.services),
+                    name="broadcast-tombstones")
         # Local services flow into the catalog via the single-writer queue
         # (state.TrackNewServices, main.go:382).
-        threading.Thread(
-            target=self.state.track_new_services,
-            args=(self.monitor.services, self._looper(1.0)),
-            name="track-services", daemon=True).start()
-        threading.Thread(
-            target=self.state.track_local_listeners,
-            args=(self._discovered_listeners, self._looper(5.0)),
-            name="track-listeners", daemon=True).start()
+        sched.drive(self._looper(1.0),
+                    self.state.track_new_services_step(
+                        self.monitor.services),
+                    name="track-services")
+        sched.drive(self._looper(5.0),
+                    self.state.track_local_listeners_step(
+                        self._discovered_listeners),
+                    name="track-listeners")
 
         # HTTP API (main.go:387-390).  Asset paths resolve against the
         # repo root (the sidecar_tpu package's parent) so the node works
@@ -305,6 +314,7 @@ class SidecarNode:
     def stop(self) -> None:
         for looper in self._loopers:
             looper.quit()
+        self._scheduler.stop()
         self.state.stop_processing()
         if self.transport is not None:
             self.transport.stop()

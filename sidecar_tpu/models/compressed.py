@@ -328,21 +328,25 @@ class CompressedSim:
         Budget selection is ``top_k``-exact but materialized as an
         elementwise mask: values strictly above the B-th largest are in;
         ties at the threshold fill the remaining slots in a PER-NODE
-        rotated line order (a cumsum rank over a rotated view).  The
-        rotation is load-bearing: a churn burst mints many records at
-        one tick — equal packed values on every node — and a fixed tie
-        order would make the whole cluster publish the SAME ``budget``
-        lines while the rest never spread (the cluster-aligned index
-        herd the dense model's select_messages also rotates away).  The
-        rotation is implemented as log2(K) conditional ``jnp.roll``
-        passes (arbitrary per-row gathers measure ~100× slower than
-        rolls on TPU v5e, ops/gossip.select_messages).  Entries at or
-        below the floor are cleared by the census line-freeing and the
-        deferred deep sweep (``deep_sweep_every``); between deep sweeps
-        a refresh-fold orphan may stay publish-eligible for a few
-        sweeps — stale-but-harmless traffic that loses every line
-        competition against in-flight records (see
-        ``_floor_advance_and_sweep``)."""
+        rotated line order.  The rotation is load-bearing: a churn
+        burst mints many records at one tick — equal packed values on
+        every node — and a fixed tie order would make the whole cluster
+        publish the SAME ``budget`` lines while the rest never spread
+        (the cluster-aligned index herd the dense model's
+        select_messages also rotates away).  The rotated rank comes
+        from the prefix-sum identity
+        ``rank(j) = S[j] − S[rot−1]  (j ≥ rot)``,
+        ``S[j] + T − S[rot−1]  (j < rot)`` — one cumsum plus an
+        [N]-sized per-row gather, measured bit-identical to and ~3 ms/
+        round cheaper than the earlier 2·log2(K) conditional-roll
+        materialization (benchmarks/hotpath_variants.py, pub_roll vs
+        pub_cumsum; ``top_k`` itself is the remaining floor at ~7 ms).
+        Entries at or below the floor are cleared by the census
+        line-freeing and the deferred deep sweep (``deep_sweep_every``);
+        between deep sweeps a refresh-fold orphan may stay
+        publish-eligible for a few sweeps — stale-but-harmless traffic
+        that loses every line competition against in-flight records
+        (see ``_floor_advance_and_sweep``)."""
         p = self.p
         k = p.cache_lines
         eligible = (state.cache_slot >= 0) & \
@@ -359,18 +363,19 @@ class CompressedSim:
         rows = jnp.arange(n, dtype=jnp.int32) + row_offset
         rot = (rows.astype(jnp.uint32) * jnp.uint32(gossip_ops.PHASE_MULT)
                & jnp.uint32(k - 1)).astype(jnp.int32)
-        view = tie
-        for b in range(k.bit_length() - 1):
-            bit = ((rot >> b) & 1)[:, None] == 1
-            view = jnp.where(bit, jnp.roll(view, -(1 << b), axis=1), view)
-        rank = jnp.cumsum(view.astype(jnp.int32), axis=1)
-        admit_rot = view & (rank <= budget - n_above)
-        for b in range(k.bit_length() - 1):
-            bit = ((rot >> b) & 1)[:, None] == 1
-            admit_rot = jnp.where(
-                bit, jnp.roll(admit_rot, 1 << b, axis=1), admit_rot)
+        s = jnp.cumsum(tie.astype(jnp.int32), axis=1)
+        total = s[:, -1:]
+        base = jnp.where(
+            rot[:, None] > 0,
+            jnp.take_along_axis(s, jnp.maximum(rot[:, None] - 1, 0),
+                                axis=1),
+            0)
+        cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+        rank = jnp.where(cols >= rot[:, None], s - base,
+                         s + total - base)
+        admit = tie & (rank <= budget - n_above)
 
-        selected = above | admit_rot
+        selected = above | admit
         bval = jnp.where(selected, state.cache_val, 0)
         bslot = jnp.where(selected, state.cache_slot, -1)
         sent = jnp.minimum(

@@ -192,16 +192,28 @@ class AdsServer:
             names = frozenset(req.resource_names)
 
             if req.response_nonce and req.response_nonce != sub["nonce"]:
-                # Stale nonce: response to a superseded push — ignore
-                # (the xDS spec's stale-response rule).
+                # Stale nonce: response to a superseded push — its
+                # ACK/NACK meaning is void (the xDS spec's
+                # stale-response rule), but a changed resource_names set
+                # is still the client's CURRENT subscription and must be
+                # served now: a cluster added here would otherwise go
+                # without endpoints until the next catalog change.
+                if names != sub["names"]:
+                    sub["names"] = names
+                    yield respond(self.snapshot(), type_url)
                 continue
             if req.response_nonce and req.HasField("error_detail"):
                 # NACK: the client rejected sent_version; the push loop
                 # stays quiet until a NEW snapshot version exists.  A
-                # NACK can still legally carry a changed subscription.
+                # NACK can still legally carry a changed subscription —
+                # and that part is not rejected content, so answer it
+                # immediately at the current version (mirroring the
+                # ACK-with-changed-names branch).
                 log.warning("ads: NACK for %s version %s: %s", type_url,
                             req.version_info, req.error_detail.message)
-                sub["names"] = names
+                if names != sub["names"]:
+                    sub["names"] = names
+                    yield respond(self.snapshot(), type_url)
                 continue
             if req.response_nonce:
                 # ACK of sent_version.  If the subscription set changed

@@ -8,13 +8,19 @@ this implementation, this module interprets the dialect that proxy
 templates actually use:
 
 * ``{{ <expr> }}`` — evaluate and write (stringified).
-* ``{{ if <expr> }} … {{ end }}`` — Go truthiness (empty string/zero/
-  empty collection/None are false).  ``else`` is not supported (the
-  stock template doesn't use it; loud error if seen).
-* ``{{ range $v := <expr> }} … {{ end }}`` and
+* ``{{ if <expr> }} … {{ else if <expr> }} … {{ else }} … {{ end }}``
+  — Go truthiness (empty string/zero/empty collection/None are false).
+* ``{{ with <expr> }} … {{ else }} … {{ end }}`` — rebinds dot to the
+  expression when truthy, else renders the else branch.
+* ``{{ range $v := <expr> }} … {{ else }} … {{ end }}`` and
   ``{{ range $k, $v := <expr> }} … {{ end }}`` — over lists (index,
   item) or maps (key, value; keys iterated in sorted order, matching
-  Go's map range in templates).
+  Go's map range in templates); the ``else`` branch renders when the
+  collection is empty, as in Go.
+* ``{{- … -}}`` trim markers — strip the whitespace (including
+  newlines) adjacent to the action, exactly text/template's rule (the
+  marker must be followed/preceded by whitespace to count as a
+  marker).
 * Expressions: ``$var``, ``.Field``, ``$var.Field.Sub``, quoted
   strings, integers, and function calls ``fname arg1 arg2`` resolved
   against the caller's FuncMap (parenthesized sub-calls are not
@@ -148,6 +154,14 @@ class _If:
     def __init__(self, tokens: list[str], body: list):
         self.tokens = tokens
         self.body = body
+        self.else_body: list = []
+
+
+class _With:
+    def __init__(self, tokens: list[str], body: list):
+        self.tokens = tokens
+        self.body = body
+        self.else_body: list = []
 
 
 class _Range:
@@ -157,6 +171,7 @@ class _Range:
         self.vvar = vvar
         self.tokens = tokens
         self.body = body
+        self.else_body: list = []
 
 
 def _tokenize_action(src: str) -> list[str]:
@@ -164,27 +179,88 @@ def _tokenize_action(src: str) -> list[str]:
     return out
 
 
+class _Frame:
+    """One open block while parsing: ``body`` is the list new nodes
+    append to (switched by ``else``); ``cur`` is the innermost _If an
+    ``else if`` chains onto (all branches share one ``{{ end }}``)."""
+
+    def __init__(self, kind: str, node: Any, body: list):
+        self.kind = kind
+        self.node = node
+        self.cur = node
+        self.body = body
+        self.saw_else = False
+
+
 def _parse(text: str) -> list:
     """Template → node tree (one pass with an explicit block stack)."""
-    root: list = []
-    stack: list[tuple[str, list, Any]] = [("root", root, None)]
+    root = _Frame("root", None, [])
+    stack: list[_Frame] = [root]
     pos = 0
+    trim_left = False          # a preceding action ended with `-}}`
     for m in _ACTION.finditer(text):
         if m.start() > pos:
-            stack[-1][1].append(_Text(text[pos:m.start()]))
+            seg = text[pos:m.start()]
+            if trim_left:
+                seg = seg.lstrip()
+            if seg:
+                stack[-1].body.append(_Text(seg))
         pos = m.end()
-        tokens = _tokenize_action(m.group(1).strip())
+        src = m.group(1)
+        # text/template trim markers: `{{- ` strips the whitespace
+        # before the action, ` -}}` after it; the marker only counts
+        # when separated from the action by whitespace (so `{{-3}}` is
+        # still the number -3).
+        if src.startswith("-") and len(src) > 1 and src[1].isspace():
+            src = src[1:]
+            last = stack[-1].body[-1] if stack[-1].body else None
+            if isinstance(last, _Text):
+                last.text = last.text.rstrip()
+                if not last.text:
+                    stack[-1].body.pop()
+        trim_left = src.endswith("-") and len(src) > 1 \
+            and src[-2].isspace()
+        if trim_left:
+            src = src[:-1]
+        tokens = _tokenize_action(src.strip())
         if not tokens:
             raise TemplateError("empty {{ }} action")
         head = tokens[0]
         if head == "end":
-            kind, body, node = stack.pop()
-            if kind == "root":
+            frame = stack.pop()
+            if frame.kind == "root":
                 raise TemplateError("{{ end }} without an open block")
-            stack[-1][1].append(node)
+            stack[-1].body.append(frame.node)
         elif head == "if":
             node = _If(tokens[1:], [])
-            stack.append(("if", node.body, node))
+            stack.append(_Frame("if", node, node.body))
+        elif head == "with":
+            if ":=" in tokens:
+                raise TemplateError(
+                    "`with $v := expr` is not supported by this "
+                    "renderer (use plain `with expr`)")
+            node = _With(tokens[1:], [])
+            stack.append(_Frame("with", node, node.body))
+        elif head == "else":
+            frame = stack[-1]
+            if frame.kind == "root":
+                raise TemplateError("{{ else }} without an open block")
+            if frame.saw_else:
+                raise TemplateError("duplicate {{ else }} in one block")
+            if len(tokens) > 1:
+                # `else if <expr>`: chain a nested _If that shares this
+                # block's single {{ end }}.  (saw_else already rejected
+                # above: nothing may follow a plain else.)
+                if frame.kind != "if" or tokens[1] != "if":
+                    raise TemplateError(
+                        f"unexpected tokens after else: {tokens[1:]}")
+                nxt = _If(tokens[2:], [])
+                frame.cur.else_body.append(nxt)
+                frame.cur = nxt
+                frame.body = nxt.body
+            else:
+                frame.saw_else = True
+                frame.body = frame.cur.else_body
         elif head == "range":
             rest = tokens[1:]
             if ":=" in rest:
@@ -209,17 +285,21 @@ def _parse(text: str) -> list:
                 raise TemplateError(
                     "only `range $v := expr` / `range $k, $v := expr` "
                     "forms are supported")
-            stack.append(("range", node.body, node))
-        elif head in ("else", "with", "template", "block", "define"):
+            stack.append(_Frame("range", node, node.body))
+        elif head in ("template", "block", "define"):
             raise TemplateError(
                 f"{{{{ {head} }}}} is not supported by this renderer")
         else:
-            stack[-1][1].append(_Action(tokens))
+            stack[-1].body.append(_Action(tokens))
     if len(stack) != 1:
-        raise TemplateError(f"unclosed {{{{ {stack[-1][0]} }}}} block")
+        raise TemplateError(f"unclosed {{{{ {stack[-1].kind} }}}} block")
     if pos < len(text):
-        root.append(_Text(text[pos:]))
-    return root
+        seg = text[pos:]
+        if trim_left:
+            seg = seg.lstrip()
+        if seg:
+            root.body.append(_Text(seg))
+    return root.body
 
 
 # -- rendering ---------------------------------------------------------------
@@ -233,6 +313,15 @@ def _render_nodes(nodes: list, env: _Env, out: list[str]) -> None:
         elif isinstance(node, _If):
             if _truthy(_eval_expr(node.tokens, env)):
                 _render_nodes(node.body, env, out)
+            else:
+                _render_nodes(node.else_body, env, out)
+        elif isinstance(node, _With):
+            val = _eval_expr(node.tokens, env)
+            if _truthy(val):
+                child = _Env(val, env.funcs, parent=env)
+                _render_nodes(node.body, child, out)
+            else:
+                _render_nodes(node.else_body, env, out)
         elif isinstance(node, _Range):
             coll = _eval_expr(node.tokens, env)
             if isinstance(coll, dict):
@@ -244,6 +333,8 @@ def _render_nodes(nodes: list, env: _Env, out: list[str]) -> None:
             else:
                 raise TemplateError(
                     f"cannot range over {type(coll).__name__}")
+            if not items:
+                _render_nodes(node.else_body, env, out)
             for k, v in items:
                 child = _Env(env.dot, env.funcs, parent=env)
                 if node.kvar is not None:

@@ -25,9 +25,17 @@ class Looper:
         self._quit = threading.Event()
         self._done = threading.Event()
         self.error: Optional[BaseException] = None
+        self._quit_callbacks: list[Callable[[], None]] = []
+
+    def add_quit_callback(self, cb: Callable[[], None]) -> None:
+        """Run ``cb`` when this looper is quit — lets an owner propagate
+        shutdown without dedicating a thread to waiting on the event."""
+        self._quit_callbacks.append(cb)
 
     def quit(self) -> None:
         self._quit.set()
+        for cb in self._quit_callbacks:
+            cb()
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the loop finishes; True if it did."""

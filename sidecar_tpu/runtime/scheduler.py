@@ -1,0 +1,135 @@
+"""One scheduler thread for every periodic loop in the live node.
+
+The reference multiplexes its periodic duties over a few goroutines on
+the Go runtime's thread pool and advertises "a few execution threads"
+(its README:54-56).  A thread-per-TimedLooper translation loses that
+row (~50 threads/node measured in round 4, benchmarks/live_node.py);
+this scheduler restores it: a single thread drives any number of
+periodic tasks from a deadline heap.
+
+Contract notes:
+
+* Tasks run ON the scheduler thread, serially.  A slow tick delays its
+  siblings — the same property a single-threaded event loop has.  Long
+  blocking work (the state-writer queue drain, blocking-IO loops, the
+  health-check tick that waits on its worker pool) stays on dedicated
+  threads; everything whose tick is quick belongs here.
+* ``drive(looper, fn)`` adopts a TimedLooper's contract: honors its
+  interval / ``immediate`` / ``quit()``, records a raising tick into
+  ``looper.error`` and stops that task (Looper.loop semantics), and
+  sets the looper's done event so ``looper.wait()`` keeps working.
+* Re-registration cadence is ``fn-end + interval`` (TimedLooper sleeps
+  the interval BETWEEN runs, so body time drifts the cadence — matched
+  here deliberately).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import logging
+import threading
+import time
+from typing import Callable, Optional
+
+from sidecar_tpu.runtime.looper import TimedLooper
+
+log = logging.getLogger(__name__)
+
+
+class Scheduler:
+    def __init__(self, name: str = "scheduler") -> None:
+        self._name = name
+        self._heap: list = []       # (deadline, seq, task)
+        self._seq = itertools.count()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registration -------------------------------------------------------
+
+    def drive(self, looper: TimedLooper, fn: Callable[[], None],
+              name: str = "task") -> None:
+        """Drive ``fn`` per ``looper``'s interval until ``looper.quit()``
+        (or ``fn`` raises).  Starts the scheduler thread on first use."""
+        first = time.monotonic() + \
+            (0.0 if looper.immediate else looper.interval)
+        task = _Task(looper, fn, name)
+        # quit() must take effect promptly (TimedLooper honors it within
+        # one interruptible wait): wake the scheduler and retire quit
+        # tasks immediately instead of at their next heap deadline.
+        looper.add_quit_callback(self._reap_quit)
+        with self._cond:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True)
+                self._thread.start()
+            heapq.heappush(self._heap, (first, next(self._seq), task))
+            self._cond.notify()
+
+    def _reap_quit(self) -> None:
+        with self._cond:
+            alive = []
+            for entry in self._heap:
+                task = entry[2]
+                if task.looper._quit.is_set():
+                    task.looper._done.set()
+                else:
+                    alive.append(entry)
+            if len(alive) != len(self._heap):
+                self._heap[:] = alive
+                heapq.heapify(self._heap)
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- the loop -----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and \
+                        (not self._heap or
+                         self._heap[0][0] > time.monotonic()):
+                    delay = None if not self._heap else \
+                        max(0.0, self._heap[0][0] - time.monotonic())
+                    self._cond.wait(timeout=delay)
+                if self._stop:
+                    for _, _, task in self._heap:
+                        task.looper._done.set()
+                    self._heap.clear()
+                    return
+                _, _, task = heapq.heappop(self._heap)
+            if task.looper._quit.is_set():
+                task.looper._done.set()
+                continue
+            try:
+                task.fn()
+            except BaseException as exc:  # noqa: BLE001 — Looper.loop parity
+                task.looper.error = exc
+                task.looper._done.set()
+                log.exception("scheduled task %s failed; stopped",
+                              task.name)
+                continue
+            if task.looper._quit.is_set():
+                task.looper._done.set()
+                continue
+            nxt = time.monotonic() + task.looper.interval
+            with self._cond:
+                heapq.heappush(self._heap, (nxt, next(self._seq), task))
+
+
+class _Task:
+    __slots__ = ("looper", "fn", "name")
+
+    def __init__(self, looper: TimedLooper, fn: Callable[[], None],
+                 name: str) -> None:
+        self.looper = looper
+        self.fn = fn
+        self.name = name

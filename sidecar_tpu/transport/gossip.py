@@ -207,11 +207,10 @@ class GossipTransport:
         self.bind_port = port
         self._push_local_state()
 
-        for name, fn in [("gossip-outbound", self._outbound_loop),
-                         ("gossip-inbound", self._inbound_loop)]:
-            t = threading.Thread(target=fn, name=name, daemon=True)
-            t.start()
-            self._threads.append(t)
+        t = threading.Thread(target=self._bridge_loop,
+                             name="gossip-bridge", daemon=True)
+        t.start()
+        self._threads.append(t)
 
         for seed in seeds or []:
             host, _, port_s = seed.partition(":")
@@ -273,16 +272,24 @@ class GossipTransport:
         for name, val in zip(self._STAT_NAMES[:n], vals[:n]):
             metrics.set_gauge(name, int(val))
 
-    def _outbound_loop(self) -> None:
-        """state.broadcasts → native queue (GetBroadcasts feed).  Timed
-        + gauged like the reference delegate
-        (services_delegate.go:86-87)."""
+    def _bridge_loop(self) -> None:
+        """ONE delegate thread for both directions ("few execution
+        threads", reference README:54-56): outbound drains
+        state.broadcasts into the native queue (GetBroadcasts feed,
+        timed + gauged like the reference delegate,
+        services_delegate.go:86-87); inbound drains the native queues
+        into the catalog (NotifyMsg / MergeRemoteState / NotifyLeave)
+        plus the engine-diagnostics log bridge
+        (logging_bridge.go:25-53).  The outbound queue get doubles as
+        the idle sleep, kept short so inbound drain latency stays low."""
         import queue as queue_mod
 
+        buf = ctypes.create_string_buffer(1 << 22)
         last_state_push = 0.0
         while not self._quit.is_set():
+            # -- outbound ---------------------------------------------------
             try:
-                prepared = self.state.broadcasts.get(timeout=0.2)
+                prepared = self.state.broadcasts.get(timeout=0.02)
             except queue_mod.Empty:
                 prepared = None
             if self._quit.is_set():
@@ -301,62 +308,61 @@ class GossipTransport:
                 self._poll_engine_stats()
                 last_state_push = now
 
-    def _inbound_loop(self) -> None:
-        """Native queues → catalog (NotifyMsg / MergeRemoteState /
-        NotifyLeave) + the engine-diagnostics log bridge
-        (logging_bridge.go:25-53)."""
-        buf = ctypes.create_string_buffer(1 << 22)
-        while not self._quit.is_set():
-            busy = False
+            # -- inbound — drain, BOUNDED per cycle so sustained inbound
+            # traffic cannot starve the outbound half above (fairness on
+            # the shared thread; leftovers are picked up next cycle).
+            busy = True
+            drained = 0
+            while busy and drained < 64 and not self._quit.is_set():
+                drained += 1
+                busy = False
 
-            n = self._lib.st_poll_msg(self._handle, buf, len(buf))
-            if n > 0:
-                busy = True
-                t0 = time.perf_counter()
-                try:
-                    svc = svc_mod.decode(buf.raw[:n])
-                    self.state.update_service(svc)
-                except ValueError as exc:
-                    log.warning("Error decoding gossip message: %s", exc)
-                metrics.measure_since("notifyMsg", t0)
-
-            # Full-state payloads are unbounded (LocalState is the whole
-            # catalog) — size the read from the engine's queue so a large
-            # cluster's push-pull can't be silently truncated.
-            need = self._lib.st_next_state_len(self._handle)
-            if need > 0:
-                sbuf = buf if need <= len(buf) else \
-                    ctypes.create_string_buffer(need)
-                n = self._lib.st_poll_state(self._handle, sbuf, len(sbuf))
+                n = self._lib.st_poll_msg(self._handle, buf, len(buf))
                 if n > 0:
                     busy = True
                     t0 = time.perf_counter()
                     try:
-                        remote = decode(sbuf.raw[:n])
-                        self.state.merge(remote)
-                    except (ValueError, KeyError) as exc:
-                        log.warning("Error merging remote state: %s", exc)
-                    metrics.measure_since("mergeRemoteState", t0)
+                        svc = svc_mod.decode(buf.raw[:n])
+                        self.state.update_service(svc)
+                    except ValueError as exc:
+                        log.warning("Error decoding gossip message: %s", exc)
+                    metrics.measure_since("notifyMsg", t0)
 
-            n = self._lib.st_poll_log(self._handle, buf, len(buf))
-            if n > 0:
-                busy = True
-                line = buf.raw[:n].decode(errors="replace")
-                level, _, msg = line.partition("|")
-                log.log(_LOG_LEVELS.get(level, logging.INFO),
-                        "engine: %s", msg)
+                # Full-state payloads are unbounded (LocalState is the whole
+                # catalog) — size the read from the engine's queue so a large
+                # cluster's push-pull can't be silently truncated.
+                need = self._lib.st_next_state_len(self._handle)
+                if need > 0:
+                    sbuf = buf if need <= len(buf) else \
+                        ctypes.create_string_buffer(need)
+                    n = self._lib.st_poll_state(self._handle, sbuf, len(sbuf))
+                    if n > 0:
+                        busy = True
+                        t0 = time.perf_counter()
+                        try:
+                            remote = decode(sbuf.raw[:n])
+                            self.state.merge(remote)
+                        except (ValueError, KeyError) as exc:
+                            log.warning("Error merging remote state: %s", exc)
+                        metrics.measure_since("mergeRemoteState", t0)
 
-            n = self._lib.st_poll_event(self._handle, buf, len(buf))
-            if n > 0:
-                busy = True
-                parts = buf.raw[:n].decode().split()
-                if parts and parts[0] == "leave" and len(parts) > 1:
-                    log.info("Member left: %s", parts[1])
-                    threading.Thread(
-                        target=self.state.expire_server, args=(parts[1],),
-                        daemon=True).start()
-                elif parts and parts[0] == "join" and len(parts) > 1:
-                    log.info("Member joined: %s", parts[1])
+                n = self._lib.st_poll_log(self._handle, buf, len(buf))
+                if n > 0:
+                    busy = True
+                    line = buf.raw[:n].decode(errors="replace")
+                    level, _, msg = line.partition("|")
+                    log.log(_LOG_LEVELS.get(level, logging.INFO),
+                            "engine: %s", msg)
 
-            if not busy:
-                self._quit.wait(0.05)
+                n = self._lib.st_poll_event(self._handle, buf, len(buf))
+                if n > 0:
+                    busy = True
+                    parts = buf.raw[:n].decode().split()
+                    if parts and parts[0] == "leave" and len(parts) > 1:
+                        log.info("Member left: %s", parts[1])
+                        threading.Thread(
+                            target=self.state.expire_server, args=(parts[1],),
+                            daemon=True).start()
+                    elif parts and parts[0] == "join" and len(parts) > 1:
+                        log.info("Member joined: %s", parts[1])
+

@@ -101,9 +101,12 @@ class SidecarApi:
     # -- route dispatch ----------------------------------------------------
 
     def dispatch(self, method: str, path: str,
-                 query: Optional[dict] = None):
+                 query: Optional[dict] = None,
+                 client: Optional[str] = None):
         """Returns (status, content_type, body_bytes) or a stream marker
-        ("watch", by_service) for the long-poll route."""
+        ("watch", by_service) for the long-poll route.  ``client`` is
+        the peer IP when the call arrives over HTTP (None = a trusted
+        in-process caller)."""
         query = query or {}
         parts = [p for p in path.split("/") if p]
         # Strip the /api prefix; deprecated unprefixed aliases hit the
@@ -152,7 +155,7 @@ class SidecarApi:
         if parts == ["debug", "stacks"]:
             return self.debug_stacks()
         if parts == ["debug", "profile"]:
-            return self.debug_profile(query)
+            return self.debug_profile(query, client=client)
         if parts == ["haproxy", "stats.csv"]:
             return self.haproxy_stats()
 
@@ -297,11 +300,17 @@ class SidecarApi:
             return self._error(502, f"HAProxy stats unreachable: {exc}")
         return 200, "text/plain", body, CORS_HEADERS
 
-    def debug_profile(self, query: dict):
+    def debug_profile(self, query: dict, client: Optional[str] = None):
         """On-demand CPU profile of the LIVE node —
         ``/api/debug/profile?seconds=N`` (the net/http/pprof CPU-profile
         analog, sidecarhttp/http.go:5; offline profiling stays behind
         ``--cpuprofile``).
+
+        Loopback-only: the endpoint burns up to 60 s of CPU per request
+        and the API is served with CORS ``*``, so an off-host (or
+        cross-origin) caller could keep a node permanently profiling.
+        net/http/pprof expects to live on a debug listener; the analog
+        here is rejecting non-local peers outright.
 
         Like pprof's, this is a SAMPLING profile: every thread's stack
         is captured at ~100 Hz for N seconds and aggregated into
@@ -315,6 +324,11 @@ class SidecarApi:
         import threading
         import time as time_mod
 
+        if client is not None and client not in ("127.0.0.1", "::1",
+                                                 "localhost") \
+                and not client.startswith("127."):
+            return self._error(
+                403, "profiling is restricted to loopback clients")
         try:
             seconds = float(query.get("seconds", ["5"])[0])
         except ValueError:

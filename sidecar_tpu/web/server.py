@@ -110,7 +110,8 @@ def make_handler(api: SidecarApi, ui_dir: Optional[str],
                 self._serve_file(static_dir, path[len("/static"):])
                 return
 
-            result = api.dispatch("GET", path, query)
+            result = api.dispatch("GET", path, query,
+                                  client=self.client_address[0])
             if isinstance(result, tuple) and result and result[0] == "watch":
                 self._watch(result[1])
                 return
@@ -122,7 +123,8 @@ def make_handler(api: SidecarApi, ui_dir: Optional[str],
             length = int(self.headers.get("Content-Length") or 0)
             if length:
                 self.rfile.read(length)
-            status, ctype, body, extra = api.dispatch("POST", parsed.path)
+            status, ctype, body, extra = api.dispatch(
+                "POST", parsed.path, client=self.client_address[0])
             self._send(status, ctype, body, extra)
 
         def do_OPTIONS(self) -> None:  # noqa: N802

@@ -268,7 +268,10 @@ class TestAdsStream:
 
     def test_eds_unknown_name_omitted_and_nack_keeps_subscription(self, ads):
         """sotw omits names the snapshot doesn't have, and a NACK that
-        carries a changed subscription still updates it."""
+        carries a changed subscription is served that subscription
+        IMMEDIATELY (the changed names are not rejected content — a
+        cluster added in a NACK must not go unserved until the next
+        catalog change)."""
         state, server, mock = ads
         x = mock.x
         mock.send(TYPE_ENDPOINT, names=["web:8080", "ghost:1"])
@@ -277,10 +280,16 @@ class TestAdsStream:
                  for r in resp.resources}
         assert names == {"web:8080"}
 
-        # NACK while narrowing to the ghost only; the next snapshot push
-        # must be scoped to the NACK's subscription (empty resources).
+        # NACK while narrowing to the ghost only: the re-scoped set is
+        # answered at once, at the current (content-rejected) version.
         mock.send(TYPE_ENDPOINT, version="", nonce=resp.nonce,
                   error="bad", names=["ghost:1"])
+        rescoped = mock.recv()
+        assert rescoped.version_info == resp.version_info
+        assert len(rescoped.resources) == 0
+
+        # And the next snapshot push stays scoped to the NACK's
+        # subscription (empty: the ghost still doesn't exist).
         state.set_clock(lambda: T0 + NS)
         state.add_service_entry(S.Service(
             id="iii999", name="new", image="n:1", hostname="h3",

@@ -58,9 +58,46 @@ class TestEngine:
         out = render("{{ .Svc.Hostname }}-{{ .Svc.ID }}", {"Svc": svc}, {})
         assert out == "h9-abc"
 
+    def test_if_else_and_else_if(self):
+        tmpl = ("{{ if .A }}a{{ else if .B }}b{{ else }}c{{ end }}")
+        assert render(tmpl, {"A": 1, "B": 0}, {}) == "a"
+        assert render(tmpl, {"A": 0, "B": 1}, {}) == "b"
+        assert render(tmpl, {"A": 0, "B": 0}, {}) == "c"
+
+    def test_with_rebinds_dot(self):
+        tmpl = ("{{ with .Inner }}v={{ .V }}{{ else }}none{{ end }}"
+                "|{{ .Top }}")
+        assert render(tmpl, {"Inner": {"V": 5}, "Top": "t"}, {}) \
+            == "v=5|t"
+        assert render(tmpl, {"Inner": None, "Top": "t"}, {}) == "none|t"
+        # Falsy non-None values also take the else branch (Go truth).
+        assert render(tmpl, {"Inner": {}, "Top": "t"}, {}) == "none|t"
+
+    def test_range_else_on_empty(self):
+        tmpl = ("{{ range $v := .L }}[{{ $v }}]{{ else }}empty{{ end }}")
+        assert render(tmpl, {"L": ["x"]}, {}) == "[x]"
+        assert render(tmpl, {"L": []}, {}) == "empty"
+
+    def test_trim_markers(self):
+        # text/template: `{{- ` eats whitespace to the left (newlines
+        # included), ` -}}` to the right; `{{-3}}` is still a number.
+        assert render("a  \n  {{- .X }}", {"X": 1}, {}) == "a1"
+        assert render("{{ .X -}}  \n  b", {"X": 1}, {}) == "1b"
+        assert render("{{ if .X -}} y {{- end }}|", {"X": 1}, {}) \
+            == "y|"
+        assert render("{{-3}}", {}, {}) == "-3"
+
+    def test_else_errors(self):
+        with pytest.raises(TemplateError, match="without an open"):
+            Template("{{ else }}")
+        with pytest.raises(TemplateError, match="duplicate"):
+            Template("{{ if .A }}{{ else }}{{ else }}{{ end }}")
+        with pytest.raises(TemplateError, match="unexpected tokens"):
+            Template("{{ range $v := .L }}{{ else if .B }}{{ end }}")
+
     def test_unsupported_constructs_fail_loudly(self):
-        for bad in ("{{ else }}", "{{ with .X }}{{ end }}",
-                    "{{ template \"x\" }}", "{{ block \"x\" }}"):
+        for bad in ("{{ template \"x\" }}", "{{ block \"x\" }}",
+                    "{{ with $v := .X }}{{ end }}"):
             with pytest.raises(TemplateError):
                 Template(bad)
         with pytest.raises(TemplateError, match="unclosed"):

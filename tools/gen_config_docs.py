@@ -41,6 +41,19 @@ SECTIONS = [
 ]
 
 
+# Per-knob behavior notes that belong next to the row (deviations from
+# the reference an operator comparing against memberlist semantics
+# should know about).
+NOTES = {
+    "SIDECAR_HANDOFF_QUEUE_DEPTH":
+        "On overflow the engine sheds the OLDEST queued inbound "
+        "records; memberlist's HandoffQueueDepth drops the INCOMING "
+        "message instead. Deliberate deviation: anti-entropy redelivers "
+        "shed records, and keeping the newest preserves the freshest "
+        "versions under a stalled consumer.",
+}
+
+
 def _describe_default(value) -> str:
     if isinstance(value, bool):
         return "`true`" if value else "`false`"
@@ -130,9 +143,15 @@ def render() -> str:
         lines.append("")
         lines.append("| Variable | Type | Default |")
         lines.append("|---|---|---|")
+        noted = []
         for var, typ, default in rows:
             lines.append(f"| `{var}` | {typ} | {default} |")
+            if var in NOTES:
+                noted.append(var)
         lines.append("")
+        for var in noted:
+            lines.append(f"**`{var}`** — {NOTES[var]}")
+            lines.append("")
     return "\n".join(lines)
 
 

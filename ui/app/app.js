@@ -2,9 +2,11 @@
 // services.json-shaped snapshot per ChangeEvent, http_api.go:56-131);
 // fallback: polling /api/services.json every 2 s, the reference UI's
 // only mode (ui/app/services/services.js:12-33).
+//
+// Pure logic (CSV parsing, time formatting, stream framing) lives in
+// lib.js — loaded before this file — so it is unit-testable
+// (ui/test/lib_test.js) without a DOM.
 "use strict";
-
-const STATUS = ["Alive", "Tombstone", "Unhealthy", "Unknown", "Draining"];
 
 function el(tag, attrs, ...children) {
   const node = document.createElement(tag);
@@ -18,24 +20,8 @@ function el(tag, attrs, ...children) {
   return node;
 }
 
-function timeAgo(ns) {
-  if (!ns) return "never";
-  // The wire format ships RFC3339 strings (Service.to_json); accept
-  // raw nanoseconds too for older payloads.
-  if (typeof ns === "string") {
-    const ms = Date.parse(ns);
-    if (Number.isNaN(ms)) return "never";
-    ns = ms * 1e6;
-  }
-  const s = Math.max(0, Date.now() / 1000 - ns / 1e9);
-  if (s < 60) return `${Math.round(s)}s ago`;
-  if (s < 3600) return `${Math.round(s / 60)}m ago`;
-  if (s < 86400) return `${Math.round(s / 3600)}h ago`;
-  return `${Math.round(s / 86400)}d ago`;
-}
-
 function chip(status) {
-  const idx = (status >= 0 && status < STATUS.length) ? status : 3;
+  const idx = statusIndex(status);
   return el("span", { class: `chip s${idx}` }, STATUS[idx]);
 }
 
@@ -46,41 +32,8 @@ function chip(status) {
 // svcName → hostname → containerID → csv row, plus the raw backend rows.
 let haproxy = { map: {}, rows: [], ok: false };
 
-function parseHaproxyCsv(text) {
-  const lines = text.split("\n").filter(l => l.trim());
-  if (!lines.length) return { map: {}, rows: [], ok: false };
-  const header = lines[0].replace(/^# /, "").split(",");
-  const map = {}, rows = [];
-  for (const line of lines.slice(1)) {
-    const cells = line.split(",");
-    const item = {};
-    header.forEach((h, i) => { item[h] = cells[i]; });
-    const px = item.pxname || "";
-    if (item.svname === "FRONTEND" || item.svname === "BACKEND" ||
-        px === "stats" || px === "stats_proxy" || px === "") continue;
-    rows.push(item);
-    // pxname = "<svcName>-<port>", svname = "<hostname>-<containerID>"
-    // (the template's naming, views/haproxy.cfg:56-58).
-    let f = px.split("-");
-    const svcName = f.slice(0, f.length - 1).join("-");
-    f = item.svname.split("-");
-    const hostname = f.slice(0, f.length - 1).join("-");
-    const id = f[f.length - 1];
-    ((map[svcName] ||= {})[hostname] ||= {})[id] = item;
-  }
-  return { map, rows, ok: true };
-}
-
-// The HAProxy template writes sanitized backend names
-// (sanitize_name: [^a-z0-9-] → "-", haproxy.go:86-89), so catalog
-// names must be transformed the same way before lookup.
-function sanitizeName(name) {
-  return (name || "").replace(/[^a-z0-9-]/g, "-");
-}
-
 function haproxyHas(svc) {
-  const byHost = haproxy.map[sanitizeName(svc.Name)];
-  return !!(byHost && byHost[svc.Hostname] && byHost[svc.Hostname][svc.ID]);
+  return haproxyHasIn(haproxy.map, svc);
 }
 
 function renderHaproxy() {
@@ -195,9 +148,7 @@ function render(data) {
   for (const name of names) {
     const instances = services[name];
     instances.forEach((svc, i) => {
-      const ports = (svc.Ports || [])
-        .map(p => p.ServicePort ? `${p.ServicePort}→${p.Port}` : `${p.Port}`)
-        .join(", ");
+      const ports = formatPorts(svc.Ports);
       const row = el("tr", {});
       const label = i === 0
         ? el("td", { class: "svc", rowspan: String(instances.length) },
@@ -282,24 +233,12 @@ async function watchLoop() {
         const { done, value } = await reader.read();
         if (done) break;
         buf += decoder.decode(value, { stream: true });
-        let depth = 0, start = -1, inStr = false, esc = false;
-        for (let i = 0; i < buf.length; i++) {
-          const c = buf[i];
-          if (esc) { esc = false; continue; }
-          if (c === "\\") { esc = inStr; continue; }
-          if (c === '"') { inStr = !inStr; continue; }
-          if (inStr) continue;
-          if (c === "{") { if (depth === 0) start = i; depth++; }
-          else if (c === "}") {
-            depth--;
-            if (depth === 0 && start >= 0) {
-              envelope.Services = JSON.parse(buf.slice(start, i + 1));
-              render(envelope);
-              setStatus(`live · ${new Date().toLocaleTimeString()}`);
-              buf = buf.slice(i + 1);
-              i = -1;
-            }
-          }
+        const { docs, rest } = extractJsonDocs(buf);
+        buf = rest;
+        for (const doc of docs) {
+          envelope.Services = doc;
+          render(envelope);
+          setStatus(`live · ${new Date().toLocaleTimeString()}`);
         }
       }
       throw new Error("stream ended");

@@ -1,0 +1,112 @@
+// Pure UI logic, extracted from app.js so it is unit-testable without a
+// browser (ui/test/lib_test.js runs under node or in the browser test
+// page ui/test/index.html — the karma-unit analog of the reference's
+// ui/karma.conf.js).  No DOM access in this file.
+"use strict";
+
+const STATUS = ["Alive", "Tombstone", "Unhealthy", "Unknown", "Draining"];
+
+// Clamp an arbitrary wire status to a renderable index (unknown = 3).
+function statusIndex(status) {
+  return (status >= 0 && status < STATUS.length) ? status : 3;
+}
+
+function timeAgo(ns, nowMs) {
+  if (!ns) return "never";
+  // The wire format ships RFC3339 strings (Service.to_json); accept
+  // raw nanoseconds too for older payloads.
+  if (typeof ns === "string") {
+    const ms = Date.parse(ns);
+    if (Number.isNaN(ms)) return "never";
+    ns = ms * 1e6;
+  }
+  const now = (nowMs === undefined ? Date.now() : nowMs);
+  const s = Math.max(0, now / 1000 - ns / 1e9);
+  if (s < 60) return `${Math.round(s)}s ago`;
+  if (s < 3600) return `${Math.round(s / 60)}m ago`;
+  if (s < 86400) return `${Math.round(s / 3600)}h ago`;
+  return `${Math.round(s / 86400)}d ago`;
+}
+
+// The HAProxy template writes sanitized backend names
+// (sanitize_name: [^a-z0-9-] → "-", haproxy.go:86-89), so catalog
+// names must be transformed the same way before lookup.
+function sanitizeName(name) {
+  return (name || "").replace(/[^a-z0-9-]/g, "-");
+}
+
+// "8080→31000, 9090" — the per-instance ports cell.
+function formatPorts(ports) {
+  return (ports || [])
+    .map(p => p.ServicePort ? `${p.ServicePort}→${p.Port}` : `${p.Port}`)
+    .join(", ");
+}
+
+// HAProxy stats CSV → { map: svcName→hostname→containerID→row,
+// rows: backend server rows, ok }.  Mirrors the reference UI's
+// transform (ui/app/services/services.js:139-158).
+function parseHaproxyCsv(text) {
+  const lines = text.split("\n").filter(l => l.trim());
+  if (!lines.length) return { map: {}, rows: [], ok: false };
+  const header = lines[0].replace(/^# /, "").split(",");
+  const map = {}, rows = [];
+  for (const line of lines.slice(1)) {
+    const cells = line.split(",");
+    const item = {};
+    header.forEach((h, i) => { item[h] = cells[i]; });
+    const px = item.pxname || "";
+    if (item.svname === "FRONTEND" || item.svname === "BACKEND" ||
+        px === "stats" || px === "stats_proxy" || px === "") continue;
+    rows.push(item);
+    // pxname = "<svcName>-<port>", svname = "<hostname>-<containerID>"
+    // (the template's naming, views/haproxy.cfg:56-58).
+    let f = px.split("-");
+    const svcName = f.slice(0, f.length - 1).join("-");
+    f = item.svname.split("-");
+    const hostname = f.slice(0, f.length - 1).join("-");
+    const id = f[f.length - 1];
+    ((map[svcName] ||= {})[hostname] ||= {})[id] = item;
+  }
+  return { map, rows, ok: true };
+}
+
+// Is this catalog instance present in the parsed HAProxy map?
+function haproxyHasIn(map, svc) {
+  const byHost = map[sanitizeName(svc.Name)];
+  return !!(byHost && byHost[svc.Hostname] && byHost[svc.Hostname][svc.ID]);
+}
+
+// Incremental JSON framing for the /watch chunked stream: pull every
+// complete top-level {...} document out of buf (string-aware brace
+// depth — snapshots are newline-free single objects).  Returns
+// { docs: [parsed...], rest: remaining partial input }.
+function extractJsonDocs(buf) {
+  const docs = [];
+  let depth = 0, start = -1, inStr = false, esc = false;
+  let consumed = 0;
+  for (let i = 0; i < buf.length; i++) {
+    const c = buf[i];
+    if (esc) { esc = false; continue; }
+    if (c === "\\") { esc = inStr; continue; }
+    if (c === '"') { inStr = !inStr; continue; }
+    if (inStr) continue;
+    if (c === "{") { if (depth === 0) start = i; depth++; }
+    else if (c === "}") {
+      depth--;
+      if (depth === 0 && start >= 0) {
+        docs.push(JSON.parse(buf.slice(start, i + 1)));
+        consumed = i + 1;
+        start = -1;
+      }
+    }
+  }
+  return { docs, rest: buf.slice(consumed) };
+}
+
+// node (the unit-test runner) sees a module; the browser just gets
+// globals on the shared script scope.
+if (typeof module !== "undefined" && module.exports) {
+  module.exports = { STATUS, statusIndex, timeAgo, sanitizeName,
+                     formatPorts, parseHaproxyCsv, haproxyHasIn,
+                     extractJsonDocs };
+}

@@ -1,0 +1,116 @@
+// Unit tests for ui/app/lib.js — the UI's pure logic (the karma-unit
+// analog of the reference's ui/karma.conf.js test stack).  Runs two
+// ways with zero dependencies:
+//   node ui/test/lib_test.js            (CI / tests/test_ui_logic.py)
+//   open ui/test/index.html             (any browser; same assertions)
+"use strict";
+
+/* global STATUS, statusIndex, timeAgo, sanitizeName, formatPorts,
+   parseHaproxyCsv, haproxyHasIn, extractJsonDocs */
+const L = (typeof require !== "undefined" && typeof window === "undefined")
+  ? require("../app/lib.js")
+  : { STATUS, statusIndex, timeAgo, sanitizeName, formatPorts,
+      parseHaproxyCsv, haproxyHasIn, extractJsonDocs };
+
+const failures = [];
+let checks = 0;
+
+function eq(got, want, label) {
+  checks++;
+  const g = JSON.stringify(got), w = JSON.stringify(want);
+  if (g !== w) failures.push(`${label}: got ${g}, want ${w}`);
+}
+
+// -- statusIndex -------------------------------------------------------------
+eq(L.statusIndex(0), 0, "statusIndex alive");
+eq(L.statusIndex(4), 4, "statusIndex draining");
+eq(L.statusIndex(9), 3, "statusIndex out-of-range -> unknown");
+eq(L.statusIndex(-1), 3, "statusIndex negative -> unknown");
+eq(L.STATUS[L.statusIndex(1)], "Tombstone", "status name");
+
+// -- timeAgo (nowMs pinned so assertions are deterministic) ------------------
+const NOW = Date.UTC(2026, 0, 2, 0, 0, 0);            // 2026-01-02T00:00Z
+const ns = ms => ms * 1e6;
+eq(L.timeAgo(0, NOW), "never", "timeAgo zero");
+eq(L.timeAgo(null, NOW), "never", "timeAgo null");
+eq(L.timeAgo(ns(NOW - 5000), NOW), "5s ago", "timeAgo seconds");
+eq(L.timeAgo(ns(NOW - 120000), NOW), "2m ago", "timeAgo minutes");
+eq(L.timeAgo(ns(NOW - 7200000), NOW), "2h ago", "timeAgo hours");
+eq(L.timeAgo(ns(NOW - 172800000), NOW), "2d ago", "timeAgo days");
+eq(L.timeAgo(ns(NOW + 60000), NOW), "0s ago", "timeAgo future clamps");
+eq(L.timeAgo("2026-01-01T23:59:30Z", NOW), "30s ago", "timeAgo RFC3339");
+eq(L.timeAgo("not-a-date", NOW), "never", "timeAgo malformed string");
+
+// -- sanitizeName (haproxy.go:86-89 sanitize rule) ---------------------------
+eq(L.sanitizeName("chaucer"), "chaucer", "sanitize clean");
+eq(L.sanitizeName("svc_one.v2"), "svc-one-v2", "sanitize specials");
+eq(L.sanitizeName("UPPER"), "-----", "sanitize uppercase");
+eq(L.sanitizeName(null), "", "sanitize null");
+
+// -- formatPorts -------------------------------------------------------------
+eq(L.formatPorts([{ ServicePort: 8080, Port: 31000 }, { Port: 9090 }]),
+   "8080→31000, 9090", "formatPorts mapped+bare");
+eq(L.formatPorts([]), "", "formatPorts empty");
+eq(L.formatPorts(null), "", "formatPorts null");
+
+// -- parseHaproxyCsv ---------------------------------------------------------
+const CSV = [
+  "# pxname,svname,scur,stot,status",
+  "chaucer-8000,FRONTEND,0,5,OPEN",
+  "chaucer-8000,node1-deadbeef01,1,4,UP",
+  "chaucer-8000,node2-deadbeef02,0,1,DOWN",
+  "chaucer-8000,BACKEND,1,5,UP",
+  "stats,FRONTEND,0,0,OPEN",
+  "bocaccio-9000,node1-cafe0002,2,9,UP 1/2",
+  "",
+].join("\n");
+const parsed = L.parseHaproxyCsv(CSV);
+eq(parsed.ok, true, "csv ok");
+eq(parsed.rows.length, 3, "csv keeps only backend server rows");
+eq(parsed.map["chaucer"]["node1"]["deadbeef01"].status, "UP",
+   "csv map path svc->host->container");
+eq(parsed.map["bocaccio"]["node1"]["cafe0002"].scur, "2", "csv cell");
+eq(L.parseHaproxyCsv("").ok, false, "csv empty input not ok");
+eq(L.parseHaproxyCsv("\n\n").ok, false, "csv blank lines not ok");
+
+// -- haproxyHasIn (catalog instance -> proxy presence tick) ------------------
+const svc = { Name: "chaucer", Hostname: "node1", ID: "deadbeef01" };
+eq(L.haproxyHasIn(parsed.map, svc), true, "haproxyHas present");
+eq(L.haproxyHasIn(parsed.map,
+                  { ...svc, ID: "nope" }), false, "haproxyHas absent id");
+eq(L.haproxyHasIn(parsed.map,
+                  { ...svc, Name: "gone" }), false, "haproxyHas absent svc");
+// catalog name with specials matches its sanitized proxy name
+const p2 = L.parseHaproxyCsv([
+  "# pxname,svname,status",
+  "svc-one-v2-8000,h1-abc,UP"].join("\n"));
+eq(L.haproxyHasIn(p2.map, { Name: "svc_one.v2", Hostname: "h1",
+                            ID: "abc" }),
+   true, "haproxyHas sanitizes catalog name");
+
+// -- extractJsonDocs (the /watch chunked-stream framer) ----------------------
+let r = L.extractJsonDocs('{"a":1}{"b":{"c":2}}{"d"');
+eq(r.docs, [{ a: 1 }, { b: { c: 2 } }], "frames two complete docs");
+eq(r.rest, '{"d"', "keeps the partial tail");
+r = L.extractJsonDocs(r.rest + ':4}');
+eq(r.docs, [{ d: 4 }], "completes across chunk boundary");
+eq(r.rest, "", "tail consumed");
+r = L.extractJsonDocs('{"s":"a}b{c","t":"\\"{"}');
+eq(r.docs, [{ s: "a}b{c", t: '"{' }], "braces inside strings ignored");
+r = L.extractJsonDocs('  {"x":1} trailing');
+eq(r.docs, [{ x: 1 }], "leading junk tolerated");
+eq(r.rest, " trailing", "non-brace tail kept");
+r = L.extractJsonDocs("");
+eq(r.docs, [], "empty input no docs");
+
+// -- report ------------------------------------------------------------------
+const summary = failures.length
+  ? `FAIL ${failures.length}/${checks}:\n  ${failures.join("\n  ")}`
+  : `PASS ${checks} checks`;
+if (typeof process !== "undefined" && process.exit) {
+  console.log(summary);
+  process.exit(failures.length ? 1 : 0);
+} else if (typeof document !== "undefined") {
+  document.body.textContent = summary;
+  document.title = failures.length ? "UI tests: FAIL" : "UI tests: PASS";
+}
