@@ -183,11 +183,13 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
     thr_total = eps * nm
     thr_unsettled = eps * behind0
 
-    # Chunk is 3 metric samples per dispatch: the ε check still has
+    # Chunk several metric samples per dispatch: the ε check still has
     # conv_every granularity (the returned curve is scanned per sample)
     # while the host↔device round-trip — ~100 ms on a tunneled chip —
-    # amortizes over 3× more rounds.
-    chunk = 3 * conv_every
+    # amortizes over more rounds.  Clamped to ≤150 rounds/dispatch: the
+    # tunnel worker crashes on very long scan dispatches, and the clamp
+    # must not depend on call sites keeping conv_every small.
+    chunk = conv_every * max(1, 150 // conv_every)
     warm, c = sim.run_behind(state, key, chunk, conv_every)
     jax.device_get(c)
 

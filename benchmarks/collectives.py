@@ -45,16 +45,33 @@ BOARD_BYTES = N * K * 4          # ~100 MB
 
 
 def timed(fn, arg, iters=30, reps=3):
+    """Time ``iters`` applications of ``fn`` inside ONE lax.scan
+    dispatch (per-dispatch overhead on the tunneled chip is ~10-100 ms,
+    so chained individual calls measure the tunnel, not the op)."""
     import jax
+    from jax import lax
 
-    out = fn(arg)
-    jax.device_get(jax.tree_util.tree_leaves(out)[0])
+    @jax.jit
+    def run(v):
+        out = lax.scan(lambda c, _: (fn(c), None), v, None,
+                       length=iters)[0]
+        # Sync on a SCALAR: device_get of the full operand would pull
+        # ~100 MB back through the tunnel and dominate the measurement.
+        return out, jnp_sum_scalar(out)
+
+    import jax.numpy as jnp
+
+    def jnp_sum_scalar(t):
+        leaves = jax.tree_util.tree_leaves(t)
+        return sum(jnp.sum(leaf) for leaf in leaves)
+
+    out, s = run(arg)
+    jax.device_get(s)
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(out)
-        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        out, s = run(out)
+        jax.device_get(s)
         best = min(best, time.perf_counter() - t0)
     return best / iters * 1000.0
 
@@ -65,7 +82,6 @@ def tpu_hbm_floor():
 
     x = jnp.ones((N, K), jnp.int32)
 
-    @jax.jit
     def copy(v):
         return v + 1                   # read 100 MB + write 100 MB
 
@@ -97,7 +113,6 @@ def cpu_mesh_collectives():
     row = NamedSharding(mesh, P("x"))
     x = jax.device_put(jnp.ones((N, K), jnp.int32), row)
 
-    @jax.jit
     def ag(v):
         def f(vl):
             g = lax.all_gather(vl, "x", tiled=True)    # [N, K] per dev
@@ -113,7 +128,6 @@ def cpu_mesh_collectives():
     y = jax.device_put(jnp.ones((d * d, C, K), jnp.int32),
                        NamedSharding(mesh, P("x")))
 
-    @jax.jit
     def a2a(v):
         def f(vl):
             return lax.all_to_all(vl, "x", 0, 0) + 1

@@ -1,0 +1,106 @@
+"""Round 3: can the publish threshold beat exact int32 top_k?
+
+  topk32    exact top_k on int32 [N, 256] (current)
+  topk16    top_k on an int16 surrogate (dynamic shift keeps ~13-bit
+            freshness resolution; the tie-rank admission makes ANY
+            coarser threshold budget-exact, so this is safe-by-
+            construction)
+  hist64    64-bin recency histogram (one-hot matmul) + cumsum
+            threshold — freshness at window/64 granularity
+
+Run: python benchmarks/hotpath_variants3.py
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+K = 256
+BUDGET = 15
+N = 100_000
+
+
+def make_priority(seed=0):
+    rng = np.random.default_rng(seed)
+    occ = rng.random((N, K)) < 0.15
+    # realistic packed keys: recent ticks in a narrow window
+    val = np.where(occ, (rng.integers(20_000, 25_000, (N, K)) << 3),
+                   0).astype(np.int32)
+    return jnp.asarray(val)
+
+
+def timed_scan(body, carry, iters=60, reps=3):
+    @jax.jit
+    def run(c):
+        return lax.scan(body, c, jnp.arange(iters, dtype=jnp.int32))[0]
+
+    out = run(carry)
+    jax.device_get(jax.tree_util.tree_leaves(out)[0])
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = run(carry)
+        jax.device_get(jax.tree_util.tree_leaves(out)[0])
+        best = min(best, time.perf_counter() - t0)
+    return best / iters * 1000.0
+
+
+def main():
+    pv0 = make_priority()
+    results = {}
+
+    def topk32(carry, i):
+        acc, pv = carry
+        p = pv ^ (i & 1)
+        thresh = lax.top_k(p, BUDGET)[0][:, -1:]
+        sel = (p > thresh) | ((p == thresh) & (p > 0))
+        return (acc + jnp.sum(sel.astype(jnp.int32)), pv), None
+
+    def topk16(carry, i):
+        acc, pv = carry
+        p = pv ^ (i & 1)
+        now_max = jnp.max(p)
+        shift = jnp.maximum(
+            0, 32 - jnp.int32(lax.clz(jnp.maximum(now_max, 1))) - 13)
+        p16 = (p >> shift).astype(jnp.int16)
+        thresh = lax.top_k(p16, BUDGET)[0][:, -1:]
+        sel = (p16 > thresh) | ((p16 == thresh) & (p > 0))
+        return (acc + jnp.sum(sel.astype(jnp.int32)), pv), None
+
+    def hist64(carry, i):
+        acc, pv = carry
+        p = pv ^ (i & 1)
+        now_max = jnp.max(p)
+        lo = now_max - (1 << 15)       # window floor
+        b = jnp.clip((p - lo) >> 9, 0, 63)      # 64 bins, newest high
+        b = jnp.where(p > 0, b, -1)
+        oh = jax.nn.one_hot(b, 64, dtype=jnp.bfloat16)  # [N, K, 64]
+        hist = jnp.sum(oh, axis=1).astype(jnp.int32)    # [N, 64]
+        # admit from the newest bin downward
+        rev = jnp.cumsum(hist[:, ::-1], axis=1)[:, ::-1]
+        tbin = 63 - jnp.argmax((rev >= BUDGET)[:, ::-1], axis=1)
+        have = jnp.any(rev >= BUDGET, axis=1)
+        tbin = jnp.where(have, tbin, 0)
+        sel = (b > tbin[:, None]) | ((b == tbin[:, None]) & (p > 0))
+        return (acc + jnp.sum(sel.astype(jnp.int32)), pv), None
+
+    for name, fn in [("topk32", topk32), ("topk16", topk16),
+                     ("hist64", hist64)]:
+        results[name] = round(
+            timed_scan(fn, (jnp.zeros((), jnp.int32), pv0)), 3)
+        print(json.dumps(results), flush=True)
+
+    print("FINAL " + json.dumps(
+        {"n": N, "platform": jax.devices()[0].platform, **results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,13 @@ the absolute numbers and the breakdown:
 Run: ``python benchmarks/live_node.py [nodes] [spn] [steady_seconds]``
 (defaults 3 nodes x 10 services, 30 s).  Prints one JSON document.
 Wants a quiet host — CPU contention skews the latency numbers.
+
+``LIVE_NODE_NO_SITE=1`` runs every node under ``python -S`` — no
+``site``/``sitecustomize``, hence no JAX import — which reproduces the
+shipped container environment (docker/Dockerfile deliberately excludes
+JAX): the RSS measured in this mode is the number comparable to the
+reference's < 20 MB claim, measured on THIS host rather than inside a
+container the bench host cannot run.
 """
 
 import json
@@ -42,6 +49,7 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 
 BASE_GOSSIP = 18700   # bind ports BASE..BASE+n-1
 BASE_HTTP = 18760
+NO_SITE = os.environ.get("LIVE_NODE_NO_SITE") == "1"
 
 
 def make_static_fixture(tmpdir: str, spn: int) -> str:
@@ -75,9 +83,11 @@ def spawn_node(i: int, static_file: str, tmpdir: str) -> subprocess.Popen:
     if i > 0:
         env["SIDECAR_SEEDS"] = f"127.0.0.1:{BASE_GOSSIP}"
     log = open(os.path.join(tmpdir, f"node-{i}.log"), "w")
+    interp = [sys.executable] + (["-S"] if NO_SITE else [])
     return subprocess.Popen(
-        [sys.executable, "-m", "sidecar_tpu.main",
-         "--http-port", str(BASE_HTTP + i), "--hostname", f"bench-{i}"],
+        interp + ["-m", "sidecar_tpu.main",
+                  "--http-port", str(BASE_HTTP + i),
+                  "--hostname", f"bench-{i}"],
         cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT)
 
 
@@ -104,8 +114,9 @@ def interpreter_baseline() -> tuple[float, int]:
     """(RSS MB, thread count) of a do-nothing interpreter in this
     environment — whatever sitecustomize drags in (JAX here) charges
     every Python process before a single line of the framework runs."""
-    probe = subprocess.Popen([sys.executable, "-c",
-                              "import time; time.sleep(30)"])
+    interp = [sys.executable] + (["-S"] if NO_SITE else [])
+    probe = subprocess.Popen(interp + ["-c",
+                             "import time; time.sleep(30)"])
     try:
         time.sleep(3.0)
         st = proc_status(probe.pid)
@@ -208,7 +219,12 @@ def main() -> None:
         print(json.dumps({
             "config": {"nodes": n, "services_per_node": spn,
                        "steady_seconds": steady,
-                       "gossip_interval_ms": 200},
+                       "gossip_interval_ms": 200,
+                       "interpreter": ("python -S (container-"
+                                       "equivalent: no sitecustomize, "
+                                       "no JAX)" if NO_SITE
+                                       else "python (bench host: "
+                                       "sitecustomize imports JAX)")},
             "interpreter_baseline_rss_mb": round(baseline, 1),
             "interpreter_baseline_threads": baseline_threads,
             "per_node": per_node,

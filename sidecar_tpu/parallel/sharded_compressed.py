@@ -121,6 +121,11 @@ class ShardedCompressedSim(CompressedSim):
             raise ValueError("a2a_slack must be >= 1")
         self.board_exchange = board_exchange
         self.a2a_slack = a2a_slack
+        # The in-flight-list census path is excluded from sharded
+        # compilation (XLA CPU GSPMD segfault — see
+        # CompressedSim._behind_and_denom); the gather fast path is
+        # bit-identical.
+        self.metric_list_ok = False
         self.mesh = mesh if mesh is not None else make_mesh()
         self.d = self.mesh.devices.size
         if params.n % self.d != 0:

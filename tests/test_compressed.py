@@ -63,7 +63,7 @@ class TestConvergence:
         sim = CompressedSim(p, topology.complete(64), PINNED)
         st = sim.init_state()
         slots = jnp.arange(5, dtype=jnp.int32) * 11
-        lines = np.asarray(hash_line(slots, p.cache_lines))
+        lines = np.asarray(hash_line(slots, p.cache_lines, p.services_per_node))
         assert len(set(lines.tolist())) == 5, "pick collision-free slots"
         st = sim.mint(st, slots, 10)
         st, conv = sim.run(st, jax.random.PRNGKey(0), 60)
@@ -93,7 +93,7 @@ class TestConvergence:
         hash serializes each line's drain (newest first, losers re-enter
         via owner recovery); all must still fold to 1.0 monotonically."""
         p = CompressedParams(n=128, services_per_node=10, cache_lines=256)
-        lines = np.asarray(hash_line(jnp.arange(p.m), p.cache_lines))
+        lines = np.asarray(hash_line(jnp.arange(p.m), p.cache_lines, p.services_per_node))
         by_line: dict[int, list[int]] = {}
         for s, l in enumerate(lines):
             by_line.setdefault(int(l), []).append(s)
@@ -354,6 +354,49 @@ class TestMetricFastPath:
         np.testing.assert_allclose(got, want, rtol=0, atol=1e-6)
 
 
+class TestMetricPathEquality:
+    """All three census paths — exact scatter, [N,K]-gather fast, and
+    the in-flight-list fast_list — must agree bit-for-bit wherever
+    their guards allow them (the bench's ε detector rides on this)."""
+
+    def _behind_all_paths(self, p_base, st, topo):
+        # "list": cap covers the whole in-flight set → fast_list runs.
+        # "gather": metric_list_ok=False excludes fast_list from the
+        # compiled program entirely, so the gather form runs whenever
+        # the in-flight count is nonzero — the comparison can never
+        # degenerate into list-vs-list.
+        list_sim = CompressedSim(p_base, topo, PINNED)
+        gather_sim = CompressedSim(p_base, topo, PINNED)
+        gather_sim.metric_list_ok = False
+        return {"list": float(list_sim.behind(st)),
+                "gather": float(gather_sim.behind(st))}
+
+    def test_list_equals_gather_mid_flight(self):
+        p = CompressedParams(n=128, services_per_node=10, cache_lines=64)
+        topo = topology.complete(p.n)
+        sim = CompressedSim(p, topo, PINNED)
+        st = mint_random(sim, sim.init_state(), 60, 10, seed=3)
+        st = sim.run_fast(st, jax.random.PRNGKey(1), 7)
+        vals = self._behind_all_paths(p, st, topo)
+        assert vals["list"] == vals["gather"], vals
+        assert vals["list"] > 0  # mid-flight: something is behind
+
+    def test_list_equals_gather_under_collisions(self):
+        p = CompressedParams(n=64, services_per_node=10, cache_lines=16)
+        topo = topology.complete(p.n)
+        sim = CompressedSim(p, topo, PINNED)
+        st = mint_random(sim, sim.init_state(), 100, 10, seed=9)
+        for rounds in (3, 9, 30):
+            st2 = sim.run_fast(st, jax.random.PRNGKey(2), rounds)
+            vals = self._behind_all_paths(p, st2, topo)
+            assert vals["list"] == vals["gather"], (rounds, vals)
+
+    def test_converged_reads_zero(self):
+        p = CompressedParams(n=32, services_per_node=4, cache_lines=16)
+        sim = CompressedSim(p, topology.complete(p.n), PINNED)
+        assert float(sim.behind(sim.init_state())) == 0.0
+
+
 class TestTtlOrphanFree:
     def test_ttl_floor_bump_frees_leaped_copies(self):
         """A floor entry expiring to TOMBSTONE at ts+1 s leaps over
@@ -396,7 +439,7 @@ class TestBelowFloorWinnerFreed:
         st = sim.init_state()
         # Plant a stale copy by hand: slot 5 at the boot-floor version
         # (== floor, i.e. at-or-below) on node 3's matching line.
-        line = int(hash_line(jnp.asarray(5), p.cache_lines))
+        line = int(hash_line(jnp.asarray(5), p.cache_lines, p.services_per_node))
         boot = int(pack(1, ALIVE))
         st = dataclasses.replace(
             st,
@@ -452,15 +495,21 @@ class TestInsertOffersEquivalence:
             se = jnp.asarray(rng.integers(0, 16,
                                           size=(p.n, p.cache_lines),
                                           dtype=np.int8))
-            slots = jnp.asarray(rng.integers(
-                0, p.m, size=(p.n, p.services_per_node), dtype=np.int32))
+            # Legal inserts are per-row OWNER RUNS (a node's own slots,
+            # or a rolled partner's): base + 0..S-1, arbitrary owners —
+            # duplicates across rows included (two rows can see the
+            # same partner).
+            base = jnp.asarray(rng.integers(0, p.n, size=(p.n,),
+                                            dtype=np.int32)) \
+                * p.services_per_node
+            slots = base[:, None] + jnp.arange(p.services_per_node,
+                                               dtype=jnp.int32)[None, :]
             ov = jnp.asarray(rng.integers(
                 0, 1 << 20, size=(p.n, p.services_per_node),
                 dtype=np.int32))
-            lines = hash_line(slots, p.cache_lines)
+            lines = hash_line(slots, p.cache_lines, p.services_per_node)
             for hold in (False, True):
-                a = sim._insert_own_offers(cv, cs, se, ov, slots, lines,
-                                           hold)
+                a = sim._insert_own_offers(cv, cs, se, ov, base, hold)
                 b = sequential(sim, cv, cs, se, ov, slots, lines, hold)
                 for x, y, name in zip(a, b, ("val", "slot", "sent", "ev")):
                     np.testing.assert_array_equal(

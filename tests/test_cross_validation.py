@@ -105,7 +105,7 @@ def collision_free_slots(rng, count, statuses=None):
     the burst is spread across the ring, not clustered)."""
     picked, lines, owners = [], set(), set()
     for slot in rng.permutation(M):
-        line = int(hash_line(jnp.asarray(int(slot)), K))
+        line = int(hash_line(jnp.asarray(int(slot)), K, SPN))
         owner = int(slot) // SPN
         if line in lines or owner in owners:
             continue
