@@ -267,7 +267,27 @@ def main() -> None:
     rounds = int(os.environ.get("BENCH_ROUNDS", "200"))
     ns_n = int(os.environ.get("BENCH_NORTH_STAR_NODES", "100000"))
 
-    platform = jax.devices()[0].platform
+    # The tunneled TPU backend can be transiently unavailable (worker
+    # restart); failing the whole bench on the first init attempt
+    # throws the run away.  Retrying is only sound when JAX_PLATFORMS
+    # pins a non-cpu backend (as this environment does: =axon): jax
+    # 0.9.0 otherwise leaves an already-initialized CPU backend in its
+    # cache after a TPU init failure, and the "retry" would silently
+    # return that CPU backend — publishing shrunken-fallback numbers as
+    # the headline.  Unpinned platforms fail fast instead.
+    want = os.environ.get("JAX_PLATFORMS", "")
+    retries = 3 if want and want != "cpu" else 0
+    platform = None
+    for attempt in range(retries + 1):
+        try:
+            platform = jax.devices()[0].platform
+            break
+        except RuntimeError as exc:
+            if attempt == retries:
+                raise
+            print(f"# device init failed ({exc}); retrying in 60 s",
+                  file=sys.stderr)
+            time.sleep(60)
     if platform == "cpu":
         # CPU fallback (no TPU attached): shrink so the bench still
         # runs; explicit env overrides are honored.

@@ -318,6 +318,23 @@ class TestAdsStream:
         pushed = mock.recv()
         assert pushed.version_info != resp.version_info
 
+    def test_stale_nonce_with_changed_names_is_served(self, ads):
+        """A stale-nonce request's ACK/NACK meaning is void, but a
+        changed resource_names set is the client's CURRENT subscription
+        and must be answered immediately — an EDS cluster added on a
+        superseded nonce must not wait for the next catalog change."""
+        state, server, mock = ads
+        x = mock.x
+        mock.send(TYPE_ENDPOINT, names=["web:8080"])
+        resp = mock.recv()
+        mock.send(TYPE_ENDPOINT, version=resp.version_info, nonce="999",
+                  names=["web:8080", "raw-tcp:9000"])
+        rescoped = mock.recv()
+        assert rescoped.version_info == resp.version_info
+        names = {x.ClusterLoadAssignment.FromString(r.value).cluster_name
+                 for r in rescoped.resources}
+        assert names == {"web:8080", "raw-tcp:9000"}
+
 
 def test_port_conflict_raises_not_shared():
     """grpc's default so_reuseport would let two ADS servers silently

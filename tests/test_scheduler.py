@@ -1,0 +1,128 @@
+"""The node scheduler (runtime/scheduler.py): one thread driving every
+periodic loop.  Pins the Looper-contract adoption the live node depends
+on (interval cadence, immediate-vs-delayed first run, quit propagation
+and promptness, error capture, serialization on the shared thread)."""
+
+import threading
+import time
+
+import pytest
+
+from sidecar_tpu.runtime.looper import TimedLooper
+from sidecar_tpu.runtime.scheduler import Scheduler
+
+
+@pytest.fixture
+def sched():
+    s = Scheduler(name="test-scheduler")
+    yield s
+    s.stop()
+
+
+def wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestDrive:
+    def test_periodic_ticks(self, sched):
+        ticks = []
+        looper = TimedLooper(0.05)
+        sched.drive(looper, lambda: ticks.append(time.monotonic()))
+        assert wait_for(lambda: len(ticks) >= 4)
+        looper.quit()
+        # Cadence ≈ interval (fn-end + interval semantics; generous
+        # bounds for a loaded CI host).
+        gaps = [b - a for a, b in zip(ticks, ticks[1:])]
+        assert all(g >= 0.04 for g in gaps), gaps
+
+    def test_immediate_flag(self, sched):
+        t0 = time.monotonic()
+        first = []
+        looper = TimedLooper(0.5, immediate=True)
+        sched.drive(looper, lambda: first.append(time.monotonic()))
+        assert wait_for(lambda: first)
+        assert first[0] - t0 < 0.4          # ran well before one interval
+        looper.quit()
+
+        delayed = []
+        looper2 = TimedLooper(0.2, immediate=False)
+        sched.drive(looper2, lambda: delayed.append(time.monotonic()))
+        t1 = time.monotonic()
+        assert wait_for(lambda: delayed)
+        assert delayed[0] - t1 >= 0.15      # waited one interval first
+        looper2.quit()
+
+    def test_many_tasks_one_thread(self, sched):
+        thread_ids = set()
+        counts = [0] * 5
+        loopers = [TimedLooper(0.03) for _ in range(5)]
+
+        def mk(i):
+            def fn():
+                thread_ids.add(threading.get_ident())
+                counts[i] += 1
+            return fn
+
+        for i, looper in enumerate(loopers):
+            sched.drive(looper, mk(i), name=f"task-{i}")
+        assert wait_for(lambda: all(c >= 3 for c in counts))
+        for looper in loopers:
+            looper.quit()
+        assert len(thread_ids) == 1          # all on the scheduler thread
+
+
+class TestQuit:
+    def test_quit_is_prompt_and_sets_done(self, sched):
+        ran = []
+        looper = TimedLooper(5.0)            # long interval
+        sched.drive(looper, lambda: ran.append(1))
+        assert wait_for(lambda: ran)         # immediate first run
+        t0 = time.monotonic()
+        looper.quit()
+        # TimedLooper contract: quit takes effect within one
+        # interruptible wait, NOT at the next 5 s deadline.
+        assert looper.wait(timeout=1.0), "done not set promptly on quit"
+        assert time.monotonic() - t0 < 1.0
+        n = len(ran)
+        time.sleep(0.15)
+        assert len(ran) == n                 # no further ticks
+
+    def test_stop_retires_everything(self):
+        sched = Scheduler(name="stop-test")
+        loopers = [TimedLooper(0.05) for _ in range(3)]
+        for looper in loopers:
+            sched.drive(looper, lambda: None)
+        sched.stop()
+        for looper in loopers:
+            assert looper.wait(timeout=1.0)
+
+
+class TestErrors:
+    def test_raising_task_stops_and_records(self, sched):
+        boom = RuntimeError("tick failed")
+        ran = []
+
+        def fn():
+            ran.append(1)
+            raise boom
+
+        looper = TimedLooper(0.02)
+        sched.drive(looper, fn)
+        assert wait_for(lambda: looper.wait(0.01))
+        assert looper.error is boom          # Looper.loop parity
+        assert len(ran) == 1                 # stopped after the raise
+
+    def test_sibling_survives_a_raising_task(self, sched):
+        good = []
+        bad_looper = TimedLooper(0.02)
+        good_looper = TimedLooper(0.02)
+        sched.drive(bad_looper, lambda: 1 / 0, name="bad")
+        sched.drive(good_looper, lambda: good.append(1), name="good")
+        assert wait_for(lambda: len(good) >= 5)
+        assert isinstance(bad_looper.error, ZeroDivisionError)
+        good_looper.quit()
