@@ -396,6 +396,30 @@ class TestMetricPathEquality:
         sim = CompressedSim(p, topology.complete(p.n), PINNED)
         assert float(sim.behind(sim.init_state())) == 0.0
 
+    def test_over_cap_routes_to_gather_and_agrees(self):
+        """More in-flight slots than metric_inflight_cap: the switch
+        must route to the gather form (the list would truncate), and
+        the tiny-cap sim must agree with an uncapped one."""
+        p_small = CompressedParams(n=64, services_per_node=10,
+                                   cache_lines=64, metric_inflight_cap=4)
+        p_big = CompressedParams(n=64, services_per_node=10,
+                                 cache_lines=64)
+        topo = topology.complete(64)
+        sim_small = CompressedSim(p_small, topo, PINNED)
+        sim_big = CompressedSim(p_big, topo, PINNED)
+        st = mint_random(sim_small, sim_small.init_state(), 50, 10,
+                         seed=11)
+        st = sim_small.run_fast(st, jax.random.PRNGKey(4), 5)
+        # Premise guard: the routing under test only happens while the
+        # in-flight count exceeds the small cap.
+        n_if = int(jnp.sum(jnp.maximum(st.floor,
+                                       st.own.reshape(p_small.m))
+                           > st.floor))
+        assert n_if > p_small.metric_inflight_cap, n_if
+        a = float(sim_small.behind(st))
+        b = float(sim_big.behind(st))
+        assert a == b and a > 0, (a, b)
+
 
 class TestTtlOrphanFree:
     def test_ttl_floor_bump_frees_leaped_copies(self):
