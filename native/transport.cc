@@ -68,6 +68,10 @@ constexpr uint8_t kTypePing = 1;
 constexpr uint8_t kTypeAck = 2;
 constexpr uint8_t kTypePingReq = 3;
 constexpr uint8_t kTypeAckFwd = 4;
+// Pseudo packet type for the test drop mask only: bit 5 refuses TCP
+// push-pull exchanges with the node, so an injected partition severs
+// anti-entropy exactly as it severs UDP gossip.
+constexpr uint8_t kTypePushPull = 5;
 
 constexpr uint8_t kFrameUser = 0;
 constexpr uint8_t kFrameMembership = 1;
@@ -437,8 +441,25 @@ class Transport {
     addr.sin_family = AF_INET;
     addr.sin_port = htons(port);
     addr.sin_addr.s_addr = inet_addr(ip.c_str());
-    sendto(udp_fd_, pkt.data(), pkt.size(), 0,
-           reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    ssize_t rc = sendto(udp_fd_, pkt.data(), pkt.size(), 0,
+                        reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+    if (rc < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      // udp_fd_ is O_NONBLOCK for the poll()-driven receive path, but
+      // sends share the fd: under send-buffer pressure the old blocking
+      // behavior becomes a silent drop — and dropped acks under burst
+      // inflate false suspicions.  Briefly wait for POLLOUT and retry
+      // once; a still-full buffer after that is a genuine (counted)
+      // drop, like any congested UDP path.
+      pollfd pfd{udp_fd_, POLLOUT, 0};
+      if (::poll(&pfd, 1, 10) > 0 && (pfd.revents & POLLOUT)) {
+        rc = sendto(udp_fd_, pkt.data(), pkt.size(), 0,
+                    reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+      }
+    }
+    if (rc < 0) {
+      udp_send_drops_.fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
     udp_out_.fetch_add(1, std::memory_order_relaxed);
     udp_bytes_out_.fetch_add(pkt.size(), std::memory_order_relaxed);
   }
@@ -450,7 +471,8 @@ class Transport {
   int stats(unsigned long long* out, int n) {
     const unsigned long long vals[] = {
         udp_out_.load(),      udp_bytes_out_.load(), udp_in_.load(),
-        udp_bytes_in_.load(), pushpull_out_.load(),  pushpull_in_.load()};
+        udp_bytes_in_.load(), pushpull_out_.load(),  pushpull_in_.load(),
+        udp_send_drops_.load()};
     int count = static_cast<int>(sizeof(vals) / sizeof(vals[0]));
     if (n < count) count = n;
     for (int i = 0; i < count; i++) out[i] = vals[i];
@@ -1093,6 +1115,15 @@ class Transport {
     // Cluster isolation BEFORE the payload: a foreign (or hostile) peer
     // must not get to size our allocation.
     if (cluster != cluster_) return false;
+    {
+      // Injected-partition gating: refuse the exchange before the
+      // payload, so neither side merges (the initiator's recv then
+      // fails too — a severed pair exchanges nothing, like a real cut).
+      std::lock_guard<std::mutex> lk(mu_);
+      auto dit = test_drops_.find(node);
+      if (dit != test_drops_.end() && (dit->second >> kTypePushPull) & 1u)
+        return false;
+    }
     uint16_t port = get_u16(pbuf);
     uint32_t inc = get_u32(pbuf + 2);
     uint8_t lbuf[4];
@@ -1177,7 +1208,8 @@ class Transport {
   std::atomic<uint32_t> incarnation_{1};
   std::atomic<uint32_t> next_seq_{1};
   std::atomic<unsigned long long> udp_out_{0}, udp_bytes_out_{0},
-      udp_in_{0}, udp_bytes_in_{0}, pushpull_out_{0}, pushpull_in_{0};
+      udp_in_{0}, udp_bytes_in_{0}, pushpull_out_{0}, pushpull_in_{0},
+      udp_send_drops_{0};
   std::vector<std::thread> threads_;
   std::vector<uint8_t> udp_buf_ = std::vector<uint8_t>(65536);
   std::shared_ptr<std::atomic<bool>> pp_inflight_ =

@@ -211,6 +211,21 @@ class ServicesState:
         Go channel send."""
         self.service_msgs.put(svc)
 
+    def offer_service(self, svc: Service, timeout: float = 0.0) -> bool:
+        """Non-wedging variant of :meth:`update_service`: returns False
+        instead of blocking past ``timeout`` when the single-writer
+        queue is full.  The transport bridge loop uses this so a stalled
+        writer cannot wedge the shared outbound/inbound thread — shed
+        records are re-delivered by anti-entropy."""
+        try:
+            if timeout > 0.0:
+                self.service_msgs.put(svc, timeout=timeout)
+            else:
+                self.service_msgs.put_nowait(svc)
+            return True
+        except queue.Full:
+            return False
+
     def process_service_msgs(self, looper: Looper) -> None:
         """Single-writer loop draining ``service_msgs``
         (services_state.go:129-135)."""

@@ -1,0 +1,40 @@
+"""Deterministic chaos/fault-injection framework.
+
+One seeded :class:`FaultPlan` (sidecar_tpu/chaos/plan.py) drives BOTH
+execution paths:
+
+* the TPU simulator — :class:`ChaosExactSim` (sim_inject.py) threads
+  per-edge drop/delay/duplicate schedules, asymmetric partitions, and
+  node crash/pause/restart windows through ``lax.scan``;
+* the live in-process cluster — :class:`LiveInjector` (live_inject.py)
+  shims the ``transport/gossip.py`` send/recv boundary and
+  ``health/checks.py``.
+
+See docs/chaos.md for the schema and the reproduce-from-seed workflow.
+"""
+
+from sidecar_tpu.chaos.plan import (
+    EdgeFault,
+    FaultPlan,
+    HealthFault,
+    NodeFault,
+    coin,
+    resolve_nodes,
+)
+from sidecar_tpu.chaos.sim_inject import (
+    ChaosExactSim,
+    ChaosSimState,
+    CompiledFaultPlan,
+)
+
+__all__ = [
+    "ChaosExactSim",
+    "ChaosSimState",
+    "CompiledFaultPlan",
+    "EdgeFault",
+    "FaultPlan",
+    "HealthFault",
+    "NodeFault",
+    "coin",
+    "resolve_nodes",
+]

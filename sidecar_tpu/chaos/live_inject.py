@@ -1,0 +1,299 @@
+"""FaultPlan → the live in-process cluster.
+
+The injection shim sits at the Python boundaries the issue names:
+
+* ``transport/gossip.py`` — every GossipTransport accepts a
+  ``fault_injector``; the bridge loop consults it on each decoded
+  inbound record (drop / delay / duplicate, per plan edge) and on each
+  outbound broadcast batch (node pause/crash windows silence the node).
+  Inbound edges are attributed by RECORD ORIGIN (``svc.hostname``) —
+  the gossip wire doesn't expose the relaying hop to Python, and
+  origin-edge attribution is the failure mode that actually matters
+  for catalog convergence (who can't hear about whom);
+* full partitions additionally use the native engine's receive-side
+  packet-drop hook (``st_test_drop_types``) through
+  :meth:`LiveChaosController.tick`, so SWIM probes and TCP push-pull
+  are cut exactly like user gossip;
+* ``health/checks.py`` — :class:`ChaosChecker` wraps any Checker and
+  injects the plan's slow/failing health-check windows.
+
+Determinism: every probabilistic decision is :func:`plan.coin` — a
+blake2b hash of (seed, src, dst, per-edge counter) — so the DECISION
+SEQUENCE per edge is a pure function of the plan seed.  (Live wall
+clock still schedules when packets exist at all; the sim path is the
+bit-reproducible twin.)
+
+All injections are counted in the process metrics registry
+(``chaos.live.*``) — degradation is observable, never silent.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time
+from typing import Optional
+
+from sidecar_tpu import metrics
+from sidecar_tpu.chaos.plan import FaultPlan, coin
+from sidecar_tpu.transport.gossip import DROP_ALL_UDP, DROP_PUSH_PULL
+
+
+class LiveInjector:
+    """One node's view of the plan: decides the fate of that node's
+    inbound records and outbound broadcasts.
+
+    ``node_names`` maps cluster node names → plan node indices (the
+    same indices a ChaosExactSim of this cluster would use); ``node``
+    is this node's name.  ``round_s`` maps wall clock onto plan rounds
+    — use the cluster's gossip interval so plan windows mean the same
+    thing on both paths.
+    """
+
+    def __init__(self, plan: FaultPlan, node_names: list[str], node: str,
+                 round_s: float) -> None:
+        if round_s <= 0:
+            raise ValueError("round_s must be positive")
+        self.plan = plan
+        self.index = {name: i for i, name in enumerate(node_names)}
+        if node not in self.index:
+            raise ValueError(f"node {node!r} not in {node_names}")
+        self.node = node
+        self.me = self.index[node]
+        self.round_s = round_s
+        # INERT until start(): the scenario builds and converges its
+        # cluster first, then anchors every node's injector (and the
+        # controller) to one shared t0 — plan windows mean the same
+        # round on every node, and setup traffic is never injected.
+        self._t0: Optional[float] = None
+        self._lock = threading.Lock()
+        self._counters: dict[int, int] = {}     # src index → decision seq
+        self._delayed: list = []                # (release, seq, svc)
+        self._seq = itertools.count()
+
+    # -- clock -------------------------------------------------------------
+
+    def start(self, t0: Optional[float] = None) -> None:
+        """(Re)anchor round 1 at ``t0`` (default: now).  Call when the
+        scenario actually begins so plan windows line up across nodes —
+        pass one shared stamp to every node's injector."""
+        self._t0 = time.monotonic() if t0 is None else t0
+
+    @property
+    def active(self) -> bool:
+        return self._t0 is not None
+
+    def round_now(self) -> int:
+        """Wall clock → plan round (1-based, like the simulator);
+        0 before :meth:`start` anchors the clock."""
+        if self._t0 is None:
+            return 0
+        return int((time.monotonic() - self._t0) / self.round_s) + 1
+
+    # -- transport shim: inbound -------------------------------------------
+
+    def _edge_decision(self, src: int, round_idx: int):
+        """(drop, delay_rounds, dup_delay_rounds) for the next record on
+        the (src → me) edge — dup_delay_rounds 0 means no duplicate.
+        One counter tick per record; each active plan entry draws its
+        own coin at stable coordinates."""
+        with self._lock:
+            seq = self._counters.get(src, 0)
+            self._counters[src] = seq + 1
+        drop = False
+        delay = 0
+        dup_delay = 0
+        for i, e in enumerate(self.plan.edges):
+            if not (e.start_round <= round_idx < e.end_round):
+                continue
+            src_set = e.src == "all" or src in e.src
+            dst_set = e.dst == "all" or self.me in e.dst
+            if not (src_set and dst_set):
+                continue
+            if e.drop_prob > 0.0 and \
+                    coin(self.plan.seed, "drop", i, src, self.me,
+                         seq) < e.drop_prob:
+                drop = True
+            if e.delay_prob > 0.0 and \
+                    coin(self.plan.seed, "delay", i, src, self.me,
+                         seq) < e.delay_prob:
+                delay = max(delay, e.delay_rounds)
+            if e.duplicate_prob > 0.0 and \
+                    coin(self.plan.seed, "dup", i, src, self.me,
+                         seq) < e.duplicate_prob:
+                dup_delay = max(dup_delay, e.ring_rounds)
+        return drop, delay, dup_delay
+
+    def on_recv(self, svc) -> list:
+        """The inbound boundary: returns the list of records to merge
+        NOW (possibly empty, possibly with a duplicate).  Delayed
+        records surface later through :meth:`due_records`."""
+        if not self.active:
+            return [svc]
+        r = self.round_now()
+        # Paused/crashed nodes accept nothing; the paused node's own
+        # bridge loop consults its own injector, so this models the
+        # stalled process from the inside.
+        if self.plan.node_down(self.me, r):
+            metrics.incr("chaos.live.droppedRecords")
+            return []
+        src = self.index.get(svc.hostname)
+        if src is None or src == self.me:
+            return [svc]
+        drop, delay, dup_delay = self._edge_decision(src, r)
+        if drop:
+            metrics.incr("chaos.live.droppedRecords")
+            return []
+        out = [svc]
+        if dup_delay:
+            # Mirror the sim ring: the duplicate re-arrives LATER (an
+            # immediate second copy would be a certain LWW no-op) — it
+            # is the late copy of a record the catalog may have moved
+            # past that exercises the idempotence/staleness path.
+            metrics.incr("chaos.live.duplicatedRecords")
+            release = time.monotonic() + dup_delay * self.round_s
+            with self._lock:
+                heapq.heappush(self._delayed,
+                               (release, next(self._seq), svc.copy()))
+        if delay:
+            metrics.incr("chaos.live.delayedRecords")
+            release = time.monotonic() + delay * self.round_s
+            with self._lock:
+                heapq.heappush(self._delayed,
+                               (release, next(self._seq), out.pop(0)))
+        return out
+
+    def due_records(self) -> list:
+        """Delayed records whose release time has passed — the bridge
+        loop drains this every cycle."""
+        now = time.monotonic()
+        out = []
+        with self._lock:
+            while self._delayed and self._delayed[0][0] <= now:
+                out.append(heapq.heappop(self._delayed)[2])
+        return out
+
+    def pending_delayed(self) -> int:
+        with self._lock:
+            return len(self._delayed)
+
+    def accept_push_pull(self) -> bool:
+        """False while this node is inside a pause/crash window: the
+        stalled process merges nothing, INCLUDING full-state TCP
+        push-pull payloads (the bridge's st_poll_state path, which
+        bypasses the per-record :meth:`on_recv` shim).  Without this
+        gate a 'paused' node would keep absorbing the whole remote
+        catalog every anti-entropy interval and converge through the
+        pause — the opposite of what the sim twin models."""
+        if not self.active:
+            return True
+        if self.plan.node_down(self.me, self.round_now()):
+            metrics.incr("chaos.live.droppedStateMerges")
+            return False
+        return True
+
+    # -- transport shim: outbound ------------------------------------------
+
+    def filter_send(self, prepared: list) -> list:
+        """The outbound boundary: a node inside a pause/crash window
+        broadcasts nothing (the process is stalled)."""
+        if not self.active:
+            return prepared
+        if prepared and self.plan.node_down(self.me, self.round_now()):
+            metrics.incr("chaos.live.droppedBroadcasts", len(prepared))
+            return []
+        return prepared
+
+    # -- health shim -------------------------------------------------------
+
+    def check_fault(self, check_id: str) -> tuple[float, bool]:
+        """(extra latency seconds, fail?) for a health check right now —
+        consumed by health.checks.ChaosChecker."""
+        if not self.active:
+            return 0.0, False
+        return self.plan.health_fault_at(check_id, self.round_now())
+
+
+class LiveChaosController:
+    """Cluster-side plan application: drives the faults that live
+    OUTSIDE a single node's record stream — full partitions (via the
+    native engine's receive-side packet drops, so SWIM and push-pull
+    are cut too) and node pause isolation.  Call :meth:`tick`
+    periodically (e.g. once per gossip interval) from the scenario
+    driver, or :meth:`run` on a thread."""
+
+    def __init__(self, plan: FaultPlan, transports: dict,
+                 round_s: float) -> None:
+        """``transports``: node name → GossipTransport, in PLAN ORDER
+        (dict insertion order defines the plan node indices — keep it
+        identical to the injectors' ``node_names``)."""
+        self.plan = plan
+        self.transports = transports
+        self.names = list(transports)
+        self.round_s = round_s
+        self._t0 = time.monotonic()
+        self._applied: dict[tuple[str, str], int] = {}
+        self._quit = threading.Event()
+
+    def start(self, t0: Optional[float] = None) -> None:
+        self._t0 = time.monotonic() if t0 is None else t0
+
+    def round_now(self) -> int:
+        return int((time.monotonic() - self._t0) / self.round_s) + 1
+
+    def _full_cut(self, src: int, dst: int, round_idx: int) -> bool:
+        for e in self.plan.edges:
+            if not e.full_cut:
+                continue
+            if not (e.start_round <= round_idx < e.end_round):
+                continue
+            if (e.src == "all" or src in e.src) and \
+                    (e.dst == "all" or dst in e.dst):
+                return True
+        return False
+
+    def tick(self) -> None:
+        """Reconcile the native receive-drop masks with the plan at the
+        current round.  UDP is cut per DIRECTION (a src→dst cut drops
+        every UDP type from src on dst's engine — asymmetric partitions
+        stay asymmetric); TCP push-pull is refused when EITHER direction
+        is fully cut (a one-way network cut kills TCP both ways), on
+        both engines, matching the sim's severing rule.  A node inside a
+        pause/crash window is isolated entirely."""
+        r = self.round_now()
+        for di, dname in enumerate(self.names):
+            dt = self.transports[dname]
+            for si, sname in enumerate(self.names):
+                if si == di:
+                    continue
+                down = self.plan.node_down(si, r) or \
+                    self.plan.node_down(di, r)
+                udp_cut = down or self._full_cut(si, di, r)
+                pp_cut = down or udp_cut or self._full_cut(di, si, r)
+                mask = (DROP_ALL_UDP if udp_cut else 0) | \
+                    (DROP_PUSH_PULL if pp_cut else 0)
+                key = (sname, dname)
+                if self._applied.get(key, 0) != mask:
+                    dt.test_drop_types(sname, mask)
+                    self._applied[key] = mask
+                    if mask:
+                        metrics.incr("chaos.live.partitionEdgesCut")
+
+    def run(self, poll_s: Optional[float] = None) -> threading.Thread:
+        """Apply the plan continuously on a daemon thread until
+        :meth:`stop`."""
+        poll = poll_s if poll_s is not None else self.round_s
+
+        def loop() -> None:
+            while not self._quit.is_set():
+                self.tick()
+                self._quit.wait(poll)
+
+        t = threading.Thread(target=loop, name="chaos-controller",
+                             daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._quit.set()

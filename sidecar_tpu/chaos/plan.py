@@ -1,0 +1,269 @@
+"""The FaultPlan: one seeded, declarative description of every fault a
+chaos run injects — shared by the TPU simulator and the live in-process
+cluster.
+
+Design requirements (the reason this is its own schema rather than ad
+hoc knobs on each component):
+
+* **Deterministic.**  Every probabilistic decision in a plan derives
+  from ``plan.seed`` plus stable coordinates (round index, edge, entry
+  index) — never from wall-clock entropy — so a failure found in CI can
+  be reproduced exactly from its seed (see docs/chaos.md).  The sim
+  path draws through the JAX threefry PRNG keyed on the seed; the live
+  path draws through :func:`coin`, a counter-based blake2b hash of the
+  same seed.  Each path is bit-reproducible against itself.
+* **Structured, not i.i.d.**  The "Robust and Tuneable Family of
+  Gossiping Algorithms" analysis (PAPERS.md) shows uniform loss is the
+  *easy* regime for epidemic protocols; the plan therefore expresses
+  per-EDGE schedules (source set × destination set × round window),
+  asymmetric partitions, and correlated node windows — the adversarial
+  structure a single ``drop_prob`` scalar cannot.
+* **Round-indexed.**  All windows are in gossip rounds (one round = one
+  GossipInterval).  The sim's round index is exact; the live injector
+  maps wall clock onto rounds via its configured round duration.
+
+Time windows are half-open ``[start_round, end_round)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import hashlib
+import json
+from typing import Iterable, Union
+
+# "all" or an explicit tuple of node indices.  Tuples (not lists) so
+# plans stay hashable — the sim closes over them as jit-static state.
+NodeSel = Union[str, tuple]
+
+FOREVER = 1 << 30
+
+
+def _as_sel(nodes) -> NodeSel:
+    if isinstance(nodes, str):
+        if nodes != "all":
+            raise ValueError(f"node selector string must be 'all', got "
+                             f"{nodes!r}")
+        return nodes
+    return tuple(int(i) for i in nodes)
+
+
+def resolve_nodes(sel: NodeSel, n: int) -> tuple:
+    """Selector → concrete node-index tuple for an ``n``-node cluster."""
+    if sel == "all":
+        return tuple(range(n))
+    bad = [i for i in sel if not 0 <= i < n]
+    if bad:
+        raise ValueError(f"node selector {bad} out of range for n={n}")
+    return tuple(sel)
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeFault:
+    """Per-edge message faults on the (src → dst) direction.
+
+    ``drop_prob`` loses the packet entirely; ``delay_prob`` diverts it
+    to arrive ``delay_rounds`` later; ``duplicate_prob`` delivers it now
+    AND again after ``max(delay_rounds, 1)`` rounds.  A full partition
+    in one direction is ``drop_prob=1.0``; an asymmetric 20% loss is
+    ``drop_prob=0.2`` with src/dst covering one direction only.
+    """
+
+    src: NodeSel = "all"
+    dst: NodeSel = "all"
+    start_round: int = 0
+    end_round: int = FOREVER
+    drop_prob: float = 0.0
+    delay_rounds: int = 0
+    delay_prob: float = 0.0
+    duplicate_prob: float = 0.0
+
+    def __post_init__(self):
+        object.__setattr__(self, "src", _as_sel(self.src))
+        object.__setattr__(self, "dst", _as_sel(self.dst))
+        for name in ("drop_prob", "delay_prob", "duplicate_prob"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{name}={v} not in [0, 1]")
+        if self.delay_rounds < 0:
+            raise ValueError("delay_rounds must be >= 0")
+        if self.delay_prob > 0.0 and self.delay_rounds == 0:
+            raise ValueError("delay_prob > 0 requires delay_rounds >= 1")
+        if self.start_round >= self.end_round:
+            raise ValueError(
+                f"empty window [{self.start_round}, {self.end_round})")
+
+    @property
+    def needs_ring(self) -> bool:
+        """True when the sim must carry a delay ring for this entry."""
+        return self.delay_prob > 0.0 or self.duplicate_prob > 0.0
+
+    @property
+    def ring_rounds(self) -> int:
+        """Depth of the delay ring (duplicates without an explicit delay
+        re-arrive the next round)."""
+        return max(self.delay_rounds, 1)
+
+    @property
+    def full_cut(self) -> bool:
+        """A deterministic total cut — severs TCP push-pull too (UDP
+        loss below 1.0 does not: TCP rides retransmission)."""
+        return self.drop_prob >= 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeFault:
+    """A correlated node window: ``pause`` (the process stalls — sends
+    and accepts nothing, state retained) or ``crash`` (same, but at
+    ``end_round`` the node restarts COLD: its belief row is wiped to a
+    fresh re-announce of its own records — the rejoin workload)."""
+
+    nodes: NodeSel
+    start_round: int
+    end_round: int
+    kind: str = "pause"
+
+    def __post_init__(self):
+        object.__setattr__(self, "nodes", _as_sel(self.nodes))
+        if self.kind not in ("pause", "crash"):
+            raise ValueError(f"kind must be pause|crash, got {self.kind!r}")
+        if self.start_round >= self.end_round:
+            raise ValueError(
+                f"empty window [{self.start_round}, {self.end_round})")
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthFault:
+    """Slow/failing health-check injection: checks whose id matches
+    ``id_pattern`` (fnmatch) gain ``extra_latency_s`` of synthetic IO
+    time inside the window; ``fail`` additionally makes them report
+    UNKNOWN.  This is the workload that exposes check-pool starvation
+    (ADVICE.md medium, health/monitor.py)."""
+
+    id_pattern: str = "*"
+    start_round: int = 0
+    end_round: int = FOREVER
+    extra_latency_s: float = 0.0
+    fail: bool = False
+
+    def matches(self, check_id: str) -> bool:
+        return fnmatch.fnmatch(check_id, self.id_pattern)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """The whole chaos schedule, rooted at one seed."""
+
+    seed: int
+    edges: tuple = ()
+    nodes: tuple = ()
+    health: tuple = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "edges", tuple(self.edges))
+        object.__setattr__(self, "nodes", tuple(self.nodes))
+        object.__setattr__(self, "health", tuple(self.health))
+        for e in self.edges:
+            if not isinstance(e, EdgeFault):
+                raise TypeError(f"edges entries must be EdgeFault, "
+                                f"got {type(e).__name__}")
+        for e in self.nodes:
+            if not isinstance(e, NodeFault):
+                raise TypeError(f"nodes entries must be NodeFault, "
+                                f"got {type(e).__name__}")
+        for e in self.health:
+            if not isinstance(e, HealthFault):
+                raise TypeError(f"health entries must be HealthFault, "
+                                f"got {type(e).__name__}")
+
+    # -- builders ----------------------------------------------------------
+
+    @staticmethod
+    def partition(side_a: Iterable[int], side_b: Iterable[int],
+                  start_round: int, end_round: int,
+                  direction: str = "both",
+                  loss_prob: float = 1.0) -> tuple:
+        """Edge entries for a (possibly asymmetric, possibly lossy
+        rather than total) partition between two node sets.
+
+        ``direction``: ``both`` | ``a_to_b`` | ``b_to_a`` — which
+        traffic direction is affected.  ``loss_prob < 1.0`` models a
+        degraded link instead of a clean split.
+        """
+        a, b = tuple(side_a), tuple(side_b)
+        if set(a) & set(b):
+            raise ValueError("partition sides overlap")
+        out = []
+        if direction in ("both", "a_to_b"):
+            out.append(EdgeFault(src=a, dst=b, start_round=start_round,
+                                 end_round=end_round, drop_prob=loss_prob))
+        if direction in ("both", "b_to_a"):
+            out.append(EdgeFault(src=b, dst=a, start_round=start_round,
+                                 end_round=end_round, drop_prob=loss_prob))
+        if not out:
+            raise ValueError(
+                f"direction must be both|a_to_b|b_to_a, got {direction!r}")
+        return tuple(out)
+
+    def with_edges(self, *entries: EdgeFault) -> "FaultPlan":
+        flat: list = []
+        for e in entries:
+            flat.extend(e) if isinstance(e, tuple) else flat.append(e)
+        return dataclasses.replace(self, edges=self.edges + tuple(flat))
+
+    # -- live-path helpers -------------------------------------------------
+
+    def health_fault_at(self, check_id: str,
+                        round_idx: int) -> tuple[float, bool]:
+        """(extra latency seconds, fail?) for a check at a round —
+        latencies of overlapping entries add, fail ORs."""
+        delay, fail = 0.0, False
+        for h in self.health:
+            if h.start_round <= round_idx < h.end_round and \
+                    h.matches(check_id):
+                delay += h.extra_latency_s
+                fail = fail or h.fail
+        return delay, fail
+
+    def node_down(self, node: int, round_idx: int) -> bool:
+        for f in self.nodes:
+            if f.start_round <= round_idx < f.end_round and \
+                    (f.nodes == "all" or node in f.nodes):
+                return True
+        return False
+
+    # -- serialization (reproduction recipes, docs/chaos.md) ---------------
+
+    def to_json(self) -> dict:
+        def enc(entry):
+            return dataclasses.asdict(entry)
+        return {"seed": self.seed,
+                "edges": [enc(e) for e in self.edges],
+                "nodes": [enc(e) for e in self.nodes],
+                "health": [enc(e) for e in self.health]}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FaultPlan":
+        return cls(seed=int(d["seed"]),
+                   edges=tuple(EdgeFault(**e) for e in d.get("edges", [])),
+                   nodes=tuple(NodeFault(**e) for e in d.get("nodes", [])),
+                   health=tuple(HealthFault(**e)
+                                for e in d.get("health", [])))
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), sort_keys=True)
+
+    @classmethod
+    def loads(cls, s: str) -> "FaultPlan":
+        return cls.from_json(json.loads(s))
+
+
+def coin(seed: int, *coords) -> float:
+    """The live path's deterministic uniform draw in [0, 1): a blake2b
+    hash of (seed, coords).  Stable across processes and platforms, so
+    a live chaos run's fault schedule is a pure function of the plan
+    seed and the decision coordinates (edge, per-edge counter)."""
+    payload = repr((int(seed),) + tuple(coords)).encode()
+    h = hashlib.blake2b(payload, digest_size=8).digest()
+    return int.from_bytes(h, "big") / float(1 << 64)

@@ -1,0 +1,456 @@
+"""FaultPlan → TPU simulator: ChaosExactSim.
+
+Generalizes the exact model's single uniform ``drop_prob`` scalar and
+static ``cut_mask`` to the full FaultPlan vocabulary, threaded through
+``lax.scan``:
+
+* **per-edge packet faults** — each plan edge entry compiles to static
+  (src_mask, dst_mask, window) arrays; every round the sampled gossip
+  targets are evaluated against them and packets are dropped, delayed,
+  or duplicated at PACKET granularity (a lost UDP datagram loses every
+  record it carries — unlike the legacy per-record ``drop_prob``, which
+  still composes underneath);
+* **delay rings** — each entry with ``delay_rounds``/``duplicate_prob``
+  owns a ring buffer of depth ``d`` carried through the scan; diverted
+  packets are re-resolved at ARRIVAL time (staleness gate, receiver
+  liveness, pre-round stickiness), so an in-flight message that went
+  stale or whose receiver crashed behaves exactly as it would on a real
+  network;
+* **asymmetric partitions** — directional ``drop_prob=1.0`` entries.
+  TCP push-pull is severed only by a FULL cut in either direction
+  (TCP rides retransmission; probabilistic UDP loss doesn't break it),
+  evaluated per sampled anti-entropy partner;
+* **node windows** — pause (state retained) and crash (belief row
+  wiped to a fresh re-announce of its own records at the restart round
+  — the cold-rejoin workload).  Down nodes stay in the convergence
+  denominator: a paused node's staleness is degradation the metric
+  must show, not hide;
+* **in-scan observability** — injected drop/delay/duplicate counts
+  accumulate in the carried state; :meth:`ChaosExactSim.run` publishes
+  the deltas to the process metrics registry
+  (``chaos.sim.droppedPackets`` etc.) so fault pressure is never
+  silent.
+
+Every fault draw derives from ``fold_in(PRNGKey(plan.seed), round)`` —
+independent of the *driver* seed — so the fault schedule is a pure
+function of the plan, and two runs of the same plan produce
+bit-identical schedules (tests/test_chaos.py pins this).
+
+Round indices are the simulator's ``round_idx`` values: the first
+executed round is 1.
+
+An EMPTY plan is bit-identical to plain ExactSim (also pinned) — the
+chaos path adds zero semantic drift when no faults are active.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sidecar_tpu import metrics
+from sidecar_tpu.chaos.plan import FaultPlan, resolve_nodes
+from sidecar_tpu.models.exact import ExactSim, SimParams, SimState
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops.merge import staleness_mask
+from sidecar_tpu.ops.status import TOMBSTONE, pack, unpack_status, unpack_ts
+from sidecar_tpu.ops.topology import Topology
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ChaosSimState:
+    """The exact-model state plus the chaos carry (delay rings and
+    injection counters), scanned together."""
+
+    sim: SimState
+    rings: tuple            # per delay entry: (rows[d,L], cols[d,L], vals[d,L])
+    injected_drops: jax.Array    # int32 — fault-dropped non-empty packets
+    injected_delays: jax.Array   # int32 — packets diverted to a delay ring
+    injected_dups: jax.Array     # int32 — packets copied for re-delivery
+
+    # The ExactSim drivers address state through these two names; the
+    # properties make a ChaosSimState drop into the inherited scan
+    # machinery unchanged.
+    @property
+    def round_idx(self):
+        return self.sim.round_idx
+
+    @property
+    def node_alive(self):
+        return self.sim.node_alive
+
+
+class CompiledFaultPlan:
+    """A FaultPlan resolved against a concrete cluster size: node
+    selectors → bool masks, entries split by capability.  All members
+    are static w.r.t. jit (masks are device constants); the per-round
+    evaluation methods trace cleanly inside ``lax.scan``."""
+
+    def __init__(self, plan: FaultPlan, n: int):
+        self.plan = plan
+        self.n = n
+        self.edge_entries = []      # (src_mask, dst_mask, entry, ring_idx)
+        ring_specs = []
+        for e in plan.edges:
+            src = np.zeros(n, bool)
+            src[list(resolve_nodes(e.src, n))] = True
+            dst = np.zeros(n, bool)
+            dst[list(resolve_nodes(e.dst, n))] = True
+            ring_idx = None
+            if e.needs_ring:
+                ring_idx = len(ring_specs)
+                ring_specs.append(e.ring_rounds)
+            self.edge_entries.append(
+                (jnp.asarray(src), jnp.asarray(dst), e, ring_idx))
+        self.ring_specs = tuple(ring_specs)
+        self.node_entries = []
+        for f in plan.nodes:
+            mask = np.zeros(n, bool)
+            mask[list(resolve_nodes(f.nodes, n))] = True
+            self.node_entries.append((jnp.asarray(mask), f))
+        self.has_drop = any(e.drop_prob > 0 for e in plan.edges)
+        self.has_full_cut = any(e.full_cut for e in plan.edges)
+        self.has_crash = any(f.kind == "crash" for f in plan.nodes)
+
+    # -- per-round fault evaluation (traced) -------------------------------
+
+    def _fault_key(self, round_idx):
+        """All fault randomness roots here: plan seed + round — NEVER the
+        driver's key, so the schedule is a pure function of the plan."""
+        return jax.random.fold_in(
+            jax.random.PRNGKey(self.plan.seed), round_idx)
+
+    @staticmethod
+    def _active(e, round_idx):
+        return (round_idx >= e.start_round) & (round_idx < e.end_round)
+
+    def edge_masks(self, dst, round_idx):
+        """Evaluate edge faults against this round's sampled targets.
+
+        Returns (keep, diverts): ``keep`` is bool [N, F] (False = packet
+        dropped) or None when the plan has no drop entries; ``diverts``
+        is a list of (ring_idx, delay_sel, dup_sel) with bool [N, F]
+        masks (either may be None).  Deterministic given (plan, dst,
+        round_idx)."""
+        n, fanout = dst.shape
+        kbase = self._fault_key(round_idx)
+
+        drop_p = None
+        for src_m, dst_m, e, _ in self.edge_entries:
+            if e.drop_prob <= 0.0:
+                continue
+            m = src_m[:, None] & dst_m[dst] & self._active(e, round_idx)
+            p_e = jnp.where(m, jnp.float32(e.drop_prob), jnp.float32(0.0))
+            drop_p = p_e if drop_p is None else \
+                1.0 - (1.0 - drop_p) * (1.0 - p_e)
+        keep = None
+        if drop_p is not None:
+            keep = ~jax.random.bernoulli(jax.random.fold_in(kbase, 0),
+                                         drop_p)
+
+        diverts = []
+        for i, (src_m, dst_m, e, ring_idx) in enumerate(self.edge_entries):
+            if ring_idx is None:
+                continue
+            m = src_m[:, None] & dst_m[dst] & self._active(e, round_idx)
+            if keep is not None:
+                m = m & keep            # a dropped packet can't be diverted
+            delay_sel = dup_sel = None
+            if e.delay_prob > 0.0:
+                delay_sel = jax.random.bernoulli(
+                    jax.random.fold_in(kbase, 100 + i), e.delay_prob,
+                    (n, fanout)) & m
+            if e.duplicate_prob > 0.0:
+                dup_sel = jax.random.bernoulli(
+                    jax.random.fold_in(kbase, 200 + i), e.duplicate_prob,
+                    (n, fanout)) & m
+            diverts.append((ring_idx, delay_sel, dup_sel))
+        return keep, diverts
+
+    def pp_severed(self, partner, round_idx):
+        """bool [N]: anti-entropy with ``partner`` is severed (a FULL
+        directional cut in either direction kills the TCP exchange) —
+        or None when the plan has no full cuts."""
+        if not self.has_full_cut:
+            return None
+        idx = jnp.arange(self.n, dtype=jnp.int32)
+        sev = jnp.zeros((self.n,), bool)
+        for src_m, dst_m, e, _ in self.edge_entries:
+            if not e.full_cut:
+                continue
+            act = self._active(e, round_idx)
+            sev = sev | (act & ((src_m[idx] & dst_m[partner])
+                                | (src_m[partner] & dst_m[idx])))
+        return sev
+
+    def down_mask(self, round_idx):
+        """bool [N]: node is inside a pause/crash window — or None."""
+        if not self.node_entries:
+            return None
+        down = jnp.zeros((self.n,), bool)
+        for mask, f in self.node_entries:
+            down = down | (mask & self._active(f, round_idx))
+        return down
+
+    def restart_mask(self, round_idx):
+        """bool [N]: a crash window closed THIS round (the node restarts
+        cold) — or None when the plan has no crash entries."""
+        if not self.has_crash:
+            return None
+        wipe = jnp.zeros((self.n,), bool)
+        for mask, f in self.node_entries:
+            if f.kind == "crash":
+                wipe = wipe | (mask & (round_idx == f.end_round))
+        return wipe
+
+
+class ChaosExactSim(ExactSim):
+    """ExactSim under a FaultPlan.  Drivers (``run``/``run_fast``/
+    ``step``), checkpoint chunking, and the convergence metric all work
+    unchanged on the wrapped state; scenario ``perturb`` hooks receive
+    the inner SimState exactly as before (they must not mutate
+    ``node_alive`` — fault windows own it for the round)."""
+
+    def __init__(self, params: SimParams, topo: Topology,
+                 timecfg: TimeConfig = TimeConfig(),
+                 plan: FaultPlan = FaultPlan(seed=0),
+                 perturb=None, cut_mask: Optional[np.ndarray] = None):
+        super().__init__(params, topo, timecfg, perturb=perturb,
+                         cut_mask=cut_mask)
+        self.plan = plan
+        self._prog = CompiledFaultPlan(plan, params.n)
+        # owner_row[i, m] — slot m belongs to node i (the crash-restart
+        # wipe's "keep only my own records" mask).
+        self._owner_row = None
+        if self._prog.has_crash:
+            self._owner_row = (
+                self.owner[None, :]
+                == jnp.arange(params.n, dtype=jnp.int32)[:, None])
+
+    # -- state construction ------------------------------------------------
+
+    def init_state(self, live_fraction: float = 1.0,
+                   seed: int = 0) -> ChaosSimState:
+        base = super().init_state(live_fraction, seed)
+        p = self.p
+        flat = p.n * p.fanout * min(p.budget, p.m)
+        rings = tuple(
+            (jnp.full((d, flat), p.n, jnp.int32),   # rows: OOB sentinel
+             jnp.zeros((d, flat), jnp.int32),
+             jnp.zeros((d, flat), jnp.int32))
+            for d in self._prog.ring_specs)
+        zero = jnp.zeros((), jnp.int32)
+        return ChaosSimState(sim=base, rings=rings, injected_drops=zero,
+                             injected_delays=zero, injected_dups=zero)
+
+    # -- the chaos round ---------------------------------------------------
+
+    def _step(self, cst: ChaosSimState, key: jax.Array) -> ChaosSimState:
+        p, t, prog = self.p, self.t, self._prog
+        limit = p.resolved_retransmit_limit()
+        state = cst.sim
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        # Node fault windows: the BASE liveness is preserved in the
+        # carried state (a pause ends and the node is simply back); the
+        # faulted mask governs this round's mechanics only.
+        base_alive = state.node_alive
+        down = prog.down_mask(round_idx)
+        alive = base_alive if down is None else base_alive & ~down
+
+        # Crash restarts: wipe the row to a cold re-announce of own
+        # records the round the window closes.
+        wipe = prog.restart_mask(round_idx)
+        known, sent = state.known, state.sent
+        if wipe is not None:
+            st_codes = unpack_status(known)
+            cold = jnp.where(
+                self._owner_row & (unpack_ts(known) > 0)
+                & (st_codes != TOMBSTONE),
+                pack(now, st_codes), 0)
+            known = jnp.where(wipe[:, None], cold, known)
+            sent = jnp.where(wipe[:, None], jnp.int8(0), sent)
+        state = dataclasses.replace(state, known=known, sent=sent,
+                                    node_alive=alive)
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+        known, sent = state.known, state.sent
+
+        # 1. select + gossip deliveries, fault-gated.
+        dst = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
+            node_alive=alive, cut_mask=self._cut)
+        svc_idx, msg = gossip_ops.select_messages(known, sent, p.budget,
+                                                  limit)
+        sent = gossip_ops.record_transmissions(sent, svc_idx, msg,
+                                               p.fanout, limit)
+
+        keep, diverts = prog.edge_masks(dst, round_idx)
+        n, fanout = dst.shape
+        budget = svc_idx.shape[1]
+        nonempty = jnp.broadcast_to(jnp.any(msg > 0, axis=1)[:, None],
+                                    (n, fanout))
+
+        def count(mask):
+            return jnp.sum((mask & nonempty).astype(jnp.int32))
+
+        drops = cst.injected_drops
+        if keep is not None:
+            drops = drops + count(~keep)
+
+        # Raw triples: every gate applied (incl. fault drops), stickiness
+        # deferred to arrival.
+        rows, cols, vals = gossip_ops.expand_deliveries(
+            dst, svc_idx, msg, now_tick=now, stale_ticks=t.stale_ticks,
+            node_alive=alive, drop_prob=p.drop_prob, drop_key=k_drop,
+            edge_keep=keep)
+
+        def flat(mask):
+            return jnp.broadcast_to(mask[:, :, None],
+                                    (n, fanout, budget)).reshape(-1)
+
+        delays, dups = cst.injected_delays, cst.injected_dups
+        delay_any = None
+        for _, delay_sel, dup_sel in diverts:
+            if delay_sel is not None:
+                delays = delays + count(delay_sel)
+                delay_any = delay_sel if delay_any is None else \
+                    delay_any | delay_sel
+            if dup_sel is not None:
+                dups = dups + count(dup_sel)
+        vals_imm = vals if delay_any is None else \
+            jnp.where(flat(delay_any), 0, vals)
+
+        # Delay rings: pop the batch that matured (written ring-depth
+        # rounds ago lands in this round's slot), push this round's
+        # diverted packets into the freed slot.
+        new_rings = list(cst.rings)
+        all_rows, all_cols, all_vals = [rows], [cols], [vals_imm]
+        for ring_idx, delay_sel, dup_sel in diverts:
+            divert = delay_sel if dup_sel is None else (
+                dup_sel if delay_sel is None else delay_sel | dup_sel)
+            r_rows, r_cols, r_vals = new_rings[ring_idx]
+            depth = r_rows.shape[0]
+            slot = round_idx % depth
+            m_rows, m_cols, m_vals = r_rows[slot], r_cols[slot], r_vals[slot]
+            # Re-resolve the matured batch at ARRIVAL: staleness and
+            # receiver liveness are re-evaluated against *now* (the
+            # pre-round stickiness resolution happens with the combined
+            # batch below).
+            m_vals = jnp.where(staleness_mask(m_vals, now, t.stale_ticks),
+                               0, m_vals)
+            ok = (m_rows < p.n) & alive[jnp.minimum(m_rows, p.n - 1)]
+            m_vals = jnp.where(ok, m_vals, 0)
+            all_rows.append(m_rows)
+            all_cols.append(m_cols)
+            all_vals.append(m_vals)
+            fm = flat(divert)
+            new_rings[ring_idx] = (
+                r_rows.at[slot].set(jnp.where(fm, rows, p.n)),
+                r_cols.at[slot].set(cols),
+                r_vals.at[slot].set(jnp.where(fm, vals, 0)))
+
+        if len(all_rows) > 1:
+            rows = jnp.concatenate(all_rows)
+            cols = jnp.concatenate(all_cols)
+            vals = jnp.concatenate(all_vals)
+        else:
+            vals = vals_imm
+        d_vals, d_adv = gossip_ops.finalize_deliveries(known, rows, cols,
+                                                       vals)
+
+        # 2. announce re-stamps, folded into the same scatter.
+        a_rows, a_cols, a_vals, a_due = self._announce_updates(
+            known, alive, round_idx, now)
+        rows = jnp.concatenate([rows, a_rows])
+        cols = jnp.concatenate([cols, a_cols])
+        vals = jnp.concatenate([d_vals, a_vals])
+        advanced = jnp.concatenate([d_adv, a_due])
+        known, sent = gossip_ops.apply_updates(known, sent, rows, cols,
+                                               vals, advanced)
+
+        # 3. anti-entropy — severed where the plan fully cuts the pair.
+        pp_partner = gossip_ops.sample_peers(
+            k_pp, p.n, 1, nbrs=self._nbrs, deg=self._deg,
+            node_alive=alive, cut_mask=self._cut)[:, 0]
+        sever = prog.pp_severed(pp_partner, round_idx)
+        if sever is not None:
+            pp_partner = jnp.where(
+                sever, jnp.arange(p.n, dtype=jnp.int32), pp_partner)
+
+        def do_push_pull(kn_se):
+            kn, se = kn_se
+            merged = gossip_ops.push_pull(
+                kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
+                node_alive=alive)
+            se = jnp.where(merged != kn, jnp.int8(0), se)
+            return merged, se
+
+        known, sent = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            do_push_pull, lambda kn_se: kn_se, (known, sent))
+
+        # 4. lifespan sweep.
+        def do_sweep(kn_se):
+            from sidecar_tpu.ops.ttl import ttl_sweep
+            kn, se = kn_se
+            swept, _ = ttl_sweep(
+                kn, now,
+                alive_lifespan=t.alive_lifespan,
+                draining_lifespan=t.draining_lifespan,
+                tombstone_lifespan=t.tombstone_lifespan,
+                one_second=t.one_second)
+            se = jnp.where(swept != kn, jnp.int8(0), se)
+            return swept, se
+
+        known, sent = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            do_sweep, lambda kn_se: kn_se, (known, sent))
+
+        return ChaosSimState(
+            sim=SimState(known=known, sent=sent, node_alive=base_alive,
+                         round_idx=round_idx),
+            rings=tuple(new_rings), injected_drops=drops,
+            injected_delays=delays, injected_dups=dups)
+
+    # -- metric + drivers --------------------------------------------------
+
+    def convergence(self, cst: ChaosSimState) -> jax.Array:
+        return super().convergence(cst.sim)
+
+    def injection_counts(self, cst: ChaosSimState) -> dict:
+        return {"dropped": int(cst.injected_drops),
+                "delayed": int(cst.injected_delays),
+                "duplicated": int(cst.injected_dups)}
+
+    def _publish_injection_metrics(self, before: ChaosSimState,
+                                   after: ChaosSimState) -> None:
+        """Fault pressure must be observable, not silent: push the run's
+        injection deltas into the process metrics registry."""
+        for name, field in (("chaos.sim.droppedPackets", "injected_drops"),
+                            ("chaos.sim.delayedPackets", "injected_delays"),
+                            ("chaos.sim.duplicatedPackets",
+                             "injected_dups")):
+            delta = int(getattr(after, field)) - int(getattr(before, field))
+            if delta:
+                metrics.incr(name, delta)
+
+    def run(self, state, key, num_rounds: int):
+        final, conv = super().run(state, key, num_rounds)
+        self._publish_injection_metrics(state, final)
+        return final, conv
+
+    def run_fast(self, state, key, num_rounds: int):
+        final = super().run_fast(state, key, num_rounds)
+        self._publish_injection_metrics(state, final)
+        return final

@@ -5,6 +5,7 @@ from __future__ import annotations
 import logging
 import shlex
 import subprocess
+import time
 import urllib.error
 import urllib.request
 
@@ -70,3 +71,38 @@ class AlwaysSuccessfulCmd(Checker):
 
     def run(self, args: str) -> tuple[int, Exception | None]:
         return HEALTHY, None
+
+
+class ChaosChecker(Checker):
+    """Fault-injection wrapper: consults a chaos injector (an object
+    with ``check_fault(check_id) -> (extra_latency_s, fail)``, see
+    sidecar_tpu/chaos/live_inject.py) before delegating to the real
+    checker.  Injected latency models a hung/trickling endpoint — the
+    workload that starves an undersized check pool (health/monitor.py);
+    ``fail`` models the endpoint being gone.  The Monitor wraps checks
+    with this automatically when its ``fault_injector`` is set."""
+
+    def __init__(self, inner: Checker, injector, check_id: str) -> None:
+        self.inner = inner
+        self.injector = injector
+        self.check_id = check_id
+
+    # The Monitor's tick-deadline clamp reaches through to the real
+    # checker's IO timeout.
+    @property
+    def timeout(self):
+        return getattr(self.inner, "timeout", None)
+
+    @timeout.setter
+    def timeout(self, value) -> None:
+        if hasattr(self.inner, "timeout"):
+            self.inner.timeout = value
+
+    def run(self, args: str) -> tuple[int, Exception | None]:
+        delay, fail = self.injector.check_fault(self.check_id)
+        if delay > 0.0:
+            time.sleep(delay)
+        if fail:
+            return UNKNOWN, TimeoutError(
+                f"chaos: injected failure for {self.check_id}")
+        return self.inner.run(args)

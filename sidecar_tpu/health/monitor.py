@@ -20,6 +20,7 @@ from typing import Callable, Optional
 from sidecar_tpu.discovery.base import Discoverer
 from sidecar_tpu.health.checks import (
     AlwaysSuccessfulCmd,
+    ChaosChecker,
     Checker,
     ExternalCmd,
     FAILED,
@@ -92,6 +93,12 @@ _TEMPLATE_RE = re.compile(
 class Monitor:
     """healthy.go:33-42, 130-216."""
 
+    # Hard ceiling on check-pool workers — the "few execution threads"
+    # budget still bounds the node; the floor keeps small clusters
+    # concurrent.
+    MIN_POOL_WORKERS = 4
+    MAX_POOL_WORKERS = 64
+
     def __init__(self, default_check_host: str,
                  default_check_endpoint: str = "") -> None:
         self.checks: dict[str, Check] = {}
@@ -100,14 +107,44 @@ class Monitor:
         self.default_check_endpoint = default_check_endpoint
         self.discovery_fn: Optional[Callable[[], list[Service]]] = None
         self._lock = threading.RLock()
-        # One long-lived BOUNDED pool for the whole monitor (the "few
-        # execution threads" budget, reference README:54-56): checks are
-        # short IO waits, so 4 workers keep a tick concurrent while a
-        # hung check can stall at most one worker — wait() moves on at
-        # the tick timeout either way, cancelling queued-not-started
-        # checks (they score UNKNOWN/timeout that tick and retry next).
+        # Chaos injection hook (sidecar_tpu/chaos/live_inject.py): when
+        # set, new checks are wrapped in checks.ChaosChecker so the plan
+        # can inject slow/failing endpoints.
+        self.fault_injector = None
+        # One long-lived BOUNDED pool for the whole monitor, SIZED BY
+        # CHECK COUNT (plus hung stragglers) at each tick rather than a
+        # fixed 4: a Base Checker has no IO timeout of its own, so a
+        # hung endpoint pins a worker past the tick — with a fixed tiny
+        # pool, a handful of hung checks permanently starves every
+        # healthy check and the whole catalog flaps to UNKNOWN
+        # (ADVICE.md r5 medium).  The tick deadline is enforced at the
+        # POOL level (the wait() below), never trusted to the checker.
+        self._pool_workers = self.MIN_POOL_WORKERS
         self._pool = concurrent.futures.ThreadPoolExecutor(
-            max_workers=4, thread_name_prefix="health-check")
+            max_workers=self._pool_workers,
+            thread_name_prefix="health-check")
+        # Futures from earlier ticks whose checker is STILL running (the
+        # pool can't kill a thread): tracked so the check isn't
+        # resubmitted on top of its pinned worker, and so pool sizing
+        # accounts for the pinned capacity.
+        self._inflight: dict[concurrent.futures.Future, str] = {}
+
+    def _ensure_pool(self, needed: int) -> None:
+        """Grow the pool to ``needed`` workers (clamped to
+        [MIN, MAX_POOL_WORKERS]).  Growth swaps in a fresh executor and
+        abandons the old one without waiting — its pinned workers drain
+        on their own; their late results are discarded exactly like the
+        reference discards post-deadline check output
+        (healthy.go:196-202)."""
+        needed = min(self.MAX_POOL_WORKERS,
+                     max(self.MIN_POOL_WORKERS, needed))
+        if needed <= self._pool_workers:
+            return
+        old = self._pool
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=needed, thread_name_prefix="health-check")
+        self._pool_workers = needed
+        old.shutdown(wait=False)
 
     # -- check management --------------------------------------------------
 
@@ -115,6 +152,11 @@ class Monitor:
         with self._lock:
             log.info("Adding health check: %s (ID: %s), Args: %s",
                      check.type, check.id, check.args)
+            if self.fault_injector is not None and \
+                    check.command is not None and \
+                    not isinstance(check.command, ChaosChecker):
+                check.command = ChaosChecker(check.command,
+                                             self.fault_injector, check.id)
             self.checks[check.id] = check
 
     def mark_service(self, svc: Service) -> None:
@@ -224,15 +266,18 @@ class Monitor:
         """Run all checks concurrently each tick, per-check timeout
         interval−1 ms (healthy.go:166-213).
 
-        Bounded-pool fairness: the reference discards any result slower
-        than the tick (healthy.go:196-202), so a checker's own longer
-        IO timeout buys nothing — it only pins a pool worker past the
-        tick.  Each checker's timeout is therefore capped at the tick
-        (same observable status: UNKNOWN/timeout), and checks are
-        submitted fastest-history-first so a handful of hung endpoints
-        pin workers only AFTER every fast check has run — without the
-        ordering, the same 4 hung checks would grab all 4 workers every
-        tick and healthy services would flap to UNKNOWN."""
+        The tick deadline is enforced at the POOL level: the wait()
+        below moves on at the timeout regardless of any checker's own
+        IO timeout (a Base Checker has none), scoring stragglers
+        UNKNOWN/timeout exactly like the reference discarding late
+        results (healthy.go:196-202).  A straggler whose thread is
+        still pinned is remembered in ``_inflight``: it is NOT
+        resubmitted while pinned (resubmitting a hung check every tick
+        is how a fixed pool starves), and the pool is resized to
+        runnable + pinned so hung endpoints can never crowd out healthy
+        checks.  Checkers that do expose a timeout are additionally
+        capped at the tick (same observable status, frees the worker
+        sooner), and checks are submitted fastest-history-first."""
         def timed_run(c: Check):
             t0 = time.monotonic()
             try:
@@ -250,9 +295,19 @@ class Monitor:
                 cmd_timeout = getattr(c.command, "timeout", None)
                 if cmd_timeout is not None and cmd_timeout > timeout:
                     c.command.timeout = timeout
-            checks.sort(key=lambda c: getattr(c, "last_duration", 0.0))
+            # Reap stragglers that finished since last tick (their
+            # results are discarded — they already scored
+            # UNKNOWN/timeout the tick they overran).
+            self._inflight = {f: cid for f, cid in self._inflight.items()
+                              if not f.done()}
+            pinned = set(self._inflight.values())
+            runnable = [c for c in checks if c.id not in pinned]
+            self._ensure_pool(len(runnable) + len(self._inflight))
+            if not runnable:
+                return
+            runnable.sort(key=lambda c: getattr(c, "last_duration", 0.0))
             futures = {self._pool.submit(timed_run, c): c
-                       for c in checks}
+                       for c in runnable}
             done, not_done = concurrent.futures.wait(
                 futures, timeout=timeout)
             for fut in done:
@@ -262,15 +317,16 @@ class Monitor:
                 except Exception as exc:  # noqa: BLE001 — check errors are data
                     status, err = UNKNOWN, exc
                 check.update_status(status, err)
-            # Move on at the timeout like the reference — a stuck check's
-            # worker lingers in the pool but cannot block the loop
-            # (healthy.go:196-202); cancel() frees the queued-not-started
-            # ones.
+            # Move on at the timeout like the reference; cancel() frees
+            # queued-not-started entries, and entries that are genuinely
+            # RUNNING go into _inflight so they aren't resubmitted onto
+            # a second worker while the first is still pinned.
             for fut in not_done:
                 check = futures[fut]
                 log.error("Error, check %s timed out! (%s)", check.id,
                           check.args)
                 check.update_status(UNKNOWN, TimeoutError("Timed out!"))
-                fut.cancel()
+                if not fut.cancel():
+                    self._inflight[fut] = check.id
 
         looper.loop(one)

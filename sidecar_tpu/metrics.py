@@ -83,6 +83,14 @@ class Metrics:
             agg[2] = ms
         self._emit(name, round(ms, 3), "ms")
 
+    def counter(self, name: str) -> int:
+        """Current value of one counter (0 if never incremented) —
+        the chaos/robustness tests and operators poll the injection and
+        shed counters (``chaos.*``, ``transport.shed*``) through this
+        without snapshotting the whole registry."""
+        with self._lock:
+            return self._counters.get(name, 0)
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -101,5 +109,6 @@ registry = Metrics()
 incr = registry.incr
 set_gauge = registry.set_gauge
 measure_since = registry.measure_since
+counter = registry.counter
 snapshot = registry.snapshot
 configure_statsd = registry.configure_statsd

@@ -254,30 +254,19 @@ def select_messages(known, sent, budget, limit, row_offset=0):
     return svc_idx.astype(jnp.int32), msg
 
 
-def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
-                       node_alive=None, drop_prob=0.0, drop_key=None):
-    """Expand each sender's message batch into flat (row, col, val) update
-    triples with all merge semantics pre-applied.
+def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
+                      node_alive=None, drop_prob=0.0, drop_key=None,
+                      edge_keep=None):
+    """Expand each sender's message batch into RAW flat (row, col, val)
+    update triples — every gate applied EXCEPT the pre-round stickiness
+    resolution (:func:`finalize_deliveries`), which callers that defer
+    delivery (the chaos delay rings) must re-evaluate at arrival time.
 
-    Each sender transmits its ``B`` selected records to each of its ``F``
-    targets — the batched equivalent of one ``AddServiceEntry`` per
-    received gossip message (services_delegate.go:72-83 →
-    services_state.go:293-347):
-
-    * staleness gate (services_state.go:302-308) — stale vals become 0;
-    * dead senders transmit nothing, dead receivers accept nothing;
-    * ``drop_prob`` models UDP loss;
-    * DRAINING stickiness (services_state.go:329-331) — where a delivery
-      would advance a cell DRAINING→ALIVE, the delivered value itself is
-      rewritten to DRAINING at the new timestamp, evaluated against the
-      pre-round state.  (The reference applies messages sequentially, so
-      same-batch races are order-dependent there; this kernel resolves
-      them one consistent way — max over sticky-adjusted values.)
-
-    Returns (rows, cols, vals, advanced): int32 [N·F·B] flat triples plus
-    the bool mask of entries that strictly advance their target cell
-    (exactly the cells whose merge is an accept — used to stamp ``acc``).
-    """
+    Gates, in order: staleness (services_state.go:302-308), dead
+    sender/receiver, ``drop_prob`` (uniform UDP loss), and ``edge_keep``
+    — an optional bool [N, F] PACKET-level mask from the fault-injection
+    layer (a dropped UDP packet loses all ``B`` records it carries,
+    unlike the per-record ``drop_prob``; see sidecar_tpu/chaos/)."""
     n, fanout = dst.shape
     budget = svc_idx.shape[1]
 
@@ -295,14 +284,51 @@ def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
         keep = jax.random.bernoulli(drop_key, 1.0 - drop_prob, val.shape)
         val = jnp.where(keep, val, 0)
 
-    rows = tgt.reshape(-1)
-    cols = svc.reshape(-1)
-    val = val.reshape(-1)
+    if edge_keep is not None:
+        val = jnp.where(edge_keep[:, :, None], val, 0)
 
+    return tgt.reshape(-1), svc.reshape(-1), val.reshape(-1)
+
+
+def finalize_deliveries(known, rows, cols, vals):
+    """Resolve a raw delivery batch against the CURRENT pre-round state:
+    the strict-advance mask (exactly the cells whose merge is an accept)
+    and DRAINING stickiness (services_state.go:329-331) — where a
+    delivery would advance a cell DRAINING→ALIVE, the delivered value is
+    rewritten to DRAINING at the new timestamp.  (The reference applies
+    messages sequentially, so same-batch races are order-dependent
+    there; this kernel resolves them one consistent way — max over
+    sticky-adjusted values.)  Returns (vals, advanced)."""
     pre_vals = known[rows, cols]
-    advanced = val > pre_vals
-    val = sticky_adjust(val, pre_vals, advanced)
-    return rows, cols, val, advanced
+    advanced = vals > pre_vals
+    vals = sticky_adjust(vals, pre_vals, advanced)
+    return vals, advanced
+
+
+def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
+                       node_alive=None, drop_prob=0.0, drop_key=None,
+                       edge_keep=None):
+    """Expand each sender's message batch into flat (row, col, val) update
+    triples with all merge semantics pre-applied.
+
+    Each sender transmits its ``B`` selected records to each of its ``F``
+    targets — the batched equivalent of one ``AddServiceEntry`` per
+    received gossip message (services_delegate.go:72-83 →
+    services_state.go:293-347).  The gate pipeline lives in
+    :func:`expand_deliveries`; the pre-round stickiness/advance
+    resolution in :func:`finalize_deliveries` — split so the chaos
+    layer can divert packets into delay buffers between the two.
+
+    Returns (rows, cols, vals, advanced): int32 [N·F·B] flat triples plus
+    the bool mask of entries that strictly advance their target cell
+    (exactly the cells whose merge is an accept — used to stamp ``acc``).
+    """
+    rows, cols, vals = expand_deliveries(
+        dst, svc_idx, msg, now_tick=now_tick, stale_ticks=stale_ticks,
+        node_alive=node_alive, drop_prob=drop_prob, drop_key=drop_key,
+        edge_keep=edge_keep)
+    vals, advanced = finalize_deliveries(known, rows, cols, vals)
+    return rows, cols, vals, advanced
 
 
 def apply_updates(known, sent, rows, cols, vals, advanced,

@@ -38,8 +38,10 @@ log = logging.getLogger(__name__)
 
 
 class Scheduler:
-    def __init__(self, name: str = "scheduler") -> None:
+    def __init__(self, name: str = "scheduler",
+                 join_timeout: float = 5.0) -> None:
         self._name = name
+        self._join_timeout = join_timeout
         self._heap: list = []       # (deadline, seq, task)
         self._seq = itertools.count()
         self._cond = threading.Condition()
@@ -60,10 +62,25 @@ class Scheduler:
         # tasks immediately instead of at their next heap deadline.
         looper.add_quit_callback(self._reap_quit)
         with self._cond:
+            if self._thread is not None and not self._thread.is_alive():
+                # Finished thread (a completed stop(), or one whose
+                # timed-out join has since drained): safe to replace.
+                self._thread = None
             if self._thread is None:
+                # Restart after stop(): reset the flag so the lifecycle
+                # is well-defined (stop → drive → running again).
+                self._stop = False
                 self._thread = threading.Thread(
                     target=self._run, name=self._name, daemon=True)
                 self._thread.start()
+            elif self._stop:
+                # stop() timed out on a slow tick and the old thread is
+                # STILL running: starting a second scheduler here would
+                # double-run every task.  Refuse loudly.
+                raise RuntimeError(
+                    f"scheduler {self._name!r} is still stopping (a slow "
+                    "tick outlived the stop timeout); retry drive() after "
+                    "the previous thread exits")
             heapq.heappush(self._heap, (first, next(self._seq), task))
             self._cond.notify()
 
@@ -85,9 +102,21 @@ class Scheduler:
         with self._cond:
             self._stop = True
             self._cond.notify()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=self._join_timeout)
+            if thread.is_alive():
+                # A slow tick outlived the join: KEEP the handle so a
+                # later drive() can tell the thread is still running and
+                # refuse to start a duplicate (double task execution,
+                # ADVICE.md r5 low).  The thread will still exit at its
+                # next loop turn; drive() clears the handle then.
+                log.warning(
+                    "scheduler %r thread did not stop within %.1f s (slow "
+                    "tick still running); keeping the handle to prevent "
+                    "a duplicate scheduler", self._name, self._join_timeout)
+            else:
+                self._thread = None
 
     # -- the loop -----------------------------------------------------------
 

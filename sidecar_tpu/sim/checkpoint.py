@@ -111,4 +111,43 @@ def load_state(path: str | pathlib.Path):
             raise ValueError(
                 f"checkpoint shape {name}={got} does not match params "
                 f"{shape}")
+    if kind == "compressed":
+        _validate_cache_placement(state, params)
     return state, params
+
+
+def _validate_cache_placement(state, params) -> None:
+    """Fail loudly on checkpoints whose cache entries sit on lines the
+    CURRENT hash no longer assigns them.
+
+    The owner-run cache layout (models/compressed.hash_line, r5) changed
+    where slots live; a pre-change v2 checkpoint deserializes cleanly
+    with entries on old-hash lines, silently breaking the invariants
+    _insert_own_offers (no collision handling) and the fast census rely
+    on — duplicate records per slot and an undercounting census after
+    resume (ADVICE.md r5 medium).  Placement is cheap to prove on load:
+    every occupied line must equal hash_line(slot)."""
+    from sidecar_tpu.models.compressed import hash_line
+
+    cache_slot = np.asarray(state.cache_slot)
+    occupied = cache_slot >= 0
+    if not occupied.any():
+        return
+    lines = np.broadcast_to(
+        np.arange(cache_slot.shape[1], dtype=np.int64)[None, :],
+        cache_slot.shape)
+    expected = np.asarray(hash_line(
+        jnp.asarray(np.where(occupied, cache_slot, 0)),
+        params.cache_lines, params.services_per_node))
+    bad = occupied & (lines != expected)
+    if bad.any():
+        n_bad = int(bad.sum())
+        node, line = np.argwhere(bad)[0]
+        raise ValueError(
+            f"checkpoint cache layout mismatch: {n_bad} cache entr"
+            f"{'y' if n_bad == 1 else 'ies'} sit on lines the current "
+            f"hash_line does not assign them (first: node {node}, line "
+            f"{line}, slot {int(cache_slot[node, line])}).  This "
+            "checkpoint predates the owner-run cache layout; resuming it "
+            "would corrupt the census — re-run the scenario or migrate "
+            "the checkpoint")

@@ -346,19 +346,93 @@ def config5_split_heal(eps: float = 1e-5, split_rounds: int = 150,
               "(must stay < 1); heal completes it")
 
 
+def config6_chaos(eps: float = 1e-3, scale: float = 1.0,
+                  seed: int = 6) -> ScenarioResult:
+    """Partition → churn → heal under 20% asymmetric loss — the chaos
+    cross-validation scenario (sidecar_tpu/chaos/), exact model.
+
+    One seeded FaultPlan drives everything: rounds [20, 80) split the
+    cluster in half (full cut both ways) while the A→B direction
+    additionally suffers 20% packet loss for the whole run (asymmetric
+    loss persists after the heal — the recovery must beat it); churn
+    lands on side A only, DURING the partition, so side B converges on
+    the backlog exclusively through the heal.  The live in-process
+    twin of this scenario runs in tests/test_chaos.py from the same
+    plan; rerunning this function with the same seed reproduces the
+    identical convergence trace (the chaos determinism contract)."""
+    from sidecar_tpu.chaos import ChaosExactSim, EdgeFault, FaultPlan
+
+    n = max(32, int(256 * scale))
+    n -= n % 2
+    spn = 4
+    side_a = tuple(range(n // 2))
+    side_b = tuple(range(n // 2, n))
+    plan = FaultPlan(
+        seed=seed,
+        edges=(EdgeFault(src=side_a, dst=side_b, drop_prob=0.2),),
+    ).with_edges(*FaultPlan.partition(side_a, side_b, 20, 80))
+
+    params = SimParams(n=n, services_per_node=spn, fanout=3, budget=15)
+
+    # Churn on side A only, rounds 30-60 (mid-partition): a Bernoulli
+    # subset of side-A slots restarts each round, exactly like
+    # config3's churn but windowed and one-sided.
+    def perturb(state, key, now):
+        import dataclasses as _dc
+
+        import jax.numpy as jnp
+
+        from sidecar_tpu.ops.status import (ALIVE as _ALIVE,
+                                            TOMBSTONE as _TOMB)
+        from sidecar_tpu.ops.status import pack as _pack
+        from sidecar_tpu.ops.status import unpack_status as _ust
+        from sidecar_tpu.ops.status import unpack_ts as _uts
+
+        round_idx = now // _STUDY_CFG.round_ticks
+        active = (round_idx >= 30) & (round_idx < 60)
+        owner = jnp.arange(params.m, dtype=jnp.int32) // spn
+        cols = jnp.arange(params.m, dtype=jnp.int32)
+        on_side_a = owner < (n // 2)
+        churn = jax.random.bernoulli(key, 0.02 / spn, (params.m,))
+        own = state.known[owner, cols]
+        flip = churn & active & on_side_a & (_uts(own) > 0) & \
+            state.node_alive[owner]
+        st = _ust(own)
+        new_status = jnp.where(st == _ALIVE, _TOMB, _ALIVE)
+        new_val = jnp.where(flip, _pack(now, new_status), own)
+        known = state.known.at[owner, cols].set(new_val)
+        reset_rows = jnp.where(flip, owner, params.n)
+        sent = state.sent.at[reset_rows, cols].set(jnp.int8(0),
+                                                   mode="drop")
+        return _dc.replace(state, known=known, sent=sent)
+
+    cfg = dataclasses.replace(_STUDY_CFG, push_pull_interval_s=2.0)
+    sim = ChaosExactSim(params, topo_mod.complete(n), cfg, plan=plan,
+                        perturb=perturb)
+    return _run(sim, sim.init_state(), rounds=200, seed=seed,
+                name="config6-chaos-partition", eps=eps,
+                scaled_from=256 if n != 256 else None,
+                notes="FaultPlan-driven: 2-way split rounds 20-80, "
+                      "one-sided churn rounds 30-60, 20% A->B loss "
+                      "throughout; heal drains the backlog")
+
+
 ALL_SCENARIOS: dict[str, Callable[..., ScenarioResult]] = {
     "config1": config1_static_merge,
     "config2": config2_ring,
     "config3": config3_er_churn,
     "config4": config4_ba_antientropy,
     "config5": config5_split_heal,
+    "config6": config6_chaos,
 }
+
+_SCALED = ("config3", "config4", "config5", "config6")
 
 
 def run_all(scale: float = 1.0) -> list[ScenarioResult]:
     out = []
     for name, fn in ALL_SCENARIOS.items():
-        if name in ("config3", "config4", "config5"):
+        if name in _SCALED:
             out.append(fn(scale=scale))
         else:
             out.append(fn())
@@ -388,7 +462,7 @@ if __name__ == "__main__":
     if args.only:
         fn = ALL_SCENARIOS[args.only]
         results = [fn(scale=args.scale)
-                   if args.only in ("config3", "config4", "config5")
+                   if args.only in _SCALED
                    else fn()]
     else:
         results = run_all(scale=args.scale)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import pathlib
+import queue as queue_mod
 import subprocess
 import threading
 import time
@@ -119,12 +120,18 @@ def load_native() -> ctypes.CDLL:
 
 
 # Packet-type bits for the engine's test-only one-way packet-drop hook
-# (st_test_drop_types masks received packets by type).
+# (st_test_drop_types masks received packets by type).  DROP_PUSH_PULL
+# refuses the node's TCP anti-entropy exchanges (native kTypePushPull),
+# so an injected partition severs push-pull exactly like UDP gossip.
 DROP_GOSSIP = 1 << 0
 DROP_PING = 1 << 1
 DROP_ACK = 1 << 2
 DROP_PING_REQ = 1 << 3
 DROP_ACK_FWD = 1 << 4
+DROP_PUSH_PULL = 1 << 5
+DROP_ALL_UDP = DROP_GOSSIP | DROP_PING | DROP_ACK | DROP_PING_REQ | \
+    DROP_ACK_FWD
+DROP_ALL = DROP_ALL_UDP | DROP_PUSH_PULL
 
 _LOG_LEVELS = {"E": logging.ERROR, "W": logging.WARNING,
                "I": logging.INFO, "D": logging.DEBUG}
@@ -146,7 +153,9 @@ class GossipTransport:
                  probe_timeout: float = 0.0,
                  suspect_timeout: float = 0.0,
                  indirect_probes: int = -1,
-                 handoff_queue_depth: int = 1024) -> None:
+                 handoff_queue_depth: int = 1024,
+                 fault_injector=None,
+                 max_pending_broadcasts: int = 4096) -> None:
         import socket
 
         self.node_name = node_name or socket.gethostname()
@@ -173,6 +182,18 @@ class GossipTransport:
                 f"handoff_queue_depth must be positive, got "
                 f"{handoff_queue_depth} (there is no unbounded mode)")
         self.handoff_queue_depth = handoff_queue_depth
+        # Chaos injection shim (sidecar_tpu/chaos/live_inject.py): an
+        # object with on_recv/due_records/filter_send, consulted at the
+        # send/recv boundary.  None = no injection.
+        self.fault_injector = fault_injector
+        # Outbound backlog bound: state.broadcasts is unbounded at the
+        # producer side (the catalog never blocks on a slow transport),
+        # so the BRIDGE enforces the bound — a partitioned or paused
+        # node sheds its OLDEST pending broadcasts (freshest-wins, like
+        # the native queue's own 4096 cap) and counts them.
+        if max_pending_broadcasts <= 0:
+            raise ValueError("max_pending_broadcasts must be positive")
+        self.max_pending_broadcasts = max_pending_broadcasts
         self._lib = load_native()
         self._handle: Optional[int] = None
         self._quit = threading.Event()
@@ -261,16 +282,59 @@ class GossipTransport:
             data = self.state.encode()
             self._lib.st_set_local_state(self._handle, data, len(data))
 
-    # Engine stats order (native/transport.cc Transport::stats).
+    # Engine stats order (native/transport.cc Transport::stats).  An
+    # older prebuilt library returns fewer values; zip's [:n] clamp
+    # keeps the bridge compatible either way.
     _STAT_NAMES = ("engine.udpOut", "engine.udpBytesOut", "engine.udpIn",
                    "engine.udpBytesIn", "engine.pushPullOut",
-                   "engine.pushPullIn")
+                   "engine.pushPullIn", "engine.udpSendDrops")
 
     def _poll_engine_stats(self) -> None:
         vals = (ctypes.c_ulonglong * len(self._STAT_NAMES))()
         n = self._lib.st_stats(self._handle, vals, len(vals))
         for name, val in zip(self._STAT_NAMES[:n], vals[:n]):
             metrics.set_gauge(name, int(val))
+
+    # Inbound shed backoff: how long (and how often) the bridge is
+    # willing to wait on a full single-writer queue before shedding the
+    # record.  Total worst-case stall per record: retries × timeout —
+    # kept far below the gossip interval so backpressure never turns
+    # into bridge-loop wedge (anti-entropy re-delivers shed records).
+    INBOUND_PUT_RETRIES = 3
+    INBOUND_PUT_TIMEOUT = 0.005
+
+    def _deliver_inbound(self, svc) -> None:
+        """Hand a record to the single-writer merge queue with bounded
+        backoff instead of a blocking put: a stalled writer (the chaos
+        scenarios provoke this on purpose) must not wedge the shared
+        bridge thread.  After the retries the record is SHED and
+        counted — silent degradation is the failure mode this replaces."""
+        for _ in range(self.INBOUND_PUT_RETRIES):
+            if self.state.offer_service(svc,
+                                        timeout=self.INBOUND_PUT_TIMEOUT):
+                return
+            if self._quit.is_set():
+                return
+        metrics.incr("transport.shedInbound")
+        log.warning("Single-writer queue full; shedding inbound record "
+                    "%s (anti-entropy will re-deliver)", svc.id)
+
+    def _shed_broadcast_backlog(self) -> None:
+        """Enforce the outbound bound: drop the OLDEST pending
+        broadcast batches beyond ``max_pending_broadcasts`` (stalest
+        records lose; push-pull still carries them) and count the shed."""
+        q = self.state.broadcasts
+        shed = 0
+        while q.qsize() > self.max_pending_broadcasts:
+            try:
+                q.get_nowait()
+            except queue_mod.Empty:
+                break
+            shed += 1
+        if shed:
+            metrics.incr("transport.shedBroadcasts", shed)
+            log.warning("Outbound broadcast backlog over %d; shed %d "
+                        "oldest batches", self.max_pending_broadcasts, shed)
 
     def _bridge_loop(self) -> None:
         """ONE delegate thread for both directions ("few execution
@@ -281,25 +345,35 @@ class GossipTransport:
         into the catalog (NotifyMsg / MergeRemoteState / NotifyLeave)
         plus the engine-diagnostics log bridge
         (logging_bridge.go:25-53).  The outbound queue get doubles as
-        the idle sleep, kept short so inbound drain latency stays low."""
-        import queue as queue_mod
-
+        the idle sleep — but ONLY when the previous cycle's inbound
+        drain went idle: while inbound is backed up the loop spins
+        without the 20 ms wait, so a sustained burst drains at full
+        rate instead of ~3.2k msgs/s (64 records / 20 ms).  The chaos
+        fault injector (when installed) is consulted on every decoded
+        inbound record and outbound batch."""
         buf = ctypes.create_string_buffer(1 << 22)
         last_state_push = 0.0
+        inbound_backlogged = False
         while not self._quit.is_set():
             # -- outbound ---------------------------------------------------
             try:
-                prepared = self.state.broadcasts.get(timeout=0.02)
+                if inbound_backlogged:
+                    prepared = self.state.broadcasts.get_nowait()
+                else:
+                    prepared = self.state.broadcasts.get(timeout=0.02)
             except queue_mod.Empty:
                 prepared = None
             if self._quit.is_set():
                 return
+            if prepared and self.fault_injector is not None:
+                prepared = self.fault_injector.filter_send(prepared)
             if prepared:
                 t0 = time.perf_counter()
                 for payload in prepared:
                     self._lib.st_broadcast(self._handle, payload,
                                            len(payload))
                 metrics.measure_since("getBroadcasts", t0)
+            self._shed_broadcast_backlog()
             metrics.set_gauge("pendingBroadcasts",
                               self.state.broadcasts.qsize())
             now = time.monotonic()
@@ -307,6 +381,11 @@ class GossipTransport:
                 self._push_local_state()
                 self._poll_engine_stats()
                 last_state_push = now
+
+            # Chaos: release injector-delayed records whose time came.
+            if self.fault_injector is not None:
+                for svc in self.fault_injector.due_records():
+                    self._deliver_inbound(svc)
 
             # -- inbound — drain, BOUNDED per cycle so sustained inbound
             # traffic cannot starve the outbound half above (fairness on
@@ -323,7 +402,12 @@ class GossipTransport:
                     t0 = time.perf_counter()
                     try:
                         svc = svc_mod.decode(buf.raw[:n])
-                        self.state.update_service(svc)
+                        if self.fault_injector is not None:
+                            records = self.fault_injector.on_recv(svc)
+                        else:
+                            records = (svc,)
+                        for record in records:
+                            self._deliver_inbound(record)
                     except ValueError as exc:
                         log.warning("Error decoding gossip message: %s", exc)
                     metrics.measure_since("notifyMsg", t0)
@@ -338,6 +422,12 @@ class GossipTransport:
                     n = self._lib.st_poll_state(self._handle, sbuf, len(sbuf))
                     if n > 0:
                         busy = True
+                        # Chaos: a paused/crashed node merges nothing —
+                        # the full-state path bypasses the per-record
+                        # shim, so it gets its own gate.
+                        if self.fault_injector is not None and \
+                                not self.fault_injector.accept_push_pull():
+                            continue
                         t0 = time.perf_counter()
                         try:
                             remote = decode(sbuf.raw[:n])
@@ -365,4 +455,9 @@ class GossipTransport:
                             daemon=True).start()
                     elif parts and parts[0] == "join" and len(parts) > 1:
                         log.info("Member joined: %s", parts[1])
+
+            # Exited the bounded drain with work still pending (the cap
+            # tripped while busy): skip the next cycle's outbound idle
+            # wait so the backlog keeps draining at full rate.
+            inbound_backlogged = busy
 

@@ -179,3 +179,40 @@ class TestCheckpoint:
         with pytest.raises(TypeError, match="must be saved with"):
             save_state(tmp_path / "x.npz", sim.init_state(),
                        CompressedParams(n=8))
+
+    def test_stale_cache_layout_rejected(self, tmp_path):
+        """A compressed checkpoint whose cache entries sit on lines the
+        CURRENT hash_line does not assign them (the pre-owner-run-layout
+        format) must fail LOUDLY on load: resuming it would plant
+        duplicate records per slot and undercount the census (ADVICE.md
+        r5 medium).  Valid checkpoints (previous test) load unchanged."""
+        import dataclasses as dc
+
+        import jax.numpy as jnp
+
+        from sidecar_tpu.models.compressed import (
+            CompressedParams,
+            CompressedSim,
+            hash_line,
+        )
+
+        p = CompressedParams(n=16, services_per_node=4, cache_lines=64)
+        sim = CompressedSim(p, topology.complete(16), FAST)
+        st = sim.mint(sim.init_state(),
+                      jnp.arange(4, dtype=jnp.int32) * 5, 10)
+        st = sim.run_fast(st, jax.random.PRNGKey(3), 4)
+        occupied = np.argwhere(np.asarray(st.cache_slot) >= 0)
+        assert occupied.size, "workload produced no cache entries"
+        node, line = occupied[0]
+        slot = int(np.asarray(st.cache_slot)[node, line])
+        wrong = (int(hash_line(jnp.int32(slot), p.cache_lines,
+                               p.services_per_node)) + 1) % p.cache_lines
+        cs = np.asarray(st.cache_slot).copy()
+        cv = np.asarray(st.cache_val).copy()
+        cs[node, wrong], cv[node, wrong] = slot, cv[node, line]
+        cs[node, line], cv[node, line] = -1, 0
+        bad = dc.replace(st, cache_slot=jnp.asarray(cs),
+                         cache_val=jnp.asarray(cv))
+        save_state(tmp_path / "stale.npz", bad, p)
+        with pytest.raises(ValueError, match="cache layout mismatch"):
+            load_state(tmp_path / "stale.npz")
