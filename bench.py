@@ -365,6 +365,23 @@ def main() -> None:
                 note="faithful at 4x cache capacity — collision-"
                      "serialization sensitivity")
 
+    # The query plane's host-side read path (benchmarks/bench_query.py):
+    # resolve throughput off the immutable snapshot + watch fan-out
+    # latency.  No TPU involved; BENCH_QUERY=0 skips it.
+    query_bench = None
+    if os.environ.get("BENCH_QUERY", "1") != "0":
+        try:
+            import importlib.util as _ilu
+            _spec = _ilu.spec_from_file_location(
+                "bench_query",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmarks", "bench_query.py"))
+            _bq = _ilu.module_from_spec(_spec)
+            _spec.loader.exec_module(_bq)
+            query_bench = _bq.run_query_bench()
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# query bench failed: {exc}", file=sys.stderr)
+
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.
     print(json.dumps({
@@ -380,6 +397,7 @@ def main() -> None:
            if north_star_sharded else {}),
         **({"north_star_faithful_k1024": north_star_k1024}
            if north_star_k1024 else {}),
+        **({"query": query_bench} if query_bench else {}),
     }))
 
 

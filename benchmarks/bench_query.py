@@ -1,0 +1,178 @@
+"""Query-plane benchmark: resolve throughput + watch fan-out latency.
+
+The north star serves heavy read traffic from millions of users; the
+query plane's job is making those reads (a) lock-free against the
+writer and (b) cheap — serialization at most once per version.  Two
+measurements over a SHARDED snapshot (many hosts, the shape a real
+cluster catalog has):
+
+* **resolve throughput** — `hub.current()` + a by-service group lookup
+  per resolve, the `/api/services/{name}.json` hot path, measured in
+  resolves/sec single-threaded AND with the writer concurrently
+  publishing (the lock-free claim under load).
+* **watch fan-out latency** — N hub subscribers, one change published:
+  wall time from publish until EVERY subscriber has the delta
+  (p50/p99 over many events) — the `/watch` push latency floor, and
+  the latency ADS now sees instead of its old 1 s poll.
+
+Host-side only (no TPU, no network): this isolates the subsystem the
+PR added.  Run: python benchmarks/bench_query.py  → one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from sidecar_tpu import service as S  # noqa: E402
+from sidecar_tpu.catalog import ServicesState  # noqa: E402
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+
+def build_state(hosts: int, services_per_host: int) -> ServicesState:
+    state = ServicesState(hostname="host000", cluster_name="bench")
+    state.set_clock(lambda: T0)
+    for hi in range(hosts):
+        host = f"host{hi:03d}"
+        for si in range(services_per_host):
+            state.add_service_entry(S.Service(
+                id=f"{host}-svc{si:03d}", name=f"svc{si:03d}",
+                image="bench:1", hostname=host,
+                updated=T0 + hi * 1000 + si, status=S.ALIVE,
+                ports=[S.Port("tcp", 32000 + si, 8000 + si,
+                              f"10.0.{hi}.{si}")]))
+    return state
+
+
+def bench_resolve(state: ServicesState, duration_s: float,
+                  with_writer: bool) -> dict:
+    hub = state.query_hub()
+    stop = threading.Event()
+    writer_published = [0]
+
+    def writer():
+        # ALIVE ↔ UNHEALTHY alternation: a re-announce with an
+        # unchanged status emits no change event (reference merge
+        # semantics), so each write must flip to actually publish.
+        i = 0
+        while not stop.is_set():
+            state.add_service_entry(S.Service(
+                id="host000-svc000", name="svc000", image="bench:1",
+                hostname="host000", updated=T0 + 10**12 + i,
+                status=S.ALIVE if i % 2 else S.UNHEALTHY))
+            writer_published[0] += 1
+            i += 1
+
+    wt = None
+    if with_writer:
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+
+    resolves = 0
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s
+    while time.perf_counter() < deadline:
+        snap = hub.current()
+        group = snap.by_service().get("svc001")
+        assert group
+        resolves += 1
+    elapsed = time.perf_counter() - t0
+    stop.set()
+    if wt is not None:
+        wt.join(timeout=5)
+    return {
+        "resolves_per_sec": round(resolves / elapsed, 1),
+        "concurrent_writer_publishes": writer_published[0],
+    }
+
+
+def bench_watch_fanout(state: ServicesState, n_subs: int,
+                       events: int) -> dict:
+    hub = state.query_hub()
+    barrier = threading.Barrier(n_subs + 1)
+    done = [threading.Event() for _ in range(events)]
+    counts = [0] * events
+    count_lock = threading.Lock()
+    base_version = hub.current().version
+
+    def subscriber(idx: int):
+        sub = hub.subscribe(f"bench{idx}", buffer=events + 8,
+                            prime=False)
+        barrier.wait(timeout=10)
+        seen = 0
+        while seen < events:
+            ev = sub.get(timeout=5)
+            if ev is None:
+                return
+            ei = ev.version - base_version - 1
+            with count_lock:
+                counts[ei] += 1
+                if counts[ei] == n_subs:
+                    done[ei].set()
+            seen += 1
+        sub.close()
+
+    threads = [threading.Thread(target=subscriber, args=(i,),
+                                daemon=True) for i in range(n_subs)]
+    for t in threads:
+        t.start()
+    barrier.wait(timeout=10)
+
+    latencies = []
+    for ei in range(events):
+        t0 = time.perf_counter()
+        # Status flip per event — unchanged-status re-announces emit no
+        # change event (see bench_resolve's writer).
+        state.add_service_entry(S.Service(
+            id="host001-svc001", name="svc001", image="bench:1",
+            hostname="host001", updated=T0 + 10**13 + ei,
+            status=S.ALIVE if ei % 2 else S.UNHEALTHY))
+        if not done[ei].wait(timeout=5):
+            raise RuntimeError(f"fan-out stalled at event {ei}")
+        latencies.append((time.perf_counter() - t0) * 1e6)
+    for t in threads:
+        t.join(timeout=5)
+    latencies.sort()
+    return {
+        "subscribers": n_subs,
+        "events": events,
+        "fanout_p50_us": round(statistics.median(latencies), 1),
+        "fanout_p99_us": round(
+            latencies[min(len(latencies) - 1,
+                          int(len(latencies) * 0.99))], 1),
+    }
+
+
+def run_query_bench(hosts: int = 64, services_per_host: int = 16,
+                    duration_s: float = 0.5, n_subs: int = 32,
+                    events: int = 200) -> dict:
+    state = build_state(hosts, services_per_host)
+    out = {
+        "snapshot_hosts": hosts,
+        "snapshot_services": hosts * services_per_host,
+        "resolve": bench_resolve(state, duration_s, with_writer=False),
+        "resolve_under_write_load": bench_resolve(
+            build_state(hosts, services_per_host), duration_s,
+            with_writer=True),
+        "watch_fanout": bench_watch_fanout(
+            build_state(hosts, services_per_host), n_subs, events),
+    }
+    return out
+
+
+def main() -> int:
+    print(json.dumps({"metric": "query-plane resolve/fanout",
+                      **run_query_bench()}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -62,6 +62,12 @@ class SimulationReport:
     eps_round: Optional[int]               # first round ≥ 1-eps
     node_agreement: dict[str, float]       # hostname → final agreement
     projected: dict                        # hostname → {svc id → status str}
+    # Per-round changed-belief stream (ops/delta.py), present when the
+    # caller asked for it: one entry per round with the (hostname,
+    # service id, status) triples that changed, or {"overflow": true}
+    # when the round changed more cells than the cap (the consumer's
+    # cue to resync from the projected snapshot).
+    deltas: Optional[list] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -127,12 +133,20 @@ class SimBridge:
 
     def simulate(self, rounds: int, seed: int = 0,
                  cold_nodes: Optional[list[str]] = None,
-                 eps: float = 0.01) -> SimulationReport:
+                 eps: float = 0.01,
+                 deltas_cap: int = 0) -> SimulationReport:
         """Run the catalog forward ``rounds`` gossip rounds.
 
         ``cold_nodes``: hostnames whose knowledge is blanked to their own
         records first — models fresh joiners (the join push-pull and
-        epidemic spread then have to re-teach them)."""
+        epidemic spread then have to re-teach them).
+
+        ``deltas_cap`` > 0 streams the per-round changed-belief sets out
+        of the ``lax.scan`` (ExactSim.run_with_deltas → ops/delta.py)
+        instead of reporting only the terminal projection: each round's
+        changed cells are mapped back through the BridgeMapping to
+        (hostname, service id, status) triples — the query plane's
+        delta contract applied to simulated futures."""
         state, params, mapping, sim = self.snapshot()
 
         if cold_nodes:
@@ -148,7 +162,14 @@ class SimBridge:
             state = dataclasses.replace(state,
                                         known=jax.numpy.asarray(known))
 
-        final, conv = sim.run(state, jax.random.PRNGKey(seed), rounds)
+        delta_stream = None
+        if deltas_cap > 0:
+            final, batches, conv = sim.run_with_deltas(
+                state, jax.random.PRNGKey(seed), rounds, deltas_cap)
+            delta_stream = self._map_deltas(batches, mapping, params,
+                                            rounds)
+        else:
+            final, conv = sim.run(state, jax.random.PRNGKey(seed), rounds)
         conv = np.asarray(jax.device_get(conv))
         known = np.asarray(final.known)
 
@@ -180,7 +201,45 @@ class SimBridge:
             eps_round=int(hits[0]) + 1 if hits.size else None,
             node_agreement=node_agreement,
             projected=projected,
+            deltas=delta_stream,
         )
+
+    @staticmethod
+    def _map_deltas(batches, mapping: BridgeMapping, params: SimParams,
+                    rounds: int) -> list:
+        """DeltaBatch stream [rounds, cap] → per-round (hostname,
+        service id, status) change lists.  Padded slots in an owner's
+        run have no service id and are dropped (they can only change
+        through announce of real records, so in practice none appear)."""
+        spn = params.services_per_node
+        count = np.asarray(jax.device_get(batches.count))
+        node = np.asarray(jax.device_get(batches.node))
+        slot = np.asarray(jax.device_get(batches.slot))
+        val = np.asarray(jax.device_get(batches.val))
+        overflow = np.asarray(jax.device_get(batches.overflow))
+        out = []
+        for r in range(rounds):
+            if bool(overflow[r]):
+                out.append({"round": r + 1, "overflow": True,
+                            "count": int(count[r])})
+                continue
+            changes = []
+            for ni, si, v in zip(node[r], slot[r], val[r]):
+                if ni < 0:
+                    continue
+                sid = mapping.slots[si // spn][si % spn]
+                if sid is None:
+                    continue
+                changes.append({
+                    "node": mapping.hostnames[ni],
+                    "service": sid,
+                    "status": svc_mod.status_string(
+                        int(unpack_status(np.int32(v)))),
+                    "tick": int(unpack_ts(np.int32(v))),
+                })
+            out.append({"round": r + 1, "overflow": False,
+                        "count": int(count[r]), "changes": changes})
+        return out
 
 
 def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
@@ -213,7 +272,8 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                     rounds=int(req.get("rounds", 50)),
                     seed=int(req.get("seed", 0)),
                     cold_nodes=req.get("cold_nodes"),
-                    eps=float(req.get("eps", 0.01)))
+                    eps=float(req.get("eps", 0.01)),
+                    deltas_cap=int(req.get("deltas_cap", 0)))
             except (ValueError, KeyError, TypeError,
                     json.JSONDecodeError) as exc:
                 self._reply(400, {"message": str(exc)})

@@ -168,11 +168,31 @@ class ServicesState:
         self.tombstone_retransmit = TOMBSTONE_RETRANSMIT
         self._lock = threading.RLock()
         self._now: Callable[[], int] = svc_mod.now_ns
+        # The versioned snapshot/delta query plane (sidecar_tpu/query/),
+        # lazily attached on first read-path use so bare states stay
+        # cheap.  Once attached, every change event ALSO publishes a
+        # copy-on-write snapshot + delta through the hub.
+        self._query_hub = None
 
     # -- time injection (tests) -------------------------------------------
 
     def set_clock(self, now_fn: Callable[[], int]) -> None:
         self._now = now_fn
+
+    # -- the query plane ---------------------------------------------------
+
+    def query_hub(self):
+        """The attached :class:`sidecar_tpu.query.QueryHub`, created on
+        first use — the read-path consumers' single entry point (web
+        /watch, UrlListener, ADS)."""
+        with self._lock:
+            if self._query_hub is None:
+                from sidecar_tpu.query import QueryHub
+
+                hub = QueryHub(self)
+                hub.attach()
+                self._query_hub = hub
+            return self._query_hub
 
     # -- basic accessors ---------------------------------------------------
 
@@ -322,17 +342,36 @@ class ServicesState:
         event = ChangeEvent(service=svc.copy(),
                             previous_status=previous_status,
                             time=changed_time)
+        # Query-plane publish rides the same writer path: versions are
+        # totally ordered because every change funnels through here
+        # (under the state lock), and publish itself never blocks —
+        # slow subscribers coalesce on their own bounded queues.
+        hub = self._query_hub
+        if hub is not None:
+            hub.publish(event)
         for listener in list(self._listeners.values()):
+            ch = listener.chan()
+            if ch is None:
+                continue  # hub-driven: fed by the query plane above
             try:
-                listener.chan().put_nowait(event)
+                ch.put_nowait(event)
             except queue.Full:
                 log.warning("Can't notify listener (%s). May not be ready "
                             "yet.", listener.name())
 
     def add_listener(self, listener: Listener) -> None:
-        """services_state.go:245-261 — queues must be bounded (≥1)."""
+        """services_state.go:245-261 — queues must be bounded (≥1).
+
+        Hub-driven listeners (``hub_driven = True``, e.g. UrlListener)
+        carry no queue: they register here only for the managed-listener
+        lifecycle (track_local_listeners) and receive their events
+        through a query-hub subscription instead."""
         ch = listener.chan()
         if ch is None:
+            if getattr(listener, "hub_driven", False):
+                with self._lock:
+                    self._listeners[listener.name()] = listener
+                return
             log.error("Refusing to add listener %s with nil channel!",
                       listener.name())
             return

@@ -1,17 +1,29 @@
-"""UrlListener: pushes StateChangedEvents to subscriber URLs over HTTP
-POST (reference: catalog/url_listener.go:22-161)."""
+"""UrlListener: pushes catalog change events to subscriber URLs over
+HTTP POST (reference: catalog/url_listener.go:22-161).
+
+Since the query plane landed this is a subscription-hub consumer: the
+drain thread reads versioned delta events from a
+:class:`sidecar_tpu.query.hub.Subscription` and POSTs the **delta wire
+shape** (docs/query.md) — ``{"Version": V, "ChangeEvent": {...}}`` per
+change, collapsing to ``{"Version": V, "State": {...}}`` when the hub
+coalesced a backlog (the subscriber fell behind; the full state is the
+resync).  The old shape — the full catalog dump re-serialized under
+``state._lock`` on EVERY event — survives only as
+:func:`state_changed_event_json` for legacy consumers, and even that
+now serves from the hub's cached snapshot encoding when one is
+attached.
+"""
 
 from __future__ import annotations
 
 import json
 import logging
-import queue
 import socket
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Optional
+from typing import Callable, Optional
 
 from sidecar_tpu.catalog.state import (
     ChangeEvent,
@@ -24,32 +36,70 @@ log = logging.getLogger(__name__)
 
 CLIENT_TIMEOUT = 3.0   # url_listener.go:18
 DEFAULT_RETRIES = 5    # url_listener.go:19
+RETRY_INTERVAL = 0.1   # linear backoff unit (url_listener.go:88)
 
 
-def with_retries(count: int, fn) -> Optional[Exception]:
-    """url_listener.go:81-94 — linear backoff, first try immediate."""
+def with_retries(count: int, fn,
+                 sleep: Callable[[float], None] = time.sleep
+                 ) -> Optional[Exception]:
+    """url_listener.go:81-94 — first try immediate, then ``count``
+    retries with linear backoff: 1×, 2×, … ``RETRY_INTERVAL`` BEFORE
+    each retry (the old schedule slept ``0.1 * 0 = 0`` before the first
+    retry, so the documented backoff never backed off where it matters
+    most — the immediate-retry hammer).  ``sleep`` is injectable so
+    tests assert the schedule against a fake clock."""
     last: Optional[Exception] = None
-    for i in range(-1, count):
+    for attempt in range(count + 1):
         try:
             fn()
             return None
         except Exception as exc:  # noqa: BLE001 — retry any failure
             last = exc
-            if i + 1 < count:
-                time.sleep(max(0.1 * (i + 1), 0))
+            if attempt < count:
+                sleep(RETRY_INTERVAL * (attempt + 1))
     log.warning("Failed after %d retries", count)
     return last
 
 
 def state_changed_event_json(state: ServicesState,
                              event: ChangeEvent) -> bytes:
-    """Wire shape of StateChangedEvent (url_listener.go:36-39)."""
-    with state._lock:
-        doc = {"State": state.to_json(), "ChangeEvent": event.to_json()}
+    """LEGACY wire shape of StateChangedEvent (url_listener.go:36-39):
+    the full catalog plus the event.  With a query hub attached the
+    state document comes from the immutable current snapshot — no
+    ``state._lock``, serialization cached per version; the lock path
+    survives only for bare states."""
+    hub = getattr(state, "_query_hub", None)
+    if hub is not None:
+        state_doc = hub.current().to_json()
+    else:
+        with state._lock:
+            state_doc = state.to_json()
+    doc = {"State": state_doc, "ChangeEvent": event.to_json()}
     return json.dumps(doc, separators=(",", ":")).encode()
 
 
+def delta_event_json(version: int, event: ChangeEvent) -> bytes:
+    """Delta wire shape (docs/query.md): one versioned change."""
+    return json.dumps({"Version": version,
+                       "ChangeEvent": event.to_json()},
+                      separators=(",", ":")).encode()
+
+
+def resync_event_json(snapshot) -> bytes:
+    """Resync wire shape (docs/query.md): the subscriber fell behind and
+    the hub collapsed its backlog — the full state at the latest
+    version replaces every missed delta."""
+    return json.dumps({"Version": snapshot.version,
+                       "State": snapshot.to_json()},
+                      separators=(",", ":")).encode()
+
+
 class UrlListener(Listener):
+    # Registered in the state's listener registry for the managed-
+    # listener lifecycle, but fed through a hub subscription — see
+    # ServicesState.add_listener.
+    hub_driven = True
+
     def __init__(self, url: str, managed: bool = False,
                  retries: int = DEFAULT_RETRIES,
                  timeout: float = CLIENT_TIMEOUT) -> None:
@@ -58,8 +108,7 @@ class UrlListener(Listener):
         self.timeout = timeout
         self._managed = managed
         self._name = f"UrlListener({url})"
-        self._chan: "queue.Queue[ChangeEvent]" = queue.Queue(
-            maxsize=LISTENER_EVENT_BUFFER_SIZE)
+        self._sub = None
         self._quit = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # Session-affinity cookie for LB stickiness
@@ -70,7 +119,10 @@ class UrlListener(Listener):
     # -- Listener ----------------------------------------------------------
 
     def chan(self):
-        return self._chan
+        # Hub-driven: no listener queue.  Kept returning None so the
+        # old add_listener path refuses it loudly rather than silently
+        # double-subscribing (watch() is the only supported entry).
+        return None
 
     def name(self) -> str:
         return self._name
@@ -83,10 +135,8 @@ class UrlListener(Listener):
 
     def stop(self) -> None:
         self._quit.set()
-        try:
-            self._chan.put_nowait(None)  # type: ignore[arg-type]
-        except queue.Full:
-            pass  # drain thread re-checks _quit after its current POST
+        if self._sub is not None:
+            self._sub.close()  # wakes the drain thread's blocking get
 
     # -- the POST loop -----------------------------------------------------
 
@@ -101,17 +151,26 @@ class UrlListener(Listener):
                 raise OSError(f"Bad status code returned ({resp.status})")
 
     def watch(self, state: ServicesState) -> None:
-        """Register and start draining events in a background thread
-        (url_listener.go:116-161)."""
-        state.add_listener(self)
+        """Subscribe to the state's query hub and start posting delta
+        events in a background thread (url_listener.go:116-161 recast
+        onto the hub)."""
+        self._sub = state.query_hub().subscribe(
+            self._name, buffer=LISTENER_EVENT_BUFFER_SIZE, prime=False)
+        state.add_listener(self)  # lifecycle registry only (no queue)
 
         def drain() -> None:
             while not self._quit.is_set():
-                event = self._chan.get()
-                if event is None or self._quit.is_set():
+                ev = self._sub.get(timeout=1.0)
+                if self._quit.is_set() or self._sub.closed:
                     return
-                data = state_changed_event_json(state, event)
-                err = with_retries(self.retries, lambda: self._post(data))
+                if ev is None:
+                    continue
+                if ev.kind == "snapshot":
+                    data = resync_event_json(ev.snapshot)
+                else:
+                    data = delta_event_json(ev.version, ev.change)
+                err = with_retries(self.retries,
+                                   lambda: self._post(data))
                 if err is not None:
                     log.warning("Failed posting state to '%s' %s: %s",
                                 self.url, self.name(), err)

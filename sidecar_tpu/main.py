@@ -201,6 +201,12 @@ class SidecarNode:
         cfg = self.config.sidecar
         log.info("%s", format_config(self.config))
 
+        # The query plane (sidecar_tpu/query/): attach the hub BEFORE
+        # any traffic so the v1 snapshot is built at boot — every
+        # read-path consumer below (UrlListener, /watch, ADS)
+        # subscribes to it instead of touching the state lock.
+        self.state.query_hub()
+
         # Single-writer state mutation loop (main.go:296-299).
         threading.Thread(
             target=self.state.process_service_msgs,

@@ -1056,6 +1056,17 @@ class CompressedSim:
         self._check_horizon(state, num_rounds)
         return self._run_fast_jit(state, key, num_rounds)
 
+    def run_with_deltas(self, state, key, num_rounds: int, cap: int):
+        """Scan with per-round changed-belief extraction: returns
+        ``(final state, DeltaBatch[num_rounds])``.  The belief view
+        ``max(floor, cache hit, own)`` is materialized per round
+        (ops/delta.compressed_belief — gathers + elementwise, no
+        scatters) and diffed on device; this is O(N·M) per round, the
+        bridge/test regime's tool — north-star-scale delta streaming
+        stays on the exact model's shard sizes (see ops/delta.py)."""
+        self._check_horizon(state, num_rounds)
+        return self._run_deltas_jit(state, key, num_rounds, cap)
+
     @functools.partial(jax.jit, static_argnums=0)
     def _step_jit(self, state, key):
         return self._step(state, key)
@@ -1090,6 +1101,25 @@ class CompressedSim:
             return self._step(st, jax.random.fold_in(key, st.round_idx)), None
         final, _ = lax.scan(body, state, None, length=num_rounds)
         return final
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+    def _run_deltas_jit(self, state, key, num_rounds, cap):
+        # Lazy import — ops/delta imports this module's hash_line.
+        from sidecar_tpu.ops.delta import compressed_belief, extract_delta
+
+        def belief(st):
+            return compressed_belief(st.own, st.cache_slot, st.cache_val,
+                                     st.floor, self.p.services_per_node)
+
+        def body(carry, _):
+            st, bel = carry
+            st2 = self._step(st, jax.random.fold_in(key, st.round_idx))
+            bel2 = belief(st2)
+            return (st2, bel2), extract_delta(bel, bel2, cap)
+
+        (final, _), deltas = lax.scan(body, (state, belief(state)), None,
+                                      length=num_rounds)
+        return final, deltas
 
 
 # -- host-path kernels ------------------------------------------------------

@@ -289,6 +289,18 @@ class ExactSim:
         self._check_horizon(state, num_rounds)
         return self._run_fast_jit(state, key, num_rounds)
 
+    def run_with_deltas(self, state: SimState, key: jax.Array,
+                        num_rounds: int, cap: int):
+        """Scan with per-round changed-cell extraction (ops/delta.py):
+        returns ``(final state, DeltaBatch[num_rounds], conv
+        [num_rounds])``.  The diff runs inside the scan on consecutive
+        ``known`` tensors, so only the capped index sets leave the
+        device — the query plane's streaming contract (a round that
+        changes more than ``cap`` cells flags ``overflow`` and the
+        consumer resyncs from a snapshot)."""
+        self._check_horizon(state, num_rounds)
+        return self._run_deltas_jit(state, key, num_rounds, cap)
+
     @functools.partial(jax.jit, static_argnums=0)
     def _step_jit(self, state: SimState, key: jax.Array) -> SimState:
         return self._step(state, key)
@@ -313,3 +325,19 @@ class ExactSim:
 
         final, _ = lax.scan(body, state, None, length=num_rounds)
         return final
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+    def _run_deltas_jit(self, state: SimState, key: jax.Array,
+                        num_rounds: int, cap: int):
+        # Lazy import: ops/delta pulls in the compressed model's line
+        # hash, and a module-level import would cycle through models.
+        from sidecar_tpu.ops.delta import extract_delta
+
+        def body(st, _):
+            st2 = self._step(st, jax.random.fold_in(key, st.round_idx))
+            return st2, (extract_delta(st.known, st2.known, cap),
+                         self.convergence(st2))
+
+        final, (deltas, conv) = lax.scan(body, state, None,
+                                         length=num_rounds)
+        return final, deltas, conv

@@ -10,18 +10,25 @@ both (or NACKs by echoing the nonce with an error_detail), and a new
 snapshot triggers a push (envoy/server_test.go:138-205 drives exactly
 this with a mock ADS client).
 
-This implementation serves the same protocol with grpcio generic
-handlers (no generated service stubs) over the shared resource
-generation in proxy/envoy.py.  Ordering on snapshot push follows
-go-control-plane's make-before-break: clusters → endpoints →
-listeners."""
+Since the query plane landed, the 1 s ``LastChanged`` poll is gone:
+the server subscribes to the catalog's
+:class:`~sidecar_tpu.query.hub.QueryHub` and rebuilds its xDS snapshot
+the moment a delta arrives (push-on-delta), reading the hub's immutable
+catalog snapshot — never ``state._lock``.  Wire versions are the hub's
+monotonic snapshot versions, so the SotW contract (versioned full
+snapshots, ACK/NACK by version + nonce) is unchanged on the wire while
+update latency drops from worst-case 1 s to the hub's fan-out latency.
+
+This implementation serves the protocol with grpcio generic handlers
+(no generated service stubs) over the shared resource generation in
+proxy/envoy.py.  Ordering on snapshot push follows go-control-plane's
+make-before-break: clusters → endpoints → listeners."""
 
 from __future__ import annotations
 
 import logging
 import queue
 import threading
-import time
 from concurrent import futures
 from typing import Optional
 
@@ -30,7 +37,6 @@ import grpc
 from sidecar_tpu.catalog.state import ServicesState
 from sidecar_tpu.proxy import xds_proto
 from sidecar_tpu.proxy.envoy import (
-    LOOPER_UPDATE_INTERVAL,
     TYPE_CLUSTER,
     TYPE_ENDPOINT,
     TYPE_LISTENER,
@@ -69,7 +75,7 @@ class Snapshot:
 
 
 class AdsServer:
-    """Snapshot cache + LastChanged poll + the ADS stream service."""
+    """Snapshot cache + hub-driven refresh + the ADS stream service."""
 
     def __init__(self, state: ServicesState, bind_ip: str = "0.0.0.0",
                  use_hostnames: bool = False) -> None:
@@ -77,21 +83,24 @@ class AdsServer:
         self.bind_ip = bind_ip
         self.use_hostnames = use_hostnames
         self._snapshot = Snapshot("0", {t: [] for t in PUSH_ORDER})
-        self._last_changed = -1
+        self._published_version = -1   # hub version of self._snapshot
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._server: Optional[grpc.Server] = None
-        self._poll_thread: Optional[threading.Thread] = None
+        self._delta_thread: Optional[threading.Thread] = None
 
     # -- snapshot maintenance ----------------------------------------------
 
     def refresh(self) -> bool:
-        """Rebuild + publish a snapshot if the catalog changed
-        (server.go:70-110).  True when a new snapshot was set."""
-        if self.state.last_changed == self._last_changed:
+        """Rebuild + publish an xDS snapshot if the hub moved past the
+        published version (server.go:70-110 recast onto the query
+        plane).  Reads the hub's immutable catalog snapshot — no
+        ``state._lock`` — and reuses its version as the SotW wire
+        version.  True when a new snapshot was set."""
+        catalog = self.state.query_hub().current()
+        if catalog.version == self._published_version:
             return False
-        last_changed = self.state.last_changed
-        res = resources_from_state(self.state, self.bind_ip,
+        res = resources_from_state(catalog, self.bind_ip,
                                    self.use_hostnames, eds_mode="ads")
         by_type = {
             TYPE_CLUSTER: [(c["name"], xds_proto.cluster_to_any(c))
@@ -103,8 +112,8 @@ class AdsServer:
                             for li in res.listeners],
         }
         with self._cond:
-            self._snapshot = Snapshot(str(time.time_ns()), by_type)
-            self._last_changed = last_changed
+            self._snapshot = Snapshot(str(catalog.version), by_type)
+            self._published_version = catalog.version
             self._cond.notify_all()
         log.debug("ads: published snapshot %s", self._snapshot.version)
         return True
@@ -113,12 +122,35 @@ class AdsServer:
         with self._cond:
             return self._snapshot
 
-    def _poll_loop(self) -> None:
-        while not self._stop.wait(LOOPER_UPDATE_INTERVAL):
+    def _delta_loop(self) -> None:
+        """Push-on-delta: block on the hub subscription, refresh on any
+        event.  A tiny buffer is enough — coalescing to snapshot-at-
+        latest is exactly right here, since refresh always reads the
+        CURRENT catalog snapshot regardless of how many deltas the
+        wake-up represents."""
+        sub = self.state.query_hub().subscribe("ads", buffer=4,
+                                               prime=False)
+        try:
+            # Close the serve()-time race: a publish that lands after
+            # serve()'s initial refresh() but before this subscribe()
+            # has no subscriber to wake — catch up once, now that every
+            # later publish is guaranteed to land on the queue.  (The
+            # old 1 s poll hid this window; no-op when nothing moved.)
             try:
                 self.refresh()
             except Exception:
                 log.exception("ads: snapshot refresh failed")
+            while not self._stop.is_set():
+                ev = sub.get(timeout=0.5)
+                if ev is None:
+                    continue
+                sub.drain()  # collapse the burst; refresh reads latest
+                try:
+                    self.refresh()
+                except Exception:
+                    log.exception("ads: snapshot refresh failed")
+        finally:
+            sub.close()
 
     # -- the stream handler -------------------------------------------------
 
@@ -266,9 +298,9 @@ class AdsServer:
             raise OSError(f"ads: failed to bind {bind}:{port} "
                           "(address in use?)")
         self._server.start()
-        self._poll_thread = threading.Thread(
-            target=self._poll_loop, name="ads-poll", daemon=True)
-        self._poll_thread.start()
+        self._delta_thread = threading.Thread(
+            target=self._delta_loop, name="ads-delta", daemon=True)
+        self._delta_thread.start()
         log.info("ads: gRPC control plane on %s:%d", bind, bound)
         return bound
 
@@ -276,5 +308,5 @@ class AdsServer:
         self._stop.set()
         if self._server is not None:
             self._server.stop(grace=0.5)
-        if self._poll_thread is not None:
-            self._poll_thread.join(timeout=2.0)
+        if self._delta_thread is not None:
+            self._delta_thread.join(timeout=2.0)

@@ -196,9 +196,16 @@ def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
     listener_map: dict[str, dict] = {}
     ports_map: dict[int, str] = {}
 
-    with state._lock:
-        walk = [(c, h, svc.copy())
-                for c, h, svc in state.each_service_sorted()]
+    # ``state`` is either a live ServicesState (walk under its lock,
+    # copying out) or an immutable query-plane CatalogSnapshot (no lock
+    # to take, nothing can mutate — the ADS path reads snapshots).
+    lock = getattr(state, "_lock", None)
+    if lock is None:
+        walk = list(state.each_service_sorted())
+    else:
+        with lock:
+            walk = [(c, h, svc.copy())
+                    for c, h, svc in state.each_service_sorted()]
     for _, _, svc in walk:
         if not svc.is_alive():
             continue

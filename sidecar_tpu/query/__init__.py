@@ -1,0 +1,35 @@
+"""The versioned snapshot + delta query plane — the read-path half of
+the framework.
+
+Every read-path consumer (web ``/watch``, ``UrlListener`` POSTs, the
+Envoy ADS looper) historically re-serialized the whole ``ServicesState``
+under its lock on every change, and ADS discovered changes by polling
+``LastChanged`` once per second.  This package replaces all of that with
+one subsystem:
+
+* :mod:`sidecar_tpu.query.snapshot` — immutable, monotonically
+  versioned, copy-on-write catalog snapshots published by the writer
+  path, so readers never touch ``state._lock`` and serialization
+  happens at most once per version (cached on the immutable object).
+* :mod:`sidecar_tpu.query.hub` — the subscription hub: per-subscriber
+  bounded queues, delta coalescing under backpressure (a subscriber
+  that falls behind collapses to one snapshot-at-latest-version
+  event), and ``query.*`` drop/coalesce counters.
+
+The TPU side of the plane — per-round changed-cell extraction from the
+simulators — lives in :mod:`sidecar_tpu.ops.delta` and streams out
+through :mod:`sidecar_tpu.bridge.sim_bridge`.
+
+Wire shapes and backpressure semantics: docs/query.md.
+"""
+
+from sidecar_tpu.query.snapshot import CatalogSnapshot, ServerView
+from sidecar_tpu.query.hub import QueryEvent, QueryHub, Subscription
+
+__all__ = [
+    "CatalogSnapshot",
+    "ServerView",
+    "QueryEvent",
+    "QueryHub",
+    "Subscription",
+]

@@ -1,0 +1,287 @@
+"""The subscription hub: versioned snapshot publication + delta fan-out.
+
+The hub sits on the catalog's writer path: ``ServicesState`` calls
+:meth:`QueryHub.publish` for every change event (from inside
+``notify_listeners``, i.e. under the writer's lock, so versions are
+totally ordered by construction).  Each publish builds the successor
+:class:`~sidecar_tpu.query.snapshot.CatalogSnapshot` by copy-on-write
+and hands every subscriber a delta event on a bounded queue.
+
+Backpressure semantics (docs/query.md): a subscriber whose queue is
+full does NOT silently lose the event — its queued deltas are
+discarded and replaced by a single *snapshot-at-latest-version* marker.
+The subscriber's next reads then see one ``snapshot`` event carrying
+the current version, from which delta flow resumes gap-free.  Both
+sides of the collapse are counted (``query.hub.dropped`` — deltas
+discarded, ``query.hub.coalesced`` — collapse occurrences) so a slow
+consumer degrades observably instead of invisibly.
+
+The hub never blocks the writer: publish is deque appends under
+per-subscription mutexes, O(subscribers) with no serialization (the
+snapshot's JSON forms are computed lazily by whichever reader first
+needs them).
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+from typing import Optional
+
+from sidecar_tpu import metrics
+from sidecar_tpu.query.snapshot import (
+    CatalogSnapshot,
+    ServerView,
+    snapshot_from_state,
+)
+
+log = logging.getLogger(__name__)
+
+# Default per-subscriber queue bound.  Small enough that a stuck
+# consumer collapses to a snapshot quickly instead of holding hundreds
+# of delta events alive; large enough to ride normal bursts.
+DEFAULT_SUBSCRIBER_BUFFER = 64
+
+
+class QueryEvent:
+    """One item on a subscription queue.
+
+    ``kind`` is ``"delta"`` (one catalog change; ``change`` holds the
+    :class:`~sidecar_tpu.catalog.state.ChangeEvent`) or ``"snapshot"``
+    (resync-at-latest: the subscriber fell behind, or this is the
+    priming event of a fresh subscription).  ``version`` is the hub
+    version AFTER applying the event; ``snapshot`` is the catalog at
+    exactly that version.
+    """
+
+    __slots__ = ("kind", "version", "snapshot", "change")
+
+    def __init__(self, kind: str, version: int,
+                 snapshot: CatalogSnapshot, change=None) -> None:
+        self.kind = kind
+        self.version = version
+        self.snapshot = snapshot
+        self.change = change
+
+    def __repr__(self) -> str:  # pragma: no cover — debugging aid
+        return f"QueryEvent({self.kind}, v{self.version})"
+
+
+class Subscription:
+    """A bounded, coalescing delta queue for one consumer."""
+
+    def __init__(self, hub: "QueryHub", name: str, buffer: int) -> None:
+        if buffer < 1:
+            raise ValueError("subscription buffer must be >= 1")
+        self.name = name
+        self._hub = hub
+        self._buffer = buffer
+        self._cond = threading.Condition()
+        self._deque: "collections.deque[QueryEvent]" = collections.deque()
+        self._pending_snapshot: Optional[CatalogSnapshot] = None
+        self._closed = False
+
+    # -- producer side (hub, under the writer path) ------------------------
+
+    def _offer(self, event: QueryEvent) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            if self._pending_snapshot is not None:
+                # Already collapsed: the marker subsumes every delta up
+                # to latest, just slide it forward.
+                self._pending_snapshot = event.snapshot
+                metrics.incr("query.hub.dropped")
+            elif len(self._deque) >= self._buffer:
+                dropped = len(self._deque)
+                self._deque.clear()
+                self._pending_snapshot = event.snapshot
+                metrics.incr("query.hub.dropped", dropped + 1)
+                metrics.incr("query.hub.coalesced")
+                log.warning("query: subscriber %s fell behind; coalesced "
+                            "%d deltas to snapshot v%d", self.name,
+                            dropped + 1, event.version)
+            else:
+                self._deque.append(event)
+            self._cond.notify_all()
+
+    # -- consumer side -----------------------------------------------------
+
+    def get(self, timeout: Optional[float] = None) -> Optional[QueryEvent]:
+        """Next event, or None on timeout / after :meth:`close`.  A
+        pending resync marker is delivered before any newer deltas (it
+        is always the oldest information the subscriber is missing)."""
+        with self._cond:
+            if not self._deque and self._pending_snapshot is None \
+                    and not self._closed:
+                self._cond.wait(timeout=timeout)
+            if self._pending_snapshot is not None:
+                snap = self._pending_snapshot
+                self._pending_snapshot = None
+                return QueryEvent("snapshot", snap.version, snap)
+            if self._deque:
+                return self._deque.popleft()
+            return None
+
+    def drain(self) -> list[QueryEvent]:
+        """Every immediately-available event (burst coalescing for
+        consumers that batch, e.g. the /watch chunk writer)."""
+        out = []
+        with self._cond:
+            if self._pending_snapshot is not None:
+                snap = self._pending_snapshot
+                self._pending_snapshot = None
+                out.append(QueryEvent("snapshot", snap.version, snap))
+            while self._deque:
+                out.append(self._deque.popleft())
+        return out
+
+    def pending(self) -> int:
+        with self._cond:
+            return len(self._deque) + (
+                1 if self._pending_snapshot is not None else 0)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def close(self) -> None:
+        """Detach from the hub; wakes any blocked :meth:`get`."""
+        with self._cond:
+            self._closed = True
+            self._deque.clear()
+            self._pending_snapshot = None
+            self._cond.notify_all()
+        self._hub._remove(self)
+
+
+class QueryHub:
+    """Snapshot publisher + subscriber registry for one catalog."""
+
+    def __init__(self, state,
+                 default_buffer: int = DEFAULT_SUBSCRIBER_BUFFER) -> None:
+        self.state = state
+        self.default_buffer = default_buffer
+        self._lock = threading.Lock()      # subscriber set + version
+        self._subs: list[Subscription] = []
+        self._current: Optional[CatalogSnapshot] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def attach(self) -> CatalogSnapshot:
+        """Build the version-1 snapshot from the live state.  Takes the
+        state lock itself (re-entrant from ``ServicesState.query_hub``);
+        lock order is always state → hub."""
+        with self.state._lock:
+            with self._lock:
+                if self._current is None:
+                    self._current = snapshot_from_state(self.state, 1)
+                    metrics.set_gauge("query.snapshot.version", 1)
+                return self._current
+
+    def current(self) -> CatalogSnapshot:
+        """The latest snapshot — one reference read, never a lock on
+        the catalog."""
+        snap = self._current
+        if snap is None:
+            return self.attach()
+        return snap
+
+    # -- the writer-path publish -------------------------------------------
+
+    def publish(self, event) -> CatalogSnapshot:
+        """Build + publish the successor snapshot for one ChangeEvent.
+
+        Runs on the catalog writer path, under ``state._lock`` (the
+        re-entrant lock makes the state reads here free).  Copy-on-write
+        scope: only the changed host's ``ServerView`` is rebuilt — from
+        the previous snapshot's frozen services when the host's service
+        set is unchanged (O(1) upsert of the event's own frozen copy),
+        from the live state when services appeared/vanished (catches
+        tombstone GC deletions, which emit no events)."""
+        host = event.service.hostname
+        with self._lock:
+            prev = self._current
+            if prev is None:
+                # Publish before attach: the implicit v1 snapshot is
+                # built from the (already mutated) state, so the v2
+                # successor below is content-identical — harmless.
+                prev = snapshot_from_state(self.state, 1)
+            servers = dict(prev.servers)
+            live = self.state.servers.get(host)
+            if live is None:
+                servers.pop(host, None)
+            else:
+                prev_view = prev.servers.get(host)
+                if prev_view is not None and \
+                        prev_view.services.keys() == live.services.keys() \
+                        and event.service.id in live.services:
+                    services = dict(prev_view.services)
+                    services[event.service.id] = event.service
+                else:
+                    services = {sid: svc.copy()
+                                for sid, svc in live.services.items()}
+                servers[host] = ServerView(
+                    name=live.name, services=services,
+                    last_updated=live.last_updated,
+                    last_changed=live.last_changed)
+            snap = CatalogSnapshot(
+                version=prev.version + 1,
+                changed_ns=self.state.last_changed,
+                cluster_name=self.state.cluster_name,
+                hostname=self.state.hostname,
+                servers=servers)
+            self._current = snap
+            subs = list(self._subs)
+        metrics.incr("query.hub.published")
+        metrics.set_gauge("query.snapshot.version", snap.version)
+        qevent = QueryEvent("delta", snap.version, snap, change=event)
+        for sub in subs:
+            sub._offer(qevent)
+        return snap
+
+    # -- subscriptions -----------------------------------------------------
+
+    def subscribe(self, name: str, buffer: Optional[int] = None,
+                  prime: bool = True) -> Subscription:
+        """Register a consumer.  With ``prime`` the first read returns a
+        snapshot event at the current version, so every subscriber
+        starts from a known version cursor."""
+        sub = Subscription(self, name,
+                           buffer if buffer is not None
+                           else self.default_buffer)
+        self.current()  # ensure attached (state→hub lock order)
+        # Snapshot read + registration are ONE critical section: a
+        # publish interleaved between them would be missed by both the
+        # prime snapshot and the fan-out (it copies _subs before the
+        # append) — the subscriber would hold a stale cursor with no
+        # delta coming.
+        with self._lock:
+            self._subs.append(sub)
+            if prime:
+                # Inside the registration critical section: a publish
+                # interleaved after registration could collapse the
+                # queue to a NEWER pending snapshot, and an unlocked
+                # prime assignment would overwrite it with the older
+                # one (hub→sub lock order matches publish's fan-out;
+                # close() releases the cond before taking the hub
+                # lock, so no inversion).
+                with sub._cond:
+                    sub._pending_snapshot = self._current
+                    sub._cond.notify_all()
+            n_subs = len(self._subs)
+        metrics.set_gauge("query.hub.subscribers", n_subs)
+        return sub
+
+    def _remove(self, sub: Subscription) -> None:
+        with self._lock:
+            try:
+                self._subs.remove(sub)
+            except ValueError:
+                return
+            metrics.set_gauge("query.hub.subscribers", len(self._subs))
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)

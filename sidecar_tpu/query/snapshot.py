@@ -1,0 +1,141 @@
+"""Immutable versioned catalog snapshots — the copy-on-write read view.
+
+A :class:`CatalogSnapshot` is a frozen view of the replicated catalog at
+one hub version: readers hold a reference and walk it without any lock,
+because nothing ever mutates a published snapshot.  The publisher
+(:class:`sidecar_tpu.query.hub.QueryHub`) builds each successor by
+structural sharing: only the server touched by a change event gets a
+fresh service map; every other host's map is the same object as in the
+predecessor.  Publishing is therefore O(services on the changed host),
+not O(catalog) — and serialization (``to_json``/``encode``/
+``by_service``) is computed lazily, at most once per version, shared by
+every consumer of that version (the old read path re-serialized the
+whole state per listener per event).
+
+Versions are a dense monotonic int sequence starting at 1 (the attach
+snapshot).  ``changed_ns`` carries the catalog's ``LastChanged``
+nanosecond stamp at publish time, so the wire keeps the reference's
+RFC3339 ``LastChanged`` field alongside the new version cursor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterator, Mapping, Optional
+
+from sidecar_tpu.service import Service, ns_to_rfc3339
+
+
+@dataclasses.dataclass(frozen=True)
+class ServerView:
+    """Frozen per-host slice of a snapshot (the ``Server`` analog)."""
+
+    name: str
+    services: Mapping[str, Service]   # sid → frozen Service copy
+    last_updated: int
+    last_changed: int
+
+    def to_json(self) -> dict:
+        return {
+            "Name": self.name,
+            "Services": {sid: s.to_json()
+                         for sid, s in self.services.items()},
+            "LastUpdated": ns_to_rfc3339(self.last_updated),
+            "LastChanged": ns_to_rfc3339(self.last_changed),
+        }
+
+
+class CatalogSnapshot:
+    """One immutable, versioned view of the catalog.
+
+    The lazy serialization caches are benign-race safe: concurrent
+    first readers may compute the same value twice, but assignment is
+    atomic and the inputs are frozen, so every reader sees a correct
+    (and eventually the same) object.
+    """
+
+    __slots__ = ("version", "changed_ns", "cluster_name", "hostname",
+                 "servers", "_json", "_encoded", "_by_service")
+
+    def __init__(self, version: int, changed_ns: int, cluster_name: str,
+                 hostname: str,
+                 servers: Mapping[str, ServerView]) -> None:
+        self.version = version
+        self.changed_ns = changed_ns
+        self.cluster_name = cluster_name
+        self.hostname = hostname
+        self.servers = servers
+        self._json: Optional[dict] = None
+        self._encoded: Optional[bytes] = None
+        self._by_service: Optional[dict] = None
+
+    # -- iteration (mirrors ServicesState's view methods) ------------------
+
+    def each_service_sorted(self) -> Iterator[tuple[str, str, Service]]:
+        """Deterministic (hostname, sid, service) walk — the same
+        contract as ``ServicesState.each_service_sorted`` so consumers
+        like the Envoy resource generator duck-type over either."""
+        for hostname in sorted(self.servers):
+            server = self.servers[hostname]
+            for sid in sorted(server.services):
+                yield hostname, sid, server.services[sid]
+
+    def service_count(self) -> int:
+        return sum(len(s.services) for s in self.servers.values())
+
+    # -- cached serializations ---------------------------------------------
+
+    def to_json(self) -> dict:
+        """State-dump wire shape (``ServicesState.to_json`` parity) plus
+        the version cursor."""
+        if self._json is None:
+            self._json = {
+                "Servers": {h: s.to_json()
+                            for h, s in self.servers.items()},
+                "LastChanged": ns_to_rfc3339(self.changed_ns),
+                "ClusterName": self.cluster_name,
+                "Hostname": self.hostname,
+                "Version": self.version,
+            }
+        return self._json
+
+    def encode(self) -> bytes:
+        if self._encoded is None:
+            self._encoded = json.dumps(
+                self.to_json(), separators=(",", ":")).encode()
+        return self._encoded
+
+    def by_service(self) -> dict[str, list[Service]]:
+        """Instances grouped by service name (``ServicesState.by_service``
+        parity, same deterministic order) — computed once per version."""
+        if self._by_service is None:
+            out: dict[str, list[Service]] = {}
+            for _, _, svc in self.each_service_sorted():
+                out.setdefault(svc.name, []).append(svc)
+            self._by_service = out
+        return self._by_service
+
+    def by_service_json(self) -> dict:
+        return {name: [svc.to_json() for svc in instances]
+                for name, instances in self.by_service().items()}
+
+
+def snapshot_from_state(state, version: int) -> CatalogSnapshot:
+    """Full snapshot of a live ``ServicesState`` — the attach/resync
+    builder.  Caller must hold (or be on the thread that holds)
+    ``state._lock``; the hub's attach path does."""
+    servers = {
+        h: ServerView(
+            name=server.name,
+            services={sid: svc.copy()
+                      for sid, svc in server.services.items()},
+            last_updated=server.last_updated,
+            last_changed=server.last_changed,
+        )
+        for h, server in state.servers.items()
+    }
+    return CatalogSnapshot(
+        version=version, changed_ns=state.last_changed,
+        cluster_name=state.cluster_name, hostname=state.hostname,
+        servers=servers)

@@ -14,7 +14,7 @@ from typing import Callable, Optional
 
 from sidecar_tpu import service as svc_mod
 from sidecar_tpu.catalog import ServicesState, decode
-from sidecar_tpu.catalog.state import ChangeEvent
+from sidecar_tpu.catalog.state import ChangeEvent, Server
 from sidecar_tpu.runtime.looper import Looper, TimedLooper
 from sidecar_tpu.service import Service
 
@@ -60,6 +60,9 @@ class Receiver:
         self.looper = looper if looper is not None else TimedLooper(
             RELOAD_HOLD_DOWN)
         self.subscriptions: list[str] = []
+        # Version cursor of the sender's query plane (docs/query.md);
+        # 0 = no versioned document seen yet.
+        self.last_version = 0
 
     # -- subscriptions -----------------------------------------------------
 
@@ -80,12 +83,30 @@ class Receiver:
             pass  # already saturated; the pending flush covers us
 
     def handle_update(self, payload: bytes | str) -> None:
-        """Accept one POSTed StateChangedEvent (receiver/http.go:17-63):
-        keep the newest state by LastChanged, filter via should_notify +
-        subscriptions, then enqueue a batched reload."""
+        """Accept one POSTed catalog document (receiver/http.go:17-63
+        extended for the query plane, docs/query.md):
+
+        * delta — ``{"Version", "ChangeEvent"}``: merge the one record
+          into the local mirror (LWW, so gaps and duplicates are safe —
+          every delta carries the full record);
+        * resync/legacy — any document with ``"State"``: replace the
+          mirror when newer by LastChanged (the pre-query-plane
+          StateChangedEvent shape decodes through the same path).
+
+        Both filter via should_notify + subscriptions, then enqueue a
+        batched reload."""
         evt = json.loads(payload)
         if not isinstance(evt, dict):
             raise ValueError("StateChangedEvent: not an object")
+        if "State" not in evt:
+            if "ChangeEvent" in evt:
+                self._handle_delta(evt)
+                return
+            # Neither shape: malformed untrusted input, not an "empty
+            # resync" — installing an empty mirror from {} would wipe
+            # downstream config.
+            raise ValueError("catalog document: neither State nor "
+                             "ChangeEvent present")
         state_doc = evt.get("State") or {}
         change_doc = evt.get("ChangeEvent") or {}
         if not isinstance(state_doc, dict) \
@@ -93,19 +114,92 @@ class Receiver:
             raise ValueError("StateChangedEvent: State/ChangeEvent "
                              "not objects")
         state = decode(json.dumps(state_doc))
-        change = ChangeEvent.from_json(change_doc)
+        change = (ChangeEvent.from_json(change_doc)
+                  if change_doc else None)
+        version = evt.get("Version") or state_doc.get("Version")
 
         with self.state_lock:
             if self.current_state is not None and \
                     self.current_state.last_changed >= state.last_changed:
                 return
             self.current_state = state
-            self.last_svc_changed = change.service
+            if isinstance(version, int):
+                self.last_version = version
+            if change is None:
+                # Resync document (no event rode along): the full
+                # replacement is itself the significant change.
+                if self.on_update is None:
+                    log.error("No on_update() callback registered!")
+                    return
+            else:
+                self.last_svc_changed = change.service
+                if not should_notify(change.previous_status,
+                                     change.service.status):
+                    return
+                if not self.is_subscribed(change.service.name):
+                    return
+                if self.on_update is None:
+                    log.error("No on_update() callback registered!")
+                    return
+        self.enqueue_update()
 
-            if not should_notify(change.previous_status,
-                                 change.service.status):
+    def _handle_delta(self, evt: dict) -> None:
+        """One versioned delta: upsert the record into the local mirror
+        iff it invalidates the held copy.  The sender's hub already ran
+        the full merge semantics (staleness gate, DRAINING stickiness);
+        the mirror records the published outcome, so no re-gating
+        here — re-running the staleness gate against the receiver's
+        clock would wrongly drop replayed-but-valid history."""
+        change_doc = evt.get("ChangeEvent")
+        if not isinstance(change_doc, dict):
+            raise ValueError("delta event: ChangeEvent not an object")
+        version = evt.get("Version")
+        if not isinstance(version, int):
+            raise ValueError("delta event: missing integer Version")
+        change = ChangeEvent.from_json(change_doc)
+        svc = change.service
+
+        with self.state_lock:
+            # The version cursor is bookkeeping only, NEVER a gate: a
+            # restarted sender's hub restarts at version 1, and a
+            # cursor-gated receiver would silently drop every delta
+            # until the new epoch caught up.  Record-level LWW below is
+            # what keeps the mirror correct — duplicates and replays
+            # are idempotent no-ops there.
+            if version > self.last_version + 1 and self.last_version:
+                log.info("delta version gap: %d -> %d (LWW merge keeps "
+                         "the mirror consistent)",
+                         self.last_version, version)
+            elif version < self.last_version:
+                log.info("delta version went backwards: %d -> %d "
+                         "(sender restart?); continuing on record LWW",
+                         self.last_version, version)
+            self.last_version = max(self.last_version, version)
+            if self.current_state is None:
+                self.current_state = ServicesState(hostname="")
+            state = self.current_state
+            server = state.servers.get(svc.hostname)
+            if server is None:
+                server = state.servers[svc.hostname] = Server(svc.hostname)
+            held = server.services.get(svc.id)
+            advanced = held is None or svc.invalidates(held)
+            if advanced:
+                server.services[svc.id] = svc.copy()
+                # max(), not assignment: a valid-but-older record for a
+                # DIFFERENT service must not move the server's change
+                # stamps backwards.
+                server.last_updated = max(server.last_updated,
+                                          svc.updated)
+                server.last_changed = max(server.last_changed,
+                                          svc.updated)
+                state.last_changed = max(state.last_changed, svc.updated)
+            self.last_svc_changed = svc
+
+            if not advanced:
+                return  # duplicate/replay: mirror unchanged, no reload
+            if not should_notify(change.previous_status, svc.status):
                 return
-            if not self.is_subscribed(change.service.name):
+            if not self.is_subscribed(svc.name):
                 return
             if self.on_update is None:
                 log.error("No on_update() callback registered!")

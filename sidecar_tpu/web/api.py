@@ -11,7 +11,8 @@ Routes (http.go:64-76, http_api.go:35-45):
   GET  /api/state.json              raw state dump
   GET  /api/services/{name}.json    one service's instances
   POST /api/services/{id}/drain     set local instance DRAINING
-  GET  /api/watch (+ /watch)        long-poll state stream
+  GET  /api/watch (+ /watch)        versioned snapshot+delta stream
+                                    (?since=V cursor; docs/query.md)
   GET  /servers                     human-readable state
   GET  /api/debug/profile           live sampling CPU profile (pprof analog)
   GET  /api/haproxy/stats.csv       relay of the managed HAProxy's stats CSV
@@ -34,13 +35,39 @@ from sidecar_tpu.service import DRAINING, ns_to_rfc3339
 log = logging.getLogger(__name__)
 
 
+class _DropOldestQueue(queue.Queue):
+    """Bounded queue whose non-blocking put evicts the OLDEST entry
+    instead of failing: a slow /watch client keeps receiving the newest
+    events (and a ``web.watch.dropped`` count says how many it lost)
+    rather than silently freezing on a full buffer."""
+
+    def put_nowait(self, item) -> None:
+        from sidecar_tpu import metrics
+
+        while True:
+            try:
+                super().put_nowait(item)
+                return
+            except queue.Full:
+                try:
+                    self.get_nowait()
+                    metrics.incr("web.watch.dropped")
+                except queue.Empty:
+                    pass  # racing consumer freed space; retry
+
+
 class HttpListener(Listener):
-    """Listener for /watch (http_listener.go:12-38): larger buffer for
-    the slow-HTTP-link problem."""
+    """The queue-shaped catalog listener (http_listener.go:12-38):
+    larger buffer for slow consumers, drop-oldest beyond it.  The
+    /watch HTTP stream itself rides the query hub now; this class is
+    the surface for in-process ``add_listener`` consumers (embedders,
+    tools) that want a plain bounded queue of ChangeEvents — its
+    ``web.watch.dropped`` counter reports THAT queue's evictions (hub
+    subscribers report through ``query.hub.dropped`` instead)."""
 
     def __init__(self) -> None:
         self._name = f"httpListener-{time.time_ns()}"
-        self._chan: "queue.Queue" = queue.Queue(maxsize=50)
+        self._chan: "queue.Queue" = _DropOldestQueue(maxsize=50)
 
     def chan(self):
         return self._chan
@@ -104,7 +131,7 @@ class SidecarApi:
                  query: Optional[dict] = None,
                  client: Optional[str] = None):
         """Returns (status, content_type, body_bytes) or a stream marker
-        ("watch", by_service) for the long-poll route.  ``client`` is
+        ("watch", by_service, since) for the stream route.  ``client`` is
         the peer IP when the call arrives over HTTP (None = a trusted
         in-process caller)."""
         query = query or {}
@@ -119,7 +146,15 @@ class SidecarApi:
 
         if parts == ["watch"] and method == "GET":
             by_service = query.get("by_service", ["true"])[0] != "false"
-            return ("watch", by_service)
+            since = None
+            raw = query.get("since", [None])[0]
+            if raw is not None:
+                try:
+                    since = int(raw)
+                except ValueError:
+                    return self._error(400, "since must be an integer "
+                                            "version cursor")
+            return ("watch", by_service, since)
 
         if method == "POST":
             if len(parts) == 3 and parts[0] == "services" \
@@ -381,13 +416,27 @@ class SidecarApi:
                   sorted(stacks.items(), key=lambda kv: -kv[1])]
         return 200, "text/plain", "\n".join(lines).encode(), CORS_HEADERS
 
-    def watch_snapshot(self, by_service: bool) -> bytes:
-        if by_service:
-            with self.state._lock:
-                doc = {name: [svc.to_json() for svc in instances]
-                       for name, instances in self.state.by_service().items()}
-            return json.dumps(doc).encode()
-        return self.state.encode()
+    # -- watch plumbing ----------------------------------------------------
+
+    def watch_snapshot_doc(self, by_service: bool, snapshot=None) -> dict:
+        """The /watch snapshot document (docs/query.md): the catalog at
+        one version, from the hub's immutable snapshot — no state lock,
+        serialization cached per version."""
+        if snapshot is None:
+            snapshot = self.state.query_hub().current()
+        body = (snapshot.by_service_json() if by_service
+                else snapshot.to_json())
+        return {"Version": snapshot.version, "Snapshot": body}
+
+    def watch_delta_doc(self, events: list) -> dict:
+        """One coalesced /watch delta document covering the contiguous
+        version range [From, Version] — one ChangeEvent per version."""
+        return {
+            "From": events[0].version,
+            "Version": events[-1].version,
+            "Deltas": [ev.change.to_json() for ev in events],
+        }
+
 
     def _json(self, status: int, doc: dict):
         body = json.dumps(doc, indent=2).encode()

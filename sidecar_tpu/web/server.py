@@ -1,18 +1,19 @@
 """Threading HTTP server mounting the Sidecar API, UI static files, and
-the /watch long-poll (reference: sidecarhttp/http.go:56-84)."""
+the /watch versioned snapshot+delta stream (reference:
+sidecarhttp/http.go:56-84; stream protocol: docs/query.md)."""
 
 from __future__ import annotations
 
+import json
 import logging
 import mimetypes
 import pathlib
-import queue
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from sidecar_tpu.web.api import HttpListener, SidecarApi
+from sidecar_tpu.web.api import SidecarApi
 
 log = logging.getLogger(__name__)
 
@@ -52,43 +53,63 @@ def make_handler(api: SidecarApi, ui_dir: Optional[str],
                 "application/octet-stream"
             self._send(200, ctype, target.read_bytes())
 
-        def _watch(self, by_service: bool) -> None:
-            """Long-poll stream: a fresh snapshot on every ChangeEvent
-            (http_api.go:56-131)."""
-            listener = HttpListener()
-            api.state.add_listener(listener)
+        def _watch(self, by_service: bool,
+                   since: Optional[int] = None) -> None:
+            """Versioned delta stream over the query hub
+            (docs/query.md): a snapshot document establishes the
+            client's version cursor, then one delta document per
+            contiguous burst of changes; a client that passes
+            ``?since=V`` at the current version skips the snapshot.  A
+            subscriber that falls behind gets a fresh snapshot document
+            (the hub's coalesce-to-snapshot rule) — version sequences
+            are gap-free by construction."""
+            sub = api.state.query_hub().subscribe(
+                f"watch-{id(self)}-{threading.get_ident()}", prime=False)
             try:
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
 
-                def push() -> None:
-                    payload = api.watch_snapshot(by_service)
+                def push(doc: dict) -> None:
+                    payload = json.dumps(doc).encode()
                     self.wfile.write(b"%x\r\n%s\r\n"
                                      % (len(payload), payload))
                     self.wfile.flush()
 
-                push()
+                current = api.state.query_hub().current()
+                if since is None or since != current.version:
+                    push(api.watch_snapshot_doc(by_service, current))
+                cursor = current.version
                 while True:
-                    try:
-                        listener.chan().get(timeout=30.0)
-                    except queue.Empty:
+                    ev = sub.get(timeout=30.0)
+                    if ev is None:
                         continue  # keep the connection; no change yet
-                    # Coalesce bursts before pushing.
-                    while True:
-                        try:
-                            listener.chan().get_nowait()
-                        except queue.Empty:
-                            break
-                    push()
+                    events = [ev] + sub.drain()  # coalesce the burst
+                    # A resync marker supersedes the deltas BEFORE it —
+                    # but deltas published after the collapse can land
+                    # behind it in the same batch (get() clears the
+                    # marker, then the writer publishes into the freed
+                    # deque before drain()); dropping those would be a
+                    # permanent gap, so push the snapshot first and the
+                    # newer deltas after it.
+                    snaps = [e for e in events if e.kind == "snapshot"]
+                    if snaps:
+                        latest = snaps[-1].snapshot
+                        if latest.version > cursor:
+                            push(api.watch_snapshot_doc(by_service,
+                                                        latest))
+                            cursor = latest.version
+                    deltas = [e for e in events
+                              if e.kind == "delta" and
+                              e.version > cursor]
+                    if deltas:
+                        push(api.watch_delta_doc(deltas))
+                        cursor = deltas[-1].version
             except OSError:
                 pass  # client went away
             finally:
-                try:
-                    api.state.remove_listener(listener.name())
-                except KeyError:
-                    pass
+                sub.close()
 
         # -- methods -------------------------------------------------------
 
@@ -113,7 +134,7 @@ def make_handler(api: SidecarApi, ui_dir: Optional[str],
             result = api.dispatch("GET", path, query,
                                   client=self.client_address[0])
             if isinstance(result, tuple) and result and result[0] == "watch":
-                self._watch(result[1])
+                self._watch(result[1], result[2])
                 return
             status, ctype, body, extra = result
             self._send(status, ctype, body, extra)

@@ -318,6 +318,59 @@ class TestAdsStream:
         pushed = mock.recv()
         assert pushed.version_info != resp.version_info
 
+    @pytest.mark.skipif(__import__("shutil").which("protoc") is None,
+                        reason="no protoc in this image; the protoc-"
+                               "free twin in TestStreamLogicWithout"
+                               "Protoc covers the logic")
+    def test_nack_regression_no_advance_and_repush_on_next_snapshot(
+            self, ads):
+        """The NACK path, end to end (the regression the query-plane
+        rewire must preserve): the client ACKs v1, the catalog moves,
+        the push arrives at v2, the client NACKs it (echoed nonce +
+        error_detail).  The server must NOT advance the acked version —
+        no re-push of the rejected v2 — and MUST re-push when the next
+        snapshot exists."""
+        state, server, mock = ads
+        mock.send(TYPE_CLUSTER)
+        first = mock.recv()
+        mock.send(TYPE_CLUSTER, version=first.version_info,
+                  nonce=first.nonce)  # ACK v1
+
+        state.set_clock(lambda: T0 + NS)
+        state.add_service_entry(S.Service(
+            id="nnn111", name="nacked", image="n:1", hostname="h3",
+            updated=T0 + NS, status=S.ALIVE, proxy_mode="http",
+            ports=[S.Port("tcp", 31010, 9500, "10.0.0.3")]))
+        pushed = mock.recv()
+        assert pushed.version_info != first.version_info
+
+        # NACK the pushed version: client stays on first.version_info.
+        mock.send(TYPE_CLUSTER, version=first.version_info,
+                  nonce=pushed.nonce, error="rejected config")
+        got = []
+
+        def try_recv():
+            try:
+                got.append(mock.recv())
+            except Exception:
+                pass
+
+        t = threading.Thread(target=try_recv, daemon=True)
+        t.start()
+        t.join(timeout=2.5)
+        assert not got, "server re-pushed the NACKed version"
+
+        # Next snapshot → re-push at the NEW version.
+        state.set_clock(lambda: T0 + 2 * NS)
+        state.add_service_entry(S.Service(
+            id="nnn222", name="fixed2", image="f:2", hostname="h3",
+            updated=T0 + 2 * NS, status=S.ALIVE, proxy_mode="tcp",
+            ports=[S.Port("tcp", 31011, 9501, "10.0.0.3")]))
+        t.join(timeout=10)
+        assert got, "no re-push after the next snapshot"
+        assert got[0].version_info not in (pushed.version_info,
+                                           first.version_info)
+
     def test_stale_nonce_with_changed_names_is_served(self, ads):
         """A stale-nonce request's ACK/NACK meaning is void, but a
         changed resource_names set is the client's CURRENT subscription
@@ -334,6 +387,185 @@ class TestAdsStream:
         names = {x.ClusterLoadAssignment.FromString(r.value).cluster_name
                  for r in rescoped.resources}
         assert names == {"web:8080", "raw-tcp:9000"}
+
+
+class StubXds:
+    """protoc-free stand-in for proxy/xds_proto: plain-Python response
+    objects and identity resource wrappers, so the SotW stream logic
+    (the pure-Python generator) is testable in images without protoc —
+    where the full-stack TestAdsStream errors at fixture setup."""
+
+    class _DiscoveryResponse:
+        def __init__(self, version_info="", type_url="", nonce=""):
+            self.version_info = version_info
+            self.type_url = type_url
+            self.nonce = nonce
+            self.resources = []
+
+    class _PB:
+        pass
+
+    def __init__(self):
+        self._PB.DiscoveryResponse = self._DiscoveryResponse
+        self._pb = self._PB()
+
+    def pb(self):
+        return self._pb
+
+    @staticmethod
+    def cluster_to_any(c):
+        return ("cluster", c["name"])
+
+    @staticmethod
+    def endpoint_to_any(e):
+        return ("endpoint", e["cluster_name"])
+
+    @staticmethod
+    def listener_to_any(li):
+        return ("listener", li["name"])
+
+
+class StubRequest:
+    def __init__(self, type_url, version="", nonce="", names=(),
+                 error=None):
+        self.type_url = type_url
+        self.version_info = version
+        self.response_nonce = nonce
+        self.resource_names = list(names)
+        self._error = error
+
+        class _Detail:
+            message = error or ""
+        self.error_detail = _Detail()
+
+    def HasField(self, name):  # noqa: N802 — protobuf API shape
+        return name == "error_detail" and self._error is not None
+
+
+class TestStreamLogicWithoutProtoc:
+    """Drives AdsServer.stream_aggregated_resources directly (no gRPC,
+    no protoc): the hub-driven snapshot versioning and the NACK
+    bookkeeping, runnable in every image."""
+
+    def setup_stream(self, monkeypatch):
+        from sidecar_tpu.proxy import ads as ads_mod
+
+        monkeypatch.setattr(ads_mod, "xds_proto", StubXds())
+        state = make_state()
+        server = AdsServer(state, bind_ip="192.168.168.168")
+        server.refresh()
+
+        import queue as queue_mod
+        inbox: "queue_mod.Queue" = queue_mod.Queue()
+
+        def request_iter():
+            while True:
+                req = inbox.get()
+                if req is None:
+                    return
+                yield req
+
+        gen = server.stream_aggregated_resources(request_iter(), None)
+        responses: "queue_mod.Queue" = queue_mod.Queue()
+
+        def pump():
+            try:
+                for resp in gen:
+                    responses.put(resp)
+            except Exception as exc:  # pragma: no cover — surface it
+                responses.put(exc)
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        return state, server, inbox, responses
+
+    def teardown_stream(self, server, inbox):
+        server._stop.set()
+        inbox.put(None)
+
+    def test_snapshot_versions_are_hub_versions(self, monkeypatch):
+        state, server, inbox, responses = self.setup_stream(monkeypatch)
+        try:
+            # Hub attach snapshot is v1; the wire version matches.
+            assert server.snapshot().version == \
+                str(state.query_hub().current().version)
+            inbox.put(StubRequest(TYPE_CLUSTER))
+            resp = responses.get(timeout=5)
+            assert resp.version_info == server.snapshot().version
+            assert {r[1] for r in resp.resources} == {"web:8080",
+                                                      "raw-tcp:9000"}
+        finally:
+            self.teardown_stream(server, inbox)
+
+    def test_nack_no_advance_then_repush_on_next_snapshot(
+            self, monkeypatch):
+        """Satellite regression: NACK (echoed nonce + error_detail)
+        must not advance the acked version — no re-push of the
+        rejected snapshot — and the NEXT snapshot must be pushed."""
+        import queue as queue_mod
+
+        state, server, inbox, responses = self.setup_stream(monkeypatch)
+        try:
+            inbox.put(StubRequest(TYPE_CLUSTER))
+            first = responses.get(timeout=5)
+            inbox.put(StubRequest(TYPE_CLUSTER,
+                                  version=first.version_info,
+                                  nonce=first.nonce))  # ACK
+
+            state.set_clock(lambda: T0 + NS)
+            state.add_service_entry(S.Service(
+                id="u1", name="upd", image="u:1", hostname="h3",
+                updated=T0 + NS, status=S.ALIVE, proxy_mode="http",
+                ports=[S.Port("tcp", 31020, 9600, "10.0.0.3")]))
+            server.refresh()  # (the serve()-time delta loop's job)
+            pushed = responses.get(timeout=5)
+            assert pushed.version_info != first.version_info
+
+            inbox.put(StubRequest(TYPE_CLUSTER,
+                                  version=first.version_info,
+                                  nonce=pushed.nonce, error="bad"))
+            with pytest.raises(queue_mod.Empty):
+                responses.get(timeout=1.0)  # no re-push of rejected v
+
+            state.set_clock(lambda: T0 + 2 * NS)
+            state.add_service_entry(S.Service(
+                id="u2", name="upd2", image="u:2", hostname="h3",
+                updated=T0 + 2 * NS, status=S.ALIVE, proxy_mode="tcp",
+                ports=[S.Port("tcp", 31021, 9601, "10.0.0.3")]))
+            server.refresh()
+            repushed = responses.get(timeout=5)
+            assert repushed.version_info not in (first.version_info,
+                                                pushed.version_info)
+            # Monotonic hub versions on the wire.
+            assert int(repushed.version_info) > int(pushed.version_info)
+        finally:
+            self.teardown_stream(server, inbox)
+
+    def test_push_on_delta_without_poll(self, monkeypatch):
+        """The 1 s LastChanged poll is gone: a catalog change published
+        through the hub reaches the stream via the delta loop, and the
+        refresh is a no-op when the hub hasn't moved."""
+        state, server, inbox, responses = self.setup_stream(monkeypatch)
+        try:
+            assert not hasattr(server, "_poll_loop")
+            assert server.refresh() is False  # hub unchanged → no-op
+            inbox.put(StubRequest(TYPE_ENDPOINT))
+            responses.get(timeout=5)
+
+            # Run the real delta loop (what serve() starts) and prove a
+            # publish alone triggers the push — no polling involved.
+            t = threading.Thread(target=server._delta_loop, daemon=True)
+            t.start()
+            state.set_clock(lambda: T0 + NS)
+            state.add_service_entry(S.Service(
+                id="p1", name="pushme", image="p:1", hostname="h3",
+                updated=T0 + NS, status=S.ALIVE, proxy_mode="http",
+                ports=[S.Port("tcp", 31030, 9700, "10.0.0.3")]))
+            pushed = responses.get(timeout=5)
+            assert pushed.type_url == TYPE_ENDPOINT
+            assert any("pushme" in r[1] for r in pushed.resources)
+        finally:
+            self.teardown_stream(server, inbox)
 
 
 def test_port_conflict_raises_not_shared():

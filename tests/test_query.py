@@ -1,0 +1,557 @@
+"""Query-plane tests: versioned COW snapshots, the subscription hub's
+backpressure/coalescing contract, and the acceptance concurrency run —
+N parallel watchers must see identical, gap-free version sequences
+while a config6-style chaos schedule mutates the catalog."""
+
+import json
+import threading
+
+import pytest
+
+from sidecar_tpu import metrics
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+
+def make_state(services=3):
+    state = ServicesState(hostname="h1", cluster_name="query-test")
+    state.set_clock(lambda: T0)
+    for i in range(services):
+        state.add_service_entry(S.Service(
+            id=f"svc{i}", name=f"app{i % 2}", image="i:1", hostname="h1",
+            updated=T0, status=S.ALIVE,
+            ports=[S.Port("tcp", 32768 + i, 8080, "10.0.0.1")]))
+    return state
+
+
+class TestSnapshot:
+    def test_attach_builds_version_one(self):
+        state = make_state()
+        snap = state.query_hub().current()
+        assert snap.version == 1
+        assert set(snap.servers["h1"].services) == {"svc0", "svc1",
+                                                    "svc2"}
+
+    def test_versions_are_dense_and_monotonic(self):
+        state = make_state()
+        hub = state.query_hub()
+        versions = [hub.current().version]
+        for i in range(5):
+            state.add_service_entry(S.Service(
+                id=f"new{i}", name="app0", image="i:1", hostname="h1",
+                updated=T0 + (i + 1) * NS, status=S.ALIVE))
+            versions.append(hub.current().version)
+        assert versions == list(range(1, 7))
+
+    def test_snapshots_are_immutable_and_share_structure(self):
+        state = make_state()
+        hub = state.query_hub()
+        state.add_service_entry(S.Service(
+            id="zzz", name="other", image="i:1", hostname="h2",
+            updated=T0 + NS, status=S.ALIVE))
+        before = hub.current()
+        h1_view = before.servers["h1"]
+        state.add_service_entry(S.Service(
+            id="yyy", name="other", image="i:1", hostname="h2",
+            updated=T0 + 2 * NS, status=S.ALIVE))
+        after = hub.current()
+        # The untouched host's view is the SAME object (copy-on-write
+        # structural sharing); the old snapshot still shows the old h2.
+        assert after.servers["h1"] is h1_view
+        assert set(before.servers["h2"].services) == {"zzz"}
+        assert set(after.servers["h2"].services) == {"zzz", "yyy"}
+
+    def test_serialization_cached_per_version(self):
+        state = make_state()
+        snap = state.query_hub().current()
+        assert snap.to_json() is snap.to_json()
+        assert snap.encode() is snap.encode()
+        assert snap.by_service() is snap.by_service()
+
+    def test_by_service_matches_state(self):
+        state = make_state()
+        snap = state.query_hub().current()
+        want = {name: [svc.to_json() for svc in instances]
+                for name, instances in state.by_service().items()}
+        assert snap.by_service_json() == want
+
+    def test_state_json_parity_plus_version(self):
+        state = make_state()
+        snap = state.query_hub().current()
+        with state._lock:
+            want = state.to_json()
+        got = dict(snap.to_json())
+        assert got.pop("Version") == 1
+        assert got == want
+
+    def test_reader_never_takes_state_lock(self):
+        """The point of the plane: with the writer wedged on its lock,
+        every snapshot read still completes."""
+        state = make_state()
+        hub = state.query_hub()
+        release = threading.Event()
+        grabbed = threading.Event()
+
+        def hold_lock():
+            with state._lock:
+                grabbed.set()
+                release.wait(timeout=5)
+
+        t = threading.Thread(target=hold_lock, daemon=True)
+        t.start()
+        assert grabbed.wait(timeout=5)
+        try:
+            snap = hub.current()          # must not block
+            assert snap.version >= 1
+            assert snap.encode()
+            assert snap.by_service() is not None
+        finally:
+            release.set()
+            t.join(timeout=5)
+
+
+class TestHub:
+    def test_prime_then_gap_free_deltas(self):
+        state = make_state()
+        hub = state.query_hub()
+        sub = hub.subscribe("t", buffer=64)
+        first = sub.get(timeout=1)
+        assert first.kind == "snapshot" and first.version == 1
+        for i in range(4):
+            state.add_service_entry(S.Service(
+                id=f"d{i}", name="app0", image="i:1", hostname="h1",
+                updated=T0 + (i + 1) * NS, status=S.ALIVE))
+        versions = []
+        while True:
+            ev = sub.get(timeout=0.2)
+            if ev is None:
+                break
+            assert ev.kind == "delta"
+            assert ev.change.service.id == f"d{len(versions)}"
+            versions.append(ev.version)
+        assert versions == [2, 3, 4, 5]
+
+    def test_backpressure_coalesces_to_snapshot(self):
+        state = make_state()
+        hub = state.query_hub()
+        sub = hub.subscribe("slow", buffer=2, prime=False)
+        dropped0 = metrics.counter("query.hub.dropped")
+        coalesced0 = metrics.counter("query.hub.coalesced")
+        for i in range(7):
+            state.add_service_entry(S.Service(
+                id=f"b{i}", name="app0", image="i:1", hostname="h1",
+                updated=T0 + (i + 1) * NS, status=S.ALIVE))
+        events = []
+        while True:
+            ev = sub.get(timeout=0.2)
+            if ev is None:
+                break
+            events.append(ev)
+        # The overflow collapses EVERYTHING (the queued-but-unread
+        # deltas included) into one snapshot marker at the LATEST
+        # version — the snapshot subsumes them, and every discarded
+        # delta is counted.
+        assert [ev.kind for ev in events] == ["snapshot"]
+        assert events[-1].version == hub.current().version
+        assert "b6" in events[-1].snapshot.servers["h1"].services
+        assert metrics.counter("query.hub.dropped") - dropped0 == 7
+        assert metrics.counter("query.hub.coalesced") - coalesced0 == 1
+
+    def test_delta_flow_resumes_after_resync(self):
+        state = make_state()
+        hub = state.query_hub()
+        sub = hub.subscribe("slow", buffer=1, prime=False)
+        for i in range(3):
+            state.add_service_entry(S.Service(
+                id=f"c{i}", name="app0", image="i:1", hostname="h1",
+                updated=T0 + (i + 1) * NS, status=S.ALIVE))
+        ev = sub.get(timeout=1)
+        assert ev.kind == "snapshot"
+        resync_version = ev.version
+        state.add_service_entry(S.Service(
+            id="afterwards", name="app0", image="i:1", hostname="h1",
+            updated=T0 + 10 * NS, status=S.ALIVE))
+        ev = sub.get(timeout=1)
+        assert ev.kind == "delta"
+        assert ev.version == resync_version + 1
+
+    def test_close_wakes_blocked_get_and_deregisters(self):
+        state = make_state()
+        hub = state.query_hub()
+        sub = hub.subscribe("t", buffer=4)
+        sub.get(timeout=1)  # the priming snapshot
+        got = []
+
+        def block():
+            got.append(sub.get(timeout=5))
+
+        t = threading.Thread(target=block, daemon=True)
+        t.start()
+        sub.close()
+        t.join(timeout=5)
+        assert got == [None]
+        assert hub.subscriber_count() == 0
+
+    def test_publish_never_blocks_writer(self):
+        """A completely stuck subscriber must not slow the writer path:
+        publishing 100 events with a dead 1-slot subscriber stays
+        instant (bounded queue + collapse, no waiting)."""
+        state = make_state()
+        hub = state.query_hub()
+        hub.subscribe("dead", buffer=1, prime=False)
+        for i in range(100):
+            state.add_service_entry(S.Service(
+                id=f"w{i}", name="app0", image="i:1", hostname="h1",
+                updated=T0 + (i + 1) * NS, status=S.ALIVE))
+        assert hub.current().version == 101
+
+
+class TestConcurrencyUnderChaos:
+    """The acceptance run: N parallel watchers, a config6-style chaos
+    churn schedule mutating the catalog, every watcher sees the
+    identical gap-free version sequence and converges on the same
+    final snapshot."""
+
+    N_WATCHERS = 8
+    ROUNDS = 40       # churn window (config6 uses rounds 30-60)
+    SIDE_A = 4        # churned hosts (config6 churns one side only)
+
+    def test_parallel_watchers_gap_free(self):
+        from sidecar_tpu.chaos.plan import FaultPlan, coin
+
+        plan = FaultPlan(seed=6)  # the config6 seed
+        state = ServicesState(hostname="n0", cluster_name="chaos")
+        state.set_clock(lambda: T0)
+        hosts = [f"n{i}" for i in range(8)]
+        for hi, host in enumerate(hosts):
+            for si in range(4):
+                state.add_service_entry(S.Service(
+                    id=f"{host}-s{si}", name=f"svc{si}", image="i:1",
+                    hostname=host, updated=T0, status=S.ALIVE))
+        hub = state.query_hub()
+        start_version = hub.current().version
+
+        stop = threading.Event()
+        results = [None] * self.N_WATCHERS
+        errors = []
+
+        def watcher(idx):
+            # Large buffer: this test pins the GAP-FREE delta contract;
+            # the coalesce path has its own tests above.
+            sub = hub.subscribe(f"w{idx}", buffer=8192, prime=True)
+            try:
+                first = sub.get(timeout=5)
+                if first is None or first.kind != "snapshot":
+                    errors.append(f"w{idx}: bad prime {first}")
+                    return
+                versions = []
+                changes = []
+                while True:
+                    ev = sub.get(timeout=0.5)
+                    if ev is None:
+                        if stop.is_set():
+                            break
+                        continue
+                    if ev.kind != "delta":
+                        errors.append(f"w{idx}: unexpected coalesce")
+                        return
+                    versions.append(ev.version)
+                    changes.append((ev.change.service.id,
+                                    ev.change.service.status,
+                                    ev.change.service.updated))
+                results[idx] = (first.version, versions, changes,
+                                sub.pending())
+            finally:
+                sub.close()
+
+        threads = [threading.Thread(target=watcher, args=(i,),
+                                    daemon=True)
+                   for i in range(self.N_WATCHERS)]
+        for t in threads:
+            t.start()
+
+        # The chaos writer: config6's one-sided Bernoulli churn recast
+        # onto the live catalog — every flip decision is the plan's
+        # deterministic coin, so the schedule replays from the seed.
+        now = T0
+        for rnd in range(self.ROUNDS):
+            now += NS // 5  # one 200 ms gossip round
+            for hi in range(self.SIDE_A):
+                for si in range(4):
+                    if coin(plan.seed, "churn", rnd, hi, si) < 0.1:
+                        host = hosts[hi]
+                        sid = f"{host}-s{si}"
+                        cur = state.servers[host].services[sid]
+                        new_status = (S.TOMBSTONE
+                                      if cur.status == S.ALIVE
+                                      else S.ALIVE)
+                        state.add_service_entry(S.Service(
+                            id=sid, name=f"svc{si}", image="i:1",
+                            hostname=host, updated=now,
+                            status=new_status))
+        final_version = hub.current().version
+        n_changes = final_version - start_version
+        assert n_changes > 20, "chaos schedule produced too few changes"
+
+        # Let every watcher drain, then stop them.
+        deadline = threading.Event()
+        deadline.wait(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors, errors
+        assert all(r is not None for r in results)
+
+        expect_versions = list(range(start_version + 1,
+                                     final_version + 1))
+        first = results[0]
+        for idx, (prime_v, versions, changes, pending) in \
+                enumerate(results):
+            assert pending == 0, f"w{idx} did not drain"
+            assert prime_v == start_version
+            # Gap-free: exactly the dense version range.
+            assert versions == expect_versions, \
+                f"w{idx} saw gaps: {len(versions)} vs {n_changes}"
+            # Identical: byte-for-byte the same change sequence.
+            assert changes == first[2], f"w{idx} diverged"
+
+        # And the final snapshot equals the live catalog.
+        snap = hub.current()
+        with state._lock:
+            for host, server in state.servers.items():
+                got = snap.servers[host].services
+                assert set(got) == set(server.services)
+                for sid, svc in server.services.items():
+                    assert got[sid].status == svc.status
+                    assert got[sid].updated == svc.updated
+
+
+@pytest.mark.slow
+class TestConcurrencySoak:
+    """Soak variant (slow marker): watchers with TINY buffers and
+    random stalls, so the coalesce path fires constantly — every
+    watcher must still reconstruct the exact final catalog from its
+    mix of snapshots and deltas, with versions non-decreasing and
+    delta runs contiguous after each resync."""
+
+    def test_slow_watchers_converge_via_resync(self):
+        import random
+
+        state = ServicesState(hostname="n0", cluster_name="soak")
+        state.set_clock(lambda: T0)
+        hosts = [f"n{i}" for i in range(6)]
+        for host in hosts:
+            for si in range(3):
+                state.add_service_entry(S.Service(
+                    id=f"{host}-s{si}", name=f"svc{si}", image="i:1",
+                    hostname=host, updated=T0, status=S.ALIVE))
+        hub = state.query_hub()
+        stop = threading.Event()
+        errors = []
+        views = [None] * 6
+
+        def watcher(idx):
+            rng = random.Random(idx)
+            sub = hub.subscribe(f"soak{idx}", buffer=4, prime=True)
+            view = {}
+            last_version = 0
+            expect_next = None  # None = just resynced, any version ok
+            try:
+                while True:
+                    ev = sub.get(timeout=0.5)
+                    if ev is None:
+                        if stop.is_set() and sub.pending() == 0:
+                            break
+                        continue
+                    if ev.version < last_version:
+                        errors.append(f"w{idx}: version regressed")
+                        return
+                    if ev.kind == "snapshot":
+                        view = {
+                            (h, sid): (svc.updated, svc.status)
+                            for h, srv in ev.snapshot.servers.items()
+                            for sid, svc in srv.services.items()}
+                        expect_next = ev.version + 1
+                    else:
+                        if expect_next is not None and \
+                                ev.version != expect_next:
+                            errors.append(
+                                f"w{idx}: delta gap {expect_next} -> "
+                                f"{ev.version} without resync")
+                            return
+                        expect_next = ev.version + 1
+                        svc = ev.change.service
+                        view[(svc.hostname, svc.id)] = (svc.updated,
+                                                        svc.status)
+                    last_version = ev.version
+                    if rng.random() < 0.05:
+                        stall = threading.Event()
+                        stall.wait(rng.random() * 0.02)  # fall behind
+                views[idx] = (view, last_version)
+            finally:
+                sub.close()
+
+        threads = [threading.Thread(target=watcher, args=(i,),
+                                    daemon=True) for i in range(6)]
+        for t in threads:
+            t.start()
+
+        rng = random.Random(99)
+        now = T0
+        for _ in range(600):
+            now += NS // 50
+            host = hosts[rng.randrange(len(hosts))]
+            si = rng.randrange(3)
+            sid = f"{host}-s{si}"
+            cur = state.servers[host].services[sid]
+            state.add_service_entry(S.Service(
+                id=sid, name=f"svc{si}", image="i:1", hostname=host,
+                updated=now,
+                status=S.TOMBSTONE if cur.status == S.ALIVE
+                else S.ALIVE))
+        final = hub.current()
+        grace = threading.Event()
+        grace.wait(1.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        assert not errors, errors
+
+        want = {(h, sid): (svc.updated, svc.status)
+                for h, srv in final.servers.items()
+                for sid, svc in srv.services.items()}
+        for idx, result in enumerate(views):
+            assert result is not None, f"w{idx} died"
+            view, last_version = result
+            assert last_version == final.version, \
+                f"w{idx} stopped at v{last_version} != v{final.version}"
+            assert view == want, f"w{idx} diverged from final catalog"
+
+
+class TestWatchHttpEndToEnd:
+    """/watch over a real server: versioned snapshot + delta framing,
+    contiguous version ranges, and the ?since cursor."""
+
+    @pytest.fixture
+    def server(self):
+        from sidecar_tpu.web import SidecarApi, serve_http
+
+        state = make_state()
+        api = SidecarApi(state, cluster_name="query-test")
+        srv = serve_http(api, bind="127.0.0.1", port=0)
+        yield state, srv
+        srv.shutdown()
+
+    def read_docs(self, resp, want, timeout=5.0):
+        """Read chunked /watch docs until ``want`` documents arrived."""
+        import time as time_mod
+        docs, buf = [], b""
+        deadline = time_mod.monotonic() + timeout
+        while len(docs) < want and time_mod.monotonic() < deadline:
+            data = resp.read1(65536)
+            if not data:
+                break
+            buf += data
+            while True:
+                brace = buf.find(b"{")
+                if brace < 0:
+                    break
+                depth = 0
+                end = -1
+                for i in range(brace, len(buf)):
+                    if buf[i:i + 1] == b"{":
+                        depth += 1
+                    elif buf[i:i + 1] == b"}":
+                        depth -= 1
+                        if depth == 0:
+                            end = i + 1
+                            break
+                if end < 0:
+                    break
+                docs.append(json.loads(buf[brace:end]))
+                buf = buf[end:]
+        return docs
+
+    def test_watch_versioned_stream(self, server):
+        import urllib.request
+
+        state, srv = server
+        port = srv.server_address[1]
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/watch", timeout=10)
+        docs = self.read_docs(resp, want=1)
+        assert docs and "Snapshot" in docs[0]
+        v0 = docs[0]["Version"]
+        assert "app0" in docs[0]["Snapshot"]
+
+        state.add_service_entry(S.Service(
+            id="fresh", name="app9", image="i:1", hostname="h1",
+            updated=T0 + NS, status=S.ALIVE))
+        docs = self.read_docs(resp, want=1)
+        assert docs, "no delta pushed"
+        doc = docs[0]
+        assert doc["From"] == v0 + 1
+        assert doc["Version"] >= doc["From"]
+        assert doc["Deltas"][0]["Service"]["ID"] == "fresh"
+        resp.close()
+
+    def test_watch_since_cursor_skips_snapshot(self, server):
+        import urllib.request
+
+        state, srv = server
+        port = srv.server_address[1]
+        current = state.query_hub().current().version
+        resp = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/watch?since={current}", timeout=10)
+        # Let the handler subscribe and evaluate the cursor before the
+        # catalog moves — a change that lands first makes the cursor
+        # stale, and a stale cursor correctly gets a snapshot instead.
+        deadline = threading.Event()
+        deadline.wait(0.3)
+        state.add_service_entry(S.Service(
+            id="only-delta", name="app9", image="i:1", hostname="h1",
+            updated=T0 + NS, status=S.ALIVE))
+        docs = self.read_docs(resp, want=1)
+        assert docs
+        # No snapshot document: the cursor was current, so the first
+        # document is already the delta.
+        assert "Deltas" in docs[0]
+        assert docs[0]["From"] == current + 1
+        resp.close()
+
+    def test_watch_bad_since_400(self, server):
+        import urllib.error
+        import urllib.request
+
+        state, srv = server
+        port = srv.server_address[1]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/watch?since=banana",
+                timeout=10)
+        assert exc.value.code == 400
+
+
+class TestHttpListenerDropOldest:
+    def test_drop_oldest_counts_and_keeps_newest(self):
+        from sidecar_tpu.catalog.state import ChangeEvent
+        from sidecar_tpu.web.api import HttpListener
+
+        listener = HttpListener()
+        dropped0 = metrics.counter("web.watch.dropped")
+        events = [ChangeEvent(
+            service=S.Service(id=f"e{i}", name="w", hostname="h1",
+                              updated=T0 + i, status=S.ALIVE),
+            previous_status=S.UNKNOWN, time=T0 + i)
+            for i in range(55)]
+        for ev in events:
+            listener.chan().put_nowait(ev)
+        assert metrics.counter("web.watch.dropped") - dropped0 == 5
+        held = []
+        while not listener.chan().empty():
+            held.append(listener.chan().get_nowait().service.id)
+        # The OLDEST five were evicted; the newest 50 survive in order.
+        assert held == [f"e{i}" for i in range(5, 55)]

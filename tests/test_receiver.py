@@ -100,9 +100,45 @@ class TestWithRetries:
         err = with_retries(2, always)
         assert isinstance(err, OSError)
 
+    def test_linear_backoff_schedule(self):
+        """The documented linear backoff, pinned against a fake clock:
+        the FIRST retry must already back off (the old schedule slept
+        0.1 * 0 = 0 s before it, hammering the failed endpoint
+        immediately), and each later retry backs off one unit more.
+        No sleep after the final failure."""
+        slept = []
+
+        def always():
+            raise OSError("nope")
+
+        err = with_retries(4, always, sleep=slept.append)
+        assert isinstance(err, OSError)
+        assert slept == pytest.approx([0.1, 0.2, 0.3, 0.4])
+
+    def test_no_sleep_on_first_try_success(self):
+        slept = []
+        assert with_retries(3, lambda: None, sleep=slept.append) is None
+        assert slept == []
+
+    def test_backoff_stops_at_success(self):
+        slept = []
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("nope")
+
+        assert with_retries(5, flaky, sleep=slept.append) is None
+        # Two failures → two backoffs (before retries 1 and 2), then
+        # the third attempt succeeds with no further sleeping.
+        assert slept == pytest.approx([0.1, 0.2])
+
 
 class TestUrlListener:
-    def test_posts_state_changed_event(self):
+    def test_posts_delta_event(self):
+        """UrlListener is hub-driven (docs/query.md): one versioned
+        delta document per change, no full-state dump."""
         server = CapturingServer()
         try:
             state = make_state()
@@ -112,9 +148,9 @@ class TestUrlListener:
                 state.servers["h1"].services["aaa"], S.UNKNOWN, T0)
             path, headers, body = server.posts.get(timeout=5)
             doc = json.loads(body)
-            assert "State" in doc and "ChangeEvent" in doc
+            assert set(doc) == {"Version", "ChangeEvent"}
+            assert doc["Version"] == state.query_hub().current().version
             assert doc["ChangeEvent"]["Service"]["ID"] == "aaa"
-            assert doc["State"]["Hostname"] == "h1"
             assert "sidecar-session-host=" in headers.get("Cookie", "")
             listener.stop()
         finally:
@@ -135,13 +171,68 @@ class TestUrlListener:
         finally:
             server.shutdown()
 
-    def test_wire_shape(self):
+    def test_coalesces_to_resync_when_behind(self):
+        """A stalled subscriber's backlog collapses to ONE full-state
+        resync document at the latest version (the hub's backpressure
+        rule) instead of a POST per missed event."""
+        server = CapturingServer()
+        try:
+            state = make_state()
+            listener = UrlListener(server.url)
+            sub = state.query_hub().subscribe(listener.name(), buffer=2,
+                                              prime=False)
+            listener._sub = sub  # tiny buffer: overflow after 2 events
+            # Burst past the buffer BEFORE any drain thread runs.
+            for i in range(6):
+                state.add_service_entry(S.Service(
+                    id=f"b{i}", name="web", image="i:1", hostname="h1",
+                    updated=T0 + (i + 1) * NS, status=S.ALIVE))
+            events = []
+            while True:
+                ev = sub.get(timeout=0.2)
+                if ev is None:
+                    break
+                events.append(ev)
+            kinds = [ev.kind for ev in events]
+            assert "snapshot" in kinds  # the collapse marker
+            # The resync document is the full state at latest version.
+            from sidecar_tpu.catalog.url_listener import resync_event_json
+            snap_ev = [ev for ev in events if ev.kind == "snapshot"][-1]
+            doc = json.loads(resync_event_json(snap_ev.snapshot))
+            assert set(doc) == {"Version", "State"}
+            assert doc["Version"] == snap_ev.version
+            assert "b5" in doc["State"]["Servers"]["h1"]["Services"]
+        finally:
+            server.shutdown()
+
+    def test_managed_lifecycle_registry(self):
+        """Hub-driven listeners still register in the state's listener
+        registry so track_local_listeners add/remove keeps working."""
         state = make_state()
+        listener = UrlListener("http://127.0.0.1:1/x", managed=True)
+        listener.watch(state)
+        assert any(li.name() == listener.name()
+                   for li in state.get_listeners())
+        listener.stop()
+        state.remove_listener(listener.name())
+        assert not any(li.name() == listener.name()
+                       for li in state.get_listeners())
+
+    def test_wire_shapes(self):
+        # Legacy full StateChangedEvent (kept for old consumers) —
+        # served from the hub snapshot, no state lock.
+        state = make_state()
+        state.query_hub()
         data = state_changed_event_json(state, make_event())
         doc = json.loads(data)
         assert set(doc) == {"State", "ChangeEvent"}
         assert set(doc["ChangeEvent"]) == {"Service", "PreviousStatus",
                                            "Time"}
+        # Delta shape.
+        from sidecar_tpu.catalog.url_listener import delta_event_json
+        doc = json.loads(delta_event_json(7, make_event()))
+        assert set(doc) == {"Version", "ChangeEvent"}
+        assert doc["Version"] == 7
 
 
 class TestShouldNotify:
@@ -238,6 +329,112 @@ class TestReceiver:
             assert len(seen) == 1
         finally:
             srv.shutdown()
+
+
+class TestReceiverDeltaPath:
+    """The query-plane wire (docs/query.md): versioned deltas merge into
+    the local mirror; resync documents replace it."""
+
+    def delta(self, version, **kw):
+        return json.dumps({"Version": version,
+                           "ChangeEvent": make_event(**kw).to_json()}
+                          ).encode()
+
+    def test_applies_delta(self):
+        rcvr = Receiver(on_update=lambda s: None)
+        status, _ = update_handler(rcvr, self.delta(2, updated=T0 + NS))
+        assert status == 200
+        assert rcvr.last_version == 2
+        svc = rcvr.current_state.servers["h1"].services["aaa"]
+        assert svc.name == "web" and svc.updated == T0 + NS
+        assert rcvr.reload_chan.qsize() == 1
+
+    def test_duplicate_replay_is_idempotent_no_reload(self):
+        """The version cursor never gates: replays flow through the
+        record-level LWW, which makes them no-ops — and a no-op must
+        not enqueue a reload."""
+        rcvr = Receiver(on_update=lambda s: None)
+        update_handler(rcvr, self.delta(3, updated=T0 + NS))
+        assert rcvr.reload_chan.qsize() == 1
+        update_handler(rcvr, self.delta(3, updated=T0 + NS))  # replay
+        assert rcvr.last_version == 3
+        assert rcvr.reload_chan.qsize() == 1  # no duplicate reload
+        assert rcvr.current_state.servers["h1"].services["aaa"].updated \
+            == T0 + NS
+
+    def test_sender_restart_resets_version_epoch(self):
+        """A restarted sender's hub restarts at version 1; the receiver
+        must keep applying (record LWW decides), not wedge on its old
+        high-water cursor."""
+        rcvr = Receiver(on_update=lambda s: None)
+        update_handler(rcvr, self.delta(500, updated=T0 + NS))
+        assert rcvr.last_version == 500
+        # New epoch: version 2 but a genuinely newer record.
+        update_handler(rcvr, self.delta(2, updated=T0 + 5 * NS,
+                                        status=S.TOMBSTONE,
+                                        previous=S.ALIVE))
+        assert rcvr.current_state.servers["h1"].services["aaa"].status \
+            == S.TOMBSTONE
+        assert rcvr.reload_chan.qsize() == 2
+
+    def test_gap_is_safe_lww(self):
+        """A missed version is staleness, not corruption: each delta
+        carries the full record, so merging across a gap keeps the
+        mirror consistent."""
+        rcvr = Receiver(on_update=lambda s: None)
+        update_handler(rcvr, self.delta(2, updated=T0 + NS))
+        update_handler(rcvr, self.delta(9, updated=T0 + 5 * NS,
+                                        status=S.TOMBSTONE,
+                                        previous=S.ALIVE))
+        assert rcvr.last_version == 9
+        assert rcvr.current_state.servers["h1"].services["aaa"].status \
+            == S.TOMBSTONE
+
+    def test_older_record_does_not_regress_mirror(self):
+        rcvr = Receiver(on_update=lambda s: None)
+        update_handler(rcvr, self.delta(2, updated=T0 + 5 * NS))
+        update_handler(rcvr, self.delta(3, updated=T0 + NS))
+        assert rcvr.current_state.servers["h1"].services["aaa"].updated \
+            == T0 + 5 * NS
+
+    def test_resync_document_replaces_mirror(self):
+        seen = []
+        rcvr = Receiver(on_update=lambda s: seen.append(s))
+        update_handler(rcvr, self.delta(2, updated=T0 + NS))
+        state = make_state()
+        state.last_changed = T0 + 10 * NS
+        snap = state.query_hub().current()
+        from sidecar_tpu.catalog.url_listener import resync_event_json
+        status, _ = update_handler(rcvr, resync_event_json(snap))
+        assert status == 200
+        assert rcvr.current_state.last_changed == T0 + 10 * NS
+        assert rcvr.reload_chan.qsize() >= 1
+
+    def test_empty_document_rejected_not_empty_resync(self):
+        """A document with neither State nor ChangeEvent is malformed
+        untrusted input — 500, never an 'empty resync' that would wipe
+        the mirror and regenerate config from nothing."""
+        rcvr = Receiver(on_update=lambda s: None)
+        status, body = update_handler(rcvr, b"{}")
+        assert status == 500
+        assert rcvr.current_state is None
+        assert rcvr.reload_chan.qsize() == 0
+
+    def test_delta_without_version_rejected(self):
+        rcvr = Receiver(on_update=lambda s: None)
+        status, body = update_handler(
+            rcvr, json.dumps({"ChangeEvent":
+                              make_event().to_json()}).encode())
+        assert status == 500
+        assert json.loads(body)["errors"]
+
+    def test_insignificant_delta_not_enqueued(self):
+        rcvr = Receiver(on_update=lambda s: None)
+        status, _ = update_handler(rcvr, self.delta(
+            2, updated=T0 + NS, status=S.UNKNOWN, previous=S.UNHEALTHY))
+        assert status == 200
+        assert rcvr.reload_chan.qsize() == 0
+        assert rcvr.current_state is not None  # still recorded
 
 
 def test_update_handler_rejects_non_object_payloads():

@@ -26,7 +26,8 @@ APP = REPO / "ui" / "app" / "app.js"
 TEST = REPO / "ui" / "test" / "lib_test.js"
 
 EXPORTED = ["statusIndex", "timeAgo", "sanitizeName", "formatPorts",
-            "parseHaproxyCsv", "haproxyHasIn", "extractJsonDocs"]
+            "parseHaproxyCsv", "haproxyHasIn", "extractJsonDocs",
+            "applyWatchDoc"]
 
 
 class TestRunUnderNode:

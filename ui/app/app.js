@@ -205,9 +205,10 @@ async function pollLoop() {
   }
 }
 
-// /watch snapshots carry only the {service: [instances]} map; the
-// member list + cluster name come from the full envelope, refreshed on
-// a slow cadence.
+// /watch documents carry only the {service: [instances]} map (as a
+// versioned snapshot or delta patch — docs/query.md); the member list
+// + cluster name come from the full envelope, refreshed on a slow
+// cadence.
 let envelope = { Services: {} };
 
 async function refreshEnvelope() {
@@ -236,9 +237,12 @@ async function watchLoop() {
         const { docs, rest } = extractJsonDocs(buf);
         buf = rest;
         for (const doc of docs) {
-          envelope.Services = doc;
+          // Versioned watch documents (docs/query.md): snapshot docs
+          // replace the view, delta docs patch it.
+          envelope.Services = applyWatchDoc(envelope.Services, doc);
           render(envelope);
-          setStatus(`live · ${new Date().toLocaleTimeString()}`);
+          setStatus(`live v${doc.Version} · ` +
+                    new Date().toLocaleTimeString());
         }
       }
       throw new Error("stream ended");

@@ -103,10 +103,37 @@ function extractJsonDocs(buf) {
   return { docs, rest: buf.slice(consumed) };
 }
 
+// One /watch document (docs/query.md) applied to the by-service map:
+// snapshot documents replace it, delta documents patch it in place —
+// upsert each changed instance by ID within its service group.
+// Tombstoned instances are KEPT (rendered with their Tombstone chip),
+// exactly like snapshot documents show them — the same catalog must
+// render identically whether the client learned of it by snapshot or
+// by delta; rows disappear when catalog GC drops the record from the
+// next snapshot.  Returns the NEW map (never mutates the input) so
+// callers can keep rendering the old view on a bad doc.
+function applyWatchDoc(services, doc) {
+  if (!doc || typeof doc !== "object") return services;
+  if (doc.Snapshot !== undefined) return doc.Snapshot || {};
+  if (!Array.isArray(doc.Deltas)) return services;
+  const out = {};
+  for (const name of Object.keys(services || {})) {
+    out[name] = services[name].slice();
+  }
+  for (const change of doc.Deltas) {
+    const svc = change && change.Service;
+    if (!svc || !svc.Name || !svc.ID) continue;
+    const list = (out[svc.Name] || []).filter(s => s.ID !== svc.ID);
+    list.push(svc);
+    out[svc.Name] = list;
+  }
+  return out;
+}
+
 // node (the unit-test runner) sees a module; the browser just gets
 // globals on the shared script scope.
 if (typeof module !== "undefined" && module.exports) {
   module.exports = { STATUS, statusIndex, timeAgo, sanitizeName,
                      formatPorts, parseHaproxyCsv, haproxyHasIn,
-                     extractJsonDocs };
+                     extractJsonDocs, applyWatchDoc };
 }

@@ -103,6 +103,31 @@ eq(r.rest, " trailing", "non-brace tail kept");
 r = L.extractJsonDocs("");
 eq(r.docs, [], "empty input no docs");
 
+// -- applyWatchDoc (the versioned /watch protocol, docs/query.md) ------------
+const base = { web: [{ Name: "web", ID: "a1", Status: 0 }] };
+eq(L.applyWatchDoc(base, { Version: 3, Snapshot: { db: [] } }),
+   { db: [] }, "snapshot doc replaces the view");
+let patched = L.applyWatchDoc(base, {
+  From: 4, Version: 5, Deltas: [
+    { Service: { Name: "web", ID: "a2", Status: 0 } },
+    { Service: { Name: "db", ID: "d1", Status: 0 } }],
+});
+eq(patched.web.length, 2, "delta upserts new instance");
+eq(Object.keys(patched).sort(), ["db", "web"], "delta adds new service");
+patched = L.applyWatchDoc(patched, {
+  From: 6, Version: 6,
+  Deltas: [{ Service: { Name: "web", ID: "a1", Status: 1 } }],
+});
+// Tombstones stay visible (with their chip) — delta and snapshot views
+// of the same catalog must render identically.
+eq(patched.web.map(s => s.ID).sort(), ["a1", "a2"],
+   "tombstone kept, not removed");
+eq(patched.web.find(s => s.ID === "a1").Status, 1,
+   "tombstone status patched in");
+eq(L.applyWatchDoc(base, { Version: 9, Deltas: "bogus" }), base,
+   "malformed doc leaves the view untouched");
+eq(base.web.length, 1, "input map never mutated");
+
 // -- report ------------------------------------------------------------------
 const summary = failures.length
   ? `FAIL ${failures.length}/${checks}:\n  ${failures.join("\n  ")}`
