@@ -83,8 +83,11 @@ def _bench_dense(n, spn, rounds):
 
     # Warm-up: compile + one short run.  Sync via device_get — on remote
     # TPU platforms block_until_ready can return before execution ends.
-    warm = sim.run_fast(state, key, rounds)
-    jax.device_get(warm.known[0, :4])
+    # The drivers DONATE their input, so the timed run chains off the
+    # warm-up's output (same shapes ⇒ same executable; the donated
+    # in-place rewrite is exactly the steady-state the bench reports).
+    state = sim.run_fast(state, key, rounds)
+    jax.device_get(state.known[0, :4])
 
     t0 = time.perf_counter()
     final = sim.run_fast(state, key, rounds)
@@ -110,8 +113,9 @@ def _bench_compressed(n, spn, rounds):
     state = sim.init_state()
     key = jax.random.PRNGKey(0)
 
-    warm = sim.run_fast(state, key, rounds)
-    jax.device_get(warm.own[0, :4])
+    # Chain warm → timed (donating drivers; see _bench_dense).
+    state = sim.run_fast(state, key, rounds)
+    jax.device_get(state.own[0, :4])
     t0 = time.perf_counter()
     final = sim.run_fast(state, key, rounds)
     jax.device_get(final.own[0, :4])
@@ -190,16 +194,38 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
     # tunnel worker crashes on very long scan dispatches, and the clamp
     # must not depend on call sites keeping conv_every small.
     chunk = conv_every * max(1, 150 // conv_every)
-    warm, c = sim.run_behind(state, key, chunk, conv_every)
+    # Warm-up compiles without advancing the measured trajectory:
+    # donate=False copies the state so the run below starts from the
+    # same burst (the drivers donate their input by default).
+    warm, c = sim.run_behind(state, key, chunk, conv_every,
+                             donate=False)
     jax.device_get(c)
 
+    # Chunked-dispatch PIPELINE: chunk i+1 is enqueued (async, donated
+    # zero-copy carry) BEFORE chunk i's scalar curve is pulled back, so
+    # the device never idles through the tunnel RTT + host-side ε
+    # bookkeeping.  The horizon check rides the host-side round counter
+    # (start_round=) — reading the in-flight state's round_idx would
+    # block on the running chunk and re-serialize the pipeline.  On
+    # convergence one speculative chunk is discarded (its rounds are
+    # not counted in rounds_executed).
     t0 = time.perf_counter()
     executed, behind_last = 0, float("inf")
     hit_total, hit_unsettled = None, None
     wall_total, wall_unsettled = None, None
-    while executed < max_rounds:
-        state, behind = sim.run_behind(state, key, chunk, conv_every)
-        behind = np.asarray(jax.device_get(behind), dtype=np.float64)
+    pend_state, pend_behind = sim.run_behind(state, key, chunk,
+                                             conv_every, start_round=0)
+    dispatched = chunk
+    while True:
+        if dispatched < max_rounds:
+            pend_state, nxt_behind = sim.run_behind(
+                pend_state, key, chunk, conv_every,
+                start_round=dispatched)
+            dispatched += chunk
+        else:
+            nxt_behind = None
+        behind = np.asarray(jax.device_get(pend_behind),
+                            dtype=np.float64)
         for j, b in enumerate(behind):
             at = executed + (j + 1) * conv_every
             if hit_total is None and b <= thr_total:
@@ -215,8 +241,10 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
             wall_total = now_wall
         if hit_unsettled is not None and wall_unsettled is None:
             wall_unsettled = now_wall
-        if hit_unsettled is not None and hit_total is not None:
+        if (hit_unsettled is not None and hit_total is not None) \
+                or nxt_behind is None:
             break
+        pend_behind = nxt_behind
     wall = time.perf_counter() - t0
     conv_last = 1.0 - behind_last / nm
     round_s = cfg.round_ticks / cfg.ticks_per_second
@@ -249,11 +277,13 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
     }
     if sharded:
         # No silent caps: an all_to_all run with bucket overflows must
-        # be distinguishable from a drop-free one.
+        # be distinguishable from a drop-free one.  Read off the LAST
+        # dispatched state — the input ``state`` was donated into the
+        # pipeline (may include one speculative chunk's drops).
         out["devices"] = len(jax.devices())
         out["board_exchange"] = sim.board_exchange
         out["a2a_slack"] = sim.a2a_slack
-        out["dropped_pulls"] = int(jax.device_get(state.dropped))
+        out["dropped_pulls"] = int(jax.device_get(pend_state.dropped))
     if note:
         out["note"] = note
     return out
@@ -271,23 +301,44 @@ def main() -> None:
     # restart); failing the whole bench on the first init attempt
     # throws the run away.  Retrying is only sound when JAX_PLATFORMS
     # pins a non-cpu backend (as this environment does: =axon): jax
-    # 0.9.0 otherwise leaves an already-initialized CPU backend in its
-    # cache after a TPU init failure, and the "retry" would silently
-    # return that CPU backend — publishing shrunken-fallback numbers as
-    # the headline.  Unpinned platforms fail fast instead.
+    # otherwise leaves an already-initialized CPU backend in its cache
+    # after a TPU init failure, and the "retry" would silently return
+    # that CPU backend — publishing shrunken-fallback numbers as the
+    # headline.  Unpinned platforms fail fast instead.
+    #
+    # Bounded fail-fast (BENCH_r05 postmortem): the old 60 s sleeps ate
+    # the driver's whole timeout (rc=124, no output, `parsed: null`).
+    # Now: ≤3 attempts with short backoff, then ONE parseable JSON
+    # error record on stdout and a nonzero exit — a dead backend must
+    # cost seconds and still produce a machine-readable verdict.
     want = os.environ.get("JAX_PLATFORMS", "")
-    retries = 3 if want and want != "cpu" else 0
+    pinned = bool(want) and want != "cpu"
+    attempts = max(1, int(os.environ.get("BENCH_INIT_ATTEMPTS",
+                                         "3" if pinned else "1")))
+    if not pinned:
+        # Retries stay hard-disabled on unpinned/cpu platforms even via
+        # the env override — see the backend-cache hazard above.
+        attempts = 1
+    backoffs = (5, 15)
     platform = None
-    for attempt in range(retries + 1):
+    for attempt in range(attempts):
         try:
             platform = jax.devices()[0].platform
             break
         except RuntimeError as exc:
-            if attempt == retries:
-                raise
-            print(f"# device init failed ({exc}); retrying in 60 s",
+            if attempt == attempts - 1:
+                print(json.dumps({
+                    "error": "device_init_failed",
+                    "platform_requested": want or "default",
+                    "attempts": attempts,
+                    "message": str(exc),
+                }))
+                sys.exit(1)
+            delay = backoffs[min(attempt, len(backoffs) - 1)]
+            print(f"# device init failed ({exc}); retry "
+                  f"{attempt + 2}/{attempts} in {delay} s",
                   file=sys.stderr)
-            time.sleep(60)
+            time.sleep(delay)
     if platform == "cpu":
         # CPU fallback (no TPU attached): shrink so the bench still
         # runs; explicit env overrides are honored.
@@ -384,9 +435,11 @@ def main() -> None:
 
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.
+    from sidecar_tpu.ops import kernels as kernel_ops
     print(json.dumps({
         "metric": f"simulated gossip rounds/sec/chip (n={n}, spn={spn}, "
                   f"{platform})",
+        "kernels": kernel_ops.resolve_path(record=False)[0],
         "value": round(dense_rps, 3),
         "unit": "rounds/sec/chip",
         "vs_baseline": round(dense_rps / 5.0, 3),

@@ -1,4 +1,11 @@
-"""Candidate-optimization experiments for the compressed round's two
+"""DEAD-END LEDGER: every variant in this file was measured and the
+conclusions are CONSOLIDATED in benchmarks/RESULTS.md ("Measured
+primitive floors and dead ends") — read that table before re-running
+anything here.  Round 6 superseded the XLA-level attack entirely: the
+publish floors are now addressed by the fused Pallas kernels in
+sidecar_tpu/ops/kernels/ (docs/kernels.md).
+
+Candidate-optimization experiments for the compressed round's two
 hot phases (publish ~?, board gather ~?, merge) at north-star shapes.
 
 Each variant runs inside one lax.scan dispatch with per-iteration

@@ -1,4 +1,11 @@
-"""Round 2 of hot-path experiments (int32-only; see hotpath_variants.py
+"""DEAD-END LEDGER: every variant in this file was measured and the
+conclusions are CONSOLIDATED in benchmarks/RESULTS.md ("Measured
+primitive floors and dead ends") — read that table before re-running
+anything here.  Round 6 superseded the XLA-level attack entirely: the
+publish floors are now addressed by the fused Pallas kernels in
+sidecar_tpu/ops/kernels/ (docs/kernels.md).
+
+Round 2 of hot-path experiments (int32-only; see hotpath_variants.py
 for the harness rationale).  Questions:
 
 * pub_approx  — does TPU-native ``lax.approx_max_k`` beat exact top_k

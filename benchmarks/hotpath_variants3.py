@@ -1,4 +1,11 @@
-"""Round 3: can the publish threshold beat exact int32 top_k?
+"""DEAD-END LEDGER: every variant in this file was measured and the
+conclusions are CONSOLIDATED in benchmarks/RESULTS.md ("Measured
+primitive floors and dead ends") — read that table before re-running
+anything here.  Round 6 superseded the XLA-level attack entirely: the
+publish floors are now addressed by the fused Pallas kernels in
+sidecar_tpu/ops/kernels/ (docs/kernels.md).
+
+Round 3: can the publish threshold beat exact int32 top_k?
 
   topk32    exact top_k on int32 [N, 256] (current)
   topk16    top_k on an int16 surrogate (dynamic shift keeps ~13-bit

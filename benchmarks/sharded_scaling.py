@@ -46,13 +46,15 @@ def time_at_d(d, params, topo, cfg, slots, rounds, exchange):
         board_exchange=exchange)
     state = sim.mint(sim.init_state(), slots, 10)
     key = jax.random.PRNGKey(0)
-    out = sim.run_fast(state, key, rounds)          # warm (same length)
-    jax.device_get(out.round_idx)
+    # Warm then chain each rep off the previous output: the drivers
+    # donate their input state (models/compressed.py).
+    state = sim.run_fast(state, key, rounds)        # warm (same length)
+    jax.device_get(state.round_idx)
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        out = sim.run_fast(state, key, rounds)
-        jax.device_get(out.round_idx)
+        state = sim.run_fast(state, key, rounds)
+        jax.device_get(state.round_idx)
         best = min(best, time.perf_counter() - t0)
     return best / rounds * 1000.0
 

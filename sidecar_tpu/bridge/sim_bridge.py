@@ -74,6 +74,17 @@ class SimulationReport:
 
 
 class SimBridge:
+    # Rounds per device dispatch.  Long requests are split into chunks
+    # and PIPELINED: chunk i+1 is enqueued (the donated state carries
+    # over zero-copy) before chunk i's results are pulled back, so the
+    # host-side consumption — convergence bookkeeping and the
+    # delta→(hostname, service) mapping — overlaps device compute
+    # instead of serializing with it.  Chunking is bit-identical to one
+    # long scan (per-round keys fold round_idx; the tested
+    # checkpoint/resume contract), and bounded dispatches also keep a
+    # tunneled TPU worker's watchdog happy (see sim/scenarios.py).
+    CHUNK_ROUNDS = 150
+
     def __init__(self, state: ServicesState,
                  timecfg: TimeConfig = TimeConfig()) -> None:
         self.state = state
@@ -162,15 +173,46 @@ class SimBridge:
             state = dataclasses.replace(state,
                                         known=jax.numpy.asarray(known))
 
-        delta_stream = None
-        if deltas_cap > 0:
-            final, batches, conv = sim.run_with_deltas(
-                state, jax.random.PRNGKey(seed), rounds, deltas_cap)
-            delta_stream = self._map_deltas(batches, mapping, params,
-                                            rounds)
-        else:
-            final, conv = sim.run(state, jax.random.PRNGKey(seed), rounds)
-        conv = np.asarray(jax.device_get(conv))
+        key = jax.random.PRNGKey(seed)
+        sizes = []
+        left = rounds
+        while left > 0:
+            sizes.append(min(self.CHUNK_ROUNDS, left))
+            left -= sizes[-1]
+
+        def dispatch(st, n_rounds, start):
+            # start_round: the host-side round counter — reading the
+            # in-flight state's round_idx would block the pipeline.
+            if deltas_cap > 0:
+                return sim.run_with_deltas(st, key, n_rounds, deltas_cap,
+                                           start_round=start)
+            return sim.run(st, key, n_rounds, start_round=start)
+
+        delta_stream = [] if deltas_cap > 0 else None
+        conv_parts = []
+
+        def consume(out, start):
+            if deltas_cap > 0:
+                final, batches, conv = out
+                delta_stream.extend(self._map_deltas(
+                    batches, mapping, params, len(conv),
+                    start_round=start))
+            else:
+                final, conv = out
+            conv_parts.append(np.asarray(jax.device_get(conv)))
+            return final
+
+        # Each pending chunk carries its own start round — no reliance
+        # on uniform chunk sizes.
+        pend, pend_start = dispatch(state, sizes[0], 0), 0
+        done = sizes[0]
+        for n_rounds in sizes[1:]:
+            nxt, nxt_start = dispatch(pend[0], n_rounds, done), done
+            done += n_rounds
+            consume(pend, pend_start)
+            pend, pend_start = nxt, nxt_start
+        final = consume(pend, pend_start)
+        conv = np.concatenate(conv_parts)
         known = np.asarray(final.known)
 
         truth = known.max(axis=0)
@@ -206,11 +248,13 @@ class SimBridge:
 
     @staticmethod
     def _map_deltas(batches, mapping: BridgeMapping, params: SimParams,
-                    rounds: int) -> list:
+                    rounds: int, start_round: int = 0) -> list:
         """DeltaBatch stream [rounds, cap] → per-round (hostname,
         service id, status) change lists.  Padded slots in an owner's
         run have no service id and are dropped (they can only change
-        through announce of real records, so in practice none appear)."""
+        through announce of real records, so in practice none appear).
+        ``start_round`` offsets the reported round numbers for chunked
+        callers."""
         spn = params.services_per_node
         count = np.asarray(jax.device_get(batches.count))
         node = np.asarray(jax.device_get(batches.node))
@@ -220,7 +264,7 @@ class SimBridge:
         out = []
         for r in range(rounds):
             if bool(overflow[r]):
-                out.append({"round": r + 1, "overflow": True,
+                out.append({"round": start_round + r + 1, "overflow": True,
                             "count": int(count[r])})
                 continue
             changes = []
@@ -237,7 +281,7 @@ class SimBridge:
                         int(unpack_status(np.int32(v)))),
                     "tick": int(unpack_ts(np.int32(v))),
                 })
-            out.append({"round": r + 1, "overflow": False,
+            out.append({"round": start_round + r + 1, "overflow": False,
                         "count": int(count[r]), "changes": changes})
         return out
 

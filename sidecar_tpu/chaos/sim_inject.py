@@ -246,9 +246,12 @@ class ChaosExactSim(ExactSim):
              jnp.zeros((d, flat), jnp.int32),
              jnp.zeros((d, flat), jnp.int32))
             for d in self._prog.ring_specs)
-        zero = jnp.zeros((), jnp.int32)
-        return ChaosSimState(sim=base, rings=rings, injected_drops=zero,
-                             injected_delays=zero, injected_dups=zero)
+        # Three DISTINCT zero buffers: the run drivers donate the whole
+        # state pytree, and XLA rejects donating one buffer twice.
+        return ChaosSimState(sim=base, rings=rings,
+                             injected_drops=jnp.zeros((), jnp.int32),
+                             injected_delays=jnp.zeros((), jnp.int32),
+                             injected_dups=jnp.zeros((), jnp.int32))
 
     # -- the chaos round ---------------------------------------------------
 
@@ -433,7 +436,13 @@ class ChaosExactSim(ExactSim):
                 "delayed": int(cst.injected_delays),
                 "duplicated": int(cst.injected_dups)}
 
-    def _publish_injection_metrics(self, before: ChaosSimState,
+    @staticmethod
+    def _counter_snapshot(cst: ChaosSimState) -> dict:
+        return {f: int(getattr(cst, f))
+                for f in ("injected_drops", "injected_delays",
+                          "injected_dups")}
+
+    def _publish_injection_metrics(self, before: dict,
                                    after: ChaosSimState) -> None:
         """Fault pressure must be observable, not silent: push the run's
         injection deltas into the process metrics registry."""
@@ -441,16 +450,24 @@ class ChaosExactSim(ExactSim):
                             ("chaos.sim.delayedPackets", "injected_delays"),
                             ("chaos.sim.duplicatedPackets",
                              "injected_dups")):
-            delta = int(getattr(after, field)) - int(getattr(before, field))
+            delta = int(getattr(after, field)) - before[field]
             if delta:
                 metrics.incr(name, delta)
 
-    def run(self, state, key, num_rounds: int):
-        final, conv = super().run(state, key, num_rounds)
-        self._publish_injection_metrics(state, final)
+    def run(self, state, key, num_rounds: int, donate: bool = True,
+            start_round=None):
+        # Snapshot the injection counters BEFORE dispatch: the donating
+        # run deletes the input state's buffers (models/exact.py).
+        # (The snapshot reads device scalars, so a chaos sim pays one
+        # sync per chunk even when start_round is supplied.)
+        before = self._counter_snapshot(state)
+        final, conv = super().run(state, key, num_rounds, donate=donate,
+                                  start_round=start_round)
+        self._publish_injection_metrics(before, final)
         return final, conv
 
-    def run_fast(self, state, key, num_rounds: int):
-        final = super().run_fast(state, key, num_rounds)
-        self._publish_injection_metrics(state, final)
+    def run_fast(self, state, key, num_rounds: int, donate: bool = True):
+        before = self._counter_snapshot(state)
+        final = super().run_fast(state, key, num_rounds, donate=donate)
+        self._publish_injection_metrics(before, final)
         return final

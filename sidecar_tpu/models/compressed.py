@@ -114,8 +114,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from sidecar_tpu.models.exact import clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops.merge import (
     apply_stickiness,
     staleness_mask,
@@ -295,6 +297,12 @@ class CompressedSim:
         self._cut = None if cut_mask is None else jnp.asarray(cut_mask)
         self._side = None if node_side is None else \
             jnp.asarray(node_side, jnp.int32)
+        # Kernel path (ops/kernels): resolved ONCE at construction — the
+        # choice is baked into this sim's jitted round, so toggling
+        # SIDECAR_TPU_KERNELS affects sims built afterwards.
+        self._kernels, self._kernels_interpret = kernel_ops.resolve_path()
+        self._fused_gather = (self._kernels == "pallas"
+                              and kernel_ops.fused_gather_enabled())
 
     # -- state construction -------------------------------------------------
 
@@ -385,43 +393,22 @@ class CompressedSim:
         between deep sweeps a refresh-fold orphan may stay
         publish-eligible for a few sweeps — stale-but-harmless traffic
         that loses every line competition against in-flight records
-        (see ``_floor_advance_and_sweep``)."""
+        (see ``_floor_advance_and_sweep``).
+
+        The selection op sequence itself lives in ops/kernels — the XLA
+        reference (``publish_board_xla``, exactly the round-5 spelling)
+        and its bit-identical fused Pallas twin, dispatched by the
+        ``SIDECAR_TPU_KERNELS`` path resolved at construction."""
         p = self.p
-        k = p.cache_lines
-        eligible = (state.cache_slot >= 0) & \
-            (state.cache_sent.astype(jnp.int32) < limit)
-        priority = jnp.where(eligible, state.cache_val, 0)
-        budget = min(p.budget, k)
-        top = lax.top_k(priority, budget)[0]
-        thresh = top[:, -1:]
-        above = priority > thresh
-        tie = (priority == thresh) & (priority > 0)
-        n_above = jnp.sum(above, axis=1, keepdims=True)
-
-        n = priority.shape[0]
-        rows = jnp.arange(n, dtype=jnp.int32) + row_offset
-        rot = (rows.astype(jnp.uint32) * jnp.uint32(gossip_ops.PHASE_MULT)
-               & jnp.uint32(k - 1)).astype(jnp.int32)
-        s = jnp.cumsum(tie.astype(jnp.int32), axis=1)
-        total = s[:, -1:]
-        base = jnp.where(
-            rot[:, None] > 0,
-            jnp.take_along_axis(s, jnp.maximum(rot[:, None] - 1, 0),
-                                axis=1),
-            0)
-        cols = jnp.arange(k, dtype=jnp.int32)[None, :]
-        rank = jnp.where(cols >= rot[:, None], s - base,
-                         s + total - base)
-        admit = tie & (rank <= budget - n_above)
-
-        selected = above | admit
-        bval = jnp.where(selected, state.cache_val, 0)
-        bslot = jnp.where(selected, state.cache_slot, -1)
-        sent = jnp.minimum(
-            state.cache_sent.astype(jnp.int32)
-            + jnp.where(selected, p.fanout, 0),
-            limit).astype(jnp.int8)
-        return bval, bslot, sent
+        kw = dict(budget=min(p.budget, p.cache_lines), limit=limit,
+                  fanout=p.fanout, cache_lines=p.cache_lines,
+                  row_offset=row_offset)
+        if self._kernels == "pallas":
+            return kernel_ops.publish_board_pallas(
+                state.cache_val, state.cache_slot, state.cache_sent,
+                interpret=self._kernels_interpret, **kw)
+        return kernel_ops.publish_board_xla(
+            state.cache_val, state.cache_slot, state.cache_sent, **kw)
 
     @staticmethod
     def _lex_max(wv, ws, cv, cs):
@@ -853,9 +840,25 @@ class CompressedSim:
         src = gossip_ops.sample_peers(
             k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
             node_alive=state.node_alive, cut_mask=self._cut)
-        bval, bslot, sent = self._publish(state, limit)
-        state = self._pull_merge(state, sent, bval, bslot, src,
-                                 state.node_alive, now, drop_key=k_drop)
+        if self._fused_gather:
+            # Fused Pallas path: publish selection + staleness gate +
+            # board row-gather in one kernel — the [N, K] board never
+            # touches HBM (ops/kernels, bit-identical to the XLA path).
+            sent, pv, ps = kernel_ops.fused_publish_gather_pallas(
+                state.cache_val, state.cache_slot, state.cache_sent,
+                src, now, stale_ticks=t.stale_ticks,
+                budget=min(p.budget, p.cache_lines), limit=limit,
+                fanout=p.fanout, cache_lines=p.cache_lines,
+                interpret=self._kernels_interpret)
+            ok = state.node_alive[src] & state.node_alive[:, None]
+            state = self._merge_pulled(state, sent, pv, ps, ok, now,
+                                       drop_key=k_drop,
+                                       stale_filtered=True)
+        else:
+            bval, bslot, sent = self._publish(state, limit)
+            state = self._pull_merge(state, sent, bval, bslot, src,
+                                     state.node_alive, now,
+                                     drop_key=k_drop)
 
         # 2. announce re-stamps + recovery offers (end of round, like the
         # exact model: broadcastable the following round).
@@ -1018,15 +1021,27 @@ class CompressedSim:
         return lax.switch(idx, (exact, fast, fast_list), state)
 
     # -- drivers ------------------------------------------------------------
+    # Donation: the _run*_jit entry points donate the input state so the
+    # cache/floor tensors are rewritten in place across chunked
+    # dispatches instead of double-buffered (see models/exact.py).
+    # ``donate=False`` keeps the input alive at the cost of one copy.
 
-    def _check_horizon(self, state, num_rounds):
-        self.t.validate_horizon(int(state.round_idx) + num_rounds)
+    def _check_horizon(self, state, num_rounds, start_round=None):
+        # ``start_round`` lets pipelined callers (bench.py, the bridge)
+        # validate the horizon from their host-side round counter:
+        # reading ``state.round_idx`` of an in-flight chunk's output
+        # would block until that chunk finishes, serializing the
+        # dispatch pipeline.
+        if start_round is None:
+            start_round = int(state.round_idx)
+        self.t.validate_horizon(start_round + num_rounds)
 
     def step(self, state, key):
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
 
-    def run(self, state, key, num_rounds: int, conv_every: int = 1):
+    def run(self, state, key, num_rounds: int, conv_every: int = 1,
+            donate: bool = True, start_round=None):
         """Run ``num_rounds``, sampling the convergence metric every
         ``conv_every`` rounds (the returned curve has
         ``num_rounds // conv_every`` points, at rounds ``conv_every,
@@ -1038,10 +1053,13 @@ class CompressedSim:
             raise ValueError(
                 f"num_rounds={num_rounds} not divisible by "
                 f"conv_every={conv_every}")
-        self._check_horizon(state, num_rounds)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
         return self._run_jit(state, key, num_rounds, conv_every)
 
-    def run_behind(self, state, key, num_rounds: int, every: int = 1):
+    def run_behind(self, state, key, num_rounds: int, every: int = 1,
+                   donate: bool = True, start_round=None):
         """Like :meth:`run` but sampling the raw behind COUNT
         (:meth:`behind`) instead of the normalized fraction — the
         bench's ε-crossing detector, immune to float32 resolution loss
@@ -1049,14 +1067,19 @@ class CompressedSim:
         if num_rounds % every:
             raise ValueError(
                 f"num_rounds={num_rounds} not divisible by every={every}")
-        self._check_horizon(state, num_rounds)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
         return self._run_behind_jit(state, key, num_rounds, every)
 
-    def run_fast(self, state, key, num_rounds: int):
+    def run_fast(self, state, key, num_rounds: int, donate: bool = True):
         self._check_horizon(state, num_rounds)
+        if not donate:
+            state = clone_state(state)
         return self._run_fast_jit(state, key, num_rounds)
 
-    def run_with_deltas(self, state, key, num_rounds: int, cap: int):
+    def run_with_deltas(self, state, key, num_rounds: int, cap: int,
+                        donate: bool = True):
         """Scan with per-round changed-belief extraction: returns
         ``(final state, DeltaBatch[num_rounds])``.  The belief view
         ``max(floor, cache hit, own)`` is materialized per round
@@ -1065,8 +1088,12 @@ class CompressedSim:
         bridge/test regime's tool — north-star-scale delta streaming
         stays on the exact model's shard sizes (see ops/delta.py)."""
         self._check_horizon(state, num_rounds)
+        if not donate:
+            state = clone_state(state)
         return self._run_deltas_jit(state, key, num_rounds, cap)
 
+    # no-donate: single-round stepping is the oracle/replay path — those
+    # callers diff pre- vs post-step states, so the input must survive.
     @functools.partial(jax.jit, static_argnums=0)
     def _step_jit(self, state, key):
         return self._step(state, key)
@@ -1074,7 +1101,7 @@ class CompressedSim:
     # Per-round keys fold the round index into the base key so chunked/
     # resumed runs replay identical randomness (see ExactSim).
 
-    @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
     def _run_jit(self, state, key, num_rounds, conv_every=1):
         def inner(st, _):
             return self._step(st, jax.random.fold_in(key, st.round_idx)), \
@@ -1085,7 +1112,7 @@ class CompressedSim:
         return lax.scan(body, state, None,
                         length=num_rounds // conv_every)
 
-    @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
     def _run_behind_jit(self, state, key, num_rounds, every):
         def inner(st, _):
             return self._step(st, jax.random.fold_in(key, st.round_idx)), \
@@ -1095,14 +1122,14 @@ class CompressedSim:
             return st, self.behind(st)
         return lax.scan(body, state, None, length=num_rounds // every)
 
-    @functools.partial(jax.jit, static_argnums=(0, 3))
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
     def _run_fast_jit(self, state, key, num_rounds):
         def body(st, _):
             return self._step(st, jax.random.fold_in(key, st.round_idx)), None
         final, _ = lax.scan(body, state, None, length=num_rounds)
         return final
 
-    @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
     def _run_deltas_jit(self, state, key, num_rounds, cap):
         # Lazy import — ops/delta imports this module's hash_line.
         from sidecar_tpu.ops.delta import compressed_belief, extract_delta

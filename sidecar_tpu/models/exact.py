@@ -67,6 +67,18 @@ class SimState:
     round_idx: jax.Array   # int32 scalar — completed rounds
 
 
+def clone_state(state):
+    """Deep-copy a sim state pytree onto fresh device buffers.
+
+    The ``_run*`` drivers DONATE their input state (the ~100 MB belief
+    tensors would otherwise be double-buffered across every chunked
+    dispatch); a caller that needs the pre-run state afterwards — the
+    warm/timed benchmark pattern, replay tests — passes ``donate=False``
+    to the driver, which routes through this copy, or clones explicitly.
+    """
+    return jax.tree_util.tree_map(jnp.copy, state)
+
+
 @dataclasses.dataclass(frozen=True)
 class SimParams:
     """Static simulation parameters (hashable; safe to close over jit)."""
@@ -270,27 +282,50 @@ class ExactSim:
     # round_idx (state is concrete between calls) before dispatching to the
     # jitted implementations — a resumed/chunked simulation must not be
     # able to silently run the int32 packed-key clock into the sign bit.
+    #
+    # Donation: every _run*_jit entry point DONATES the input state
+    # (donate_argnums=1) so the belief tensors are rewritten in place
+    # across chunked dispatches instead of double-buffered — ~840 MB of
+    # HBM headroom at the dense bench shape, ~100 MB on the compressed
+    # north star.  After run*(state, ...) returns, ``state``'s buffers
+    # are DELETED (accessing them raises); pass ``donate=False`` to keep
+    # the input alive at the cost of one device copy.
 
-    def _check_horizon(self, state: SimState, num_rounds: int) -> None:
-        self.t.validate_horizon(int(state.round_idx) + num_rounds)
+    def _check_horizon(self, state: SimState, num_rounds: int,
+                       start_round=None) -> None:
+        # ``start_round`` lets pipelined callers validate the horizon
+        # from a host-side round counter — reading ``state.round_idx``
+        # of an in-flight chunk's output would block on that chunk and
+        # serialize the dispatch pipeline (see bridge/sim_bridge.py).
+        if start_round is None:
+            start_round = int(state.round_idx)
+        self.t.validate_horizon(start_round + num_rounds)
 
     def step(self, state: SimState, key: jax.Array) -> SimState:
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
 
-    def run(self, state: SimState, key: jax.Array, num_rounds: int):
+    def run(self, state: SimState, key: jax.Array, num_rounds: int,
+            donate: bool = True, start_round=None):
         """Scan ``num_rounds`` gossip rounds; returns (final state,
-        per-round convergence fraction [num_rounds])."""
-        self._check_horizon(state, num_rounds)
+        per-round convergence fraction [num_rounds]).  Donates ``state``
+        unless ``donate=False`` (see the drivers note above)."""
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
         return self._run_jit(state, key, num_rounds)
 
-    def run_fast(self, state: SimState, key: jax.Array, num_rounds: int):
+    def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
+                 donate: bool = True):
         """Scan without per-round metrics — the benchmark path."""
         self._check_horizon(state, num_rounds)
+        if not donate:
+            state = clone_state(state)
         return self._run_fast_jit(state, key, num_rounds)
 
     def run_with_deltas(self, state: SimState, key: jax.Array,
-                        num_rounds: int, cap: int):
+                        num_rounds: int, cap: int, donate: bool = True,
+                        start_round=None):
         """Scan with per-round changed-cell extraction (ops/delta.py):
         returns ``(final state, DeltaBatch[num_rounds], conv
         [num_rounds])``.  The diff runs inside the scan on consecutive
@@ -298,9 +333,13 @@ class ExactSim:
         device — the query plane's streaming contract (a round that
         changes more than ``cap`` cells flags ``overflow`` and the
         consumer resyncs from a snapshot)."""
-        self._check_horizon(state, num_rounds)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
         return self._run_deltas_jit(state, key, num_rounds, cap)
 
+    # no-donate: single-round stepping is the oracle/replay path — those
+    # callers diff pre- vs post-step states, so the input must survive.
     @functools.partial(jax.jit, static_argnums=0)
     def _step_jit(self, state: SimState, key: jax.Array) -> SimState:
         return self._step(state, key)
@@ -310,7 +349,7 @@ class ExactSim:
     # resumed in chunks replays the exact same randomness as a straight
     # run: run(s0, k, a+b) == run(run(s0, k, a), k, b).
 
-    @functools.partial(jax.jit, static_argnums=(0, 3))
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
     def _run_jit(self, state: SimState, key: jax.Array, num_rounds: int):
         def body(st, _):
             st = self._step(st, jax.random.fold_in(key, st.round_idx))
@@ -318,7 +357,7 @@ class ExactSim:
 
         return lax.scan(body, state, None, length=num_rounds)
 
-    @functools.partial(jax.jit, static_argnums=(0, 3))
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
     def _run_fast_jit(self, state: SimState, key: jax.Array, num_rounds: int):
         def body(st, _):
             return self._step(st, jax.random.fold_in(key, st.round_idx)), None
@@ -326,7 +365,7 @@ class ExactSim:
         final, _ = lax.scan(body, state, None, length=num_rounds)
         return final
 
-    @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
     def _run_deltas_jit(self, state: SimState, key: jax.Array,
                         num_rounds: int, cap: int):
         # Lazy import: ops/delta pulls in the compressed model's line

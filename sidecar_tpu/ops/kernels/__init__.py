@@ -1,0 +1,107 @@
+"""Custom-kernel layer: runtime selection between the fused Pallas
+publish/board kernels and the XLA reference path.
+
+Selection contract (docs/kernels.md):
+
+* ``SIDECAR_TPU_KERNELS=pallas`` — force the Pallas kernels.  On a
+  non-TPU backend they run under ``pallas_call(interpret=True)`` — the
+  same kernel logic the TPU compiles, executed by the Pallas
+  interpreter — which is how tier-1 (CPU) exercises them.  On TPU, if
+  Mosaic lowering of the probe kernel fails, the layer FALLS BACK to
+  XLA instead of crashing the run.
+* ``SIDECAR_TPU_KERNELS=xla`` — force the round-5 XLA op sequence.
+* unset / ``auto`` — Pallas on TPU (with the same lowering-probe
+  fallback), XLA elsewhere: CPU test runs keep the cheap native path
+  unless a test opts in explicitly.
+
+``SIDECAR_TPU_FUSED_GATHER=0`` additionally degrades the Pallas path to
+publish-kernel + XLA row-gather (the gather half rides XLA's native
+gather lowering) — the documented escape hatch if the in-kernel DMA
+gather underperforms on some topology of real hardware.
+
+Every resolution is recorded in the metrics registry: the counter
+``kernels.path.<pallas|xla|xla_fallback>`` counts sims built on each
+path, and the gauge ``kernels.pallas_active`` holds whether the most
+recent resolution selected Pallas — the observability hook the bench
+and round_phases reports read back.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu import metrics
+from sidecar_tpu.ops.kernels.publish_gather import (  # noqa: F401
+    fused_publish_gather_pallas,
+    fused_publish_gather_xla,
+    publish_board_pallas,
+    publish_board_xla,
+)
+
+ENV_VAR = "SIDECAR_TPU_KERNELS"
+ENV_FUSED = "SIDECAR_TPU_FUSED_GATHER"
+
+# Lowering-probe result, memoized per process: None = not yet probed.
+_probe_ok: Optional[bool] = None
+
+
+def _probe_lowering() -> bool:
+    """Can Mosaic actually lower the publish kernel on this backend?
+    Compiles a tiny non-interpret instance once per process; any
+    failure (old jaxlib, unsupported target, missing Mosaic) selects
+    the XLA fallback rather than crashing the first real dispatch."""
+    global _probe_ok
+    if _probe_ok is None:
+        try:
+            cv = jnp.zeros((8, 128), jnp.int32)
+            cs = jnp.full((8, 128), -1, jnp.int32)
+            se = jnp.zeros((8, 128), jnp.int8)
+            jax.jit(lambda a, b, c: publish_board_pallas(
+                a, b, c, budget=4, limit=4, fanout=2, cache_lines=128,
+                interpret=False)).lower(cv, cs, se).compile()
+            _probe_ok = True
+        except Exception:  # noqa: BLE001 — any lowering failure ⇒ fallback
+            _probe_ok = False
+    return _probe_ok
+
+
+def resolve_path(record: bool = True) -> tuple[str, bool]:
+    """Resolve the active kernel path → ``(path, interpret)`` where
+    ``path`` is ``"pallas"`` or ``"xla"`` and ``interpret`` says the
+    Pallas kernels must run under the interpreter (non-TPU backend).
+
+    Called at sim construction (trace-time decision — the choice is
+    baked into the jitted round), so toggling the env var affects sims
+    built afterwards, not already-compiled ones.
+    """
+    mode = os.environ.get(ENV_VAR, "auto").strip().lower() or "auto"
+    if mode not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"{ENV_VAR}={mode!r}: expected 'pallas', 'xla' or 'auto'")
+    on_tpu = jax.default_backend() == "tpu"
+    interpret = not on_tpu
+
+    if mode == "xla":
+        path = "xla"
+    elif mode == "pallas":
+        path = "pallas" if (interpret or _probe_lowering()) else "xla"
+    else:  # auto: Pallas where it compiles natively, XLA elsewhere
+        path = "pallas" if (on_tpu and _probe_lowering()) else "xla"
+
+    if record:
+        fellback = path == "xla" and mode != "xla" and on_tpu \
+            and not _probe_lowering()
+        metrics.incr(f"kernels.path.{'xla_fallback' if fellback else path}")
+        metrics.set_gauge("kernels.pallas_active",
+                          1.0 if path == "pallas" else 0.0)
+    return path, interpret
+
+
+def fused_gather_enabled() -> bool:
+    """Whether the Pallas path uses the fully-fused in-kernel DMA gather
+    (default) or the publish-kernel + XLA-gather degraded form."""
+    return os.environ.get(ENV_FUSED, "1").strip() != "0"

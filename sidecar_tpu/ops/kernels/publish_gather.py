@@ -1,0 +1,355 @@
+"""Fused Pallas TPU kernels for the publish/board phase — the two
+measured per-round floors of the compressed round.
+
+`benchmarks/RESULTS.md` (round 5) pins the single-chip compressed round
+at ~29.6 ms, dominated by two primitive floors inside the publish +
+delivery phase: exact ``lax.top_k`` over ``[N, K]`` at **6.2 ms** and
+the board row-gather at **4.1 ms**.  Neither is compute-bound — both
+are "stream the cache through the core and do trivial per-element
+work", which is exactly the shape operator fusion wins (the
+GNN-architecture survey's scatter/gather argument, PAPERS.md): XLA
+spells the publish selection as top_k + cumsum + a chain of elementwise
+passes, each a full HBM round trip over ``[N, K]``, then materializes
+the board and re-reads it for the gather.
+
+The kernels here collapse that:
+
+* :func:`publish_board_pallas` — ONE pass: each ``[T, K]`` cache tile
+  is streamed through VMEM once and the entire selection pipeline runs
+  on it in-registers — eligibility mask, the budget-th-largest
+  threshold (a 31-step bitwise max search replacing ``top_k``; see
+  ``_publish_block``), the rotated prefix-sum tie rank (the cumsum
+  lowered onto the MXU as a triangular-ones matmul), the admit mask,
+  and the transmit-count bump.  The intermediate tensors XLA would
+  bounce through HBM never leave VMEM.
+* :func:`fused_publish_gather_pallas` — the same pass ALSO serves the
+  delivery gather: for each receiver row the kernel DMAs its sampled
+  peers' cache rows from HBM (a depth-``_DMA_RING`` ring of async
+  copies overlapped with compute), recomputes their publish selection
+  in VMEM, applies the board staleness gate, and emits the pulled
+  boards ``[N, F, K]`` directly — the ``[N, K]`` message board is
+  never materialized in HBM at all on the single-chip path.
+
+Bit-identity contract: both kernels are **bit-identical** to the XLA
+reference (:func:`publish_board_xla` — the exact op sequence the model
+shipped through round 5), enforced by tests/test_kernels.py across
+ragged shapes, tie-heavy bursts, all-ineligible rows and tombstone-only
+rows, plus a lockstep ``CompressedSim`` parity run.  On CPU the kernels
+run under ``pallas_call(interpret=True)`` so tier-1 exercises the same
+kernel logic the TPU compiles.
+
+Why the threshold search is exact: the XLA path's threshold is
+``top_k(priority, B)[:, -1]`` — the B-th largest *with multiplicity*.
+That value is the maximum ``t`` with ``count(priority >= t) >= B``
+(monotone in ``t``), so a greedy bitwise maximization over the 31
+value bits finds exactly it: 31 compare+row-sum passes over a VMEM
+tile instead of a full sort.  All arithmetic is int32; there is no
+tolerance anywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sidecar_tpu.ops.gossip import PHASE_MULT
+from sidecar_tpu.ops.merge import staleness_mask
+
+# Depth of the peer-row DMA ring in the fused gather (outstanding async
+# copies per buffer); sized so the fetch of row i+_DMA_RING overlaps the
+# publish recompute of row i without exhausting DMA slots.
+_DMA_RING = 16
+
+
+def _tile_rows(n: int, k: int) -> int:
+    """Row-tile height: scale with 1/K so the working set (own tile +
+    gathered peer rows + outputs + the [K, K] prefix matrix) stays a
+    few MB of VMEM at any cache width."""
+    return max(1, min(n, max(8, 65536 // max(k, 1))))
+
+
+# -- the shared selection math (one definition, two backends) ---------------
+
+def _publish_block(cv, cs, se, gids, *, budget: int, limit: int,
+                   fanout: int, k: int):
+    """Publish selection on a ``[T, K]`` block — the in-VMEM recast of
+    the XLA reference in :func:`publish_board_xla`, bit-identical by
+    construction (integer arithmetic only).
+
+    ``gids`` are the rows' GLOBAL node ids (the tie-rotation seed).
+    Returns (bval, bslot, sent) for the block.
+    """
+    t = cv.shape[0]
+    eligible = (cs >= 0) & (se.astype(jnp.int32) < limit)
+    priority = jnp.where(eligible, cv, 0)
+
+    # Threshold: budget-th largest with multiplicity, via bitwise max
+    # search (see module docstring).  Unrolled 31 compare+sum passes —
+    # VPU work on a tile already resident in VMEM.
+    thresh = jnp.zeros((t, 1), jnp.int32)
+    for b in range(30, -1, -1):
+        cand = thresh | (1 << b)
+        cnt = jnp.sum((priority >= cand).astype(jnp.int32), axis=1,
+                      keepdims=True)
+        thresh = jnp.where(cnt >= budget, cand, thresh)
+
+    above = priority > thresh
+    tie = (priority == thresh) & (priority > 0)
+    n_above = jnp.sum(above.astype(jnp.int32), axis=1, keepdims=True)
+
+    rot = (gids.astype(jnp.uint32) * jnp.uint32(PHASE_MULT)
+           & jnp.uint32(k - 1)).astype(jnp.int32)[:, None]
+    cols = lax.broadcasted_iota(jnp.int32, (t, k), 1)
+    # Inclusive prefix sum of the tie mask as a triangular-ones matmul:
+    # counts are <= K <= 2^24, exact in f32 on the MXU.
+    tri = (lax.broadcasted_iota(jnp.int32, (k, k), 0)
+           <= lax.broadcasted_iota(jnp.int32, (k, k), 1)
+           ).astype(jnp.float32)
+    s = jnp.dot(tie.astype(jnp.float32), tri,
+                preferred_element_type=jnp.float32).astype(jnp.int32)
+    total = jnp.sum(tie.astype(jnp.int32), axis=1, keepdims=True)
+    # base = s[rot-1] (0 when rot == 0), spelled as a masked sum so no
+    # per-row lane gather is needed.
+    base = jnp.sum((tie & (cols < rot)).astype(jnp.int32), axis=1,
+                   keepdims=True)
+    rank = jnp.where(cols >= rot, s - base, s + total - base)
+    admit = tie & (rank <= budget - n_above)
+
+    selected = above | admit
+    bval = jnp.where(selected, cv, 0)
+    bslot = jnp.where(selected, cs, -1)
+    sent = jnp.minimum(
+        se.astype(jnp.int32) + jnp.where(selected, fanout, 0),
+        limit).astype(jnp.int8)
+    return bval, bslot, sent
+
+
+def publish_board_xla(cache_val, cache_slot, cache_sent, *, budget: int,
+                      limit: int, fanout: int, cache_lines: int,
+                      row_offset=0):
+    """The XLA reference path — the exact op sequence
+    ``CompressedSim._publish`` shipped through round 5 (top_k threshold
+    + rotated prefix-sum tie admission; see models/compressed.py for
+    the protocol rationale).  The Pallas kernels are bit-identical to
+    this function.
+    """
+    k = cache_lines
+    eligible = (cache_slot >= 0) & (cache_sent.astype(jnp.int32) < limit)
+    priority = jnp.where(eligible, cache_val, 0)
+    budget = min(budget, k)
+    top = lax.top_k(priority, budget)[0]
+    thresh = top[:, -1:]
+    above = priority > thresh
+    tie = (priority == thresh) & (priority > 0)
+    n_above = jnp.sum(above, axis=1, keepdims=True)
+
+    n = priority.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32) + row_offset
+    rot = (rows.astype(jnp.uint32) * jnp.uint32(PHASE_MULT)
+           & jnp.uint32(k - 1)).astype(jnp.int32)
+    s = jnp.cumsum(tie.astype(jnp.int32), axis=1)
+    total = s[:, -1:]
+    base = jnp.where(
+        rot[:, None] > 0,
+        jnp.take_along_axis(s, jnp.maximum(rot[:, None] - 1, 0), axis=1),
+        0)
+    cols = jnp.arange(k, dtype=jnp.int32)[None, :]
+    rank = jnp.where(cols >= rot[:, None], s - base, s + total - base)
+    admit = tie & (rank <= budget - n_above)
+
+    selected = above | admit
+    bval = jnp.where(selected, cache_val, 0)
+    bslot = jnp.where(selected, cache_slot, -1)
+    sent = jnp.minimum(
+        cache_sent.astype(jnp.int32) + jnp.where(selected, fanout, 0),
+        limit).astype(jnp.int8)
+    return bval, bslot, sent
+
+
+# -- board-only kernel ------------------------------------------------------
+
+def publish_board_pallas(cache_val, cache_slot, cache_sent, *, budget: int,
+                         limit: int, fanout: int, cache_lines: int,
+                         row_offset=0, interpret: bool = True):
+    """Publish selection as one fused VMEM pass per ``[T, K]`` tile.
+
+    Drop-in for :func:`publish_board_xla`; ``row_offset`` may be traced
+    (the sharded twin passes its shard base inside ``shard_map``), so it
+    rides in as an SMEM scalar.
+    """
+    n, k = cache_val.shape
+    if k != cache_lines:
+        raise ValueError(f"cache width {k} != cache_lines {cache_lines}")
+    budget = min(budget, k)
+    tile = _tile_rows(n, k)
+    block = functools.partial(_publish_block, budget=budget, limit=limit,
+                              fanout=fanout, k=k)
+
+    def kernel(off_s, cv_t, cs_t, se_t, bv_o, bs_o, se_o):
+        r0 = pl.program_id(0) * tile + off_s[0]
+        gids = r0 + lax.broadcasted_iota(jnp.int32, (tile, 1), 0)[:, 0]
+        bv, bs, se = block(cv_t[:], cs_t[:], se_t[:], gids)
+        bv_o[:] = bv
+        bs_o[:] = bs
+        se_o[:] = se
+
+    row_block = pl.BlockSpec((tile, k), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(n, tile),),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            row_block, row_block, row_block,
+        ],
+        out_specs=[row_block, row_block, row_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, k), jnp.int32),
+            jax.ShapeDtypeStruct((n, k), jnp.int32),
+            jax.ShapeDtypeStruct((n, k), jnp.int8),
+        ],
+        interpret=interpret,
+        name="sidecar_publish_board",
+    )(jnp.asarray(row_offset, jnp.int32).reshape(1),
+      cache_val, cache_slot, cache_sent)
+
+
+# -- fused publish + board row-gather ---------------------------------------
+
+def fused_publish_gather_xla(cache_val, cache_slot, cache_sent, src, now,
+                             *, stale_ticks: int, budget: int, limit: int,
+                             fanout: int, cache_lines: int):
+    """XLA spelling of the fused contract: publish, staleness-filter the
+    board, gather the sampled rows.  Exactly the round-5 op sequence
+    (``_publish`` + the board filter + ``bval[src]`` / ``bslot[src]``
+    from ``_pull_merge``), packaged so both backends share one
+    signature.  Returns ``(sent, pv, ps)``.
+    """
+    bval, bslot, sent = publish_board_xla(
+        cache_val, cache_slot, cache_sent, budget=budget, limit=limit,
+        fanout=fanout, cache_lines=cache_lines)
+    bval = jnp.where(staleness_mask(bval, now, stale_ticks), 0, bval)
+    return sent, bval[src], bslot[src]
+
+
+def fused_publish_gather_pallas(cache_val, cache_slot, cache_sent, src,
+                                now, *, stale_ticks: int, budget: int,
+                                limit: int, fanout: int, cache_lines: int,
+                                interpret: bool = True):
+    """Publish + board row-gather in ONE kernel: the ``[N, K]`` board is
+    never materialized in HBM.
+
+    Per receiver tile the kernel (a) runs the fused publish pass on its
+    own cache rows (emitting the transmit-count bump), and (b) streams
+    its sampled peers' cache rows in through a depth-``_DMA_RING`` ring
+    of async copies, recomputes their publish selection in VMEM, applies
+    the board staleness gate, and writes the pulled boards
+    ``pv/ps [N, F, K]`` that feed ``_merge_pulled`` directly.
+
+    ``pv[r, f] == stale_filtered(board)[src[r, f]]`` and
+    ``ps[r, f] == bslot[src[r, f]]`` bit-for-bit vs the XLA path; the
+    recompute is sound because a board row is a pure function of its
+    node's pre-round cache row.  Returns ``(sent, pv, ps)``.
+    """
+    n, k = cache_val.shape
+    f = src.shape[1]
+    if k != cache_lines:
+        raise ValueError(f"cache width {k} != cache_lines {cache_lines}")
+    budget = min(budget, k)
+    tile = _tile_rows(n, k)
+    rows = tile * f
+    ring = min(_DMA_RING, rows)
+    block = functools.partial(_publish_block, budget=budget, limit=limit,
+                              fanout=fanout, k=k)
+
+    def kernel(params_s, src_s, src_v, cv_t, cs_t, se_t,
+               cv_h, cs_h, se_h, se_o, pv_o, ps_o, gv, gs, ge, sem):
+        now_t = params_s[0]
+        stale_t = params_s[1]
+        r0 = pl.program_id(0) * tile
+        gids = r0 + lax.broadcasted_iota(jnp.int32, (tile, 1), 0)[:, 0]
+
+        def peer_copies(i):
+            # Clamp: rows past N in a ragged last tile carry garbage
+            # src values; their outputs are dropped by the block store,
+            # but the DMA itself must stay in bounds.
+            peer = jnp.clip(src_s[i // f, i % f], 0, n - 1)
+            return tuple(
+                pltpu.make_async_copy(h.at[peer], g.at[i],
+                                      sem.at[i % ring, w])
+                for w, (h, g) in enumerate(
+                    ((cv_h, gv), (cs_h, gs), (se_h, ge))))
+
+        def fetch(i, _):
+            # Free the ring slot this copy reuses, then start it —
+            # fetches run ahead of the publish compute below.
+            @pl.when(i >= ring)
+            def _():
+                for c in peer_copies(i - ring):
+                    c.wait()
+            for c in peer_copies(i):
+                c.start()
+            return _
+
+        lax.fori_loop(0, rows, fetch, None)
+
+        # Own-tile publish overlaps the tail of the peer-row DMAs.
+        se_o[:] = block(cv_t[:], cs_t[:], se_t[:], gids)[2]
+
+        def drain(i, _):
+            for c in peer_copies(i):
+                c.wait()
+            return _
+
+        lax.fori_loop(max(0, rows - ring), rows, drain, None)
+
+        peer_ids = src_v[:].reshape(rows)
+        pbv, pbs, _ = block(gv[:], gs[:], ge[:], peer_ids)
+        ts = pbv >> 3
+        pbv = jnp.where((ts > 0) & (ts < now_t - stale_t), 0, pbv)
+        pv_o[:] = pbv.reshape(tile, f, k)
+        ps_o[:] = pbs.reshape(tile, f, k)
+
+    row_block = pl.BlockSpec((tile, k), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    fan_block = pl.BlockSpec((tile, f, k), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM)
+    src_map = lambda i: (i, 0)  # noqa: E731 — shared by SMEM+VMEM views
+    params = jnp.stack([jnp.asarray(now, jnp.int32),
+                        jnp.asarray(stale_ticks, jnp.int32)])
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(n, tile),),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            # src twice: SMEM for scalar DMA addressing, VMEM for the
+            # vectorized tie-rotation seed of the recomputed boards.
+            pl.BlockSpec((tile, f), src_map, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, f), src_map, memory_space=pltpu.VMEM),
+            row_block, row_block, row_block,
+            # The full cache stays addressable for the peer-row DMAs.
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[row_block, fan_block, fan_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, k), jnp.int8),
+            jax.ShapeDtypeStruct((n, f, k), jnp.int32),
+            jax.ShapeDtypeStruct((n, f, k), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, k), jnp.int32),
+            pltpu.VMEM((rows, k), jnp.int32),
+            pltpu.VMEM((rows, k), jnp.int8),
+            pltpu.SemaphoreType.DMA((ring, 3)),
+        ],
+        interpret=interpret,
+        name="sidecar_fused_publish_gather",
+    )(params, src, src, cache_val, cache_slot, cache_sent,
+      cache_val, cache_slot, cache_sent)

@@ -9,12 +9,33 @@ catalog — lives on one device, exactly the data locality the reference has
 
 from __future__ import annotations
 
+import inspect
 from typing import Optional, Sequence
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODE_AXIS = "nodes"
+
+# jax moved shard_map out of experimental (and renamed check_rep →
+# check_vma) across the versions this repo meets in the wild; resolve
+# once here so both sharded twins import one spelling.
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.6
+except ImportError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_SHARD_MAP_CHECK_ARG = (
+    "check_vma"
+    if "check_vma" in inspect.signature(_shard_map).parameters
+    else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """Version-portable ``shard_map`` wrapper (see module note)."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs,
+                      **{_SHARD_MAP_CHECK_ARG: check_vma})
 
 
 def make_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:

@@ -47,9 +47,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
-from sidecar_tpu.models.exact import SimParams, SimState
+from sidecar_tpu.models.exact import SimParams, SimState, clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops.merge import merge_packed, staleness_mask, sticky_adjust
@@ -61,7 +60,7 @@ from sidecar_tpu.ops.status import (
 )
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
-from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh
+from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh, shard_map
 
 
 class ShardedSim:
@@ -331,29 +330,39 @@ class ShardedSim:
         self.t.validate_horizon(int(state.round_idx) + 1)
         return self._step_jit(state, key)
 
-    def run(self, state: SimState, key: jax.Array, num_rounds: int):
+    def run(self, state: SimState, key: jax.Array, num_rounds: int,
+            donate: bool = True):
         self.t.validate_horizon(int(state.round_idx) + num_rounds)
+        if not donate:
+            state = clone_state(state)
         return self._run_jit(state, key, num_rounds)
 
-    def run_fast(self, state: SimState, key: jax.Array, num_rounds: int):
+    def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
+                 donate: bool = True):
         self.t.validate_horizon(int(state.round_idx) + num_rounds)
+        if not donate:
+            state = clone_state(state)
         return self._run_fast_jit(state, key, num_rounds)
 
+    # no-donate: single-round stepping is the oracle/replay path — those
+    # callers diff pre- vs post-step states, so the input must survive.
     @functools.partial(jax.jit, static_argnums=0)
     def _step_jit(self, state, key):
         return self._step(state, key)
 
     # Per-round keys fold the round index into the base key so chunked/
-    # resumed runs replay identical randomness (see ExactSim).
+    # resumed runs replay identical randomness (see ExactSim).  The scan
+    # drivers donate their input like every other _run*_jit (the sharded
+    # known/sent blocks are the largest buffers in the process).
 
-    @functools.partial(jax.jit, static_argnums=(0, 3))
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
     def _run_jit(self, state, key, num_rounds):
         def body(st, _):
             st = self._step(st, jax.random.fold_in(key, st.round_idx))
             return st, self.convergence(st)
         return lax.scan(body, state, None, length=num_rounds)
 
-    @functools.partial(jax.jit, static_argnums=(0, 3))
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
     def _run_fast_jit(self, state, key, num_rounds):
         def body(st, _):
             return self._step(st, jax.random.fold_in(key, st.round_idx)), None

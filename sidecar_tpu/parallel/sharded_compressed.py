@@ -86,7 +86,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
 
 from sidecar_tpu.models.compressed import (
     CompressedParams,
@@ -95,7 +94,7 @@ from sidecar_tpu.models.compressed import (
 )
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops.topology import Topology
-from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh
+from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh, shard_map
 
 
 class ShardedCompressedSim(CompressedSim):

@@ -36,7 +36,10 @@ class ScriptedSim:
     def mint(self, state, slots, tick):
         return state
 
-    def run_behind(self, state, key, num_rounds, every):
+    def run_behind(self, state, key, num_rounds, every, donate=True,
+                   start_round=None):
+        # donate/start_round: the pipelined driver contract (PR 3);
+        # a scripted dict has no device buffers, both are no-ops here.
         rounds = np.arange(state["round"] + every,
                            state["round"] + num_rounds + 1, every)
         behind = np.asarray([self.schedule(r) for r in rounds],
@@ -97,3 +100,73 @@ class TestCrossingDetection:
         # First sample at/after round 30 on the 25-cadence is round 50.
         assert out["rounds_to_eps"] == 50
         assert out["rounds_to_eps_unsettled"] == 50
+
+
+class TestDeviceInitFailure:
+    """PR 3 satellite: a dead pinned backend must cost bounded time and
+    still emit ONE parseable JSON record (BENCH_r05 burned the whole
+    driver timeout in unbounded 60 s retries and produced no output)."""
+
+    def test_bounded_retries_then_json_error_record(self, monkeypatch,
+                                                    capsys):
+        import json
+
+        import jax
+
+        import bench
+
+        calls = []
+        sleeps = []
+
+        def dead_devices(*a, **k):
+            calls.append(1)
+            raise RuntimeError("tunnel worker unavailable")
+
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("BENCH_INIT_ATTEMPTS", "3")
+        monkeypatch.setattr(jax, "devices", dead_devices)
+        monkeypatch.setattr(bench.time, "sleep",
+                            lambda s: sleeps.append(s))
+
+        try:
+            bench.main()
+            raised = None
+        except SystemExit as exc:
+            raised = exc
+        assert raised is not None and raised.code == 1
+        assert len(calls) == 3                      # bounded attempts
+        assert sleeps and max(sleeps) <= 15         # short backoff
+        record = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert record["error"] == "device_init_failed"
+        assert record["attempts"] == 3
+        assert "tunnel worker unavailable" in record["message"]
+
+    def test_cpu_pin_fails_fast_without_retry(self, monkeypatch,
+                                              capsys):
+        import json
+
+        import jax
+
+        import bench
+
+        calls = []
+
+        def dead_devices(*a, **k):
+            calls.append(1)
+            raise RuntimeError("no backend")
+
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.delenv("BENCH_INIT_ATTEMPTS", raising=False)
+        monkeypatch.setattr(jax, "devices", dead_devices)
+
+        try:
+            bench.main()
+            code = None
+        except SystemExit as exc:
+            code = exc.code
+        assert code == 1
+        assert len(calls) == 1                      # no retry on cpu pin
+        record = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert record["error"] == "device_init_failed"

@@ -114,3 +114,25 @@ class TestBridgeServer:
             assert err.value.code == 400
         finally:
             server.shutdown()
+
+
+class TestChunkedPipeline:
+    """PR 3: long simulate() requests are split into pipelined donated
+    chunks (SimBridge.CHUNK_ROUNDS).  Chunking must be bit-invisible:
+    same convergence curve, projection, eps round and delta stream as
+    one dispatch (fold-in PRNG keys make chunking exact)."""
+
+    def test_chunked_equals_single_dispatch(self):
+        single = SimBridge(make_state(), CFG).simulate(
+            rounds=20, seed=3, deltas_cap=50, cold_nodes=["h2"])
+        chunked_bridge = SimBridge(make_state(), CFG)
+        chunked_bridge.CHUNK_ROUNDS = 7     # force 7+7+6 chunks
+        chunked = chunked_bridge.simulate(
+            rounds=20, seed=3, deltas_cap=50, cold_nodes=["h2"])
+        assert chunked.convergence == single.convergence
+        assert chunked.projected == single.projected
+        assert chunked.eps_round == single.eps_round
+        assert chunked.deltas == single.deltas
+        # Absolute round numbering across chunk boundaries.
+        assert [d["round"] for d in chunked.deltas] == \
+            list(range(1, 21))

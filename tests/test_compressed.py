@@ -153,7 +153,9 @@ class TestQuietRefresh:
         p = CompressedParams(n=128, services_per_node=10, cache_lines=256)
         sim = CompressedSim(p, topology.complete(128), PINNED)
         st0 = sim.init_state()
-        st, conv = sim.run(st0, jax.random.PRNGKey(0), 120)
+        # donate=False: st0 is the comparison baseline below.
+        st, conv = sim.run(st0, jax.random.PRNGKey(0), 120,
+                           donate=False)
         assert (np.asarray(conv) == 1.0).all()
         np.testing.assert_array_equal(np.asarray(st.own),
                                       np.asarray(st0.own))
@@ -264,7 +266,8 @@ class TestProtocolSemantics:
         sim = CompressedSim(p, topology.complete(32), PINNED)
         st = mint_random(sim, sim.init_state(), 10, 10, seed=2)
         key = jax.random.PRNGKey(7)
-        full = sim.run_fast(st, key, 30)
+        # donate=False: st is dispatched twice (donating drivers).
+        full = sim.run_fast(st, key, 30, donate=False)
         half = sim.run_fast(sim.run_fast(st, key, 13), key, 17)
         for f in ("own", "cache_slot", "cache_val", "cache_sent", "floor"):
             np.testing.assert_array_equal(
@@ -310,8 +313,9 @@ class TestMetricFastPath:
         sim = CompressedSim(p, topology.complete(128), PINNED)
         st = mint_random(sim, sim.init_state(), 60, 10, seed=3)
         for rounds in (0, 7, 23, 60):
-            run = sim.run_fast(st, jax.random.PRNGKey(4), rounds) \
-                if rounds else st
+            # donate=False: st is re-dispatched each iteration.
+            run = sim.run_fast(st, jax.random.PRNGKey(4), rounds,
+                               donate=False) if rounds else st
             got = float(sim.convergence(run))
             want = self._exact_metric(sim, run)
             np.testing.assert_allclose(got, want, rtol=0, atol=1e-6,
@@ -387,7 +391,8 @@ class TestMetricPathEquality:
         sim = CompressedSim(p, topo, PINNED)
         st = mint_random(sim, sim.init_state(), 100, 10, seed=9)
         for rounds in (3, 9, 30):
-            st2 = sim.run_fast(st, jax.random.PRNGKey(2), rounds)
+            st2 = sim.run_fast(st, jax.random.PRNGKey(2), rounds,
+                               donate=False)
             vals = self._behind_all_paths(p, st2, topo)
             assert vals["list"] == vals["gather"], (rounds, vals)
 

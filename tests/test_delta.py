@@ -218,7 +218,9 @@ class TestCompressedModelVsOracle:
         key = jax.random.PRNGKey(seed)
         rounds = 5
         cap = params.n * params.m
-        final, batches = sim.run_with_deltas(state, key, rounds, cap)
+        # donate=False: the stepwise replay below re-reads ``state``.
+        final, batches = sim.run_with_deltas(state, key, rounds, cap,
+                                             donate=False)
 
         st = state
         for r in range(rounds):

@@ -1,0 +1,198 @@
+"""Donation-safety regression suite.
+
+The ``_run*_jit`` drivers donate their input state (PR 3): the belief
+tensors are rewritten in place across chunked dispatches instead of
+double-buffered.  The safety contract has two halves, both pinned here:
+
+* after a donated run chunk, the INPUT state's buffers are deleted and
+  any access RAISES — silent use-after-donate must be impossible;
+* the drivers themselves never reuse a donated input (chunked chains,
+  ``donate=False`` copies, and the chaos metrics snapshot all keep
+  working), and a donated chunked chain is bit-identical to a straight
+  run — donation changes memory behavior, never results.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams, clone_state
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology
+
+FAST = TimeConfig(refresh_interval_s=10_000.0)
+
+
+def _deleted(arr) -> bool:
+    return arr.is_deleted()
+
+
+def _assert_access_raises(arr):
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(arr)
+
+
+class TestExactDonation:
+    def make(self):
+        p = SimParams(n=8, services_per_node=3, fanout=2, budget=6)
+        sim = ExactSim(p, topology.complete(8), FAST)
+        return sim, sim.init_state()
+
+    def test_run_donates_and_access_raises(self):
+        sim, st = self.make()
+        out, _ = sim.run(st, jax.random.PRNGKey(0), 5)
+        assert _deleted(st.known) and _deleted(st.sent)
+        _assert_access_raises(st.known)
+        # The OUTPUT is alive and usable.
+        assert int(out.round_idx) == 5
+
+    def test_run_fast_and_deltas_donate(self):
+        sim, st = self.make()
+        out = sim.run_fast(st, jax.random.PRNGKey(0), 4)
+        assert _deleted(st.known)
+        out2, batches, conv = sim.run_with_deltas(
+            out, jax.random.PRNGKey(0), 3, cap=sim.p.n * sim.p.m)
+        assert _deleted(out.known)
+        assert int(out2.round_idx) == 7
+
+    def test_donate_false_preserves_input_and_results(self):
+        sim, st = self.make()
+        kept, conv_a = sim.run(st, jax.random.PRNGKey(1), 6,
+                               donate=False)
+        assert not _deleted(st.known)   # input survived
+        # Same dispatch WITH donation from the preserved input: results
+        # must be bit-identical (donation is memory-only).
+        donated, conv_b = sim.run(st, jax.random.PRNGKey(1), 6)
+        assert _deleted(st.known)
+        np.testing.assert_array_equal(np.asarray(kept.known),
+                                      np.asarray(donated.known))
+        np.testing.assert_array_equal(np.asarray(conv_a),
+                                      np.asarray(conv_b))
+
+    def test_step_does_not_donate(self):
+        """The oracle/replay path: step() must keep its input alive
+        (cross-validation diffs pre vs post states)."""
+        sim, st = self.make()
+        post = sim.step(st, jax.random.PRNGKey(0))
+        assert not _deleted(st.known)
+        assert int(post.round_idx) == 1
+
+    def test_clone_state_is_independent(self):
+        sim, st = self.make()
+        cl = clone_state(st)
+        sim.run_fast(st, jax.random.PRNGKey(0), 3)
+        assert _deleted(st.known) and not _deleted(cl.known)
+        np.testing.assert_array_equal(
+            np.asarray(cl.known), np.asarray(sim.init_state().known))
+
+
+class TestCompressedDonation:
+    def make(self):
+        p = CompressedParams(n=32, services_per_node=4, cache_lines=64)
+        sim = CompressedSim(p, topology.complete(32), FAST)
+        st = sim.mint(sim.init_state(),
+                      jnp.arange(10, dtype=jnp.int32) * 3, 10)
+        return sim, st
+
+    def test_all_run_drivers_donate(self):
+        sim, st = self.make()
+        key = jax.random.PRNGKey(0)
+        st1, _ = sim.run(st, key, 4)
+        assert _deleted(st.cache_val) and _deleted(st.own) \
+            and _deleted(st.floor)
+        _assert_access_raises(st.cache_val)
+        st2, _ = sim.run_behind(st1, key, 4)
+        assert _deleted(st1.cache_val)
+        st3 = sim.run_fast(st2, key, 4)
+        assert _deleted(st2.cache_val)
+        st4, _ = sim.run_with_deltas(st3, key, 2, cap=sim.p.n * sim.p.m)
+        assert _deleted(st3.cache_val)
+        assert int(st4.round_idx) == 14
+
+    def test_donated_chunked_chain_equals_straight_run(self):
+        """The bench/bridge pipeline shape: chunked dispatches chained
+        through donated outputs replay the straight run exactly (fold-in
+        PRNG + donation changes nothing observable)."""
+        sim, st = self.make()
+        key = jax.random.PRNGKey(7)
+        straight = sim.run_fast(st, key, 30, donate=False)
+        chunked = st
+        done = 0
+        for chunk in (10, 10, 10):
+            chunked = sim.run_fast(chunked, key, chunk)
+            done += chunk
+        for f in ("own", "cache_slot", "cache_val", "cache_sent",
+                  "floor"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(straight, f)),
+                np.asarray(getattr(chunked, f)), err_msg=f)
+
+    def test_start_round_skips_device_read(self):
+        """Pipelined callers pass start_round; the horizon check must
+        accept it without touching the (possibly in-flight) state and
+        still reject horizon overruns."""
+        sim, st = self.make()
+        out, _ = sim.run_behind(st, jax.random.PRNGKey(0), 4,
+                                start_round=0)
+        with pytest.raises(ValueError, match="horizon|tick"):
+            sim.run_behind(out, jax.random.PRNGKey(0), 4,
+                           start_round=10 ** 9)
+
+    def test_mutating_donated_state_fields_raises(self):
+        """Even through dataclasses.replace, a donated buffer read
+        must raise — the guard against drivers resurrecting inputs."""
+        sim, st = self.make()
+        sim.run_fast(st, jax.random.PRNGKey(0), 3)
+        ghost = dataclasses.replace(st, round_idx=jnp.zeros((), jnp.int32))
+        _assert_access_raises(ghost.cache_val)
+
+
+class TestShardedDonation:
+    def test_sharded_compressed_run_donates(self):
+        from sidecar_tpu.parallel.sharded_compressed import (
+            ShardedCompressedSim,
+        )
+        p = CompressedParams(n=64, services_per_node=4, cache_lines=32)
+        sim = ShardedCompressedSim(p, topology.complete(64), FAST)
+        st = sim.mint(sim.init_state(),
+                      jnp.arange(8, dtype=jnp.int32) * 5, 10)
+        out, _ = sim.run(st, jax.random.PRNGKey(0), 4)
+        assert _deleted(st.cache_val) and _deleted(st.own)
+        _assert_access_raises(st.cache_val)
+        out2 = sim.run_fast(out, jax.random.PRNGKey(0), 4)
+        assert _deleted(out.cache_val)
+        assert int(out2.round_idx) == 8
+
+    def test_sharded_exact_run_donates(self):
+        from sidecar_tpu.parallel.sharded import ShardedSim
+        p = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        sim = ShardedSim(p, topology.complete(16), FAST)
+        st = sim.init_state()
+        out, _ = sim.run(st, jax.random.PRNGKey(0), 4)
+        assert _deleted(st.known)
+        out2 = sim.run_fast(out, jax.random.PRNGKey(0), 4,
+                            donate=False)
+        assert not _deleted(out.known)
+        assert int(out2.round_idx) == 8
+
+
+class TestChaosDonation:
+    def test_chaos_run_snapshots_counters_before_donating(self):
+        """ChaosExactSim.run publishes injection-count DELTAS; with
+        donation it must read the input's counters before dispatch
+        rather than after (use-after-donate)."""
+        from sidecar_tpu.chaos.plan import EdgeFault, FaultPlan
+        from sidecar_tpu.chaos.sim_inject import ChaosExactSim
+        plan = FaultPlan(seed=3, edges=(EdgeFault(drop_prob=0.5),))
+        p = SimParams(n=8, services_per_node=2, fanout=2, budget=4)
+        sim = ChaosExactSim(p, topology.complete(8), FAST, plan=plan)
+        st = sim.init_state()
+        out, _ = sim.run(st, jax.random.PRNGKey(0), 6)
+        assert int(out.sim.round_idx) == 6
+        out2 = sim.run_fast(out, jax.random.PRNGKey(0), 6)
+        assert int(out2.sim.round_idx) == 12

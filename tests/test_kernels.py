@@ -1,0 +1,274 @@
+"""Bit-identity contract of the fused Pallas publish/board kernels
+(sidecar_tpu/ops/kernels) against the XLA reference path.
+
+On CPU the kernels run under ``pallas_call(interpret=True)`` — the same
+kernel program the TPU compiles, executed by the Pallas interpreter —
+so this suite pins the KERNEL LOGIC, and the TPU run only has to trust
+Mosaic's lowering of ops the parity suite already exercised.
+
+Shapes are chosen adversarially: row counts that don't divide the
+kernel row tile, tiny and wide cache widths, tie-heavy bursts (every
+value equal — the rotated-prefix-sum admission path), all-ineligible
+rows, tombstone-only rows, and empty caches.  All comparisons are
+``assert_array_equal`` — the contract is bit-identity, not tolerance.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops.kernels.publish_gather import (
+    fused_publish_gather_pallas,
+    fused_publish_gather_xla,
+    publish_board_pallas,
+    publish_board_xla,
+)
+from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, pack
+
+pytestmark = pytest.mark.pallas
+
+PINNED = TimeConfig(refresh_interval_s=10_000.0)
+
+
+def _random_cache(rng, n, k, *, occupancy=0.7, tie_value=None,
+                  sent_ceiling=8, status=ALIVE):
+    """A plausible cache triple: packed values, slot ids, transmit
+    counts.  ``tie_value`` pins EVERY occupied value (the tie-herd
+    shape); ``status`` packs a status code into every record."""
+    if tie_value is not None:
+        ts = np.full((n, k), tie_value, dtype=np.int64)
+    else:
+        ts = rng.integers(1, 1 << 20, (n, k), dtype=np.int64)
+    cv = ((ts << 3) | status).astype(np.int32)
+    occupied = rng.random((n, k)) < occupancy
+    cs = np.where(occupied, rng.integers(0, n * 8, (n, k)), -1)
+    cv = np.where(cs >= 0, cv, 0)
+    se = rng.integers(0, sent_ceiling, (n, k)).astype(np.int8)
+    return (jnp.asarray(cv, jnp.int32), jnp.asarray(cs, jnp.int32),
+            jnp.asarray(se, jnp.int8))
+
+
+def _assert_board_parity(cv, cs, se, *, budget, limit, fanout, k,
+                         row_offset=0):
+    ref = publish_board_xla(cv, cs, se, budget=budget, limit=limit,
+                            fanout=fanout, cache_lines=k,
+                            row_offset=row_offset)
+    got = publish_board_pallas(cv, cs, se, budget=budget, limit=limit,
+                               fanout=fanout, cache_lines=k,
+                               row_offset=row_offset, interpret=True)
+    for name, a, b in zip(("bval", "bslot", "sent"), ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+
+
+class TestPublishBoardParity:
+    @pytest.mark.parametrize("n,k", [(7, 8), (20, 64), (33, 16),
+                                     (64, 256), (130, 32)])
+    def test_random_shapes(self, n, k):
+        """Ragged row counts (none divide the row tile evenly at every
+        width) and cache widths below/above one TPU lane register."""
+        rng = np.random.default_rng(n * 1000 + k)
+        cv, cs, se = _random_cache(rng, n, k)
+        _assert_board_parity(cv, cs, se, budget=5, limit=6, fanout=3,
+                             k=k)
+
+    def test_tie_heavy_burst(self):
+        """A cold-start-shaped burst: every occupied record at ONE tick
+        — selection is decided entirely by the rotated prefix-sum tie
+        rank, the most order-sensitive path in the kernel."""
+        rng = np.random.default_rng(0)
+        cv, cs, se = _random_cache(rng, 50, 32, occupancy=1.0,
+                                   tie_value=17, sent_ceiling=2)
+        _assert_board_parity(cv, cs, se, budget=6, limit=6, fanout=3,
+                             k=32)
+
+    def test_all_ineligible_rows(self):
+        """sent >= limit everywhere: empty boards on both paths."""
+        rng = np.random.default_rng(1)
+        cv, cs, se = _random_cache(rng, 19, 16)
+        se = jnp.full_like(se, 100)
+        _assert_board_parity(cv, cs, se, budget=4, limit=6, fanout=2,
+                             k=16)
+        bval, bslot, _ = publish_board_pallas(
+            cv, cs, se, budget=4, limit=6, fanout=2, cache_lines=16)
+        assert int(jnp.sum(bval)) == 0
+        assert bool(jnp.all(bslot == -1))
+
+    def test_tombstone_only_rows(self):
+        """Tombstones are ordinary packed records on the wire (they
+        gossip like anything else); the selection must treat them
+        identically on both paths."""
+        rng = np.random.default_rng(2)
+        cv, cs, se = _random_cache(rng, 21, 32, status=TOMBSTONE)
+        _assert_board_parity(cv, cs, se, budget=5, limit=8, fanout=3,
+                             k=32)
+
+    def test_empty_cache(self):
+        n, k = 12, 16
+        cv = jnp.zeros((n, k), jnp.int32)
+        cs = jnp.full((n, k), -1, jnp.int32)
+        se = jnp.zeros((n, k), jnp.int8)
+        _assert_board_parity(cv, cs, se, budget=5, limit=6, fanout=3,
+                             k=k)
+
+    def test_row_offset_matches(self):
+        """The tie rotation follows GLOBAL node identity (sharded
+        shards pass their block offset)."""
+        rng = np.random.default_rng(3)
+        cv, cs, se = _random_cache(rng, 24, 32, tie_value=9,
+                                   occupancy=1.0, sent_ceiling=2)
+        _assert_board_parity(cv, cs, se, budget=4, limit=6, fanout=2,
+                             k=32, row_offset=13)
+
+    def test_budget_wider_than_cache(self):
+        rng = np.random.default_rng(4)
+        cv, cs, se = _random_cache(rng, 9, 8)
+        _assert_board_parity(cv, cs, se, budget=64, limit=6, fanout=2,
+                             k=8)
+
+
+class TestFusedGatherParity:
+    @pytest.mark.parametrize("n,k,f", [(20, 16, 3), (33, 64, 2),
+                                       (7, 8, 4)])
+    def test_random(self, n, k, f):
+        rng = np.random.default_rng(n + k + f)
+        cv, cs, se = _random_cache(rng, n, k)
+        src = jnp.asarray(rng.integers(0, n, (n, f)), jnp.int32)
+        now, stale = 1 << 19, 1 << 18
+        kw = dict(stale_ticks=stale, budget=5, limit=6, fanout=f,
+                  cache_lines=k)
+        ref = fused_publish_gather_xla(cv, cs, se, src, now, **kw)
+        got = fused_publish_gather_pallas(cv, cs, se, src, now, **kw)
+        for name, a, b in zip(("sent", "pv", "ps"), ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+
+    def test_staleness_gate_fires_identically(self):
+        """Records straddling the staleness horizon: the fused kernel
+        applies the board filter before "gathering", like the XLA
+        path's filter-then-gather."""
+        rng = np.random.default_rng(7)
+        n, k, f = 16, 16, 3
+        ts = rng.integers(1, 100, (n, k), dtype=np.int64)
+        cv = jnp.asarray((ts << 3) | ALIVE, jnp.int32)
+        cs = jnp.asarray(rng.integers(0, n * 4, (n, k)), jnp.int32)
+        se = jnp.zeros((n, k), jnp.int8)
+        src = jnp.asarray(rng.integers(0, n, (n, f)), jnp.int32)
+        now, stale = 90, 40   # ts in [1, 50) is stale, rest fresh
+        kw = dict(stale_ticks=stale, budget=6, limit=6, fanout=f,
+                  cache_lines=k)
+        ref = fused_publish_gather_xla(cv, cs, se, src, now, **kw)
+        got = fused_publish_gather_pallas(cv, cs, se, src, now, **kw)
+        for name, a, b in zip(("sent", "pv", "ps"), ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+        # Premise: the gate actually fired somewhere.
+        assert int(jnp.sum(ref[1] == 0)) > 0
+
+
+def _mint_burst(sim, n_slots, seed):
+    rng = np.random.default_rng(seed)
+    slots = np.sort(rng.choice(sim.p.m, size=n_slots, replace=False))
+    return sim.mint(sim.init_state(), jnp.asarray(slots, jnp.int32), 10)
+
+
+class TestModelLockstep:
+    """The whole-model contract: a CompressedSim built under
+    SIDECAR_TPU_KERNELS=pallas runs LOCKSTEP bit-identical to one built
+    under =xla — same states, every field, across rounds that exercise
+    publish, pull-merge, announce, push-pull and the census sweep."""
+
+    def _run_pair(self, monkeypatch, n=32, k=64, rounds=40, spn=4):
+        states = {}
+        for mode in ("xla", "pallas"):
+            monkeypatch.setenv(kernel_ops.ENV_VAR, mode)
+            p = CompressedParams(n=n, services_per_node=spn,
+                                 cache_lines=k)
+            sim = CompressedSim(p, topology.complete(n), PINNED)
+            assert sim._kernels == mode
+            st = _mint_burst(sim, 3 * n // 2, seed=5)
+            states[mode] = sim.run_fast(st, jax.random.PRNGKey(3),
+                                        rounds)
+        return states
+
+    def test_lockstep_bit_identical(self, monkeypatch):
+        states = self._run_pair(monkeypatch)
+        for f in ("own", "cache_slot", "cache_val", "cache_sent",
+                  "floor", "node_alive", "round_idx", "evictions"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(states["xla"], f)),
+                np.asarray(getattr(states["pallas"], f)), err_msg=f)
+
+    def test_publish_only_kernel_lockstep(self, monkeypatch):
+        """SIDECAR_TPU_FUSED_GATHER=0: the degraded pallas form
+        (publish kernel + XLA gather) is equally bit-identical."""
+        monkeypatch.setenv(kernel_ops.ENV_FUSED, "0")
+        states = self._run_pair(monkeypatch, rounds=25)
+        for f in ("cache_val", "cache_slot", "floor"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(states["xla"], f)),
+                np.asarray(getattr(states["pallas"], f)), err_msg=f)
+
+
+class TestShardedLockstep:
+    def test_sharded_conv_curve_identical(self, monkeypatch):
+        """The sharded twin inherits the pallas publish kernel inside
+        shard_map; its convergence trajectory must match the xla
+        build exactly."""
+        from sidecar_tpu.parallel.sharded_compressed import (
+            ShardedCompressedSim,
+        )
+        curves = {}
+        for mode in ("xla", "pallas"):
+            monkeypatch.setenv(kernel_ops.ENV_VAR, mode)
+            p = CompressedParams(n=64, services_per_node=4,
+                                 cache_lines=32)
+            sim = ShardedCompressedSim(p, topology.complete(64), PINNED)
+            assert sim._kernels == mode
+            st = _mint_burst(sim, 12, seed=13)
+            _, conv = sim.run(st, jax.random.PRNGKey(0), 20)
+            curves[mode] = np.asarray(conv)
+        np.testing.assert_array_equal(curves["xla"], curves["pallas"])
+
+
+class TestSelection:
+    def test_env_validation(self, monkeypatch):
+        monkeypatch.setenv(kernel_ops.ENV_VAR, "cuda")
+        with pytest.raises(ValueError, match="SIDECAR_TPU_KERNELS"):
+            kernel_ops.resolve_path(record=False)
+
+    def test_auto_is_xla_off_tpu(self, monkeypatch):
+        monkeypatch.delenv(kernel_ops.ENV_VAR, raising=False)
+        path, interpret = kernel_ops.resolve_path(record=False)
+        assert path == "xla" and interpret  # CPU test environment
+
+    def test_path_metric_recorded(self, monkeypatch):
+        from sidecar_tpu import metrics
+        monkeypatch.setenv(kernel_ops.ENV_VAR, "pallas")
+        before = metrics.counter("kernels.path.pallas")
+        p = CompressedParams(n=8, services_per_node=2, cache_lines=16,
+                             budget=4)
+        CompressedSim(p, topology.complete(8), PINNED)
+        assert metrics.counter("kernels.path.pallas") == before + 1
+        assert metrics.snapshot()["gauges"]["kernels.pallas_active"] == 1.0
+
+    def test_cache_width_mismatch_rejected(self):
+        cv = jnp.zeros((4, 8), jnp.int32)
+        cs = jnp.full((4, 8), -1, jnp.int32)
+        se = jnp.zeros((4, 8), jnp.int8)
+        with pytest.raises(ValueError, match="cache_lines"):
+            publish_board_pallas(cv, cs, se, budget=2, limit=4,
+                                 fanout=2, cache_lines=16)
+
+    def test_env_untouched_by_suite(self):
+        """Guard: the suite must not leak a forced mode into the rest
+        of tier-1 (monkeypatch restores; this asserts it)."""
+        assert os.environ.get(kernel_ops.ENV_VAR) in (None, "", "auto")

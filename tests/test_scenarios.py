@@ -136,7 +136,7 @@ class TestCheckpoint:
                       jnp.arange(8, dtype=jnp.int32) * 3, 10)
         key = jax.random.PRNGKey(11)
 
-        straight = sim.run_fast(st, key, 30)
+        straight = sim.run_fast(st, key, 30, donate=False)
 
         half = sim.run_fast(st, key, 14)
         save_state(tmp_path / "c.npz", half, sim.p)

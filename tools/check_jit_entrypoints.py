@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Static check: every ``@jax.jit`` scan driver in ``sidecar_tpu/``
+either donates its state (``donate_argnums``) or carries an explicit
+``# no-donate:`` justification.
+
+Why this exists (PR 3): threading ``donate_argnums`` through the
+``_run*_jit`` entry points stops the ~100 MB belief tensors from being
+double-buffered across chunked dispatches — HBM headroom that directly
+raises max N per chip.  The failure mode this guards against is silent:
+a NEW scan driver added without donation compiles, runs, and quietly
+costs a full extra copy of the state; nothing in the test suite would
+notice.  So tier-1 runs this check (tests/test_jit_entrypoints.py) and
+fails the build instead.
+
+A "scan driver" is a function decorated with ``jax.jit`` (directly or
+via ``functools.partial(jax.jit, ...)``) whose body calls
+``lax.scan``/``jax.lax.scan``.  To opt a driver out, put a comment
+containing ``# no-donate: <reason>`` in the decorator/body source or
+immediately above the decorator.
+
+Usage: ``python tools/check_jit_entrypoints.py [root]`` — exits 0 when
+clean, 1 with a per-offender report otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+NO_DONATE_TAG = "# no-donate:"
+
+
+def _is_jit_decorator(node: ast.expr) -> bool:
+    """Matches ``@jax.jit``, ``@jit``, and
+    ``@functools.partial(jax.jit, ...)`` / ``@partial(jit, ...)``."""
+
+    def names_jit(expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Attribute):
+            return expr.attr == "jit"
+        if isinstance(expr, ast.Name):
+            return expr.id == "jit"
+        return False
+
+    if names_jit(node):
+        return True
+    if isinstance(node, ast.Call):
+        fn = node.func
+        is_partial = (isinstance(fn, ast.Attribute) and fn.attr == "partial") \
+            or (isinstance(fn, ast.Name) and fn.id == "partial")
+        if is_partial and node.args and names_jit(node.args[0]):
+            return True
+        # jax.jit(...) called directly as a decorator factory
+        if names_jit(fn):
+            return True
+    return False
+
+
+def _declares_donation(node: ast.expr) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    return any(kw.arg in ("donate_argnums", "donate_argnames")
+               for kw in node.keywords)
+
+
+def _calls_scan(fn: ast.AST) -> bool:
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            callee = sub.func
+            if isinstance(callee, ast.Attribute) and callee.attr == "scan":
+                return True
+            if isinstance(callee, ast.Name) and callee.id == "scan":
+                return True
+    return False
+
+
+def _has_waiver(src_lines: list[str], fn: ast.FunctionDef) -> bool:
+    """``# no-donate:`` anywhere in the function's source span or in the
+    3 lines above its first decorator."""
+    first = min([d.lineno for d in fn.decorator_list] + [fn.lineno])
+    lo = max(0, first - 1 - 3)
+    hi = fn.end_lineno or fn.lineno
+    return any(NO_DONATE_TAG in line for line in src_lines[lo:hi])
+
+
+def check_tree(root: pathlib.Path) -> list[str]:
+    """Returns a list of violation strings (empty = clean)."""
+    problems = []
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        src = path.read_text()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError as exc:  # pragma: no cover - broken file
+            problems.append(f"{path}: unparseable ({exc})")
+            continue
+        lines = src.splitlines()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jit_decs = [d for d in node.decorator_list
+                        if _is_jit_decorator(d)]
+            if not jit_decs or not _calls_scan(node):
+                continue
+            if any(_declares_donation(d) for d in jit_decs):
+                continue
+            if _has_waiver(lines, node):
+                continue
+            problems.append(
+                f"{path}:{node.lineno}: jitted scan driver "
+                f"'{node.name}' neither declares donate_argnums nor "
+                f"carries a '{NO_DONATE_TAG} <reason>' comment")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    root = pathlib.Path(argv[1]) if len(argv) > 1 else \
+        pathlib.Path(__file__).resolve().parent.parent / "sidecar_tpu"
+    problems = check_tree(root)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} undonated jitted scan driver(s) — donate "
+              f"the state or justify with '{NO_DONATE_TAG} <reason>'",
+              file=sys.stderr)
+        return 1
+    print(f"check_jit_entrypoints: OK ({root})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
