@@ -163,10 +163,13 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
         from sidecar_tpu.parallel.sharded_compressed import (
             ShardedCompressedSim,
         )
+        # Exchange selection: BENCH_BOARD_EXCHANGE (bench-local
+        # override) > SIDECAR_TPU_BOARD_EXCHANGE > all_gather — the
+        # same env contract the sim constructor resolves
+        # (docs/sharding.md).
         sim = ShardedCompressedSim(
             params, topo, cfg,
-            board_exchange=os.environ.get("BENCH_BOARD_EXCHANGE",
-                                          "all_gather"))
+            board_exchange=os.environ.get("BENCH_BOARD_EXCHANGE") or None)
     else:
         sim = CompressedSim(params, topo, cfg)
     rng = np.random.default_rng(7)
@@ -279,11 +282,13 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
         # No silent caps: an all_to_all run with bucket overflows must
         # be distinguishable from a drop-free one.  Read off the LAST
         # dispatched state — the input ``state`` was donated into the
-        # pipeline (may include one speculative chunk's drops).
+        # pipeline (may include one speculative chunk's drops).  The
+        # sync also publishes the count as parallel.exchange.overflow.
         out["devices"] = len(jax.devices())
         out["board_exchange"] = sim.board_exchange
         out["a2a_slack"] = sim.a2a_slack
-        out["dropped_pulls"] = int(jax.device_get(pend_state.dropped))
+        out["exchange_bytes_per_round"] = sim.exchange_bytes_per_round
+        out["dropped_pulls"] = sim.sync_exchange_metrics(pend_state)
     if note:
         out["note"] = note
     return out
@@ -399,7 +404,8 @@ def main() -> None:
         # BENCH_SHARDED=1: the same north star on the sharded twin over
         # EVERY attached device (jax.sharding.Mesh) — on a v5e-8 this
         # is the real 8-chip target run in one command; board exchange
-        # via BENCH_BOARD_EXCHANGE (all_gather | all_to_all).
+        # via SIDECAR_TPU_BOARD_EXCHANGE / BENCH_BOARD_EXCHANGE
+        # (all_gather | all_to_all | ring — docs/sharding.md).
         north_star_sharded = None
         if os.environ.get("BENCH_SHARDED"):
             north_star_sharded = _bench_north_star(

@@ -104,9 +104,17 @@ def cpu_mesh_collectives():
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    from jax import lax, shard_map
+    from jax import lax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     import numpy as np
+
+    # Version-portable shard_map (jax moved it out of experimental —
+    # same shim the sharded twins use).
+    from sidecar_tpu.parallel.mesh import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
 
     d = 8
     mesh = Mesh(np.asarray(jax.devices()[:d]), ("x",))
@@ -134,6 +142,31 @@ def cpu_mesh_collectives():
         return shard_map(f, mesh=mesh, in_specs=P("x"),
                          out_specs=P("x"))(v)
 
+    # The ring exchange's collective: d-1 ppermute hops of one [nl, K]
+    # block (the board_exchange="ring" schedule, docs/sharding.md) —
+    # the streamed alternative to replicating the whole board.
+    perm = [(i, (i - 1) % d) for i in range(d)]
+
+    def ring(v):
+        def f(vl):
+            buf = vl
+            acc = vl
+            for _ in range(d - 1):
+                buf = lax.ppermute(buf, "x", perm)
+                acc = acc + buf[0, 0]
+            return acc
+        return shard_map(f, mesh=mesh, in_specs=P("x"),
+                         out_specs=P("x"))(v)
+
+    ag_ms = timed(ag, x)
+    a2a_ms = timed(a2a, y)
+    ring_ms = timed(ring, x)
+    # Per-device receive payloads, for the per-byte comparison: the
+    # all_gather receives the other shards' blocks ((d-1)/d of the
+    # board), the a2a its bucketed responses, the ring d-1 blocks.
+    ag_mb = BOARD_BYTES * (d - 1) / d / 1e6
+    a2a_mb = d * C * K * 4 / 1e6
+    ring_mb = (d - 1) * (N // d) * K * 4 / 1e6
     return {
         "what": "the twin's board-exchange collectives over the "
                 "virtual 8-device CPU mesh — STRUCTURAL evidence "
@@ -141,8 +174,14 @@ def cpu_mesh_collectives():
         "devices": d,
         "board_mb": round(BOARD_BYTES / 1e6, 1),
         "a2a_payload_mb": round(d * d * C * K * 4 / 1e6, 1),
-        "cpu_mesh_all_gather_ms": round(timed(ag, x), 3),
-        "cpu_mesh_all_to_all_ms": round(timed(a2a, y), 3),
+        "cpu_mesh_all_gather_ms": round(ag_ms, 3),
+        "cpu_mesh_all_to_all_ms": round(a2a_ms, 3),
+        "cpu_mesh_ppermute_ring_ms": round(ring_ms, 3),
+        "cpu_mesh_ms_per_recv_mb": {
+            "all_gather": round(ag_ms / ag_mb, 4),
+            "all_to_all": round(a2a_ms / a2a_mb, 4),
+            "ppermute_ring": round(ring_ms / ring_mb, 4),
+        },
     }
 
 

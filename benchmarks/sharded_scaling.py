@@ -1,17 +1,34 @@
-"""d-scaling evidence for the sharded compressed twin (VERDICT r4 #3b).
+"""d-scaling + comm-overlap evidence for the sharded compressed twin
+(VERDICT r4 #3b; exchange modes + overlap pipeline: RESULTS.md round 7).
 
 Runs the SAME jitted step (ShardedCompressedSim.run_fast) at
 d = 1/2/4/8 over the virtual CPU host platform.  On this bench host all
 virtual "devices" share ONE physical core, so what the curve can and
 does show is TOTAL-WORK CONSERVATION: wall-clock per round stays flat
-as d grows (measured ≤5% overhead at d=8), i.e. sharding introduces no
-hidden serial phase, no superlinear collective blowup, and no
-replicated recompute — per-device work is total/d by SPMD construction.
-Wall-clock SPEEDUP with d requires d real compute units (the v5e-8);
-this curve is the structural half of that projection, the ICI half is
-benchmarks/collectives.py.
+as d grows, i.e. sharding introduces no hidden serial phase, no
+superlinear collective blowup, and no replicated recompute — per-device
+work is total/d by SPMD construction.  Wall-clock SPEEDUP with d
+requires d real compute units (the v5e-8); this curve is the structural
+half of that projection, the ICI half is benchmarks/collectives.py.
+
+Two additions for the split-phase round (docs/sharding.md):
+
+* every ``--exchange`` mode (all_gather | all_to_all | ring) runs
+  through the same harness, and the record carries the mode plus its
+  analytic per-round per-device exchange bytes;
+* ``overlap_exposed_ms`` — the comm time NOT hidden behind compute,
+  measured by differencing the full round against an exchange-stubbed
+  build of the same program (``exchange_stub=True`` consumes only
+  own-shard rows and skips the collectives) at the largest d.  On the
+  shared-core virtual mesh "comm" is memcpy + schedule, so this is a
+  structural bound, not an ICI wall-clock; the value is also published
+  as the ``parallel.overlap.exposed_ms`` gauge.
+
+The final stdout line is ONE machine-parseable JSON record (the
+MULTICHIP_r*.json tail contract).
 
 Run: python benchmarks/sharded_scaling.py [--n 32768] [--rounds 40]
+     [--exchange all_gather|all_to_all|ring]
 """
 
 import argparse
@@ -31,6 +48,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 
+from sidecar_tpu import metrics  # noqa: E402
 from sidecar_tpu.models.compressed import CompressedParams  # noqa: E402
 from sidecar_tpu.models.timecfg import TimeConfig  # noqa: E402
 from sidecar_tpu.ops.topology import erdos_renyi  # noqa: E402
@@ -40,10 +58,13 @@ from sidecar_tpu.parallel.sharded_compressed import (  # noqa: E402
 )
 
 
-def time_at_d(d, params, topo, cfg, slots, rounds, exchange):
-    sim = ShardedCompressedSim(
+def build(d, params, topo, cfg, exchange, stub=False):
+    return ShardedCompressedSim(
         params, topo, cfg, mesh=make_mesh(jax.devices()[:d]),
-        board_exchange=exchange)
+        board_exchange=exchange, exchange_stub=stub)
+
+
+def time_sim(sim, slots, rounds):
     state = sim.mint(sim.init_state(), slots, 10)
     key = jax.random.PRNGKey(0)
     # Warm then chain each rep off the previous output: the drivers
@@ -56,7 +77,7 @@ def time_at_d(d, params, topo, cfg, slots, rounds, exchange):
         state = sim.run_fast(state, key, rounds)
         jax.device_get(state.round_idx)
         best = min(best, time.perf_counter() - t0)
-    return best / rounds * 1000.0
+    return best / rounds * 1000.0, sim.sync_exchange_metrics(state)
 
 
 def main():
@@ -64,7 +85,7 @@ def main():
     ap.add_argument("--n", type=int, default=32768)
     ap.add_argument("--rounds", type=int, default=40)
     ap.add_argument("--exchange", default="all_gather",
-                    choices=["all_gather", "all_to_all"])
+                    choices=["all_gather", "all_to_all", "ring"])
     opts = ap.parse_args()
 
     params = CompressedParams(n=opts.n, services_per_node=10, fanout=3,
@@ -76,11 +97,22 @@ def main():
     slots = np.sort(rng.choice(params.m, size=max(1, params.m // 1000),
                                replace=False)).astype(np.int32)
 
-    curve = {}
+    curve, bytes_by_d, dropped = {}, {}, 0
     for d in (1, 2, 4, 8):
-        curve[str(d)] = round(
-            time_at_d(d, params, topo, cfg, slots, opts.rounds,
-                      opts.exchange), 3)
+        sim = build(d, params, topo, cfg, opts.exchange)
+        ms, drops = time_sim(sim, slots, opts.rounds)
+        curve[str(d)] = round(ms, 3)
+        bytes_by_d[str(d)] = sim.exchange_bytes_per_round
+        dropped += drops
+
+    # Exposed (non-overlapped) comm at the largest d: full round minus
+    # the exchange-stubbed build of the same program.
+    d_max = 8
+    stub_ms, _ = time_sim(build(d_max, params, topo, cfg, opts.exchange,
+                                stub=True), slots, opts.rounds)
+    exposed = max(0.0, curve[str(d_max)] - stub_ms)
+    metrics.set_gauge("parallel.overlap.exposed_ms", round(exposed, 3))
+
     d1 = curve["1"]
     print(json.dumps({
         "what": "sharded-twin ms/round vs device count on a 1-core "
@@ -92,6 +124,10 @@ def main():
         "ms_per_round_by_d": curve,
         "total_work_overhead_vs_d1": {
             d: round(v / d1 - 1.0, 3) for d, v in curve.items()},
+        "exchange_bytes_per_round_per_device_by_d": bytes_by_d,
+        "overlap_exposed_ms_d8": round(exposed, 3),
+        "overlap_stub_ms_per_round_d8": round(stub_ms, 3),
+        "dropped_pulls": dropped,
     }))
 
 

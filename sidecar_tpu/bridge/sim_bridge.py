@@ -68,6 +68,10 @@ class SimulationReport:
     # when the round changed more cells than the cap (the consumer's
     # cue to resync from the projected snapshot).
     deltas: Optional[list] = None
+    # Multi-chip runs (sharded=True): which board exchange the round
+    # used (docs/sharding.md) and how many devices the mesh spanned.
+    board_exchange: Optional[str] = None
+    devices: Optional[int] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -92,13 +96,20 @@ class SimBridge:
 
     # -- state mapping -----------------------------------------------------
 
-    def snapshot(self) -> tuple[SimState, SimParams, BridgeMapping,
-                                ExactSim]:
+    def snapshot(self, sharded: bool = False,
+                 board_exchange: Optional[str] = None
+                 ) -> tuple[SimState, SimParams, BridgeMapping, ExactSim]:
         """Freeze the live catalog into simulator tensors.
 
         Every node starts knowing the full snapshot (the live catalog IS
         the local node's view, already converged from its perspective);
-        callers can blank rows to model cold joiners."""
+        callers can blank rows to model cold joiners.
+
+        ``sharded=True`` builds the node-axis-sharded twin over every
+        attached device instead of the single-chip ExactSim (the
+        catalog's node count must divide the mesh); ``board_exchange``
+        picks its exchange mode (None → SIDECAR_TPU_BOARD_EXCHANGE,
+        docs/sharding.md)."""
         with self.state._lock:
             servers = {h: dict(server.services)
                        for h, server in self.state.servers.items()}
@@ -130,22 +141,40 @@ class SimBridge:
             slots.append(row)
 
         params = SimParams(n=n, services_per_node=spn)
-        sim = ExactSim(params, topo_mod.complete(n), self.t)
+        if sharded:
+            from sidecar_tpu.parallel.sharded import ShardedSim
+            sim = ShardedSim(params, topo_mod.complete(n), self.t,
+                             board_exchange=board_exchange)
+        else:
+            sim = ExactSim(params, topo_mod.complete(n), self.t)
         state = sim.init_state()
         # Overwrite the cold-start rows: every node knows the snapshot.
         known = np.tile(owned_vals.reshape(-1).astype(np.int32), (n, 1))
         state = dataclasses.replace(
-            state, known=jax.numpy.asarray(known))
+            state, known=self._put_known(sim, known))
         mapping = BridgeMapping(hostnames=hostnames, slots=slots,
                                 t0_ns=t0, tick_ns=tick_ns)
         return state, params, mapping, sim
+
+    @staticmethod
+    def _put_known(sim, known: np.ndarray):
+        """Place a host-side belief matrix with the sim's canonical
+        layout (row-sharded on the sharded twin, single-device
+        otherwise)."""
+        arr = jax.numpy.asarray(known.astype(np.int32))
+        row_sharding = getattr(sim, "_row_sharding", None)
+        if row_sharding is not None:
+            arr = jax.device_put(arr, row_sharding)
+        return arr
 
     # -- the RPC -----------------------------------------------------------
 
     def simulate(self, rounds: int, seed: int = 0,
                  cold_nodes: Optional[list[str]] = None,
                  eps: float = 0.01,
-                 deltas_cap: int = 0) -> SimulationReport:
+                 deltas_cap: int = 0,
+                 sharded: bool = False,
+                 board_exchange: Optional[str] = None) -> SimulationReport:
         """Run the catalog forward ``rounds`` gossip rounds.
 
         ``cold_nodes``: hostnames whose knowledge is blanked to their own
@@ -157,8 +186,20 @@ class SimBridge:
         instead of reporting only the terminal projection: each round's
         changed cells are mapped back through the BridgeMapping to
         (hostname, service id, status) triples — the query plane's
-        delta contract applied to simulated futures."""
-        state, params, mapping, sim = self.snapshot()
+        delta contract applied to simulated futures.
+
+        ``sharded=True`` runs the multi-chip twin (node count must
+        divide the device mesh); ``board_exchange`` selects its
+        exchange mode (all_gather | ring; None → the
+        SIDECAR_TPU_BOARD_EXCHANGE env contract, docs/sharding.md).
+        Delta streaming stays single-chip: the two options are
+        mutually exclusive."""
+        if sharded and deltas_cap > 0:
+            raise ValueError(
+                "deltas_cap > 0 is not supported with sharded=True "
+                "(delta extraction runs on the single-chip model)")
+        state, params, mapping, sim = self.snapshot(
+            sharded=sharded, board_exchange=board_exchange)
 
         if cold_nodes:
             known = np.asarray(state.known).copy()
@@ -171,7 +212,7 @@ class SimBridge:
                 known[ni, :] = 0
                 known[ni, ni * spn:(ni + 1) * spn] = own
             state = dataclasses.replace(state,
-                                        known=jax.numpy.asarray(known))
+                                        known=self._put_known(sim, known))
 
         key = jax.random.PRNGKey(seed)
         sizes = []
@@ -244,6 +285,8 @@ class SimBridge:
             node_agreement=node_agreement,
             projected=projected,
             deltas=delta_stream,
+            board_exchange=sim.board_exchange if sharded else None,
+            devices=sim.d if sharded else None,
         )
 
     @staticmethod
@@ -289,7 +332,8 @@ class SimBridge:
 def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                  port: int = 7778,
                  background: bool = True) -> ThreadingHTTPServer:
-    """POST /simulate {"rounds": N, "seed": S, "cold_nodes": [...]}."""
+    """POST /simulate {"rounds": N, "seed": S, "cold_nodes": [...],
+    "sharded": bool, "board_exchange": "all_gather"|"ring"}."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -317,7 +361,9 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                     seed=int(req.get("seed", 0)),
                     cold_nodes=req.get("cold_nodes"),
                     eps=float(req.get("eps", 0.01)),
-                    deltas_cap=int(req.get("deltas_cap", 0)))
+                    deltas_cap=int(req.get("deltas_cap", 0)),
+                    sharded=bool(req.get("sharded", False)),
+                    board_exchange=req.get("board_exchange"))
             except (ValueError, KeyError, TypeError,
                     json.JSONDecodeError) as exc:
                 self._reply(400, {"message": str(exc)})

@@ -441,6 +441,48 @@ class CompressedSim:
         return self._merge_pulled(state, sent, pv, ps, ok, now,
                                   drop_key=drop_key, stale_filtered=True)
 
+    def _fold_pulled(self, cv0, cs0, wv, ws, pv, ps, ok, now, keep=None,
+                     stale_filtered=False):
+        """Fold a GROUP of pulled candidates ``pv``/``ps`` ([nl, G, K])
+        into the running line winners ``(wv, ws)``.
+
+        Every candidate is resolved against the PRE-round cache
+        ``(cv0, cs0)`` — one consistent batch resolution like
+        ops/gossip.prepare_deliveries — and the lex-max accumulation is
+        a true max over the (val, slot) total order, so candidate
+        groups may be folded in ANY order (the split-phase sharded
+        round folds own-shard rows while remote rows are still in
+        flight; see docs/sharding.md) without changing the result.
+        ``keep`` is a pre-drawn ``drop_prob`` keep-mask slice (the
+        caller draws ONE mask over the full candidate set so splitting
+        groups never changes the PRNG stream)."""
+        pv = jnp.where(ok[:, :, None], pv, 0)
+        if keep is not None:
+            pv = jnp.where(keep, pv, 0)
+        if not stale_filtered:
+            pv = jnp.where(staleness_mask(pv, now, self.t.stale_ticks),
+                           0, pv)
+        ps = jnp.where(pv > 0, ps, -1)
+        for f in range(pv.shape[1]):
+            cand_v, cand_s = pv[:, f], ps[:, f]
+            cand_v = sticky_adjust(cand_v, cv0,
+                                   (cand_s == cs0) & (cand_v > cv0))
+            wv, ws = self._lex_max(wv, ws, cand_v, cand_s)
+        return wv, ws
+
+    def _finalize_merge(self, state: CompressedState, sent, wv, ws):
+        """Complete a pull-merge batch: reset transmit counts at changed
+        lines, count live evictions — both against the PRE-round cache
+        (``state`` still holds it)."""
+        cv0, cs0 = state.cache_val, state.cache_slot
+        changed = (wv != cv0) | (ws != cs0)
+        sent = jnp.where(changed, jnp.int8(0), sent)
+        evicted = (cs0 >= 0) & (ws != cs0)
+        return dataclasses.replace(
+            state, cache_slot=ws, cache_val=wv, cache_sent=sent,
+            evictions=state.evictions
+            + jnp.sum(evicted.astype(jnp.int32)))
+
     def _merge_pulled(self, state: CompressedState, sent, pv, ps, ok,
                       now, drop_key=None, stale_filtered=False):
         """Merge pre-gathered peer board rows ``pv``/``ps`` ([nl, F, K])
@@ -452,32 +494,18 @@ class CompressedSim:
         board, ``stale_filtered``); dead sources/receivers
         contribute/accept nothing (the ``ok`` mask); ``drop_prob``
         models UDP loss; same-slot DRAINING stickiness rewrites an
-        advancing ALIVE to DRAINING."""
-        p, t = self.p, self.t
-        cv0, cs0 = state.cache_val, state.cache_slot
-        pv = jnp.where(ok[:, :, None], pv, 0)
-        if p.drop_prob > 0.0:
-            keep = jax.random.bernoulli(drop_key, 1.0 - p.drop_prob,
+        advancing ALIVE to DRAINING.  (Fold + finalize are split out so
+        the sharded twins can fold candidate groups as they arrive —
+        :meth:`_fold_pulled`.)"""
+        keep = None
+        if self.p.drop_prob > 0.0:
+            keep = jax.random.bernoulli(drop_key, 1.0 - self.p.drop_prob,
                                         pv.shape)
-            pv = jnp.where(keep, pv, 0)
-        if not stale_filtered:
-            pv = jnp.where(staleness_mask(pv, now, t.stale_ticks), 0, pv)
-        ps = jnp.where(pv > 0, ps, -1)
-
-        wv, ws = cv0, cs0
-        for f in range(pv.shape[1]):
-            cand_v, cand_s = pv[:, f], ps[:, f]
-            cand_v = sticky_adjust(cand_v, cv0,
-                                   (cand_s == cs0) & (cand_v > cv0))
-            wv, ws = self._lex_max(wv, ws, cand_v, cand_s)
-
-        changed = (wv != cv0) | (ws != cs0)
-        sent = jnp.where(changed, jnp.int8(0), sent)
-        evicted = (cs0 >= 0) & (ws != cs0)
-        return dataclasses.replace(
-            state, cache_slot=ws, cache_val=wv, cache_sent=sent,
-            evictions=state.evictions
-            + jnp.sum(evicted.astype(jnp.int32)))
+        wv, ws = self._fold_pulled(
+            state.cache_val, state.cache_slot, state.cache_val,
+            state.cache_slot, pv, ps, ok, now, keep=keep,
+            stale_filtered=stale_filtered)
+        return self._finalize_merge(state, sent, wv, ws)
 
     def _insert_own_offers(self, cache_val, cache_slot, cache_sent,
                            offer_val, base_slot, reset_on_hold=False):
@@ -570,29 +598,47 @@ class CompressedSim:
         transmit budget of a stalled/evicted record, which is what
         drains collision chains (the changed-service re-broadcast,
         services_state.go:538)."""
+        own, floor, offer_val, base_slot = self._announce_offers(
+            state.own, state.floor, state.node_alive, round_idx, now,
+            row_offset=row_offset)
+        cv, cs, se, ev = self._insert_own_offers(
+            state.cache_val, state.cache_slot, state.cache_sent,
+            offer_val, base_slot, reset_on_hold=True)
+        return dataclasses.replace(
+            state, own=own, floor=floor, cache_slot=cs, cache_val=cv,
+            cache_sent=se, evictions=state.evictions + ev)
+
+    def _announce_offers(self, own0, floor0, node_alive, round_idx, now,
+                         row_offset=0):
+        """The BOARD-INDEPENDENT half of announce: the refresh/fold
+        update of ``own``/``floor`` plus the offer values, none of which
+        read the cache — so the sharded split-phase round runs this
+        while exchanged board rows are still in flight and applies the
+        cache insert (:meth:`_insert_own_offers`) only in the final
+        phase.  Returns ``(own, floor, offer_val, base_slot)``."""
         p, t = self.p, self.t
         s = p.services_per_node
-        n = state.own.shape[0]        # local row count (= p.n single-chip)
+        n = own0.shape[0]             # local row count (= p.n single-chip)
         node = jnp.arange(n, dtype=jnp.int32)[:, None]          # [N, 1]
         gnode = node + row_offset                               # global ids
         slots = row_offset * s + \
             jnp.arange(n * s, dtype=jnp.int32).reshape(n, s)    # [N, S]
         floor_l = lax.dynamic_slice(
-            state.floor, (row_offset * s,), (n * s,)).reshape(n, s)
+            floor0, (row_offset * s,), (n * s,)).reshape(n, s)
 
-        st = unpack_status(state.own)
-        present = is_known(state.own) & state.node_alive[:, None]
+        st = unpack_status(own0)
+        present = is_known(own0) & node_alive[:, None]
 
         refresh_due = gossip_ops.refresh_due(
-            state.own, slots, round_idx, refresh_rounds=t.refresh_rounds,
+            own0, slots, round_idx, refresh_rounds=t.refresh_rounds,
             round_ticks=t.round_ticks, now=now) & present \
             & (st != TOMBSTONE)
         new_val = pack(now, st)
-        fold = refresh_due & (state.own == floor_l)
-        own = jnp.where(refresh_due, new_val, state.own)
+        fold = refresh_due & (own0 == floor_l)
+        own = jnp.where(refresh_due, new_val, own0)
         floor_l = jnp.where(fold, new_val, floor_l)
         floor = lax.dynamic_update_slice(
-            state.floor, floor_l.reshape(-1), (row_offset * s,))
+            floor0, floor_l.reshape(-1), (row_offset * s,))
 
         rphase = gnode % p.recover_rounds
         recover_due = ((round_idx % p.recover_rounds) == rphase) & present \
@@ -600,12 +646,7 @@ class CompressedSim:
 
         offer = (refresh_due & ~fold) | recover_due
         offer_val = jnp.where(offer, own, 0)
-        cv, cs, se, ev = self._insert_own_offers(
-            state.cache_val, state.cache_slot, state.cache_sent,
-            offer_val, slots[:, 0], reset_on_hold=True)
-        return dataclasses.replace(
-            state, own=own, floor=floor, cache_slot=cs, cache_val=cv,
-            cache_sent=se, evictions=state.evictions + ev)
+        return own, floor, offer_val, slots[:, 0]
 
     def _push_pull_stride(self, state: CompressedState, key, now):
         """Anti-entropy: two-way exchange with the node ``stride``
@@ -1072,8 +1113,9 @@ class CompressedSim:
             state = clone_state(state)
         return self._run_behind_jit(state, key, num_rounds, every)
 
-    def run_fast(self, state, key, num_rounds: int, donate: bool = True):
-        self._check_horizon(state, num_rounds)
+    def run_fast(self, state, key, num_rounds: int, donate: bool = True,
+                 start_round=None):
+        self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
         return self._run_fast_jit(state, key, num_rounds)

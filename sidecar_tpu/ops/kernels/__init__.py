@@ -36,6 +36,8 @@ import jax.numpy as jnp
 
 from sidecar_tpu import metrics
 from sidecar_tpu.ops.kernels.publish_gather import (  # noqa: F401
+    board_row_gather_pallas,
+    board_row_gather_xla,
     fused_publish_gather_pallas,
     fused_publish_gather_xla,
     publish_board_pallas,

@@ -219,6 +219,120 @@ def publish_board_pallas(cache_val, cache_slot, cache_sent, *, budget: int,
       cache_val, cache_slot, cache_sent)
 
 
+# -- sharded board row-gather ------------------------------------------------
+#
+# The multi-chip twin's delivery gather (docs/sharding.md): each shard
+# holds a board BLOCK (its own rows, or — in the all_gather mode — the
+# whole gathered board) and must serve the rows its nodes sampled.  The
+# kernel DMAs the in-range rows from the block (``base`` is the block's
+# global row offset, traced — the shard passes ``r0`` inside shard_map);
+# rows outside the block are emitted as (0, -1) — the merge no-op — so
+# the caller can fold them from the exchanged buffer (the a2a response /
+# the ring hops) instead.  Bit-identical to :func:`board_row_gather_xla`.
+
+
+def board_row_gather_xla(bval, bslot, src, base=0):
+    """XLA reference: ``pv[r, f] = bval[src[r, f] - base]`` where the
+    row is in the block, else ``(0, -1)``.  With ``base=0`` and a full
+    board this is exactly the round-5 delivery gather
+    (``bval[src]``/``bslot[src]`` of ``_pull_merge``)."""
+    rows_total = bval.shape[0]
+    rel = src - base
+    in_block = (rel >= 0) & (rel < rows_total)
+    rows = jnp.clip(rel, 0, rows_total - 1)
+    pv = jnp.where(in_block[:, :, None], bval[rows], 0)
+    ps = jnp.where(in_block[:, :, None], bslot[rows], -1)
+    return pv, ps
+
+
+def board_row_gather_pallas(bval, bslot, src, base=0, *,
+                            interpret: bool = True):
+    """Board row-gather as a depth-``_DMA_RING`` async-copy ring: the
+    sampled block rows stream into VMEM while earlier rows are masked
+    and stored — the sharded delivery path's half of the single-chip
+    fused gather (no publish recompute: the block rows ARE board rows,
+    already selected and staleness-filtered by their home shard).
+
+    ``src`` holds GLOBAL peer ids; ``base`` (traced, SMEM) is the
+    block's global row offset.  Out-of-block rows emit (0, -1).
+    """
+    n, f = src.shape
+    rows_total, k = bval.shape
+    tile = _tile_rows(n, k)
+    rows = tile * f
+    ring = min(_DMA_RING, rows)
+
+    def kernel(base_s, src_s, src_v, bv_h, bs_h, pv_o, ps_o, gv, gs, sem):
+        base_t = base_s[0]
+
+        def peer_copies(i):
+            # Clamp into the block: out-of-block rows still DMA a valid
+            # row (their outputs are masked below), rows past N in a
+            # ragged last tile carry garbage src values — both stay in
+            # bounds.
+            rel = jnp.clip(src_s[i // f, i % f] - base_t, 0,
+                           rows_total - 1)
+            return tuple(
+                pltpu.make_async_copy(h.at[rel], g.at[i],
+                                      sem.at[i % ring, w])
+                for w, (h, g) in enumerate(((bv_h, gv), (bs_h, gs))))
+
+        def fetch(i, _):
+            @pl.when(i >= ring)
+            def _():
+                for c in peer_copies(i - ring):
+                    c.wait()
+            for c in peer_copies(i):
+                c.start()
+            return _
+
+        lax.fori_loop(0, rows, fetch, None)
+
+        def drain(i, _):
+            for c in peer_copies(i):
+                c.wait()
+            return _
+
+        lax.fori_loop(max(0, rows - ring), rows, drain, None)
+
+        rel = src_v[:].reshape(rows) - base_t
+        in_block = (rel >= 0) & (rel < rows_total)
+        pv = jnp.where(in_block[:, None], gv[:], 0)
+        ps = jnp.where(in_block[:, None], gs[:], -1)
+        pv_o[:] = pv.reshape(tile, f, k)
+        ps_o[:] = ps.reshape(tile, f, k)
+
+    fan_block = pl.BlockSpec((tile, f, k), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM)
+    src_map = lambda i: (i, 0)  # noqa: E731 — shared by SMEM+VMEM views
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(n, tile),),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            # src twice: SMEM for scalar DMA addressing, VMEM for the
+            # vectorized in-block mask.
+            pl.BlockSpec((tile, f), src_map, memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, f), src_map, memory_space=pltpu.VMEM),
+            # The block stays addressable for the row DMAs.
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[fan_block, fan_block],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, f, k), jnp.int32),
+            jax.ShapeDtypeStruct((n, f, k), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rows, k), jnp.int32),
+            pltpu.VMEM((rows, k), jnp.int32),
+            pltpu.SemaphoreType.DMA((ring, 2)),
+        ],
+        interpret=interpret,
+        name="sidecar_board_row_gather",
+    )(jnp.asarray(base, jnp.int32).reshape(1), src, src, bval, bslot)
+
+
 # -- fused publish + board row-gather ---------------------------------------
 
 def fused_publish_gather_xla(cache_val, cache_slot, cache_sent, src, now,

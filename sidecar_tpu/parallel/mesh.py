@@ -10,12 +10,59 @@ catalog — lives on one device, exactly the data locality the reference has
 from __future__ import annotations
 
 import inspect
+import os
 from typing import Optional, Sequence
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from sidecar_tpu import metrics
+
 NODE_AXIS = "nodes"
+
+# Board-exchange selection (docs/sharding.md): how the per-round
+# cross-shard exchange is spelled.  Resolved at sim construction — like
+# SIDECAR_TPU_KERNELS, the choice is baked into the jitted round, so
+# toggling the env var affects sims built afterwards.
+BOARD_EXCHANGE_ENV = "SIDECAR_TPU_BOARD_EXCHANGE"
+BOARD_EXCHANGES = ("all_gather", "all_to_all", "ring")
+
+
+def resolve_board_exchange(explicit: Optional[str] = None, *,
+                           supported: Sequence[str] = BOARD_EXCHANGES,
+                           record: bool = True) -> str:
+    """Resolve the active board-exchange mode: an explicit constructor
+    argument wins, else ``SIDECAR_TPU_BOARD_EXCHANGE``, else
+    ``all_gather``.
+
+    An EXPLICIT mode a twin doesn't support raises (the caller asked
+    for something impossible).  An env-derived mode that is globally
+    valid but unsupported by this twin FALLS BACK to ``all_gather``
+    instead — the env knob is process-wide (an operator sets
+    ``all_to_all`` for the compressed bench), and it must not hard-fail
+    the dense twin's read paths (the bridge's ``sharded=True``); the
+    fallback is recorded as ``parallel.exchange.mode.fallback``.
+    Every resolution is recorded in the metrics registry
+    (``parallel.exchange.mode.<mode>``) so bench/ops reports can read
+    back which exchange a run actually used."""
+    from_env = explicit is None
+    if from_env:
+        mode = os.environ.get(BOARD_EXCHANGE_ENV, "all_gather") \
+            .strip().lower() or "all_gather"
+    else:
+        mode = explicit
+    if mode not in supported:
+        if from_env and mode in BOARD_EXCHANGES:
+            if record:
+                metrics.incr("parallel.exchange.mode.fallback")
+            mode = "all_gather"
+        else:
+            raise ValueError(
+                f"board_exchange must be one of {tuple(supported)}, got "
+                f"{mode!r} (explicit argument or {BOARD_EXCHANGE_ENV})")
+    if record:
+        metrics.incr(f"parallel.exchange.mode.{mode}")
+    return mode
 
 # jax moved shard_map out of experimental (and renamed check_rep →
 # check_vma) across the versions this repo meets in the wild; resolve

@@ -48,6 +48,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sidecar_tpu import metrics
 from sidecar_tpu.models.exact import SimParams, SimState, clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
@@ -60,7 +61,12 @@ from sidecar_tpu.ops.status import (
 )
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
-from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh, shard_map
+from sidecar_tpu.parallel.mesh import (
+    NODE_AXIS,
+    make_mesh,
+    resolve_board_exchange,
+    shard_map,
+)
 
 
 class ShardedSim:
@@ -72,7 +78,9 @@ class ShardedSim:
                  timecfg: TimeConfig = TimeConfig(),
                  mesh=None,
                  cut_mask: Optional[np.ndarray] = None,
-                 node_side: Optional[np.ndarray] = None):
+                 node_side: Optional[np.ndarray] = None,
+                 board_exchange: Optional[str] = None,
+                 exchange_stub: bool = False):
         if topo.n != params.n:
             raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
         if cut_mask is not None and topo.nbrs is None:
@@ -80,10 +88,29 @@ class ShardedSim:
         self.p = params
         self.t = timecfg
         self.topo = topo
+        # The dense twin exchanges bounded OFFER tensors, not boards:
+        # all_gather replicates them, ring streams sender blocks hop by
+        # hop.  all_to_all request routing only exists on the
+        # compressed twin (its pulls have a row-id request shape; dense
+        # offers are pushes) — docs/sharding.md.
+        self.board_exchange = resolve_board_exchange(
+            board_exchange, supported=("all_gather", "ring"))
+        # Measurement-only (benchmarks/sharded_scaling.py): consume only
+        # own-shard offers, skip the collectives — the exposed-comm
+        # probe; the trajectory is wrong by construction.
+        self._exchange_stub = exchange_stub
         self.mesh = mesh if mesh is not None else make_mesh()
         self.d = self.mesh.devices.size
         if params.n % self.d != 0:
             raise ValueError(f"n={params.n} must divide the {self.d}-device mesh")
+        nl = params.n // self.d
+        payload_ints = params.fanout + 2 * min(params.budget, params.m)
+        self.exchange_bytes_per_round = {
+            "all_gather": (params.n - nl) * payload_ints * 4,
+            "ring": (self.d - 1) * nl * payload_ints * 4,
+        }[self.board_exchange]
+        metrics.set_gauge("parallel.exchange.bytes",
+                          float(self.exchange_bytes_per_round))
 
         shard = NamedSharding(self.mesh, P(NODE_AXIS))
         self._row_sharding = shard
@@ -135,14 +162,58 @@ class ShardedSim:
             dst = jnp.where(cut, gi[:, None], dst)
         return jnp.where(alive[gi][:, None], dst, gi[:, None])
 
+    def _block_candidates(self, known0, dst_b, svc_b, msg_b, senders,
+                          alive, r0, nl, now, keep_b):
+        """Flat (rows, cols, vals, advanced) delivery candidates from
+        one contiguous SENDER block, localized to this shard's rows and
+        resolved against the pre-round local block ``known0`` — the
+        round-5 candidate pipeline, applied per block so the split-phase
+        round can evaluate own-shard offers while remote blocks are
+        still in flight (every gate is elementwise and every candidate
+        resolves against ``known0``, so block order is irrelevant; the
+        combined scatter-max at the end commutes)."""
+        t = self.t
+        bn, fanout = dst_b.shape
+        budget = svc_b.shape[1]
+        val = jnp.broadcast_to(msg_b[:, None, :], (bn, fanout, budget))
+        tgt = jnp.broadcast_to(dst_b[:, :, None], (bn, fanout, budget))
+        svc = jnp.broadcast_to(svc_b[:, None, :], (bn, fanout, budget))
+
+        val = jnp.where(staleness_mask(val, now, t.stale_ticks), 0, val)
+        val = jnp.where(alive[senders][:, None, None], val, 0)
+        val = jnp.where(alive[tgt], val, 0)
+        if keep_b is not None:
+            val = jnp.where(keep_b, val, 0)
+
+        # Localize: rows outside [0, nl) belong to other shards — their
+        # gathers clamp harmlessly and their scatters drop.
+        tgt_local = (tgt - r0).reshape(-1)
+        cols = svc.reshape(-1)
+        val = val.reshape(-1)
+        local = (tgt_local >= 0) & (tgt_local < nl)
+        val = jnp.where(local, val, 0)
+
+        pre_vals = known0[tgt_local, cols]
+        advanced = (val > pre_vals) & local
+        val = sticky_adjust(val, pre_vals, advanced)
+        d_rows = jnp.where(local, tgt_local, nl)
+        return d_rows, cols, val, advanced
+
     def _gossip_shard(self, known_l, sent_l, alive, key, round_idx,
                       nbrs_l=None, deg_l=None, cut_l=None):
-        """One shard's gossip round: select → all-gather offers → local
-        combined scatter (deliveries + announce) → sweep."""
+        """One shard's split-phase, comm-overlapped gossip round
+        (docs/sharding.md): select local offers → issue the exchange →
+        evaluate own-shard deliveries + the announce stamps (both
+        board-independent, overlapping the in-flight offers) → consume
+        remote blocks → ONE combined scatter → sweep.  Bit-identical to
+        the pre-split round in both exchange modes (the lockstep suite
+        is the oracle): every candidate resolves against the pre-round
+        block and the combined scatter-max/reset commute."""
         p, t = self.p, self.t
         limit = p.resolved_retransmit_limit()
         s = p.services_per_node
         nl = known_l.shape[0]
+        d = self.d
         ax = lax.axis_index(NODE_AXIS)
         r0 = (ax * nl).astype(jnp.int32)
         now = round_idx * t.round_ticks
@@ -156,52 +227,50 @@ class ShardedSim:
             dst = self._sample_dst_nbrs(k_peers, gi, alive, nl,
                                         nbrs_l, deg_l, cut_l)
 
-        # Select offers from the local block + transmit accounting.
-        # row_offset ties the tie-break rotation to GLOBAL node ids so
-        # the selection matches ExactSim bit-for-bit.
+        # Phase 1 — select offers from the local block + transmit
+        # accounting.  row_offset ties the tie-break rotation to GLOBAL
+        # node ids so the selection matches ExactSim bit-for-bit.
         svc_idx, msg = gossip_ops.select_messages(
             known_l, sent_l, p.budget, limit, row_offset=r0)
         sent_l = gossip_ops.record_transmissions(
             sent_l, svc_idx, msg, p.fanout, limit)
 
-        # The only cross-shard gossip traffic: the message offers.
-        dst_all = lax.all_gather(dst, NODE_AXIS, tiled=True)        # [N, F]
-        svc_all = lax.all_gather(svc_idx, NODE_AXIS, tiled=True)    # [N, B]
-        msg_all = lax.all_gather(msg, NODE_AXIS, tiled=True)        # [N, B]
-
-        n_total, fanout = dst_all.shape
-        budget = svc_all.shape[1]
-        val = jnp.broadcast_to(msg_all[:, None, :], (n_total, fanout, budget))
-        tgt = jnp.broadcast_to(dst_all[:, :, None], (n_total, fanout, budget))
-        svc = jnp.broadcast_to(svc_all[:, None, :], (n_total, fanout, budget))
-
-        val = jnp.where(staleness_mask(val, now, t.stale_ticks), 0, val)
-        sender_alive = alive[jnp.arange(n_total)]
-        val = jnp.where(sender_alive[:, None, None], val, 0)
-        val = jnp.where(alive[tgt], val, 0)
+        known0 = known_l               # pre-round snapshot: ALL candidate
+        fanout = dst.shape[1]          # resolution happens against it
+        budget = svc_idx.shape[1]
+        keepmask = None
         if p.drop_prob > 0.0:
-            keep = jax.random.bernoulli(k_drop, 1.0 - p.drop_prob, val.shape)
-            val = jnp.where(keep, val, 0)
+            # ONE draw over the full sender space (the pre-split shape),
+            # sliced per block — splitting never changes the stream.
+            keepmask = jax.random.bernoulli(
+                k_drop, 1.0 - p.drop_prob, (p.n, fanout, budget))
 
-        # Localize: rows outside [0, nl) belong to other shards — their
-        # gathers clamp harmlessly and their scatters drop.
-        tgt_local = (tgt - r0).reshape(-1)
-        cols = svc.reshape(-1)
-        val = val.reshape(-1)
-        local = (tgt_local >= 0) & (tgt_local < nl)
-        val = jnp.where(local, val, 0)
+        def keep_slice(s0, bn):
+            if keepmask is None:
+                return None
+            return lax.dynamic_slice(keepmask, (s0, 0, 0),
+                                     (bn, fanout, budget))
 
-        pre_vals = known_l[tgt_local, cols]
-        advanced = (val > pre_vals) & local
-        val = sticky_adjust(val, pre_vals, advanced)
-        d_rows = jnp.where(local, tgt_local, nl)
+        # Phase 2 — issue the exchange (mode-dependent; the only
+        # cross-shard gossip traffic is the bounded offer tensors).
+        if self.board_exchange == "all_gather" and not self._exchange_stub:
+            dst_all = lax.all_gather(dst, NODE_AXIS, tiled=True)     # [N, F]
+            svc_all = lax.all_gather(svc_idx, NODE_AXIS, tiled=True)  # [N, B]
+            msg_all = lax.all_gather(msg, NODE_AXIS, tiled=True)     # [N, B]
 
-        # Announce (owners of my rows' slots are exactly my rows).
-        # Phase/guard arithmetic is over GLOBAL slot ids, so it matches
-        # ExactSim._announce_updates bit-for-bit.
+        # Phase 3a — own-shard deliveries (no exchange needed).
+        groups = [self._block_candidates(
+            known0, dst, svc_idx, msg, gi, alive, r0, nl, now,
+            keep_slice(r0, nl))]
+
+        # Phase 3b — announce stamps (owners of my rows' slots are
+        # exactly my rows; reads only the pre-round block, so it
+        # overlaps the in-flight exchange).  Phase/guard arithmetic is
+        # over GLOBAL slot ids, matching ExactSim._announce_updates
+        # bit-for-bit.
         lr = jnp.arange(nl * s, dtype=jnp.int32) // s
         a_cols = r0 * s + jnp.arange(nl * s, dtype=jnp.int32)
-        own = known_l[lr, a_cols]
+        own = known0[lr, a_cols]
         st = unpack_status(own)
         present = is_known(own) & alive[r0 + lr]
         due = gossip_ops.refresh_due(
@@ -211,10 +280,59 @@ class ShardedSim:
         a_vals = jnp.where(due, pack(now, st), 0)
         a_rows = jnp.where(due, lr, nl)
 
-        rows = jnp.concatenate([d_rows, a_rows])
-        cols = jnp.concatenate([cols, a_cols])
-        vals = jnp.concatenate([val, a_vals])
-        adv = jnp.concatenate([advanced, due])
+        # Phase 4 — consume remote sender blocks.
+        if self._exchange_stub:
+            pass  # measurement-only exposed-comm probe: no collectives
+        elif self.board_exchange == "all_gather":
+            rem = p.n - nl
+            if rem:
+                # Rotate my own block out of the gathered tensors (it
+                # was already consumed from the local arrays above):
+                # the remaining N - nl senders, in ring order.
+                shift = r0 + nl
+                senders_r = (shift + jnp.arange(rem, dtype=jnp.int32)) \
+                    % p.n
+                keep_r = None
+                if keepmask is not None:
+                    keep_r = jnp.roll(keepmask, -shift, axis=0)[:rem]
+                groups.append(self._block_candidates(
+                    known0,
+                    jnp.roll(dst_all, -shift, axis=0)[:rem],
+                    jnp.roll(svc_all, -shift, axis=0)[:rem],
+                    jnp.roll(msg_all, -shift, axis=0)[:rem],
+                    senders_r, alive, r0, nl, now, keep_r))
+        else:  # ring — stream offer blocks hop by hop over ppermute
+            if d > 1:
+                perm = [(i, (i - 1) % d) for i in range(d)]
+
+                def hop(blocks):
+                    return tuple(lax.ppermute(b, NODE_AXIS, perm)
+                                 for b in blocks)
+
+                cur = hop((dst, svc_idx, msg))
+                for h in range(1, d):
+                    if h < d - 1:
+                        # Double buffer: hop h+1's transfer is issued
+                        # before hop h's block is consumed, so the next
+                        # transfer overlaps this hop's gate/localize.
+                        # Live footprint: two offer-block triples,
+                        # O(N/d·(F+2B)).
+                        nxt = hop(cur)
+                    s0 = ((ax + h) % d) * nl
+                    senders_h = s0 + jnp.arange(nl, dtype=jnp.int32)
+                    groups.append(self._block_candidates(
+                        known0, cur[0], cur[1], cur[2], senders_h,
+                        alive, r0, nl, now, keep_slice(s0, nl)))
+                    if h < d - 1:
+                        cur = nxt
+
+        # Final phase — ONE combined scatter for deliveries + announce
+        # (scatters on the big tensors cost a full buffer rewrite each;
+        # one per tensor per round stays the budget).
+        rows = jnp.concatenate([g[0] for g in groups] + [a_rows])
+        cols = jnp.concatenate([g[1] for g in groups] + [a_cols])
+        vals = jnp.concatenate([g[2] for g in groups] + [a_vals])
+        adv = jnp.concatenate([g[3] for g in groups] + [due])
         known_l, sent_l = gossip_ops.apply_updates(
             known_l, sent_l, rows, cols, vals, adv, num_rows=nl)
 
@@ -326,20 +444,29 @@ class ShardedSim:
         per_node = jnp.mean(agree.astype(jnp.float32), axis=1)
         return jnp.sum(per_node * alive_f) / jnp.maximum(jnp.sum(alive_f), 1.0)
 
+    def _check_horizon(self, state, num_rounds, start_round=None):
+        # ``start_round`` lets pipelined callers (the bridge, bench)
+        # validate the horizon from their host-side round counter:
+        # reading an in-flight chunk's ``round_idx`` would block until
+        # that chunk finishes, serializing the dispatch pipeline.
+        if start_round is None:
+            start_round = int(state.round_idx)
+        self.t.validate_horizon(start_round + num_rounds)
+
     def step(self, state: SimState, key: jax.Array) -> SimState:
-        self.t.validate_horizon(int(state.round_idx) + 1)
+        self._check_horizon(state, 1)
         return self._step_jit(state, key)
 
     def run(self, state: SimState, key: jax.Array, num_rounds: int,
-            donate: bool = True):
-        self.t.validate_horizon(int(state.round_idx) + num_rounds)
+            donate: bool = True, start_round=None):
+        self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
         return self._run_jit(state, key, num_rounds)
 
     def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
-                 donate: bool = True):
-        self.t.validate_horizon(int(state.round_idx) + num_rounds)
+                 donate: bool = True, start_round=None):
+        self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
         return self._run_fast_jit(state, key, num_rounds)

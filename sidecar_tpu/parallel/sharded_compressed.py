@@ -87,14 +87,22 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sidecar_tpu import metrics
 from sidecar_tpu.models.compressed import (
     CompressedParams,
     CompressedSim,
     CompressedState,
 )
 from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops.merge import staleness_mask
 from sidecar_tpu.ops.topology import Topology
-from sidecar_tpu.parallel.mesh import NODE_AXIS, make_mesh, shard_map
+from sidecar_tpu.parallel.mesh import (
+    NODE_AXIS,
+    make_mesh,
+    resolve_board_exchange,
+    shard_map,
+)
 
 
 class ShardedCompressedSim(CompressedSim):
@@ -108,18 +116,31 @@ class ShardedCompressedSim(CompressedSim):
                  perturb=None,
                  cut_mask: Optional[np.ndarray] = None,
                  node_side: Optional[np.ndarray] = None,
-                 board_exchange: str = "all_gather",
-                 a2a_slack: int = 2):
+                 board_exchange: Optional[str] = None,
+                 a2a_slack: int = 2,
+                 exchange_stub: bool = False):
         super().__init__(params, topo, timecfg, perturb=perturb,
                          cut_mask=cut_mask, node_side=node_side)
-        if board_exchange not in ("all_gather", "all_to_all"):
-            raise ValueError(
-                f"board_exchange must be 'all_gather' or 'all_to_all', "
-                f"got {board_exchange!r}")
         if a2a_slack < 1:
             raise ValueError("a2a_slack must be >= 1")
-        self.board_exchange = board_exchange
+        # None → SIDECAR_TPU_BOARD_EXCHANGE, default all_gather
+        # (docs/sharding.md); the resolution is recorded in the metrics
+        # registry (parallel.exchange.mode.<mode>).
+        self.board_exchange = resolve_board_exchange(board_exchange)
         self.a2a_slack = a2a_slack
+        # Measurement-only knob (benchmarks/sharded_scaling.py): skip
+        # the cross-shard exchange and consume only own-shard rows.
+        # The resulting trajectory is WRONG by construction — its only
+        # use is differencing wall-clock against the full round to
+        # measure exposed (non-overlapped) communication time.
+        self._exchange_stub = exchange_stub
+        # Sharded delivery gather kernel (board_row_gather): rides the
+        # same SIDECAR_TPU_KERNELS resolution as the publish kernel and
+        # the same SIDECAR_TPU_FUSED_GATHER degrade switch.
+        self._sharded_gather = (self._kernels == "pallas"
+                                and kernel_ops.fused_gather_enabled())
+        # Host-side watermark for sync_exchange_metrics.
+        self._overflow_synced = 0
         # The in-flight-list census path is excluded from sharded
         # compilation (XLA CPU GSPMD segfault — see
         # CompressedSim._behind_and_denom); the gather fast path is
@@ -148,6 +169,40 @@ class ShardedCompressedSim(CompressedSim):
             self._cut = jax.device_put(self._cut, row)
         if self._side is not None:
             self._side = jax.device_put(self._side, repl)
+
+        # Analytic per-round per-device RECEIVE bytes of the board
+        # exchange (docs/metrics.md: parallel.exchange.bytes) — the
+        # int32 bval + bslot payloads each mode moves.
+        k, d, cap = params.cache_lines, self.d, self._a2a_cap
+        self.exchange_bytes_per_round = {
+            # every other shard's [nl, K] block, twice (val + slot)
+            "all_gather": (params.n - nl) * k * 4 * 2,
+            # request row-ids + the two response legs
+            "all_to_all": d * cap * 4 + 2 * d * cap * k * 4,
+            # d-1 hops of one [nl, K] block pair
+            "ring": (d - 1) * nl * k * 4 * 2,
+        }[self.board_exchange]
+        metrics.set_gauge("parallel.exchange.bytes",
+                          float(self.exchange_bytes_per_round))
+
+    def sync_exchange_metrics(self, state: CompressedState) -> int:
+        """Publish the cumulative bounded-exchange overflow count
+        (``state.dropped`` — all_to_all bucket overflows) into the
+        metrics registry as ``parallel.exchange.overflow``.  Host-side:
+        reads the device scalar, so call it AFTER a dispatch pipeline
+        has drained, never between pipelined chunks.  The watermark is
+        per-trajectory: a state whose counter reads BELOW the watermark
+        (a fresh init_state on a reused sim) resets it, so drops on the
+        new trajectory count from zero — sync each trajectory before
+        starting the next.  Returns the state's cumulative count."""
+        dropped = int(jax.device_get(state.dropped))
+        if dropped < self._overflow_synced:
+            self._overflow_synced = 0     # fresh/rewound trajectory
+        delta = dropped - self._overflow_synced
+        if delta > 0:
+            metrics.incr("parallel.exchange.overflow", delta)
+        self._overflow_synced = dropped
+        return dropped
 
     # -- state --------------------------------------------------------------
 
@@ -194,35 +249,35 @@ class ShardedCompressedSim(CompressedSim):
             dst = jnp.where(cut, gi[:, None], dst)
         return jnp.where(alive[gi][:, None], dst, gi[:, None])
 
-    # -- the all_to_all board exchange (inside shard_map) -------------------
+    # -- the all_to_all request routing (inside shard_map) ------------------
 
-    def _a2a_exchange(self, bval_l, bslot_l, dst, ax, nl):
-        """Fetch exactly the board rows this shard's nodes sampled
-        (``dst``: [nl, F] global peer ids) from their home shards.
+    def _a2a_route(self, dst, ax, nl):
+        """Request routing for the all_to_all exchange — pure index math
+        over the sampled peer ids (NO board data), so the split-phase
+        round computes it and launches the request leg BEFORE the local
+        board publish, overlapping the request flight with the publish
+        kernel.
 
-        Request routing: each sampled peer id splits into (source
-        shard, source row); own-shard rows read the local board
-        directly; cross-shard rows are rank-compacted into per-source-
-        shard buckets of static capacity ``C``, the row ids cross in
-        one ``all_to_all``, every shard serves its requested rows from
-        its local board, and the rows come back in a second
-        ``all_to_all``.  Requests past a bucket's capacity become empty
-        pulls, COUNTED in the returned drop total (see the module
-        docstring for why dropping is sound and why it never fires at
-        the default slack; tests assert the count stays 0).  Returns
-        (pv, ps, n_dropped): [nl, F, K] board rows identical to
-        ``bval[dst]``/``bslot[dst]`` of the all_gather path whenever
-        ``n_dropped == 0``."""
+        Each sampled peer id splits into (source shard, source row);
+        own-shard rows are served locally; cross-shard rows are
+        rank-compacted into per-source-shard buckets of static capacity
+        ``C``.  Requests past a bucket's capacity become empty pulls,
+        COUNTED in ``n_dropped`` (surfaced as ``state.dropped`` and the
+        ``parallel.exchange.overflow`` metric; the lockstep suites
+        assert it stays 0 — see the module docstring for why dropping
+        is sound and why it never fires at the default slack).
+
+        The rank comes from one stable sort — O(R log R), independent
+        of d (an earlier form used d sequential cumsum passes, which
+        re-serializes at exactly the large d this mode exists for).
+        Returns ``(req[d, C], src_shard, src_row, is_local, valid,
+        rank, n_dropped)`` with the per-request arrays flat [nl·F]."""
         d, C = self.d, self._a2a_cap
         flat = dst.reshape(-1)                       # [R], R = nl·F
         src_shard = flat // nl
         src_row = flat % nl
         is_local = src_shard == ax
 
-        # Rank of each cross-shard request within its source-shard
-        # bucket, via one stable sort — O(R log R), independent of d
-        # (an earlier form used d sequential cumsum passes, which
-        # re-serializes at exactly the large d this mode exists for).
         src_eff = jnp.where(is_local, d, src_shard)  # locals → bucket d
         order = jnp.argsort(src_eff, stable=True)    # [R]
         counts = jnp.zeros((d + 1,), jnp.int32).at[src_eff].add(1)
@@ -236,39 +291,56 @@ class ShardedCompressedSim(CompressedSim):
         req = jnp.zeros((d, C), jnp.int32)
         req = req.at[jnp.where(valid, src_shard, d),
                      jnp.where(valid, rank, 0)].set(src_row, mode="drop")
-        req_in = lax.all_to_all(req, NODE_AXIS, 0, 0)   # [d, C] rows
-                                                        # to serve
-        rows = jnp.clip(req_in, 0, nl - 1)
-        resp_v = lax.all_to_all(bval_l[rows], NODE_AXIS, 0, 0)
-        resp_s = lax.all_to_all(bslot_l[rows], NODE_AXIS, 0, 0)
+        return req, src_shard, src_row, is_local, valid, rank, n_dropped
 
-        # Assemble [R, K]: local rows from the local board, served rows
-        # from the responses, overflowed requests empty.
-        safe_shard = jnp.where(valid, src_shard, 0)
-        safe_rank = jnp.where(valid, rank, 0)
-        cross_v = resp_v[safe_shard, safe_rank]
-        cross_s = resp_s[safe_shard, safe_rank]
-        local_v = bval_l[jnp.where(is_local, src_row, 0)]
-        local_s = bslot_l[jnp.where(is_local, src_row, 0)]
-        pv = jnp.where(is_local[:, None], local_v,
-                       jnp.where(valid[:, None], cross_v, 0))
-        ps = jnp.where(is_local[:, None], local_s,
-                       jnp.where(valid[:, None], cross_s, -1))
-        k = self.p.cache_lines
-        return (pv.reshape(nl, self.p.fanout, k),
-                ps.reshape(nl, self.p.fanout, k), n_dropped)
+    def _serve_local(self, bval_f, bslot_l, dst, base):
+        """Board rows of the block for the sampled peers: [nl, F] global
+        ids → [nl, F, K], out-of-block entries (0, -1) — the merge
+        no-op, so folding them is free.  Pallas DMA kernel
+        (``board_row_gather``) when the kernel path is active, its
+        bit-identical XLA twin otherwise."""
+        if self._sharded_gather:
+            return kernel_ops.board_row_gather_pallas(
+                bval_f, bslot_l, dst, base,
+                interpret=self._kernels_interpret)
+        return kernel_ops.board_row_gather_xla(bval_f, bslot_l, dst, base)
 
     # -- the per-shard gossip + announce phase (inside shard_map) -----------
 
     def _gossip_shard(self, own_l, cslot_l, cval_l, csent_l, floor, alive,
                       key, round_idx, nbrs_l=None, deg_l=None, cut_l=None):
+        """One shard's split-phase, comm-overlapped round
+        (docs/sharding.md):
+
+        1. LOCAL BOARD — publish selection on this shard's rows (the
+           Pallas/XLA kernel, tie rotation over global ids) + ONE
+           staleness gate per shard (elementwise — commutes with every
+           exchange, so rows travel pre-filtered).
+        2. ISSUE the exchange (mode-dependent; the a2a request leg is
+           issued even earlier, before the publish).
+        3. BOARD-INDEPENDENT local work while rows are in flight: fold
+           own-shard deliveries (every candidate resolves against the
+           pre-round cache, and the lex-max fold is order-independent,
+           so groups fold as they arrive), and the announce own/floor
+           half (refresh fold + offer values — none of it reads the
+           cache).
+        4. CONSUME remote rows — fold them, then the single batch
+           finalize (sent reset + eviction count vs the pre-round
+           cache) and the announce cache insert, exactly the op
+           sequence of the single-chip round.
+
+        Bit-identical to the pre-split round in every mode: the
+        lockstep suites (tests/test_sharded_compressed.py,
+        tests/test_sharded_exchange.py) are the oracle."""
         p, t = self.p, self.t
         limit = p.resolved_retransmit_limit()
         nl = own_l.shape[0]
+        d = self.d
         ax = lax.axis_index(NODE_AXIS)
         r0 = (ax * nl).astype(jnp.int32)
         gi = r0 + jnp.arange(nl, dtype=jnp.int32)
         now = round_idx * t.round_ticks
+        mode = self.board_exchange
 
         k_peers, k_drop = jax.random.split(jax.random.fold_in(key, ax))
         if nbrs_l is None:
@@ -286,38 +358,118 @@ class ShardedCompressedSim(CompressedSim):
             round_idx=round_idx, evictions=jnp.zeros((), jnp.int32),
             dropped=jnp.zeros((), jnp.int32))
 
-        # 1. publish local board rows + transmit accounting (elementwise;
-        # row_offset ties the tie rotation to global node ids).
+        n_drop = jnp.zeros((), jnp.int32)
+        # The a2a request leg is pure index math over dst — issue it
+        # ahead of the publish so the row ids cross while the publish
+        # kernel runs.
+        if mode == "all_to_all" and not self._exchange_stub:
+            (req, src_shard, src_row, is_local, valid, rank,
+             n_drop) = self._a2a_route(dst, ax, nl)
+            req_in = lax.all_to_all(req, NODE_AXIS, 0, 0)  # [d, C] rows
+            is_local_f = is_local.reshape(nl, p.fanout)
+
+        # Phase 1 — local board rows + transmit accounting, then the
+        # board staleness gate once per shard (rows travel filtered).
         bval_l, bslot_l, sent = self._publish(local, limit, row_offset=r0)
+        bval_f = jnp.where(staleness_mask(bval_l, now, t.stale_ticks),
+                           0, bval_l)
 
-        # The only cross-shard gossip traffic: the board (bounded offers,
-        # line-aligned — each row is the ≤budget records its node would
-        # pack into one ~1398 B datagram).
-        if self.board_exchange == "all_gather":
-            bval = lax.all_gather(bval_l, NODE_AXIS, tiled=True)  # [N, K]
+        ok = alive[dst] & alive[gi][:, None]             # [nl, F]
+        keep = None
+        if p.drop_prob > 0.0:
+            # ONE keep mask for the whole candidate set: groups fold
+            # separately but slice this same draw, so the split changes
+            # nothing observable.
+            keep = jax.random.bernoulli(
+                k_drop, 1.0 - p.drop_prob,
+                (nl, p.fanout, p.cache_lines))
+
+        cv0, cs0 = cval_l, cslot_l
+        wv, ws = cv0, cs0
+
+        # Phase 3a — own-shard deliveries fold immediately (no exchange
+        # needed): the sharded gather kernel DMAs the block rows.  The
+        # all_gather mode skips this — its remote buffer IS the full
+        # board, so local rows ride the same single consume (an extra
+        # early-fold group there would duplicate [nl, F, K] work for no
+        # footprint win; ring/a2a serve local rows separately by
+        # construction).
+        if mode != "all_gather" or self._exchange_stub:
+            pv0, ps0 = self._serve_local(bval_f, bslot_l, dst, r0)
+            wv, ws = self._fold_pulled(cv0, cs0, wv, ws, pv0, ps0,
+                                       ok & (dst // nl == ax), now,
+                                       keep=keep, stale_filtered=True)
+
+        # Phase 3b — the announce own/floor half (refresh fold + offer
+        # values; reads own/floor only, never the cache) overlaps the
+        # in-flight exchange; the cache insert waits for the final
+        # phase.
+        own_l, floor, offer_val, base_slot = self._announce_offers(
+            own_l, floor, alive[gi], round_idx, now, row_offset=r0)
+
+        # Phases 2 + 4 — issue the remote exchange and consume its rows.
+        if self._exchange_stub:
+            pass  # measurement-only: exposed-comm probe, no collectives
+        elif mode == "all_gather":
+            bval = lax.all_gather(bval_f, NODE_AXIS, tiled=True)  # [N, K]
             bslot = lax.all_gather(bslot_l, NODE_AXIS, tiled=True)
-            # 2. pull-merge into my rows (src holds global peer ids).
-            local = self._pull_merge(local, sent, bval, bslot, dst,
-                                     alive, now, drop_key=k_drop)
-        else:
-            pv, ps, n_drop = self._a2a_exchange(bval_l, bslot_l, dst,
-                                                ax, nl)
-            ok = alive[dst] & alive[gi][:, None]
-            local = self._merge_pulled(local, sent, pv, ps, ok, now,
-                                       drop_key=k_drop)
-            local = dataclasses.replace(
-                local, dropped=local.dropped + n_drop)
+            pv, ps = self._serve_local(bval, bslot, dst, 0)
+            wv, ws = self._fold_pulled(cv0, cs0, wv, ws, pv, ps, ok,
+                                       now, keep=keep,
+                                       stale_filtered=True)
+        elif mode == "all_to_all":
+            rows = jnp.clip(req_in, 0, nl - 1)
+            resp_v = lax.all_to_all(bval_f[rows], NODE_AXIS, 0, 0)
+            resp_s = lax.all_to_all(bslot_l[rows], NODE_AXIS, 0, 0)
+            safe_shard = jnp.where(valid, src_shard, 0)
+            safe_rank = jnp.where(valid, rank, 0)
+            cross_v = jnp.where(valid[:, None],
+                                resp_v[safe_shard, safe_rank], 0) \
+                .reshape(nl, p.fanout, p.cache_lines)
+            cross_s = jnp.where(valid[:, None],
+                                resp_s[safe_shard, safe_rank], -1) \
+                .reshape(nl, p.fanout, p.cache_lines)
+            wv, ws = self._fold_pulled(cv0, cs0, wv, ws, cross_v, cross_s,
+                                       ok & ~is_local_f, now,
+                                       keep=keep, stale_filtered=True)
+        else:  # ring — lax.ppermute streams block pairs hop by hop
+            src_shard_r = dst // nl
+            src_row_r = dst - src_shard_r * nl
+            if d > 1:
+                perm = [(i, (i - 1) % d) for i in range(d)]
+                cur_v = lax.ppermute(bval_f, NODE_AXIS, perm)
+                cur_s = lax.ppermute(bslot_l, NODE_AXIS, perm)
+                for h in range(1, d):
+                    if h < d - 1:
+                        # Double buffer: hop h+1's transfer is issued
+                        # BEFORE hop h's rows are consumed, so the
+                        # next transfer overlaps this hop's
+                        # gate/fold.  Live footprint: two [nl, K]
+                        # block pairs, O(N/d·K) — never the
+                        # replicated O(N·K) board.
+                        nxt_v = lax.ppermute(cur_v, NODE_AXIS, perm)
+                        nxt_s = lax.ppermute(cur_s, NODE_AXIS, perm)
+                    sel = src_shard_r == (ax + h) % d
+                    rows_h = jnp.where(sel, src_row_r, 0)
+                    wv, ws = self._fold_pulled(
+                        cv0, cs0, wv, ws, cur_v[rows_h], cur_s[rows_h],
+                        ok & sel, now, keep=keep, stale_filtered=True)
+                    if h < d - 1:
+                        cur_v, cur_s = nxt_v, nxt_s
 
-        # 3. announce re-stamps + recovery offers (local rows own exactly
-        # this shard's slot range; the refresh fold raises only shard-owned
-        # floor entries, re-merged via pmax below).
-        local = self._announce(local, round_idx, now, row_offset=r0)
+        # Final phase — one batch resolution vs the pre-round cache
+        # (the _merge_pulled finalize), then the announce cache insert
+        # on the merged lines: the single-chip op sequence exactly.
+        changed = (wv != cv0) | (ws != cs0)
+        sent = jnp.where(changed, jnp.int8(0), sent)
+        ev_merge = jnp.sum(((cs0 >= 0) & (ws != cs0)).astype(jnp.int32))
+        cv, cs, se, ev_ann = self._insert_own_offers(
+            wv, ws, sent, offer_val, base_slot, reset_on_hold=True)
 
-        floor = lax.pmax(local.floor, NODE_AXIS)
-        ev = lax.psum(local.evictions, NODE_AXIS)
-        dr = lax.psum(local.dropped, NODE_AXIS)
-        return (local.own, local.cache_slot, local.cache_val,
-                local.cache_sent, floor, ev, dr)
+        floor = lax.pmax(floor, NODE_AXIS)
+        ev = lax.psum(ev_merge + ev_ann, NODE_AXIS)
+        dr = lax.psum(n_drop, NODE_AXIS)
+        return own_l, cs, cv, se, floor, ev, dr
 
     # -- the round ----------------------------------------------------------
 

@@ -136,3 +136,54 @@ class TestChunkedPipeline:
         # Absolute round numbering across chunk boundaries.
         assert [d["round"] for d in chunked.deltas] == \
             list(range(1, 21))
+
+
+class TestShardedSimulate:
+    """PR 4: simulate(sharded=True) runs the multi-chip twin over the
+    attached mesh, with the board exchange selected per request (or via
+    SIDECAR_TPU_BOARD_EXCHANGE — docs/sharding.md)."""
+
+    HOSTS = tuple(f"h{i}" for i in range(8))   # divides the 8-dev mesh
+
+    def test_sharded_modes_report_and_converge(self):
+        bridge = SimBridge(make_state(hosts=self.HOSTS), CFG)
+        for mode in ("all_gather", "ring"):
+            report = bridge.simulate(rounds=12, sharded=True,
+                                     board_exchange=mode)
+            assert report.board_exchange == mode
+            assert report.devices == 8
+            # Warm snapshot: every node already knows everything.
+            assert report.convergence[-1] == 1.0
+            assert report.projected["h2"]["h1-svc0"] == "Alive"
+
+    def test_sharded_chunked_pipeline_matches(self):
+        single = SimBridge(make_state(hosts=self.HOSTS), CFG).simulate(
+            rounds=20, seed=3, sharded=True, cold_nodes=["h2"])
+        chunked_bridge = SimBridge(make_state(hosts=self.HOSTS), CFG)
+        chunked_bridge.CHUNK_ROUNDS = 7     # force 7+7+6 chunks
+        chunked = chunked_bridge.simulate(
+            rounds=20, seed=3, sharded=True, cold_nodes=["h2"])
+        assert chunked.convergence == single.convergence
+        assert chunked.projected == single.projected
+
+    def test_sharded_rejects_deltas(self):
+        bridge = SimBridge(make_state(hosts=self.HOSTS), CFG)
+        with pytest.raises(ValueError, match="deltas_cap"):
+            bridge.simulate(rounds=4, sharded=True, deltas_cap=5)
+
+    def test_sharded_over_http(self):
+        bridge = SimBridge(make_state(hosts=self.HOSTS), CFG)
+        server = serve_bridge(bridge, port=0)
+        try:
+            port = server.server_address[1]
+            body = json.dumps({"rounds": 6, "sharded": True,
+                               "board_exchange": "ring"}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/simulate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                doc = json.loads(resp.read())
+            assert doc["board_exchange"] == "ring"
+            assert doc["devices"] == 8
+        finally:
+            server.shutdown()

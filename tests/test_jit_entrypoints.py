@@ -79,6 +79,43 @@ class TestDetection:
             """)
         assert problems == []
 
+    def test_flags_indirect_scan_driver(self, tmp_path):
+        """The PR-4 extension: a jitted driver that DELEGATES its scan
+        to a same-file helper (the sharded-twin wrapper shape) is still
+        a scan driver — no sharded driver slips back to
+        double-buffering by hiding the scan one call deep."""
+        problems = self._check(tmp_path, """
+            import functools, jax
+            from jax import lax
+
+            class Sim:
+                def _run_scan(self, state, key, n):
+                    return lax.scan(lambda st, _: (st, None), state,
+                                    None, length=n)
+
+                @functools.partial(jax.jit, static_argnums=(0, 3))
+                def _run_jit(self, state, key, n):
+                    return self._run_scan(state, key, n)
+            """)
+        assert len(problems) == 1 and "_run_jit" in problems[0]
+
+    def test_indirect_scan_driver_accepts_donation(self, tmp_path):
+        problems = self._check(tmp_path, """
+            import functools, jax
+            from jax import lax
+
+            class Sim:
+                def _run_scan(self, state, key, n):
+                    return lax.scan(lambda st, _: (st, None), state,
+                                    None, length=n)
+
+                @functools.partial(jax.jit, static_argnums=(0, 3),
+                                   donate_argnums=1)
+                def _run_jit(self, state, key, n):
+                    return self._run_scan(state, key, n)
+            """)
+        assert problems == []
+
     def test_ignores_scanless_jit(self, tmp_path):
         problems = self._check(tmp_path, """
             import jax

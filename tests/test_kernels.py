@@ -26,6 +26,8 @@ from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import topology
 from sidecar_tpu.ops.kernels.publish_gather import (
+    board_row_gather_pallas,
+    board_row_gather_xla,
     fused_publish_gather_pallas,
     fused_publish_gather_xla,
     publish_board_pallas,
@@ -172,6 +174,61 @@ class TestFusedGatherParity:
                                           err_msg=name)
         # Premise: the gate actually fired somewhere.
         assert int(jnp.sum(ref[1] == 0)) > 0
+
+
+class TestBoardRowGatherParity:
+    """The sharded delivery gather (PR 4): DMA-serves rows inside the
+    block, emits the (0, -1) merge no-op outside it — bit-identical to
+    the XLA twin across ragged shapes, block offsets, and src ids that
+    straddle / overshoot the block."""
+
+    @pytest.mark.parametrize("n,k,f,blk,base", [
+        (13, 16, 3, 13, 0),    # full board, base 0 (the all_gather use)
+        (20, 32, 2, 5, 10),    # mid-cluster block (a ring hop's view)
+        (33, 8, 4, 11, 22),    # ragged rows vs the row tile
+        (7, 128, 2, 7, 0),     # wide cache, one lane register
+    ])
+    def test_parity(self, n, k, f, blk, base):
+        rng = np.random.default_rng(n * 100 + k + f)
+        bval = jnp.asarray(rng.integers(0, 1 << 20, (blk, k)), jnp.int32)
+        bslot = jnp.asarray(rng.integers(-1, blk * 4, (blk, k)),
+                            jnp.int32)
+        # src deliberately overshoots [base, base+blk) on both sides.
+        src = jnp.asarray(rng.integers(0, base + blk + 5, (n, f)),
+                          jnp.int32)
+        ref = board_row_gather_xla(bval, bslot, src, base)
+        got = board_row_gather_pallas(bval, bslot, src, base,
+                                      interpret=True)
+        for name, a, b in zip(("pv", "ps"), ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
+
+    def test_out_of_block_rows_are_merge_noops(self):
+        bval = jnp.ones((4, 8), jnp.int32) * 5
+        bslot = jnp.ones((4, 8), jnp.int32)
+        src = jnp.asarray([[0, 9], [3, 4]], jnp.int32)  # 9, 4 off-block
+        pv, ps = board_row_gather_pallas(bval, bslot, src, 0)
+        assert int(jnp.sum(pv[0, 1])) == 0 and bool(
+            jnp.all(ps[0, 1] == -1))
+        assert int(jnp.sum(pv[1, 1])) == 0
+        assert int(jnp.sum(pv[0, 0])) == 40  # in-block row served
+
+    def test_traced_base_inside_jit(self):
+        """The shard passes its block offset r0 as a TRACED value
+        inside shard_map — the kernel must accept it (SMEM scalar)."""
+        rng = np.random.default_rng(3)
+        bval = jnp.asarray(rng.integers(0, 99, (6, 16)), jnp.int32)
+        bslot = jnp.asarray(rng.integers(-1, 24, (6, 16)), jnp.int32)
+        src = jnp.asarray(rng.integers(0, 12, (6, 2)), jnp.int32)
+
+        @jax.jit
+        def run(base):
+            return board_row_gather_pallas(bval, bslot, src, base)
+
+        ref = board_row_gather_xla(bval, bslot, src, 6)
+        got = run(jnp.asarray(6, jnp.int32))
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def _mint_burst(sim, n_slots, seed):

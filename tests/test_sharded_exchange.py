@@ -1,0 +1,289 @@
+"""Exchange-mode contract of the split-phase, comm-overlapped sharded
+round (PR 4 — docs/sharding.md).
+
+Centerpiece: every ``board_exchange`` mode, at every mesh width d ∈
+{1, 2, 4, 8}, runs LOCKSTEP bit-identical to the single-chip model
+WITH the Pallas kernel path active (interpret mode on CPU — the same
+kernel logic the TPU compiles: the per-shard publish kernel plus the
+sharded ``board_row_gather`` DMA kernel).  The single-chip trajectory
+is computed once and every (mode, d) sharded build must reproduce it
+state-for-state — any error in the split-phase restructure (folding
+own-shard rows early, hoisting the announce own/floor half, the
+double-buffered ppermute ring, the a2a request leg issued ahead of the
+publish) breaks equality at the first diverging round.
+
+Also here: the chaos-plan lockstep (config6 seed — pause windows from a
+seeded FaultPlan driving node_alive on both sims), the
+donated-chunked-chain == straight-run check for both sharded twins, the
+SIDECAR_TPU_BOARD_EXCHANGE resolution contract, and the
+``parallel.exchange.*`` metric surfaces (overflow asserted ZERO in
+every lockstep run — a capacity bug must fail loudly, not converge
+slowly).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu import metrics
+from sidecar_tpu.chaos.plan import FaultPlan, NodeFault
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.parallel.mesh import (
+    BOARD_EXCHANGE_ENV,
+    make_mesh,
+    resolve_board_exchange,
+)
+from sidecar_tpu.parallel.sharded import ShardedSim
+from sidecar_tpu.parallel.sharded_compressed import ShardedCompressedSim
+
+from tests.test_sharded import DetShardedSim, det_sample_peers
+from tests.test_sharded_compressed import (
+    DET,
+    DetShardedCompressedSim,
+    assert_states_equal,
+)
+
+MODES = ("all_gather", "all_to_all", "ring")
+DENSE_MODES = ("all_gather", "ring")
+DS = (1, 2, 4, 8)
+
+DET_DENSE = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=1e6,
+                       sweep_interval_s=1.0)
+
+
+def _compressed_schedule(params, rounds, mint_at=(0, 3)):
+    """Deterministic (round → mint slots) schedule shared by reference
+    and candidates."""
+    rng = np.random.default_rng(7)
+    return {i: np.sort(rng.choice(params.m, size=5, replace=False))
+            .astype(np.int32) for i in mint_at}, rounds
+
+
+def _run_compressed(sim, schedule, rounds, alive_at=None):
+    st = sim.init_state()
+    states = []
+    for i in range(rounds):
+        key = jax.random.PRNGKey(100 + i)
+        if i in schedule:
+            tick = int(st.round_idx) * sim.t.round_ticks + 7
+            st = sim.mint(st, schedule[i], tick)
+        if alive_at is not None:
+            st = dataclasses.replace(
+                st, node_alive=jnp.asarray(alive_at(i)))
+        st = sim.step(st, key)
+        states.append(st)
+    return states
+
+
+@pytest.mark.pallas
+class TestCompressedLockstepModesByD:
+    """The acceptance matrix: mode × d, Pallas kernels active."""
+
+    def test_all_modes_all_d_bit_identical(self, monkeypatch):
+        monkeypatch.setenv(kernel_ops.ENV_VAR, "pallas")
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        rounds = 8
+        schedule, rounds = _compressed_schedule(params, rounds)
+
+        single = CompressedSim(params, topology.complete(16), DET)
+        assert single._kernels == "pallas"
+        ref = _run_compressed(single, schedule, rounds)
+
+        for d in DS:
+            for mode in MODES:
+                sharded = DetShardedCompressedSim(
+                    params, topology.complete(16), DET,
+                    mesh=make_mesh(jax.devices()[:d]),
+                    board_exchange=mode)
+                assert sharded._kernels == "pallas"
+                assert sharded._sharded_gather
+                got = _run_compressed(sharded, schedule, rounds)
+                for i, (a, b) in enumerate(zip(ref, got)):
+                    assert_states_equal(a, b, f"{mode}/d={d} r{i + 1}")
+                # No silent caps: a capacity overflow must surface.
+                assert sharded.sync_exchange_metrics(got[-1]) == 0
+
+
+class TestDenseLockstepModesByD:
+    def test_modes_by_d_bit_identical(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        rounds = 8
+        exact = ExactSim(params, topology.complete(16), DET_DENSE)
+        se = exact.init_state()
+        ref = []
+        for i in range(rounds):
+            se = exact.step(se, jax.random.PRNGKey(i))
+            ref.append(se)
+
+        for d in DS:
+            for mode in DENSE_MODES:
+                sharded = DetShardedSim(
+                    params, topology.complete(16), DET_DENSE,
+                    mesh=make_mesh(jax.devices()[:d]),
+                    board_exchange=mode)
+                ss = sharded.init_state()
+                for i in range(rounds):
+                    ss = sharded.step(ss, jax.random.PRNGKey(i))
+                    np.testing.assert_array_equal(
+                        np.asarray(ref[i].known), np.asarray(ss.known),
+                        err_msg=f"known {mode}/d={d} r{i + 1}")
+                    np.testing.assert_array_equal(
+                        np.asarray(ref[i].sent), np.asarray(ss.sent),
+                        err_msg=f"sent {mode}/d={d} r{i + 1}")
+
+
+class TestChaosPlanLockstep:
+    def test_config6_seed_pause_window(self, monkeypatch):
+        """A seeded FaultPlan (the config6 chaos seed) drives a node
+        pause window on BOTH sims; the sharded round must track the
+        single-chip model through the failure and the recovery in every
+        exchange mode."""
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        plan = FaultPlan(seed=6, nodes=(
+            NodeFault(nodes=(3, 4, 5), start_round=5, end_round=12),))
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        rounds = 16
+        schedule, rounds = _compressed_schedule(params, rounds,
+                                                mint_at=(0, 6))
+
+        def alive_at(i):
+            return np.array([not plan.node_down(node, i)
+                             for node in range(params.n)], dtype=bool)
+
+        single = CompressedSim(params, topology.complete(16), DET)
+        ref = _run_compressed(single, schedule, rounds, alive_at)
+        for mode in MODES:
+            sharded = DetShardedCompressedSim(
+                params, topology.complete(16), DET, board_exchange=mode)
+            got = _run_compressed(sharded, schedule, rounds, alive_at)
+            for i, (a, b) in enumerate(zip(ref, got)):
+                assert_states_equal(a, b, f"chaos {mode} r{i + 1}")
+            assert sharded.sync_exchange_metrics(got[-1]) == 0
+
+
+class TestChunkedPipelineEqualsStraight:
+    """The bench/bridge pipeline shape on BOTH sharded twins: chunked
+    dispatches chained through donated outputs (horizon-checked via
+    start_round, never reading in-flight round_idx) replay the straight
+    run exactly."""
+
+    def test_sharded_compressed_chunked_chain(self):
+        params = CompressedParams(n=32, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        sim = ShardedCompressedSim(params, topology.complete(32), DET,
+                                   board_exchange="ring")
+        st0 = sim.mint(sim.init_state(),
+                       jnp.arange(8, dtype=jnp.int32) * 3, 10)
+        key = jax.random.PRNGKey(7)
+        straight = sim.run_fast(st0, key, 18, donate=False)
+        chunked, done = st0, 0
+        for chunk in (6, 6, 6):
+            chunked = sim.run_fast(chunked, key, chunk,
+                                   start_round=done)
+            done += chunk
+        for f in ("own", "cache_slot", "cache_val", "cache_sent",
+                  "floor"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(straight, f)),
+                np.asarray(getattr(chunked, f)), err_msg=f)
+
+    def test_sharded_dense_chunked_chain(self):
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        sim = ShardedSim(params, topology.complete(16), DET_DENSE,
+                         board_exchange="ring")
+        st0 = sim.init_state()
+        key = jax.random.PRNGKey(3)
+        straight = sim.run_fast(st0, key, 18, donate=False)
+        chunked, done = st0, 0
+        for chunk in (6, 6, 6):
+            chunked = sim.run_fast(chunked, key, chunk, start_round=done)
+            done += chunk
+        np.testing.assert_array_equal(np.asarray(straight.known),
+                                      np.asarray(chunked.known))
+        np.testing.assert_array_equal(np.asarray(straight.sent),
+                                      np.asarray(chunked.sent))
+
+    def test_start_round_skips_device_read_dense(self):
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        sim = ShardedSim(params, topology.complete(16), DET_DENSE)
+        out = sim.run_fast(sim.init_state(), jax.random.PRNGKey(0), 4,
+                           start_round=0)
+        with pytest.raises(ValueError, match="horizon|tick"):
+            sim.run_fast(out, jax.random.PRNGKey(0), 4,
+                         start_round=10 ** 9)
+
+
+class TestExchangeSelection:
+    def test_env_resolution(self, monkeypatch):
+        monkeypatch.setenv(BOARD_EXCHANGE_ENV, "ring")
+        assert resolve_board_exchange(record=False) == "ring"
+        # Explicit constructor argument wins over the env.
+        assert resolve_board_exchange("all_gather",
+                                      record=False) == "all_gather"
+
+    def test_env_default_is_all_gather(self, monkeypatch):
+        monkeypatch.delenv(BOARD_EXCHANGE_ENV, raising=False)
+        assert resolve_board_exchange(record=False) == "all_gather"
+
+    def test_invalid_mode_rejected(self, monkeypatch):
+        monkeypatch.setenv(BOARD_EXCHANGE_ENV, "broadcast")
+        with pytest.raises(ValueError, match="board_exchange"):
+            resolve_board_exchange(record=False)
+
+    def test_env_reaches_sharded_sim(self, monkeypatch):
+        monkeypatch.setenv(BOARD_EXCHANGE_ENV, "ring")
+        params = CompressedParams(n=16, services_per_node=2,
+                                  cache_lines=32, budget=4)
+        sim = ShardedCompressedSim(params, topology.complete(16), DET)
+        assert sim.board_exchange == "ring"
+
+    def test_dense_twin_rejects_all_to_all(self):
+        params = SimParams(n=16, services_per_node=2)
+        with pytest.raises(ValueError, match="board_exchange"):
+            ShardedSim(params, topology.complete(16), DET_DENSE,
+                       board_exchange="all_to_all")
+
+    def test_env_all_to_all_falls_back_on_dense_twin(self, monkeypatch):
+        """The env knob is process-wide (set for the compressed bench);
+        it must not hard-fail the dense twin's read paths — an
+        env-derived mode a twin doesn't support falls back to
+        all_gather (counted), while an EXPLICIT one still raises."""
+        monkeypatch.setenv(BOARD_EXCHANGE_ENV, "all_to_all")
+        before = metrics.counter("parallel.exchange.mode.fallback")
+        params = SimParams(n=16, services_per_node=2)
+        sim = ShardedSim(params, topology.complete(16), DET_DENSE)
+        assert sim.board_exchange == "all_gather"
+        assert metrics.counter("parallel.exchange.mode.fallback") == \
+            before + 1
+        # A typo'd env value still fails loudly.
+        monkeypatch.setenv(BOARD_EXCHANGE_ENV, "broadcst")
+        with pytest.raises(ValueError, match="board_exchange"):
+            ShardedSim(params, topology.complete(16), DET_DENSE)
+
+    def test_mode_and_bytes_metrics_recorded(self):
+        params = CompressedParams(n=16, services_per_node=2,
+                                  cache_lines=32, budget=4)
+        before = metrics.counter("parallel.exchange.mode.ring")
+        sim = ShardedCompressedSim(params, topology.complete(16), DET,
+                                   board_exchange="ring")
+        assert metrics.counter("parallel.exchange.mode.ring") == before + 1
+        gauge = metrics.snapshot()["gauges"]["parallel.exchange.bytes"]
+        assert gauge == float(sim.exchange_bytes_per_round)
+        # ring bytes: (d-1) hops of one [nl, K] int32 pair
+        d = sim.d
+        nl = params.n // d
+        assert sim.exchange_bytes_per_round == \
+            (d - 1) * nl * params.cache_lines * 4 * 2

@@ -13,10 +13,16 @@ notice.  So tier-1 runs this check (tests/test_jit_entrypoints.py) and
 fails the build instead.
 
 A "scan driver" is a function decorated with ``jax.jit`` (directly or
-via ``functools.partial(jax.jit, ...)``) whose body calls
-``lax.scan``/``jax.lax.scan``.  To opt a driver out, put a comment
-containing ``# no-donate: <reason>`` in the decorator/body source or
-immediately above the decorator.
+via ``functools.partial(jax.jit, ...)``) whose body reaches
+``lax.scan``/``jax.lax.scan`` — directly, OR through calls to other
+functions/methods defined in the SAME file (resolved by name, to a
+fixpoint).  The transitive rule exists for the sharded twins (PR 4): a
+jitted driver that delegates its scan to a helper (``self._run_scan``
+and the like) would otherwise slip back to double-buffering unnoticed.
+Name-based resolution is deliberately conservative — a false positive
+costs one ``# no-donate:`` comment; a false negative costs HBM.  To opt
+a driver out, put a comment containing ``# no-donate: <reason>`` in the
+decorator/body source or immediately above the decorator.
 
 Usage: ``python tools/check_jit_entrypoints.py [root]`` — exits 0 when
 clean, 1 with a per-offender report otherwise.
@@ -74,6 +80,46 @@ def _calls_scan(fn: ast.AST) -> bool:
     return False
 
 
+def _called_local_names(fn: ast.AST) -> set:
+    """Names of functions/methods this function calls that COULD be
+    defined in the same file: bare names (``helper(...)``) and
+    attribute calls (``self._run_scan(...)`` — matched by attr name;
+    any-object attrs are included, which over-approximates safely)."""
+    names = set()
+    for sub in ast.walk(fn):
+        if isinstance(sub, ast.Call):
+            callee = sub.func
+            if isinstance(callee, ast.Name):
+                names.add(callee.id)
+            elif isinstance(callee, ast.Attribute):
+                names.add(callee.attr)
+    return names
+
+
+def _scan_reachers(tree: ast.AST) -> set:
+    """Fixpoint over the file's call graph (by function NAME): the set
+    of function names from which ``scan`` is reachable through
+    same-file calls."""
+    defs: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+    reach = {name for name, fns in defs.items()
+             if any(_calls_scan(fn) for fn in fns)}
+    changed = True
+    while changed:
+        changed = False
+        for name, fns in defs.items():
+            if name in reach:
+                continue
+            for fn in fns:
+                if _called_local_names(fn) & reach:
+                    reach.add(name)
+                    changed = True
+                    break
+    return reach
+
+
 def _has_waiver(src_lines: list[str], fn: ast.FunctionDef) -> bool:
     """``# no-donate:`` anywhere in the function's source span or in the
     3 lines above its first decorator."""
@@ -96,12 +142,17 @@ def check_tree(root: pathlib.Path) -> list[str]:
             problems.append(f"{path}: unparseable ({exc})")
             continue
         lines = src.splitlines()
+        reach = _scan_reachers(tree)
         for node in ast.walk(tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             jit_decs = [d for d in node.decorator_list
                         if _is_jit_decorator(d)]
-            if not jit_decs or not _calls_scan(node):
+            if not jit_decs:
+                continue
+            reaches_scan = _calls_scan(node) or \
+                bool(_called_local_names(node) & reach)
+            if not reaches_scan:
                 continue
             if any(_declares_donation(d) for d in jit_decs):
                 continue
