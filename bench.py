@@ -66,8 +66,59 @@ from __future__ import annotations
 
 import json
 import os
+import signal
 import sys
 import time
+
+# -- timeout watchdog --------------------------------------------------------
+# BENCH_r05 postmortem, part 2: the harness timeout (`timeout -k 10 870`)
+# sends SIGTERM, and a bench that dies mid-north-star leaves rc=124 with
+# `parsed: null` — zero salvageable data even though hundreds of rounds
+# already ran.  The watchdog keeps a host-side progress record (phase +
+# partial north-star numbers, updated as the pipelined loop consumes
+# chunks) and flushes it as ONE parseable JSON line on SIGTERM (and on
+# SIGALRM when BENCH_WATCHDOG_S arms a self-timer below the harness
+# deadline), then exits 124.
+
+_WATCHDOG: dict = {"phase": "init", "partial": None}
+
+
+def _watchdog_note(phase: str, partial=None) -> None:
+    """Advance the watchdog's phase label and MERGE ``partial`` into
+    the progress record — merge, not replace, so a later phase's loop
+    progress never clobbers an earlier phase's completed block (the
+    faithful rerun must not erase the finished headline north star)."""
+    _WATCHDOG["phase"] = phase
+    if partial is not None:
+        merged = _WATCHDOG["partial"] or {}
+        merged.update(partial)
+        _WATCHDOG["partial"] = merged
+
+
+def _watchdog_record() -> dict:
+    return {"error": "bench_timeout", "watchdog": True,
+            "phase": _WATCHDOG["phase"],
+            "partial": _WATCHDOG["partial"]}
+
+
+def _watchdog_handler(signum, frame):  # pragma: no cover - signal path
+    print(json.dumps(_watchdog_record()), flush=True)
+    sys.exit(124)
+
+
+def install_watchdog() -> None:
+    signal.signal(signal.SIGTERM, _watchdog_handler)
+    alarm_s = int(os.environ.get("BENCH_WATCHDOG_S", "0"))
+    if alarm_s > 0:
+        signal.signal(signal.SIGALRM, _watchdog_handler)
+        signal.alarm(alarm_s)
+
+
+def disarm_watchdog() -> None:
+    """Cancel the self-timer once the measured phases are done — a run
+    that completes just before the alarm must exit 0 with the real
+    result record, not a spurious timeout one mid-teardown."""
+    signal.alarm(0)
 
 
 def _bench_dense(n, spn, rounds):
@@ -124,7 +175,8 @@ def _bench_compressed(n, spn, rounds):
 
 def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
                       timecfg=None, fold_quorum=0.995, deep_sweep_every=0,
-                      cache_lines=256, sharded=False, note=""):
+                      cache_lines=256, sharded=False, note="",
+                      phase="north_star"):
     """Wall-clock for one chip to simulate a ``churn_frac`` burst on an
     n-node / n·spn-service cluster to ε-convergence (compressed model;
     the churn workload of BASELINE config 4 at north-star scale).
@@ -197,12 +249,38 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
     # tunnel worker crashes on very long scan dispatches, and the clamp
     # must not depend on call sites keeping conv_every small.
     chunk = conv_every * max(1, 150 // conv_every)
+
+    # Sparse-frontier arbiter (docs/sparse.md): dense vs sparse per
+    # pipelined chunk, driven by the behind census this loop already
+    # pulls, with hysteresis and the overflow→dense cooldown.
+    # BENCH_SPARSE=0 pins dense (the pre-round-8 bench); otherwise the
+    # SIDECAR_TPU_SPARSE contract applies (auto = census-driven, entry
+    # heuristic shared with the bridge via for_census).
+    from sidecar_tpu.ops import sparse as sparse_ops
+    if os.environ.get("BENCH_SPARSE", "1") == "0":
+        sparse_mode = "0"
+    else:
+        sparse_mode = sparse_ops.resolve_sparse(record=False)
+    arbiter = sparse_ops.SparseArbiter.for_census(sparse_mode, n)
+
     # Warm-up compiles without advancing the measured trajectory:
     # donate=False copies the state so the run below starts from the
-    # same burst (the drivers donate their input by default).
-    warm, c = sim.run_behind(state, key, chunk, conv_every,
-                             donate=False)
-    jax.device_get(c)
+    # same burst (the drivers donate their input by default).  Only the
+    # programs the arbiter can actually dispatch are warmed (mode "1"
+    # never dispatches the standalone dense program — its overflow
+    # fallback lives inside the sparse scan), and the warm-up outputs
+    # are dropped immediately so they don't pin device memory alongside
+    # the two in-flight pipelined states below.
+    if sparse_mode != "1":
+        warm, c = sim.run_behind(state, key, chunk, conv_every,
+                                 donate=False, sparse=False)
+        jax.device_get(c)
+        del warm, c
+    if sparse_mode != "0":
+        warm_s, c_s = sim.run_behind(state, key, chunk, conv_every,
+                                     donate=False, sparse=True)
+        jax.device_get(c_s)
+        del warm_s, c_s
 
     # Chunked-dispatch PIPELINE: chunk i+1 is enqueued (async, donated
     # zero-copy carry) BEFORE chunk i's scalar curve is pulled back, so
@@ -216,19 +294,35 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
     executed, behind_last = 0, float("inf")
     hit_total, hit_unsettled = None, None
     wall_total, wall_unsettled = None, None
-    pend_state, pend_behind = sim.run_behind(state, key, chunk,
-                                             conv_every, start_round=0)
+
+    def dispatch(st, start):
+        # The arbiter's decision applies to the chunk being enqueued —
+        # passed EXPLICITLY both ways (dispatch_kwargs: an omitted
+        # kwarg would resolve the sim's env default and defeat the
+        # BENCH_SPARSE=0 pin); sparse dispatches also hand back the
+        # device stats handle (grabbing it never blocks — it is read
+        # with the chunk's census, after the chunk has finished).
+        use_sparse = arbiter.sparse
+        st2, behind = sim.run_behind(st, key, chunk, conv_every,
+                                     start_round=start,
+                                     **arbiter.dispatch_kwargs())
+        return st2, behind, (sim.last_sparse_stats if use_sparse
+                             else None)
+
+    pend_state, pend_behind, pend_stats = dispatch(state, 0)
     dispatched = chunk
     while True:
         if dispatched < max_rounds:
-            pend_state, nxt_behind = sim.run_behind(
-                pend_state, key, chunk, conv_every,
-                start_round=dispatched)
+            pend_state, nxt_behind, nxt_stats = dispatch(
+                pend_state, dispatched)
             dispatched += chunk
         else:
-            nxt_behind = None
+            nxt_behind = nxt_stats = None
         behind = np.asarray(jax.device_get(pend_behind),
                             dtype=np.float64)
+        arbiter.record_chunk(
+            chunk, None if pend_stats is None
+            else np.asarray(jax.device_get(pend_stats)))
         for j, b in enumerate(behind):
             at = executed + (j + 1) * conv_every
             if hit_total is None and b <= thr_total:
@@ -237,6 +331,7 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
                 hit_unsettled = at
         executed += chunk
         behind_last = float(behind[-1])
+        arbiter.update_census(behind_last)
         # Wall-clock at each crossing, measured at the end of the chunk
         # that crossed (the whole chunk ran on-device either way).
         now_wall = time.perf_counter() - t0
@@ -244,10 +339,20 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
             wall_total = now_wall
         if hit_unsettled is not None and wall_unsettled is None:
             wall_unsettled = now_wall
+        # Namespaced under this run's phase label so concurrent/later
+        # north-star variants each keep their own progress block.
+        _watchdog_note(phase, {phase + "_progress": {
+            "n": n, "rounds_executed": executed,
+            "behind_last": behind_last,
+            "rounds_to_eps": hit_total,
+            "rounds_to_eps_unsettled": hit_unsettled,
+            "sparse": arbiter.snapshot(),
+            "wall_seconds": round(now_wall, 2), "note": note or None,
+        }})
         if (hit_unsettled is not None and hit_total is not None) \
                 or nxt_behind is None:
             break
-        pend_behind = nxt_behind
+        pend_behind, pend_stats = nxt_behind, nxt_stats
     wall = time.perf_counter() - t0
     conv_last = 1.0 - behind_last / nm
     round_s = cfg.round_ticks / cfg.ticks_per_second
@@ -277,6 +382,7 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
         "wall_ms_per_round": round(wall / executed * 1000, 1),
         "target": "<10 s on v5e-8 (this is 1 chip; scaling path: "
                   "parallel/sharded_compressed.py, BENCH_SHARDED=1)",
+        "sparse": {"mode": sparse_mode, **arbiter.snapshot()},
     }
     if sharded:
         # No silent caps: an all_to_all run with bucket overflows must
@@ -297,6 +403,7 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
 def main() -> None:
     import jax
 
+    install_watchdog()
     n = int(os.environ.get("BENCH_NODES", "4096"))
     spn = int(os.environ.get("BENCH_SERVICES_PER_NODE", "10"))
     rounds = int(os.environ.get("BENCH_ROUNDS", "200"))
@@ -360,8 +467,12 @@ def main() -> None:
     trace = (jax.profiler.trace(trace_dir) if trace_dir
              else contextlib.nullcontext())
     with trace:
+        _watchdog_note("dense_headline")
         dense_rps = _bench_dense(n, spn, rounds)
+        _watchdog_note("compressed_headline",
+                       {"dense_rounds_per_sec": round(dense_rps, 3)})
         compressed_rps = _bench_compressed(n, spn, rounds)
+        _watchdog_note("north_star")
         north_star = _bench_north_star(
             ns_n, spn, churn_frac=0.001, eps=1e-4, conv_every=25,
             max_rounds=600,
@@ -386,10 +497,12 @@ def main() -> None:
         # reported.
         from sidecar_tpu.models.timecfg import TimeConfig
         faithful_cfg = TimeConfig(refresh_interval_s=10_000.0)
+        _watchdog_note("north_star_faithful",
+                       {"north_star": north_star})
         north_star_faithful = _bench_north_star(
             ns_n, spn, churn_frac=0.001, eps=1e-4, conv_every=25,
             max_rounds=1500, timecfg=faithful_cfg, fold_quorum=1.0,
-            deep_sweep_every=0,
+            deep_sweep_every=0, phase="north_star_faithful",
             note="reference-faithful: PushPullInterval 20 s "
                  "(config/config.go:45), fold_quorum=1.0 (no analytic "
                  "straggler fold), same capacity as headline")
@@ -410,7 +523,7 @@ def main() -> None:
         if os.environ.get("BENCH_SHARDED"):
             north_star_sharded = _bench_north_star(
                 ns_n, spn, churn_frac=0.001, eps=1e-4, conv_every=25,
-                max_rounds=600, sharded=True,
+                max_rounds=600, sharded=True, phase="north_star_sharded",
                 note=f"sharded twin over {len(jax.devices())} device(s), "
                      "headline protocol constants")
         north_star_k1024 = None
@@ -419,6 +532,7 @@ def main() -> None:
                 ns_n, spn, churn_frac=0.001, eps=1e-4, conv_every=25,
                 max_rounds=1500, timecfg=faithful_cfg, fold_quorum=1.0,
                 deep_sweep_every=0, cache_lines=1024,
+                phase="north_star_faithful_k1024",
                 note="faithful at 4x cache capacity — collision-"
                      "serialization sensitivity")
 
@@ -441,6 +555,7 @@ def main() -> None:
 
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.
+    disarm_watchdog()
     from sidecar_tpu.ops import kernels as kernel_ops
     print(json.dumps({
         "metric": f"simulated gossip rounds/sec/chip (n={n}, spn={spn}, "

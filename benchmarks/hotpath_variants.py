@@ -3,31 +3,42 @@ conclusions are CONSOLIDATED in benchmarks/RESULTS.md ("Measured
 primitive floors and dead ends") — read that table before re-running
 anything here.  Round 6 superseded the XLA-level attack entirely: the
 publish floors are now addressed by the fused Pallas kernels in
-sidecar_tpu/ops/kernels/ (docs/kernels.md).
+sidecar_tpu/ops/kernels/ (docs/kernels.md), and round 8 attacks the
+remaining per-round cost from the other side (the sparse-frontier
+path, docs/sparse.md).
 
-Candidate-optimization experiments for the compressed round's two
-hot phases (publish ~?, board gather ~?, merge) at north-star shapes.
+All three experiment rounds live here as SUBCOMMANDS (they shipped as
+hotpath_variants{,2,3}.py through round 7; consolidated in round 8 —
+same variants, same harness, same numbers):
+
+  r1  candidate optimizations for the compressed round's hot phases
+      at north-star shapes:
+        pub_roll    round-4 publish: top_k threshold + 16
+                    conditional-roll tie rotation
+        pub_cumsum  WINNER (shipped in round 5): same top_k threshold,
+                    tie rank via ONE cumsum + a per-row gather (the
+                    rotated prefix-sum identity; no rolls)
+        pub_topk    top_k + threshold only (what the tie logic costs)
+        g2x32       round-4 board gather: bval[src] + bslot[src]
+        g1x64       dead end: pack (val,slot) into one int64 board,
+                    gather once, unpack
+        merge_loop  shipped merge: per-f sticky_adjust + lex_max
+        merge_key   dead end: int64-key tree-reduce over F
+  r2  int32-only follow-ups: approx_max_k vs exact top_k for the
+      publish threshold (pub quality check included), gather forms
+      (one [N,F] row gather vs 3×[N], fused reduce, val-only).
+  r3  can the publish threshold beat exact int32 top_k?  (int16
+      surrogate with dynamic shift; 64-bin recency histogram via
+      one-hot matmul + cumsum.)  Answer: no — topk32 stands.
 
 Each variant runs inside one lax.scan dispatch with per-iteration
-varying inputs (so XLA cannot hoist the work out of the loop — the trap
-the round-4 Pallas measurement caught) and folds a checksum into the
-carry (so nothing dead-codes).  Times are ms per iteration, best of 3.
+varying inputs (so XLA cannot hoist the work out of the loop — the
+trap the round-4 Pallas measurement caught) and folds a checksum into
+the carry (so nothing dead-codes).  Times are ms per iteration, best
+of 3.
 
-Variants:
-  pub_roll    current publish: top_k threshold + 16 conditional-roll
-              tie rotation (models/compressed.py _publish)
-  pub_cumsum  candidate: same top_k threshold, tie rank via ONE cumsum
-              and a per-row gather of the rotation offset (the rotated
-              prefix-sum identity; no rolls)
-  pub_topk    top_k + threshold only (what the tie logic costs on top)
-  g2x32       current board gather: bval[src] + bslot[src], int32 x2
-  g1x64       candidate: pack (val,slot) into one int64 board, gather
-              once, unpack
-  merge_loop  current merge: per-f sticky_adjust + lex_max passes
-  merge_key   candidate: pack candidates to int64 keys, sticky-adjust
-              elementwise, tree-reduce max over F, final lex vs cache
-
-Run: python benchmarks/hotpath_variants.py [--n 100000]
+Run: python benchmarks/hotpath_variants.py {r1,r2,r3} [--n 100000]
+     (r1 also takes --only pub,gather,merge)
 """
 
 import argparse
@@ -39,12 +50,6 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 import jax
-
-# The packed-key variants need real int64 on device; x64 here is
-# experiment-local (the model itself stays int32 unless a variant wins
-# AND the global-dtype cost is acceptable).
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -85,7 +90,7 @@ def timed_scan(body, carry, iters=60, reps=3):
     return best / iters * 1000.0
 
 
-# -- publish variants --------------------------------------------------------
+# -- r1: publish variants ----------------------------------------------------
 
 def publish_roll(val, slot, sent, limit=15):
     eligible = (slot >= 0) & (sent.astype(jnp.int32) < limit)
@@ -153,7 +158,7 @@ def publish_topk(val, slot, sent, limit=15):
     return jnp.where(selected, val, 0), jnp.where(selected, slot, -1)
 
 
-# -- gather + merge pieces ---------------------------------------------------
+# -- r1: gather + merge pieces -----------------------------------------------
 
 def lex_max(wv, ws, cv, cs):
     adv = (cv > wv) | ((cv == wv) & (cs > ws))
@@ -169,12 +174,7 @@ def sticky_adjust_stub(cand_v, cur_v, mask):
     return jnp.where(rewrite, (cand_v & ~7) | 4, cand_v)
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--n", type=int, default=100_000)
-    ap.add_argument("--only", default="",
-                    help="comma list of variant groups: pub,gather,merge")
-    opts = ap.parse_args()
+def run_r1(opts):
     only = set(opts.only.split(",")) if opts.only else None
 
     def want(group):
@@ -284,8 +284,177 @@ def main():
                 fn, (jnp.zeros((), jnp.int64), val, slot, pv0, ps0)), 3)
             print(json.dumps(results), flush=True)
 
+    return results
+
+
+# -- r2: approx threshold + gather forms (formerly hotpath_variants2) --------
+
+def run_r2(opts):
+    n = opts.n
+    val, slot, _ = make_inputs(n)
+    key0 = jax.random.PRNGKey(1)
+    results = {}
+
+    # publish threshold: exact top_k vs approx_max_k
+    def mk_thresh(kind):
+        def body(carry, i):
+            acc, v = carry
+            pv = v ^ (i & 1)
+            if kind == "exact":
+                top = lax.top_k(pv, BUDGET)[0]
+            else:
+                top = lax.approx_max_k(pv.astype(jnp.float32), BUDGET,
+                                       recall_target=0.95)[0] \
+                    .astype(jnp.int32)
+            thresh = top[:, -1:]
+            sel = jnp.where(pv >= thresh, pv, 0)
+            return (acc + jnp.sum(sel), v), None
+        return body
+
+    results["thresh_topk"] = round(
+        timed_scan(mk_thresh("exact"), (jnp.zeros((), jnp.int32), val)),
+        3)
+    print(json.dumps(results), flush=True)
+    results["thresh_approx"] = round(
+        timed_scan(mk_thresh("approx"), (jnp.zeros((), jnp.int32), val)),
+        3)
+    print(json.dumps(results), flush=True)
+
+    # approx quality at this shape: how far off is the returned B-th
+    # value, and how many rows get it exactly right?
+    exact_t = lax.top_k(val, BUDGET)[0][:, -1]
+    approx_t = lax.approx_max_k(val.astype(jnp.float32), BUDGET,
+                                recall_target=0.95)[0][:, -1] \
+        .astype(jnp.int32)
+    results["approx_rows_exact_pct"] = round(float(
+        jnp.mean((exact_t == approx_t).astype(jnp.float32))) * 100, 2)
+    print(json.dumps(results), flush=True)
+
+    # gather forms
+    def g_rows(carry, i):            # one [N, F] row gather, both arrays
+        acc, k = carry
+        k, sub = jax.random.split(k)
+        src = jax.random.randint(sub, (n, F), 0, n, dtype=jnp.int32)
+        pv = val[src]
+        ps = slot[src]
+        return (acc + jnp.sum(pv) + jnp.sum(ps), k), None
+
+    def g3x1row(carry, i):           # three [N] row gathers, both arrays
+        acc, k = carry
+        k, sub = jax.random.split(k)
+        src = jax.random.randint(sub, (n, F), 0, n, dtype=jnp.int32)
+        acc2 = acc
+        for f in range(F):
+            acc2 = acc2 + jnp.sum(val[src[:, f]]) \
+                + jnp.sum(slot[src[:, f]])
+        return (acc2, k), None
+
+    def g_fused(carry, i):           # gather → F-axis max, no slot
+        acc, k = carry
+        k, sub = jax.random.split(k)
+        src = jax.random.randint(sub, (n, F), 0, n, dtype=jnp.int32)
+        wv = jnp.max(val[src], axis=1)           # [N, K]
+        return (acc + jnp.sum(wv), k), None
+
+    def g_half(carry, i):            # val-only gather
+        acc, k = carry
+        k, sub = jax.random.split(k)
+        src = jax.random.randint(sub, (n, F), 0, n, dtype=jnp.int32)
+        pv = val[src]
+        return (acc + jnp.sum(pv), k), None
+
+    for name, fn in [("g_rows", g_rows), ("g3x1row", g3x1row),
+                     ("g_fused", g_fused), ("g_half", g_half)]:
+        results[name] = round(
+            timed_scan(fn, (jnp.zeros((), jnp.int32), key0)), 3)
+        print(json.dumps(results), flush=True)
+
+    return results
+
+
+# -- r3: cheaper publish thresholds (formerly hotpath_variants3) -------------
+
+def run_r3(opts):
+    n = opts.n
+    rng = np.random.default_rng(0)
+    occ = rng.random((n, K)) < 0.15
+    # realistic packed keys: recent ticks in a narrow window
+    pv0 = jnp.asarray(np.where(
+        occ, (rng.integers(20_000, 25_000, (n, K)) << 3), 0)
+        .astype(np.int32))
+    results = {}
+
+    def topk32(carry, i):
+        acc, pv = carry
+        p = pv ^ (i & 1)
+        thresh = lax.top_k(p, BUDGET)[0][:, -1:]
+        sel = (p > thresh) | ((p == thresh) & (p > 0))
+        return (acc + jnp.sum(sel.astype(jnp.int32)), pv), None
+
+    def topk16(carry, i):
+        acc, pv = carry
+        p = pv ^ (i & 1)
+        now_max = jnp.max(p)
+        shift = jnp.maximum(
+            0, 32 - jnp.int32(lax.clz(jnp.maximum(now_max, 1))) - 13)
+        p16 = (p >> shift).astype(jnp.int16)
+        thresh = lax.top_k(p16, BUDGET)[0][:, -1:]
+        sel = (p16 > thresh) | ((p16 == thresh) & (p > 0))
+        return (acc + jnp.sum(sel.astype(jnp.int32)), pv), None
+
+    def hist64(carry, i):
+        acc, pv = carry
+        p = pv ^ (i & 1)
+        now_max = jnp.max(p)
+        lo = now_max - (1 << 15)       # window floor
+        b = jnp.clip((p - lo) >> 9, 0, 63)      # 64 bins, newest high
+        b = jnp.where(p > 0, b, -1)
+        oh = jax.nn.one_hot(b, 64, dtype=jnp.bfloat16)  # [N, K, 64]
+        hist = jnp.sum(oh, axis=1).astype(jnp.int32)    # [N, 64]
+        # admit from the newest bin downward
+        rev = jnp.cumsum(hist[:, ::-1], axis=1)[:, ::-1]
+        tbin = 63 - jnp.argmax((rev >= BUDGET)[:, ::-1], axis=1)
+        have = jnp.any(rev >= BUDGET, axis=1)
+        tbin = jnp.where(have, tbin, 0)
+        sel = (b > tbin[:, None]) | ((b == tbin[:, None]) & (p > 0))
+        return (acc + jnp.sum(sel.astype(jnp.int32)), pv), None
+
+    for name, fn in [("topk32", topk32), ("topk16", topk16),
+                     ("hist64", hist64)]:
+        results[name] = round(
+            timed_scan(fn, (jnp.zeros((), jnp.int32), pv0)), 3)
+        print(json.dumps(results), flush=True)
+
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="hot-path variant dead-end ledger (see module "
+                    "docstring and benchmarks/RESULTS.md)")
+    sub = ap.add_subparsers(dest="round", required=True)
+    for name, help_txt in (("r1", "publish/gather/merge candidates"),
+                           ("r2", "approx threshold + gather forms"),
+                           ("r3", "cheaper publish thresholds")):
+        sp = sub.add_parser(name, help=help_txt)
+        sp.add_argument("--n", type=int, default=100_000)
+        if name == "r1":
+            sp.add_argument(
+                "--only", default="",
+                help="comma list of variant groups: pub,gather,merge")
+    opts = ap.parse_args()
+
+    if opts.round == "r1":
+        # The packed-key variants need real int64 on device; x64 is
+        # experiment-local (the model itself stays int32 unless a
+        # variant wins AND the global-dtype cost is acceptable).  r2/r3
+        # ran int32-only when they shipped and stay that way.
+        jax.config.update("jax_enable_x64", True)
+
+    results = {"r1": run_r1, "r2": run_r2, "r3": run_r3}[opts.round](opts)
     print("FINAL " + json.dumps(
-        {"n": n, "platform": jax.devices()[0].platform, **results}))
+        {"round": opts.round, "n": opts.n,
+         "platform": jax.devices()[0].platform, **results}))
 
 
 if __name__ == "__main__":

@@ -72,6 +72,12 @@ class SimulationReport:
     # used (docs/sharding.md) and how many devices the mesh spanned.
     board_exchange: Optional[str] = None
     devices: Optional[int] = None
+    # Sparse-frontier execution record (docs/sparse.md): the per-RUN
+    # arbiter counters — mode, sparse/dense round split, overflow
+    # fallbacks, switches, frontier high-water mark.  Reported per
+    # request (a fresh arbiter per simulate call), so back-to-back
+    # POST /simulate calls never bleed counters into each other.
+    sparse: Optional[dict] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -174,7 +180,8 @@ class SimBridge:
                  eps: float = 0.01,
                  deltas_cap: int = 0,
                  sharded: bool = False,
-                 board_exchange: Optional[str] = None) -> SimulationReport:
+                 board_exchange: Optional[str] = None,
+                 sparse: Optional[bool] = None) -> SimulationReport:
         """Run the catalog forward ``rounds`` gossip rounds.
 
         ``cold_nodes``: hostnames whose knowledge is blanked to their own
@@ -193,7 +200,15 @@ class SimBridge:
         exchange mode (all_gather | ring; None → the
         SIDECAR_TPU_BOARD_EXCHANGE env contract, docs/sharding.md).
         Delta streaming stays single-chip: the two options are
-        mutually exclusive."""
+        mutually exclusive.
+
+        ``sparse`` selects the sparse-frontier round (docs/sparse.md):
+        ``True``/``False`` force it per request; ``None`` follows the
+        ``SIDECAR_TPU_SPARSE`` contract — under ``auto`` a per-request
+        arbiter picks dense vs sparse at each ``CHUNK_ROUNDS`` boundary
+        from the convergence census the pipeline already pulls, with
+        hysteresis and the frontier-overflow→dense fallback.  The
+        report's ``sparse`` block carries the per-RUN counters."""
         if sharded and deltas_cap > 0:
             raise ValueError(
                 "deltas_cap > 0 is not supported with sharded=True "
@@ -221,18 +236,43 @@ class SimBridge:
             sizes.append(min(self.CHUNK_ROUNDS, left))
             left -= sizes[-1]
 
+        # Per-request sparse arbiter (docs/sparse.md): counters are
+        # per-RUN by construction — a fresh arbiter per simulate call
+        # (the watermark-reset contract of sync_exchange_metrics,
+        # applied from the start).  Census: the chunk's terminal
+        # convergence mapped back to a behind estimate.
+        from sidecar_tpu.ops import sparse as sparse_ops
+        if sparse is None:
+            sparse_mode = sparse_ops.resolve_sparse(record=False)
+        else:
+            sparse_mode = "1" if sparse else "0"
+        arbiter = sparse_ops.SparseArbiter.for_census(
+            sparse_mode, params.n)
+        nm = float(params.n) * float(params.m)
+
         def dispatch(st, n_rounds, start):
             # start_round: the host-side round counter — reading the
             # in-flight state's round_idx would block the pipeline.
+            # The mode is passed EXPLICITLY both ways (an omitted
+            # sparse= would resolve the sim's env default and defeat
+            # the per-request {"sparse": false} forcing contract).
+            use_sparse = arbiter.sparse
+            kw = arbiter.dispatch_kwargs()
             if deltas_cap > 0:
-                return sim.run_with_deltas(st, key, n_rounds, deltas_cap,
-                                           start_round=start)
-            return sim.run(st, key, n_rounds, start_round=start)
+                out = sim.run_with_deltas(st, key, n_rounds, deltas_cap,
+                                          start_round=start, **kw)
+            else:
+                out = sim.run(st, key, n_rounds, start_round=start,
+                              **kw)
+            return out + ((sim.last_sparse_stats if use_sparse
+                           else None),)
 
         delta_stream = [] if deltas_cap > 0 else None
         conv_parts = []
 
-        def consume(out, start):
+        def consume(out, start, n_rounds):
+            stats = out[-1]
+            out = out[:-1]
             if deltas_cap > 0:
                 final, batches, conv = out
                 delta_stream.extend(self._map_deltas(
@@ -240,19 +280,25 @@ class SimBridge:
                     start_round=start))
             else:
                 final, conv = out
-            conv_parts.append(np.asarray(jax.device_get(conv)))
+            conv_h = np.asarray(jax.device_get(conv))
+            conv_parts.append(conv_h)
+            arbiter.record_chunk(
+                n_rounds, None if stats is None
+                else np.asarray(jax.device_get(stats)))
+            arbiter.update_census((1.0 - float(conv_h[-1])) * nm)
             return final
 
         # Each pending chunk carries its own start round — no reliance
         # on uniform chunk sizes.
-        pend, pend_start = dispatch(state, sizes[0], 0), 0
+        pend, pend_start, pend_n = dispatch(state, sizes[0], 0), 0, \
+            sizes[0]
         done = sizes[0]
         for n_rounds in sizes[1:]:
             nxt, nxt_start = dispatch(pend[0], n_rounds, done), done
             done += n_rounds
-            consume(pend, pend_start)
-            pend, pend_start = nxt, nxt_start
-        final = consume(pend, pend_start)
+            consume(pend, pend_start, pend_n)
+            pend, pend_start, pend_n = nxt, nxt_start, n_rounds
+        final = consume(pend, pend_start, pend_n)
         conv = np.concatenate(conv_parts)
         known = np.asarray(final.known)
 
@@ -287,6 +333,7 @@ class SimBridge:
             deltas=delta_stream,
             board_exchange=sim.board_exchange if sharded else None,
             devices=sim.d if sharded else None,
+            sparse={"mode": sparse_mode, **arbiter.snapshot()},
         )
 
     @staticmethod
@@ -333,7 +380,8 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                  port: int = 7778,
                  background: bool = True) -> ThreadingHTTPServer:
     """POST /simulate {"rounds": N, "seed": S, "cold_nodes": [...],
-    "sharded": bool, "board_exchange": "all_gather"|"ring"}."""
+    "sharded": bool, "board_exchange": "all_gather"|"ring",
+    "sparse": bool|null (null → SIDECAR_TPU_SPARSE / arbiter)}."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -356,6 +404,7 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if not isinstance(req, dict):
                     raise ValueError("request body: not an object")
+                sparse_req = req.get("sparse")
                 report = bridge.simulate(
                     rounds=int(req.get("rounds", 50)),
                     seed=int(req.get("seed", 0)),
@@ -363,7 +412,9 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                     eps=float(req.get("eps", 0.01)),
                     deltas_cap=int(req.get("deltas_cap", 0)),
                     sharded=bool(req.get("sharded", False)),
-                    board_exchange=req.get("board_exchange"))
+                    board_exchange=req.get("board_exchange"),
+                    sparse=(None if sparse_req is None
+                            else bool(sparse_req)))
             except (ValueError, KeyError, TypeError,
                     json.JSONDecodeError) as exc:
                 self._reply(400, {"message": str(exc)})

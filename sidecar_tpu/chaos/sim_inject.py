@@ -218,6 +218,13 @@ class ChaosExactSim(ExactSim):
     the inner SimState exactly as before (they must not mutate
     ``node_alive`` — fault windows own it for the round)."""
 
+    # The fault-gated round stays dense: its delay rings and packet
+    # masks are already bounded structures, and chaos runs are not the
+    # convergence-tail regime the sparse path attacks (docs/sparse.md).
+    # FaultPlan-driven *node liveness* composes with the sparse path on
+    # the plain sims instead (tests/test_sparse.py).
+    supports_sparse = False
+
     def __init__(self, params: SimParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  plan: FaultPlan = FaultPlan(seed=0),
@@ -455,19 +462,21 @@ class ChaosExactSim(ExactSim):
                 metrics.incr(name, delta)
 
     def run(self, state, key, num_rounds: int, donate: bool = True,
-            start_round=None):
+            start_round=None, sparse=None):
         # Snapshot the injection counters BEFORE dispatch: the donating
         # run deletes the input state's buffers (models/exact.py).
         # (The snapshot reads device scalars, so a chaos sim pays one
         # sync per chunk even when start_round is supplied.)
         before = self._counter_snapshot(state)
         final, conv = super().run(state, key, num_rounds, donate=donate,
-                                  start_round=start_round)
+                                  start_round=start_round, sparse=sparse)
         self._publish_injection_metrics(before, final)
         return final, conv
 
-    def run_fast(self, state, key, num_rounds: int, donate: bool = True):
+    def run_fast(self, state, key, num_rounds: int, donate: bool = True,
+                 sparse=None):
         before = self._counter_snapshot(state)
-        final = super().run_fast(state, key, num_rounds, donate=donate)
+        final = super().run_fast(state, key, num_rounds, donate=donate,
+                                 sparse=sparse)
         self._publish_injection_metrics(before, final)
         return final

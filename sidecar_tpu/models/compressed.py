@@ -118,6 +118,7 @@ from sidecar_tpu.models.exact import clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops.merge import (
     apply_stickiness,
     staleness_mask,
@@ -229,6 +230,15 @@ class CompressedParams:
                                  # slots are in flight the census falls
                                  # back to the gather form, bit-for-bit
                                  # identical.
+    sparse_cap: int = 0          # C — static width of the sparse-frontier
+                                 # round's sender/announce compaction
+                                 # (receivers get C·fanout); 0 = auto
+                                 # (ops/sparse.default_frontier_cap).
+                                 # Purely an execution-path knob like
+                                 # metric_inflight_cap: a round whose
+                                 # frontier exceeds C falls back to the
+                                 # dense round, bit-for-bit identical
+                                 # (docs/sparse.md).
 
     def __post_init__(self):
         if self.cache_lines & (self.cache_lines - 1):
@@ -279,11 +289,17 @@ class CompressedSim:
     # than silently re-enabling the path.
     metric_list_ok = True
 
+    # Whether this sim implements the sparse-frontier round
+    # (docs/sparse.md); a wrapper that overrides _step without a sparse
+    # twin sets this False and the drivers degrade/raise accordingly.
+    supports_sparse = True
+
     def __init__(self, params: CompressedParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  perturb: Optional[PerturbFn] = None,
                  cut_mask: Optional[np.ndarray] = None,
-                 node_side: Optional[np.ndarray] = None):
+                 node_side: Optional[np.ndarray] = None,
+                 sparse: Optional[str] = None):
         if topo.n != params.n:
             raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
         if cut_mask is not None and topo.nbrs is None:
@@ -303,6 +319,19 @@ class CompressedSim:
         self._kernels, self._kernels_interpret = kernel_ops.resolve_path()
         self._fused_gather = (self._kernels == "pallas"
                               and kernel_ops.fused_gather_enabled())
+        # Sparse-frontier execution mode (ops/sparse.py, docs/sparse.md):
+        # resolved once at construction like the kernel path; the caps
+        # are static — they shape the compacted program.
+        self._sparse_mode = sparse_ops.resolve_sparse(sparse)
+        cap = params.sparse_cap or sparse_ops.default_frontier_cap(params.n)
+        self._sparse_caps = (min(params.n, cap),
+                             min(params.n, cap * params.fanout),
+                             min(params.n, cap))
+        # The most recent sparse dispatch's int32 [3] stats vector
+        # (sparse rounds, overflow rounds, frontier high-water mark) —
+        # a DEVICE array, so grabbing the handle right after a
+        # pipelined dispatch never blocks; None after dense dispatches.
+        self.last_sparse_stats = None
 
     # -- state construction -------------------------------------------------
 
@@ -367,7 +396,7 @@ class CompressedSim:
     # -- kernels ------------------------------------------------------------
 
     def _publish(self, state: CompressedState, limit: int,
-                 row_offset=0):
+                 row_offset=0, force_xla=False):
         """The message board: each node's top-``budget`` freshest
         eligible cache lines, in place (``[N, K]``, unselected lines
         zeroed).  Eligible = occupied with transmits left.
@@ -403,7 +432,7 @@ class CompressedSim:
         kw = dict(budget=min(p.budget, p.cache_lines), limit=limit,
                   fanout=p.fanout, cache_lines=p.cache_lines,
                   row_offset=row_offset)
-        if self._kernels == "pallas":
+        if self._kernels == "pallas" and not force_xla:
             return kernel_ops.publish_board_pallas(
                 state.cache_val, state.cache_slot, state.cache_sent,
                 interpret=self._kernels_interpret, **kw)
@@ -866,22 +895,25 @@ class CompressedSim:
             state, own=own, floor=floor_swept, cache_slot=cache_slot,
             cache_val=swept_val, cache_sent=cache_sent)
 
-    def _step(self, state: CompressedState,
-              key: jax.Array) -> CompressedState:
+    def _round_gossip_announce(self, state: CompressedState, src, k_drop,
+                               round_idx, now, force_xla=False,
+                               ann=None):
+        """Phases 1 + 2 of the round — publish/pull/merge + announce —
+        the DENSE form, extracted so the sparse step's overflow
+        fallback (``_step_sparse``) is literally this function.
+        ``force_xla`` pins the publish/gather to the XLA twin (the
+        sparse program's fallback branch — bit-identical to the Pallas
+        path by the kernel parity contract, and it keeps the Pallas
+        interpreter out of a ``lax.cond`` branch that rarely runs).
+        ``ann`` is the announce own/floor half when the caller already
+        computed it (the sparse step needs it for the announcer
+        frontier either way) — identical values, one O(N·S) pass
+        instead of two on overflow rounds."""
         p, t = self.p, self.t
         limit = p.resolved_retransmit_limit()
-        round_idx = state.round_idx + 1
-        now = round_idx * t.round_ticks
-        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
-
-        if self.perturb is not None:
-            state = self.perturb(state, k_perturb, now)
 
         # 1. publish the board (pre-round snapshot) + pull deliveries.
-        src = gossip_ops.sample_peers(
-            k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
-            node_alive=state.node_alive, cut_mask=self._cut)
-        if self._fused_gather:
+        if self._fused_gather and not force_xla:
             # Fused Pallas path: publish selection + staleness gate +
             # board row-gather in one kernel — the [N, K] board never
             # touches HBM (ops/kernels, bit-identical to the XLA path).
@@ -896,14 +928,39 @@ class CompressedSim:
                                        drop_key=k_drop,
                                        stale_filtered=True)
         else:
-            bval, bslot, sent = self._publish(state, limit)
+            bval, bslot, sent = self._publish(state, limit,
+                                              force_xla=force_xla)
             state = self._pull_merge(state, sent, bval, bslot, src,
                                      state.node_alive, now,
                                      drop_key=k_drop)
 
         # 2. announce re-stamps + recovery offers (end of round, like the
         # exact model: broadcastable the following round).
-        state = self._announce(state, round_idx, now)
+        if ann is None:
+            return self._announce(state, round_idx, now)
+        own1, floor1, offer_val, base_slot = ann
+        cv, cs, se, ev = self._insert_own_offers(
+            state.cache_val, state.cache_slot, state.cache_sent,
+            offer_val, base_slot, reset_on_hold=True)
+        return dataclasses.replace(
+            state, own=own1, floor=floor1, cache_slot=cs, cache_val=cv,
+            cache_sent=se, evictions=state.evictions + ev)
+
+    def _step(self, state: CompressedState,
+              key: jax.Array) -> CompressedState:
+        p, t = self.p, self.t
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+
+        src = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
+            node_alive=state.node_alive, cut_mask=self._cut)
+        state = self._round_gossip_announce(state, src, k_drop,
+                                            round_idx, now)
 
         # 3. anti-entropy.
         state = lax.cond(
@@ -918,6 +975,171 @@ class CompressedSim:
             lambda st: st, state)
 
         return dataclasses.replace(state, round_idx=round_idx)
+
+    # -- the sparse-frontier round (docs/sparse.md) --------------------------
+
+    def _sparse_frontiers(self, state: CompressedState, src, limit,
+                          round_idx, now):
+        """The three bounded frontiers of a round, plus the dense-cheap
+        announce precompute shared by both branches:
+
+        * **senders** — rows with any ELIGIBLE line (occupied AND
+          transmits left).  TransmitLimited is what makes the tail
+          sparse: an exhausted relay still HOLDS its copy but publishes
+          nothing, so its board is empty and its ``sent`` never bumps.
+        * **receivers** — alive rows that sampled ≥ 1 active sender;
+          every other row's pull folds only empty boards (a provable
+          no-op: ``wv == cv0`` ⇒ no change, no reset, no eviction).
+        * **announcers** — rows with any refresh/recovery offer; the
+          own/floor half of announce is elementwise O(N·S) and runs
+          dense in both branches (``_announce_offers`` reads neither
+          the cache nor the board)."""
+        sender = jnp.any(kernel_ops.eligible_lines(
+            state.cache_slot, state.cache_sent, limit), axis=1)
+        recv = state.node_alive & jnp.any(sender[src], axis=1)
+        own1, floor1, offer_val, base_slot = self._announce_offers(
+            state.own, state.floor, state.node_alive, round_idx, now)
+        announcer = jnp.any(offer_val > 0, axis=1)
+        return sender, recv, announcer, (own1, floor1, offer_val,
+                                         base_slot)
+
+    def _round_gossip_announce_sparse(self, st: CompressedState, src,
+                                      k_drop, now, sender, recv,
+                                      announcer, ann):
+        """Phases 1 + 2 on the COMPACTED frontier views — bit-identical
+        to ``_round_gossip_announce`` when no frontier overflows (the
+        caller guards that with the dense fallback).  All write-backs
+        are gather+select (``compact[pos]`` under the frontier mask) —
+        the round keeps the model's zero-per-round-scatter budget; the
+        only scatters are the O(N) inverse-position builds in
+        ``compact_rows``."""
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        n, k = p.n, p.cache_lines
+        cs_cap, cr_cap, ca_cap = self._sparse_caps
+        own1, floor1, offer_val, base_slot = ann
+
+        # Senders: publish the compacted board (the XLA twin with
+        # explicit global row ids — the dense tie rotation per row).
+        idx_s, row_s, valid_s, pos_s = sparse_ops.compact_rows(
+            sender, cs_cap)
+        cv_s = jnp.where(valid_s[:, None], st.cache_val[row_s], 0)
+        sl_s = jnp.where(valid_s[:, None], st.cache_slot[row_s], -1)
+        bval_c, bslot_c, sent_c = kernel_ops.publish_board_xla(
+            cv_s, sl_s, st.cache_sent[row_s],
+            budget=min(p.budget, k), limit=limit, fanout=p.fanout,
+            cache_lines=k, row_ids=idx_s)
+        sent = jnp.where(sender[:, None], sent_c[pos_s], st.cache_sent)
+        # Board staleness gate once, on the compacted board; the pad
+        # row at index cs_cap is the "inactive sender" — an all-zero
+        # board, the merge no-op every non-frontier row serves in the
+        # dense round too.
+        bval_c = jnp.where(staleness_mask(bval_c, now, t.stale_ticks),
+                           0, bval_c)
+        bval_p = jnp.concatenate(
+            [bval_c, jnp.zeros((1, k), jnp.int32)])
+        bslot_p = jnp.concatenate(
+            [bslot_c, jnp.full((1, k), -1, jnp.int32)])
+        bpos = jnp.where(sender, pos_s, cs_cap)            # [N]
+
+        # Receivers: pull the compacted boards and fold.
+        idx_r, row_r, valid_r, pos_r = sparse_ops.compact_rows(
+            recv, cr_cap)
+        src_r = src[row_r]                                 # [Cr, F]
+        pv = bval_p[bpos[src_r]]                           # [Cr, F, K]
+        ps = bslot_p[bpos[src_r]]
+        ok = st.node_alive[src_r] & \
+            (st.node_alive[row_r] & valid_r)[:, None]
+        keep_r = None
+        if p.drop_prob > 0.0:
+            # The dense draw, sliced: the loss stream is
+            # mode-independent (ops/sparse.py module docstring).
+            keep = jax.random.bernoulli(k_drop, 1.0 - p.drop_prob,
+                                        (n, p.fanout, k))
+            keep_r = keep[row_r]
+        cv0_r, cs0_r = st.cache_val[row_r], st.cache_slot[row_r]
+        wv, ws = self._fold_pulled(cv0_r, cs0_r, cv0_r, cs0_r, pv, ps,
+                                   ok, now, keep=keep_r,
+                                   stale_filtered=True)
+        sent_r = sent[row_r]
+        changed = (wv != cv0_r) | (ws != cs0_r)
+        sent_r = jnp.where(changed, jnp.int8(0), sent_r)
+        ev = jnp.sum(((cs0_r >= 0) & (ws != cs0_r)).astype(jnp.int32))
+
+        recv_c = recv[:, None]
+        cache_val = jnp.where(recv_c, wv[pos_r], st.cache_val)
+        cache_slot = jnp.where(recv_c, ws[pos_r], st.cache_slot)
+        cache_sent = jnp.where(recv_c, sent_r[pos_r], sent)
+
+        # Announcers: the cache insert on the compacted rows (own/floor
+        # already advanced dense in ``_sparse_frontiers``; the insert
+        # reads the POST-merge cache, exactly the dense phase order).
+        idx_a, row_a, valid_a, pos_a = sparse_ops.compact_rows(
+            announcer, ca_cap)
+        off_a = jnp.where(valid_a[:, None], offer_val[row_a], 0)
+        cv2, cs2, se2, ev_a = self._insert_own_offers(
+            cache_val[row_a], cache_slot[row_a], cache_sent[row_a],
+            off_a, base_slot[row_a], reset_on_hold=True)
+        ann_c = announcer[:, None]
+        cache_val = jnp.where(ann_c, cv2[pos_a], cache_val)
+        cache_slot = jnp.where(ann_c, cs2[pos_a], cache_slot)
+        cache_sent = jnp.where(ann_c, se2[pos_a], cache_sent)
+
+        return dataclasses.replace(
+            st, own=own1, floor=floor1, cache_slot=cache_slot,
+            cache_val=cache_val, cache_sent=cache_sent,
+            evictions=st.evictions + ev + ev_a)
+
+    def _step_sparse(self, state: CompressedState, key: jax.Array):
+        """One round on the sparse path: compute the frontiers, run the
+        compacted phases when they fit their caps, fall back to the
+        dense round (same program, ``lax.cond``) when any overflows —
+        bit-identical either way.  Returns ``(state, stats[3])`` with
+        stats = (ran-sparse, overflowed, frontier size)."""
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+
+        src = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
+            node_alive=state.node_alive, cut_mask=self._cut)
+
+        sender, recv, announcer, ann = self._sparse_frontiers(
+            state, src, limit, round_idx, now)
+        cs_cap, cr_cap, ca_cap = self._sparse_caps
+        n_s = jnp.sum(sender.astype(jnp.int32))
+        n_r = jnp.sum(recv.astype(jnp.int32))
+        n_a = jnp.sum(announcer.astype(jnp.int32))
+        overflow = (n_s > cs_cap) | (n_r > cr_cap) | (n_a > ca_cap)
+        frontier = jnp.maximum(n_s, jnp.maximum(n_r, n_a))
+
+        state = lax.cond(
+            overflow,
+            lambda st: self._round_gossip_announce(
+                st, src, k_drop, round_idx, now, force_xla=True,
+                ann=ann),
+            lambda st: self._round_gossip_announce_sparse(
+                st, src, k_drop, now, sender, recv, announcer, ann),
+            state)
+
+        # 3 + 4 — cadence-amortized, dense in both modes.
+        state = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            lambda st: self._push_pull_stride(st, k_pp, now),
+            lambda st: st, state)
+        state = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            lambda st: self._floor_advance_and_sweep(st, now),
+            lambda st: st, state)
+
+        ov = overflow.astype(jnp.int32)
+        stats = jnp.stack([1 - ov, ov, frontier])
+        return dataclasses.replace(state, round_idx=round_idx), stats
 
     # -- metrics ------------------------------------------------------------
 
@@ -1077,12 +1299,24 @@ class CompressedSim:
             start_round = int(state.round_idx)
         self.t.validate_horizon(start_round + num_rounds)
 
+    def _resolve_sparse_request(self, sparse):
+        return sparse_ops.resolve_request(self._sparse_mode, sparse,
+                                          self.supports_sparse)
+
     def step(self, state, key):
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
 
+    def step_sparse(self, state, key):
+        """One sparse-path round; returns ``(state, stats[3])`` — the
+        lockstep suites' probe (drivers report stats via
+        ``last_sparse_stats`` instead, keeping their arity stable)."""
+        self._resolve_sparse_request(True)
+        self._check_horizon(state, 1)
+        return self._step_sparse_jit(state, key)
+
     def run(self, state, key, num_rounds: int, conv_every: int = 1,
-            donate: bool = True, start_round=None):
+            donate: bool = True, start_round=None, sparse=None):
         """Run ``num_rounds``, sampling the convergence metric every
         ``conv_every`` rounds (the returned curve has
         ``num_rounds // conv_every`` points, at rounds ``conv_every,
@@ -1097,10 +1331,16 @@ class CompressedSim:
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, conv, stats = self._run_sparse_jit(
+                state, key, num_rounds, conv_every)
+            self.last_sparse_stats = stats
+            return final, conv
+        self.last_sparse_stats = None
         return self._run_jit(state, key, num_rounds, conv_every)
 
     def run_behind(self, state, key, num_rounds: int, every: int = 1,
-                   donate: bool = True, start_round=None):
+                   donate: bool = True, start_round=None, sparse=None):
         """Like :meth:`run` but sampling the raw behind COUNT
         (:meth:`behind`) instead of the normalized fraction — the
         bench's ε-crossing detector, immune to float32 resolution loss
@@ -1111,17 +1351,29 @@ class CompressedSim:
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, behind, stats = self._run_behind_sparse_jit(
+                state, key, num_rounds, every)
+            self.last_sparse_stats = stats
+            return final, behind
+        self.last_sparse_stats = None
         return self._run_behind_jit(state, key, num_rounds, every)
 
     def run_fast(self, state, key, num_rounds: int, donate: bool = True,
-                 start_round=None):
+                 start_round=None, sparse=None):
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, stats = self._run_fast_sparse_jit(state, key,
+                                                     num_rounds)
+            self.last_sparse_stats = stats
+            return final
+        self.last_sparse_stats = None
         return self._run_fast_jit(state, key, num_rounds)
 
     def run_with_deltas(self, state, key, num_rounds: int, cap: int,
-                        donate: bool = True):
+                        donate: bool = True, sparse=None):
         """Scan with per-round changed-belief extraction: returns
         ``(final state, DeltaBatch[num_rounds])``.  The belief view
         ``max(floor, cache hit, own)`` is materialized per round
@@ -1132,6 +1384,12 @@ class CompressedSim:
         self._check_horizon(state, num_rounds)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, deltas, stats = self._run_deltas_sparse_jit(
+                state, key, num_rounds, cap)
+            self.last_sparse_stats = stats
+            return final, deltas
+        self.last_sparse_stats = None
         return self._run_deltas_jit(state, key, num_rounds, cap)
 
     # no-donate: single-round stepping is the oracle/replay path — those
@@ -1139,6 +1397,12 @@ class CompressedSim:
     @functools.partial(jax.jit, static_argnums=0)
     def _step_jit(self, state, key):
         return self._step(state, key)
+
+    # no-donate: the sparse single-round probe serves the same
+    # oracle/replay callers as _step_jit.
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_sparse_jit(self, state, key):
+        return self._step_sparse(state, key)
 
     # Per-round keys fold the round index into the base key so chunked/
     # resumed runs replay identical randomness (see ExactSim).
@@ -1189,6 +1453,82 @@ class CompressedSim:
         (final, _), deltas = lax.scan(body, (state, belief(state)), None,
                                       length=num_rounds)
         return final, deltas
+
+    # -- sparse-path scan drivers (docs/sparse.md) ---------------------------
+    # Mirrors of the dense drivers above: same donation, same per-round
+    # key folding (sparse chunks pipeline/resume interchangeably with
+    # dense ones), plus an int32 [3] stats accumulator in the carry
+    # (sparse rounds, overflow rounds, frontier high-water mark) that
+    # the public wrappers surface through ``last_sparse_stats``.
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_sparse_jit(self, state, key, num_rounds, conv_every=1):
+        def inner(carry, _):
+            st, acc = carry
+            st, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            return (st, sparse_ops.accumulate_stats(acc, s)), None
+
+        def body(carry, _):
+            carry, _ = lax.scan(inner, carry, None, length=conv_every)
+            return carry, self.convergence(carry[0])
+
+        (final, stats), conv = lax.scan(
+            body, (state, sparse_ops.zero_stats()), None,
+            length=num_rounds // conv_every)
+        return final, conv, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_behind_sparse_jit(self, state, key, num_rounds, every):
+        def inner(carry, _):
+            st, acc = carry
+            st, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            return (st, sparse_ops.accumulate_stats(acc, s)), None
+
+        def body(carry, _):
+            carry, _ = lax.scan(inner, carry, None, length=every)
+            return carry, self.behind(carry[0])
+
+        (final, stats), behind = lax.scan(
+            body, (state, sparse_ops.zero_stats()), None,
+            length=num_rounds // every)
+        return final, behind, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
+    def _run_fast_sparse_jit(self, state, key, num_rounds):
+        def body(carry, _):
+            st, acc = carry
+            st, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            return (st, sparse_ops.accumulate_stats(acc, s)), None
+
+        (final, stats), _ = lax.scan(
+            body, (state, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_deltas_sparse_jit(self, state, key, num_rounds, cap):
+        # Lazy import — ops/delta imports this module's hash_line.
+        from sidecar_tpu.ops.delta import compressed_belief, extract_delta
+
+        def belief(st):
+            return compressed_belief(st.own, st.cache_slot, st.cache_val,
+                                     st.floor, self.p.services_per_node)
+
+        def body(carry, _):
+            st, bel, acc = carry
+            st2, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            bel2 = belief(st2)
+            return (st2, bel2, sparse_ops.accumulate_stats(acc, s)), \
+                extract_delta(bel, bel2, cap)
+
+        (final, _, stats), deltas = lax.scan(
+            body, (state, belief(state), sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, deltas, stats
 
 
 # -- host-path kernels ------------------------------------------------------

@@ -51,6 +51,7 @@ from jax import lax
 
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, is_known, pack, unpack_status, unpack_ts
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
@@ -91,6 +92,11 @@ class SimParams:
     retransmit_limit: int = 0   # 0 = auto: RetransmitMult(4) × ⌈log10(n+1)⌉
                                 # transmissions per record version (memberlist
                                 # TransmitLimited semantics)
+    sparse_cap: int = 0         # C — static sender-frontier width of the
+                                # sparse round (0 = auto); rounds whose
+                                # eligible-sender set exceeds it fall back
+                                # to the dense round, bit-identically
+                                # (docs/sparse.md)
 
     def __post_init__(self):
         # The int8 transmit counters are unclamped scatter-adds bounded
@@ -122,16 +128,29 @@ PerturbFn = Callable[[SimState, jax.Array, jax.Array], SimState]
 class ExactSim:
     """Single-chip exact simulator (multi-chip: ``sidecar_tpu.parallel``)."""
 
+    # Whether this sim implements the sparse-frontier round; the chaos
+    # wrapper overrides to False (its fault-gated round stays dense —
+    # the delay rings/packet masks are already bounded structures).
+    supports_sparse = True
+
     def __init__(self, params: SimParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  perturb: Optional[PerturbFn] = None,
-                 cut_mask: Optional[np.ndarray] = None):
+                 cut_mask: Optional[np.ndarray] = None,
+                 sparse: Optional[str] = None):
         if topo.n != params.n:
             raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
         self.p = params
         self.t = timecfg
         self.topo = topo
         self.perturb = perturb
+        # Sparse-frontier mode (ops/sparse.py, docs/sparse.md): resolved
+        # once at construction, like the compressed model.
+        self._sparse_mode = sparse_ops.resolve_sparse(sparse)
+        self._sparse_cap = min(
+            params.n,
+            params.sparse_cap or sparse_ops.default_frontier_cap(params.n))
+        self.last_sparse_stats = None
         if cut_mask is not None and topo.nbrs is None:
             raise ValueError(
                 "cut_mask requires a neighbor-list topology (mesh/ring/ER/BA);"
@@ -189,23 +208,16 @@ class ExactSim:
         rows = jnp.where(due, self.owner, p.n)     # OOB row drops the entry
         return rows, cols, vals, due
 
-    def _step(self, state: SimState, key: jax.Array) -> SimState:
+    def _round_deliver_announce(self, known, sent, node_alive, dst,
+                                k_drop, round_idx, now):
+        """Phases 1 + 2 of the round (select → deliveries → announce →
+        the combined scatter) — the DENSE form, extracted so the sparse
+        step's overflow fallback is literally this function.  Returns
+        ``(known, sent)``."""
         p, t = self.p, self.t
         limit = p.resolved_retransmit_limit()
-        round_idx = state.round_idx + 1
-        now = round_idx * t.round_ticks
-        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
-
-        if self.perturb is not None:
-            state = self.perturb(state, k_perturb, now)
-        known, sent, node_alive = state.known, state.sent, state.node_alive
 
         # 1. select + gossip deliveries (from the pre-round state).
-        dst = gossip_ops.sample_peers(
-            k_peers, p.n, p.fanout,
-            nbrs=self._nbrs, deg=self._deg,
-            node_alive=node_alive, cut_mask=self._cut,
-        )
         svc_idx, msg = gossip_ops.select_messages(
             known, sent, p.budget, limit)
         sent = gossip_ops.record_transmissions(
@@ -225,8 +237,77 @@ class ExactSim:
         cols = jnp.concatenate([d_cols, a_cols])
         vals = jnp.concatenate([d_vals, a_vals])
         advanced = jnp.concatenate([d_adv, a_due])
-        known, sent = gossip_ops.apply_updates(
+        return gossip_ops.apply_updates(
             known, sent, rows, cols, vals, advanced)
+
+    def _round_deliver_announce_sparse(self, known, sent, node_alive,
+                                       dst, k_drop, round_idx, now,
+                                       sender):
+        """Phases 1 + 2 on the compacted sender frontier — bit-identical
+        to the dense form when the frontier fits (the caller guards with
+        the dense fallback).  Only SENDERS compact on the exact model:
+        deliveries are pushes, so the update triples shrink to
+        ``C·F·B`` and the select/top-k runs on ``[C, M]``; the combined
+        scatter-max is commutative, and every omitted row's triples are
+        provable no-ops (no eligible records ⇒ ``msg == 0`` ⇒ val 0 /
+        OOB svc).  The scatter itself stays — the measured dense-model
+        floor (benchmarks/RESULTS.md), so the exact model's sparse win
+        is the select side, not the apply."""
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        n = p.n
+
+        idx_s, row_s, valid_s, _ = sparse_ops.compact_rows(
+            sender, self._sparse_cap)
+        kn_s = jnp.where(valid_s[:, None], known[row_s], 0)
+        svc_c, msg_c = gossip_ops.select_messages(
+            kn_s, sent[row_s], p.budget, limit, row_ids=idx_s)
+        sent = gossip_ops.record_transmissions(
+            sent, svc_c, msg_c, p.fanout, limit, row_ids=idx_s)
+
+        keep_c = None
+        if p.drop_prob > 0.0:
+            # The dense-shaped draw, sliced — the loss stream is
+            # mode-independent (ops/sparse.py).
+            keep = jax.random.bernoulli(
+                k_drop, 1.0 - p.drop_prob,
+                (n, p.fanout, svc_c.shape[1]))
+            keep_c = keep[row_s]
+        d_rows, d_cols, d_vals, d_adv = gossip_ops.prepare_deliveries(
+            known, dst[row_s], svc_c, msg_c,
+            now_tick=now, stale_ticks=t.stale_ticks,
+            node_alive=node_alive,
+            sender_alive=node_alive[row_s] & valid_s,
+            record_keep=keep_c,
+        )
+
+        a_rows, a_cols, a_vals, a_due = self._announce_updates(
+            known, node_alive, round_idx, now)
+
+        rows = jnp.concatenate([d_rows, a_rows])
+        cols = jnp.concatenate([d_cols, a_cols])
+        vals = jnp.concatenate([d_vals, a_vals])
+        advanced = jnp.concatenate([d_adv, a_due])
+        return gossip_ops.apply_updates(
+            known, sent, rows, cols, vals, advanced)
+
+    def _step(self, state: SimState, key: jax.Array) -> SimState:
+        p, t = self.p, self.t
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+        known, sent, node_alive = state.known, state.sent, state.node_alive
+
+        dst = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+        )
+        known, sent = self._round_deliver_announce(
+            known, sent, node_alive, dst, k_drop, round_idx, now)
 
         # 3. anti-entropy push-pull (amortized: every push_pull_rounds).
         pp_partner = gossip_ops.sample_peers(
@@ -267,6 +348,82 @@ class ExactSim:
         return SimState(known=known, sent=sent, node_alive=node_alive,
                         round_idx=round_idx)
 
+    def _step_sparse(self, state: SimState, key: jax.Array):
+        """One round on the sparse path (docs/sparse.md): the sender
+        frontier — rows with any ELIGIBLE record (TransmitLimited
+        budget left on a known cell) — is compacted when it fits the
+        static cap, with a ``lax.cond`` dense fallback when it
+        overflows; bit-identical either way.  Returns
+        ``(state, stats[3])``."""
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+        known, sent, node_alive = state.known, state.sent, state.node_alive
+
+        dst = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+        )
+        sender = jnp.any(
+            gossip_ops.eligible_records(known, sent, limit), axis=1)
+        n_s = jnp.sum(sender.astype(jnp.int32))
+        overflow = n_s > self._sparse_cap
+
+        known, sent = lax.cond(
+            overflow,
+            lambda ks: self._round_deliver_announce(
+                ks[0], ks[1], node_alive, dst, k_drop, round_idx, now),
+            lambda ks: self._round_deliver_announce_sparse(
+                ks[0], ks[1], node_alive, dst, k_drop, round_idx, now,
+                sender),
+            (known, sent))
+
+        # 3 + 4 — cadence-amortized, dense in both modes (identical to
+        # the dense step's tail).
+        pp_partner = gossip_ops.sample_peers(
+            k_pp, p.n, 1,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+        )[:, 0]
+
+        def do_push_pull(kn_se):
+            kn, se = kn_se
+            merged = gossip_ops.push_pull(
+                kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
+                node_alive=node_alive)
+            se = jnp.where(merged != kn, jnp.int8(0), se)
+            return merged, se
+
+        known, sent = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            do_push_pull, lambda kn_se: kn_se, (known, sent))
+
+        def do_sweep(kn_se):
+            kn, se = kn_se
+            swept, expired = ttl_sweep(
+                kn, now,
+                alive_lifespan=t.alive_lifespan,
+                draining_lifespan=t.draining_lifespan,
+                tombstone_lifespan=t.tombstone_lifespan,
+                one_second=t.one_second)
+            se = jnp.where(swept != kn, jnp.int8(0), se)
+            return swept, se
+
+        known, sent = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            do_sweep, lambda kn_se: kn_se, (known, sent))
+
+        ov = overflow.astype(jnp.int32)
+        stats = jnp.stack([1 - ov, ov, n_s])
+        return SimState(known=known, sent=sent, node_alive=node_alive,
+                        round_idx=round_idx), stats
+
     def convergence(self, state: SimState) -> jax.Array:
         """Fraction of (alive-node, slot) cells agreeing with the global
         freshest belief — 1.0 means every live node has converged."""
@@ -301,31 +458,56 @@ class ExactSim:
             start_round = int(state.round_idx)
         self.t.validate_horizon(start_round + num_rounds)
 
+    def _resolve_sparse_request(self, sparse):
+        return sparse_ops.resolve_request(self._sparse_mode, sparse,
+                                          self.supports_sparse)
+
     def step(self, state: SimState, key: jax.Array) -> SimState:
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
 
+    def step_sparse(self, state: SimState, key: jax.Array):
+        """One sparse-path round → ``(state, stats[3])`` — the lockstep
+        suites' probe."""
+        self._resolve_sparse_request(True)
+        self._check_horizon(state, 1)
+        return self._step_sparse_jit(state, key)
+
     def run(self, state: SimState, key: jax.Array, num_rounds: int,
-            donate: bool = True, start_round=None):
+            donate: bool = True, start_round=None, sparse=None):
         """Scan ``num_rounds`` gossip rounds; returns (final state,
         per-round convergence fraction [num_rounds]).  Donates ``state``
-        unless ``donate=False`` (see the drivers note above)."""
+        unless ``donate=False`` (see the drivers note above).
+        ``sparse`` selects the sparse-frontier round (docs/sparse.md);
+        the dispatch's stats land in ``last_sparse_stats``."""
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, conv, stats = self._run_sparse_jit(state, key,
+                                                      num_rounds)
+            self.last_sparse_stats = stats
+            return final, conv
+        self.last_sparse_stats = None
         return self._run_jit(state, key, num_rounds)
 
     def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
-                 donate: bool = True):
+                 donate: bool = True, sparse=None):
         """Scan without per-round metrics — the benchmark path."""
         self._check_horizon(state, num_rounds)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, stats = self._run_fast_sparse_jit(state, key,
+                                                     num_rounds)
+            self.last_sparse_stats = stats
+            return final
+        self.last_sparse_stats = None
         return self._run_fast_jit(state, key, num_rounds)
 
     def run_with_deltas(self, state: SimState, key: jax.Array,
                         num_rounds: int, cap: int, donate: bool = True,
-                        start_round=None):
+                        start_round=None, sparse=None):
         """Scan with per-round changed-cell extraction (ops/delta.py):
         returns ``(final state, DeltaBatch[num_rounds], conv
         [num_rounds])``.  The diff runs inside the scan on consecutive
@@ -336,6 +518,12 @@ class ExactSim:
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, deltas, conv, stats = self._run_deltas_sparse_jit(
+                state, key, num_rounds, cap)
+            self.last_sparse_stats = stats
+            return final, deltas, conv
+        self.last_sparse_stats = None
         return self._run_deltas_jit(state, key, num_rounds, cap)
 
     # no-donate: single-round stepping is the oracle/replay path — those
@@ -343,6 +531,12 @@ class ExactSim:
     @functools.partial(jax.jit, static_argnums=0)
     def _step_jit(self, state: SimState, key: jax.Array) -> SimState:
         return self._step(state, key)
+
+    # no-donate: the sparse single-round probe serves the same
+    # oracle/replay callers as _step_jit.
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_sparse_jit(self, state: SimState, key: jax.Array):
+        return self._step_sparse(state, key)
 
     # Per-round keys are derived by folding the round index into the base
     # key (not by splitting over num_rounds), so a checkpointed run
@@ -380,3 +574,58 @@ class ExactSim:
         final, (deltas, conv) = lax.scan(body, state, None,
                                          length=num_rounds)
         return final, deltas, conv
+
+    # -- sparse-path scan drivers (docs/sparse.md) ---------------------------
+    # Mirrors of the dense drivers: same donation, same per-round key
+    # folding (sparse chunks pipeline/resume interchangeably with dense
+    # ones), plus the int32 [3] stats accumulator surfaced through
+    # ``last_sparse_stats``.
+
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
+    def _run_sparse_jit(self, state: SimState, key: jax.Array,
+                        num_rounds: int):
+        def body(carry, _):
+            st, acc = carry
+            st, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            return (st, sparse_ops.accumulate_stats(acc, s)), \
+                self.convergence(st)
+
+        (final, stats), conv = lax.scan(
+            body, (state, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, conv, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
+    def _run_fast_sparse_jit(self, state: SimState, key: jax.Array,
+                             num_rounds: int):
+        def body(carry, _):
+            st, acc = carry
+            st, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            return (st, sparse_ops.accumulate_stats(acc, s)), None
+
+        (final, stats), _ = lax.scan(
+            body, (state, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_deltas_sparse_jit(self, state: SimState, key: jax.Array,
+                               num_rounds: int, cap: int):
+        # Lazy import: ops/delta pulls in the compressed model's line
+        # hash, and a module-level import would cycle through models.
+        from sidecar_tpu.ops.delta import extract_delta
+
+        def body(carry, _):
+            st, acc = carry
+            st2, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            return (st2, sparse_ops.accumulate_stats(acc, s)), \
+                (extract_delta(st.known, st2.known, cap),
+                 self.convergence(st2))
+
+        (final, stats), (deltas, conv) = lax.scan(
+            body, (state, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, deltas, conv, stats

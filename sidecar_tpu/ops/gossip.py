@@ -154,7 +154,21 @@ def eligible_mask(sent, limit):
     return sent.astype(jnp.int32) < limit
 
 
-def select_messages(known, sent, budget, limit, row_offset=0):
+def eligible_records(known, sent, limit):
+    """A record the dense select could actually publish: known
+    (packed key > 0) AND transmissions left.  ONE definition — the
+    sparse sender frontier (models/exact.py ``_step_sparse``,
+    parallel/sharded.py) must be exactly the rows where
+    :func:`select_messages` would offer anything (its ``priority``
+    zeroes the same cells), or an eligible row could be silently
+    excluded from the frontier with no overflow signal, breaking
+    dense==sparse bit-identity (the kernels.eligible_lines contract,
+    exact-family form)."""
+    return eligible_mask(sent, limit) & (known > 0)
+
+
+def select_messages(known, sent, budget, limit, row_offset=0,
+                    row_ids=None):
     """Top-``budget`` freshest *eligible* records per node.
 
     The reference's broadcast queue (``GetBroadcasts`` draining
@@ -175,7 +189,11 @@ def select_messages(known, sent, budget, limit, row_offset=0):
     at a hashed offset — which spreads cold-start coverage across the
     cluster.  Values are untouched; only equal-value ordering varies by
     node.  ``row_offset`` is the global id of row 0 (sharded callers
-    pass their block offset so rotation follows global node identity).
+    pass their block offset so rotation follows global node identity);
+    ``row_ids`` overrides it with EXPLICIT per-row global ids — the
+    sparse-frontier path selects over a compacted, non-contiguous row
+    set and must reproduce each row's dense rotation exactly
+    (ops/sparse.py).
 
     Returns (svc_idx[N, B], msg[N, B]) — ``msg`` is 0 (merge no-op) in
     slots where a node has fewer than ``budget`` eligible records, and
@@ -187,7 +205,8 @@ def select_messages(known, sent, budget, limit, row_offset=0):
     priority = jnp.where(eligible_mask(sent, limit), known, 0)
     n, m = priority.shape
     budget = min(budget, m)  # tiny catalogs: can't offer more than exists
-    rows = jnp.arange(n, dtype=jnp.int32) + row_offset
+    rows = (row_ids if row_ids is not None
+            else jnp.arange(n, dtype=jnp.int32) + row_offset)
     rot = rows.astype(jnp.uint32) * jnp.uint32(PHASE_MULT)
 
     if m <= 4 * 1024:
@@ -256,7 +275,8 @@ def select_messages(known, sent, budget, limit, row_offset=0):
 
 def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
                       node_alive=None, drop_prob=0.0, drop_key=None,
-                      edge_keep=None):
+                      edge_keep=None, sender_alive=None,
+                      record_keep=None):
     """Expand each sender's message batch into RAW flat (row, col, val)
     update triples — every gate applied EXCEPT the pre-round stickiness
     resolution (:func:`finalize_deliveries`), which callers that defer
@@ -266,7 +286,15 @@ def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
     sender/receiver, ``drop_prob`` (uniform UDP loss), and ``edge_keep``
     — an optional bool [N, F] PACKET-level mask from the fault-injection
     layer (a dropped UDP packet loses all ``B`` records it carries,
-    unlike the per-record ``drop_prob``; see sidecar_tpu/chaos/)."""
+    unlike the per-record ``drop_prob``; see sidecar_tpu/chaos/).
+
+    ``sender_alive`` overrides the sender-liveness gate for compacted
+    sender batches whose rows are NOT node ids (the sparse-frontier
+    path — ``node_alive`` keeps gating receivers through ``dst``).
+    ``record_keep`` is a pre-drawn bool ``[rows, F, B]`` keep mask
+    replacing the in-call ``drop_prob`` draw: the sparse path draws ONE
+    dense-shaped mask and slices its frontier rows, so the loss stream
+    is mode-independent (pass ``drop_prob=0`` with it)."""
     n, fanout = dst.shape
     budget = svc_idx.shape[1]
 
@@ -277,12 +305,16 @@ def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
     val = jnp.where(staleness_mask(val, now_tick, stale_ticks), 0, val)
 
     if node_alive is not None:
-        val = jnp.where(node_alive[:, None, None], val, 0)
+        snd = sender_alive if sender_alive is not None else node_alive
+        val = jnp.where(snd[:, None, None], val, 0)
         val = jnp.where(node_alive[tgt], val, 0)
 
     if drop_prob > 0.0:
         keep = jax.random.bernoulli(drop_key, 1.0 - drop_prob, val.shape)
         val = jnp.where(keep, val, 0)
+
+    if record_keep is not None:
+        val = jnp.where(record_keep, val, 0)
 
     if edge_keep is not None:
         val = jnp.where(edge_keep[:, :, None], val, 0)
@@ -307,7 +339,8 @@ def finalize_deliveries(known, rows, cols, vals):
 
 def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
                        node_alive=None, drop_prob=0.0, drop_key=None,
-                       edge_keep=None):
+                       edge_keep=None, sender_alive=None,
+                       record_keep=None):
     """Expand each sender's message batch into flat (row, col, val) update
     triples with all merge semantics pre-applied.
 
@@ -326,7 +359,8 @@ def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
     rows, cols, vals = expand_deliveries(
         dst, svc_idx, msg, now_tick=now_tick, stale_ticks=stale_ticks,
         node_alive=node_alive, drop_prob=drop_prob, drop_key=drop_key,
-        edge_keep=edge_keep)
+        edge_keep=edge_keep, sender_alive=sender_alive,
+        record_keep=record_keep)
     vals, advanced = finalize_deliveries(known, rows, cols, vals)
     return rows, cols, vals, advanced
 
@@ -351,7 +385,7 @@ def apply_updates(known, sent, rows, cols, vals, advanced,
     return known, sent
 
 
-def record_transmissions(sent, svc_idx, msg, fanout, limit):
+def record_transmissions(sent, svc_idx, msg, fanout, limit, row_ids=None):
     """Bump transmit counts for the records offered this round —
     ``fanout`` sends each (TransmitLimited's per-message accounting).
 
@@ -361,10 +395,16 @@ def record_transmissions(sent, svc_idx, msg, fanout, limit):
     crosses the limit — counts are bounded by ``limit + fanout - 1``
     (≈ 19 at the 4,096-node defaults, far under int8).  Dropping the
     clamp removes the read-modify-write gather, leaving one scatter
-    (the dense round's budget, see :func:`apply_updates`)."""
+    (the dense round's budget, see :func:`apply_updates`).
+
+    ``row_ids`` maps a COMPACTED selection batch back to its true rows
+    (``svc_idx``/``msg`` row *i* belongs to ``sent`` row
+    ``row_ids[i]``; out-of-range ids drop) — the sparse-frontier path,
+    where only the active sender rows selected."""
     del limit  # bounded by construction; kept for the call-site contract
     n = sent.shape[0]
-    rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+    rows = (row_ids[:, None] if row_ids is not None
+            else jnp.arange(n, dtype=jnp.int32)[:, None])
     bump = jnp.where(msg > 0, fanout, 0).astype(sent.dtype)
     return sent.at[rows, svc_idx].add(bump, mode="drop")
 

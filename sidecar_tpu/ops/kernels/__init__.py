@@ -38,6 +38,7 @@ from sidecar_tpu import metrics
 from sidecar_tpu.ops.kernels.publish_gather import (  # noqa: F401
     board_row_gather_pallas,
     board_row_gather_xla,
+    eligible_lines,
     fused_publish_gather_pallas,
     fused_publish_gather_xla,
     publish_board_pallas,

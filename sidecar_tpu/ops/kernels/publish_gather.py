@@ -75,6 +75,16 @@ def _tile_rows(n: int, k: int) -> int:
 
 # -- the shared selection math (one definition, two backends) ---------------
 
+def eligible_lines(cache_slot, cache_sent, limit: int):
+    """Publish eligibility of a cache line: occupied AND transmits
+    left (memberlist TransmitLimited semantics).  ONE definition — the
+    sparse sender frontier (models/compressed.py ``_sparse_frontiers``,
+    parallel/sharded_compressed.py) must be exactly this predicate or
+    an eligible row could be silently excluded from the frontier with
+    no overflow signal, breaking dense==sparse bit-identity."""
+    return (cache_slot >= 0) & (cache_sent.astype(jnp.int32) < limit)
+
+
 def _publish_block(cv, cs, se, gids, *, budget: int, limit: int,
                    fanout: int, k: int):
     """Publish selection on a ``[T, K]`` block — the in-VMEM recast of
@@ -85,7 +95,7 @@ def _publish_block(cv, cs, se, gids, *, budget: int, limit: int,
     Returns (bval, bslot, sent) for the block.
     """
     t = cv.shape[0]
-    eligible = (cs >= 0) & (se.astype(jnp.int32) < limit)
+    eligible = eligible_lines(cs, se, limit)
     priority = jnp.where(eligible, cv, 0)
 
     # Threshold: budget-th largest with multiplicity, via bitwise max
@@ -131,15 +141,21 @@ def _publish_block(cv, cs, se, gids, *, budget: int, limit: int,
 
 def publish_board_xla(cache_val, cache_slot, cache_sent, *, budget: int,
                       limit: int, fanout: int, cache_lines: int,
-                      row_offset=0):
+                      row_offset=0, row_ids=None):
     """The XLA reference path — the exact op sequence
     ``CompressedSim._publish`` shipped through round 5 (top_k threshold
     + rotated prefix-sum tie admission; see models/compressed.py for
     the protocol rationale).  The Pallas kernels are bit-identical to
     this function.
+
+    ``row_ids`` overrides the contiguous ``row_offset + i`` global ids
+    with explicit per-row ones — the sparse-frontier path publishes a
+    compacted, non-contiguous row set and must reproduce each row's
+    dense tie rotation exactly (ops/sparse.py; the compacted path is
+    XLA-only, riding this reference's bit-identity contract).
     """
     k = cache_lines
-    eligible = (cache_slot >= 0) & (cache_sent.astype(jnp.int32) < limit)
+    eligible = eligible_lines(cache_slot, cache_sent, limit)
     priority = jnp.where(eligible, cache_val, 0)
     budget = min(budget, k)
     top = lax.top_k(priority, budget)[0]
@@ -149,7 +165,8 @@ def publish_board_xla(cache_val, cache_slot, cache_sent, *, budget: int,
     n_above = jnp.sum(above, axis=1, keepdims=True)
 
     n = priority.shape[0]
-    rows = jnp.arange(n, dtype=jnp.int32) + row_offset
+    rows = (row_ids if row_ids is not None
+            else jnp.arange(n, dtype=jnp.int32) + row_offset)
     rot = (rows.astype(jnp.uint32) * jnp.uint32(PHASE_MULT)
            & jnp.uint32(k - 1)).astype(jnp.int32)
     s = jnp.cumsum(tie.astype(jnp.int32), axis=1)

@@ -1,0 +1,324 @@
+"""Sparse-frontier round execution — the convergence-tail attack.
+
+The north-star run's cost is dominated by the TAIL, not the round: the
+strict-unsettled ε takes ~925 rounds at a flat ~31 ms/round
+(benchmarks/RESULTS.md round 6) even though the in-flight census shows
+the active set collapsing to a few hundred entries within the first
+~200 rounds.  Late rounds do O(N·K) dense work to move an O(C)
+frontier — the classic sparse-frontier gap GNN-accelerator work names
+between dense message-passing kernels and real propagation workloads
+(PAPERS.md: the GNN computer-architecture survey), and the same
+observation pipelined-gossip analysis makes at the protocol level
+(PAPERS.md: *The Algorithm of Pipelined Gossiping*): after the bulk
+wave, only stragglers carry traffic.
+
+This module holds the mode plumbing shared by every model:
+
+* **Resolution** — ``SIDECAR_TPU_SPARSE=auto|0|1`` (or the ``sparse=``
+  constructor argument), resolved ONCE at sim construction exactly like
+  ``SIDECAR_TPU_KERNELS``:
+
+  - ``0``   — sparse execution disabled; ``run*(..., sparse=True)``
+    raises.  The pre-round-8 behavior.
+  - ``1``   — drivers default to the sparse step (each round still
+    carries the overflow→dense fallback, so a burst mid-chunk is
+    handled bit-identically).
+  - ``auto`` (default) — drivers default to dense; a host-side
+    :class:`SparseArbiter` opts chunks in from the census it already
+    pulls (bench.py north-star loop, ``SimBridge.simulate``).
+
+* **Frontier compaction** — :func:`compact_rows`: bounded static-width
+  ``nonzero`` over a row mask (the same bounded-nonzero machinery as
+  the ``metric_inflight_cap`` census path, models/compressed.py
+  ``fast_list``) plus the inverse position map used for the
+  scatter-free gather-based write-back.
+
+* **The arbiter** — :class:`SparseArbiter`: picks dense vs sparse for
+  the NEXT pipelined chunk from the behind-census the driver already
+  reads back, with hysteresis (enter/exit thresholds form a band, so a
+  census oscillating around one threshold cannot thrash the mode) and
+  a frontier-overflow→dense fallback with cooldown (the same
+  overflow→resync shape as ``ops/delta.py``: capacity exhaustion is
+  REPORTED and degrades to the dense path, never silently truncated).
+
+What "sparse" means mechanically (docs/sparse.md has the full
+contract): per round, three bounded frontiers are compacted out of the
+dense state —
+
+* **senders**: rows with any ELIGIBLE cache line (occupied AND
+  transmits left — TransmitLimited is what makes the tail sparse:
+  exhausted relays hold copies but publish nothing),
+* **receivers**: alive rows that sampled at least one active sender
+  (every other row's pull folds only empty boards — a no-op),
+* **announcers**: rows with any refresh/recovery offer this round —
+
+and the publish/deliver/merge/announce-insert work runs on the
+``[C]``-shaped views, scattered back through gather+select.  Rows
+outside the frontiers are PROVABLY unchanged by the dense round, so
+the sparse round is bit-identical (the lockstep suites in
+tests/test_sparse.py are the oracle).  TTL decay, push-pull and the
+floor census stay dense — they are cadence-amortized and already
+elementwise-cheap.
+
+The PRNG streams are mode-independent by construction: peer sampling
+is drawn at the full ``[N, F]`` shape in both modes (O(N·F) — cheap)
+and the sparse path slices rows of the same draw; the ``drop_prob``
+keep mask, when active, is likewise drawn at the dense shape and
+sliced, so a sparse round replays the dense round's randomness
+exactly.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+from sidecar_tpu import metrics
+
+SPARSE_ENV = "SIDECAR_TPU_SPARSE"
+SPARSE_MODES = ("auto", "0", "1")
+
+# Stats vector layout: every sparse step/driver reports an int32 [3]
+# (rounds executed on the compacted path, rounds that overflowed to the
+# dense fallback, frontier high-water mark).  Kept positional so the
+# scan carry stays a flat array.
+STAT_SPARSE_ROUNDS = 0
+STAT_OVERFLOW_ROUNDS = 1
+STAT_FRONTIER_HWM = 2
+
+
+def resolve_sparse(explicit: Optional[str] = None, *,
+                   record: bool = True) -> str:
+    """Resolve the sparse-execution mode: an explicit constructor
+    argument wins, else ``SIDECAR_TPU_SPARSE``, else ``auto``.
+
+    Returns one of ``"auto" | "0" | "1"``.  Resolved at sim
+    construction (the choice gates which jitted drivers a sim may
+    dispatch), so toggling the env var affects sims built afterwards —
+    the ``SIDECAR_TPU_KERNELS`` contract."""
+    mode = explicit
+    if mode is None:
+        mode = os.environ.get(SPARSE_ENV, "auto").strip().lower() or "auto"
+    mode = {"on": "1", "off": "0"}.get(mode, mode)
+    if mode not in SPARSE_MODES:
+        raise ValueError(
+            f"sparse mode must be one of {SPARSE_MODES}, got {mode!r} "
+            f"(explicit argument or {SPARSE_ENV})")
+    if record:
+        metrics.incr(f"sparse.mode.{mode}")
+    return mode
+
+
+def resolve_request(mode: str, sparse, supports_sparse: bool = True) -> bool:
+    """Per-dispatch sparse resolution, shared by every sim family
+    (one definition so the ``supports_sparse`` guard cannot silently
+    diverge between models): ``sparse=None`` follows the
+    construction-time ``mode`` — and DEGRADES to dense on a sim that
+    doesn't implement the path (the chaos wrapper under an env-forced
+    ``"1"``); an explicit ``True`` is the arbiter's chunk-level opt-in
+    and raises when the mode is ``"0"`` or the sim can't honor it."""
+    if sparse is None:
+        sparse = mode == "1"
+        if sparse and not supports_sparse:
+            return False        # env default degrades, never breaks
+    if sparse and (mode == "0" or not supports_sparse):
+        raise ValueError(
+            "sparse execution is disabled or unsupported on this sim "
+            f"(mode={mode!r}, supports_sparse={supports_sparse}; "
+            f"see {SPARSE_ENV} / docs/sparse.md)")
+    return bool(sparse)
+
+
+def default_frontier_cap(n: int) -> int:
+    """Auto frontier width: wide enough that the arbiter's entry
+    heuristic has slack, narrow enough that the compacted round is
+    decisively cheaper than dense (C ≪ N)."""
+    return min(n, max(128, n // 16))
+
+
+def compact_rows(mask, cap: int):
+    """Bounded static-width row compaction.
+
+    ``mask`` is bool [N]; returns ``(idx, row, valid, pos)``:
+
+    * ``idx``  int32 [cap] — the first ``cap`` set rows, padded with
+      ``n`` (the bounded-nonzero form of the ``metric_inflight_cap``
+      census path);
+    * ``row``  int32 [cap] — ``min(idx, n-1)``: always-in-bounds gather
+      rows (padding rows duplicate row n-1; their results are masked);
+    * ``valid`` bool [cap] — True at real entries;
+    * ``pos``  int32 [N] — inverse map: ``pos[g]`` is g's compacted
+      index where ``mask[g]``, else an arbitrary value the caller must
+      mask with ``mask`` (the gather-based write-back reads
+      ``compact[pos]`` under ``where(mask, ...)``).
+    """
+    n = mask.shape[0]
+    idx = jnp.nonzero(mask, size=cap, fill_value=n)[0].astype(jnp.int32)
+    row = jnp.minimum(idx, n - 1)
+    valid = idx < n
+    pos = jnp.zeros((n,), jnp.int32).at[idx].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+    return idx, row, valid, pos
+
+
+def zero_stats():
+    return jnp.zeros((3,), jnp.int32)
+
+
+def accumulate_stats(acc, step_stats):
+    """Fold one round's [3] stats into the running accumulator:
+    counters add, the frontier high-water mark maxes."""
+    return jnp.stack([
+        acc[STAT_SPARSE_ROUNDS] + step_stats[STAT_SPARSE_ROUNDS],
+        acc[STAT_OVERFLOW_ROUNDS] + step_stats[STAT_OVERFLOW_ROUNDS],
+        jnp.maximum(acc[STAT_FRONTIER_HWM],
+                    step_stats[STAT_FRONTIER_HWM]),
+    ])
+
+
+class SparseArbiter:
+    """Host-side dense/sparse chunk arbiter.
+
+    Lives at the pipelined-chunk boundary (bench.py north-star loop,
+    ``SimBridge.simulate``): the driver already pulls a census sample
+    per chunk (the behind count / the convergence curve); the arbiter
+    turns that into the NEXT chunk's mode without any extra
+    device↔host traffic.
+
+    Policy:
+
+    * ``mode="0"``  — always dense; ``mode="1"`` — always sparse (the
+      per-round overflow fallback still protects capacity).
+    * ``mode="auto"`` — hysteresis band on the census: enter sparse
+      when the census drops to ``enter_below``, exit only when it
+      rises above ``exit_above`` (> enter_below), so oscillation around
+      one threshold cannot thrash the mode.  A chunk that reports
+      frontier overflows forces dense for ``cooldown`` decisions — the
+      overflow→resync shape of ``ops/delta.py``.
+
+    Counters/gauges (docs/metrics.md): ``sparse.rounds``,
+    ``sparse.switches``, ``sparse.overflow`` counters and the
+    ``sparse.frontier_size`` gauge.  The process registry accumulates
+    across runs; per-run numbers come from the INSTANCE counters
+    (:meth:`snapshot`), which :meth:`new_trajectory` zeroes — both
+    drivers construct (or reset) an arbiter per run, which is what
+    keeps ``POST /simulate`` reports per-run (the PR-4
+    ``sync_exchange_metrics`` lesson: never report the accumulating
+    registry as if it were per-trajectory).
+    """
+
+    @classmethod
+    def for_census(cls, mode: str, n: int) -> "SparseArbiter":
+        """The shared driver policy (bench north-star loop AND
+        ``SimBridge.simulate`` — one definition so the entry heuristic
+        cannot silently diverge between them): enter sparse when the
+        behind census drops to ``n`` — on average under one behind
+        cell per node, the tail regime where the active-sender
+        frontier fits its cap; a mispredicted chunk costs only the
+        mask passes (the per-round overflow fallback IS the dense
+        round)."""
+        return cls(mode, enter_below=float(n))
+
+    def __init__(self, mode: str = "auto", *, enter_below: float,
+                 exit_above: Optional[float] = None, cooldown: int = 2):
+        if mode not in SPARSE_MODES:
+            raise ValueError(f"mode must be one of {SPARSE_MODES}")
+        if exit_above is None:
+            exit_above = 2.0 * enter_below
+        if exit_above < enter_below:
+            raise ValueError("exit_above must be >= enter_below "
+                             "(the hysteresis band)")
+        self.mode = mode
+        self.enter_below = float(enter_below)
+        self.exit_above = float(exit_above)
+        self.cooldown = int(cooldown)
+        self._sparse = mode == "1"
+        self._cooldown_left = 0
+        self.new_trajectory()
+
+    # -- per-trajectory counters -------------------------------------------
+
+    def new_trajectory(self) -> None:
+        """Reset the per-run view (fresh init_state / new simulate
+        request): per-run counters restart at zero; the process
+        registry keeps accumulating across runs."""
+        self.run_sparse_rounds = 0
+        self.run_dense_rounds = 0
+        self.run_overflow_rounds = 0
+        self.run_switches = 0
+        self.run_frontier_hwm = 0
+        self._cooldown_left = 0
+        self._sparse = self.mode == "1"
+        metrics.set_gauge("sparse.frontier_size", 0.0)
+
+    def snapshot(self) -> dict:
+        """The per-run record (the bridge report / bench JSON block)."""
+        return {
+            "sparse_rounds": self.run_sparse_rounds,
+            "dense_rounds": self.run_dense_rounds,
+            "overflow_rounds": self.run_overflow_rounds,
+            "switches": self.run_switches,
+            "frontier_hwm": self.run_frontier_hwm,
+        }
+
+    # -- the decision -------------------------------------------------------
+
+    @property
+    def sparse(self) -> bool:
+        """Mode for the chunk about to be dispatched."""
+        return self._sparse
+
+    def dispatch_kwargs(self) -> dict:
+        """The driver kwargs for the next chunk.  ``sparse`` is passed
+        EXPLICITLY either way: a dense decision must say
+        ``sparse=False`` — omitting the kwarg would let a sim built
+        under ``SIDECAR_TPU_SPARSE=1`` resolve its construction-time
+        default and silently run the sparse program on a chunk the
+        arbiter pinned dense (the BENCH_SPARSE=0 / ``{"sparse":
+        false}`` forcing contracts)."""
+        return {"sparse": self._sparse}
+
+    def record_chunk(self, rounds: int, stats=None) -> None:
+        """Account a finished chunk.  ``stats`` is the driver's int32
+        [3] stats vector for a sparse chunk (None for dense chunks)."""
+        if stats is None:
+            self.run_dense_rounds += rounds
+            return
+        sparse_rounds = int(stats[STAT_SPARSE_ROUNDS])
+        overflow = int(stats[STAT_OVERFLOW_ROUNDS])
+        frontier = int(stats[STAT_FRONTIER_HWM])
+        self.run_sparse_rounds += sparse_rounds
+        self.run_dense_rounds += rounds - sparse_rounds
+        self.run_overflow_rounds += overflow
+        self.run_frontier_hwm = max(self.run_frontier_hwm, frontier)
+        if sparse_rounds:
+            metrics.incr("sparse.rounds", sparse_rounds)
+        if overflow:
+            metrics.incr("sparse.overflow", overflow)
+            if self.mode == "auto":
+                # Frontier overflow → dense fallback with cooldown.
+                self._cooldown_left = self.cooldown
+                self._switch(False)
+        metrics.set_gauge("sparse.frontier_size", float(frontier))
+
+    def update_census(self, census: float) -> bool:
+        """Feed the latest census sample (the behind count the driver
+        already pulled); returns the mode for the next chunk."""
+        if self.mode != "auto":
+            return self._sparse
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            return self._sparse
+        if not self._sparse and census <= self.enter_below:
+            self._switch(True)
+        elif self._sparse and census > self.exit_above:
+            self._switch(False)
+        return self._sparse
+
+    def _switch(self, to_sparse: bool) -> None:
+        if self._sparse != to_sparse:
+            self._sparse = to_sparse
+            self.run_switches += 1
+            metrics.incr("sparse.switches")

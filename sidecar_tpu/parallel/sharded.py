@@ -52,6 +52,7 @@ from sidecar_tpu import metrics
 from sidecar_tpu.models.exact import SimParams, SimState, clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops.merge import merge_packed, staleness_mask, sticky_adjust
 from sidecar_tpu.ops.status import (
     TOMBSTONE,
@@ -74,13 +75,18 @@ class ShardedSim:
     except for the documented anti-entropy pairing (and independent PRNG
     streams per shard)."""
 
+    # The sparse-frontier round is available on this twin
+    # (docs/sparse.md); select-level compaction, per shard.
+    supports_sparse = True
+
     def __init__(self, params: SimParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  mesh=None,
                  cut_mask: Optional[np.ndarray] = None,
                  node_side: Optional[np.ndarray] = None,
                  board_exchange: Optional[str] = None,
-                 exchange_stub: bool = False):
+                 exchange_stub: bool = False,
+                 sparse: Optional[str] = None):
         if topo.n != params.n:
             raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
         if cut_mask is not None and topo.nbrs is None:
@@ -88,6 +94,8 @@ class ShardedSim:
         self.p = params
         self.t = timecfg
         self.topo = topo
+        self._sparse_mode = sparse_ops.resolve_sparse(sparse)
+        self.last_sparse_stats = None
         # The dense twin exchanges bounded OFFER tensors, not boards:
         # all_gather replicates them, ring streams sender blocks hop by
         # hop.  all_to_all request routing only exists on the
@@ -104,6 +112,12 @@ class ShardedSim:
         if params.n % self.d != 0:
             raise ValueError(f"n={params.n} must divide the {self.d}-device mesh")
         nl = params.n // self.d
+        # Per-shard sparse sender cap: the global cap split over the
+        # mesh with 2× imbalance slack (docs/sparse.md).
+        cap = min(params.n,
+                  params.sparse_cap
+                  or sparse_ops.default_frontier_cap(params.n))
+        self._sparse_cap_shard = min(nl, max(16, -(-cap // self.d) * 2))
         payload_ints = params.fanout + 2 * min(params.budget, params.m)
         self.exchange_bytes_per_round = {
             "all_gather": (params.n - nl) * payload_ints * 4,
@@ -200,7 +214,8 @@ class ShardedSim:
         return d_rows, cols, val, advanced
 
     def _gossip_shard(self, known_l, sent_l, alive, key, round_idx,
-                      nbrs_l=None, deg_l=None, cut_l=None):
+                      nbrs_l=None, deg_l=None, cut_l=None,
+                      use_sparse=False):
         """One shard's split-phase, comm-overlapped gossip round
         (docs/sharding.md): select local offers → issue the exchange →
         evaluate own-shard deliveries + the announce stamps (both
@@ -230,10 +245,53 @@ class ShardedSim:
         # Phase 1 — select offers from the local block + transmit
         # accounting.  row_offset ties the tie-break rotation to GLOBAL
         # node ids so the selection matches ExactSim bit-for-bit.
-        svc_idx, msg = gossip_ops.select_messages(
-            known_l, sent_l, p.budget, limit, row_offset=r0)
-        sent_l = gossip_ops.record_transmissions(
-            sent_l, svc_idx, msg, p.fanout, limit)
+        #
+        # Sparse mode (docs/sparse.md): the select/top-k — the phase
+        # whose cost scales with the mostly-ineligible tail — runs on
+        # the shard's compacted eligible-sender rows and the dense
+        # offer tensors are reconstructed (a no-offer row is exactly
+        # ``svc = m / msg = 0`` in the dense select too), so the
+        # exchange and every downstream phase are untouched.  The cond
+        # is per-shard divergent — legal, it contains no collectives —
+        # with the dense select as the overflow fallback; bit-identical
+        # either way.
+        ovf = n_s = None
+        if use_sparse:
+            sender_l = jnp.any(
+                gossip_ops.eligible_records(known_l, sent_l, limit),
+                axis=1)
+            n_s = jnp.sum(sender_l.astype(jnp.int32))
+            ovf = n_s > self._sparse_cap_shard
+
+            def dense_sel(_):
+                svc, msg = gossip_ops.select_messages(
+                    known_l, sent_l, p.budget, limit, row_offset=r0)
+                se2 = gossip_ops.record_transmissions(
+                    sent_l, svc, msg, p.fanout, limit)
+                return svc, msg, se2
+
+            def sparse_sel(_):
+                idx_s, row_s, valid_s, pos_s = sparse_ops.compact_rows(
+                    sender_l, self._sparse_cap_shard)
+                kn_s = jnp.where(valid_s[:, None], known_l[row_s], 0)
+                svc_c, msg_c = gossip_ops.select_messages(
+                    kn_s, sent_l[row_s], p.budget, limit,
+                    row_ids=idx_s + r0)
+                se2 = gossip_ops.record_transmissions(
+                    sent_l, svc_c, msg_c, p.fanout, limit,
+                    row_ids=idx_s)
+                snd = sender_l[:, None]
+                svc = jnp.where(snd, svc_c[pos_s], p.m)
+                msg = jnp.where(snd, msg_c[pos_s], 0)
+                return svc, msg, se2
+
+            svc_idx, msg, sent_l = lax.cond(ovf, dense_sel, sparse_sel,
+                                            None)
+        else:
+            svc_idx, msg = gossip_ops.select_messages(
+                known_l, sent_l, p.budget, limit, row_offset=r0)
+            sent_l = gossip_ops.record_transmissions(
+                sent_l, svc_idx, msg, p.fanout, limit)
 
         known0 = known_l               # pre-round snapshot: ALL candidate
         fanout = dst.shape[1]          # resolution happens against it
@@ -351,6 +409,12 @@ class ShardedSim:
         known_l, sent_l = lax.cond(
             round_idx % t.sweep_rounds == 0,
             do_sweep, lambda kn_se: kn_se, (known_l, sent_l))
+        if use_sparse:
+            # Replicated stats outs: shards that overflowed this round
+            # and the global eligible-sender count.
+            return (known_l, sent_l, lax.psum(ovf.astype(jnp.int32),
+                                              NODE_AXIS),
+                    lax.psum(n_s, NODE_AXIS))
         return known_l, sent_l
 
     # -- anti-entropy stride exchange (jit level, sharding-propagated) -----
@@ -382,7 +446,8 @@ class ShardedSim:
 
     # -- drivers -----------------------------------------------------------
 
-    def _step(self, state: SimState, key: jax.Array) -> SimState:
+    def _step_impl(self, state: SimState, key: jax.Array,
+                   use_sparse: bool):
         p, t = self.p, self.t
         round_idx = state.round_idx + 1
         now = round_idx * t.round_ticks
@@ -390,40 +455,52 @@ class ShardedSim:
 
         spec_row = P(NODE_AXIS)
         spec_repl = P()
+        out_specs = (spec_row, spec_row)
+        if use_sparse:
+            out_specs += (spec_repl, spec_repl)
         if self._nbrs is None:
+            def wrapper_complete(kn, se, al, k, r):
+                return self._gossip_shard(kn, se, al, k, r,
+                                          use_sparse=use_sparse)
             fn = shard_map(
-                self._gossip_shard,
+                wrapper_complete,
                 mesh=self.mesh,
                 in_specs=(spec_row, spec_row, spec_repl, spec_repl,
                           spec_repl),
-                out_specs=(spec_row, spec_row),
+                out_specs=out_specs,
                 check_vma=False,
             )
-            known, sent = fn(state.known, state.sent, state.node_alive,
-                             k_round, round_idx)
+            out = fn(state.known, state.sent, state.node_alive,
+                     k_round, round_idx)
         elif self._cut is not None:
             def wrapper(kn, se, al, nb, dg, ct, k, r):
                 return self._gossip_shard(kn, se, al, k, r, nbrs_l=nb,
-                                          deg_l=dg, cut_l=ct)
+                                          deg_l=dg, cut_l=ct,
+                                          use_sparse=use_sparse)
             fn = shard_map(
                 wrapper, mesh=self.mesh,
                 in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 3
                          + (spec_repl, spec_repl),
-                out_specs=(spec_row, spec_row), check_vma=False)
-            known, sent = fn(state.known, state.sent, state.node_alive,
-                             self._nbrs, self._deg, self._cut, k_round,
-                             round_idx)
+                out_specs=out_specs, check_vma=False)
+            out = fn(state.known, state.sent, state.node_alive,
+                     self._nbrs, self._deg, self._cut, k_round,
+                     round_idx)
         else:
             def wrapper_nocut(kn, se, al, nb, dg, k, r):
                 return self._gossip_shard(kn, se, al, k, r, nbrs_l=nb,
-                                          deg_l=dg, cut_l=None)
+                                          deg_l=dg, cut_l=None,
+                                          use_sparse=use_sparse)
             fn = shard_map(
                 wrapper_nocut, mesh=self.mesh,
                 in_specs=(spec_row,) * 2 + (spec_repl,) + (spec_row,) * 2
                          + (spec_repl, spec_repl),
-                out_specs=(spec_row, spec_row), check_vma=False)
-            known, sent = fn(state.known, state.sent, state.node_alive,
-                             self._nbrs, self._deg, k_round, round_idx)
+                out_specs=out_specs, check_vma=False)
+            out = fn(state.known, state.sent, state.node_alive,
+                     self._nbrs, self._deg, k_round, round_idx)
+        if use_sparse:
+            known, sent, ovf_shards, n_s = out
+        else:
+            known, sent = out
 
         known, sent = lax.cond(
             round_idx % t.push_pull_rounds == 0,
@@ -433,8 +510,20 @@ class ShardedSim:
             (known, sent),
         )
 
-        return SimState(known=known, sent=sent, node_alive=state.node_alive,
-                        round_idx=round_idx)
+        new = SimState(known=known, sent=sent,
+                       node_alive=state.node_alive, round_idx=round_idx)
+        if not use_sparse:
+            return new
+        # Stats: a round counts sparse when NO shard fell back; the
+        # frontier gauge is the global eligible-sender count.
+        ov = (ovf_shards > 0).astype(jnp.int32)
+        return new, jnp.stack([1 - ov, ov, n_s])
+
+    def _step(self, state: SimState, key: jax.Array) -> SimState:
+        return self._step_impl(state, key, use_sparse=False)
+
+    def _step_sparse(self, state: SimState, key: jax.Array):
+        return self._step_impl(state, key, use_sparse=True)
 
     def convergence(self, state: SimState) -> jax.Array:
         alive = state.node_alive
@@ -453,22 +542,44 @@ class ShardedSim:
             start_round = int(state.round_idx)
         self.t.validate_horizon(start_round + num_rounds)
 
+    def _resolve_sparse_request(self, sparse):
+        return sparse_ops.resolve_request(self._sparse_mode, sparse,
+                                          self.supports_sparse)
+
     def step(self, state: SimState, key: jax.Array) -> SimState:
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
 
+    def step_sparse(self, state: SimState, key: jax.Array):
+        """One sparse-path round → ``(state, stats[3])``."""
+        self._resolve_sparse_request(True)
+        self._check_horizon(state, 1)
+        return self._step_sparse_jit(state, key)
+
     def run(self, state: SimState, key: jax.Array, num_rounds: int,
-            donate: bool = True, start_round=None):
+            donate: bool = True, start_round=None, sparse=None):
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, conv, stats = self._run_sparse_jit(state, key,
+                                                      num_rounds)
+            self.last_sparse_stats = stats
+            return final, conv
+        self.last_sparse_stats = None
         return self._run_jit(state, key, num_rounds)
 
     def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
-                 donate: bool = True, start_round=None):
+                 donate: bool = True, start_round=None, sparse=None):
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, stats = self._run_fast_sparse_jit(state, key,
+                                                     num_rounds)
+            self.last_sparse_stats = stats
+            return final
+        self.last_sparse_stats = None
         return self._run_fast_jit(state, key, num_rounds)
 
     # no-donate: single-round stepping is the oracle/replay path — those
@@ -476,6 +587,12 @@ class ShardedSim:
     @functools.partial(jax.jit, static_argnums=0)
     def _step_jit(self, state, key):
         return self._step(state, key)
+
+    # no-donate: the sparse single-round probe serves the same
+    # oracle/replay callers as _step_jit.
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_sparse_jit(self, state, key):
+        return self._step_sparse(state, key)
 
     # Per-round keys fold the round index into the base key so chunked/
     # resumed runs replay identical randomness (see ExactSim).  The scan
@@ -495,3 +612,33 @@ class ShardedSim:
             return self._step(st, jax.random.fold_in(key, st.round_idx)), None
         final, _ = lax.scan(body, state, None, length=num_rounds)
         return final
+
+    # Sparse-path scan drivers (docs/sparse.md): same donation and key
+    # folding as the dense drivers, plus the stats accumulator.
+
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
+    def _run_sparse_jit(self, state, key, num_rounds):
+        def body(carry, _):
+            st, acc = carry
+            st, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            return (st, sparse_ops.accumulate_stats(acc, s)), \
+                self.convergence(st)
+
+        (final, stats), conv = lax.scan(
+            body, (state, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, conv, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3), donate_argnums=1)
+    def _run_fast_sparse_jit(self, state, key, num_rounds):
+        def body(carry, _):
+            st, acc = carry
+            st, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            return (st, sparse_ops.accumulate_stats(acc, s)), None
+
+        (final, stats), _ = lax.scan(
+            body, (state, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, stats

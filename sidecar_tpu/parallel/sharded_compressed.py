@@ -95,6 +95,7 @@ from sidecar_tpu.models.compressed import (
 )
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops.merge import staleness_mask
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.parallel.mesh import (
@@ -118,9 +119,11 @@ class ShardedCompressedSim(CompressedSim):
                  node_side: Optional[np.ndarray] = None,
                  board_exchange: Optional[str] = None,
                  a2a_slack: int = 2,
-                 exchange_stub: bool = False):
+                 exchange_stub: bool = False,
+                 sparse: Optional[str] = None):
         super().__init__(params, topo, timecfg, perturb=perturb,
-                         cut_mask=cut_mask, node_side=node_side)
+                         cut_mask=cut_mask, node_side=node_side,
+                         sparse=sparse)
         if a2a_slack < 1:
             raise ValueError("a2a_slack must be >= 1")
         # None → SIDECAR_TPU_BOARD_EXCHANGE, default all_gather
@@ -157,6 +160,12 @@ class ShardedCompressedSim(CompressedSim):
         nl = params.n // self.d
         self._a2a_cap = max(16, -(-nl * params.fanout // self.d)
                             * a2a_slack)
+        # Per-shard sparse-frontier caps (docs/sparse.md): the global
+        # caps split over the mesh with 2× slack for load imbalance —
+        # one hot shard must not flip the whole round dense early.
+        self._sparse_caps_shard = tuple(
+            min(nl, max(16, -(-c // self.d) * 2))
+            for c in self._sparse_caps)
 
         row = NamedSharding(self.mesh, P(NODE_AXIS))
         repl = NamedSharding(self.mesh, P())
@@ -332,6 +341,31 @@ class ShardedCompressedSim(CompressedSim):
         Bit-identical to the pre-split round in every mode: the
         lockstep suites (tests/test_sharded_compressed.py,
         tests/test_sharded_exchange.py) are the oracle."""
+        nl = own_l.shape[0]
+        ax = lax.axis_index(NODE_AXIS)
+        gi = (ax * nl).astype(jnp.int32) + jnp.arange(nl, dtype=jnp.int32)
+        k_peers, k_drop = jax.random.split(jax.random.fold_in(key, ax))
+        if nbrs_l is None:
+            dst = self._sample_dst_complete(k_peers, gi, alive, nl)
+        else:
+            dst = self._sample_dst_nbrs(k_peers, gi, alive, nl,
+                                        nbrs_l, deg_l, cut_l)
+        return self._gossip_shard_body(own_l, cslot_l, cval_l, csent_l,
+                                       floor, alive, dst, k_drop,
+                                       round_idx)
+
+    def _gossip_shard_body(self, own_l, cslot_l, cval_l, csent_l, floor,
+                           alive, dst, k_drop, round_idx,
+                           ann_local=None):
+        """The round body after peer sampling — split out so the sparse
+        step can reuse it verbatim as its per-chunk overflow fallback
+        with a jit-level-precomputed ``dst`` (docs/sparse.md).
+        ``ann_local`` is the announce own/floor half when the caller
+        already ran it at the jit level (the sparse step computes it
+        for the announcer frontier either way): ``own_l``/``floor``
+        then arrive advanced and ``(offer_val, base_slot)`` are this
+        shard's slices — identical values, one O(N·S) pass per round
+        instead of two on overflow rounds."""
         p, t = self.p, self.t
         limit = p.resolved_retransmit_limit()
         nl = own_l.shape[0]
@@ -341,13 +375,6 @@ class ShardedCompressedSim(CompressedSim):
         gi = r0 + jnp.arange(nl, dtype=jnp.int32)
         now = round_idx * t.round_ticks
         mode = self.board_exchange
-
-        k_peers, k_drop = jax.random.split(jax.random.fold_in(key, ax))
-        if nbrs_l is None:
-            dst = self._sample_dst_complete(k_peers, gi, alive, nl)
-        else:
-            dst = self._sample_dst_nbrs(k_peers, gi, alive, nl,
-                                        nbrs_l, deg_l, cut_l)
 
         # Local view of this shard: the inherited single-chip kernels run
         # on it unchanged (row_offset maps local rows to global identity),
@@ -404,8 +431,11 @@ class ShardedCompressedSim(CompressedSim):
         # values; reads own/floor only, never the cache) overlaps the
         # in-flight exchange; the cache insert waits for the final
         # phase.
-        own_l, floor, offer_val, base_slot = self._announce_offers(
-            own_l, floor, alive[gi], round_idx, now, row_offset=r0)
+        if ann_local is None:
+            own_l, floor, offer_val, base_slot = self._announce_offers(
+                own_l, floor, alive[gi], round_idx, now, row_offset=r0)
+        else:
+            offer_val, base_slot = ann_local
 
         # Phases 2 + 4 — issue the remote exchange and consume its rows.
         if self._exchange_stub:
@@ -466,10 +496,304 @@ class ShardedCompressedSim(CompressedSim):
         cv, cs, se, ev_ann = self._insert_own_offers(
             wv, ws, sent, offer_val, base_slot, reset_on_hold=True)
 
-        floor = lax.pmax(floor, NODE_AXIS)
+        if ann_local is None:
+            # Per-shard announce wrote only this shard's floor slice;
+            # re-merge the replicas (precomputed floors arrive merged).
+            floor = lax.pmax(floor, NODE_AXIS)
         ev = lax.psum(ev_merge + ev_ann, NODE_AXIS)
         dr = lax.psum(n_drop, NODE_AXIS)
         return own_l, cs, cv, se, floor, ev, dr
+
+    # -- the sparse-frontier shard round (docs/sparse.md) --------------------
+
+    def _gossip_shard_body_sparse(self, own_l, cslot_l, cval_l, csent_l,
+                                  floor, alive, dst, k_drop, round_idx,
+                                  sender_l, recv_l, ann_l, offer_val,
+                                  base_slot):
+        """Per-shard compaction of the split-phase round: publish runs
+        on the shard's compacted active-sender rows (the XLA kernel
+        with explicit global ids) and is scattered back to the dense
+        ``[nl, K]`` block — bit-identical to the dense block, since
+        inactive rows publish ``(0, -1)`` boards — so EVERY board
+        exchange mode (all_gather | all_to_all | ring) runs verbatim on
+        it; the fold/finalize and the announce cache insert run on the
+        compacted receiver/announcer rows.  Compute shrinks to the
+        frontier, the exchange keeps its dense shape (its cost is the
+        mode's documented envelope, docs/sharding.md).  The caller
+        guarantees no per-shard frontier overflowed (the jit-level
+        dense fallback) and hands in the announce own/floor half
+        PRECOMPUTED at the jit level (``_step_sparse`` needs it for the
+        announcer frontier anyway — the O(N·S) pass runs once per
+        round): ``own_l``/``floor`` arrive already advanced,
+        ``offer_val``/``base_slot`` are this shard's slices."""
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        nl = own_l.shape[0]
+        d = self.d
+        ax = lax.axis_index(NODE_AXIS)
+        r0 = (ax * nl).astype(jnp.int32)
+        gi = r0 + jnp.arange(nl, dtype=jnp.int32)
+        now = round_idx * t.round_ticks
+        mode = self.board_exchange
+        k = p.cache_lines
+        cs_cap, cr_cap, ca_cap = self._sparse_caps_shard
+
+        n_drop = jnp.zeros((), jnp.int32)
+        # The a2a request leg is unchanged — pure index math over the
+        # full dst (requests to inactive senders return empty boards,
+        # the merge no-op), so bucket ranks and the drop accounting
+        # match the dense round exactly.
+        if mode == "all_to_all" and not self._exchange_stub:
+            (req, src_shard, src_row, is_local, valid, rank,
+             n_drop) = self._a2a_route(dst, ax, nl)
+            req_in = lax.all_to_all(req, NODE_AXIS, 0, 0)
+            is_local_f = is_local.reshape(nl, p.fanout)
+
+        # Phase 1 — compacted publish, reconstructed to the dense block.
+        idx_s, row_s, valid_s, pos_s = sparse_ops.compact_rows(
+            sender_l, cs_cap)
+        cv_s = jnp.where(valid_s[:, None], cval_l[row_s], 0)
+        sl_s = jnp.where(valid_s[:, None], cslot_l[row_s], -1)
+        bval_c, bslot_c, sent_c = kernel_ops.publish_board_xla(
+            cv_s, sl_s, csent_l[row_s], budget=min(p.budget, k),
+            limit=limit, fanout=p.fanout, cache_lines=k,
+            row_ids=idx_s + r0)
+        sent = jnp.where(sender_l[:, None], sent_c[pos_s], csent_l)
+        bval_c = jnp.where(staleness_mask(bval_c, now, t.stale_ticks),
+                           0, bval_c)
+        snd_c = sender_l[:, None]
+        bval_f = jnp.where(snd_c, bval_c[pos_s], 0)
+        bslot_f = jnp.where(snd_c, bslot_c[pos_s], -1)
+
+        # Receiver compaction (shared by every fold below).
+        idx_r, row_r, valid_r, pos_r = sparse_ops.compact_rows(
+            recv_l, cr_cap)
+        dst_c = dst[row_r]                                   # [Cr, F]
+        ok_c = alive[dst_c] & (alive[gi[row_r]] & valid_r)[:, None]
+        keep_c = None
+        if p.drop_prob > 0.0:
+            # The dense per-shard draw, sliced (mode-independent loss).
+            keep = jax.random.bernoulli(
+                k_drop, 1.0 - p.drop_prob, (nl, p.fanout, k))
+            keep_c = keep[row_r]
+        cv0_c, cs0_c = cval_l[row_r], cslot_l[row_r]
+        wv, ws = cv0_c, cs0_c
+
+        # Phase 3a — own-shard early fold (ring/a2a; XLA gather twin).
+        if mode != "all_gather" or self._exchange_stub:
+            pv0, ps0 = kernel_ops.board_row_gather_xla(
+                bval_f, bslot_f, dst_c, r0)
+            wv, ws = self._fold_pulled(cv0_c, cs0_c, wv, ws, pv0, ps0,
+                                       ok_c & (dst_c // nl == ax), now,
+                                       keep=keep_c, stale_filtered=True)
+
+        # Phases 2 + 4 — the exchange runs on the reconstructed dense
+        # block (identical bytes to the dense round's exchange).
+        if self._exchange_stub:
+            pass
+        elif mode == "all_gather":
+            bval = lax.all_gather(bval_f, NODE_AXIS, tiled=True)
+            bslot = lax.all_gather(bslot_f, NODE_AXIS, tiled=True)
+            pv, ps = kernel_ops.board_row_gather_xla(bval, bslot,
+                                                     dst_c, 0)
+            wv, ws = self._fold_pulled(cv0_c, cs0_c, wv, ws, pv, ps,
+                                       ok_c, now, keep=keep_c,
+                                       stale_filtered=True)
+        elif mode == "all_to_all":
+            rows = jnp.clip(req_in, 0, nl - 1)
+            resp_v = lax.all_to_all(bval_f[rows], NODE_AXIS, 0, 0)
+            resp_s = lax.all_to_all(bslot_f[rows], NODE_AXIS, 0, 0)
+            valid_c = valid.reshape(nl, p.fanout)[row_r]
+            shard_c = jnp.where(valid, src_shard, 0) \
+                .reshape(nl, p.fanout)[row_r]
+            rank_c = jnp.where(valid, rank, 0) \
+                .reshape(nl, p.fanout)[row_r]
+            cross_v = jnp.where(valid_c[:, :, None],
+                                resp_v[shard_c, rank_c], 0)
+            cross_s = jnp.where(valid_c[:, :, None],
+                                resp_s[shard_c, rank_c], -1)
+            wv, ws = self._fold_pulled(cv0_c, cs0_c, wv, ws, cross_v,
+                                       cross_s,
+                                       ok_c & ~is_local_f[row_r], now,
+                                       keep=keep_c, stale_filtered=True)
+        else:  # ring
+            src_shard_r = dst_c // nl
+            src_row_r = dst_c - src_shard_r * nl
+            if d > 1:
+                perm = [(i, (i - 1) % d) for i in range(d)]
+                cur_v = lax.ppermute(bval_f, NODE_AXIS, perm)
+                cur_s = lax.ppermute(bslot_f, NODE_AXIS, perm)
+                for h in range(1, d):
+                    if h < d - 1:
+                        nxt_v = lax.ppermute(cur_v, NODE_AXIS, perm)
+                        nxt_s = lax.ppermute(cur_s, NODE_AXIS, perm)
+                    sel = src_shard_r == (ax + h) % d
+                    rows_h = jnp.where(sel, src_row_r, 0)
+                    wv, ws = self._fold_pulled(
+                        cv0_c, cs0_c, wv, ws, cur_v[rows_h],
+                        cur_s[rows_h], ok_c & sel, now, keep=keep_c,
+                        stale_filtered=True)
+                    if h < d - 1:
+                        cur_v, cur_s = nxt_v, nxt_s
+
+        # Final phase — finalize on the compacted rows, gather-based
+        # write-back (zero scatters on the [nl, K] block), then the
+        # announce cache insert on the compacted announcer rows.
+        changed = (wv != cv0_c) | (ws != cs0_c)
+        sent_r = jnp.where(changed, jnp.int8(0), sent[row_r])
+        ev_merge = jnp.sum(((cs0_c >= 0)
+                            & (ws != cs0_c)).astype(jnp.int32))
+        rc = recv_l[:, None]
+        cv = jnp.where(rc, wv[pos_r], cval_l)
+        cs = jnp.where(rc, ws[pos_r], cslot_l)
+        se = jnp.where(rc, sent_r[pos_r], sent)
+
+        idx_a, row_a, valid_a, pos_a = sparse_ops.compact_rows(
+            ann_l, ca_cap)
+        off_a = jnp.where(valid_a[:, None], offer_val[row_a], 0)
+        cv2, cs2, se2, ev_ann = self._insert_own_offers(
+            cv[row_a], cs[row_a], se[row_a], off_a, base_slot[row_a],
+            reset_on_hold=True)
+        ac = ann_l[:, None]
+        cv = jnp.where(ac, cv2[pos_a], cv)
+        cs = jnp.where(ac, cs2[pos_a], cs)
+        se = jnp.where(ac, se2[pos_a], se)
+
+        # floor arrived fully advanced and replicated (jit-level
+        # announce) — no pmax re-merge needed on this path.
+        ev = lax.psum(ev_merge + ev_ann, NODE_AXIS)
+        dr = lax.psum(n_drop, NODE_AXIS)
+        return own_l, cs, cv, se, floor, ev, dr
+
+    def _sample_dst_jit(self, k_peers, alive):
+        """Replay the per-shard sampling streams at the jit level —
+        shard s draws ``split(fold_in(key, s))[0]`` over its rows,
+        exactly what ``_gossip_shard`` derives inside ``shard_map`` —
+        so the sparse step can compute its receiver frontier from the
+        very ``dst`` the round will use."""
+        p = self.p
+        nl = p.n // self.d
+        parts = []
+        for s_ix in range(self.d):
+            k_p, _ = jax.random.split(jax.random.fold_in(k_peers, s_ix))
+            gi = s_ix * nl + jnp.arange(nl, dtype=jnp.int32)
+            if self._nbrs is None:
+                parts.append(self._sample_dst_complete(k_p, gi, alive,
+                                                       nl))
+            else:
+                nbrs_l = lax.dynamic_slice_in_dim(self._nbrs,
+                                                  s_ix * nl, nl)
+                deg_l = lax.dynamic_slice_in_dim(self._deg, s_ix * nl,
+                                                 nl)
+                cut_l = None if self._cut is None else \
+                    lax.dynamic_slice_in_dim(self._cut, s_ix * nl, nl)
+                parts.append(self._sample_dst_nbrs(
+                    k_p, gi, alive, nl, nbrs_l, deg_l, cut_l))
+        return jnp.concatenate(parts)
+
+    def _step_sparse(self, state: CompressedState, key: jax.Array):
+        """The sharded sparse round: frontiers and the overflow check
+        run at the jit level (GSPMD elementwise over the sharded
+        state), then ONE replicated predicate picks the sparse or the
+        dense shard body for every device — the collectives inside
+        either branch stay uniform across the mesh, the same shape as
+        the cadence-gated push-pull cond below."""
+        p, t = self.p, self.t
+        limit = p.resolved_retransmit_limit()
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
+        del k_drop  # folded per-shard inside the shard bodies
+
+        if self.perturb is not None:
+            state = self.perturb(state, k_perturb, now)
+
+        dst = lax.with_sharding_constraint(
+            self._sample_dst_jit(k_peers, state.node_alive),
+            self._row_sharding)
+
+        sender = jnp.any(kernel_ops.eligible_lines(
+            state.cache_slot, state.cache_sent, limit), axis=1)
+        recv = state.node_alive & jnp.any(sender[dst], axis=1)
+        # The announce own/floor half runs ONCE here (the announcer
+        # frontier needs offer_val anyway) and its outputs feed the
+        # sparse shard body directly — per-shard recompute would double
+        # the O(N·S) pass (GSPMD slices these row-sharded).
+        own1, floor1, offer_val, base_slot = self._announce_offers(
+            state.own, state.floor, state.node_alive, round_idx, now)
+        ann = jnp.any(offer_val > 0, axis=1)
+
+        nl = p.n // self.d
+        cs_cap, cr_cap, ca_cap = self._sparse_caps_shard
+
+        def per_shard(m):
+            return jnp.sum(m.reshape(self.d, nl).astype(jnp.int32),
+                           axis=1)
+
+        ns, nr, na = per_shard(sender), per_shard(recv), per_shard(ann)
+        overflow = jnp.any((ns > cs_cap) | (nr > cr_cap)
+                           | (na > ca_cap))
+        frontier = jnp.maximum(jnp.sum(ns),
+                               jnp.maximum(jnp.sum(nr), jnp.sum(na)))
+
+        spec_row, spec_repl = P(NODE_AXIS), P()
+        base_specs = (spec_row,) * 4 + (spec_repl,) * 4 + (spec_row,)
+        out_specs = (spec_row,) * 4 + (spec_repl,) * 3
+
+        def dense_branch(st):
+            def body(own, cs, cv, se, floor, al, k, r, dstl, offv,
+                     bsl):
+                ax = lax.axis_index(NODE_AXIS)
+                _, kd = jax.random.split(jax.random.fold_in(k, ax))
+                return self._gossip_shard_body(own, cs, cv, se, floor,
+                                               al, dstl, kd, r,
+                                               ann_local=(offv, bsl))
+            fn = shard_map(body, mesh=self.mesh,
+                           in_specs=base_specs + (spec_row, spec_row),
+                           out_specs=out_specs, check_vma=False)
+            # own/floor enter already announce-advanced — the jit-level
+            # pass feeds BOTH branches.
+            return fn(own1, st.cache_slot, st.cache_val,
+                      st.cache_sent, floor1, st.node_alive, k_peers,
+                      round_idx, dst, offer_val, base_slot)
+
+        def sparse_branch(st):
+            def body(own, cs, cv, se, floor, al, k, r, dstl, snd, rcv,
+                     an, offv, bsl):
+                ax = lax.axis_index(NODE_AXIS)
+                _, kd = jax.random.split(jax.random.fold_in(k, ax))
+                return self._gossip_shard_body_sparse(
+                    own, cs, cv, se, floor, al, dstl, kd, r, snd, rcv,
+                    an, offv, bsl)
+            fn = shard_map(body, mesh=self.mesh,
+                           in_specs=base_specs + (spec_row,) * 5,
+                           out_specs=out_specs, check_vma=False)
+            # own/floor enter already announce-advanced (own1/floor1).
+            return fn(own1, st.cache_slot, st.cache_val,
+                      st.cache_sent, floor1, st.node_alive, k_peers,
+                      round_idx, dst, sender, recv, ann, offer_val,
+                      base_slot)
+
+        own, cs, cv, se, floor, ev, dr = lax.cond(
+            overflow, dense_branch, sparse_branch, state)
+        state = dataclasses.replace(
+            state, own=own, cache_slot=cs, cache_val=cv, cache_sent=se,
+            floor=floor, evictions=state.evictions + ev,
+            dropped=state.dropped + dr)
+
+        state = lax.cond(
+            round_idx % t.push_pull_rounds == 0,
+            lambda st: self._push_pull_stride(st, k_pp, now),
+            lambda st: st, state)
+        state = lax.cond(
+            round_idx % t.sweep_rounds == 0,
+            lambda st: self._floor_advance_and_sweep(st, now),
+            lambda st: st, state)
+
+        state = dataclasses.replace(state, round_idx=round_idx)
+        ov = overflow.astype(jnp.int32)
+        stats = jnp.stack([1 - ov, ov, frontier])
+        return self._constrain(state), stats
 
     # -- the round ----------------------------------------------------------
 

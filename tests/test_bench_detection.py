@@ -29,6 +29,8 @@ class ScriptedSim:
         self.schedule = schedule
         self.board_exchange = "all_gather"
         self.a2a_slack = 2
+        self.last_sparse_stats = None
+        self.sparse_dispatches = []   # the per-chunk mode trace
 
     def init_state(self):
         return {"round": 0, "dropped": jnp.zeros((), jnp.int32)}
@@ -37,9 +39,17 @@ class ScriptedSim:
         return state
 
     def run_behind(self, state, key, num_rounds, every, donate=True,
-                   start_round=None):
+                   start_round=None, sparse=None):
         # donate/start_round: the pipelined driver contract (PR 3);
         # a scripted dict has no device buffers, both are no-ops here.
+        # sparse: the round-8 arbiter contract — recorded RAW (an
+        # omitted/None sparse would resolve the sim's env default, so
+        # the arbiter must always pass an explicit bool); a sparse
+        # dispatch reports a stats vector through last_sparse_stats.
+        self.sparse_dispatches.append(sparse)
+        self.last_sparse_stats = (
+            jnp.asarray([num_rounds, 0, 17], jnp.int32) if sparse
+            else None)
         rounds = np.arange(state["round"] + every,
                            state["round"] + num_rounds + 1, every)
         behind = np.asarray([self.schedule(r) for r in rounds],
@@ -100,6 +110,96 @@ class TestCrossingDetection:
         # First sample at/after round 30 on the 25-cadence is round 50.
         assert out["rounds_to_eps"] == 50
         assert out["rounds_to_eps_unsettled"] == 50
+
+    def test_bench_sparse_0_forces_explicit_dense(self, monkeypatch):
+        """BENCH_SPARSE=0 must pin EVERY dispatch to sparse=False even
+        when SIDECAR_TPU_SPARSE=1 would make the sims default sparse —
+        an omitted kwarg (sparse=None) would resolve the env default
+        and silently run the sparse program on the 'dense' baseline."""
+        import sidecar_tpu.models.compressed as comp
+        from sidecar_tpu.ops.sparse import SPARSE_ENV
+
+        monkeypatch.setenv("BENCH_SPARSE", "0")
+        monkeypatch.setenv(SPARSE_ENV, "1")
+        sims = []
+
+        def make(*a, **k):
+            sims.append(ScriptedSim(lambda r: 0.0))
+            return sims[-1]
+
+        monkeypatch.setattr(comp, "CompressedSim", make)
+        bench._bench_north_star(1000, 10, churn_frac=0.01, eps=1e-4,
+                                conv_every=25, max_rounds=150)
+        dispatches = [s for sim in sims for s in sim.sparse_dispatches]
+        assert dispatches and all(s is False for s in dispatches)
+
+
+class TestTimeoutWatchdog:
+    """PR 5 satellite: the harness timeout (SIGTERM) must flush ONE
+    parseable JSON record carrying the partial north-star progress —
+    BENCH_r05 ended rc=124 with `parsed: null` and zero salvageable
+    data."""
+
+    def test_watchdog_record_parses_with_partial_progress(self, capsys):
+        import json
+
+        import bench
+
+        bench._WATCHDOG.update({"phase": "init", "partial": None})
+        bench._watchdog_note("north_star", {"north_star_progress": {
+            "n": 1000, "rounds_executed": 300, "behind_last": 42.0,
+            "rounds_to_eps": 250, "rounds_to_eps_unsettled": None,
+            "sparse": {"sparse_rounds": 150, "dense_rounds": 150,
+                       "overflow_rounds": 0, "switches": 1,
+                       "frontier_hwm": 17},
+            "wall_seconds": 12.5, "note": None,
+        }})
+        # A later phase MERGES: the completed headline block and the
+        # faithful rerun's own progress must both survive (BENCH_r05:
+        # zero salvageable data is exactly what this prevents).
+        bench._watchdog_note("north_star_faithful",
+                             {"north_star": {"rounds_to_eps": 250}})
+        bench._watchdog_note("north_star_faithful", {
+            "north_star_faithful_progress": {"rounds_executed": 75}})
+        try:
+            bench._watchdog_handler(15, None)
+            code = None
+        except SystemExit as exc:
+            code = exc.code
+        assert code == 124
+        record = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert record["error"] == "bench_timeout"
+        assert record["watchdog"] is True
+        assert record["phase"] == "north_star_faithful"
+        partial = record["partial"]
+        assert partial["north_star_progress"]["rounds_executed"] == 300
+        assert partial["north_star_progress"]["sparse"]["switches"] == 1
+        assert partial["north_star"]["rounds_to_eps"] == 250
+        assert partial["north_star_faithful_progress"][
+            "rounds_executed"] == 75
+
+    def test_sigterm_reaches_installed_handler(self, capsys):
+        import json
+        import os
+        import signal
+
+        import bench
+
+        bench._WATCHDOG.update({"phase": "init", "partial": None})
+        bench._watchdog_note("compressed_headline",
+                            {"dense_rounds_per_sec": 28.1})
+        old = signal.getsignal(signal.SIGTERM)
+        try:
+            bench.install_watchdog()
+            with np.testing.assert_raises(SystemExit):
+                os.kill(os.getpid(), signal.SIGTERM)
+        finally:
+            signal.signal(signal.SIGTERM, old)
+        record = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert record["phase"] == "compressed_headline"
+        assert record["partial"]["dense_rounds_per_sec"] == 28.1
 
 
 class TestDeviceInitFailure:

@@ -11,7 +11,7 @@ import textwrap
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
                        / "tools"))
 
-from check_jit_entrypoints import check_tree  # noqa: E402
+from check_jit_entrypoints import check_tree, list_drivers  # noqa: E402
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
@@ -27,6 +27,36 @@ class TestRepoIsClean:
                                  "check_jit_entrypoints.py")],
             capture_output=True, text=True)
         assert proc.returncode == 0, proc.stderr
+
+    def test_sparse_scan_drivers_are_covered(self):
+        """PR 5 satellite: the sparse drivers must be SEEN by the
+        donate-or-waiver contract (a checker that silently stops
+        matching a new driver family is worse than none) — and all of
+        them donate."""
+        drivers = list_drivers(REPO / "sidecar_tpu")
+        sparse = [d for d in drivers if "_sparse_jit" in d]
+        names = "\n".join(sparse)
+        for expected in (
+                "models/compressed.py:_run_sparse_jit",
+                "models/compressed.py:_run_behind_sparse_jit",
+                "models/compressed.py:_run_fast_sparse_jit",
+                "models/compressed.py:_run_deltas_sparse_jit",
+                "models/exact.py:_run_sparse_jit",
+                "models/exact.py:_run_fast_sparse_jit",
+                "models/exact.py:_run_deltas_sparse_jit",
+                "parallel/sharded.py:_run_sparse_jit",
+                "parallel/sharded.py:_run_fast_sparse_jit"):
+            assert any(expected in d for d in sparse), (
+                f"{expected} not seen by the checker:\n{names}")
+        assert all(d.endswith(" donates") for d in sparse), names
+
+    def test_cli_list_mode(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" /
+                                 "check_jit_entrypoints.py"), "--list"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "_run_sparse_jit donates" in proc.stdout
 
 
 class TestDetection:

@@ -1,0 +1,657 @@
+"""The sparse-frontier round path (docs/sparse.md, PR 5).
+
+Centerpiece: dense==sparse BIT-IDENTITY.  The sparse round claims to be
+an execution-path optimization with zero semantic surface, so every
+suite here runs the same trajectory on both paths and asserts equality
+state-for-state (and delta-for-delta on the streaming drivers):
+
+* single-chip, both models, with and without ``drop_prob`` (the loss
+  stream is mode-independent by construction);
+* frontier-overflow rounds (tiny caps force the in-scan dense
+  fallback — which must also be bit-identical);
+* under a config6-seeded ``FaultPlan`` driving node pause windows
+  (the chaos composition surface of the sharded lockstep suite);
+* on BOTH sharded twins at d ∈ {1, 2, 4, 8} across every board
+  exchange mode, with the Pallas kernel path active on the compressed
+  twin (the sparse compacted publish rides the XLA twin of the kernel
+  pair — parity IS the contract being exercised);
+* chunked + donated + ``start_round=`` pipelining, mixing dense and
+  sparse chunks in one chain (the arbiter's switching pattern).
+
+Also here: the :class:`SparseArbiter` policy (hysteresis band — no
+dense↔sparse thrash on a census oscillating around one threshold;
+frontier-overflow→dense fallback with cooldown), the
+``SIDECAR_TPU_SPARSE`` env/constructor resolution contract, the
+``sparse.*`` metrics surfaces, and the bridge's per-run sparse report
+(back-to-back ``POST /simulate`` calls must not bleed counters —
+the PR-4 ``sync_exchange_metrics`` watermark bug class).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu import metrics
+from sidecar_tpu.chaos.plan import FaultPlan, NodeFault
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops.sparse import (
+    SPARSE_ENV,
+    SparseArbiter,
+    compact_rows,
+    resolve_sparse,
+)
+from sidecar_tpu.parallel.mesh import make_mesh
+from sidecar_tpu.parallel.sharded import ShardedSim
+
+from tests.test_sharded import DetShardedSim, det_sample_peers
+from tests.test_sharded_compressed import (
+    DET,
+    DetShardedCompressedSim,
+    assert_states_equal,
+)
+
+DET_DENSE = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=1.0,
+                       sweep_interval_s=0.4)
+MODES = ("all_gather", "all_to_all", "ring")
+DS = (1, 2, 4, 8)
+
+
+def _mint_schedule(params, mint_at=(0, 3)):
+    rng = np.random.default_rng(7)
+    return {i: np.sort(rng.choice(params.m, size=5, replace=False))
+            .astype(np.int32) for i in mint_at}
+
+
+def _compressed_pair_lockstep(params, rounds, alive_at=None,
+                              mint_at=(0, 3), timecfg=DET):
+    """Step a dense CompressedSim and a sparse twin in lockstep;
+    asserts bit-identity each round, returns the accumulated stats."""
+    schedule = _mint_schedule(params, mint_at)
+    dense = CompressedSim(params, topology.complete(params.n), timecfg)
+    sp = CompressedSim(params, topology.complete(params.n), timecfg)
+    sd, ss = dense.init_state(), sp.init_state()
+    totals = np.zeros(3, np.int64)
+    for i in range(rounds):
+        key = jax.random.PRNGKey(100 + i)
+        if i in schedule:
+            tick = int(sd.round_idx) * dense.t.round_ticks + 7
+            sd = dense.mint(sd, schedule[i], tick)
+            ss = sp.mint(ss, schedule[i], tick)
+        if alive_at is not None:
+            alive = jnp.asarray(alive_at(i))
+            sd = dataclasses.replace(sd, node_alive=alive)
+            ss = dataclasses.replace(ss, node_alive=alive)
+        sd = dense.step(sd, key)
+        ss, stats = sp.step_sparse(ss, key)
+        totals[:2] += np.asarray(stats)[:2]
+        totals[2] = max(totals[2], int(stats[2]))
+        assert_states_equal(sd, ss, f"r{i + 1}")
+    return totals
+
+
+@pytest.mark.sparse
+class TestCompressedLockstep:
+    def test_dense_equals_sparse_bit_identical(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        totals = _compressed_pair_lockstep(params, 12)
+        assert totals[0] == 12 and totals[1] == 0     # no fallbacks
+
+    def test_random_sampling_and_drop_prob(self):
+        """No det patching: the real PRNG streams (peer sampling AND
+        the drop_prob loss mask) must be mode-independent."""
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32,
+                                  drop_prob=0.2)
+        _compressed_pair_lockstep(params, 12)
+
+    def test_overflow_falls_back_dense_bit_identical(self, monkeypatch):
+        """A frontier bigger than the cap must take the in-scan dense
+        fallback — and stay bit-identical (the overflow→resync shape:
+        capacity exhaustion is reported, never silently truncated)."""
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32, sparse_cap=2)
+        totals = _compressed_pair_lockstep(params, 10)
+        assert totals[1] > 0                          # fallbacks fired
+
+    def test_config6_fault_plan_pause_window(self, monkeypatch):
+        """The chaos composition surface: a config6-seeded FaultPlan
+        drives node pause windows on both paths (the round must track
+        the failure and the recovery — dead rows leave the receiver
+        frontier, their re-announces re-enter it)."""
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        plan = FaultPlan(seed=6, nodes=(
+            NodeFault(nodes=(3, 4, 5), start_round=5, end_round=12),))
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+
+        def alive_at(i):
+            return np.array([not plan.node_down(node, i)
+                             for node in range(params.n)], dtype=bool)
+
+        _compressed_pair_lockstep(params, 16, alive_at=alive_at,
+                                  mint_at=(0, 6))
+
+    def test_deltas_stream_identical(self):
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        schedule = _mint_schedule(params, (0,))
+        key = jax.random.PRNGKey(3)
+        outs = []
+        for sparse in (False, True):
+            sim = CompressedSim(params, topology.complete(16), DET)
+            st = sim.mint(sim.init_state(), schedule[0], 7)
+            outs.append(sim.run_with_deltas(st, key, 10, cap=64,
+                                            donate=False, sparse=sparse))
+        (fd, dd), (fs, ds) = outs
+        assert_states_equal(fd, fs, "final")
+        for f in ("count", "node", "slot", "val", "overflow"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(dd, f)), np.asarray(getattr(ds, f)),
+                err_msg=f"delta {f}")
+
+    def test_chunked_donated_mixed_mode_chain(self):
+        """The arbiter's real dispatch pattern: a donated chunked chain
+        that SWITCHES mode between chunks replays the straight dense
+        run exactly (per-round keys fold round_idx, so chunks are
+        mode-interchangeable)."""
+        params = CompressedParams(n=32, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        sim = CompressedSim(params, topology.complete(32), DET)
+        mint = jnp.arange(8, dtype=jnp.int32) * 3
+        key = jax.random.PRNGKey(7)
+        straight = sim.run_fast(sim.mint(sim.init_state(), mint, 10),
+                                key, 18, donate=False)
+        chunked = sim.mint(sim.init_state(), mint, 10)
+        done = 0
+        for chunk, sparse in ((6, False), (6, True), (6, False)):
+            chunked = sim.run_fast(chunked, key, chunk,
+                                   start_round=done, sparse=sparse)
+            done += chunk
+        assert_states_equal(straight, chunked, "chunked")
+
+    def test_run_behind_sparse_matches_dense_curve(self):
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        schedule = _mint_schedule(params, (0,))
+        key = jax.random.PRNGKey(5)
+        sim = CompressedSim(params, topology.complete(16), DET)
+        st = sim.mint(sim.init_state(), schedule[0], 7)
+        _, behind_d = sim.run_behind(st, key, 12, 2, donate=False)
+        assert sim.last_sparse_stats is None
+        _, behind_s = sim.run_behind(st, key, 12, 2, donate=False,
+                                     sparse=True)
+        np.testing.assert_array_equal(np.asarray(behind_d),
+                                      np.asarray(behind_s))
+        stats = np.asarray(sim.last_sparse_stats)
+        assert stats[0] + stats[1] == 12 and stats[2] > 0
+
+
+@pytest.mark.sparse
+class TestNorthStarShapedTrajectory:
+    def test_env_forced_sparse_matches_dense_run(self, monkeypatch):
+        """The acceptance trajectory: the north-star workload shape at
+        CPU scale — converged floor, ER topology, refresh pinned, a
+        churn burst drained through the real budget — run once dense
+        and once with SIDECAR_TPU_SPARSE=1, state-for-state and
+        census-for-census identical across the wave AND the tail
+        (overflow fallback rounds included)."""
+        from sidecar_tpu.ops.topology import erdos_renyi
+
+        n = 256
+        cfg = TimeConfig(refresh_interval_s=10_000.0,
+                         push_pull_interval_s=4.0)
+        params = CompressedParams(n=n, services_per_node=4, fanout=3,
+                                  budget=8, cache_lines=32,
+                                  deep_sweep_every=0, sparse_cap=64)
+        topo = erdos_renyi(n, avg_degree=8.0, seed=3)
+        rng = np.random.default_rng(7)
+        slots = np.sort(rng.choice(params.m, size=10,
+                                   replace=False)).astype(np.int32)
+        key = jax.random.PRNGKey(0)
+
+        dense = CompressedSim(params, topo, cfg, sparse="0")
+        fd, bd = dense.run_behind(
+            dense.mint(dense.init_state(), slots, 10), key, 60, 5,
+            donate=False)
+
+        monkeypatch.setenv(SPARSE_ENV, "1")
+        sp = CompressedSim(params, topo, cfg)
+        fs, bs = sp.run_behind(sp.mint(sp.init_state(), slots, 10),
+                               key, 60, 5, donate=False)
+        assert_states_equal(fd, fs, "final")
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(bs))
+        stats = np.asarray(sp.last_sparse_stats)
+        # The wave overflows the tiny cap (dense fallback rounds), the
+        # tail runs compacted — both regimes exercised in ONE run.
+        assert stats[0] > 0 and stats[0] + stats[1] == 60
+
+
+@pytest.mark.sparse
+@pytest.mark.pallas
+class TestCompressedLockstepPallasKernels:
+    def test_sparse_xla_cut_matches_dense_pallas_round(self,
+                                                       monkeypatch):
+        """With SIDECAR_TPU_KERNELS=pallas the dense round runs the
+        fused Pallas publish/gather while the sparse round's compacted
+        publish rides the XLA twin — the kernel-pair bit-identity
+        contract is what keeps the two paths equal."""
+        monkeypatch.setenv(kernel_ops.ENV_VAR, "pallas")
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        schedule = _mint_schedule(params)
+        dense = CompressedSim(params, topology.complete(16), DET)
+        sp = CompressedSim(params, topology.complete(16), DET)
+        assert dense._kernels == "pallas" and dense._fused_gather
+        sd, ss = dense.init_state(), sp.init_state()
+        for i in range(8):
+            key = jax.random.PRNGKey(100 + i)
+            if i in schedule:
+                tick = int(sd.round_idx) * dense.t.round_ticks + 7
+                sd = dense.mint(sd, schedule[i], tick)
+                ss = sp.mint(ss, schedule[i], tick)
+            sd = dense.step(sd, key)
+            ss, _ = sp.step_sparse(ss, key)
+            assert_states_equal(sd, ss, f"pallas r{i + 1}")
+
+
+@pytest.mark.sparse
+class TestExactLockstep:
+    def test_dense_equals_sparse_with_drop(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        for drop in (0.0, 0.3):
+            params = SimParams(n=16, services_per_node=2, fanout=2,
+                               budget=4, drop_prob=drop)
+            dense = ExactSim(params, topology.complete(16), DET_DENSE)
+            sp = ExactSim(params, topology.complete(16), DET_DENSE)
+            sd, ss = dense.init_state(), sp.init_state()
+            for i in range(12):
+                key = jax.random.PRNGKey(i)
+                sd = dense.step(sd, key)
+                ss, _ = sp.step_sparse(ss, key)
+                np.testing.assert_array_equal(
+                    np.asarray(sd.known), np.asarray(ss.known),
+                    err_msg=f"known drop={drop} r{i + 1}")
+                np.testing.assert_array_equal(
+                    np.asarray(sd.sent), np.asarray(ss.sent),
+                    err_msg=f"sent drop={drop} r{i + 1}")
+
+    def test_wide_catalog_two_stage_select_and_deltas(self):
+        """m > 4096 exercises the grouped two-stage top-k with explicit
+        compacted row ids; the delta stream is the bridge's contract."""
+        params = SimParams(n=64, services_per_node=80, fanout=3,
+                           budget=5)
+        dense = ExactSim(params, topology.complete(64), DET_DENSE)
+        sp = ExactSim(params, topology.complete(64), DET_DENSE)
+        key = jax.random.PRNGKey(2)
+        f1, d1, c1 = dense.run_with_deltas(dense.init_state(), key, 10,
+                                           cap=4096, donate=False)
+        f2, d2, c2 = sp.run_with_deltas(sp.init_state(), key, 10,
+                                        cap=4096, donate=False,
+                                        sparse=True)
+        np.testing.assert_array_equal(np.asarray(f1.known),
+                                      np.asarray(f2.known))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c2))
+        for f in ("count", "node", "slot", "val", "overflow"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(d1, f)), np.asarray(getattr(d2, f)),
+                err_msg=f"delta {f}")
+
+    def test_overflow_fallback(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = SimParams(n=16, services_per_node=2, fanout=2,
+                           budget=4, sparse_cap=3)
+        dense = ExactSim(params, topology.complete(16), DET_DENSE)
+        sp = ExactSim(params, topology.complete(16), DET_DENSE)
+        sd, ss = dense.init_state(), sp.init_state()
+        overflowed = 0
+        for i in range(10):
+            key = jax.random.PRNGKey(i)
+            sd = dense.step(sd, key)
+            ss, stats = sp.step_sparse(ss, key)
+            overflowed += int(stats[1])
+            np.testing.assert_array_equal(np.asarray(sd.known),
+                                          np.asarray(ss.known))
+        assert overflowed > 0
+
+    def test_chaos_sim_rejects_sparse(self):
+        from sidecar_tpu.chaos.sim_inject import ChaosExactSim
+        params = SimParams(n=8, services_per_node=2)
+        sim = ChaosExactSim(params, topology.complete(8), DET_DENSE)
+        with pytest.raises(ValueError, match="sparse"):
+            sim.run_fast(sim.init_state(), jax.random.PRNGKey(0), 2,
+                         sparse=True)
+        # The env default degrades silently instead of breaking chaos.
+        assert sim._resolve_sparse_request(None) is False
+
+
+@pytest.mark.sparse
+class TestShardedTwinsLockstep:
+    def test_compressed_twin_all_modes_all_d(self, monkeypatch):
+        """The sparse sharded round vs the single-chip DENSE model:
+        per-shard compaction composing with every exchange mode at
+        every mesh width."""
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        schedule = _mint_schedule(params)
+        single = CompressedSim(params, topology.complete(16), DET)
+        ref = []
+        st = single.init_state()
+        for i in range(8):
+            key = jax.random.PRNGKey(100 + i)
+            if i in schedule:
+                st = single.mint(st, schedule[i],
+                                 int(st.round_idx) * DET.round_ticks + 7)
+            st = single.step(st, key)
+            ref.append(st)
+
+        for d in DS:
+            for mode in MODES:
+                sh = DetShardedCompressedSim(
+                    params, topology.complete(16), DET,
+                    mesh=make_mesh(jax.devices()[:d]),
+                    board_exchange=mode)
+                ss = sh.init_state()
+                for i in range(8):
+                    key = jax.random.PRNGKey(100 + i)
+                    if i in schedule:
+                        ss = sh.mint(ss, schedule[i],
+                                     int(ss.round_idx)
+                                     * DET.round_ticks + 7)
+                    ss, stats = sh.step_sparse(ss, key)
+                    assert_states_equal(ref[i], ss,
+                                        f"{mode}/d={d} r{i + 1}")
+                assert int(stats[1]) == 0
+                assert sh.sync_exchange_metrics(ss) == 0
+
+    def test_dense_twin_modes_by_d(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        cfg = TimeConfig(refresh_interval_s=1000.0,
+                         push_pull_interval_s=1e6, sweep_interval_s=1.0)
+        exact = ExactSim(params, topology.complete(16), cfg)
+        se = exact.init_state()
+        ref = []
+        for i in range(8):
+            se = exact.step(se, jax.random.PRNGKey(i))
+            ref.append(se)
+
+        for d in DS:
+            for mode in ("all_gather", "ring"):
+                sh = DetShardedSim(params, topology.complete(16), cfg,
+                                   mesh=make_mesh(jax.devices()[:d]),
+                                   board_exchange=mode)
+                ss = sh.init_state()
+                for i in range(8):
+                    ss, stats = sh.step_sparse(ss, jax.random.PRNGKey(i))
+                    np.testing.assert_array_equal(
+                        np.asarray(ref[i].known), np.asarray(ss.known),
+                        err_msg=f"known {mode}/d={d} r{i + 1}")
+                    np.testing.assert_array_equal(
+                        np.asarray(ref[i].sent), np.asarray(ss.sent),
+                        err_msg=f"sent {mode}/d={d} r{i + 1}")
+                assert int(stats[1]) == 0
+
+    def test_compressed_twin_overflow_falls_back_dense(self,
+                                                       monkeypatch):
+        """Force the sharded twin's per-shard frontier over its cap
+        (nl=32 > the floor-of-16 cap at sparse_cap=2): the replicated
+        overflow predicate must route every shard through the dense
+        body — with the jit-level announce precompute threaded in —
+        and stay bit-identical to the single-chip dense model."""
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=64, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32,
+                                  sparse_cap=2)
+        rng = np.random.default_rng(7)
+        schedule = {0: np.sort(rng.choice(params.m, size=40,
+                                          replace=False))
+                    .astype(np.int32)}
+        single = CompressedSim(params, topology.complete(64), DET)
+        st = single.init_state()
+        ref = []
+        for i in range(6):
+            key = jax.random.PRNGKey(100 + i)
+            if i in schedule:
+                st = single.mint(st, schedule[i], 7)
+            st = single.step(st, key)
+            ref.append(st)
+
+        overflowed = 0
+        for mode in MODES:
+            sh = DetShardedCompressedSim(
+                params, topology.complete(64), DET,
+                mesh=make_mesh(jax.devices()[:2]), board_exchange=mode)
+            ss = sh.init_state()
+            for i in range(6):
+                key = jax.random.PRNGKey(100 + i)
+                if i in schedule:
+                    ss = sh.mint(ss, schedule[i], 7)
+                ss, stats = sh.step_sparse(ss, key)
+                overflowed += int(stats[1])
+                assert_states_equal(ref[i], ss,
+                                    f"ovf {mode} r{i + 1}")
+        assert overflowed > 0
+
+    def test_sharded_compressed_sparse_chunked_chain(self):
+        from sidecar_tpu.parallel.sharded_compressed import (
+            ShardedCompressedSim,
+        )
+        params = CompressedParams(n=32, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        sim = ShardedCompressedSim(params, topology.complete(32), DET,
+                                   board_exchange="ring")
+        mint = jnp.arange(8, dtype=jnp.int32) * 3
+        key = jax.random.PRNGKey(7)
+        straight = sim.run_fast(sim.mint(sim.init_state(), mint, 10),
+                                key, 12, donate=False)
+        chunked, done = sim.mint(sim.init_state(), mint, 10), 0
+        for chunk, sparse in ((6, True), (6, False)):
+            chunked = sim.run_fast(chunked, key, chunk,
+                                   start_round=done, sparse=sparse)
+            done += chunk
+        assert_states_equal(straight, chunked, "chunked")
+
+
+class TestResolutionContract:
+    def test_env_resolution(self, monkeypatch):
+        monkeypatch.setenv(SPARSE_ENV, "1")
+        assert resolve_sparse(record=False) == "1"
+        monkeypatch.setenv(SPARSE_ENV, "0")
+        assert resolve_sparse(record=False) == "0"
+        monkeypatch.delenv(SPARSE_ENV, raising=False)
+        assert resolve_sparse(record=False) == "auto"
+        # Explicit constructor argument wins over the env.
+        monkeypatch.setenv(SPARSE_ENV, "0")
+        assert resolve_sparse("1", record=False) == "1"
+
+    def test_invalid_mode_rejected(self, monkeypatch):
+        monkeypatch.setenv(SPARSE_ENV, "always")
+        with pytest.raises(ValueError, match="sparse"):
+            resolve_sparse(record=False)
+
+    def test_mode_0_rejects_explicit_sparse(self):
+        params = CompressedParams(n=8, services_per_node=2,
+                                  cache_lines=32, budget=4)
+        sim = CompressedSim(params, topology.complete(8), DET,
+                            sparse="0")
+        with pytest.raises(ValueError, match="disabled"):
+            sim.run_fast(sim.init_state(), jax.random.PRNGKey(0), 2,
+                         sparse=True)
+
+    def test_mode_1_defaults_drivers_sparse(self, monkeypatch):
+        monkeypatch.setenv(SPARSE_ENV, "1")
+        params = CompressedParams(n=8, services_per_node=2,
+                                  cache_lines=32, budget=4)
+        sim = CompressedSim(params, topology.complete(8), DET)
+        final = sim.run_fast(sim.init_state(), jax.random.PRNGKey(0), 4)
+        assert sim.last_sparse_stats is not None
+        assert int(sim.last_sparse_stats[0]
+                   + sim.last_sparse_stats[1]) == 4
+        assert int(final.round_idx) == 4
+
+    def test_compact_rows_contract(self):
+        mask = jnp.asarray([False, True, False, True, True, False])
+        idx, row, valid, pos = compact_rows(mask, 4)
+        np.testing.assert_array_equal(np.asarray(idx), [1, 3, 4, 6])
+        np.testing.assert_array_equal(np.asarray(valid),
+                                      [True, True, True, False])
+        assert int(pos[1]) == 0 and int(pos[3]) == 1 and int(pos[4]) == 2
+
+
+class TestArbiter:
+    def test_hysteresis_no_thrash_on_oscillating_census(self):
+        arb = SparseArbiter("auto", enter_below=100.0, exit_above=200.0)
+        assert arb.sparse is False
+        # Oscillation within the band (between enter and exit
+        # thresholds) after entry must NOT flip the mode back.
+        assert arb.update_census(90.0) is True       # entered
+        for census in (150.0, 99.0, 180.0, 120.0, 101.0):
+            assert arb.update_census(census) is True
+        assert arb.run_switches == 1
+        # Only rising ABOVE the exit threshold leaves sparse...
+        assert arb.update_census(250.0) is False
+        assert arb.run_switches == 2
+        # ...and oscillation within the band does not re-enter.
+        for census in (150.0, 199.0, 101.0):
+            assert arb.update_census(census) is False
+        assert arb.run_switches == 2
+
+    def test_overflow_forces_dense_with_cooldown(self):
+        arb = SparseArbiter("auto", enter_below=100.0, cooldown=2)
+        arb.update_census(50.0)
+        assert arb.sparse is True
+        # A chunk whose stats report overflow rounds → dense + cooldown.
+        arb.record_chunk(10, np.asarray([7, 3, 42]))
+        assert arb.sparse is False
+        assert arb.run_overflow_rounds == 3
+        assert arb.update_census(10.0) is False      # cooldown 1
+        assert arb.update_census(10.0) is False      # cooldown 2
+        assert arb.update_census(10.0) is True       # re-entry allowed
+
+    def test_pinned_modes_ignore_census(self):
+        always = SparseArbiter("1", enter_below=1.0)
+        assert always.sparse is True
+        assert always.update_census(1e12) is True
+        never = SparseArbiter("0", enter_below=1e12)
+        assert never.sparse is False
+        assert never.update_census(0.0) is False
+
+    def test_dispatch_kwargs_always_explicit(self):
+        """A dense decision must dispatch ``sparse=False`` EXPLICITLY:
+        an omitted kwarg (None) would resolve the sim's env default
+        and defeat the BENCH_SPARSE=0 / {"sparse": false} pins."""
+        assert SparseArbiter("0", enter_below=1.0).dispatch_kwargs() \
+            == {"sparse": False}
+        assert SparseArbiter("1", enter_below=1.0).dispatch_kwargs() \
+            == {"sparse": True}
+        auto = SparseArbiter("auto", enter_below=10.0)
+        assert auto.dispatch_kwargs() == {"sparse": False}
+        auto.update_census(1.0)
+        assert auto.dispatch_kwargs() == {"sparse": True}
+
+    def test_explicit_false_overrides_env_default(self, monkeypatch):
+        """The forcing contract behind dispatch_kwargs: sparse=False on
+        a sim built under SIDECAR_TPU_SPARSE=1 runs the DENSE program
+        (last_sparse_stats stays None)."""
+        monkeypatch.setenv(SPARSE_ENV, "1")
+        params = CompressedParams(n=8, services_per_node=2,
+                                  cache_lines=32, budget=4)
+        sim = CompressedSim(params, topology.complete(8), DET)
+        sim.run_fast(sim.init_state(), jax.random.PRNGKey(0), 2,
+                     sparse=False)
+        assert sim.last_sparse_stats is None
+
+    def test_counters_and_per_run_reset(self):
+        before_rounds = metrics.counter("sparse.rounds")
+        before_sw = metrics.counter("sparse.switches")
+        arb = SparseArbiter("auto", enter_below=100.0)
+        arb.update_census(50.0)
+        arb.record_chunk(10, np.asarray([10, 0, 33]))
+        arb.record_chunk(5, None)
+        snap = arb.snapshot()
+        assert snap["sparse_rounds"] == 10
+        assert snap["dense_rounds"] == 5
+        assert snap["frontier_hwm"] == 33
+        assert snap["switches"] == 1
+        assert metrics.counter("sparse.rounds") == before_rounds + 10
+        assert metrics.counter("sparse.switches") == before_sw + 1
+        gauges = metrics.snapshot()["gauges"]
+        assert gauges["sparse.frontier_size"] == 33.0
+        # Fresh trajectory: the per-run view resets (the PR-4
+        # watermark-reset bug class), the process counters keep
+        # accumulating.
+        arb.new_trajectory()
+        assert arb.snapshot()["sparse_rounds"] == 0
+        assert metrics.counter("sparse.rounds") == before_rounds + 10
+        assert metrics.snapshot()["gauges"]["sparse.frontier_size"] == 0.0
+
+    def test_invalid_band_rejected(self):
+        with pytest.raises(ValueError, match="hysteresis|enter"):
+            SparseArbiter("auto", enter_below=100.0, exit_above=50.0)
+
+
+@pytest.mark.sparse
+class TestBridgeSparse:
+    def _bridge(self):
+        from tests.test_bridge import CFG, make_state
+        from sidecar_tpu.bridge import SimBridge
+        return SimBridge(make_state(("h1", "h2", "h3", "h4"), 2), CFG)
+
+    def test_forced_sparse_report_matches_dense(self):
+        bridge = self._bridge()
+        dense = bridge.simulate(rounds=20, seed=1, deltas_cap=32,
+                                sparse=False)
+        sparse = bridge.simulate(rounds=20, seed=1, deltas_cap=32,
+                                 sparse=True)
+        assert dense.projected == sparse.projected
+        assert dense.convergence == sparse.convergence
+        assert dense.deltas == sparse.deltas
+        assert dense.sparse["mode"] == "0"
+        assert sparse.sparse["mode"] == "1"
+        assert sparse.sparse["sparse_rounds"] \
+            + sparse.sparse["overflow_rounds"] == 20
+
+    def test_back_to_back_runs_report_per_run_numbers(self):
+        bridge = self._bridge()
+        first = bridge.simulate(rounds=12, sparse=True)
+        second = bridge.simulate(rounds=12, sparse=True)
+        # Per-run counters: the second run's report must NOT include
+        # the first run's rounds (the watermark-reset bug class).
+        assert first.sparse["sparse_rounds"] \
+            + first.sparse["overflow_rounds"] == 12
+        assert second.sparse["sparse_rounds"] \
+            + second.sparse["overflow_rounds"] == 12
+
+    def test_http_sparse_roundtrip(self):
+        import json
+        import urllib.request
+
+        from sidecar_tpu.bridge import serve_bridge
+        server = serve_bridge(self._bridge(), port=0)
+        try:
+            port = server.server_address[1]
+            body = json.dumps({"rounds": 8, "sparse": True}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/simulate", data=body,
+                method="POST")
+            with urllib.request.urlopen(req) as resp:
+                doc = json.loads(resp.read())
+            assert doc["sparse"]["mode"] == "1"
+            assert doc["sparse"]["sparse_rounds"] \
+                + doc["sparse"]["overflow_rounds"] == 8
+        finally:
+            server.shutdown()

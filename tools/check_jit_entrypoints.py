@@ -129,9 +129,10 @@ def _has_waiver(src_lines: list[str], fn: ast.FunctionDef) -> bool:
     return any(NO_DONATE_TAG in line for line in src_lines[lo:hi])
 
 
-def check_tree(root: pathlib.Path) -> list[str]:
-    """Returns a list of violation strings (empty = clean)."""
-    problems = []
+def _walk_drivers(root: pathlib.Path):
+    """Yield every jitted scan driver under ``root`` as
+    ``(path, lineno, name, status)`` with status one of ``donates`` /
+    ``waived`` / ``violation``."""
     for path in sorted(root.rglob("*.py")):
         if "__pycache__" in path.parts:
             continue
@@ -139,7 +140,7 @@ def check_tree(root: pathlib.Path) -> list[str]:
         try:
             tree = ast.parse(src)
         except SyntaxError as exc:  # pragma: no cover - broken file
-            problems.append(f"{path}: unparseable ({exc})")
+            yield path, 0, f"<unparseable: {exc}>", "violation"
             continue
         lines = src.splitlines()
         reach = _scan_reachers(tree)
@@ -155,19 +156,49 @@ def check_tree(root: pathlib.Path) -> list[str]:
             if not reaches_scan:
                 continue
             if any(_declares_donation(d) for d in jit_decs):
-                continue
-            if _has_waiver(lines, node):
-                continue
+                status = "donates"
+            elif _has_waiver(lines, node):
+                status = "waived"
+            else:
+                status = "violation"
+            yield path, node.lineno, node.name, status
+
+
+def check_tree(root: pathlib.Path) -> list[str]:
+    """Returns a list of violation strings (empty = clean)."""
+    problems = []
+    for path, lineno, name, status in _walk_drivers(root):
+        if status != "violation":
+            continue
+        if name.startswith("<unparseable"):
+            problems.append(f"{path}: {name[1:-1]}")
+        else:
             problems.append(
-                f"{path}:{node.lineno}: jitted scan driver "
-                f"'{node.name}' neither declares donate_argnums nor "
+                f"{path}:{lineno}: jitted scan driver "
+                f"'{name}' neither declares donate_argnums nor "
                 f"carries a '{NO_DONATE_TAG} <reason>' comment")
     return problems
 
 
+def list_drivers(root: pathlib.Path) -> list[str]:
+    """Coverage report: every jitted scan driver the contract governs,
+    one ``path:name status`` line each.  Exists so the test suite can
+    PIN that newly added driver families (the round-8 sparse drivers,
+    ``_run_*_sparse_jit``) are actually seen by the checker — a
+    contract that silently stops matching is worse than none."""
+    return [f"{path}:{name} {status}"
+            for path, _, name, status in _walk_drivers(root)]
+
+
 def main(argv: list[str]) -> int:
-    root = pathlib.Path(argv[1]) if len(argv) > 1 else \
+    args = [a for a in argv[1:] if a != "--list"]
+    do_list = len(args) != len(argv) - 1
+    root = pathlib.Path(args[0]) if args else \
         pathlib.Path(__file__).resolve().parent.parent / "sidecar_tpu"
+    if do_list:
+        for line in list_drivers(root):
+            print(line)
+        return 0
     problems = check_tree(root)
     for p in problems:
         print(p, file=sys.stderr)
