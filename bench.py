@@ -295,6 +295,9 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
     hit_total, hit_unsettled = None, None
     wall_total, wall_unsettled = None, None
 
+    from sidecar_tpu import metrics
+    from sidecar_tpu.telemetry import profiling
+
     def dispatch(st, start):
         # The arbiter's decision applies to the chunk being enqueued —
         # passed EXPLICITLY both ways (dispatch_kwargs: an omitted
@@ -303,9 +306,10 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
         # device stats handle (grabbing it never blocks — it is read
         # with the chunk's census, after the chunk has finished).
         use_sparse = arbiter.sparse
-        st2, behind = sim.run_behind(st, key, chunk, conv_every,
-                                     start_round=start,
-                                     **arbiter.dispatch_kwargs())
+        with profiling.annotate(f"sidecar.bench.{phase}.chunk"):
+            st2, behind = sim.run_behind(st, key, chunk, conv_every,
+                                         start_round=start,
+                                         **arbiter.dispatch_kwargs())
         return st2, behind, (sim.last_sparse_stats if use_sparse
                              else None)
 
@@ -318,8 +322,13 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
             dispatched += chunk
         else:
             nxt_behind = nxt_stats = None
+        t_chunk = time.perf_counter()
         behind = np.asarray(jax.device_get(pend_behind),
                             dtype=np.float64)
+        # Per-chunk wall (device_get drains the chunk's compute) into
+        # the telemetry histograms (docs/metrics.md) — the bench JSON's
+        # `telemetry` block reports their percentiles.
+        metrics.histogram_since(f"bench.{phase}.chunk", t_chunk)
         arbiter.record_chunk(
             chunk, None if pend_stats is None
             else np.asarray(jax.device_get(pend_stats)))
@@ -355,6 +364,32 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
         pend_behind, pend_stats = nxt_behind, nxt_stats
     wall = time.perf_counter() - t0
     conv_last = 1.0 - behind_last / nm
+
+    # Sharded exchange accounting reads the LAST dispatched state —
+    # captured BEFORE the trace probe below donates/advances it, so
+    # dropped_pulls counts only the measured run (and a probe failure
+    # after donation can never poison the headline read).  The sync
+    # also publishes the count as parallel.exchange.overflow.
+    dropped_pulls = sim.sync_exchange_metrics(pend_state) if sharded \
+        else None
+
+    # Flight-recorder tail probe (AFTER the timed loop — the measured
+    # numbers above are untouched): a short traced run off the final
+    # pipelined state summarizes the convergence tail round-for-round
+    # (frontier size, behind census, exchange bytes — ops/trace.py).
+    # BENCH_TRACE_TAIL=0 skips it.
+    trace_tail = None
+    if os.environ.get("BENCH_TRACE_TAIL", "1") != "0":
+        try:
+            from sidecar_tpu.ops import trace as trace_ops
+            tail_rounds = 8
+            pend_state, tail_tr = sim.run_with_trace(
+                pend_state, key, tail_rounds, start_round=dispatched,
+                **arbiter.dispatch_kwargs())
+            trace_tail = trace_ops.summarize(tail_tr)
+        except Exception as exc:  # the headline must survive the probe
+            print(f"# trace tail probe skipped: {exc}", file=sys.stderr)
+
     round_s = cfg.round_ticks / cfg.ticks_per_second
     out = {
         "n": n,
@@ -383,18 +418,19 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
         "target": "<10 s on v5e-8 (this is 1 chip; scaling path: "
                   "parallel/sharded_compressed.py, BENCH_SHARDED=1)",
         "sparse": {"mode": sparse_mode, **arbiter.snapshot()},
+        **({"round_trace_tail": trace_tail} if trace_tail else {}),
     }
     if sharded:
         # No silent caps: an all_to_all run with bucket overflows must
-        # be distinguishable from a drop-free one.  Read off the LAST
-        # dispatched state — the input ``state`` was donated into the
-        # pipeline (may include one speculative chunk's drops).  The
-        # sync also publishes the count as parallel.exchange.overflow.
+        # be distinguishable from a drop-free one.  ``dropped_pulls``
+        # was read off the LAST dispatched state above, pre-probe —
+        # the input ``state`` was donated into the pipeline (may
+        # include one speculative chunk's drops).
         out["devices"] = len(jax.devices())
         out["board_exchange"] = sim.board_exchange
         out["a2a_slack"] = sim.a2a_slack
         out["exchange_bytes_per_round"] = sim.exchange_bytes_per_round
-        out["dropped_pulls"] = sim.sync_exchange_metrics(pend_state)
+        out["dropped_pulls"] = dropped_pulls
     if note:
         out["note"] = note
     return out
@@ -459,11 +495,14 @@ def main() -> None:
         if "BENCH_NORTH_STAR_NODES" not in os.environ:
             ns_n = 4096
 
-    # Device-level tracing (SURVEY.md §5): BENCH_TRACE=<dir> wraps the
-    # measured runs in a jax.profiler trace (TensorBoard/xprof format) —
-    # the per-kernel timeline behind the roofline numbers above.
+    # Device-level tracing (SURVEY.md §5): BENCH_TRACE=<dir> (or the
+    # framework-wide SIDECAR_TPU_PROFILE_DIR — docs/telemetry.md) wraps
+    # the measured runs in a jax.profiler trace (TensorBoard/xprof
+    # format) — the per-kernel timeline behind the roofline numbers
+    # above; the north-star chunk dispatches annotate themselves on it.
     import contextlib
-    trace_dir = os.environ.get("BENCH_TRACE")
+    from sidecar_tpu.telemetry import profiling
+    trace_dir = os.environ.get("BENCH_TRACE") or profiling.profile_dir()
     trace = (jax.profiler.trace(trace_dir) if trace_dir
              else contextlib.nullcontext())
     with trace:
@@ -556,7 +595,16 @@ def main() -> None:
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.
     disarm_watchdog()
+    from sidecar_tpu import metrics as metrics_mod
     from sidecar_tpu.ops import kernels as kernel_ops
+
+    # The self-describing telemetry block (docs/telemetry.md): the
+    # per-phase chunk histograms this process accumulated plus the
+    # headline north star's round-trace tail summary.
+    telemetry = {
+        "histograms": metrics_mod.snapshot()["histograms"],
+        "round_trace_tail": north_star.get("round_trace_tail"),
+    }
     print(json.dumps({
         "metric": f"simulated gossip rounds/sec/chip (n={n}, spn={spn}, "
                   f"{platform})",
@@ -572,6 +620,7 @@ def main() -> None:
         **({"north_star_faithful_k1024": north_star_k1024}
            if north_star_k1024 else {}),
         **({"query": query_bench} if query_bench else {}),
+        "telemetry": telemetry,
     }))
 
 

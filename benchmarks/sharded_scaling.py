@@ -98,12 +98,14 @@ def main():
                                replace=False)).astype(np.int32)
 
     curve, bytes_by_d, dropped = {}, {}, 0
+    sim_dmax = None
     for d in (1, 2, 4, 8):
         sim = build(d, params, topo, cfg, opts.exchange)
         ms, drops = time_sim(sim, slots, opts.rounds)
         curve[str(d)] = round(ms, 3)
         bytes_by_d[str(d)] = sim.exchange_bytes_per_round
         dropped += drops
+        sim_dmax = sim
 
     # Exposed (non-overlapped) comm at the largest d: full round minus
     # the exchange-stubbed build of the same program.
@@ -112,6 +114,15 @@ def main():
                                 stub=True), slots, opts.rounds)
     exposed = max(0.0, curve[str(d_max)] - stub_ms)
     metrics.set_gauge("parallel.overlap.exposed_ms", round(exposed, 3))
+
+    # Flight-recorder pass at the largest d (ops/trace.py): per-round
+    # MEASURED offer volume for this mode, alongside the analytic
+    # per-device receive bytes — the comm telemetry the MULTICHIP
+    # record carries per exchange mode.
+    from sidecar_tpu.ops import trace as trace_ops
+    tstate = sim_dmax.mint(sim_dmax.init_state(), slots, 10)
+    _, tr = sim_dmax.run_with_trace(tstate, jax.random.PRNGKey(0), 8)
+    round_trace = trace_ops.summarize(tr)
 
     d1 = curve["1"]
     print(json.dumps({
@@ -128,6 +139,7 @@ def main():
         "overlap_exposed_ms_d8": round(exposed, 3),
         "overlap_stub_ms_per_round_d8": round(stub_ms, 3),
         "dropped_pulls": dropped,
+        "round_trace_d8": round_trace,
     }))
 
 

@@ -24,13 +24,16 @@ import dataclasses
 import json
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import jax
 import numpy as np
 
+from sidecar_tpu import metrics
 from sidecar_tpu import service as svc_mod
+from sidecar_tpu.telemetry import profiling
 from sidecar_tpu.catalog import ServicesState
 from sidecar_tpu.models.exact import ExactSim, SimParams, SimState
 from sidecar_tpu.models.timecfg import TimeConfig
@@ -78,6 +81,11 @@ class SimulationReport:
     # request (a fresh arbiter per simulate call), so back-to-back
     # POST /simulate calls never bleed counters into each other.
     sparse: Optional[dict] = None
+    # Flight-recorder round traces (ops/trace.py, docs/telemetry.md),
+    # present when the caller asked for them: ``{"requested": N,
+    # "rounds": [...]}`` with one record dict per traced round
+    # (frontier/behind/admitted/exchange_bytes/mode/tombstones).
+    trace: Optional[dict] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -181,7 +189,8 @@ class SimBridge:
                  deltas_cap: int = 0,
                  sharded: bool = False,
                  board_exchange: Optional[str] = None,
-                 sparse: Optional[bool] = None) -> SimulationReport:
+                 sparse: Optional[bool] = None,
+                 trace: int = 0) -> SimulationReport:
         """Run the catalog forward ``rounds`` gossip rounds.
 
         ``cold_nodes``: hostnames whose knowledge is blanked to their own
@@ -208,11 +217,25 @@ class SimBridge:
         arbiter picks dense vs sparse at each ``CHUNK_ROUNDS`` boundary
         from the convergence census the pipeline already pulls, with
         hysteresis and the frontier-overflow→dense fallback.  The
-        report's ``sparse`` block carries the per-RUN counters."""
+        report's ``sparse`` block carries the per-RUN counters.
+
+        ``trace`` > 0 records the flight-recorder stream for the first
+        ``trace`` rounds (``run_with_trace`` → ops/trace.py): one
+        record per round — frontier size, behind census, offers
+        admitted, analytic exchange bytes, sparse/dense mode, overflow
+        flag, tombstone count — in the report's ``trace`` block.
+        Available on both the single-chip and sharded twins; mutually
+        exclusive with ``deltas_cap`` (one scan streams one record
+        kind)."""
         if sharded and deltas_cap > 0:
             raise ValueError(
                 "deltas_cap > 0 is not supported with sharded=True "
                 "(delta extraction runs on the single-chip model)")
+        if trace > 0 and deltas_cap > 0:
+            raise ValueError(
+                "trace and deltas_cap are mutually exclusive "
+                "(one scan streams one record kind)")
+        t_req = time.perf_counter()
         state, params, mapping, sim = self.snapshot(
             sharded=sharded, board_exchange=board_exchange)
 
@@ -258,19 +281,33 @@ class SimBridge:
             # the per-request {"sparse": false} forcing contract).
             use_sparse = arbiter.sparse
             kw = arbiter.dispatch_kwargs()
-            if deltas_cap > 0:
-                out = sim.run_with_deltas(st, key, n_rounds, deltas_cap,
-                                          start_round=start, **kw)
-            else:
-                out = sim.run(st, key, n_rounds, start_round=start,
-                              **kw)
+            # Rounds of THIS chunk inside the trace budget: chunks past
+            # it dispatch the plain (trace-free) program.
+            traced_n = max(0, min(trace - start, n_rounds)) \
+                if trace > 0 else 0
+            with profiling.annotate("sidecar.bridge.dispatch"):
+                if deltas_cap > 0:
+                    out = sim.run_with_deltas(
+                        st, key, n_rounds, deltas_cap,
+                        start_round=start, **kw)
+                elif traced_n > 0:
+                    out = sim.run_with_trace(
+                        st, key, n_rounds, cap=traced_n,
+                        start_round=start, **kw)
+                else:
+                    out = sim.run(st, key, n_rounds, start_round=start,
+                                  **kw)
             return out + ((sim.last_sparse_stats if use_sparse
-                           else None),)
+                           else None),), traced_n > 0
 
         delta_stream = [] if deltas_cap > 0 else None
+        trace_rounds = [] if trace > 0 else None
         conv_parts = []
 
-        def consume(out, start, n_rounds):
+        def consume(out, start, n_rounds, traced):
+            from sidecar_tpu.ops import trace as trace_ops
+
+            t0 = time.perf_counter()
             stats = out[-1]
             out = out[:-1]
             if deltas_cap > 0:
@@ -278,6 +315,9 @@ class SimBridge:
                 delta_stream.extend(self._map_deltas(
                     batches, mapping, params, len(conv),
                     start_round=start))
+            elif traced:
+                final, tr, conv = out
+                trace_rounds.extend(trace_ops.trace_to_dicts(tr))
             else:
                 final, conv = out
             conv_h = np.asarray(jax.device_get(conv))
@@ -286,19 +326,24 @@ class SimBridge:
                 n_rounds, None if stats is None
                 else np.asarray(jax.device_get(stats)))
             arbiter.update_census((1.0 - float(conv_h[-1])) * nm)
+            # Chunk wall time measured at consumption (the device_get
+            # above drains this chunk's compute) — docs/metrics.md.
+            metrics.histogram_since("bridge.chunk", t0)
             return final
 
         # Each pending chunk carries its own start round — no reliance
         # on uniform chunk sizes.
-        pend, pend_start, pend_n = dispatch(state, sizes[0], 0), 0, \
-            sizes[0]
+        (pend, pend_tr), pend_start, pend_n = \
+            dispatch(state, sizes[0], 0), 0, sizes[0]
         done = sizes[0]
         for n_rounds in sizes[1:]:
-            nxt, nxt_start = dispatch(pend[0], n_rounds, done), done
+            (nxt, nxt_tr), nxt_start = dispatch(pend[0], n_rounds,
+                                                done), done
             done += n_rounds
-            consume(pend, pend_start, pend_n)
-            pend, pend_start, pend_n = nxt, nxt_start, n_rounds
-        final = consume(pend, pend_start, pend_n)
+            consume(pend, pend_start, pend_n, pend_tr)
+            pend, pend_tr, pend_start, pend_n = nxt, nxt_tr, \
+                nxt_start, n_rounds
+        final = consume(pend, pend_start, pend_n, pend_tr)
         conv = np.concatenate(conv_parts)
         known = np.asarray(final.known)
 
@@ -322,6 +367,7 @@ class SimBridge:
             projected[hostname] = view
 
         hits = np.nonzero(conv >= 1.0 - eps)[0]
+        metrics.histogram_since("bridge.simulate", t_req)
         return SimulationReport(
             rounds=rounds,
             seconds_simulated=rounds * self.t.round_ticks
@@ -334,6 +380,8 @@ class SimBridge:
             board_exchange=sim.board_exchange if sharded else None,
             devices=sim.d if sharded else None,
             sparse={"mode": sparse_mode, **arbiter.snapshot()},
+            trace=(None if trace_rounds is None
+                   else {"requested": trace, "rounds": trace_rounds}),
         )
 
     @staticmethod
@@ -381,7 +429,9 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                  background: bool = True) -> ThreadingHTTPServer:
     """POST /simulate {"rounds": N, "seed": S, "cold_nodes": [...],
     "sharded": bool, "board_exchange": "all_gather"|"ring",
-    "sparse": bool|null (null → SIDECAR_TPU_SPARSE / arbiter)}."""
+    "sparse": bool|null (null → SIDECAR_TPU_SPARSE / arbiter),
+    "trace": N (flight-recorder records for the first N rounds —
+    docs/telemetry.md)}."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -414,7 +464,8 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                     sharded=bool(req.get("sharded", False)),
                     board_exchange=req.get("board_exchange"),
                     sparse=(None if sparse_req is None
-                            else bool(sparse_req)))
+                            else bool(sparse_req)),
+                    trace=int(req.get("trace", 0)))
             except (ValueError, KeyError, TypeError,
                     json.JSONDecodeError) as exc:
                 self._reply(400, {"message": str(exc)})

@@ -28,6 +28,7 @@ from typing import Callable, Iterable, Iterator, Optional
 from sidecar_tpu import metrics
 from sidecar_tpu import service as svc_mod
 from sidecar_tpu.output import time_ago
+from sidecar_tpu.telemetry.span import span as _span
 from sidecar_tpu.runtime.looper import Looper, TimedLooper
 from sidecar_tpu.service import (
     ALIVE_LIFESPAN,
@@ -269,10 +270,15 @@ class ServicesState:
         This is the host-side scalar twin of ops/merge.py's vectorized
         kernel.  Timed like the reference (services_state.go:294)."""
         t0 = time.perf_counter()
-        try:
-            self._add_service_entry(new_svc)
-        finally:
-            metrics.measure_since("addServiceEntry", t0)
+        # Span: the merge hop of the live propagation path — the root
+        # of the writer-thread chain (snapshot publish nests under it;
+        # gossip.receive traces separately across the inbound queue —
+        # docs/telemetry.md).
+        with _span("catalog.merge"):
+            try:
+                self._add_service_entry(new_svc)
+            finally:
+                metrics.measure_since("addServiceEntry", t0)
 
     def _add_service_entry(self, new_svc: Service) -> None:
         with self._lock:

@@ -17,6 +17,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from sidecar_tpu import metrics
 from sidecar_tpu.discovery.base import Discoverer
 from sidecar_tpu.health.checks import (
     AlwaysSuccessfulCmd,
@@ -284,6 +285,12 @@ class Monitor:
                 return c.command.run(c.args)
             finally:
                 c.last_duration = time.monotonic() - t0
+                # Percentiles across ALL checks (the per-check
+                # last_duration above only orders submission): a few
+                # slow endpoints show up as a fat p99 even while p50
+                # stays healthy (docs/metrics.md).
+                metrics.histogram("health.check",
+                                  c.last_duration * 1000.0)
 
         def one() -> None:
             with self._lock:

@@ -1,4 +1,4 @@
-"""Metrics: counters / gauges / timers with a statsd sink.
+"""Metrics: counters / gauges / timers / histograms with a statsd sink.
 
 The reference instruments its hot paths with armon/go-metrics —
 ``MeasureSince`` timers on the delegate and catalog merge paths
@@ -10,12 +10,28 @@ in-memory (so tests and operators can read ``snapshot()``) and
 additionally emits standard statsd datagrams (``name:v|c``, ``|g``,
 ``|ms``) over UDP when a sink address is configured.
 
+Two latency instruments coexist (docs/metrics.md has the migration
+story):
+
+* :meth:`Metrics.measure_since` — the original go-metrics analog:
+  count / total / last-value only.  Kept for the legacy gossip-path
+  timers (``addServiceEntry``, ``notifyMsg``, ...).
+* :meth:`Metrics.histogram` — a bounded-reservoir percentile
+  instrument (p50/p95/p99 over up to ``HIST_RESERVOIR`` samples,
+  Vitter's Algorithm R beyond it).  The bridge dispatch, query-hub
+  fan-out, health-check, and chunk-dispatch sites record through this.
+  Every histogram ALSO mirrors count/total/last into the ``timers``
+  snapshot block, so dashboards reading the pre-histogram shape keep
+  working while sites migrate (the back-compat contract pinned by
+  tests/test_telemetry.py).
+
 Emission is fire-and-forget UDP on the caller's thread — one
 ``sendto`` per event, no buffering, errors swallowed — the same
 trade statsite/statsd clients make on hot paths."""
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
@@ -24,36 +40,68 @@ from typing import Optional
 PREFIX = "sidecar"
 
 
+def _percentile(sorted_samples: list, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample list."""
+    if not sorted_samples:
+        return 0.0
+    rank = max(1, int(-(-q * len(sorted_samples) // 1)))  # ceil(q·n)
+    return sorted_samples[min(rank, len(sorted_samples)) - 1]
+
+
 class Metrics:
+    # Reservoir bound per histogram: large enough that p99 over a
+    # steady stream is stable, small enough that a registry with dozens
+    # of histograms stays a few hundred KB.
+    HIST_RESERVOIR = 512
+
     def __init__(self, prefix: str = PREFIX) -> None:
         self.prefix = prefix
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {}
         self._gauges: dict[str, float] = {}
         self._timers: dict[str, list] = {}  # name → [count, total_ms, last]
-        self._sock: Optional[socket.socket] = None
-        self._addr: Optional[tuple[str, int]] = None
+        # name → [count, total_ms, last, max, min, samples(list)]
+        self._hists: dict[str, list] = {}
+        # Deterministic reservoir replacement (tests never depend on it:
+        # percentile assertions stay under the reservoir bound).
+        self._rand = random.Random(0xC0FFEE)
+        # The statsd sink is ONE (addr, sock) pair swapped atomically:
+        # hot-path emitters read it in a single reference load, so a
+        # concurrent reconfiguration can never expose a half-configured
+        # address-without-socket (or vice versa).
+        self._sink: Optional[tuple[tuple[str, int], socket.socket]] = None
 
     # -- configuration ------------------------------------------------------
 
     def configure_statsd(self, addr: Optional[str]) -> None:
         """``host:port`` enables the statsd sink; None/'' disables it
-        (SIDECAR_STATS_ADDR, main.go:156-166).  Ordered so concurrent
-        hot-path emitters never observe an address without a socket."""
-        if not addr:
-            self._addr = None
-            self._sock = None
-            return
-        host, _, port = addr.partition(":")
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._addr = (host or "127.0.0.1", int(port or 8125))
+        (SIDECAR_STATS_ADDR, main.go:156-166).  Reconfiguration is an
+        atomic pair swap under the registry lock — concurrent hot-path
+        emitters either see the complete old sink or the complete new
+        one — and the PREVIOUS socket is closed instead of leaked (the
+        pre-round-9 behavior dropped it unclosed, one leaked fd per
+        reconfiguration)."""
+        new_sink = None
+        if addr:
+            host, _, port = addr.partition(":")
+            sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            new_sink = ((host or "127.0.0.1", int(port or 8125)), sock)
+        with self._lock:
+            old_sink = self._sink
+            self._sink = new_sink
+        if old_sink is not None:
+            try:
+                old_sink[1].close()
+            except OSError:
+                pass
 
     def _emit(self, name: str, value, kind: str) -> None:
-        # Snapshot the pair: reconfiguration races must never kill a
-        # delegate thread mid-emit.
-        addr, sock = self._addr, self._sock
-        if addr is None or sock is None:
+        # One reference load snapshots the whole pair: reconfiguration
+        # races must never kill a delegate thread mid-emit.
+        sink = self._sink
+        if sink is None:
             return
+        addr, sock = sink
         try:
             payload = f"{self.prefix}.{name}:{value}|{kind}".encode()
             sock.sendto(payload, addr)
@@ -83,6 +131,41 @@ class Metrics:
             agg[2] = ms
         self._emit(name, round(ms, 3), "ms")
 
+    def histogram(self, name: str, ms: float) -> None:
+        """Record one latency sample (milliseconds) into the bounded
+        reservoir behind ``name`` — p50/p95/p99 in ``snapshot()``, a
+        standard ``|ms`` statsd datagram on the wire, and a mirrored
+        count/total/last entry in the legacy ``timers`` block (the
+        migration back-compat contract; see the module docstring)."""
+        ms = float(ms)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = [0, 0.0, 0.0, ms, ms, []]
+            h[0] += 1
+            h[1] += ms
+            h[2] = ms
+            h[3] = max(h[3], ms)
+            h[4] = min(h[4], ms)
+            samples = h[5]
+            if len(samples) < self.HIST_RESERVOIR:
+                samples.append(ms)
+            else:
+                # Vitter's Algorithm R: uniform over the full stream.
+                j = self._rand.randrange(h[0])
+                if j < self.HIST_RESERVOIR:
+                    samples[j] = ms
+            agg = self._timers.setdefault(name, [0, 0.0, 0.0])
+            agg[0] += 1
+            agg[1] += ms
+            agg[2] = ms
+        self._emit(name, round(ms, 3), "ms")
+
+    def histogram_since(self, name: str, t0: float) -> None:
+        """``histogram(name, elapsed-from-t0)`` — the MeasureSince
+        spelling for histogram sites."""
+        self.histogram(name, (time.perf_counter() - t0) * 1000.0)
+
     def counter(self, name: str) -> int:
         """Current value of one counter (0 if never incremented) —
         the chaos/robustness tests and operators poll the injection and
@@ -93,6 +176,19 @@ class Metrics:
 
     def snapshot(self) -> dict:
         with self._lock:
+            hists = {}
+            for k, h in self._hists.items():
+                s = sorted(h[5])
+                hists[k] = {
+                    "count": h[0],
+                    "total_ms": round(h[1], 3),
+                    "last_ms": round(h[2], 3),
+                    "max_ms": round(h[3], 3),
+                    "min_ms": round(h[4], 3),
+                    "p50_ms": round(_percentile(s, 0.50), 3),
+                    "p95_ms": round(_percentile(s, 0.95), 3),
+                    "p99_ms": round(_percentile(s, 0.99), 3),
+                }
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),
@@ -100,6 +196,7 @@ class Metrics:
                                "total_ms": round(v[1], 3),
                                "last_ms": round(v[2], 3)}
                            for k, v in self._timers.items()},
+                "histograms": hists,
             }
 
 
@@ -109,6 +206,8 @@ registry = Metrics()
 incr = registry.incr
 set_gauge = registry.set_gauge
 measure_since = registry.measure_since
+histogram = registry.histogram
+histogram_since = registry.histogram_since
 counter = registry.counter
 snapshot = registry.snapshot
 configure_statsd = registry.configure_statsd

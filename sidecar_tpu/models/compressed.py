@@ -119,6 +119,7 @@ from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import sparse as sparse_ops
+from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.merge import (
     apply_stickiness,
     staleness_mask,
@@ -1372,6 +1373,38 @@ class CompressedSim:
         self.last_sparse_stats = None
         return self._run_fast_jit(state, key, num_rounds)
 
+    def _trace_record(self, prev, nxt, stats):
+        """One round's flight-recorder record (ops/trace.py) — the
+        behind census goes through :meth:`behind`, so the sharded
+        twin's census-path restrictions (``metric_list_ok``) apply
+        unchanged."""
+        p = self.p
+        return trace_ops.compressed_record(
+            prev, nxt, self.behind(nxt),
+            budget=min(p.budget, p.cache_lines), fanout=p.fanout,
+            limit=p.resolved_retransmit_limit(), stats=stats)
+
+    def run_with_trace(self, state, key, num_rounds: int, cap: int = 0,
+                       donate: bool = True, start_round=None,
+                       sparse=None):
+        """Scan with the per-round flight recorder (ops/trace.py):
+        returns ``(final state, RoundTrace)``.  ``cap`` bounds the
+        record buffer (0 = every round); rounds past it truncate with
+        ``overflow`` set — the DeltaBatch contract.  Works unchanged on
+        the sharded twin (records are computed at the jit level over
+        the global tensors)."""
+        cap = cap or num_rounds
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, tr, stats = self._run_trace_sparse_jit(
+                state, key, num_rounds, cap)
+            self.last_sparse_stats = stats
+            return final, tr
+        self.last_sparse_stats = None
+        return self._run_trace_jit(state, key, num_rounds, cap)
+
     def run_with_deltas(self, state, key, num_rounds: int, cap: int,
                         donate: bool = True, sparse=None):
         """Scan with per-round changed-belief extraction: returns
@@ -1454,6 +1487,20 @@ class CompressedSim:
                                       length=num_rounds)
         return final, deltas
 
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_trace_jit(self, state, key, num_rounds, cap):
+        def body(carry, _):
+            st, buf = carry
+            st2 = self._step(st, jax.random.fold_in(key, st.round_idx))
+            buf = trace_ops.append_record(
+                buf, self._trace_record(st, st2, None))
+            return (st2, buf), None
+
+        (final, buf), _ = lax.scan(
+            body, (state, trace_ops.zero_trace(cap)), None,
+            length=num_rounds)
+        return final, buf
+
     # -- sparse-path scan drivers (docs/sparse.md) ---------------------------
     # Mirrors of the dense drivers above: same donation, same per-round
     # key folding (sparse chunks pipeline/resume interchangeably with
@@ -1529,6 +1576,21 @@ class CompressedSim:
             body, (state, belief(state), sparse_ops.zero_stats()), None,
             length=num_rounds)
         return final, deltas, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_trace_sparse_jit(self, state, key, num_rounds, cap):
+        def body(carry, _):
+            st, buf, acc = carry
+            st2, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            buf = trace_ops.append_record(
+                buf, self._trace_record(st, st2, s))
+            return (st2, buf, sparse_ops.accumulate_stats(acc, s)), None
+
+        (final, buf, stats), _ = lax.scan(
+            body, (state, trace_ops.zero_trace(cap),
+                   sparse_ops.zero_stats()), None, length=num_rounds)
+        return final, buf, stats
 
 
 # -- host-path kernels ------------------------------------------------------

@@ -52,6 +52,7 @@ from jax import lax
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import sparse as sparse_ops
+from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, is_known, pack, unpack_status, unpack_ts
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
@@ -505,6 +506,36 @@ class ExactSim:
         self.last_sparse_stats = None
         return self._run_fast_jit(state, key, num_rounds)
 
+    def _trace_record(self, prev: SimState, nxt: SimState, stats):
+        """One round's flight-recorder record (ops/trace.py)."""
+        return trace_ops.exact_record(
+            prev, nxt, budget=min(self.p.budget, self.p.m),
+            fanout=self.p.fanout,
+            limit=self.p.resolved_retransmit_limit(), stats=stats)
+
+    def run_with_trace(self, state: SimState, key: jax.Array,
+                       num_rounds: int, cap: int = 0,
+                       donate: bool = True, start_round=None,
+                       sparse=None):
+        """Scan with the per-round flight recorder (ops/trace.py):
+        returns ``(final state, RoundTrace, conv[num_rounds])``.  The
+        record stream rides the scan carry behind the static ``cap``
+        (0 = trace every round); rounds past the capacity are truncated
+        with ``overflow`` set — the DeltaBatch contract.  The plain
+        drivers compile none of this: ``trace=0`` dispatches
+        (:meth:`run`) are bit-identical to pre-trace programs."""
+        cap = cap or num_rounds
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, tr, conv, stats = self._run_trace_sparse_jit(
+                state, key, num_rounds, cap)
+            self.last_sparse_stats = stats
+            return final, tr, conv
+        self.last_sparse_stats = None
+        return self._run_trace_jit(state, key, num_rounds, cap)
+
     def run_with_deltas(self, state: SimState, key: jax.Array,
                         num_rounds: int, cap: int, donate: bool = True,
                         start_round=None, sparse=None):
@@ -575,6 +606,21 @@ class ExactSim:
                                          length=num_rounds)
         return final, deltas, conv
 
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_trace_jit(self, state: SimState, key: jax.Array,
+                       num_rounds: int, cap: int):
+        def body(carry, _):
+            st, buf = carry
+            st2 = self._step(st, jax.random.fold_in(key, st.round_idx))
+            buf = trace_ops.append_record(
+                buf, self._trace_record(st, st2, None))
+            return (st2, buf), self.convergence(st2)
+
+        (final, buf), conv = lax.scan(
+            body, (state, trace_ops.zero_trace(cap)), None,
+            length=num_rounds)
+        return final, buf, conv
+
     # -- sparse-path scan drivers (docs/sparse.md) ---------------------------
     # Mirrors of the dense drivers: same donation, same per-round key
     # folding (sparse chunks pipeline/resume interchangeably with dense
@@ -629,3 +675,20 @@ class ExactSim:
             body, (state, sparse_ops.zero_stats()), None,
             length=num_rounds)
         return final, deltas, conv, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_trace_sparse_jit(self, state: SimState, key: jax.Array,
+                              num_rounds: int, cap: int):
+        def body(carry, _):
+            st, buf, acc = carry
+            st2, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            buf = trace_ops.append_record(
+                buf, self._trace_record(st, st2, s))
+            return (st2, buf, sparse_ops.accumulate_stats(acc, s)), \
+                self.convergence(st2)
+
+        (final, buf, stats), conv = lax.scan(
+            body, (state, trace_ops.zero_trace(cap),
+                   sparse_ops.zero_stats()), None, length=num_rounds)
+        return final, buf, conv, stats

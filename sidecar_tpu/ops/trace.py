@@ -1,0 +1,237 @@
+"""TPU-side round tracing — the flight recorder's jit half.
+
+Per-round convergence telemetry used to exist only as scattered scalar
+counters synced after whole trajectories; this op turns it into a
+stream: consecutive scan states are summarized ON DEVICE into a fixed
+int32 record per round — frontier size, behind census, offers admitted,
+analytic exchange bytes, sparse/dense mode, overflow flag, tombstone
+count — and a bounded buffer of those records rides the scan carry.
+It is the ``ops/delta.py`` pattern applied to telemetry: shape-static,
+scan-compatible, and governed by the same static-cap contract —
+``count`` stays exact, rounds past the capacity are truncated away and
+``overflow`` reports it (the consumer's cue that the tail of the
+trajectory is unrecorded), never silently lost.
+
+Tracing is OPT-IN per dispatch (``run_with_trace``): the plain drivers
+compile no trace ops at all, so ``trace=0`` leaves every existing
+program untouched — the lockstep and ``check_jit_entrypoints``
+contracts pin this.
+
+Record semantics (shared by all four model families — exact,
+compressed, and both sharded twins; the sharded records are computed at
+the jit level over the global tensors, so GSPMD turns the reductions
+into all-reduces and the stream is bit-identical to the single-chip
+one, which tests/test_telemetry.py pins at d ∈ {1, 2, 4, 8}):
+
+* ``round``     — the absolute round index the record describes.
+* ``frontier``  — the PRE-round sender frontier: rows with any
+  eligible record/line (``ops/gossip.eligible_records`` /
+  ``ops/kernels.eligible_lines`` — the sparse path's own sender
+  predicate, so the traced value is exactly the frontier the sparse
+  arbiter reasons about).  Computed before the round's perturbation
+  hook runs (the trace extractor sits OUTSIDE the step).
+* ``behind``    — the POST-round behind census: #(alive node, slot)
+  beliefs not at the global freshest version — the settled/behind
+  split the north-star ε detector thresholds on.
+* ``admitted``  — offers admitted: state cells the round actually
+  changed (belief tensors diffed elementwise, the delta plane's
+  "changed cells" without materializing their indices).
+* ``exchange_bytes`` — analytic wire bytes of the round's offers: per
+  node ``min(budget, eligible) × fanout`` records at
+  :data:`RECORD_WIRE_BYTES` each (the reference's ~1398 B packet / 15
+  records budget model; push and pull move the same offer volume).
+* ``sparse``    — 1 when the round executed on the compacted sparse
+  path (the step's stats vector), 0 on dense rounds/runs.
+* ``overflow``  — 1 when a sparse round's frontier overflowed its cap
+  and took the in-scan dense fallback.
+* ``tombstones`` — POST-round count of tombstone-status cells across
+  the model's belief structures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops.kernels.publish_gather import eligible_lines
+from sidecar_tpu.ops.status import TOMBSTONE, is_known, unpack_status
+
+# Analytic wire cost of one gossiped record: the reference's ~1398 B
+# UDP packet carries the 15-record budget (services_delegate.go:182).
+RECORD_WIRE_BYTES = 93
+
+# Record layout — kept positional (a flat int32 [W] vector) so the scan
+# carry stays one array; names map through TRACE_FIELDS.
+TRACE_ROUND = 0
+TRACE_FRONTIER = 1
+TRACE_BEHIND = 2
+TRACE_ADMITTED = 3
+TRACE_EXCHANGE_BYTES = 4
+TRACE_SPARSE = 5
+TRACE_OVERFLOW = 6
+TRACE_TOMBSTONES = 7
+TRACE_WIDTH = 8
+TRACE_FIELDS = ("round", "frontier", "behind", "admitted",
+                "exchange_bytes", "sparse", "overflow", "tombstones")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RoundTrace:
+    """A bounded stream of per-round records.
+
+    ``count`` is the TRUE number of rounds traced (it may exceed the
+    buffer capacity); rows past ``min(count, cap)`` are zero padding.
+    ``overflow`` is ``count > cap`` — records beyond the capacity were
+    truncated (the DeltaBatch contract: capacity exhaustion is
+    reported, never silent)."""
+
+    count: jax.Array     # int32 scalar — rounds traced (exact)
+    rec: jax.Array       # int32 [cap, TRACE_WIDTH]
+    overflow: jax.Array  # bool scalar — count exceeded cap
+
+
+def zero_trace(cap: int) -> RoundTrace:
+    return RoundTrace(count=jnp.zeros((), jnp.int32),
+                      rec=jnp.zeros((cap, TRACE_WIDTH), jnp.int32),
+                      overflow=jnp.zeros((), bool))
+
+
+def append_record(buf: RoundTrace, rec: jax.Array) -> RoundTrace:
+    """Append one [TRACE_WIDTH] record; past the capacity the write
+    drops (truncation) while ``count`` keeps the exact total."""
+    cap = buf.rec.shape[0]
+    out = buf.rec.at[buf.count].set(rec, mode="drop")
+    count = buf.count + 1
+    return RoundTrace(count=count, rec=out, overflow=count > cap)
+
+
+def offer_census(elig, budget: int, fanout: int):
+    """(frontier, exchange_bytes) from a PRE-round eligibility mask
+    ``elig`` (bool [N, X] — records/lines a node could publish):
+    frontier = rows with any eligible entry; bytes = the analytic offer
+    volume ``Σ min(budget, eligible_i) × fanout × RECORD_WIRE_BYTES``."""
+    per_row = jnp.sum(elig.astype(jnp.int32), axis=1)
+    frontier = jnp.sum((per_row > 0).astype(jnp.int32))
+    recs = jnp.sum(jnp.minimum(per_row, budget))
+    return frontier, recs * (fanout * RECORD_WIRE_BYTES)
+
+
+def count_tombstones(*packed) -> jax.Array:
+    """Tombstone-status cells across packed-key tensors (unknown cells
+    — packed 0 — never count: ``is_known`` gates them)."""
+    total = jnp.zeros((), jnp.int32)
+    for arr in packed:
+        hit = is_known(arr) & (unpack_status(arr) == TOMBSTONE)
+        total = total + jnp.sum(hit.astype(jnp.int32))
+    return total
+
+
+def build_record(round_idx, frontier, behind, admitted, exchange_bytes,
+                 tombstones, stats=None) -> jax.Array:
+    """Assemble the [TRACE_WIDTH] int32 record; ``stats`` is the sparse
+    step's int32 [3] vector (sparse-taken, overflowed, frontier-hwm) or
+    None on dense rounds."""
+    if stats is None:
+        sparse = jnp.zeros((), jnp.int32)
+        overflow = jnp.zeros((), jnp.int32)
+    else:
+        sparse, overflow = stats[0], stats[1]
+    return jnp.stack([
+        jnp.asarray(round_idx, jnp.int32),
+        jnp.asarray(frontier, jnp.int32),
+        jnp.asarray(behind, jnp.int32),
+        jnp.asarray(admitted, jnp.int32),
+        jnp.asarray(exchange_bytes, jnp.int32),
+        jnp.asarray(sparse, jnp.int32),
+        jnp.asarray(overflow, jnp.int32),
+        jnp.asarray(tombstones, jnp.int32),
+    ])
+
+
+def exact_record(prev, nxt, *, budget: int, fanout: int, limit: int,
+                 stats=None) -> jax.Array:
+    """One round's record for the EXACT family (``SimState`` in, both
+    the single-chip model and the sharded twin — the reductions shard
+    cleanly under GSPMD)."""
+    elig = gossip_ops.eligible_records(prev.known, prev.sent, limit)
+    frontier, xbytes = offer_census(elig, budget, fanout)
+    alive = nxt.node_alive
+    truth = jnp.max(jnp.where(alive[:, None], nxt.known, 0), axis=0)
+    behind = jnp.sum((alive[:, None]
+                      & (nxt.known < truth[None, :])).astype(jnp.int32))
+    admitted = jnp.sum((nxt.known != prev.known).astype(jnp.int32))
+    tombs = count_tombstones(nxt.known)
+    return build_record(nxt.round_idx, frontier, behind, admitted,
+                        xbytes, tombs, stats)
+
+
+def compressed_record(prev, nxt, behind, *, budget: int, fanout: int,
+                      limit: int, stats=None) -> jax.Array:
+    """One round's record for the COMPRESSED family
+    (``CompressedState`` in; ``behind`` is the model's own census —
+    ``CompressedSim.behind(nxt)`` — passed in so the sharded twin's
+    census-path restrictions apply automatically)."""
+    elig = eligible_lines(prev.cache_slot, prev.cache_sent, limit)
+    frontier, xbytes = offer_census(elig, budget, fanout)
+    admitted = (
+        jnp.sum((nxt.own != prev.own).astype(jnp.int32))
+        + jnp.sum((nxt.cache_val != prev.cache_val).astype(jnp.int32))
+        + jnp.sum((nxt.cache_slot != prev.cache_slot).astype(jnp.int32))
+        + jnp.sum((nxt.floor != prev.floor).astype(jnp.int32)))
+    tombs = count_tombstones(nxt.own, nxt.floor, nxt.cache_val)
+    behind_i = jnp.minimum(jnp.asarray(behind, jnp.float32),
+                           jnp.float32(2**31 - 1)).astype(jnp.int32)
+    return build_record(nxt.round_idx, frontier, behind_i, admitted,
+                        xbytes, tombs, stats)
+
+
+# -- host-side views ---------------------------------------------------------
+
+def trace_to_dicts(trace: RoundTrace) -> list[dict]:
+    """Host-side view: one dict per RECORDED round (padding dropped —
+    with overflow, only the first ``cap`` rounds are present; the
+    caller reads ``trace.overflow``/``trace.count`` for the
+    truncation)."""
+    import numpy as np
+
+    count = int(np.asarray(trace.count))
+    rec = np.asarray(trace.rec)
+    out = []
+    for row in rec[:min(count, rec.shape[0])]:
+        out.append({name: int(row[i])
+                    for i, name in enumerate(TRACE_FIELDS)})
+    return out
+
+
+def summarize(trace: RoundTrace) -> dict:
+    """Compact tail summary of a trace (the bench / MULTICHIP JSON
+    block): last-record census plus per-round exchange-byte stats over
+    the recorded rounds."""
+    import numpy as np
+
+    count = int(np.asarray(trace.count))
+    rec = np.asarray(trace.rec)
+    recorded = rec[:min(count, rec.shape[0])]
+    if recorded.shape[0] == 0:
+        return {"rounds": 0, "truncated": bool(np.asarray(trace.overflow))}
+    xb = recorded[:, TRACE_EXCHANGE_BYTES].astype(np.int64)
+    return {
+        "rounds": count,
+        "truncated": bool(np.asarray(trace.overflow)),
+        "frontier_last": int(recorded[-1, TRACE_FRONTIER]),
+        "frontier_max": int(recorded[:, TRACE_FRONTIER].max()),
+        "behind_last": int(recorded[-1, TRACE_BEHIND]),
+        "admitted_total": int(
+            recorded[:, TRACE_ADMITTED].astype(np.int64).sum()),
+        "exchange_bytes_per_round_mean": int(xb.mean()),
+        "exchange_bytes_per_round_max": int(xb.max()),
+        "exchange_bytes_total": int(xb.sum()),
+        "sparse_rounds": int(recorded[:, TRACE_SPARSE].sum()),
+        "overflow_rounds": int(recorded[:, TRACE_OVERFLOW].sum()),
+        "tombstones_last": int(recorded[-1, TRACE_TOMBSTONES]),
+    }

@@ -53,6 +53,7 @@ from sidecar_tpu.models.exact import SimParams, SimState, clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import sparse as sparse_ops
+from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.merge import merge_packed, staleness_mask, sticky_adjust
 from sidecar_tpu.ops.status import (
     TOMBSTONE,
@@ -569,6 +570,34 @@ class ShardedSim:
         self.last_sparse_stats = None
         return self._run_jit(state, key, num_rounds)
 
+    def _trace_record(self, prev: SimState, nxt: SimState, stats):
+        """One round's flight-recorder record (ops/trace.py): computed
+        at the jit level over the GLOBAL tensors, so GSPMD shards the
+        reductions — the stream is bit-identical to ExactSim's."""
+        return trace_ops.exact_record(
+            prev, nxt, budget=min(self.p.budget, self.p.m),
+            fanout=self.p.fanout,
+            limit=self.p.resolved_retransmit_limit(), stats=stats)
+
+    def run_with_trace(self, state: SimState, key: jax.Array,
+                       num_rounds: int, cap: int = 0,
+                       donate: bool = True, start_round=None,
+                       sparse=None):
+        """Scan with the per-round flight recorder — the ExactSim
+        contract: ``(final, RoundTrace, conv[num_rounds])`` with the
+        static-cap truncation rule (docs/telemetry.md)."""
+        cap = cap or num_rounds
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, tr, conv, stats = self._run_trace_sparse_jit(
+                state, key, num_rounds, cap)
+            self.last_sparse_stats = stats
+            return final, tr, conv
+        self.last_sparse_stats = None
+        return self._run_trace_jit(state, key, num_rounds, cap)
+
     def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
                  donate: bool = True, start_round=None, sparse=None):
         self._check_horizon(state, num_rounds, start_round)
@@ -612,6 +641,36 @@ class ShardedSim:
             return self._step(st, jax.random.fold_in(key, st.round_idx)), None
         final, _ = lax.scan(body, state, None, length=num_rounds)
         return final
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_trace_jit(self, state, key, num_rounds, cap):
+        def body(carry, _):
+            st, buf = carry
+            st2 = self._step(st, jax.random.fold_in(key, st.round_idx))
+            buf = trace_ops.append_record(
+                buf, self._trace_record(st, st2, None))
+            return (st2, buf), self.convergence(st2)
+
+        (final, buf), conv = lax.scan(
+            body, (state, trace_ops.zero_trace(cap)), None,
+            length=num_rounds)
+        return final, buf, conv
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
+    def _run_trace_sparse_jit(self, state, key, num_rounds, cap):
+        def body(carry, _):
+            st, buf, acc = carry
+            st2, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            buf = trace_ops.append_record(
+                buf, self._trace_record(st, st2, s))
+            return (st2, buf, sparse_ops.accumulate_stats(acc, s)), \
+                self.convergence(st2)
+
+        (final, buf, stats), conv = lax.scan(
+            body, (state, trace_ops.zero_trace(cap),
+                   sparse_ops.zero_stats()), None, length=num_rounds)
+        return final, buf, conv, stats
 
     # Sparse-path scan drivers (docs/sparse.md): same donation and key
     # folding as the dense drivers, plus the stats accumulator.

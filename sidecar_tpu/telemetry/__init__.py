@@ -1,0 +1,23 @@
+"""The host-side telemetry plane (docs/telemetry.md).
+
+Three surfaces, one package:
+
+* :mod:`sidecar_tpu.telemetry.span` — the lightweight span tracer: a
+  thread-safe ring buffer of timed, parent/child-linked spans across
+  the live propagation path (gossip receive → catalog merge → snapshot
+  publish → watcher delivery), served as JSON at ``GET /api/trace``.
+* :mod:`sidecar_tpu.telemetry.prometheus` — Prometheus text exposition
+  of the metrics registry (``GET /metrics``), histogram quantiles
+  included.
+* :mod:`sidecar_tpu.telemetry.profiling` — ``jax.profiler`` trace
+  hooks behind ``SIDECAR_TPU_PROFILE_DIR`` (bench.py north-star chunks
+  and ``SimBridge`` dispatches annotate themselves when it is set).
+
+The jit-side half — the in-scan per-round :class:`RoundTrace` stream —
+lives with the other device ops in :mod:`sidecar_tpu.ops.trace`.
+"""
+
+from sidecar_tpu.telemetry.prometheus import render_prometheus
+from sidecar_tpu.telemetry.span import span, spans, reset_spans
+
+__all__ = ["render_prometheus", "span", "spans", "reset_spans"]

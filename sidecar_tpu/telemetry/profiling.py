@@ -1,0 +1,86 @@
+"""``jax.profiler`` trace hooks behind ``SIDECAR_TPU_PROFILE_DIR``.
+
+When the env var names a directory, the instrumented drivers record a
+TensorBoard/xprof device trace there and annotate their dispatch
+boundaries, so the per-kernel timeline lines up with the host-side
+phases:
+
+* bench.py wraps its measured phases in :func:`maybe_trace` and each
+  pipelined north-star chunk in :func:`annotate`;
+* ``SimBridge.simulate`` annotates every chunk dispatch (and can host
+  the whole-process trace when the bridge runs standalone).
+
+When the env var is unset every helper is a no-op returning a null
+context — zero imports of the profiler machinery, zero overhead on the
+hot path.  Profiler failures (a second concurrent trace, an
+unwritable directory) are logged and swallowed: telemetry must never
+take down the run it observes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+PROFILE_ENV = "SIDECAR_TPU_PROFILE_DIR"
+
+# One device trace per process (jax.profiler is a process singleton);
+# losers of the race simply run un-traced.
+_gate = threading.Semaphore(1)
+
+
+def profile_dir() -> Optional[str]:
+    """The configured profile directory, or None when profiling is off."""
+    return os.environ.get(PROFILE_ENV) or None
+
+
+@contextlib.contextmanager
+def maybe_trace(log_dir: Optional[str] = None):
+    """Context: a ``jax.profiler.trace`` into ``log_dir`` (default: the
+    env directory) when profiling is enabled AND no other trace is
+    active in this process; a no-op otherwise.  Yields True when a
+    trace actually started."""
+    target = log_dir or profile_dir()
+    if not target:
+        yield False
+        return
+    if not _gate.acquire(blocking=False):
+        yield False
+        return
+    started = False
+    try:
+        import jax
+        try:
+            jax.profiler.start_trace(target)
+            started = True
+        except Exception as exc:  # profiler state is process-global
+            log.warning("telemetry: jax profiler trace failed to start "
+                        "(%s) — continuing untraced", exc)
+        yield started
+    finally:
+        if started:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as exc:
+                log.warning("telemetry: jax profiler trace failed to "
+                            "stop cleanly (%s)", exc)
+        _gate.release()
+
+
+def annotate(name: str):
+    """A ``jax.profiler.TraceAnnotation`` labelling the enclosed
+    dispatches on the device timeline when profiling is enabled; a null
+    context otherwise."""
+    if not profile_dir():
+        return contextlib.nullcontext()
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover — profiler API drift
+        return contextlib.nullcontext()

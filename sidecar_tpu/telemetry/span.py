@@ -1,0 +1,98 @@
+"""Lightweight span tracer — end-to-end timing of the live path.
+
+The histograms in :mod:`sidecar_tpu.metrics` answer "how long does ONE
+site take"; spans answer "what did this event pass THROUGH": a record
+arriving on gossip crosses receive → catalog merge → snapshot publish →
+watcher delivery, and each hop records a span.  Spans on the same
+thread nest (a span opened while another is active becomes its child
+and shares its ``trace_id``), so one /trace read reconstructs the whole
+causal chain of a delivery.
+
+Deliberately tiny: a thread-local stack for parentage, one lock-guarded
+ring buffer of COMPLETED spans (bounded — a quiet reader never grows
+memory, a busy path overwrites oldest-first), plain dicts out.  No
+cross-thread context propagation: a hop that crosses a queue starts a
+new trace, which is exactly the boundary where the queue's own metrics
+(``query.hub.*``, ``web.watch.dropped``) take over the story.
+
+Served at ``GET /api/trace`` (web/api.py) newest-last; ``reset_spans``
+exists for tests.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from typing import Optional
+
+# Ring bound: ~1k completed spans ≈ a few seconds of a busy live path —
+# enough to reconstruct recent deliveries, small enough to never matter.
+RING_CAPACITY = 1024
+
+_lock = threading.Lock()
+_ring: "collections.deque[dict]" = collections.deque(maxlen=RING_CAPACITY)
+_ids = itertools.count(1)
+_tls = threading.local()
+
+
+class span:
+    """Context manager: ``with span("catalog.merge"): ...`` times the
+    block and records it into the ring on exit.  Nested spans link to
+    their parent and inherit its trace id."""
+
+    __slots__ = ("name", "span_id", "parent_id", "trace_id",
+                 "_t0", "_wall0")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def __enter__(self) -> "span":
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        with _lock:
+            self.span_id = next(_ids)
+        parent: Optional[span] = stack[-1] if stack else None
+        self.parent_id = parent.span_id if parent is not None else None
+        self.trace_id = parent.trace_id if parent is not None \
+            else self.span_id
+        self._wall0 = time.time()
+        self._t0 = time.perf_counter()
+        stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        dur_ms = (time.perf_counter() - self._t0) * 1000.0
+        stack = getattr(_tls, "stack", [])
+        if stack and stack[-1] is self:
+            stack.pop()
+        with _lock:
+            _ring.append({
+                "name": self.name,
+                "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "trace_id": self.trace_id,
+                "thread": threading.current_thread().name,
+                "start_unix_s": round(self._wall0, 6),
+                "duration_ms": round(dur_ms, 3),
+                "error": exc_type is not None,
+            })
+        return False
+
+
+def spans(limit: Optional[int] = None) -> list[dict]:
+    """Completed spans, oldest first (the ring's natural order); with
+    ``limit``, only the newest ``limit``."""
+    with _lock:
+        items = list(_ring)
+    if limit is not None and limit >= 0:
+        items = items[len(items) - min(limit, len(items)):]
+    return items
+
+
+def reset_spans() -> None:
+    """Clear the ring (tests)."""
+    with _lock:
+        _ring.clear()

@@ -29,6 +29,7 @@ from typing import Optional
 from sidecar_tpu import metrics
 from sidecar_tpu import service as svc_mod
 from sidecar_tpu.catalog import ServicesState, decode
+from sidecar_tpu.telemetry.span import span as _span
 
 log = logging.getLogger(__name__)
 
@@ -400,16 +401,24 @@ class GossipTransport:
                 if n > 0:
                     busy = True
                     t0 = time.perf_counter()
-                    try:
-                        svc = svc_mod.decode(buf.raw[:n])
-                        if self.fault_injector is not None:
-                            records = self.fault_injector.on_recv(svc)
-                        else:
-                            records = (svc,)
-                        for record in records:
-                            self._deliver_inbound(record)
-                    except ValueError as exc:
-                        log.warning("Error decoding gossip message: %s", exc)
+                    # Receive-side span: decode + hand-off to the
+                    # single-writer merge queue.  The merge itself runs
+                    # on the writer thread, so it starts its OWN trace
+                    # (the queue boundary — docs/telemetry.md); the
+                    # queue's `transport.shedInbound` accounting covers
+                    # the hand-off.
+                    with _span("gossip.receive"):
+                        try:
+                            svc = svc_mod.decode(buf.raw[:n])
+                            if self.fault_injector is not None:
+                                records = self.fault_injector.on_recv(svc)
+                            else:
+                                records = (svc,)
+                            for record in records:
+                                self._deliver_inbound(record)
+                        except ValueError as exc:
+                            log.warning("Error decoding gossip message: %s",
+                                        exc)
                     metrics.measure_since("notifyMsg", t0)
 
                 # Full-state payloads are unbounded (LocalState is the whole

@@ -14,6 +14,10 @@ Routes (http.go:64-76, http_api.go:35-45):
   GET  /api/watch (+ /watch)        versioned snapshot+delta stream
                                     (?since=V cursor; docs/query.md)
   GET  /servers                     human-readable state
+  GET  /metrics (+ /api/metrics)    Prometheus text exposition of the
+                                    registry (docs/telemetry.md)
+  GET  /api/trace (+ /trace)        span-tracer ring buffer as JSON
+                                    (?limit=N newest spans)
   GET  /api/debug/profile           live sampling CPU profile (pprof analog)
   GET  /api/haproxy/stats.csv       relay of the managed HAProxy's stats CSV
   OPTIONS                            CORS headers
@@ -184,9 +188,14 @@ class SidecarApi:
 
         # Observability surface — the go-metrics + net/http/pprof analog
         # (sidecarhttp/http.go:5, main.go:156-166): live hot-path
-        # counters/timers and thread stack dumps.
+        # counters/timers/histograms, Prometheus exposition, the span
+        # tracer, and thread stack dumps.
         if parts == ["metrics.json"]:
             return self.metrics_dump()
+        if parts == ["metrics"]:
+            return self.metrics_prometheus()
+        if parts == ["trace"]:
+            return self.trace_dump(query)
         if parts == ["debug", "stacks"]:
             return self.debug_stacks()
         if parts == ["debug", "profile"]:
@@ -298,6 +307,33 @@ class SidecarApi:
         from sidecar_tpu import metrics
 
         body = json.dumps(metrics.snapshot(), indent=2).encode()
+        return 200, "application/json", body, CORS_HEADERS
+
+    def metrics_prometheus(self):
+        """The registry in Prometheus text exposition format (``GET
+        /metrics`` — the standard scrape path; counters, gauges, and
+        the histogram instruments' quantiles, docs/telemetry.md)."""
+        from sidecar_tpu.telemetry import render_prometheus
+
+        body = render_prometheus().encode()
+        return (200, "text/plain; version=0.0.4; charset=utf-8", body,
+                CORS_HEADERS)
+
+    def trace_dump(self, query: dict):
+        """The span tracer's ring buffer as JSON (``GET /api/trace`` —
+        end-to-end timing of the live propagation path, receive →
+        merge → publish → watcher delivery; docs/telemetry.md).
+        ``?limit=N`` returns only the newest N spans."""
+        from sidecar_tpu.telemetry import spans
+
+        limit = None
+        raw = query.get("limit", [None])[0]
+        if raw is not None:
+            try:
+                limit = int(raw)
+            except ValueError:
+                return self._error(400, "limit must be an integer")
+        body = json.dumps({"spans": spans(limit)}, indent=2).encode()
         return 200, "application/json", body, CORS_HEADERS
 
     def debug_stacks(self):

@@ -13,6 +13,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from sidecar_tpu.telemetry.span import span as _span
 from sidecar_tpu.web.api import SidecarApi
 
 log = logging.getLogger(__name__)
@@ -72,10 +73,14 @@ def make_handler(api: SidecarApi, ui_dir: Optional[str],
                 self.end_headers()
 
                 def push(doc: dict) -> None:
-                    payload = json.dumps(doc).encode()
-                    self.wfile.write(b"%x\r\n%s\r\n"
-                                     % (len(payload), payload))
-                    self.wfile.flush()
+                    # The delivery hop of the live propagation path
+                    # (docs/telemetry.md): serialize + write one /watch
+                    # document to this subscriber.
+                    with _span("watch.deliver"):
+                        payload = json.dumps(doc).encode()
+                        self.wfile.write(b"%x\r\n%s\r\n"
+                                         % (len(payload), payload))
+                        self.wfile.flush()
 
                 current = api.state.query_hub().current()
                 if since is None or since != current.version:

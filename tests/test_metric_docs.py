@@ -1,0 +1,115 @@
+"""tools/check_metric_docs.py runs IN tier-1: every metric name
+emitted from ``sidecar_tpu/`` (``incr`` / ``set_gauge`` /
+``histogram`` / ``histogram_since`` literals and f-string prefixes)
+must be documented in ``docs/metrics.md`` — the reference is only
+trustworthy if it is complete (see the tool's docstring)."""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tools"))
+
+from check_metric_docs import check, documented_names, emitted_names  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+class TestRepoIsClean:
+    def test_sidecar_tpu_tree_is_documented(self):
+        problems = check(REPO / "sidecar_tpu", REPO / "docs" /
+                         "metrics.md")
+        assert problems == [], "\n".join(problems)
+
+    def test_cli_exit_code(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" /
+                                 "check_metric_docs.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_new_instruments_are_scanned(self):
+        """The PR-6 histogram sites must be SEEN by the scanner (a
+        checker that silently stops matching an instrument family is
+        worse than none)."""
+        names = {name for _, _, name, _ in
+                 emitted_names(REPO / "sidecar_tpu")}
+        for expected in ("bridge.simulate", "bridge.chunk",
+                         "query.hub.fanout", "health.check"):
+            assert expected in names, sorted(names)
+
+
+class TestDetection:
+    """The checker must actually flag offenders — a green run proves
+    nothing if the matcher is dead."""
+
+    DOCS = textwrap.dedent("""\
+        # Metrics
+
+        | name | meaning |
+        |------|---------|
+        | `query.hub.published` | publishes |
+        | `sparse.mode.<m>` | resolved mode |
+        | `kernels.path.pallas` | kernel dispatches |
+        """)
+
+    def _check(self, tmp_path, source, docs=None):
+        (tmp_path / "mod.py").write_text(textwrap.dedent(source))
+        docs_file = tmp_path / "metrics.md"
+        docs_file.write_text(docs if docs is not None else self.DOCS)
+        return check(tmp_path, docs_file)
+
+    def test_flags_undocumented_literal(self, tmp_path):
+        problems = self._check(tmp_path, """
+            from sidecar_tpu import metrics
+            metrics.incr("query.hub.published")
+            metrics.histogram("totally.new.name", 1.0)
+            """)
+        assert len(problems) == 1
+        assert "totally.new.name" in problems[0]
+
+    def test_accepts_documented_names_all_instruments(self, tmp_path):
+        problems = self._check(tmp_path, """
+            from sidecar_tpu import metrics
+            incr = metrics.incr
+            incr("query.hub.published")
+            metrics.set_gauge("query.hub.published", 2)
+            metrics.histogram_since("query.hub.published", 0.0)
+            """)
+        assert problems == []
+
+    def test_placeholder_matches_any_value(self, tmp_path):
+        problems = self._check(tmp_path, """
+            from sidecar_tpu import metrics
+            metrics.incr("sparse.mode.auto")
+            metrics.incr("sparse.mode.forced-dense")
+            metrics.incr("sparse.modeX")
+            """)
+        assert len(problems) == 1 and "sparse.modeX" in problems[0]
+
+    def test_fstring_prefix_covered_by_documented_name(self, tmp_path):
+        problems = self._check(tmp_path, """
+            from sidecar_tpu import metrics
+            path = "xla"
+            metrics.incr(f"kernels.path.{path}")
+            metrics.incr(f"unknown.prefix.{path}")
+            """)
+        assert len(problems) == 1
+        assert "unknown.prefix." in problems[0]
+
+    def test_fully_dynamic_name_is_skipped(self, tmp_path):
+        problems = self._check(tmp_path, """
+            from sidecar_tpu import metrics
+            def relay(name, value):
+                metrics.incr(name, value)
+            """)
+        assert problems == []
+
+    def test_metrics_module_itself_excluded(self, tmp_path):
+        (tmp_path / "metrics.py").write_text(
+            'def incr(name):\n    incr("internal.name")\n')
+        docs_file = tmp_path / "metrics.md"
+        docs_file.write_text(self.DOCS)
+        assert check(tmp_path, docs_file) == []

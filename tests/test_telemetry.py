@@ -1,0 +1,793 @@
+"""Telemetry-plane tests (PR 6, docs/telemetry.md).
+
+Four surfaces:
+
+* the in-scan flight recorder (ops/trace.py): record streams validated
+  round-for-round against a pure-Python oracle on both single-chip
+  models, the dense↔sparse lockstep, both sharded twins at
+  d ∈ {1, 2, 4, 8} (the trace must equal the untraced run's post-hoc
+  census — and must not perturb the run), and the static-cap
+  truncation contract;
+* the bridge plumbing: ``simulate(trace=N)`` / ``POST /simulate``
+  round-trip, chunked-pipeline equality, the deltas exclusivity rule;
+* the host instruments: histogram percentile math, the reservoir
+  bound, the timers-block back-compat mirror, statsd ``|ms`` emission,
+  and the ``configure_statsd`` reconfiguration fix (old socket closed,
+  pair swapped atomically);
+* exposition: span nesting / thread isolation, Prometheus text
+  rendering, and the ``GET /metrics`` + ``GET /api/trace`` endpoints.
+"""
+
+import dataclasses
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.bridge import SimBridge, serve_bridge
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.metrics import Metrics
+from sidecar_tpu.models.compressed import (
+    CompressedParams,
+    CompressedSim,
+    hash_line,
+)
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops import trace as trace_ops
+from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, pack, unpack_status
+from sidecar_tpu.parallel.mesh import make_mesh
+from sidecar_tpu.parallel.sharded import ShardedSim
+from sidecar_tpu.parallel.sharded_compressed import ShardedCompressedSim
+from sidecar_tpu.telemetry import render_prometheus, reset_spans, span, spans
+from sidecar_tpu.web import SidecarApi
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+CFG = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=2.0)
+
+
+# -- pure-Python oracle ------------------------------------------------------
+# Independent numpy recomputation of every record field from consecutive
+# state pairs — the jitted extractor must reproduce it cell-for-cell.
+
+def np_tombstones(*arrays) -> int:
+    """is_known & status==TOMBSTONE across packed-key tensors."""
+    total = 0
+    for a in arrays:
+        a = np.asarray(a)
+        total += int((((a >> 3) > 0) & ((a & 7) == TOMBSTONE)).sum())
+    return total
+
+
+def oracle_exact_record(prev, nxt, p: SimParams) -> dict:
+    limit = p.resolved_retransmit_limit()
+    known_p = np.asarray(prev.known)
+    sent_p = np.asarray(prev.sent)
+    elig = (known_p > 0) & (sent_p.astype(np.int32) < limit)
+    per_row = elig.sum(axis=1)
+    budget = min(p.budget, p.m)
+    known_n = np.asarray(nxt.known)
+    alive = np.asarray(nxt.node_alive)
+    truth = np.max(np.where(alive[:, None], known_n, 0), axis=0)
+    return {
+        "round": int(nxt.round_idx),
+        "frontier": int((per_row > 0).sum()),
+        "behind": int((alive[:, None] & (known_n < truth[None, :])).sum()),
+        "admitted": int((known_n != known_p).sum()),
+        "exchange_bytes": int(np.minimum(per_row, budget).sum())
+        * p.fanout * trace_ops.RECORD_WIRE_BYTES,
+        "tombstones": np_tombstones(known_n),
+    }
+
+
+def np_belief(state, params: CompressedParams) -> np.ndarray:
+    """Numpy materialization of the compressed belief view (the
+    test_delta oracle): max(floor, cache hit, own at owner rows)."""
+    n, s, m = params.n, params.services_per_node, params.m
+    own = np.asarray(state.own)
+    cache_slot = np.asarray(state.cache_slot)
+    cache_val = np.asarray(state.cache_val)
+    floor = np.asarray(state.floor)
+    out = np.tile(floor, (n, 1))
+    lines = np.asarray(hash_line(jnp.arange(m, dtype=jnp.int32),
+                                 params.cache_lines, s))
+    for i in range(n):
+        for slot in range(m):
+            li = lines[slot]
+            if cache_slot[i, li] == slot:
+                out[i, slot] = max(out[i, slot], cache_val[i, li])
+            if slot // s == i:
+                out[i, slot] = max(out[i, slot], own[i, slot % s])
+    return out
+
+
+def oracle_compressed_record(prev, nxt, p: CompressedParams) -> dict:
+    """All nodes alive, no DRAINING (the test regimes below) — the
+    behind census is #(node, slot) beliefs below the per-slot max."""
+    limit = p.resolved_retransmit_limit()
+    elig = (np.asarray(prev.cache_slot) >= 0) \
+        & (np.asarray(prev.cache_sent).astype(np.int32) < limit)
+    per_row = elig.sum(axis=1)
+    budget = min(p.budget, p.cache_lines)
+    belief = np_belief(nxt, p)
+    truth = belief.max(axis=0)
+    admitted = (
+        int((np.asarray(nxt.own) != np.asarray(prev.own)).sum())
+        + int((np.asarray(nxt.cache_val)
+               != np.asarray(prev.cache_val)).sum())
+        + int((np.asarray(nxt.cache_slot)
+               != np.asarray(prev.cache_slot)).sum())
+        + int((np.asarray(nxt.floor) != np.asarray(prev.floor)).sum()))
+    return {
+        "round": int(nxt.round_idx),
+        "frontier": int((per_row > 0).sum()),
+        "behind": int((belief < truth[None, :]).sum()),
+        "admitted": admitted,
+        "exchange_bytes": int(np.minimum(per_row, budget).sum())
+        * p.fanout * trace_ops.RECORD_WIRE_BYTES,
+        "tombstones": np_tombstones(nxt.own, nxt.floor, nxt.cache_val),
+    }
+
+
+def assert_trace_matches(rec: np.ndarray, r: int, want: dict,
+                         label: str) -> None:
+    got = {name: int(rec[r, i])
+           for i, name in enumerate(trace_ops.TRACE_FIELDS)}
+    for field, value in want.items():
+        assert got[field] == value, \
+            f"{label} round {r}: {field} = {got[field]}, want {value}"
+
+
+def churn_perturb(params: SimParams, spn: int, flip_prob: float = 0.05):
+    """config3-style churn (the test_delta hook): a Bernoulli subset of
+    owners re-stamps each round, flipping ALIVE ↔ TOMBSTONE so the
+    trace's tombstone census actually moves."""
+    owner = jnp.arange(params.m, dtype=jnp.int32) // spn
+    cols = jnp.arange(params.m, dtype=jnp.int32)
+
+    def perturb(state, key, now):
+        churn = jax.random.bernoulli(key, flip_prob, (params.m,))
+        own = state.known[owner, cols]
+        flip = churn & (own > 0) & state.node_alive[owner]
+        st = unpack_status(own)
+        new_status = jnp.where(st == ALIVE, TOMBSTONE, ALIVE)
+        new_val = jnp.where(flip, pack(now, new_status), own)
+        known = state.known.at[owner, cols].set(new_val)
+        reset = jnp.where(flip, owner, params.n)
+        sent = state.sent.at[reset, cols].set(jnp.int8(0), mode="drop")
+        return dataclasses.replace(state, known=known, sent=sent)
+
+    return perturb
+
+
+# -- the flight recorder vs the oracle --------------------------------------
+
+@pytest.mark.parametrize("seed", [0, 1])
+class TestExactTraceVsOracle:
+    def make(self):
+        params = SimParams(n=8, services_per_node=3, fanout=2, budget=6)
+        sim = ExactSim(params, topology.complete(8),
+                       perturb=churn_perturb(params, 3))
+        return params, sim
+
+    def test_stream_matches_stepwise_census(self, seed):
+        params, sim = self.make()
+        state = sim.init_state()
+        key = jax.random.PRNGKey(seed)
+        rounds = 10
+        final, tr, conv = sim.run_with_trace(state, key, rounds,
+                                             donate=False)
+        assert int(tr.count) == rounds and not bool(tr.overflow)
+        rec = np.asarray(tr.rec)
+
+        st, saw_tombstone = state, False
+        for r in range(rounds):
+            prev = st
+            st = sim.step(st, jax.random.fold_in(key, st.round_idx))
+            want = oracle_exact_record(prev, st, params)
+            assert_trace_matches(rec, r, want, "exact")
+            # Dense run: mode flags stay zero.
+            assert rec[r, trace_ops.TRACE_SPARSE] == 0
+            assert rec[r, trace_ops.TRACE_OVERFLOW] == 0
+            saw_tombstone = saw_tombstone or want["tombstones"] > 0
+        assert saw_tombstone, "churn never produced a traced tombstone"
+        np.testing.assert_array_equal(np.asarray(final.known),
+                                      np.asarray(st.known))
+
+    def test_trace_does_not_perturb_the_run(self, seed):
+        """trace=N and trace=0 dispatches produce bit-identical states
+        and convergence curves (the trace extractor sits OUTSIDE the
+        step)."""
+        params, sim = self.make()
+        state = sim.init_state()
+        key = jax.random.PRNGKey(seed)
+        plain_final, plain_conv = sim.run(state, key, 8, donate=False)
+        traced_final, _, traced_conv = sim.run_with_trace(
+            state, key, 8, donate=False)
+        np.testing.assert_array_equal(np.asarray(plain_final.known),
+                                      np.asarray(traced_final.known))
+        np.testing.assert_array_equal(np.asarray(plain_conv),
+                                      np.asarray(traced_conv))
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+class TestCompressedTraceVsOracle:
+    def make(self):
+        params = CompressedParams(n=8, services_per_node=4,
+                                  cache_lines=16, fanout=2, budget=6)
+        sim = CompressedSim(params, topology.complete(8))
+        return params, sim
+
+    def seeded_state(self, sim, params, seed):
+        """Minted churn (tombstones included) so the traced rounds
+        carry real in-flight records."""
+        state = sim.init_state()
+        rng = np.random.default_rng(seed)
+        for burst in range(2):
+            slots = rng.choice(params.m, size=5, replace=False)
+            state = sim.mint(state, jnp.asarray(slots, jnp.int32),
+                             now_tick=burst * 50 + 10,
+                             status=TOMBSTONE if burst else ALIVE)
+        return state
+
+    def test_stream_matches_stepwise_census(self, seed):
+        params, sim = self.make()
+        state = self.seeded_state(sim, params, seed)
+        key = jax.random.PRNGKey(seed)
+        rounds = 6
+        final, tr = sim.run_with_trace(state, key, rounds, donate=False)
+        assert int(tr.count) == rounds and not bool(tr.overflow)
+        rec = np.asarray(tr.rec)
+
+        st = state
+        for r in range(rounds):
+            prev = st
+            st = sim.step(st, jax.random.fold_in(key, st.round_idx))
+            want = oracle_compressed_record(prev, st, params)
+            assert_trace_matches(rec, r, want, "compressed")
+        np.testing.assert_array_equal(np.asarray(final.cache_val),
+                                      np.asarray(st.cache_val))
+
+    def test_trace_does_not_perturb_the_run(self, seed):
+        params, sim = self.make()
+        state = self.seeded_state(sim, params, seed)
+        key = jax.random.PRNGKey(seed)
+        plain_final, plain_conv = sim.run(state, key, 6, donate=False)
+        traced_final, _ = sim.run_with_trace(state, key, 6,
+                                             donate=False)
+        np.testing.assert_array_equal(np.asarray(plain_final.cache_val),
+                                      np.asarray(traced_final.cache_val))
+        np.testing.assert_array_equal(np.asarray(plain_final.floor),
+                                      np.asarray(traced_final.floor))
+
+
+# Every trace column EXCEPT the execution-mode pair — dense and sparse
+# runs must agree on all of these (the PR-5 bit-identity contract,
+# observed through the flight recorder).
+CENSUS_COLS = [trace_ops.TRACE_ROUND, trace_ops.TRACE_FRONTIER,
+               trace_ops.TRACE_BEHIND, trace_ops.TRACE_ADMITTED,
+               trace_ops.TRACE_EXCHANGE_BYTES, trace_ops.TRACE_TOMBSTONES]
+
+
+class TestDenseSparseLockstep:
+    def test_exact_traces_agree(self):
+        params = SimParams(n=16, services_per_node=2, fanout=2,
+                           budget=4, sparse_cap=16)
+        sim = ExactSim(params, topology.complete(16))
+        state = sim.init_state()
+        key = jax.random.PRNGKey(7)
+        fd, td, cd = sim.run_with_trace(state, key, 8, donate=False,
+                                        sparse=False)
+        fs, ts, cs = sim.run_with_trace(state, key, 8, donate=False,
+                                        sparse=True)
+        rd, rs = np.asarray(td.rec), np.asarray(ts.rec)
+        np.testing.assert_array_equal(rd[:, CENSUS_COLS],
+                                      rs[:, CENSUS_COLS])
+        assert not rd[:, trace_ops.TRACE_SPARSE].any()
+        # cap == n: no overflow, every sparse round takes the
+        # compacted path and the trace says so.
+        assert rs[:, trace_ops.TRACE_SPARSE].all()
+        assert not rs[:, trace_ops.TRACE_OVERFLOW].any()
+        np.testing.assert_array_equal(np.asarray(fd.known),
+                                      np.asarray(fs.known))
+        np.testing.assert_array_equal(np.asarray(cd), np.asarray(cs))
+
+    def test_compressed_traces_agree(self):
+        params = CompressedParams(n=16, services_per_node=2,
+                                  cache_lines=32, fanout=2, budget=4,
+                                  sparse_cap=16)
+        sim = CompressedSim(params, topology.complete(16))
+        state = sim.mint(sim.init_state(),
+                         jnp.arange(0, params.m, 2, dtype=jnp.int32),
+                         now_tick=10)
+        key = jax.random.PRNGKey(9)
+        fd, td = sim.run_with_trace(state, key, 8, donate=False,
+                                    sparse=False)
+        fs, ts = sim.run_with_trace(state, key, 8, donate=False,
+                                    sparse=True)
+        rd, rs = np.asarray(td.rec), np.asarray(ts.rec)
+        np.testing.assert_array_equal(rd[:, CENSUS_COLS],
+                                      rs[:, CENSUS_COLS])
+        assert rs[:, trace_ops.TRACE_SPARSE].all()
+        assert not rd[:, trace_ops.TRACE_SPARSE].any()
+        np.testing.assert_array_equal(np.asarray(fd.cache_val),
+                                      np.asarray(fs.cache_val))
+
+
+DS = (1, 2, 4, 8)
+
+
+class TestShardedTrace:
+    """Both sharded twins, every device count: the jit-level trace
+    (GSPMD-sharded reductions over the global tensors) must equal the
+    untraced run's post-hoc census."""
+
+    def test_exact_twin_matches_census_by_d(self):
+        params = SimParams(n=16, services_per_node=2, fanout=2,
+                           budget=4)
+        for d in DS:
+            sim = ShardedSim(params, topology.complete(16),
+                             mesh=make_mesh(jax.devices()[:d]))
+            state = sim.init_state()
+            key = jax.random.PRNGKey(d)
+            rounds = 6
+            final, tr, conv = sim.run_with_trace(state, key, rounds,
+                                                 donate=False)
+            assert int(tr.count) == rounds and not bool(tr.overflow)
+            rec = np.asarray(tr.rec)
+            st = state
+            for r in range(rounds):
+                prev = st
+                st = sim.step(st, jax.random.fold_in(key,
+                                                     st.round_idx))
+                want = oracle_exact_record(prev, st, params)
+                assert_trace_matches(rec, r, want, f"sharded d={d}")
+            np.testing.assert_array_equal(np.asarray(final.known),
+                                          np.asarray(st.known))
+
+    def test_compressed_twin_matches_census_by_d(self):
+        params = CompressedParams(n=16, services_per_node=2,
+                                  cache_lines=32, fanout=2, budget=4)
+        for d in DS:
+            sim = ShardedCompressedSim(params, topology.complete(16),
+                                       mesh=make_mesh(jax.devices()[:d]))
+            state = sim.mint(
+                sim.init_state(),
+                jnp.arange(0, params.m, 2, dtype=jnp.int32),
+                now_tick=10)
+            key = jax.random.PRNGKey(d)
+            rounds = 6
+            final, tr = sim.run_with_trace(state, key, rounds,
+                                           donate=False)
+            assert int(tr.count) == rounds and not bool(tr.overflow)
+            rec = np.asarray(tr.rec)
+            st = state
+            for r in range(rounds):
+                prev = st
+                st = sim.step(st, jax.random.fold_in(key,
+                                                     st.round_idx))
+                want = oracle_compressed_record(prev, st, params)
+                assert_trace_matches(rec, r, want,
+                                     f"sharded-compressed d={d}")
+            np.testing.assert_array_equal(np.asarray(final.cache_val),
+                                          np.asarray(st.cache_val))
+
+
+class TestTruncationContract:
+    """The DeltaBatch contract: count stays exact, rows past the cap
+    truncate, overflow reports it — never silent."""
+
+    def make_run(self, cap):
+        params = SimParams(n=8, services_per_node=3, fanout=2, budget=6)
+        sim = ExactSim(params, topology.complete(8),
+                       perturb=churn_perturb(params, 3))
+        state = sim.init_state()
+        _, tr, _ = sim.run_with_trace(state, jax.random.PRNGKey(0), 10,
+                                      cap=cap, donate=False)
+        return tr
+
+    def test_truncates_with_exact_count(self):
+        full = self.make_run(cap=10)
+        capped = self.make_run(cap=4)
+        assert int(capped.count) == 10 and bool(capped.overflow)
+        assert capped.rec.shape == (4, trace_ops.TRACE_WIDTH)
+        # The records it DID keep are the first 4 of the full stream.
+        np.testing.assert_array_equal(np.asarray(capped.rec),
+                                      np.asarray(full.rec)[:4])
+        dicts = trace_ops.trace_to_dicts(capped)
+        assert len(dicts) == 4
+        assert [d["round"] for d in dicts] == [1, 2, 3, 4]
+        assert set(dicts[0]) == set(trace_ops.TRACE_FIELDS)
+
+    def test_default_cap_traces_every_round(self):
+        full = self.make_run(cap=0)   # 0 → cap = num_rounds
+        assert int(full.count) == 10 and not bool(full.overflow)
+        assert full.rec.shape[0] == 10
+        summary = trace_ops.summarize(full)
+        assert summary["rounds"] == 10 and not summary["truncated"]
+        rec = np.asarray(full.rec)
+        assert summary["exchange_bytes_total"] == int(
+            rec[:, trace_ops.TRACE_EXCHANGE_BYTES].sum())
+        assert summary["frontier_max"] == int(
+            rec[:, trace_ops.TRACE_FRONTIER].max())
+
+    def test_summarize_reports_truncation(self):
+        capped = self.make_run(cap=4)
+        summary = trace_ops.summarize(capped)
+        assert summary["truncated"] and summary["rounds"] == 10
+
+
+# -- bridge plumbing ---------------------------------------------------------
+
+def make_bridge_state(hosts=("h1", "h2", "h3"), spn=2):
+    state = ServicesState(hostname=hosts[0])
+    state.set_clock(lambda: T0)
+    for hi, host in enumerate(hosts):
+        for si in range(spn):
+            state.add_service_entry(S.Service(
+                id=f"{host}-svc{si}", name=f"app{si}", image="i:1",
+                hostname=host, updated=T0 + hi * NS + si,
+                status=S.ALIVE))
+    return state
+
+
+class TestBridgeTrace:
+    def test_trace_block_shape(self):
+        bridge = SimBridge(make_bridge_state(), CFG)
+        report = bridge.simulate(rounds=8, seed=1, trace=5,
+                                 cold_nodes=["h3"])
+        assert report.trace is not None
+        assert report.trace["requested"] == 5
+        rounds = report.trace["rounds"]
+        assert len(rounds) == 5
+        for i, rd in enumerate(rounds):
+            assert set(rd) == set(trace_ops.TRACE_FIELDS)
+            assert rd["exchange_bytes"] >= 0
+        # Absolute, consecutive round numbering.
+        assert [rd["round"] for rd in rounds] == \
+            [rounds[0]["round"] + i for i in range(5)]
+        # The cold joiner forces re-teaching → a live sender frontier.
+        assert max(rd["frontier"] for rd in rounds) > 0
+        # Untraced requests carry no block.
+        assert SimBridge(make_bridge_state(), CFG).simulate(
+            rounds=4, seed=1).trace is None
+        json.dumps(report.to_json())
+
+    def test_chunked_pipeline_stream_identical(self):
+        """Trace records crossing CHUNK_ROUNDS boundaries equal the
+        single-dispatch stream (absolute rounds, fold-in PRNG)."""
+        single = SimBridge(make_bridge_state(), CFG).simulate(
+            rounds=12, seed=3, trace=9, cold_nodes=["h2"])
+        chunked_bridge = SimBridge(make_bridge_state(), CFG)
+        chunked_bridge.CHUNK_ROUNDS = 5     # 5+5+2 chunks, trace=9
+        chunked = chunked_bridge.simulate(
+            rounds=12, seed=3, trace=9, cold_nodes=["h2"])
+        assert chunked.trace["rounds"] == single.trace["rounds"]
+        assert chunked.convergence == single.convergence
+
+    def test_trace_and_deltas_mutually_exclusive(self):
+        bridge = SimBridge(make_bridge_state(), CFG)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            bridge.simulate(rounds=4, trace=3, deltas_cap=8)
+
+    def test_sharded_trace(self):
+        hosts = tuple(f"h{i}" for i in range(8))
+        bridge = SimBridge(make_bridge_state(hosts=hosts), CFG)
+        report = bridge.simulate(rounds=6, sharded=True, trace=3)
+        assert len(report.trace["rounds"]) == 3
+        assert report.devices == 8
+
+    def test_http_round_trip(self):
+        bridge = SimBridge(make_bridge_state(), CFG)
+        server = serve_bridge(bridge, port=0)
+        try:
+            port = server.server_address[1]
+            body = json.dumps({"rounds": 6, "seed": 2, "trace": 4,
+                               "cold_nodes": ["h3"]}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/simulate", data=body,
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                doc = json.loads(resp.read())
+            assert doc["trace"]["requested"] == 4
+            assert len(doc["trace"]["rounds"]) == 4
+            assert set(doc["trace"]["rounds"][0]) == \
+                set(trace_ops.TRACE_FIELDS)
+        finally:
+            server.shutdown()
+
+
+# -- host instruments --------------------------------------------------------
+
+class TestHistogram:
+    def test_percentile_math(self):
+        m = Metrics(prefix="t")
+        for v in range(1, 101):
+            m.histogram("h", float(v))
+        h = m.snapshot()["histograms"]["h"]
+        assert h["count"] == 100
+        assert h["total_ms"] == 5050.0
+        assert h["min_ms"] == 1.0 and h["max_ms"] == 100.0
+        assert h["last_ms"] == 100.0
+        # Nearest-rank over the full (sub-reservoir) sample set.
+        assert h["p50_ms"] == 50.0
+        assert h["p95_ms"] == 95.0
+        assert h["p99_ms"] == 99.0
+
+    def test_single_sample(self):
+        m = Metrics(prefix="t")
+        m.histogram("h", 7.5)
+        h = m.snapshot()["histograms"]["h"]
+        assert h["p50_ms"] == h["p95_ms"] == h["p99_ms"] == 7.5
+        assert h["count"] == 1
+
+    def test_reservoir_bound_with_exact_aggregates(self):
+        m = Metrics(prefix="t")
+        total = 3 * Metrics.HIST_RESERVOIR
+        for v in range(total):
+            m.histogram("h", float(v))
+        with m._lock:
+            assert len(m._hists["h"][5]) == Metrics.HIST_RESERVOIR
+        h = m.snapshot()["histograms"]["h"]
+        # Aggregates stay exact past the reservoir; percentiles stay
+        # inside the observed range.
+        assert h["count"] == total
+        assert h["total_ms"] == float(sum(range(total)))
+        assert h["min_ms"] == 0.0 and h["max_ms"] == total - 1
+        assert 0.0 <= h["p50_ms"] <= h["p95_ms"] <= h["p99_ms"] \
+            <= total - 1
+
+    def test_timers_backcompat_mirror(self):
+        """The migration contract (docs/metrics.md): every histogram
+        mirrors count/total/last into the legacy ``timers`` block so
+        pre-histogram dashboards keep reading; pure timers gain no
+        histograms entry."""
+        m = Metrics(prefix="t")
+        m.histogram("site.hist", 10.0)
+        m.histogram("site.hist", 30.0)
+        m.measure_since("site.legacy", time.perf_counter())
+        snap = m.snapshot()
+        assert set(snap) == {"counters", "gauges", "timers",
+                             "histograms"}
+        mirror = snap["timers"]["site.hist"]
+        hist = snap["histograms"]["site.hist"]
+        assert mirror == {"count": 2, "total_ms": 40.0,
+                          "last_ms": 30.0}
+        assert hist["count"] == 2 and hist["total_ms"] == 40.0
+        assert "site.legacy" in snap["timers"]
+        assert "site.legacy" not in snap["histograms"]
+
+    def test_statsd_ms_datagram(self):
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        sock.settimeout(2.0)
+        port = sock.getsockname()[1]
+        m = Metrics(prefix="t")
+        m.configure_statsd(f"127.0.0.1:{port}")
+        try:
+            m.histogram("h", 12.5)
+            data, _ = sock.recvfrom(4096)
+            assert data == b"t.h:12.5|ms"
+        finally:
+            m.configure_statsd(None)
+            sock.close()
+
+    def test_histogram_since(self):
+        m = Metrics(prefix="t")
+        m.histogram_since("h", time.perf_counter())
+        h = m.snapshot()["histograms"]["h"]
+        assert h["count"] == 1 and h["last_ms"] >= 0.0
+
+
+class TestStatsdReconfigure:
+    """The PR-6 satellite fix: reconfiguration must close the previous
+    socket (no fd leak) and swap the (addr, sock) pair atomically."""
+
+    def test_old_socket_closed_on_reconfigure(self):
+        m = Metrics(prefix="t")
+        m.configure_statsd("127.0.0.1:9125")
+        first = m._sink[1]
+        assert first.fileno() != -1
+        m.configure_statsd("127.0.0.1:9126")
+        assert first.fileno() == -1, "previous statsd socket leaked"
+        second = m._sink[1]
+        assert second.fileno() != -1
+        m.configure_statsd(None)
+        assert second.fileno() == -1 and m._sink is None
+
+    def test_disable_when_never_configured_is_noop(self):
+        m = Metrics(prefix="t")
+        m.configure_statsd(None)
+        assert m._sink is None
+
+    def test_concurrent_emit_never_sees_torn_pair(self):
+        """Emitters load ONE reference: while reconfiguration churns,
+        every emit sees either a complete sink or none — no
+        half-configured (addr, sock) crash."""
+        m = Metrics(prefix="t")
+        errors = []
+        stop = threading.Event()
+
+        def hammer():
+            try:
+                while not stop.is_set():
+                    m.incr("x")
+            except Exception as exc:  # pragma: no cover — the bug
+                errors.append(exc)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for i in range(200):
+                m.configure_statsd(f"127.0.0.1:{9200 + i % 2}")
+                m.configure_statsd(None)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+        assert errors == []
+        assert m._sink is None
+
+
+# -- spans -------------------------------------------------------------------
+
+class TestSpans:
+    def setup_method(self):
+        reset_spans()
+
+    def test_nesting_links_parent_and_trace(self):
+        with span("outer"):
+            with span("inner"):
+                pass
+            with span("sibling"):
+                pass
+        inner, sibling, outer = spans()[-3:]
+        assert [s["name"] for s in (inner, sibling, outer)] == \
+            ["inner", "sibling", "outer"]
+        assert outer["parent_id"] is None
+        assert inner["parent_id"] == outer["span_id"]
+        assert sibling["parent_id"] == outer["span_id"]
+        assert inner["trace_id"] == sibling["trace_id"] \
+            == outer["trace_id"] == outer["span_id"]
+        assert inner["duration_ms"] >= 0.0
+        assert not outer["error"]
+
+    def test_threads_get_independent_traces(self):
+        done = threading.Barrier(3)
+
+        def worker():
+            with span("w"):
+                done.wait(timeout=5)
+
+        threads = [threading.Thread(target=worker) for _ in range(2)]
+        for t in threads:
+            t.start()
+        done.wait(timeout=5)
+        for t in threads:
+            t.join(timeout=5)
+        ws = [s for s in spans() if s["name"] == "w"]
+        assert len(ws) == 2
+        assert ws[0]["trace_id"] != ws[1]["trace_id"]
+        assert all(s["parent_id"] is None for s in ws)
+
+    def test_error_flag_and_unwind(self):
+        with pytest.raises(RuntimeError):
+            with span("boom"):
+                raise RuntimeError("x")
+        rec = spans()[-1]
+        assert rec["name"] == "boom" and rec["error"]
+        # The stack unwound: a new span is a fresh root.
+        with span("after"):
+            pass
+        assert spans()[-1]["parent_id"] is None
+
+    def test_limit_and_reset(self):
+        for i in range(5):
+            with span(f"s{i}"):
+                pass
+        newest = spans(limit=2)
+        assert [s["name"] for s in newest] == ["s3", "s4"]
+        reset_spans()
+        assert spans() == []
+
+
+# -- exposition --------------------------------------------------------------
+
+class TestPrometheus:
+    SNAP = {
+        "counters": {"query.hub.published": 3},
+        "gauges": {"kernels.pallas_active": 1.0},
+        "timers": {
+            "notifyMsg": {"count": 2, "total_ms": 4.0, "last_ms": 1.5},
+            # The back-compat mirror of the histogram below — must NOT
+            # render a second family under the same name.
+            "bridge.chunk": {"count": 4, "total_ms": 100.0,
+                             "last_ms": 30.0},
+        },
+        "histograms": {
+            "bridge.chunk": {"count": 4, "total_ms": 100.0,
+                             "last_ms": 30.0, "min_ms": 10.0,
+                             "max_ms": 40.0, "p50_ms": 20.0,
+                             "p95_ms": 40.0, "p99_ms": 40.0},
+        },
+    }
+
+    def test_render_families(self):
+        text = render_prometheus(self.SNAP)
+        assert "# TYPE sidecar_query_hub_published_total counter\n" \
+            "sidecar_query_hub_published_total 3\n" in text
+        assert "# TYPE sidecar_kernels_pallas_active gauge\n" \
+            "sidecar_kernels_pallas_active 1\n" in text
+        assert 'sidecar_bridge_chunk_ms{quantile="0.5"} 20' in text
+        assert 'sidecar_bridge_chunk_ms{quantile="0.99"} 40' in text
+        assert "sidecar_bridge_chunk_ms_sum 100" in text
+        assert "sidecar_bridge_chunk_ms_count 4" in text
+        # Legacy timer: summary with sum/count only.
+        assert "# TYPE sidecar_notifyMsg_ms summary" in text
+        assert "sidecar_notifyMsg_ms_sum 4" in text
+        # The mirrored timer is skipped — exactly one family.
+        assert text.count("# TYPE sidecar_bridge_chunk_ms summary") == 1
+
+    def test_renders_live_registry(self):
+        # Seed the process-global registry so this test is
+        # order-independent (any -k selection must pass).
+        from sidecar_tpu import metrics as global_metrics
+        global_metrics.incr("telemetry.render.probe")
+        text = render_prometheus()
+        assert text.endswith("\n")
+        assert "# TYPE sidecar_telemetry_render_probe_total counter" \
+            in text
+
+
+def make_api():
+    state = ServicesState(hostname="h1")
+    state.set_clock(lambda: T0)
+    state.add_service_entry(S.Service(
+        id="aaa111", name="web", image="img:1", hostname="h1",
+        updated=T0, status=S.ALIVE))
+    return SidecarApi(state, members_fn=lambda: ["h1"],
+                      cluster_name="test-cluster")
+
+
+class TestEndpoints:
+    def test_metrics_prometheus(self):
+        for path in ("/metrics", "/api/metrics"):
+            status, ctype, body, _ = make_api().dispatch("GET", path)
+            assert status == 200
+            assert ctype.startswith("text/plain")
+            text = body.decode()
+            assert "sidecar_" in text
+            # The make_api add_service_entry above records a timer.
+            assert "sidecar_addServiceEntry_ms_count" in text
+
+    def test_trace_endpoint(self):
+        reset_spans()
+        api = make_api()   # add_service_entry → a catalog.merge span
+        status, ctype, body, _ = api.dispatch("GET", "/api/trace")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert any(s["name"] == "catalog.merge" for s in doc["spans"])
+
+    def test_trace_endpoint_limit(self):
+        reset_spans()
+        api = make_api()
+        with span("extra"):
+            pass
+        status, _, body, _ = api.dispatch("GET", "/trace",
+                                          {"limit": ["1"]})
+        doc = json.loads(body)
+        assert [s["name"] for s in doc["spans"]] == ["extra"]
+        status, _, body, _ = api.dispatch("GET", "/api/trace",
+                                          {"limit": ["nope"]})
+        assert status == 400

@@ -127,7 +127,7 @@ class TestObservability:
         status, ctype, body, _ = api.dispatch("GET", "/api/metrics.json")
         assert status == 200 and ctype == "application/json"
         doc = json.loads(body)
-        assert set(doc) == {"counters", "gauges", "timers"}
+        assert set(doc) == {"counters", "gauges", "timers", "histograms"}
         assert doc["timers"]["addServiceEntry"]["count"] >= 1
         assert metrics.snapshot()["timers"]["addServiceEntry"]["count"] \
             == doc["timers"]["addServiceEntry"]["count"]

@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""Static check: every metric name emitted from ``sidecar_tpu/`` is
+documented in ``docs/metrics.md``.
+
+Why this exists (PR 6): the metrics reference only stays trustworthy if
+it is COMPLETE — an operator alerting off ``/metrics`` output has to be
+able to look any name up, and the failure mode is silent: a new
+``incr``/``set_gauge``/``histogram`` call site ships, nothing breaks,
+and the name is simply absent from the doc forever.  So tier-1 runs
+this check (tests/test_metric_docs.py, the ``check_jit_entrypoints``
+pattern) and fails the build instead.
+
+Mechanics: the ``sidecar_tpu/`` tree is AST-scanned for calls to
+``incr`` / ``set_gauge`` / ``histogram`` / ``histogram_since``
+(attribute or bare-name form).  A string-literal first argument must
+appear in the doc verbatim, or match a documented placeholder pattern
+(backticked names may contain ``<...>`` wildcards: ``sparse.mode.<m>``
+covers ``sparse.mode.auto``).  An f-string first argument contributes
+its constant PREFIX, which must prefix some documented name (so
+``f"kernels.path.{path}"`` is covered by ``kernels.path.pallas``...).
+Fully dynamic names (a bare variable) are skipped — they are relays of
+names documented at their origin (e.g. the chaos counter sync and the
+engine stats relay, both documented as families).
+
+``sidecar_tpu/metrics.py`` itself is excluded: it is the instrument
+implementation, not a call site.
+
+Usage: ``python tools/check_metric_docs.py [src_root [docs_file]]`` —
+exits 0 when clean, 1 with a per-offender report otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+METRIC_FNS = ("incr", "set_gauge", "histogram", "histogram_since")
+
+
+def _call_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def emitted_names(root: pathlib.Path):
+    """Yield ``(path, lineno, name, is_prefix)`` for every metric-name
+    literal (or f-string constant prefix) passed to an instrument call
+    under ``root``."""
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts or path.name == "metrics.py":
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:  # pragma: no cover — broken file
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if _call_name(node) not in METRIC_FNS:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                yield path, node.lineno, arg.value, False
+            elif isinstance(arg, ast.JoinedStr):
+                prefix = ""
+                for part in arg.values:
+                    if isinstance(part, ast.Constant) and \
+                            isinstance(part.value, str):
+                        prefix += part.value
+                    else:
+                        break
+                if prefix:
+                    yield path, node.lineno, prefix, True
+            # else: fully dynamic — a relay; skipped by design.
+
+
+def documented_names(docs_text: str) -> list[str]:
+    """Every backticked token in the doc that looks like a metric name
+    (dotted or a known bare timer/gauge name) — ``<...>`` placeholders
+    kept verbatim for the matchers below."""
+    return [tok for tok in re.findall(r"`([^`\s]+)`", docs_text)
+            if re.fullmatch(r"[A-Za-z0-9_.<>*-]+", tok)]
+
+
+def _pattern(token: str) -> "re.Pattern":
+    """A documented token as a regex: ``<...>`` placeholders match any
+    non-empty run."""
+    out = []
+    for piece in re.split(r"(<[^>]*>)", token):
+        out.append(".+" if piece.startswith("<") else re.escape(piece))
+    return re.compile("".join(out))
+
+
+def check(src_root: pathlib.Path, docs_file: pathlib.Path) -> list[str]:
+    """Violation strings (empty = every emitted name is documented)."""
+    docs_text = docs_file.read_text()
+    tokens = documented_names(docs_text)
+    patterns = [(_pattern(t), t) for t in tokens]
+    problems = []
+    for path, lineno, name, is_prefix in emitted_names(src_root):
+        if is_prefix:
+            ok = any(t.startswith(name) for t in tokens)
+            kind = f"f-string metric prefix {name!r}"
+        else:
+            ok = name in tokens or any(p.fullmatch(name)
+                                       for p, _ in patterns)
+            kind = f"metric name {name!r}"
+        if not ok:
+            problems.append(
+                f"{path}:{lineno}: {kind} is not documented in "
+                f"{docs_file.name}")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    here = pathlib.Path(__file__).resolve().parent.parent
+    src = pathlib.Path(argv[1]) if len(argv) > 1 else here / "sidecar_tpu"
+    docs = pathlib.Path(argv[2]) if len(argv) > 2 else \
+        here / "docs" / "metrics.md"
+    problems = check(src, docs)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} undocumented metric name(s) — add them "
+              f"to {docs}", file=sys.stderr)
+        return 1
+    print(f"check_metric_docs: OK ({src} vs {docs})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
