@@ -592,6 +592,22 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# query bench failed: {exc}", file=sys.stderr)
 
+    # Robustness under chaos (benchmarks/robustness.py, docs/chaos.md):
+    # false-positive tombstone evictions + proxy-config churn under
+    # config6-seeded loss/pause chaos, suspicion+damping ON vs OFF at
+    # matched tail convergence.  BENCH_ROBUSTNESS=0 skips it;
+    # BENCH_ROBUSTNESS_NODES overrides the cluster size.
+    robustness = None
+    if os.environ.get("BENCH_ROBUSTNESS", "1") != "0":
+        try:
+            from benchmarks.robustness import run_robustness
+            _watchdog_note("robustness")
+            robustness = run_robustness(
+                n=int(os.environ.get("BENCH_ROBUSTNESS_NODES", "128")))
+            _watchdog_note("robustness", {"robustness": robustness})
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# robustness bench failed: {exc}", file=sys.stderr)
+
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.
     disarm_watchdog()
@@ -620,6 +636,7 @@ def main() -> None:
         **({"north_star_faithful_k1024": north_star_k1024}
            if north_star_k1024 else {}),
         **({"query": query_bench} if query_bench else {}),
+        **({"robustness": robustness} if robustness else {}),
         "telemetry": telemetry,
     }))
 

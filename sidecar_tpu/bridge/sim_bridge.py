@@ -86,6 +86,13 @@ class SimulationReport:
     # "rounds": [...]}`` with one record dict per traced round
     # (frontier/behind/admitted/exchange_bytes/mode/tombstones).
     trace: Optional[dict] = None
+    # Suspicion/flap-damping what-if (ops/suspicion.py, docs/chaos.md),
+    # present when the caller passed ``protocol``: the effective knob
+    # bundle, plus — when damping is enabled — the services the damper
+    # would suppress in THIS node's view over the simulated horizon and
+    # their flap counts (the sim-side twin of catalog/damping.py,
+    # cross-validated in tests/test_damping.py).
+    robustness: Optional[dict] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -111,7 +118,8 @@ class SimBridge:
     # -- state mapping -----------------------------------------------------
 
     def snapshot(self, sharded: bool = False,
-                 board_exchange: Optional[str] = None
+                 board_exchange: Optional[str] = None,
+                 timecfg: Optional[TimeConfig] = None
                  ) -> tuple[SimState, SimParams, BridgeMapping, ExactSim]:
         """Freeze the live catalog into simulator tensors.
 
@@ -123,7 +131,10 @@ class SimBridge:
         attached device instead of the single-chip ExactSim (the
         catalog's node count must divide the mesh); ``board_exchange``
         picks its exchange mode (None → SIDECAR_TPU_BOARD_EXCHANGE,
-        docs/sharding.md)."""
+        docs/sharding.md).  ``timecfg`` overrides the bridge's protocol
+        clock for this snapshot — the per-request suspicion-window
+        path (ops/suspicion.ProtocolParams)."""
+        cfg = timecfg if timecfg is not None else self.t
         with self.state._lock:
             servers = {h: dict(server.services)
                        for h, server in self.state.servers.items()}
@@ -138,8 +149,8 @@ class SimBridge:
                        for svcs in servers.values()
                        for svc in svcs.values()]
         t0 = min(all_updates)
-        tick_ns = int(self.t.round_ticks / self.t.ticks_per_second * 1e9
-                      / self.t.round_ticks)  # 1 tick in ns (1 ms default)
+        tick_ns = int(cfg.round_ticks / cfg.ticks_per_second * 1e9
+                      / cfg.round_ticks)  # 1 tick in ns (1 ms default)
 
         slots: list[list[Optional[str]]] = []
         owned_vals = np.zeros((n, spn), dtype=np.int64)
@@ -157,10 +168,10 @@ class SimBridge:
         params = SimParams(n=n, services_per_node=spn)
         if sharded:
             from sidecar_tpu.parallel.sharded import ShardedSim
-            sim = ShardedSim(params, topo_mod.complete(n), self.t,
+            sim = ShardedSim(params, topo_mod.complete(n), cfg,
                              board_exchange=board_exchange)
         else:
-            sim = ExactSim(params, topo_mod.complete(n), self.t)
+            sim = ExactSim(params, topo_mod.complete(n), cfg)
         state = sim.init_state()
         # Overwrite the cold-start rows: every node knows the snapshot.
         known = np.tile(owned_vals.reshape(-1).astype(np.int32), (n, 1))
@@ -190,7 +201,8 @@ class SimBridge:
                  sharded: bool = False,
                  board_exchange: Optional[str] = None,
                  sparse: Optional[bool] = None,
-                 trace: int = 0) -> SimulationReport:
+                 trace: int = 0,
+                 protocol=None) -> SimulationReport:
         """Run the catalog forward ``rounds`` gossip rounds.
 
         ``cold_nodes``: hostnames whose knowledge is blanked to their own
@@ -226,7 +238,31 @@ class SimBridge:
         flag, tombstone count — in the report's ``trace`` block.
         Available on both the single-chip and sharded twins; mutually
         exclusive with ``deltas_cap`` (one scan streams one record
-        kind)."""
+        kind).
+
+        ``protocol`` (an :class:`ops.suspicion.ProtocolParams` or its
+        dict form — the ``POST /simulate`` surface) runs the request
+        under those suspicion/damping knobs: the suspicion window is
+        threaded into the jitted round via a per-request TimeConfig,
+        and with ``damping_threshold > 0`` the report's ``robustness``
+        block predicts which services THIS node's flap damper
+        (catalog/damping.py) would suppress over the horizon — the sim
+        side of the sim↔live damping cross-validation
+        (tests/test_damping.py).  Damping prediction consumes the
+        delta stream, so it is single-chip only (like ``deltas_cap``)
+        and raises with ``sharded=True``."""
+        from sidecar_tpu.ops.suspicion import ProtocolParams
+
+        if protocol is not None and not isinstance(protocol,
+                                                   ProtocolParams):
+            protocol = ProtocolParams.from_json(protocol)
+        damping_on = protocol is not None and \
+            protocol.damping_threshold > 0
+        if sharded and damping_on:
+            raise ValueError(
+                "damping prediction consumes the delta stream and is "
+                "single-chip only (like deltas_cap); drop sharded=True "
+                "or the damping_threshold")
         if sharded and deltas_cap > 0:
             raise ValueError(
                 "deltas_cap > 0 is not supported with sharded=True "
@@ -235,9 +271,21 @@ class SimBridge:
             raise ValueError(
                 "trace and deltas_cap are mutually exclusive "
                 "(one scan streams one record kind)")
+        if trace > 0 and damping_on:
+            raise ValueError(
+                "trace and damping prediction are mutually exclusive "
+                "(damping consumes the delta stream; one scan streams "
+                "one record kind)")
+        # Damping prediction needs the per-round change stream even when
+        # the caller didn't ask for deltas in the report.
+        report_deltas = deltas_cap > 0
+        if damping_on and deltas_cap == 0:
+            deltas_cap = 4096
         t_req = time.perf_counter()
         state, params, mapping, sim = self.snapshot(
-            sharded=sharded, board_exchange=board_exchange)
+            sharded=sharded, board_exchange=board_exchange,
+            timecfg=(protocol.timecfg(self.t)
+                     if protocol is not None else None))
 
         if cold_nodes:
             known = np.asarray(state.known).copy()
@@ -366,23 +414,89 @@ class SimBridge:
                             int(unpack_status(np.int32(cell))))
             projected[hostname] = view
 
+        robustness = None
+        if protocol is not None:
+            robustness = {"protocol": protocol.to_json()}
+            if damping_on:
+                robustness.update(self._predict_damping(
+                    protocol, delta_stream, mapping))
+
         hits = np.nonzero(conv >= 1.0 - eps)[0]
         metrics.histogram_since("bridge.simulate", t_req)
         return SimulationReport(
             rounds=rounds,
-            seconds_simulated=rounds * self.t.round_ticks
-            / self.t.ticks_per_second,
+            seconds_simulated=rounds * sim.t.round_ticks
+            / sim.t.ticks_per_second,
             convergence=[float(c) for c in conv],
             eps_round=int(hits[0]) + 1 if hits.size else None,
             node_agreement=node_agreement,
             projected=projected,
-            deltas=delta_stream,
+            deltas=delta_stream if report_deltas else None,
             board_exchange=sim.board_exchange if sharded else None,
             devices=sim.d if sharded else None,
             sparse={"mode": sparse_mode, **arbiter.snapshot()},
             trace=(None if trace_rounds is None
                    else {"requested": trace, "rounds": trace_rounds}),
+            robustness=robustness,
         )
+
+    def _predict_damping(self, protocol, delta_stream,
+                         mapping: BridgeMapping) -> dict:
+        """Replay the simulated change stream through THE live damper
+        implementation (catalog/damping.py) as observed from this
+        node's own view — the sim-side twin of the catalog hook, on a
+        logical clock derived from simulated ticks.
+
+        Replay rules (SUSPECT quarantine invisible, discovery not a
+        flap) live in ONE place — ``catalog.damping.TransitionReplay``
+        — shared with the bench robustness harness and the
+        cross-validation tests.  A delta round that overflowed its cap
+        carries no change list; those rounds' flaps are unobservable
+        and the count is REPORTED as ``delta_overflow_rounds`` (the
+        DeltaBatch contract: truncation is surfaced, never silent)."""
+        from sidecar_tpu.catalog.damping import FlapDamper, TransitionReplay
+
+        observer = self.state.hostname \
+            if self.state.hostname in mapping.hostnames \
+            else mapping.hostnames[0]
+        # Codes 0..5 have distinct names; higher codes alias to the
+        # "Tombstone" fallback and must not clobber the real code 1.
+        code_of = {svc_mod.status_string(c): c for c in range(6)}
+
+        end_ns = mapping.t0_ns
+        damper = FlapDamper.from_protocol(
+            protocol, now_fn=lambda: end_ns)
+        replay = TransitionReplay(damper)
+
+        # Initial view + record ownership from the live catalog (the
+        # snapshot the simulation started from).
+        owner_of: dict[str, str] = {}
+        with self.state._lock:
+            for host, server in self.state.servers.items():
+                for sid, svc in server.services.items():
+                    replay.prime(sid, svc.status)
+                    owner_of[sid] = host
+
+        overflow_rounds = 0
+        for round_doc in delta_stream or ():
+            if round_doc.get("overflow"):
+                overflow_rounds += 1
+                continue
+            for ch in round_doc.get("changes", ()):
+                if ch["node"] != observer:
+                    continue
+                sid = ch["service"]
+                st = code_of.get(ch["status"])
+                if st is None:
+                    continue
+                now_ns = mapping.t0_ns + ch["tick"] * mapping.tick_ns
+                end_ns = max(end_ns, now_ns)
+                replay.see(owner_of.get(sid, observer), sid, st, now_ns)
+
+        damped = sorted(f"{h}/{sid}" for h, sid in damper.damped(end_ns))
+        return {"observer": observer, "damped": damped,
+                "flaps": replay.flaps,
+                "delta_overflow_rounds": overflow_rounds}
 
     @staticmethod
     def _map_deltas(batches, mapping: BridgeMapping, params: SimParams,
@@ -431,7 +545,11 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
     "sharded": bool, "board_exchange": "all_gather"|"ring",
     "sparse": bool|null (null → SIDECAR_TPU_SPARSE / arbiter),
     "trace": N (flight-recorder records for the first N rounds —
-    docs/telemetry.md)}."""
+    docs/telemetry.md),
+    "protocol": {"suspicion_window_s": S, "damping_half_life_s": H,
+    "damping_threshold": T, ...} — the suspicion/flap-damping knob
+    bundle (ops/suspicion.ProtocolParams); the report's ``robustness``
+    block carries the damping prediction (docs/chaos.md)}."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -465,7 +583,8 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                     board_exchange=req.get("board_exchange"),
                     sparse=(None if sparse_req is None
                             else bool(sparse_req)),
-                    trace=int(req.get("trace", 0)))
+                    trace=int(req.get("trace", 0)),
+                    protocol=req.get("protocol"))
             except (ValueError, KeyError, TypeError,
                     json.JSONDecodeError) as exc:
                 self._reply(400, {"message": str(exc)})

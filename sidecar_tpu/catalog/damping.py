@@ -1,0 +1,271 @@
+"""Flap damping — churn-gated proxy admission for flapping services.
+
+Under chaos (asymmetric loss, GC pauses), bare TTL expiry makes healthy
+services flap alive→tombstone→alive; every flap churns the whole read
+path — snapshots, watch deltas, ADS pushes, proxy reloads.  The device
+side of the fix is SWIM suspicion (ops/suspicion.py); this module is
+the host side: a per-service-instance penalty counter with exponential
+decay, the BGP route-flap-damping / Envoy-outlier-detection shape,
+gating PROXY ADMISSION only.  A damped service stays fully present in
+the catalog and every catalog view (the record is real state; damping
+is a routing decision) — it is withheld from HAProxy/Envoy resource
+generation until its penalty decays below the reuse threshold.
+
+Mechanics (RFC 2439 recast):
+
+* every observed liveness flap (ALIVE ↔ not-ALIVE status transition on
+  the catalog's writer path, ``ServicesState.service_changed``) adds
+  ``flap_penalty`` to the instance's penalty;
+* the penalty decays continuously with half-life ``half_life_s``;
+* an instance whose penalty reaches ``threshold`` is SUPPRESSED;
+* it is REINSTATED once the penalty decays below ``reuse_threshold``
+  (default threshold/2 — the hysteresis band keeps a service hovering
+  at the threshold from thrashing in and out of routing).
+
+``threshold == 0`` disables suppression entirely (observation still
+counts flaps, so the metrics stay useful).  The same knobs ride
+:class:`~sidecar_tpu.ops.suspicion.ProtocolParams` through config.py
+(SIDECAR_DAMPING_*) and ``POST /simulate``, so the simulator predicts
+exactly what the live damper would do (tests/test_damping.py
+cross-validates the two paths under one FaultPlan).
+
+Metrics: ``damping.flaps`` / ``damping.suppressed`` /
+``damping.reinstated`` counters, ``damping.damped_services`` gauge
+(docs/metrics.md).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Callable, Optional
+
+from sidecar_tpu import metrics
+from sidecar_tpu.service import ALIVE, UNKNOWN
+
+# Entries whose penalty decayed below this are garbage-collected on the
+# next observation — the table stays bounded by the actively-flapping
+# population, not by catalog size.
+_GC_FLOOR = 0.01
+
+NS_PER_SECOND = 1_000_000_000
+
+
+class _SimRecord:
+    """Minimal record shim for :class:`TransitionReplay` — simulated
+    transitions have no live ``Service`` object behind them."""
+
+    __slots__ = ("hostname", "id", "status")
+
+    def __init__(self, hostname: str, sid: str, status: int) -> None:
+        self.hostname = hostname
+        self.id = sid
+        self.status = status
+
+
+class TransitionReplay:
+    """Replay SIMULATED status observations through a damper with the
+    SAME rules the live writer path applies — the ONE definition shared
+    by the bench robustness harness (benchmarks/robustness.py), the
+    bridge's damping prediction (``SimBridge._predict_damping``), and
+    the cross-validation tests; a rule change here changes all of them
+    together:
+
+    * SUSPECT (status code 5, ops/status.py) is quarantine, not
+      routing-visible liveness: a SUSPECT observation neither flaps nor
+      updates the tracked status (the live catalog never materializes
+      SUSPECT, so a refuted suspicion is replay-invisible);
+    * first sight of a record is discovery, not a flap;
+    * only liveness changes (ALIVE ↔ not-ALIVE) flap — exactly
+      :meth:`FlapDamper.observe`'s rule, which does the actual
+      penalty accounting.
+    """
+
+    def __init__(self, damper: FlapDamper) -> None:
+        self.damper = damper
+        self._last: dict[str, int] = {}
+        self.flaps: dict[str, int] = {}
+
+    def prime(self, sid: str, status: int) -> None:
+        """Seed the tracked status from an initial catalog view (so the
+        first simulated observation is a transition, not discovery)."""
+        self._last[sid] = status
+
+    def see(self, hostname: str, sid: str, status: int,
+            now_ns: int) -> None:
+        """One observed (service, status) sample from the simulated
+        stream."""
+        from sidecar_tpu.service import SUSPECT as _SUS
+
+        if status == _SUS or status < 0:
+            return
+        prev = self._last.get(sid)
+        self._last[sid] = status
+        if prev is None or prev == status:
+            return
+        if (prev == ALIVE) != (status == ALIVE):
+            self.flaps[sid] = self.flaps.get(sid, 0) + 1
+        self.damper.observe(_SimRecord(hostname, sid, status), prev,
+                            now_ns=now_ns)
+
+
+class FlapDamper:
+    """Per-instance flap penalty with exponential decay and
+    suppress/reuse hysteresis.  Thread-safe; observation sites call it
+    under the catalog writer's lock, admission sites from reader
+    threads."""
+
+    def __init__(self, half_life_s: float = 60.0,
+                 threshold: float = 0.0,
+                 reuse_threshold: float = 0.0,
+                 flap_penalty: float = 1.0,
+                 now_fn: Optional[Callable[[], int]] = None) -> None:
+        if half_life_s <= 0:
+            raise ValueError("half_life_s must be > 0")
+        if reuse_threshold > threshold:
+            raise ValueError("reuse_threshold cannot exceed threshold")
+        self.half_life_s = half_life_s
+        self.threshold = threshold
+        self.reuse_threshold = reuse_threshold if reuse_threshold > 0 \
+            else threshold / 2.0
+        self.flap_penalty = flap_penalty
+        # Injectable clock (ns) — tests and the sim cross-validation
+        # drive a logical clock; the live node uses wall time.
+        self._now = now_fn if now_fn is not None else time.time_ns
+        self._lock = threading.Lock()
+        # key → [penalty, last_ns, suppressed]
+        self._entries: dict[tuple[str, str], list] = {}
+
+    @classmethod
+    def from_protocol(cls, params,
+                      now_fn: Optional[Callable[[], int]] = None
+                      ) -> "FlapDamper":
+        """Build from an :class:`ops.suspicion.ProtocolParams` bundle —
+        the sim↔live shared-knob path."""
+        return cls(half_life_s=params.damping_half_life_s,
+                   threshold=params.damping_threshold,
+                   reuse_threshold=params.resolved_reuse_threshold
+                   if params.damping_threshold > 0 else 0.0,
+                   flap_penalty=params.damping_flap_penalty,
+                   now_fn=now_fn)
+
+    @staticmethod
+    def key_of(svc) -> tuple[str, str]:
+        return (svc.hostname, svc.id)
+
+    # -- internal ----------------------------------------------------------
+
+    def _decayed(self, entry: list, now_ns: int) -> float:
+        penalty, last_ns, _ = entry
+        dt_s = max(0, now_ns - last_ns) / NS_PER_SECOND
+        return penalty * math.exp(-math.log(2.0) * dt_s / self.half_life_s)
+
+    def _update_suppression(self, key, entry: list, penalty: float) -> None:
+        suppressed = entry[2]
+        if not suppressed and self.threshold > 0 \
+                and penalty >= self.threshold:
+            entry[2] = True
+            metrics.incr("damping.suppressed")
+        elif suppressed and penalty < self.reuse_threshold:
+            entry[2] = False
+            metrics.incr("damping.reinstated")
+
+    def _gauge(self) -> None:
+        metrics.set_gauge("damping.damped_services",
+                          sum(1 for e in self._entries.values() if e[2]))
+
+    # -- observation (writer path) -----------------------------------------
+
+    def observe(self, svc, previous_status: int,
+                now_ns: Optional[int] = None) -> None:
+        """Record one catalog status transition.  A FLAP is a liveness
+        change — ALIVE ↔ anything-not-ALIVE — on a record we had seen
+        before (the first sighting of a service, previous UNKNOWN, is
+        discovery, not a flap)."""
+        if previous_status == UNKNOWN:
+            return
+        was_alive = previous_status == ALIVE
+        is_alive = svc.status == ALIVE
+        if was_alive == is_alive:
+            return
+        now = now_ns if now_ns is not None else self._now()
+        key = self.key_of(svc)
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = [0.0, now, False]
+                self._entries[key] = entry
+            penalty = self._decayed(entry, now) + self.flap_penalty
+            entry[0], entry[1] = penalty, now
+            metrics.incr("damping.flaps")
+            self._update_suppression(key, entry, penalty)
+            self._gc(now)
+            self._gauge()
+
+    def _gc(self, now_ns: int) -> None:
+        dead = [k for k, e in self._entries.items()
+                if not e[2] and self._decayed(e, now_ns) < _GC_FLOOR]
+        for k in dead:
+            del self._entries[k]
+
+    # -- admission (reader paths) ------------------------------------------
+
+    def suppressed(self, key: tuple[str, str],
+                   now_ns: Optional[int] = None) -> bool:
+        """Is this instance currently damped out of routing?  Re-checks
+        the decayed penalty against the hysteresis band, so a quiet
+        service readmits by pure time passage — no new event needed."""
+        if self.threshold <= 0:
+            return False
+        now = now_ns if now_ns is not None else self._now()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return False
+            self._update_suppression(key, entry, self._decayed(entry, now))
+            result = entry[2]
+            self._gauge()
+            return result
+
+    def admitted(self, svc, now_ns: Optional[int] = None) -> bool:
+        """The proxy-admission gate (HAProxy/Envoy resource
+        generation): False while the instance is damped."""
+        return not self.suppressed(self.key_of(svc), now_ns)
+
+    def penalty(self, key: tuple[str, str],
+                now_ns: Optional[int] = None) -> float:
+        now = now_ns if now_ns is not None else self._now()
+        with self._lock:
+            entry = self._entries.get(key)
+            return 0.0 if entry is None else self._decayed(entry, now)
+
+    def damped(self, now_ns: Optional[int] = None) -> set[tuple[str, str]]:
+        """The currently-suppressed instance set (hysteresis applied at
+        read time)."""
+        if self.threshold <= 0:
+            return set()
+        now = now_ns if now_ns is not None else self._now()
+        with self._lock:
+            for key, entry in self._entries.items():
+                self._update_suppression(key, entry,
+                                         self._decayed(entry, now))
+            self._gauge()
+            return {k for k, e in self._entries.items() if e[2]}
+
+    def snapshot(self, now_ns: Optional[int] = None) -> dict:
+        """JSON-able view for the web API (`/api/damping`)."""
+        now = now_ns if now_ns is not None else self._now()
+        with self._lock:
+            return {
+                "half_life_s": self.half_life_s,
+                "threshold": self.threshold,
+                "reuse_threshold": self.reuse_threshold,
+                "entries": {
+                    f"{host}/{sid}": {
+                        "penalty": round(self._decayed(e, now), 4),
+                        "suppressed": bool(e[2]),
+                    }
+                    for (host, sid), e in sorted(self._entries.items())
+                },
+            }

@@ -174,6 +174,11 @@ class ServicesState:
         # cheap.  Once attached, every change event ALSO publishes a
         # copy-on-write snapshot + delta through the hub.
         self._query_hub = None
+        # Flap damper (catalog/damping.py): when attached, every status
+        # transition through service_changed feeds it, and the proxy
+        # resource generators consult it for admission.  None = the
+        # subprotocol is off (SIDECAR_DAMPING_THRESHOLD unset).
+        self.flap_damper = None
 
     # -- time injection (tests) -------------------------------------------
 
@@ -327,10 +332,24 @@ class ServicesState:
 
     # -- change accounting + listener fan-out ------------------------------
 
+    def attach_damper(self, damper) -> None:
+        """Attach a :class:`~sidecar_tpu.catalog.damping.FlapDamper`:
+        from here on every status transition is observed, and the proxy
+        resource generators (which read it through :meth:`query_hub` or
+        directly) gate admission on it."""
+        with self._lock:
+            self.flap_damper = damper
+
     def service_changed(self, svc: Service, previous_status: int,
                         updated: int) -> None:
         """services_state.go:195-201."""
         self._server_changed(svc.hostname, updated)
+        # Flap observation sits on the writer funnel — EVERY status
+        # transition passes through here, so the damper sees the full
+        # flap history regardless of which consumers are subscribed.
+        damper = self.flap_damper
+        if damper is not None:
+            damper.observe(svc, previous_status)
         self.notify_listeners(svc, previous_status, self.last_changed)
 
     def _server_changed(self, hostname: str, updated: int) -> None:

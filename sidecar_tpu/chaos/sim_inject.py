@@ -419,7 +419,8 @@ class ChaosExactSim(ExactSim):
                 alive_lifespan=t.alive_lifespan,
                 draining_lifespan=t.draining_lifespan,
                 tombstone_lifespan=t.tombstone_lifespan,
-                one_second=t.one_second)
+                one_second=t.one_second,
+                suspicion_window=t.suspicion_window)
             se = jnp.where(swept != kn, jnp.int8(0), se)
             return swept, se
 
@@ -437,6 +438,21 @@ class ChaosExactSim(ExactSim):
 
     def convergence(self, cst: ChaosSimState) -> jax.Array:
         return super().convergence(cst.sim)
+
+    def _trace_record(self, prev: ChaosSimState, nxt: ChaosSimState,
+                      stats):
+        """Flight-recorder record off the wrapped SimStates — the chaos
+        state carries rings/counters the extractor has no columns for,
+        so the record summarizes the protocol state exactly like
+        ExactSim's (this is what makes ``run_with_trace`` — and with it
+        the false-positive-tombstone robustness measurement,
+        benchmarks/robustness.py — work under a FaultPlan)."""
+        from sidecar_tpu.ops import trace as trace_ops
+
+        return trace_ops.exact_record(
+            prev.sim, nxt.sim, budget=min(self.p.budget, self.p.m),
+            fanout=self.p.fanout,
+            limit=self.p.resolved_retransmit_limit(), stats=stats)
 
     def injection_counts(self, cst: ChaosSimState) -> dict:
         return {"dropped": int(cst.injected_drops),
@@ -480,3 +496,13 @@ class ChaosExactSim(ExactSim):
                                  sparse=sparse)
         self._publish_injection_metrics(before, final)
         return final
+
+    def run_with_trace(self, state, key, num_rounds: int, cap: int = 0,
+                       donate: bool = True, start_round=None,
+                       sparse=None):
+        before = self._counter_snapshot(state)
+        final, tr, conv = super().run_with_trace(
+            state, key, num_rounds, cap=cap, donate=donate,
+            start_round=start_round, sparse=sparse)
+        self._publish_injection_metrics(before, final)
+        return final, tr, conv

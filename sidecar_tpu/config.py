@@ -161,6 +161,12 @@ class SidecarConfig:
     bind_port: int = 7946
     debug: bool = False
     discovery_sleep_interval: float = 1.0
+    # Suspicion & flap damping (ops/suspicion.py, catalog/damping.py,
+    # docs/chaos.md): one knob bundle shared with the simulator so a
+    # `POST /simulate` what-if runs the settings the live node uses.
+    suspicion_window: float = 0.0     # SWIM quarantine window (0 = off)
+    damping_half_life: float = 60.0   # flap-penalty decay half-life
+    damping_threshold: float = 0.0    # suppress at penalty >= (0 = off)
 
     @classmethod
     def from_env(cls) -> "SidecarConfig":
@@ -190,6 +196,12 @@ class SidecarConfig:
             discovery_sleep_interval=_env(
                 "SIDECAR", "DISCOVERY_SLEEP_INTERVAL",
                 d.discovery_sleep_interval),
+            suspicion_window=_env("SIDECAR", "SUSPICION_WINDOW",
+                                  d.suspicion_window),
+            damping_half_life=_env("SIDECAR", "DAMPING_HALF_LIFE",
+                                   d.damping_half_life),
+            damping_threshold=_env("SIDECAR", "DAMPING_THRESHOLD",
+                                   d.damping_threshold, cast=float),
         )
 
 

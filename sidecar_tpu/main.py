@@ -101,6 +101,18 @@ class SidecarNode:
         self.state = ServicesState(
             hostname=self.hostname,
             cluster_name=self.config.sidecar.cluster_name)
+        # Flap damping (catalog/damping.py, docs/chaos.md): attached
+        # only when SIDECAR_DAMPING_THRESHOLD enables it — the damper
+        # then observes every catalog status transition and the proxy
+        # resource generators (HAProxy, Envoy ADS) gate admission on
+        # it.  The same knobs flow through POST /simulate so the sim
+        # predicts exactly this node's damping decisions.
+        if self.config.sidecar.damping_threshold > 0:
+            from sidecar_tpu.catalog.damping import FlapDamper
+            from sidecar_tpu.ops.suspicion import ProtocolParams
+
+            self.state.attach_damper(FlapDamper.from_protocol(
+                ProtocolParams.from_config(self.config.sidecar)))
         self.disco = configure_discovery(self.config, self.advertise_ip,
                                          self.hostname)
         self.monitor = Monitor(self.advertise_ip,
@@ -410,7 +422,14 @@ def main(argv=None) -> int:
                            .push_pull_interval,
                            gossip_messages=config.sidecar.gossip_messages,
                            handoff_queue_depth=config.sidecar
-                           .handoff_queue_depth))
+                           .handoff_queue_depth,
+                           # The membership-level SWIM suspicion window
+                           # (the native engine's Lifeguard quarantine)
+                           # follows the same knob as the catalog-level
+                           # record suspicion, so the two layers agree
+                           # on how long a silent peer stays suspect.
+                           suspect_timeout=config.sidecar
+                           .suspicion_window))
     node.start(http_port=opts.http_port)
     log.info("Sidecar node %s up on %s", node.hostname, node.advertise_ip)
     try:

@@ -119,6 +119,7 @@ from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import sparse as sparse_ops
+from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.merge import (
     apply_stickiness,
@@ -663,6 +664,13 @@ class CompressedSim:
             own0, slots, round_idx, refresh_rounds=t.refresh_rounds,
             round_ticks=t.round_ticks, now=now) & present \
             & (st != TOMBSTONE)
+        # Lifeguard self-refutation (ops/suspicion.py): a SUSPECT own
+        # record refreshes a refuting ALIVE immediately (and, when it
+        # equalled the floor's copy, folds the refutation straight into
+        # the floor — anti-entropy-guaranteed delivery, the refresh-fold
+        # contract below).  Compiles to nothing at window 0.
+        refresh_due, st = suspicion_ops.announce_refute(
+            refresh_due, st, present, t.suspicion_window > 0)
         new_val = pack(now, st)
         fold = refresh_due & (own0 == floor_l)
         own = jnp.where(refresh_due, new_val, own0)
@@ -857,7 +865,8 @@ class CompressedSim:
         kw = dict(alive_lifespan=t.alive_lifespan,
                   draining_lifespan=t.draining_lifespan,
                   tombstone_lifespan=t.tombstone_lifespan,
-                  one_second=t.one_second)
+                  one_second=t.one_second,
+                  suspicion_window=t.suspicion_window)
         own, _ = ttl_sweep(state.own, now, **kw)
         floor_swept, _ = ttl_sweep(floor, now, **kw)
         swept_val, _ = ttl_sweep(cache_val, now, **kw)

@@ -52,6 +52,7 @@ from jax import lax
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import sparse as sparse_ops
+from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, is_known, pack, unpack_status, unpack_ts
 from sidecar_tpu.ops.topology import Topology
@@ -204,6 +205,11 @@ class ExactSim:
             own, cols, round_idx, refresh_rounds=t.refresh_rounds,
             round_ticks=t.round_ticks, now=now_tick) & present \
             & (st != TOMBSTONE)
+        # Lifeguard self-refutation (ops/suspicion.py): a SUSPECT own
+        # record announces a refuting ALIVE immediately; compiles to
+        # nothing while the suspicion window is 0.
+        due, st = suspicion_ops.announce_refute(
+            due, st, present, t.suspicion_window > 0)
 
         vals = jnp.where(due, pack(now_tick, st), 0)
         rows = jnp.where(due, self.owner, p.n)     # OOB row drops the entry
@@ -338,7 +344,8 @@ class ExactSim:
                 alive_lifespan=t.alive_lifespan,
                 draining_lifespan=t.draining_lifespan,
                 tombstone_lifespan=t.tombstone_lifespan,
-                one_second=t.one_second)
+                one_second=t.one_second,
+                suspicion_window=t.suspicion_window)
             se = jnp.where(swept != kn, jnp.int8(0), se)
             return swept, se
 
@@ -412,7 +419,8 @@ class ExactSim:
                 alive_lifespan=t.alive_lifespan,
                 draining_lifespan=t.draining_lifespan,
                 tombstone_lifespan=t.tombstone_lifespan,
-                one_second=t.one_second)
+                one_second=t.one_second,
+                suspicion_window=t.suspicion_window)
             se = jnp.where(swept != kn, jnp.int8(0), se)
             return swept, se
 

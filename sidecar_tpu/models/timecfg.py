@@ -50,6 +50,16 @@ class TimeConfig:
     refresh_interval_s: float = 60.0  # ALIVE_BROADCAST_INTERVAL (:35)
     push_pull_interval_s: float = 20.0  # PushPullInterval (config/config.go:45)
     sweep_interval_s: float = 2.0     # TOMBSTONE_SLEEP_INTERVAL (:30)
+    # SWIM-style suspicion grace window (ops/suspicion.py, docs/chaos.md):
+    # 0 (the default) disables the subprotocol — every round is then
+    # bit-identical to the pre-suspicion sweep/announce (the lockstep
+    # suites pin this).  > 0: an expired non-DRAINING record becomes
+    # SUSPECT at its ORIGINAL timestamp for this window and only an
+    # unrefuted suspicion tombstones (at original ts + 1 s, preserving
+    # the +1 s rule).  The memberlist analog is the Lifeguard suspicion
+    # timeout the live engine already carries (transport/gossip.py
+    # suspect_timeout).
+    suspicion_window_s: float = 0.0
 
     def ticks(self, seconds: float) -> int:
         return int(round(seconds * self.ticks_per_second))
@@ -75,6 +85,11 @@ class TimeConfig:
     @property
     def one_second(self) -> int:
         return self.ticks_per_second
+
+    @property
+    def suspicion_window(self) -> int:
+        """Suspicion grace window in ticks (0 = subprotocol disabled)."""
+        return self.ticks(self.suspicion_window_s)
 
     def rounds(self, seconds: float) -> int:
         """Number of gossip rounds in a wall-clock duration."""

@@ -24,6 +24,13 @@ by a newer ALIVE record in the *same* batch sticks, while the reverse
 order does not — the outcome is order-dependent in the reference itself.
 The batched kernel resolves such races one consistent way (highest packed
 key wins, then stickiness vs. the pre-batch state).
+
+SUSPECT (ops/suspicion.py) needs NO case here by construction: a
+suspicion re-packs at the record's ORIGINAL timestamp with a status
+code above every reference status, so the same max both GOSSIPS it (it
+wins ties against same-version copies) and REFUTES it (any strictly
+newer ALIVE outranks it).  Stickiness stays DRAINING-only — draining
+records never enter quarantine (ops/ttl.py).
 """
 
 from __future__ import annotations

@@ -51,6 +51,11 @@ dense state —
 * **receivers**: alive rows that sampled at least one active sender
   (every other row's pull folds only empty boards — a no-op),
 * **announcers**: rows with any refresh/recovery offer this round —
+  which, with the suspicion window active, includes every row whose
+  own record is SUSPECT: the Lifeguard self-refutation
+  (ops/suspicion.announce_refute) marks it due immediately, so
+  quarantined owners join the announcer frontier and their refuting
+  version goes out the same round on the compacted path too —
 
 and the publish/deliver/merge/announce-insert work runs on the
 ``[C]``-shaped views, scattered back through gather+select.  Rows

@@ -43,6 +43,16 @@ TOMBSTONE = 1
 UNHEALTHY = 2
 UNKNOWN = 3
 DRAINING = 4
+# SUSPECT is a simulator-side extension occupying a spare code of the
+# 3-bit status field (the reference enum stops at DRAINING): a record
+# whose refresh window lapsed sits in SWIM-style quarantine for a grace
+# window before it may be tombstoned (ops/suspicion.py, docs/chaos.md).
+# The code is deliberately ABOVE every reference status: suspicion is
+# re-packed at the record's ORIGINAL timestamp, so under the max-merge
+# it wins ties against same-version ALIVE/DRAINING copies (suspicion
+# gossips for free through the existing scatter-max) while ANY strictly
+# newer ALIVE record — an owner refresh — refutes it, also for free.
+SUSPECT = 5
 
 STATUS_BITS = 3
 STATUS_MASK = (1 << STATUS_BITS) - 1
@@ -56,6 +66,7 @@ _STATUS_NAMES = {
     UNHEALTHY: "Unhealthy",
     UNKNOWN: "Unknown",
     DRAINING: "Draining",
+    SUSPECT: "Suspect",
 }
 
 

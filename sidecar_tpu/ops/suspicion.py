@@ -1,0 +1,158 @@
+"""Suspicion & flap-damping subprotocol — shared knobs and kernels.
+
+The sim's liveness model used to be bare LWW + TTL expiry: a record one
+refresh window late was tombstoned immediately (ops/ttl.py), so under
+chaos (asymmetric loss, pause windows) healthy services flap
+alive→tombstone→alive, churning every downstream consumer — snapshots,
+watch deltas, ADS pushes, proxy config.  memberlist grew SWIM/Lifeguard
+suspicion for exactly this; "Robust and Tuneable Family of Gossiping
+Algorithms" (PAPERS.md) frames the robustness-vs-latency knob this
+module makes tunable.
+
+The subprotocol has two halves:
+
+* **Suspicion (device side, this module + ops/ttl.py)** — expired
+  records enter a ``SUSPECT`` status (a spare code of the 3-bit status
+  field, ops/status.py) for ``TimeConfig.suspicion_window_s`` instead
+  of tombstoning.  Three properties come FREE from the packed-key LWW
+  machinery:
+
+  - *gossip*: SUSPECT re-packs at the record's ORIGINAL timestamp with
+    a status code above every reference status, so the packed key
+    strictly increases — the existing scatter-max/lex-merge carries the
+    suspicion to every copy of that version, and the sweep's
+    changed-cell transmit reset re-enqueues it for broadcast;
+  - *refutation*: any strictly newer ALIVE record (an owner refresh)
+    outranks the suspicion under the same max — no anti-entropy case
+    analysis anywhere;
+  - *solicitation*: a suspected OWN record joins the announce path
+    immediately (:func:`announce_refute` below — the Lifeguard
+    self-refutation), so a node returning from a pause re-asserts its
+    services the very next round instead of waiting out its refresh
+    phase; SUSPECT rows thereby join the announcer frontier on the
+    sparse path for free, and the periodic push-pull leg pulls refuting
+    versions for records a node does not own.
+
+  Only an UNREFUTED suspicion expiry becomes a tombstone, stamped
+  original ts + 1 s exactly as before — the +1 s rule is preserved, so
+  an unseen newer record still wins the LWW race.  With
+  ``suspicion_window_s == 0`` every round is bit-identical to the
+  pre-suspicion protocol (tests/test_suspicion.py pins this across all
+  four model families, sparse and dense, trace and delta streams).
+
+* **Flap damping (host side, catalog/damping.py)** — a per-service
+  penalty counter with exponential decay (the BGP route-flap /
+  Envoy-outlier shape) gates proxy/ADS admission: a service that keeps
+  flapping is damped OUT OF ROUTING without being evicted from the
+  catalog, and readmits once its penalty decays below the reuse
+  threshold.
+
+:class:`ProtocolParams` is the single knob bundle both worlds consume:
+``config.py`` reads it from ``SIDECAR_*`` env vars for the live node,
+``SimBridge.simulate`` / ``POST /simulate`` accept the same fields per
+request, so a what-if simulation runs the exact settings the live
+cluster would.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from sidecar_tpu.ops.status import ALIVE, SUSPECT
+
+
+def announce_refute(due, st, present, suspicion: bool):
+    """Fold the Lifeguard self-refutation into an announce site.
+
+    ``due`` is the refresh-stagger mask (ops/gossip.refresh_due already
+    ANDed with the caller's present/non-tombstone gates), ``st`` the
+    owners' current status codes, ``present`` the owner-alive gate.
+    With ``suspicion`` (a static Python bool — the disabled path
+    compiles nothing), an owner whose OWN record is SUSPECT announces
+    immediately, and the announced status is ALIVE: the owner is alive
+    and answering, which is precisely the refutation (SWIM's
+    alive-with-higher-incarnation message; here the higher incarnation
+    is the fresh timestamp the caller stamps).
+
+    Returns ``(due, st)`` with the refutation folded in.
+    """
+    if not suspicion:
+        return due, st
+    refute = present & (st == SUSPECT)
+    return due | refute, jnp.where(refute, ALIVE, st)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProtocolParams:
+    """The suspicion/damping knob bundle shared by sim and live.
+
+    Defaults are the DISABLED subprotocol: ``suspicion_window_s == 0``
+    keeps every simulated round bit-identical to the pre-suspicion
+    protocol, and ``damping_threshold == 0`` never suppresses a
+    service.
+    """
+
+    suspicion_window_s: float = 0.0   # SWIM quarantine window (0 = off)
+    damping_half_life_s: float = 60.0  # penalty exponential-decay half-life
+    damping_threshold: float = 0.0    # suppress at penalty ≥ this (0 = off)
+    damping_reuse_threshold: float = 0.0  # readmit below this
+                                      # (0 = auto: threshold / 2)
+    damping_flap_penalty: float = 1.0  # penalty added per observed flap
+
+    def __post_init__(self):
+        if self.suspicion_window_s < 0:
+            raise ValueError("suspicion_window_s must be >= 0")
+        if self.damping_half_life_s <= 0:
+            raise ValueError("damping_half_life_s must be > 0")
+        if self.damping_threshold < 0:
+            raise ValueError("damping_threshold must be >= 0")
+        if self.damping_reuse_threshold > self.damping_threshold:
+            raise ValueError(
+                "damping_reuse_threshold cannot exceed damping_threshold")
+
+    @property
+    def resolved_reuse_threshold(self) -> float:
+        """Hysteresis floor: explicit, else half the suppress threshold
+        (the BGP reuse < suppress convention) so a service hovering at
+        the threshold cannot thrash in and out of routing."""
+        if self.damping_reuse_threshold > 0:
+            return self.damping_reuse_threshold
+        return self.damping_threshold / 2.0
+
+    def timecfg(self, base):
+        """``base`` TimeConfig with this bundle's suspicion window
+        applied — how the bridge/bench thread per-request protocol
+        params into the jitted round."""
+        return dataclasses.replace(
+            base, suspicion_window_s=self.suspicion_window_s)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, d: Optional[dict]) -> "ProtocolParams":
+        """Build from a request dict (the ``POST /simulate`` surface);
+        unknown keys are rejected loudly — a typoed knob silently
+        running the defaults would defeat the sim↔live parity story."""
+        if not d:
+            return cls()
+        known = {f.name for f in dataclasses.fields(cls)}
+        bad = set(d) - known
+        if bad:
+            raise ValueError(
+                f"unknown protocol param(s): {sorted(bad)} "
+                f"(expected a subset of {sorted(known)})")
+        return cls(**{k: float(v) for k, v in d.items()})
+
+    @classmethod
+    def from_config(cls, sidecar_cfg) -> "ProtocolParams":
+        """From the live node's ``SidecarConfig`` (config.py) — the
+        SIDECAR_SUSPICION_WINDOW / SIDECAR_DAMPING_* env knobs."""
+        return cls(
+            suspicion_window_s=sidecar_cfg.suspicion_window,
+            damping_half_life_s=sidecar_cfg.damping_half_life,
+            damping_threshold=sidecar_cfg.damping_threshold,
+        )

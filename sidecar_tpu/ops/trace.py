@@ -46,6 +46,18 @@ one, which tests/test_telemetry.py pins at d ∈ {1, 2, 4, 8}):
   and took the in-scan dense fallback.
 * ``tombstones`` — POST-round count of tombstone-status cells across
   the model's belief structures.
+* ``suspects`` — POST-round count of SUSPECT-status cells (the SWIM
+  quarantine population, ops/suspicion.py); always 0 while the
+  suspicion window is disabled.
+* ``fp_tombstones`` — cells that ENTERED tombstone status this round
+  while the slot's owner node is a live cluster member (the carried
+  ``node_alive`` — a fault-plan pause does not clear it): the
+  false-positive eviction count the robustness methodology measures
+  (docs/chaos.md).  A tombstone of a genuinely departed owner
+  (``node_alive`` false) never counts.  On the compressed family the
+  columns cover ``own`` + ``floor`` (the authoritative structures);
+  transient cache copies of tombstones ride the tombstone census but
+  not this transition count.
 """
 
 from __future__ import annotations
@@ -58,7 +70,12 @@ import jax.numpy as jnp
 
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops.kernels.publish_gather import eligible_lines
-from sidecar_tpu.ops.status import TOMBSTONE, is_known, unpack_status
+from sidecar_tpu.ops.status import (
+    SUSPECT,
+    TOMBSTONE,
+    is_known,
+    unpack_status,
+)
 
 # Analytic wire cost of one gossiped record: the reference's ~1398 B
 # UDP packet carries the 15-record budget (services_delegate.go:182).
@@ -74,9 +91,12 @@ TRACE_EXCHANGE_BYTES = 4
 TRACE_SPARSE = 5
 TRACE_OVERFLOW = 6
 TRACE_TOMBSTONES = 7
-TRACE_WIDTH = 8
+TRACE_SUSPECTS = 8
+TRACE_FP_TOMBSTONES = 9
+TRACE_WIDTH = 10
 TRACE_FIELDS = ("round", "frontier", "behind", "admitted",
-                "exchange_bytes", "sparse", "overflow", "tombstones")
+                "exchange_bytes", "sparse", "overflow", "tombstones",
+                "suspects", "fp_tombstones")
 
 
 @jax.tree_util.register_dataclass
@@ -131,8 +151,31 @@ def count_tombstones(*packed) -> jax.Array:
     return total
 
 
+def count_suspects(*packed) -> jax.Array:
+    """SUSPECT-status cells (the SWIM quarantine population,
+    ops/suspicion.py) across packed-key tensors."""
+    total = jnp.zeros((), jnp.int32)
+    for arr in packed:
+        hit = is_known(arr) & (unpack_status(arr) == SUSPECT)
+        total = total + jnp.sum(hit.astype(jnp.int32))
+    return total
+
+
+def fp_tombstone_entries(prev, nxt, owner_alive) -> jax.Array:
+    """Cells that ENTERED tombstone status between two aligned packed
+    tensors while the slot's owner is alive (``owner_alive`` broadcasts
+    against the tensors): the false-positive eviction transition count
+    — the service is actually up, yet a belief cell now calls it dead.
+    A tombstone arriving at a previously-unknown cell counts too (it is
+    a new false belief either way)."""
+    entered = is_known(nxt) & (unpack_status(nxt) == TOMBSTONE) & \
+        ~(is_known(prev) & (unpack_status(prev) == TOMBSTONE))
+    return jnp.sum((entered & owner_alive).astype(jnp.int32))
+
+
 def build_record(round_idx, frontier, behind, admitted, exchange_bytes,
-                 tombstones, stats=None) -> jax.Array:
+                 tombstones, suspects, fp_tombstones,
+                 stats=None) -> jax.Array:
     """Assemble the [TRACE_WIDTH] int32 record; ``stats`` is the sparse
     step's int32 [3] vector (sparse-taken, overflowed, frontier-hwm) or
     None on dense rounds."""
@@ -150,6 +193,8 @@ def build_record(round_idx, frontier, behind, admitted, exchange_bytes,
         jnp.asarray(sparse, jnp.int32),
         jnp.asarray(overflow, jnp.int32),
         jnp.asarray(tombstones, jnp.int32),
+        jnp.asarray(suspects, jnp.int32),
+        jnp.asarray(fp_tombstones, jnp.int32),
     ])
 
 
@@ -166,8 +211,13 @@ def exact_record(prev, nxt, *, budget: int, fanout: int, limit: int,
                       & (nxt.known < truth[None, :])).astype(jnp.int32))
     admitted = jnp.sum((nxt.known != prev.known).astype(jnp.int32))
     tombs = count_tombstones(nxt.known)
+    suspects = count_suspects(nxt.known)
+    n, m = nxt.known.shape
+    owner = jnp.arange(m, dtype=jnp.int32) // (m // n)
+    fp = fp_tombstone_entries(prev.known, nxt.known,
+                              alive[owner][None, :])
     return build_record(nxt.round_idx, frontier, behind, admitted,
-                        xbytes, tombs, stats)
+                        xbytes, tombs, suspects, fp, stats)
 
 
 def compressed_record(prev, nxt, behind, *, budget: int, fanout: int,
@@ -184,10 +234,16 @@ def compressed_record(prev, nxt, behind, *, budget: int, fanout: int,
         + jnp.sum((nxt.cache_slot != prev.cache_slot).astype(jnp.int32))
         + jnp.sum((nxt.floor != prev.floor).astype(jnp.int32)))
     tombs = count_tombstones(nxt.own, nxt.floor, nxt.cache_val)
+    suspects = count_suspects(nxt.own, nxt.floor, nxt.cache_val)
+    alive = nxt.node_alive
+    n, s = nxt.own.shape
+    floor_owner = jnp.arange(n * s, dtype=jnp.int32) // s
+    fp = fp_tombstone_entries(prev.own, nxt.own, alive[:, None]) + \
+        fp_tombstone_entries(prev.floor, nxt.floor, alive[floor_owner])
     behind_i = jnp.minimum(jnp.asarray(behind, jnp.float32),
                            jnp.float32(2**31 - 1)).astype(jnp.int32)
     return build_record(nxt.round_idx, frontier, behind_i, admitted,
-                        xbytes, tombs, stats)
+                        xbytes, tombs, suspects, fp, stats)
 
 
 # -- host-side views ---------------------------------------------------------
@@ -234,4 +290,8 @@ def summarize(trace: RoundTrace) -> dict:
         "sparse_rounds": int(recorded[:, TRACE_SPARSE].sum()),
         "overflow_rounds": int(recorded[:, TRACE_OVERFLOW].sum()),
         "tombstones_last": int(recorded[-1, TRACE_TOMBSTONES]),
+        "suspects_last": int(recorded[-1, TRACE_SUSPECTS]),
+        "suspects_max": int(recorded[:, TRACE_SUSPECTS].max()),
+        "fp_tombstones_total": int(
+            recorded[:, TRACE_FP_TOMBSTONES].astype(np.int64).sum()),
     }

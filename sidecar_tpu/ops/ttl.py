@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from sidecar_tpu.ops.status import (
     DRAINING,
+    SUSPECT,
     TOMBSTONE,
     is_known,
     pack,
@@ -34,7 +35,7 @@ from sidecar_tpu.ops.status import (
 
 
 def ttl_sweep(known, now_tick, *, alive_lifespan, draining_lifespan,
-              tombstone_lifespan, one_second):
+              tombstone_lifespan, one_second, suspicion_window=0):
     """Apply the lifespan sweep to a tensor of packed records.
 
     Args:
@@ -43,10 +44,26 @@ def ttl_sweep(known, now_tick, *, alive_lifespan, draining_lifespan,
       alive_lifespan / draining_lifespan / tombstone_lifespan / one_second:
         durations in ticks (see models/timecfg.py for the mapping from the
         reference's wall-clock constants).
+      suspicion_window: SWIM-style quarantine window in ticks
+        (ops/suspicion.py, docs/chaos.md).  0 — the default — compiles
+        the pre-suspicion sweep unchanged, bit for bit.  > 0: an expired
+        non-DRAINING record is re-packed SUSPECT at its ORIGINAL
+        timestamp (a monotone packed increase, so the max-merge gossips
+        the suspicion and any strictly newer ALIVE refutes it), and only
+        a suspicion that survives unrefuted past ``lifespan + window``
+        becomes a tombstone — still stamped original ts + 1 s, so the
+        +1 s rule holds identically.  DRAINING records never enter
+        quarantine: draining is an ORDERLY shutdown with its own 10 min
+        lifespan, not a suspected failure — they tombstone directly, as
+        before (the memberlist/Lifeguard analog suspects alive members
+        only).
 
     Returns:
       (swept, expired) — the updated tensor and a bool mask of cells that
       were tombstoned by this sweep (for event accounting / metrics).
+      Cells entering SUSPECT are NOT in ``expired`` (nothing was
+      tombstoned); they surface through the trace plane's suspect census
+      (ops/trace.py) instead.
     """
     now_tick = jnp.asarray(now_tick, jnp.int32)
     ts = unpack_ts(known)
@@ -55,6 +72,23 @@ def ttl_sweep(known, now_tick, *, alive_lifespan, draining_lifespan,
 
     is_tomb = present & (st == TOMBSTONE)
     gc = is_tomb & (ts < now_tick - tombstone_lifespan)
+
+    if suspicion_window > 0:
+        # Quarantine-before-tombstone: fresh expiries of suspectable
+        # records become SUSPECT at the original ts; a SUSPECT record
+        # tombstones only once the grace window has ALSO lapsed.
+        is_suspect = present & (st == SUSPECT)
+        is_drain = present & (st == DRAINING)
+        suspectable = present & ~is_tomb & ~is_suspect & ~is_drain
+        to_suspect = suspectable & (ts < now_tick - alive_lifespan)
+        expired = (is_drain & (ts < now_tick - draining_lifespan)) | \
+            (is_suspect & (ts < now_tick - alive_lifespan
+                           - suspicion_window))
+        swept = jnp.where(to_suspect, pack(ts, SUSPECT), known)
+        swept = jnp.where(expired, pack(ts + one_second, TOMBSTONE),
+                          swept)
+        swept = jnp.where(gc, 0, swept)
+        return swept, expired
 
     lifespan = jnp.where(st == DRAINING, draining_lifespan, alive_lifespan)
     expired = present & ~is_tomb & (ts < now_tick - lifespan)

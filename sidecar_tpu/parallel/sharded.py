@@ -53,6 +53,7 @@ from sidecar_tpu.models.exact import SimParams, SimState, clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import sparse as sparse_ops
+from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.merge import merge_packed, staleness_mask, sticky_adjust
 from sidecar_tpu.ops.status import (
@@ -336,6 +337,10 @@ class ShardedSim:
             own, a_cols, round_idx, refresh_rounds=t.refresh_rounds,
             round_ticks=t.round_ticks, now=now) & present \
             & (st != TOMBSTONE)
+        # Lifeguard self-refutation, matching ExactSim._announce_updates
+        # bit-for-bit (compiles to nothing at suspicion window 0).
+        due, st = suspicion_ops.announce_refute(
+            due, st, present, t.suspicion_window > 0)
         a_vals = jnp.where(due, pack(now, st), 0)
         a_rows = jnp.where(due, lr, nl)
 
@@ -403,7 +408,8 @@ class ShardedSim:
                 alive_lifespan=t.alive_lifespan,
                 draining_lifespan=t.draining_lifespan,
                 tombstone_lifespan=t.tombstone_lifespan,
-                one_second=t.one_second)
+                one_second=t.one_second,
+                suspicion_window=t.suspicion_window)
             se = jnp.where(swept != kn, jnp.int8(0), se)
             return swept, se
 

@@ -84,6 +84,8 @@ class AdsServer:
         self.use_hostnames = use_hostnames
         self._snapshot = Snapshot("0", {t: [] for t in PUSH_ORDER})
         self._published_version = -1   # hub version of self._snapshot
+        self._damping_gen = 0          # forced-rebuild counter (see refresh)
+        self._damped_seen: frozenset = frozenset()
         self._cond = threading.Condition()
         self._stop = threading.Event()
         self._server: Optional[grpc.Server] = None
@@ -91,17 +93,29 @@ class AdsServer:
 
     # -- snapshot maintenance ----------------------------------------------
 
-    def refresh(self) -> bool:
+    def refresh(self, force: bool = False) -> bool:
         """Rebuild + publish an xDS snapshot if the hub moved past the
         published version (server.go:70-110 recast onto the query
         plane).  Reads the hub's immutable catalog snapshot — no
         ``state._lock`` — and reuses its version as the SotW wire
-        version.  True when a new snapshot was set."""
-        catalog = self.state.query_hub().current()
-        if catalog.version == self._published_version:
+        version.  True when a new snapshot was set.
+
+        ``force`` rebuilds even at an unchanged hub version — the
+        damping readmission path: a suppressed service readmits by
+        penalty DECAY, which produces no catalog event, so the delta
+        loop forces a rebuild when it notices the damped set moved.
+        The wire version gains a ``.d<n>`` suffix then, keeping SotW
+        versions unique without faking a catalog change."""
+        hub = self.state.query_hub()
+        catalog = hub.current()
+        if catalog.version == self._published_version and not force:
             return False
+        # Flap-damped admission on the snapshot path (catalog/damping.py
+        # via the hub): suppressed instances are withheld from the xDS
+        # resource set without leaving the catalog.
         res = resources_from_state(catalog, self.bind_ip,
-                                   self.use_hostnames, eds_mode="ads")
+                                   self.use_hostnames, eds_mode="ads",
+                                   damper=hub.damper)
         by_type = {
             TYPE_CLUSTER: [(c["name"], xds_proto.cluster_to_any(c))
                            for c in res.clusters],
@@ -112,7 +126,16 @@ class AdsServer:
                             for li in res.listeners],
         }
         with self._cond:
-            self._snapshot = Snapshot(str(catalog.version), by_type)
+            version = str(catalog.version)
+            if catalog.version == self._published_version:
+                # Forced (damping-driven) rebuild at the same catalog
+                # version: suffix a generation counter so every pushed
+                # SotW version stays distinct.
+                self._damping_gen += 1
+                version = f"{catalog.version}.d{self._damping_gen}"
+            else:
+                self._damping_gen = 0
+            self._snapshot = Snapshot(version, by_type)
             self._published_version = catalog.version
             self._cond.notify_all()
         log.debug("ads: published snapshot %s", self._snapshot.version)
@@ -143,10 +166,31 @@ class AdsServer:
             while not self._stop.is_set():
                 ev = sub.get(timeout=0.5)
                 if ev is None:
+                    # Idle tick: damping readmission is driven by
+                    # penalty DECAY (no catalog event fires), so check
+                    # whether the damped set moved and force a rebuild
+                    # when it did.
+                    damper = self.state.query_hub().damper
+                    if damper is not None:
+                        damped = frozenset(damper.damped())
+                        if damped != self._damped_seen:
+                            try:
+                                self.refresh(force=True)
+                                # Recorded only AFTER a successful
+                                # rebuild, so a transient refresh
+                                # failure is retried next tick instead
+                                # of leaving Envoys on stale routing.
+                                self._damped_seen = damped
+                            except Exception:
+                                log.exception(
+                                    "ads: damping refresh failed")
                     continue
                 sub.drain()  # collapse the burst; refresh reads latest
                 try:
                     self.refresh()
+                    damper = self.state.query_hub().damper
+                    if damper is not None:
+                        self._damped_seen = frozenset(damper.damped())
                 except Exception:
                     log.exception("ads: snapshot refresh failed")
         finally:

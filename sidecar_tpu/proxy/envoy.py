@@ -184,12 +184,19 @@ def _listener_from_service(svc: Service, envoy_name: str, svc_port: int,
 
 def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
                          use_hostnames: bool = False,
-                         eds_mode: str = "rest") -> EnvoyResources:
+                         eds_mode: str = "rest",
+                         damper=None) -> EnvoyResources:
     """Full resource set from the catalog (adapter.go:108-212).
 
     The port-collision guard gives each ServicePort to the first (oldest,
     via the sorted state walk) service claiming it — multiple listeners
-    on one port make Envoy melt down (adapter.go:87-103)."""
+    on one port make Envoy melt down (adapter.go:87-103).
+
+    ``damper`` (catalog/damping.py): flap-damped admission — instances
+    the damper currently suppresses are withheld from the resource set
+    (no endpoint, no listener) while remaining in the catalog; they
+    readmit automatically once their penalty decays below the reuse
+    threshold."""
     global _last_logged_port_collision
     endpoint_map: dict[str, dict] = {}
     cluster_map: dict[str, dict] = {}
@@ -208,6 +215,8 @@ def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
                     for c, h, svc in state.each_service_sorted()]
     for _, _, svc in walk:
         if not svc.is_alive():
+            continue
+        if damper is not None and not damper.admitted(svc):
             continue
         for port in svc.ports:
             if port.service_port < 1:
@@ -418,18 +427,29 @@ class XdsServer:
         self._snapshot: Optional[EnvoyResources] = None
         self._version = "0"
         self._last_changed = -1
+        self._damped_seen: frozenset = frozenset()
         self._lock = threading.Lock()
 
     def refresh(self) -> bool:
-        """Rebuild the snapshot if the state changed; True when updated."""
-        if self.state.last_changed == self._last_changed:
+        """Rebuild the snapshot if the state changed — or if the flap
+        damper's suppressed set moved (catalog/damping.py: readmission
+        is penalty-DECAY driven and produces no catalog event, so the
+        LastChanged poll alone would never serve it); True when
+        updated."""
+        damper = getattr(self.state, "flap_damper", None)
+        damped = frozenset(damper.damped()) if damper is not None \
+            else frozenset()
+        if self.state.last_changed == self._last_changed \
+                and damped == self._damped_seen:
             return False
         resources = resources_from_state(
-            self.state, self.bind_ip, self.use_hostnames, eds_mode="rest")
+            self.state, self.bind_ip, self.use_hostnames, eds_mode="rest",
+            damper=damper)
         with self._lock:
             self._snapshot = resources
             self._version = str(time.time_ns())
             self._last_changed = self.state.last_changed
+            self._damped_seen = damped
         return True
 
     def discovery_response(self, type_url: str):

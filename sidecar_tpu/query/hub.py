@@ -190,6 +190,16 @@ class QueryHub:
             return self.attach()
         return snap
 
+    @property
+    def damper(self):
+        """The catalog's flap damper (catalog/damping.py), or None —
+        snapshot-path consumers (the ADS server, HAProxy writer) gate
+        proxy admission on it so a flapping service is withheld from
+        routing without being dropped from the snapshots themselves
+        (the catalog views stay complete; damping is a routing
+        decision)."""
+        return getattr(self.state, "flap_damper", None)
+
     # -- the writer-path publish -------------------------------------------
 
     def publish(self, event) -> CatalogSnapshot:

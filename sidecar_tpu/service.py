@@ -26,6 +26,11 @@ TOMBSTONE = 1
 UNHEALTHY = 2
 UNKNOWN = 3
 DRAINING = 4
+# Simulator-side extension (ops/status.py): SWIM-style quarantine
+# before tombstone.  The live catalog never produces this code — it
+# exists here so simulator projections (bridge reports, delta streams)
+# render it by name instead of the unknown-code "Tombstone" fallback.
+SUSPECT = 5
 
 NS_PER_SECOND = 1_000_000_000
 
@@ -47,6 +52,7 @@ def status_string(status: int) -> str:
         UNHEALTHY: "Unhealthy",
         UNKNOWN: "Unknown",
         DRAINING: "Draining",
+        SUSPECT: "Suspect",
     }.get(status, "Tombstone")
 
 

@@ -38,6 +38,7 @@ from sidecar_tpu.ops.status import (
     DRAINING,
     STATUS_BITS,
     STATUS_MASK,
+    SUSPECT,
     TOMBSTONE,
 )
 
@@ -165,8 +166,14 @@ class OracleSim:
             if ts == 0 or st == TOMBSTONE:
                 continue
             phase = ((m * 2654435761) & 0xFFFFFFFF) % t.refresh_rounds
-            if (self.round_idx % t.refresh_rounds) == phase \
-                    and (now - ts) >= guard:
+            due = (self.round_idx % t.refresh_rounds) == phase \
+                and (now - ts) >= guard
+            if t.suspicion_window > 0 and st == SUSPECT:
+                # Lifeguard self-refutation (ops/suspicion.py): an
+                # alive owner whose own record is quarantined announces
+                # a refuting ALIVE immediately, phase regardless.
+                due, st = True, ALIVE
+            if due:
                 self.apply_one(o, m, _pack(now, st), pre)
 
         # 3. anti-entropy push-pull.
@@ -213,8 +220,11 @@ class OracleSim:
     # -- lifespan sweep ----------------------------------------------------
 
     def sweep(self, now: int) -> None:
-        """TombstoneOthersServices per node (services_state.go:635-683)."""
+        """TombstoneOthersServices per node (services_state.go:635-683),
+        plus the SWIM suspicion quarantine when the window is enabled
+        (ops/ttl.py suspicion_window, docs/chaos.md)."""
         t = self.t
+        window = t.suspicion_window
         n, m_tot = self.known.shape
         for node in range(n):
             for m in range(m_tot):
@@ -225,6 +235,27 @@ class OracleSim:
                 if st == TOMBSTONE:
                     if ts < now - t.tombstone_lifespan:
                         self.known[node, m] = 0  # GC (:645-653)
+                        self.sent[node, m] = 0
+                    continue
+                if window > 0:
+                    # Quarantine-before-tombstone: non-DRAINING expiry
+                    # re-packs SUSPECT at the ORIGINAL ts; only an
+                    # unrefuted suspicion past the window tombstones
+                    # (still at ts + 1 s — the +1 s rule holds).
+                    if st == SUSPECT:
+                        if ts < now - t.alive_lifespan - window:
+                            self.known[node, m] = _pack(
+                                ts + t.one_second, TOMBSTONE)
+                            self.sent[node, m] = 0
+                        continue
+                    if st == DRAINING:
+                        if ts < now - t.draining_lifespan:
+                            self.known[node, m] = _pack(
+                                ts + t.one_second, TOMBSTONE)
+                            self.sent[node, m] = 0
+                        continue
+                    if ts < now - t.alive_lifespan:
+                        self.known[node, m] = _pack(ts, SUSPECT)
                         self.sent[node, m] = 0
                     continue
                 lifespan = (t.draining_lifespan if st == DRAINING

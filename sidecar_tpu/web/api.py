@@ -20,6 +20,8 @@ Routes (http.go:64-76, http_api.go:35-45):
                                     (?limit=N newest spans)
   GET  /api/debug/profile           live sampling CPU profile (pprof analog)
   GET  /api/haproxy/stats.csv       relay of the managed HAProxy's stats CSV
+  GET  /api/damping.json            flap-damper penalties + suppressed set
+                                    (catalog/damping.py; docs/chaos.md)
   OPTIONS                            CORS headers
 Deprecated aliases /services.json and /state.json are also served.
 """
@@ -196,6 +198,8 @@ class SidecarApi:
             return self.metrics_prometheus()
         if parts == ["trace"]:
             return self.trace_dump(query)
+        if parts == ["damping.json"] or parts == ["damping"]:
+            return self.damping_dump()
         if parts == ["debug", "stacks"]:
             return self.debug_stacks()
         if parts == ["debug", "profile"]:
@@ -216,6 +220,15 @@ class SidecarApi:
 
     def _members(self) -> list[str]:
         return sorted(self.members_fn()) if self.members_fn else []
+
+    def damping_dump(self):
+        """Flap-damper state (``GET /api/damping.json`` —
+        catalog/damping.py): per-instance penalties + the suppressed
+        set, or ``{"enabled": false}`` when damping is off."""
+        damper = getattr(self.state, "flap_damper", None)
+        if damper is None:
+            return self._json(200, {"enabled": False})
+        return self._json(200, {"enabled": True, **damper.snapshot()})
 
     def services(self, extension: str):
         """Grouped-by-service + cluster members

@@ -26,6 +26,28 @@ NS = S.NS_PER_SECOND
 T0 = 1_700_000_000 * NS
 
 
+def _xds_pb_available() -> bool:
+    """True when the generated xds stubs are usable — either protoc is
+    installed (pb() compiles on demand) or a previous run left the
+    generated module behind.  Evaluated once at collection so the
+    full-stack suites SKIP with a reason on protoc-less images instead
+    of erroring at fixture setup (the protocol logic is still covered
+    by TestStreamLogicWithoutProtoc)."""
+    import subprocess
+
+    try:
+        xds_proto.pb()
+        return True
+    except (OSError, subprocess.CalledProcessError):
+        return False
+
+
+requires_xds_pb = pytest.mark.skipif(
+    not _xds_pb_available(),
+    reason="protoc and generated xds stubs unavailable in this image; "
+           "stream logic is covered by TestStreamLogicWithoutProtoc")
+
+
 def make_state():
     state = ServicesState(hostname="h1")
     state.set_clock(lambda: T0)
@@ -110,6 +132,7 @@ def ads():
     server.shutdown()
 
 
+@requires_xds_pb
 class TestAdsStream:
     def test_subscribe_receives_and_decodes_all_types(self, ads):
         state, server, mock = ads
@@ -568,6 +591,7 @@ class TestStreamLogicWithoutProtoc:
             self.teardown_stream(server, inbox)
 
 
+@requires_xds_pb
 def test_port_conflict_raises_not_shared():
     """grpc's default so_reuseport would let two ADS servers silently
     SHARE one port (each getting a random subset of Envoy streams); the

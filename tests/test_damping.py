@@ -1,0 +1,337 @@
+"""Flap damping — host-side unit contracts and the sim↔live
+cross-validation (the tests/test_chaos.py style: ONE FaultPlan drives
+both paths, and they must agree on which services get damped).
+
+The live path here is the REAL catalog machinery: a ``ServicesState``
+on a fake clock with an attached :class:`FlapDamper`, where pauses are
+played out exactly as they unfold in production — the paused node stops
+refreshing, the genuine ``tombstone_others_services`` sweep mints the
+tombstone (the +1 s rule path), and the node's comeback re-announce
+flips the record back.  The sim path runs the SAME plan through
+``ChaosExactSim`` and feeds one node's observed transitions through the
+same FlapDamper implementation, the benchmarks/robustness.py /
+SimBridge._predict_damping shape.  Timescales differ (live protocol
+constants are fixed at 80 s lifespan; the sim runs expiry-scale
+clocks), so the damper runs with a decay half-life long past both
+horizons — the damped set then depends only on the FLAP STRUCTURE,
+which is exactly what one plan must reproduce on both paths.
+
+Also here: damper unit semantics (hysteresis, decay readmission,
+discovery-is-not-a-flap), proxy admission gating (Envoy resource
+generation + HAProxy backend set + the ADS damping-generation
+versioning), and the bridge's ``protocol``/``robustness`` surface.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu import service as S
+from sidecar_tpu.bridge import SimBridge
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.catalog.damping import FlapDamper
+from sidecar_tpu.chaos import ChaosExactSim, FaultPlan, NodeFault
+from sidecar_tpu.models.exact import SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops.status import ALIVE as SIM_ALIVE
+from sidecar_tpu.ops.suspicion import ProtocolParams
+from sidecar_tpu.proxy.envoy import resources_from_state
+from sidecar_tpu.proxy.haproxy import services_with_ports
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+# The shared plan: node 2 pauses TWICE (a flapper — two expiry/return
+# cycles = 4 liveness transitions), node 3 pauses ONCE (2 transitions).
+# With flap threshold 3, both paths must damp node 2's service and ONLY
+# node 2's service.
+N_NODES = 4
+PAUSE_2A = (20, 45)
+PAUSE_2B = (70, 95)
+PAUSE_3 = (25, 50)
+PLAN = FaultPlan(seed=6, nodes=(
+    NodeFault(nodes=(2,), start_round=PAUSE_2A[0], end_round=PAUSE_2A[1],
+              kind="pause"),
+    NodeFault(nodes=(2,), start_round=PAUSE_2B[0], end_round=PAUSE_2B[1],
+              kind="pause"),
+    NodeFault(nodes=(3,), start_round=PAUSE_3[0], end_round=PAUSE_3[1],
+              kind="pause"),
+))
+THRESHOLD = 3.0
+HALF_LIFE_S = 1e6   # decay negligible over both horizons (see module doc)
+
+TIGHT = TimeConfig(refresh_interval_s=2.0, alive_lifespan_s=3.0,
+                   sweep_interval_s=0.4, push_pull_interval_s=1.0)
+
+
+def make_service(hostname, sid, updated, status=S.ALIVE,
+                 service_port=8080):
+    return S.Service(id=sid, name=f"web-{sid}", image="w:1",
+                     hostname=hostname, updated=updated, status=status,
+                     ports=[S.Port("tcp", 10000, service_port,
+                                   "10.0.0.9")])
+
+
+class TestDamperUnit:
+    def _damper(self, clock, threshold=2.0, half_life_s=10.0):
+        return FlapDamper(half_life_s=half_life_s, threshold=threshold,
+                          now_fn=lambda: clock[0])
+
+    def test_discovery_is_not_a_flap(self):
+        clock = [T0]
+        d = self._damper(clock)
+        svc = make_service("h1", "i1", T0)
+        d.observe(svc, S.UNKNOWN)
+        assert d.penalty(("h1", "i1")) == 0.0
+
+    def test_same_liveness_transition_is_not_a_flap(self):
+        clock = [T0]
+        d = self._damper(clock)
+        svc = make_service("h1", "i1", T0, status=S.DRAINING)
+        d.observe(svc, S.TOMBSTONE)   # dead -> dead-ish: no liveness change
+        assert d.penalty(("h1", "i1")) == 0.0
+
+    def test_suppress_then_decay_readmits_with_hysteresis(self):
+        clock = [T0]
+        d = self._damper(clock, threshold=2.0, half_life_s=10.0)
+        svc = make_service("h1", "i1", T0)
+        for prev, new in ((S.ALIVE, S.TOMBSTONE), (S.TOMBSTONE, S.ALIVE)):
+            svc.status = new
+            d.observe(svc, prev)
+        assert not d.admitted(svc)
+        # Above reuse (1.0) but below suppress (2.0): still damped —
+        # the hysteresis band.
+        clock[0] += 5_000_000_000
+        assert not d.admitted(svc)
+        # Decayed below reuse: readmitted by pure time passage.
+        clock[0] += 20_000_000_000
+        assert d.admitted(svc)
+
+    def test_threshold_zero_never_suppresses(self):
+        clock = [T0]
+        d = FlapDamper(half_life_s=10.0, threshold=0.0,
+                       now_fn=lambda: clock[0])
+        svc = make_service("h1", "i1", T0)
+        for _ in range(10):
+            svc.status = S.TOMBSTONE
+            d.observe(svc, S.ALIVE)
+            svc.status = S.ALIVE
+            d.observe(svc, S.TOMBSTONE)
+        assert d.admitted(svc) and d.damped() == set()
+
+
+class TestProxyAdmission:
+    def _flapped_state(self, clock):
+        state = ServicesState(hostname="h1")
+        state.set_clock(lambda: clock[0])
+        damper = FlapDamper(half_life_s=1e6, threshold=2.0,
+                            now_fn=lambda: clock[0])
+        state.attach_damper(damper)
+        # Distinct ServicePorts: the port-collision guard must not be
+        # the thing hiding bbb from the resource set.
+        for host, sid, port in (("h1", "aaa", 8080), ("h2", "bbb", 8081)):
+            state.add_service_entry(
+                make_service(host, sid, clock[0], service_port=port))
+        # Flap bbb through the real merge path until well past the
+        # threshold (penalty decays a hair between observations, so an
+        # exact-threshold flap count would sit on the float boundary).
+        for status in (S.TOMBSTONE, S.ALIVE, S.TOMBSTONE, S.ALIVE):
+            clock[0] += NS
+            svc = make_service("h2", "bbb", clock[0], status=status,
+                               service_port=8081)
+            state.add_service_entry(svc)
+        return state, damper
+
+    def test_envoy_resources_withhold_damped_instance(self):
+        clock = [T0]
+        state, damper = self._flapped_state(clock)
+        assert damper.damped() == {("h2", "bbb")}
+        res = resources_from_state(state, damper=damper)
+        names = {e["cluster_name"] for e in res.endpoints}
+        assert names == {"web-aaa:8080"}
+        # Without the damper the instance is served (catalog unchanged).
+        res_all = resources_from_state(state)
+        assert {e["cluster_name"] for e in res_all.endpoints} == \
+            {"web-aaa:8080", "web-bbb:8081"}
+
+    def test_haproxy_backends_withhold_damped_instance(self):
+        clock = [T0]
+        state, damper = self._flapped_state(clock)
+        with_damper = services_with_ports(state, damper)
+        assert set(with_damper) == {"web-aaa"}
+        assert set(services_with_ports(state)) == {"web-aaa", "web-bbb"}
+
+    def test_catalog_views_keep_damped_instance(self):
+        """Damping is a ROUTING decision: the record stays in every
+        catalog view."""
+        clock = [T0]
+        state, _ = self._flapped_state(clock)
+        assert "bbb" in state.servers["h2"].services
+        assert any(svc.id == "bbb"
+                   for group in state.by_service().values()
+                   for svc in group)
+
+
+class TestCrossValidation:
+    """One FaultPlan, both paths, same damped set."""
+
+    def _sim_damped(self, suspicion_window_s):
+        """ChaosExactSim under PLAN; node 0's observed transitions feed
+        the damper (the robustness-harness shape).
+
+        The perturb hook models the COMEBACK: the round a pause window
+        closes, the returned node's discovery loop re-announces its
+        service with a fresh timestamp (the reference's
+        track_new_services path; the sim's announce models only the
+        periodic refresh, which never resurrects a tombstone) — without
+        it a paused-out record stays dead and the live path's
+        flap-back has no sim twin."""
+        from sidecar_tpu.ops.status import pack as sim_pack
+
+        cfg = dataclasses.replace(
+            TIGHT, suspicion_window_s=suspicion_window_s)
+        params = SimParams(n=N_NODES, services_per_node=1, fanout=2,
+                           budget=3)
+        comebacks = tuple((f.end_round, f.nodes[0])
+                          for f in PLAN.nodes)
+
+        def perturb(state, key, now):
+            known, sent = state.known, state.sent
+            r = now // cfg.round_ticks
+            for end, node in comebacks:
+                mint = r == end        # spn=1: slot id == node id
+                val = jnp.where(mint, sim_pack(now, SIM_ALIVE),
+                                known[node, node])
+                known = known.at[node, node].set(val)
+                sent = sent.at[node, node].set(
+                    jnp.where(mint, 0,
+                              sent[node, node]).astype(jnp.int8))
+            return dataclasses.replace(state, known=known, sent=sent)
+
+        sim = ChaosExactSim(params, topology.complete(N_NODES), cfg,
+                            plan=PLAN, perturb=perturb)
+        cst = sim.init_state()
+        key = jax.random.PRNGKey(1)
+        clock = [0]
+        damper = FlapDamper(half_life_s=HALF_LIFE_S, threshold=THRESHOLD,
+                            now_fn=lambda: clock[0])
+        # The SHARED replay rules (quarantine invisible, discovery not
+        # a flap) — same definition the bridge and bench harness use.
+        from sidecar_tpu.catalog.damping import TransitionReplay
+        replay = TransitionReplay(damper)
+
+        def statuses(row):
+            row = np.asarray(row)
+            return np.where((row >> 3) > 0, row & 7, -1)
+
+        for r in range(120):
+            cst = sim.step(cst, jax.random.fold_in(key, r))
+            clock[0] = (r + 1) * cfg.round_ticks * 1_000_000
+            cur = statuses(cst.sim.known[0])
+            for slot in range(N_NODES):
+                if int(cur[slot]) >= 0:
+                    replay.see(f"node{slot}", f"svc-{slot}",
+                               int(cur[slot]), clock[0])
+        return {sid for _, sid in damper.damped()}
+
+    def _live_damped(self):
+        """The same plan on the live catalog machinery: paused nodes
+        stop refreshing, the REAL lifespan sweep mints the tombstones,
+        comebacks re-announce — observed by the attached damper through
+        the writer funnel."""
+        clock = [T0]
+        state = ServicesState(hostname="node0")
+        state.set_clock(lambda: clock[0])
+        damper = FlapDamper(half_life_s=HALF_LIFE_S, threshold=THRESHOLD,
+                            now_fn=lambda: clock[0])
+        state.attach_damper(damper)
+
+        hosts = [f"node{i}" for i in range(N_NODES)]
+        for i, host in enumerate(hosts):
+            state.add_service_entry(
+                make_service(host, f"svc-{i}", clock[0]))
+
+        def refresh(live_hosts):
+            for i, host in enumerate(hosts):
+                if host in live_hosts:
+                    state.add_service_entry(
+                        make_service(host, f"svc-{i}", clock[0]))
+
+        def expire_paused(paused):
+            """One pause cycle: everyone else refreshes at now, the
+            clock runs past the ALIVE lifespan, the genuine sweep
+            tombstones the silent node's records, and the node's
+            comeback re-announces."""
+            clock[0] += int((S.ALIVE_LIFESPAN + 5) * NS)
+            refresh([h for h in hosts if h not in paused])
+            state.tombstone_others_services()
+            clock[0] += NS
+            refresh(hosts)  # everyone back, paused nodes re-announce
+
+        # The plan's windows in order: node2+node3 overlap, then node2
+        # again alone.
+        expire_paused({"node2", "node3"})
+        expire_paused({"node2"})
+        return {sid for _, sid in damper.damped()}
+
+    def test_same_plan_same_damped_set(self):
+        sim_damped = self._sim_damped(suspicion_window_s=0.0)
+        live_damped = self._live_damped()
+        assert sim_damped == live_damped == {"svc-2"}, (
+            f"sim={sim_damped} live={live_damped}")
+
+    def test_suspicion_prevents_damping_on_both_definitions(self):
+        """With the quarantine window covering the pauses, the sim path
+        sees NO routing-visible flaps at all — nothing to damp.  (The
+        live analog is the membership-level suspect_timeout the native
+        engine already runs — transport/gossip.py — exercised by the
+        churn soak.)"""
+        assert self._sim_damped(suspicion_window_s=8.0) == set()
+
+
+class TestBridgeProtocolSurface:
+    def _state(self):
+        state = ServicesState(hostname="h1")
+        state.set_clock(lambda: T0)
+        for h, sid in (("h1", "a1"), ("h2", "b2")):
+            state.add_service_entry(make_service(h, sid, T0))
+        return state
+
+    def test_report_carries_protocol_and_damping_prediction(self):
+        bridge = SimBridge(self._state(), TIGHT)
+        rep = bridge.simulate(20, protocol={
+            "suspicion_window_s": 2.0, "damping_threshold": 3.0,
+            "damping_half_life_s": 60.0})
+        assert rep.robustness["protocol"]["suspicion_window_s"] == 2.0
+        # A fault-free simulated future flaps nothing.
+        assert rep.robustness["damped"] == []
+        assert rep.deltas is None  # internal stream is not reported
+
+    def test_unknown_protocol_key_rejected(self):
+        bridge = SimBridge(self._state(), TIGHT)
+        with pytest.raises(ValueError, match="unknown protocol param"):
+            bridge.simulate(5, protocol={"suspicion_windows_s": 1.0})
+
+    def test_damping_excluded_on_sharded_and_trace(self):
+        bridge = SimBridge(self._state(), TIGHT)
+        proto = {"damping_threshold": 1.0}
+        with pytest.raises(ValueError, match="single-chip"):
+            bridge.simulate(5, sharded=True, protocol=proto)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            bridge.simulate(5, trace=3, protocol=proto)
+
+    def test_protocol_params_from_config_roundtrip(self):
+        from sidecar_tpu.config import SidecarConfig
+
+        cfg = SidecarConfig(suspicion_window=4.0, damping_half_life=30.0,
+                            damping_threshold=2.5)
+        p = ProtocolParams.from_config(cfg)
+        assert (p.suspicion_window_s, p.damping_half_life_s,
+                p.damping_threshold) == (4.0, 30.0, 2.5)
+        assert p.resolved_reuse_threshold == 1.25
+        assert p.timecfg(TIGHT).suspicion_window_s == 4.0

@@ -1,0 +1,102 @@
+"""tools/check_env_docs.py runs IN tier-1: every ``SIDECAR_TPU_*`` /
+``BENCH_*`` env var the code reads must be documented in
+``docs/env.md``, and the doc must not carry stale rows for knobs
+nothing reads anymore (the ``check_metric_docs.py`` pattern applied to
+the env surface — see the tool's docstring)."""
+
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tools"))
+
+from check_env_docs import check, documented_names, read_names  # noqa: E402
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+class TestRepoIsClean:
+    def test_tree_is_documented(self):
+        problems = check(REPO, REPO / "docs" / "env.md")
+        assert problems == [], "\n".join(problems)
+
+    def test_cli_exit_code(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "check_env_docs.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+    def test_known_knobs_are_scanned(self):
+        """The long-standing knobs must be SEEN by the scanner — a
+        checker that silently stops matching proves nothing green."""
+        names = {name for _, _, name in read_names(REPO)}
+        for expected in ("SIDECAR_TPU_KERNELS", "SIDECAR_TPU_SPARSE",
+                         "SIDECAR_TPU_BOARD_EXCHANGE", "BENCH_SPARSE",
+                         "BENCH_ROBUSTNESS", "BENCH_WATCHDOG_S"):
+            assert expected in names, sorted(names)
+
+
+class TestDetection:
+    """The checker must actually flag offenders in both directions."""
+
+    DOCS = textwrap.dedent("""\
+        # Env reference
+
+        | name | meaning |
+        |------|---------|
+        | `SIDECAR_TPU_DOCUMENTED` | a knob |
+        """)
+
+    def _repo(self, tmp_path, source, docs=None):
+        (tmp_path / "sidecar_tpu").mkdir()
+        (tmp_path / "sidecar_tpu" / "mod.py").write_text(
+            textwrap.dedent(source))
+        docs_file = tmp_path / "env.md"
+        docs_file.write_text(docs if docs is not None else self.DOCS)
+        return tmp_path, docs_file
+
+    def test_flags_undocumented_read(self, tmp_path):
+        repo, docs = self._repo(tmp_path, """
+            import os
+            os.environ.get("SIDECAR_TPU_DOCUMENTED")
+            os.environ.get("SIDECAR_TPU_BRAND_NEW")
+            """)
+        problems = check(repo, docs)
+        assert len(problems) == 1
+        assert "SIDECAR_TPU_BRAND_NEW" in problems[0]
+
+    def test_named_constant_form_is_caught(self, tmp_path):
+        """The resolver-module idiom (NAME = "SIDECAR_TPU_X"; then
+        os.environ.get(NAME)) must be caught via the literal."""
+        repo, docs = self._repo(tmp_path, """
+            import os
+            KNOB = "SIDECAR_TPU_VIA_CONSTANT"
+            os.environ.get(KNOB)
+            os.environ.get("SIDECAR_TPU_DOCUMENTED")
+            """)
+        problems = check(repo, docs)
+        assert len(problems) == 1
+        assert "SIDECAR_TPU_VIA_CONSTANT" in problems[0]
+
+    def test_flags_stale_doc_row(self, tmp_path):
+        repo, docs = self._repo(tmp_path, """
+            import os
+            os.environ.get("SIDECAR_TPU_DOCUMENTED")
+            """, docs=self.DOCS + "| `BENCH_GONE` | removed knob |\n")
+        problems = check(repo, docs)
+        assert len(problems) == 1 and "BENCH_GONE" in problems[0]
+
+    def test_docstring_mentions_do_not_match(self, tmp_path):
+        """A knob named in prose (docstring with other text) is not a
+        read; only exact-name literals count."""
+        repo, docs = self._repo(tmp_path, '''
+            """Mentions SIDECAR_TPU_PROSE_ONLY in passing."""
+            import os
+            os.environ.get("SIDECAR_TPU_DOCUMENTED")
+            ''')
+        assert check(repo, docs) == []
+
+    def test_doc_parser_reads_backticked_names(self):
+        assert documented_names(self.DOCS) == {"SIDECAR_TPU_DOCUMENTED"}

@@ -1,0 +1,40 @@
+"""The live-cluster churn soak (tools/node_churn_soak.py) as a
+``slow``-marked suite member, so membership/engine robustness is
+exercised by ``pytest -m slow`` instead of only by hand.
+
+The soak drives the REAL stack — native SWIM engine, catalog,
+discovery, broadcast loops — through random abrupt kills and
+fresh-incarnation rejoins, then audits that every alive node agrees on
+membership and sees every alive peer's services.  It runs as a
+subprocess (the script owns its node lifecycle and prints its verdict
+before teardown); the timeout leaves the documented headroom past the
+soak duration for listener drains."""
+
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SOAK = REPO / "tools" / "node_churn_soak.py"
+
+SEED = "7"
+DURATION_S = "25"
+
+
+@pytest.mark.slow
+def test_node_churn_soak_converges():
+    proc = subprocess.run(
+        [sys.executable, str(SOAK), SEED, DURATION_S],
+        capture_output=True, text=True,
+        # duration + join/settle (~16 s) + audit + teardown headroom
+        # (the script's docstring warns teardown can take a minute+).
+        timeout=float(DURATION_S) + 150.0)
+    tail = "\n".join(proc.stdout.splitlines()[-20:])
+    assert "SOAK PASS" in proc.stdout, (
+        f"soak verdict missing/failed (rc={proc.returncode}):\n"
+        f"{tail}\n--- stderr ---\n{proc.stderr[-2000:]}")
+    assert proc.returncode == 0, (
+        f"soak exited {proc.returncode} after PASS verdict "
+        f"(teardown failure?):\n{tail}")

@@ -1,0 +1,488 @@
+"""Suspicion & flap-damping subprotocol — the device-side contracts
+(ops/suspicion.py, ops/ttl.py, docs/chaos.md).
+
+Four surfaces:
+
+* the sweep/announce kernels against straight-line numpy oracles —
+  including a pin that ``suspicion_window=0`` compiles EXACTLY the
+  pre-suspicion sweep rule (the disabled path must stay bit-identical
+  to the pre-PR protocol);
+* full-round lockstep of ExactSim against the sequential
+  ``sim/oracle.py`` mirror WITH suspicion active, through the whole
+  quarantine lifecycle (expiry → SUSPECT → gossiped → refuted, and an
+  unrefutable dead owner → tombstone at original-ts+1 s);
+* dense↔sparse and single-chip↔sharded lockstep (both models, both
+  twins, d ∈ {1, 2, 4, 8} × every board_exchange mode) with the window
+  BOTH disabled and enabled, plus trace/delta stream equality through
+  chunked dispatch — the new status code must ride every execution
+  path bit-identically;
+* the flight recorder's robustness columns (suspects,
+  fp_tombstones) against numpy recomputation, including under a
+  config6-seeded chaos FaultPlan — the columns benchmarks/robustness.py
+  and the bench `robustness` block report.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams, clone_state
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import suspicion as suspicion_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops import trace as trace_ops
+from sidecar_tpu.ops.status import (
+    ALIVE,
+    DRAINING,
+    SUSPECT,
+    TOMBSTONE,
+    UNHEALTHY,
+    pack,
+    unpack_status,
+    unpack_ts,
+)
+from sidecar_tpu.ops.ttl import ttl_sweep
+from sidecar_tpu.sim.oracle import OracleSim
+from sidecar_tpu.parallel.mesh import make_mesh
+
+from tests.test_sharded import DetShardedSim, det_sample_peers
+from tests.test_sharded_compressed import (
+    DetShardedCompressedSim,
+    assert_states_equal,
+)
+
+DS = (1, 2, 4, 8)
+
+# Expiry-scale clocks: refresh 10 rounds, lifespan 15 rounds, sweep
+# every 2 rounds, push-pull every 5 — suspicion decisions happen INSIDE
+# short runs.  Window 2 s = 10 rounds of quarantine.
+TIGHT = TimeConfig(refresh_interval_s=2.0, alive_lifespan_s=3.0,
+                   sweep_interval_s=0.4, push_pull_interval_s=1.0,
+                   suspicion_window_s=2.0)
+TIGHT_OFF = dataclasses.replace(TIGHT, suspicion_window_s=0.0)
+
+
+def np_status(known):
+    known = np.asarray(known)
+    return np.where((known >> 3) > 0, known & 7, -1)
+
+
+# -- sweep / announce kernels ------------------------------------------------
+
+class TestTtlSweepSuspicion:
+    L, D, T, SEC = 3000, 6000, 100_000, 1000
+    KW = dict(alive_lifespan=L, draining_lifespan=D, tombstone_lifespan=T,
+              one_second=SEC)
+
+    def test_fresh_expiry_becomes_suspect_at_original_ts(self):
+        known = jnp.asarray([pack(100, ALIVE), pack(100, UNHEALTHY)])
+        swept, expired = ttl_sweep(known, 5000, suspicion_window=1000,
+                                   **self.KW)
+        np.testing.assert_array_equal(
+            np.asarray(swept),
+            [int(pack(100, SUSPECT)), int(pack(100, SUSPECT))])
+        assert not np.asarray(expired).any()  # nothing tombstoned
+
+    def test_unrefuted_suspect_tombstones_at_plus_one_second(self):
+        known = jnp.asarray([pack(100, SUSPECT)])
+        # Inside the window: held.
+        swept, expired = ttl_sweep(known, self.L + 100 + 999,
+                                   suspicion_window=1000, **self.KW)
+        assert int(swept[0]) == int(pack(100, SUSPECT))
+        assert not bool(expired[0])
+        # Window lapsed: tombstone, stamped ORIGINAL ts + 1 s.
+        swept, expired = ttl_sweep(known, self.L + 100 + 1001,
+                                   suspicion_window=1000, **self.KW)
+        assert int(swept[0]) == int(pack(100 + self.SEC, TOMBSTONE))
+        assert bool(expired[0])
+
+    def test_draining_never_enters_quarantine(self):
+        known = jnp.asarray([pack(100, DRAINING)])
+        swept, expired = ttl_sweep(known, self.D + 200,
+                                   suspicion_window=1000, **self.KW)
+        assert int(swept[0]) == int(pack(100 + self.SEC, TOMBSTONE))
+        assert bool(expired[0])
+
+    def test_fresh_records_and_gc_unchanged(self):
+        now = 2 * self.T
+        known = jnp.asarray([
+            pack(now - 10, ALIVE),         # fresh: untouched
+            pack(now - self.T - 1, TOMBSTONE),  # old tombstone: GC'd
+            0,                             # unknown: untouched
+        ])
+        swept, _ = ttl_sweep(known, now, suspicion_window=1000, **self.KW)
+        np.testing.assert_array_equal(
+            np.asarray(swept), [int(pack(now - 10, ALIVE)), 0, 0])
+
+    def test_packed_keys_never_regress_except_gc(self):
+        rng = np.random.default_rng(0)
+        ts = rng.integers(1, 50_000, size=512)
+        st = rng.integers(0, 6, size=512)
+        known = jnp.asarray((ts << 3 | st).astype(np.int32))
+        swept, _ = ttl_sweep(known, 40_000, suspicion_window=1500,
+                             **self.KW)
+        swept = np.asarray(swept)
+        kept = swept != 0
+        assert (swept[kept] >= np.asarray(known)[kept]).all()
+
+    def test_window_zero_is_the_pre_suspicion_rule(self):
+        """The disabled path must implement EXACTLY the pre-PR sweep:
+        pinned against an independent numpy replica of the old rule on
+        randomized states."""
+        rng = np.random.default_rng(1)
+        ts = rng.integers(0, 220_000, size=2048)
+        st = rng.integers(0, 5, size=2048)  # reference codes only
+        known_np = (ts << 3 | st).astype(np.int32)
+        for now in (5_000, 50_000, 150_000, 215_000):
+            swept, expired = ttl_sweep(jnp.asarray(known_np), now,
+                                       suspicion_window=0, **self.KW)
+            present = (known_np >> 3) > 0
+            is_tomb = present & (st == TOMBSTONE)
+            gc = is_tomb & (ts < now - self.T)
+            lifespan = np.where(st == DRAINING, self.D, self.L)
+            exp = present & ~is_tomb & (ts < now - lifespan)
+            want = np.where(exp, ((ts + self.SEC) << 3 | TOMBSTONE),
+                            known_np)
+            want = np.where(gc, 0, want).astype(np.int32)
+            np.testing.assert_array_equal(np.asarray(swept), want)
+            np.testing.assert_array_equal(np.asarray(expired), exp)
+
+
+class TestAnnounceRefute:
+    def test_disabled_is_identity(self):
+        due = jnp.asarray([True, False])
+        st = jnp.asarray([SUSPECT, SUSPECT])
+        present = jnp.asarray([True, True])
+        due2, st2 = suspicion_ops.announce_refute(due, st, present, False)
+        assert due2 is due and st2 is st
+
+    def test_suspect_own_record_refutes_immediately_as_alive(self):
+        due = jnp.asarray([False, False, False, True])
+        st = jnp.asarray([SUSPECT, SUSPECT, ALIVE, DRAINING])
+        present = jnp.asarray([True, False, True, True])
+        due2, st2 = suspicion_ops.announce_refute(due, st, present, True)
+        # Present suspect: due now, announced ALIVE.  Absent suspect
+        # (dead owner): untouched.  Others: untouched.
+        np.testing.assert_array_equal(np.asarray(due2),
+                                      [True, False, False, True])
+        np.testing.assert_array_equal(
+            np.asarray(st2), [ALIVE, SUSPECT, ALIVE, DRAINING])
+
+
+# -- full-round oracle lockstep ----------------------------------------------
+
+class TestOracleLockstep:
+    def _run(self, cfg, rounds, dead_at=None, n=12, spn=2):
+        params = SimParams(n=n, services_per_node=spn, fanout=2, budget=4)
+        sim = ExactSim(params, topology.complete(n), cfg)
+        state = sim.init_state()
+        orc = OracleSim(sim, state)
+        key = jax.random.PRNGKey(0)
+        statuses = set()
+        for r in range(rounds):
+            if dead_at is not None and r == dead_at:
+                alive = np.ones(n, bool)
+                alive[0] = False
+                state = dataclasses.replace(
+                    state, node_alive=jnp.asarray(alive))
+                orc.node_alive = alive.copy()
+            k = jax.random.fold_in(key, r)
+            state = sim.step(state, k)
+            orc.step(k)
+            np.testing.assert_array_equal(
+                np.asarray(state.known), orc.known,
+                err_msg=f"known diverged at round {r + 1}")
+            np.testing.assert_array_equal(
+                np.asarray(state.sent).astype(np.int32), orc.sent,
+                err_msg=f"sent diverged at round {r + 1}")
+            statuses.update(np_status(state.known)[
+                np_status(state.known) >= 0].tolist())
+        return statuses
+
+    def test_suspicion_on_with_refutation(self):
+        """All owners alive: expiries quarantine and every suspicion is
+        refuted — SUSPECT appears, TOMBSTONE never does."""
+        statuses = self._run(TIGHT, 70)
+        assert SUSPECT in statuses
+        assert TOMBSTONE not in statuses
+
+    def test_suspicion_on_dead_owner_tombstones(self):
+        """A dead owner cannot refute: its records walk the full
+        quarantine lifecycle to tombstone."""
+        statuses = self._run(TIGHT, 90, dead_at=10)
+        assert SUSPECT in statuses and TOMBSTONE in statuses
+
+    def test_window_zero_expiry_matches_pre_pr_oracle(self):
+        """Disabled subprotocol, expiry-heavy run with a dead owner:
+        the oracle's window-0 path is the untouched pre-PR sweep, so
+        this lockstep pins the model to the pre-PR round."""
+        statuses = self._run(TIGHT_OFF, 70, dead_at=10)
+        assert TOMBSTONE in statuses
+        assert SUSPECT not in statuses
+
+
+# -- dense ↔ sparse ----------------------------------------------------------
+
+class TestDenseSparseLockstep:
+    @pytest.mark.sparse
+    @pytest.mark.parametrize("cfg", [TIGHT, TIGHT_OFF],
+                             ids=["window-on", "window-off"])
+    def test_exact_dense_equals_sparse(self, cfg):
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        key = jax.random.PRNGKey(3)
+
+        def run(sparse):
+            sim = ExactSim(params, topology.complete(16), cfg,
+                           sparse="1" if sparse else "0")
+            state = sim.init_state()
+            alive = np.ones(16, bool)
+            alive[1] = False     # dead owner: full lifecycle runs
+            state = dataclasses.replace(state,
+                                        node_alive=jnp.asarray(alive))
+            return sim.run(state, key, 60, sparse=sparse)
+
+        fd, cd = run(False)
+        fs, cs = run(True)
+        np.testing.assert_array_equal(np.asarray(fd.known),
+                                      np.asarray(fs.known))
+        np.testing.assert_array_equal(np.asarray(fd.sent),
+                                      np.asarray(fs.sent))
+        np.testing.assert_array_equal(np.asarray(cd), np.asarray(cs))
+        if cfg.suspicion_window > 0:
+            assert SUSPECT in set(np_status(fd.known).ravel().tolist()) \
+                or TOMBSTONE in set(np_status(fd.known).ravel().tolist())
+
+    @pytest.mark.sparse
+    @pytest.mark.parametrize("cfg", [TIGHT, TIGHT_OFF],
+                             ids=["window-on", "window-off"])
+    def test_compressed_dense_equals_sparse(self, cfg):
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        key = jax.random.PRNGKey(4)
+
+        def run(sparse):
+            sim = CompressedSim(params, topology.complete(16), cfg,
+                                sparse="1" if sparse else "0")
+            state = sim.init_state()
+            alive = np.ones(16, bool)
+            alive[1] = False
+            state = dataclasses.replace(state,
+                                        node_alive=jnp.asarray(alive))
+            return sim.run(state, key, 60, sparse=sparse)
+
+        fd, cd = run(False)
+        fs, cs = run(True)
+        assert_states_equal(fd, fs, 60)
+        np.testing.assert_array_equal(np.asarray(cd), np.asarray(cs))
+        if cfg.suspicion_window > 0:
+            # The dead owner's records have walked the quarantine
+            # lifecycle by round 60: SUSPECT if still quarantined,
+            # TOMBSTONE once the window lapsed unrefuted.
+            seen = set(np_status(fd.floor).tolist() +
+                       np_status(fd.own).ravel().tolist())
+            assert SUSPECT in seen or TOMBSTONE in seen
+
+
+# -- single-chip ↔ sharded twins --------------------------------------------
+
+# Exact↔sharded lockstep requires the shared deterministic peer rule
+# and push-pull pinned out (the sharded twin's stride anti-entropy is a
+# DOCUMENTED divergence from partner sampling).  Refresh and the sweep
+# stay live — the suspicion lifecycle rides announce + sweep.
+SHARD_CFG = dataclasses.replace(TIGHT, push_pull_interval_s=1e6)
+
+
+class TestShardedExactLockstep:
+    @pytest.mark.parametrize("mode", ("all_gather", "ring"))
+    @pytest.mark.parametrize("d", DS)
+    def test_lockstep_with_suspicion(self, monkeypatch, d, mode):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        n = 16
+        params = SimParams(n=n, services_per_node=2, fanout=2, budget=4)
+        exact = ExactSim(params, topology.complete(n), SHARD_CFG)
+        sharded = DetShardedSim(params, topology.complete(n), SHARD_CFG,
+                                mesh=make_mesh(jax.devices()[:d]),
+                                board_exchange=mode)
+        se, ss = exact.init_state(), sharded.init_state()
+        alive = np.ones(n, bool)
+        alive[0] = False
+        se = dataclasses.replace(se, node_alive=jnp.asarray(alive))
+        ss = dataclasses.replace(ss, node_alive=jnp.asarray(alive))
+        saw = set()
+        for r in range(40):
+            key = jax.random.PRNGKey(r)  # ignored by det samplers
+            se = exact.step(se, key)
+            ss = sharded.step(ss, key)
+            np.testing.assert_array_equal(
+                np.asarray(se.known), np.asarray(ss.known),
+                err_msg=f"known diverged at round {r + 1} "
+                        f"(d={d}, {mode})")
+            np.testing.assert_array_equal(
+                np.asarray(se.sent), np.asarray(ss.sent),
+                err_msg=f"sent diverged at round {r + 1}")
+            saw.update(np_status(se.known)[
+                np_status(se.known) >= 0].tolist())
+        # The run must actually exercise the quarantine lifecycle.
+        assert SUSPECT in saw and TOMBSTONE in saw
+
+
+class TestShardedCompressedLockstep:
+    @pytest.mark.parametrize("mode", ("all_gather", "all_to_all", "ring"))
+    @pytest.mark.parametrize("d", DS)
+    def test_lockstep_with_suspicion(self, monkeypatch, d, mode):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        n = 16
+        params = CompressedParams(n=n, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        single = CompressedSim(params, topology.complete(n), TIGHT)
+        sharded = DetShardedCompressedSim(
+            params, topology.complete(n), TIGHT,
+            mesh=make_mesh(jax.devices()[:d]), board_exchange=mode)
+        ss, sh = single.init_state(), sharded.init_state()
+        alive = np.ones(n, bool)
+        alive[1] = False
+        ss = dataclasses.replace(ss, node_alive=jnp.asarray(alive))
+        sh = dataclasses.replace(sh, node_alive=jnp.asarray(alive))
+        for r in range(40):
+            key = jax.random.PRNGKey(r)  # stride draw shared via key
+            ss = single.step(ss, key)
+            sh = sharded.step(sh, key)
+            assert_states_equal(ss, sh, r + 1)
+        assert SUSPECT in set(np_status(ss.floor).tolist()) \
+            or TOMBSTONE in set(np_status(ss.floor).tolist())
+
+
+# -- trace / delta streams through chunked dispatch --------------------------
+
+class TestStreamsWithSuspicion:
+    @pytest.mark.parametrize("cfg", [TIGHT, TIGHT_OFF],
+                             ids=["window-on", "window-off"])
+    def test_exact_chunked_trace_and_deltas_equal_straight(self, cfg):
+        params = SimParams(n=12, services_per_node=2, fanout=2, budget=4)
+        sim = ExactSim(params, topology.complete(12), cfg)
+        key = jax.random.PRNGKey(5)
+
+        def dead_start(state):
+            alive = np.ones(12, bool)
+            alive[0] = False
+            return dataclasses.replace(state,
+                                       node_alive=jnp.asarray(alive))
+
+        base = dead_start(sim.init_state())
+
+        f1, tr1, c1 = sim.run_with_trace(clone_state(base), key, 40,
+                                         cap=40)
+        mid, tra, ca = sim.run_with_trace(clone_state(base), key, 20,
+                                          cap=40)
+        f2, trb, cb = sim.run_with_trace(mid, key, 20, cap=40,
+                                         start_round=20)
+        np.testing.assert_array_equal(np.asarray(f1.known),
+                                      np.asarray(f2.known))
+        np.testing.assert_array_equal(np.asarray(c1),
+                                      np.concatenate([ca, cb]))
+        recs = np.concatenate([np.asarray(tra.rec)[:20],
+                               np.asarray(trb.rec)[:20]])
+        np.testing.assert_array_equal(np.asarray(tr1.rec)[:40], recs)
+
+        f3, d1, c3 = sim.run_with_deltas(clone_state(base), key, 40,
+                                         cap=64)
+        np.testing.assert_array_equal(np.asarray(f1.known),
+                                      np.asarray(f3.known))
+        np.testing.assert_array_equal(np.asarray(c1), np.asarray(c3))
+
+    def test_compressed_trace_rides_suspicion(self):
+        params = CompressedParams(n=12, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        sim = CompressedSim(params, topology.complete(12), TIGHT)
+        state = sim.init_state()
+        alive = np.ones(12, bool)
+        alive[1] = False
+        state = dataclasses.replace(state, node_alive=jnp.asarray(alive))
+        final, tr = sim.run_with_trace(state, jax.random.PRNGKey(6), 40)
+        recs = np.asarray(tr.rec)
+        assert recs[:, trace_ops.TRACE_SUSPECTS].max() > 0
+        summary = trace_ops.summarize(tr)
+        assert summary["suspects_max"] > 0
+        assert "fp_tombstones_total" in summary
+
+
+# -- the robustness columns --------------------------------------------------
+
+class TestRobustnessColumns:
+    def _oracle_columns(self, prev, nxt):
+        """Numpy recomputation of suspects + fp_tombstones from a
+        consecutive state pair (exact family)."""
+        p_st = np_status(prev.known)
+        n_st = np_status(nxt.known)
+        alive = np.asarray(nxt.node_alive)
+        n, m = np.asarray(nxt.known).shape
+        owner = np.arange(m) // (m // n)
+        suspects = int((n_st == SUSPECT).sum())
+        entered = (n_st == TOMBSTONE) & (p_st != TOMBSTONE)
+        fp = int((entered & alive[owner][None, :]).sum())
+        return suspects, fp
+
+    def test_exact_trace_columns_match_numpy(self):
+        params = SimParams(n=12, services_per_node=2, fanout=2, budget=4)
+        sim = ExactSim(params, topology.complete(12), TIGHT)
+        state = sim.init_state()
+        alive = np.ones(12, bool)
+        alive[0] = False
+        state = dataclasses.replace(state, node_alive=jnp.asarray(alive))
+        key = jax.random.PRNGKey(7)
+        saw_fp = saw_suspect = False
+        for r in range(80):
+            prev = state
+            state = sim.step(state, jax.random.fold_in(key, r))
+            rec = np.asarray(trace_ops.exact_record(
+                prev, state, budget=4, fanout=2,
+                limit=params.resolved_retransmit_limit()))
+            suspects, fp = self._oracle_columns(prev, state)
+            assert rec[trace_ops.TRACE_SUSPECTS] == suspects
+            assert rec[trace_ops.TRACE_FP_TOMBSTONES] == fp
+            saw_suspect |= suspects > 0
+            saw_fp |= fp > 0
+        assert saw_suspect
+        # Node 0 is dead, so ITS records' tombstones are true positives;
+        # under loss-free all-alive-otherwise conditions no false
+        # positives occur — exactly the column's contract.
+        assert not saw_fp
+
+    def test_chaos_pause_produces_false_positives_and_suspicion_stops_them(
+            self):
+        """The headline mechanism end to end, tied to the flight
+        recorder: a config6-seeded FaultPlan pause (node healthy but
+        silent) makes bare TTL mint false-positive tombstones; the same
+        run with the window on quarantines instead (the
+        benchmarks/robustness.py measurement in miniature)."""
+        from sidecar_tpu.chaos import ChaosExactSim, FaultPlan, NodeFault
+
+        n = 12
+        params = SimParams(n=n, services_per_node=2, fanout=2, budget=4)
+        plan = FaultPlan(seed=6, nodes=(
+            NodeFault(nodes=(2, 3), start_round=10, end_round=35,
+                      kind="pause"),))
+
+        def fp_total(cfg):
+            sim = ChaosExactSim(params, topology.complete(n), cfg,
+                                plan=plan)
+            final, tr, _ = sim.run_with_trace(
+                sim.init_state(), jax.random.PRNGKey(8), 80, cap=80)
+            recs = np.asarray(tr.rec)
+            return (int(recs[:, trace_ops.TRACE_FP_TOMBSTONES].sum()),
+                    int(recs[:, trace_ops.TRACE_SUSPECTS].max()))
+
+        fp_off, sus_off = fp_total(TIGHT_OFF)
+        fp_on, sus_on = fp_total(
+            dataclasses.replace(TIGHT, suspicion_window_s=6.0))
+        assert sus_off == 0 and sus_on > 0
+        assert fp_off > 0, "pause must mint false positives with TTL only"
+        assert fp_on * 5 <= fp_off, (
+            f"suspicion must cut false positives >= 5x: "
+            f"off={fp_off}, on={fp_on}")

@@ -1,0 +1,111 @@
+#!/usr/bin/env python3
+"""Static check: every ``SIDECAR_TPU_*`` / ``BENCH_*`` env var the code
+reads is documented in ``docs/env.md``.
+
+The ``check_metric_docs.py`` pattern applied to the env-knob surface:
+the knob catalog only stays trustworthy if it is COMPLETE — an operator
+tuning a bench run or a sim toggle has to be able to look any name up,
+and the failure mode is silent (a new ``os.environ.get`` ships, nothing
+breaks, the name is simply absent from the doc forever).  Tier-1 runs
+this check (tests/test_env_docs.py) and fails the build instead.
+
+Mechanics: the scanned trees are AST-walked for STRING LITERALS that
+fully match ``(SIDECAR_TPU_|BENCH_)[A-Z0-9_]+`` — this catches both
+direct ``os.environ.get("SIDECAR_TPU_X")`` reads and the named-constant
+form (``SPARSE_ENV = "SIDECAR_TPU_SPARSE"``) the resolver modules use.
+Names that only appear in docstrings/comments never match (a docstring
+is one big constant that fails the fullmatch).  Every matched name must
+appear backticked in the doc; the doc may also list names the code no
+longer reads — flagged as stale so removals stay honest too.
+
+Live-node config (``SIDECAR_*`` etc.) is out of scope: that catalog is
+GENERATED from the config wiring (tools/gen_config_docs.py).
+
+Usage: ``python tools/check_env_docs.py [repo_root [docs_file]]`` —
+exits 0 when clean, 1 with a per-offender report otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+NAME_RE = re.compile(r"(SIDECAR_TPU_|BENCH_)[A-Z0-9_]+")
+
+# Trees (relative to the repo root) whose env reads the doc must cover.
+SCAN = ("sidecar_tpu", "benchmarks", "tools", "bench.py",
+        "__graft_entry__.py")
+
+
+def read_names(repo: pathlib.Path):
+    """Yield ``(path, lineno, name)`` for every matching string literal
+    under the scanned trees."""
+    for root in SCAN:
+        p = repo / root
+        files = [p] if p.suffix == ".py" else sorted(p.rglob("*.py"))
+        for f in files:
+            if "__pycache__" in f.parts or not f.exists():
+                continue
+            try:
+                tree = ast.parse(f.read_text())
+            except SyntaxError:  # pragma: no cover — broken file
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str) \
+                        and NAME_RE.fullmatch(node.value):
+                    yield f, node.lineno, node.value
+
+
+def documented_names(docs_text: str) -> set[str]:
+    """Names with a REAL catalog entry: the backticked token in the
+    FIRST column of a table row.  Prose mentions elsewhere (another
+    row's meaning column, a paragraph) deliberately do not count — a
+    knob name-dropped in passing is not documented, and a deleted row
+    must not stay 'covered' by a cross-reference."""
+    out = set()
+    for line in docs_text.splitlines():
+        m = re.match(r"\s*\|\s*`([^`\s]+)`", line)
+        if m and NAME_RE.fullmatch(m.group(1)):
+            out.add(m.group(1))
+    return out
+
+
+def check(repo: pathlib.Path, docs_file: pathlib.Path) -> list[str]:
+    """Violation strings (empty = doc and code agree)."""
+    docs = documented_names(docs_file.read_text())
+    problems = []
+    seen: set[str] = set()
+    for path, lineno, name in read_names(repo):
+        seen.add(name)
+        if name not in docs:
+            problems.append(
+                f"{path}:{lineno}: env var {name!r} is not documented "
+                f"in {docs_file.name}")
+    for stale in sorted(docs - seen):
+        problems.append(
+            f"{docs_file}: documents {stale!r} but nothing reads it — "
+            "remove the row or restore the knob")
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    here = pathlib.Path(__file__).resolve().parent.parent
+    repo = pathlib.Path(argv[1]) if len(argv) > 1 else here
+    docs = pathlib.Path(argv[2]) if len(argv) > 2 else \
+        repo / "docs" / "env.md"
+    problems = check(repo, docs)
+    for p in problems:
+        print(p, file=sys.stderr)
+    if problems:
+        print(f"{len(problems)} env-doc violation(s) — fix {docs}",
+              file=sys.stderr)
+        return 1
+    print(f"check_env_docs: OK ({repo} vs {docs})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
