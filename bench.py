@@ -608,6 +608,25 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# robustness bench failed: {exc}", file=sys.stderr)
 
+    # Scenario-fleet sweep (benchmarks/sweep.py, docs/sweep.md): the
+    # 64-point protocol grid in ONE vmapped dispatch vs the per-point
+    # trace+compile+dispatch status quo, with the per-scenario
+    # bit-identity oracle riding along.  BENCH_SWEEP=0 skips it;
+    # BENCH_SWEEP_NODES sizes the cluster; BENCH_SWEEP_SEQ caps how
+    # many sequential baseline points are compiled (the rest is
+    # extrapolated per point — sequential cost is per-config uniform).
+    sweep = None
+    if os.environ.get("BENCH_SWEEP", "1") != "0":
+        try:
+            from benchmarks.sweep import run_sweep_bench
+            _watchdog_note("sweep")
+            sweep = run_sweep_bench(
+                n=int(os.environ.get("BENCH_SWEEP_NODES", "32")),
+                seq_points=int(os.environ.get("BENCH_SWEEP_SEQ", "12")))
+            _watchdog_note("sweep", {"sweep": sweep})
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# sweep bench failed: {exc}", file=sys.stderr)
+
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.
     disarm_watchdog()
@@ -637,6 +656,7 @@ def main() -> None:
            if north_star_k1024 else {}),
         **({"query": query_bench} if query_bench else {}),
         **({"robustness": robustness} if robustness else {}),
+        **({"sweep": sweep} if sweep else {}),
         "telemetry": telemetry,
     }))
 

@@ -1,0 +1,155 @@
+"""Scenario-fleet sweep bench: one vmapped dispatch vs N classic ones.
+
+The claim under measurement (ROADMAP "vmap the whole simulator"): a
+protocol-configuration grid that used to cost one TRACE + COMPILE +
+DISPATCH per point — every grid point is a distinct static
+``SimParams``/``TimeConfig``, so jit can never reuse a program across
+points — becomes ONE of each through the fleet engine
+(``sidecar_tpu/fleet``), because the swept knobs are data
+(ops/knobs.py), not compile keys.
+
+Method (CPU-budget honest):
+
+* **batched** — one 64-point grid (push-pull × suspicion × loss ×
+  transmit-limit; fanout fixed — a compile-key axis — so the whole
+  grid is literally one ``ScenarioBatch``) through one fleet dispatch.
+  Reported end to end (trace+compile+run) AND warm (a second dispatch
+  on fresh states — the steady-state ``scenarios/sec/chip`` headline).
+* **sequential** — the status quo: each point builds its classic
+  ``ExactSim`` and runs the same horizon, paying its own trace+compile
+  (``BENCH_SWEEP_SEQ`` caps how many points are measured; the
+  remainder is extrapolated per-point — sequential cost is per-config
+  uniform — and the JSON says so).
+* **bit-identity** — every sequentially-run point's final state is
+  compared cell-for-cell against its fleet lane (the acceptance
+  oracle riding the measurement for free).
+
+Run standalone: ``python benchmarks/sweep.py [n]`` — prints the JSON
+block bench.py embeds (``BENCH_SWEEP=0`` skips it there), including a
+sample Pareto table of the grid.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import sys
+import time
+
+if __name__ == "__main__":  # standalone: resolve the repo root
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def sweep_axes() -> dict:
+    """The 64-point grid: 4 push-pull cadences × 2 suspicion windows ×
+    4 loss rates × 2 transmit limits — all data axes, one batch."""
+    return {
+        "push_pull_interval_s": [1.0, 2.0, 4.0, 8.0],
+        "suspicion_window_s": [0.0, 2.0],
+        "drop_prob": [0.0, 0.05, 0.1, 0.2],
+        "retransmit_limit": [0, 8],
+    }
+
+
+def run_sweep_bench(n: int = 32, spn: int = 4, rounds: int = 100,
+                    seq_points: int | None = None,
+                    seed: int = 0) -> dict:
+    import jax
+    import numpy as np
+
+    from sidecar_tpu.fleet import (
+        FleetSim,
+        ScenarioBatch,
+        expand_grid,
+    )
+    from sidecar_tpu.fleet.grid import pareto_front
+    from sidecar_tpu.models.exact import ExactSim, SimParams
+    from sidecar_tpu.models.timecfg import TimeConfig
+    from sidecar_tpu.ops import topology as topo_mod
+
+    specs = expand_grid(sweep_axes(), base={"seed": seed})
+    s = len(specs)
+    params = SimParams(n=n, services_per_node=spn, fanout=3, budget=15)
+    cfg = TimeConfig(refresh_interval_s=10_000.0)
+    batch = ScenarioBatch.build(specs, params, cfg, family="exact")
+    topo = topo_mod.complete(n)
+
+    # -- batched: end-to-end (trace+compile+run), then warm ---------------
+    fleet = FleetSim(batch, topo=topo)
+    t0 = time.perf_counter()
+    run_cold = fleet.run(fleet.init_states(), rounds, eps=0.01,
+                         stop=False)
+    batched_total = time.perf_counter() - t0
+    run_warm = fleet.run(fleet.init_states(), rounds, eps=0.01,
+                         stop=False)
+    batched_warm = run_warm.wall_seconds
+
+    # -- sequential status quo: per-point trace+compile+dispatch ----------
+    if seq_points is None:
+        seq_points = int(os.environ.get("BENCH_SWEEP_SEQ", str(s)))
+    seq_points = max(1, min(s, seq_points))
+    seq_wall = 0.0
+    mismatches = []
+    for i in range(seq_points):
+        p_i = batch.scenario_params(i)
+        t_i = batch.scenario_timecfg(i)
+        t1 = time.perf_counter()
+        sim = ExactSim(p_i, topo, t_i)
+        st = sim.init_state()
+        final, _conv = sim.run(st, jax.random.PRNGKey(specs[i].seed),
+                               rounds)
+        jax.block_until_ready(final.known)
+        seq_wall += time.perf_counter() - t1
+        for name in ("known", "sent", "node_alive", "round_idx"):
+            a = np.asarray(getattr(run_warm.final_states, name))[i]
+            b = np.asarray(getattr(final, name))
+            if not np.array_equal(a, b):
+                mismatches.append(f"{specs[i].name}:{name}")
+    seq_total = seq_wall * (s / seq_points)
+
+    ratio = seq_total / batched_total if batched_total > 0 else None
+    ratio_warm = (seq_total / batched_warm
+                  if batched_warm > 0 else None)
+
+    table = run_warm.table(cfg.round_ticks, cfg.ticks_per_second)
+    for j, spec in enumerate(specs):
+        table[j]["config"] = spec.axes()
+    front = pareto_front(table)
+
+    return {
+        "points": s,
+        "n": n,
+        "services_per_node": spn,
+        "rounds": rounds,
+        "scenarios_per_sec_chip": round(s / batched_warm, 2)
+        if batched_warm > 0 else None,
+        "batched_total_s": round(batched_total, 3),
+        "batched_warm_s": round(batched_warm, 3),
+        "sequential_total_s": round(seq_total, 3),
+        "sequential_points_measured": seq_points,
+        "sequential_extrapolated": seq_points < s,
+        "ratio_vs_sequential": round(ratio, 2) if ratio else None,
+        "ratio_vs_sequential_warm_batched": round(ratio_warm, 2)
+        if ratio_warm else None,
+        "bit_identical_points": seq_points - len(
+            {m.split(":")[0] for m in mismatches}),
+        "mismatches": mismatches[:8],
+        "pareto_front": front,
+        "pareto_table": [table[i] for i in front],
+    }
+
+
+def main() -> int:
+    # The environment's sitecustomize pins jax to the default platform
+    # at interpreter start; re-assert an explicit JAX_PLATFORMS choice.
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    print(json.dumps(run_sweep_bench(n=n), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -498,6 +498,100 @@ class SimBridge:
                 "flaps": replay.flaps,
                 "delta_overflow_rounds": overflow_rounds}
 
+    # -- the capacity-planning sweep (docs/sweep.md) -----------------------
+
+    def sweep(self, axes: dict, *, rounds: int = 200, eps: float = 0.01,
+              n: Optional[int] = None, services_per_node: int = 4,
+              fanout: int = 3, budget: int = 15, seed: int = 0,
+              conv_every: int = 1, stop: bool = True,
+              base: Optional[dict] = None,
+              max_batch: Optional[int] = None) -> dict:
+        """Evaluate a protocol-configuration grid in batched fleet
+        dispatches (sidecar_tpu/fleet) and return the Pareto table.
+
+        ``axes`` is the grid spec (axis name → value list,
+        ``fleet/grid.KNOWN_AXES``); ``base`` fixes spec fields every
+        point shares.  Scenarios are synthetic cold-start clusters of
+        ``n`` nodes (default: the live catalog's node count, so the
+        sweep plans capacity for THIS cluster's shape) on the exact
+        model.  Compile-key axes (fanout, budget) group into separate
+        batches; data axes vary within one compiled scan.  Each row
+        reports rounds/seconds-to-ε and the analytic exchange bytes
+        spent getting there (early exit freezes both at the crossing);
+        ``pareto_front`` lists the non-dominated configs on
+        (rounds_to_eps, exchange_bytes)."""
+        from sidecar_tpu.fleet import FleetSim, expand_grid
+        from sidecar_tpu.fleet.grid import pareto_front
+
+        if n is None:
+            with self.state._lock:
+                n = len(self.state.servers)
+            n = max(n, 8)
+        if rounds < 1:
+            raise ValueError(f"rounds={rounds} must be >= 1")
+        if conv_every < 1 or rounds % conv_every:
+            raise ValueError(
+                f"rounds={rounds} must be a positive multiple of "
+                f"conv_every={conv_every}")
+        base = dict(base or {})
+        base.setdefault("seed", seed)
+        # Library-only axes get a NAMED rejection here rather than the
+        # batch builder's family/plan error: the HTTP surface has no
+        # way to supply a FaultPlan structure or select the compressed
+        # family (docs/sweep.md).
+        wire_only = {"fault_seed", "mint_frac"} & (set(axes) | set(base))
+        if wire_only:
+            raise ValueError(
+                f"axis(es) {sorted(wire_only)} are library-only: "
+                "fault_seed needs a shared FaultPlan structure and "
+                "mint_frac the compressed family — build a "
+                "ScenarioBatch directly (sidecar_tpu/fleet, "
+                "docs/sweep.md); POST /sweep runs the plain exact "
+                "family")
+        specs = expand_grid(axes, base)
+        params = SimParams(n=int(n),
+                           services_per_node=int(services_per_node),
+                           fanout=int(fanout), budget=int(budget))
+        # Cold-start study clock: refresh pinned out so rounds-to-ε
+        # measures pure epidemic spread (the sim/scenarios convention).
+        cfg = dataclasses.replace(self.t, refresh_interval_s=10_000.0)
+
+        t_req = time.perf_counter()
+        table: list = [None] * len(specs)
+        batches = 0
+        for batch, idxs in self._build_sweep_batches(
+                specs, params, cfg, max_batch):
+            fleet = FleetSim(batch)
+            run = fleet.run(fleet.init_states(), rounds,
+                            conv_every=conv_every, eps=eps, stop=stop)
+            rows = run.table(cfg.round_ticks, cfg.ticks_per_second)
+            for j, src_idx in enumerate(idxs):
+                rows[j]["config"] = batch.specs[j].axes()
+                table[src_idx] = rows[j]
+            batches += 1
+        wall = time.perf_counter() - t_req
+        metrics.histogram_since("bridge.sweep", t_req)
+        return {
+            "points": len(specs),
+            "batches": batches,
+            "n": int(n),
+            "services_per_node": int(services_per_node),
+            "rounds": rounds,
+            "eps": eps,
+            "stop": bool(stop),
+            "wall_seconds": round(wall, 3),
+            "scenarios_per_sec": round(len(specs) / wall, 2)
+            if wall > 0 else None,
+            "table": table,
+            "pareto_front": pareto_front(table),
+        }
+
+    @staticmethod
+    def _build_sweep_batches(specs, params, cfg, max_batch):
+        from sidecar_tpu.fleet import build_batches
+        return build_batches(specs, params, cfg, family="exact",
+                             max_batch=max_batch)
+
     @staticmethod
     def _map_deltas(batches, mapping: BridgeMapping, params: SimParams,
                     rounds: int, start_round: int = 0) -> list:
@@ -549,7 +643,18 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
     "protocol": {"suspicion_window_s": S, "damping_half_life_s": H,
     "damping_threshold": T, ...} — the suspicion/flap-damping knob
     bundle (ops/suspicion.ProtocolParams); the report's ``robustness``
-    block carries the damping prediction (docs/chaos.md)}."""
+    block carries the damping prediction (docs/chaos.md)}.
+
+    POST /sweep {"axes": {axis: [values...]}, "rounds": N, "eps": E,
+    "n": nodes, "services_per_node": S, "fanout": F, "budget": B,
+    "base": {fixed spec fields}, "conv_every": K, "stop": bool,
+    "seed": S} — the batched capacity-planning sweep
+    (sidecar_tpu/fleet, docs/sweep.md): the grid is expanded, chunked
+    into vmapped fleet dispatches, and answered with a per-config
+    Pareto table (rounds/seconds-to-ε, analytic exchange bytes,
+    ``pareto_front`` indices).  Malformed grids (unknown axis names,
+    out-of-range knobs, duplicate names) return 400 with a parseable
+    ``{"message": ...}`` body."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -563,8 +668,50 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
             self.end_headers()
             self.wfile.write(body)
 
+        def _do_simulate(self, req: dict) -> dict:
+            sparse_req = req.get("sparse")
+            report = bridge.simulate(
+                rounds=int(req.get("rounds", 50)),
+                seed=int(req.get("seed", 0)),
+                cold_nodes=req.get("cold_nodes"),
+                eps=float(req.get("eps", 0.01)),
+                deltas_cap=int(req.get("deltas_cap", 0)),
+                sharded=bool(req.get("sharded", False)),
+                board_exchange=req.get("board_exchange"),
+                sparse=(None if sparse_req is None
+                        else bool(sparse_req)),
+                trace=int(req.get("trace", 0)),
+                protocol=req.get("protocol"))
+            return report.to_json()
+
+        def _do_sweep(self, req: dict) -> dict:
+            axes = req.get("axes")
+            if not isinstance(axes, dict) or not axes:
+                raise ValueError(
+                    "sweep request needs a non-empty 'axes' object "
+                    "(axis name -> list of values)")
+            base = req.get("base")
+            if base is not None and not isinstance(base, dict):
+                raise ValueError("'base' must be an object")
+            n = req.get("n")
+            return bridge.sweep(
+                axes,
+                rounds=int(req.get("rounds", 200)),
+                eps=float(req.get("eps", 0.01)),
+                n=None if n is None else int(n),
+                services_per_node=int(req.get("services_per_node", 4)),
+                fanout=int(req.get("fanout", 3)),
+                budget=int(req.get("budget", 15)),
+                seed=int(req.get("seed", 0)),
+                conv_every=int(req.get("conv_every", 1)),
+                stop=bool(req.get("stop", True)),
+                base=base)
+
         def do_POST(self):
-            if self.path.split("?")[0] != "/simulate":
+            route = self.path.split("?")[0]
+            handlers = {"/simulate": self._do_simulate,
+                        "/sweep": self._do_sweep}
+            if route not in handlers:
                 self._reply(404, {"message": "not found"})
                 return
             try:
@@ -572,24 +719,12 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                 req = json.loads(self.rfile.read(length) or b"{}")
                 if not isinstance(req, dict):
                     raise ValueError("request body: not an object")
-                sparse_req = req.get("sparse")
-                report = bridge.simulate(
-                    rounds=int(req.get("rounds", 50)),
-                    seed=int(req.get("seed", 0)),
-                    cold_nodes=req.get("cold_nodes"),
-                    eps=float(req.get("eps", 0.01)),
-                    deltas_cap=int(req.get("deltas_cap", 0)),
-                    sharded=bool(req.get("sharded", False)),
-                    board_exchange=req.get("board_exchange"),
-                    sparse=(None if sparse_req is None
-                            else bool(sparse_req)),
-                    trace=int(req.get("trace", 0)),
-                    protocol=req.get("protocol"))
+                doc = handlers[route](req)
             except (ValueError, KeyError, TypeError,
                     json.JSONDecodeError) as exc:
                 self._reply(400, {"message": str(exc)})
                 return
-            self._reply(200, report.to_json())
+            self._reply(200, doc)
 
     server = ThreadingHTTPServer((bind, port), Handler)
     if background:

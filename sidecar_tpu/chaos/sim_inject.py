@@ -121,26 +121,31 @@ class CompiledFaultPlan:
 
     # -- per-round fault evaluation (traced) -------------------------------
 
-    def _fault_key(self, round_idx):
-        """All fault randomness roots here: plan seed + round — NEVER the
-        driver's key, so the schedule is a pure function of the plan."""
-        return jax.random.fold_in(
-            jax.random.PRNGKey(self.plan.seed), round_idx)
+    def _fault_key(self, round_idx, seed=None):
+        """All fault randomness roots here: fault seed + round — NEVER
+        the driver's key, so the schedule is a pure function of the
+        plan.  ``seed`` overrides ``plan.seed`` (may be TRACED — the
+        fleet's per-scenario FaultPlan-seed knob, ops/knobs.py); the
+        default compiles the plan's own seed as before."""
+        if seed is None:
+            seed = self.plan.seed
+        return jax.random.fold_in(jax.random.PRNGKey(seed), round_idx)
 
     @staticmethod
     def _active(e, round_idx):
         return (round_idx >= e.start_round) & (round_idx < e.end_round)
 
-    def edge_masks(self, dst, round_idx):
+    def edge_masks(self, dst, round_idx, fault_seed=None):
         """Evaluate edge faults against this round's sampled targets.
 
         Returns (keep, diverts): ``keep`` is bool [N, F] (False = packet
         dropped) or None when the plan has no drop entries; ``diverts``
         is a list of (ring_idx, delay_sel, dup_sel) with bool [N, F]
         masks (either may be None).  Deterministic given (plan, dst,
-        round_idx)."""
+        round_idx); ``fault_seed`` re-roots the draws for a fleet
+        scenario (same schedule when it equals ``plan.seed``)."""
         n, fanout = dst.shape
-        kbase = self._fault_key(round_idx)
+        kbase = self._fault_key(round_idx, fault_seed)
 
         drop_p = None
         for src_m, dst_m, e, _ in self.edge_entries:
@@ -232,6 +237,11 @@ class ChaosExactSim(ExactSim):
         super().__init__(params, topo, timecfg, perturb=perturb,
                          cut_mask=cut_mask)
         self.plan = plan
+        # Re-root the static knob bundle with the plan's fault seed so
+        # the knobbed round (ops/knobs.py) reproduces the plan schedule
+        # bit for bit; the fleet overrides the seed per scenario.
+        self._knobs = dataclasses.replace(self._knobs,
+                                          fault_seed=plan.seed)
         self._prog = CompiledFaultPlan(plan, params.n)
         # owner_row[i, m] — slot m belongs to node i (the crash-restart
         # wipe's "keep only my own records" mask).
@@ -262,9 +272,11 @@ class ChaosExactSim(ExactSim):
 
     # -- the chaos round ---------------------------------------------------
 
-    def _step(self, cst: ChaosSimState, key: jax.Array) -> ChaosSimState:
+    def _step(self, cst: ChaosSimState, key: jax.Array,
+              kn=None) -> ChaosSimState:
         p, t, prog = self.p, self.t, self._prog
-        limit = p.resolved_retransmit_limit()
+        kn = self._knobs if kn is None else kn
+        limit = kn.limit
         state = cst.sim
         round_idx = state.round_idx + 1
         now = round_idx * t.round_ticks
@@ -293,7 +305,12 @@ class ChaosExactSim(ExactSim):
                                     node_alive=alive)
 
         if self.perturb is not None:
-            state = self.perturb(state, k_perturb, now)
+            # Knob-aware hooks (the fleet's per-scenario churn) opt in
+            # via ``wants_knobs`` — same dispatch as ExactSim._step.
+            if getattr(self.perturb, "wants_knobs", False):
+                state = self.perturb(state, k_perturb, now, kn)
+            else:
+                state = self.perturb(state, k_perturb, now)
         known, sent = state.known, state.sent
 
         # 1. select + gossip deliveries, fault-gated.
@@ -305,7 +322,8 @@ class ChaosExactSim(ExactSim):
         sent = gossip_ops.record_transmissions(sent, svc_idx, msg,
                                                p.fanout, limit)
 
-        keep, diverts = prog.edge_masks(dst, round_idx)
+        keep, diverts = prog.edge_masks(dst, round_idx,
+                                        fault_seed=kn.fault_seed)
         n, fanout = dst.shape
         budget = svc_idx.shape[1]
         nonempty = jnp.broadcast_to(jnp.any(msg > 0, axis=1)[:, None],
@@ -319,10 +337,17 @@ class ChaosExactSim(ExactSim):
             drops = drops + count(~keep)
 
         # Raw triples: every gate applied (incl. fault drops), stickiness
-        # deferred to arrival.
+        # deferred to arrival.  The uniform-loss keep mask is drawn
+        # here (same key/prob/shape as the in-call draw — bit-identical)
+        # so a traced per-scenario keep_prob works; static keep_prob 1
+        # compiles no draw, as before.
+        record_keep = None
+        if kn.needs_drop_draw:
+            record_keep = jax.random.bernoulli(
+                k_drop, kn.keep_prob, (n, fanout, budget))
         rows, cols, vals = gossip_ops.expand_deliveries(
-            dst, svc_idx, msg, now_tick=now, stale_ticks=t.stale_ticks,
-            node_alive=alive, drop_prob=p.drop_prob, drop_key=k_drop,
+            dst, svc_idx, msg, now_tick=now, stale_ticks=kn.stale_ticks,
+            node_alive=alive, record_keep=record_keep,
             edge_keep=keep)
 
         def flat(mask):
@@ -357,7 +382,7 @@ class ChaosExactSim(ExactSim):
             # receiver liveness are re-evaluated against *now* (the
             # pre-round stickiness resolution happens with the combined
             # batch below).
-            m_vals = jnp.where(staleness_mask(m_vals, now, t.stale_ticks),
+            m_vals = jnp.where(staleness_mask(m_vals, now, kn.stale_ticks),
                                0, m_vals)
             ok = (m_rows < p.n) & alive[jnp.minimum(m_rows, p.n - 1)]
             m_vals = jnp.where(ok, m_vals, 0)
@@ -381,7 +406,7 @@ class ChaosExactSim(ExactSim):
 
         # 2. announce re-stamps, folded into the same scatter.
         a_rows, a_cols, a_vals, a_due = self._announce_updates(
-            known, alive, round_idx, now)
+            known, alive, round_idx, now, kn=kn)
         rows = jnp.concatenate([rows, a_rows])
         cols = jnp.concatenate([cols, a_cols])
         vals = jnp.concatenate([d_vals, a_vals])
@@ -399,33 +424,33 @@ class ChaosExactSim(ExactSim):
                 sever, jnp.arange(p.n, dtype=jnp.int32), pp_partner)
 
         def do_push_pull(kn_se):
-            kn, se = kn_se
+            kn_, se = kn_se
             merged = gossip_ops.push_pull(
-                kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
-                node_alive=alive)
-            se = jnp.where(merged != kn, jnp.int8(0), se)
+                kn_, pp_partner, now_tick=now,
+                stale_ticks=kn.stale_ticks, node_alive=alive)
+            se = jnp.where(merged != kn_, jnp.int8(0), se)
             return merged, se
 
         known, sent = lax.cond(
-            round_idx % t.push_pull_rounds == 0,
+            round_idx % kn.push_pull_rounds == 0,
             do_push_pull, lambda kn_se: kn_se, (known, sent))
 
         # 4. lifespan sweep.
         def do_sweep(kn_se):
             from sidecar_tpu.ops.ttl import ttl_sweep
-            kn, se = kn_se
+            kn_, se = kn_se
             swept, _ = ttl_sweep(
-                kn, now,
-                alive_lifespan=t.alive_lifespan,
-                draining_lifespan=t.draining_lifespan,
-                tombstone_lifespan=t.tombstone_lifespan,
+                kn_, now,
+                alive_lifespan=kn.alive_lifespan,
+                draining_lifespan=kn.draining_lifespan,
+                tombstone_lifespan=kn.tombstone_lifespan,
                 one_second=t.one_second,
-                suspicion_window=t.suspicion_window)
-            se = jnp.where(swept != kn, jnp.int8(0), se)
+                suspicion_window=kn.suspicion_window)
+            se = jnp.where(swept != kn_, jnp.int8(0), se)
             return swept, se
 
         known, sent = lax.cond(
-            round_idx % t.sweep_rounds == 0,
+            round_idx % kn.sweep_rounds == 0,
             do_sweep, lambda kn_se: kn_se, (known, sent))
 
         return ChaosSimState(

@@ -1,0 +1,24 @@
+"""Batched scenario-fleet engine: S independent scenarios in ONE
+compiled scan (docs/sweep.md).
+
+* :mod:`batch`  — ``ScenarioSpec`` / ``ScenarioBatch``: per-scenario
+  protocol knobs validated and stacked into a vmappable pytree.
+* :mod:`engine` — ``FleetSim``: the vmapped round drivers on the exact
+  (plain + FaultPlan) and compressed families, with converged-mask
+  early exit and per-scenario convergence curves + trace summaries.
+* :mod:`grid`   — axis-spec expansion into ``ScenarioBatch``es (grids
+  larger than one batch are chunked; compile-key axes group), and the
+  Pareto-front helper behind ``POST /sweep``.
+"""
+
+from sidecar_tpu.fleet.batch import (  # noqa: F401
+    ScenarioBatch,
+    ScenarioSpec,
+    restart_churn_perturb,
+)
+from sidecar_tpu.fleet.engine import FleetRun, FleetSim  # noqa: F401
+from sidecar_tpu.fleet.grid import (  # noqa: F401
+    build_batches,
+    expand_grid,
+    pareto_front,
+)

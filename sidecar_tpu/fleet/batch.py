@@ -1,0 +1,306 @@
+"""ScenarioBatch: stacked per-scenario protocol knobs for the fleet.
+
+A :class:`ScenarioSpec` is ONE scenario's configuration in host terms
+(seconds, probabilities, seeds).  :meth:`ScenarioBatch.build` validates
+S of them against a shared compile-key base (``SimParams`` /
+``CompressedParams`` + ``TimeConfig`` + optional ``FaultPlan``
+structure) and stacks the data axes into a ``[S]``-leaved
+:class:`~sidecar_tpu.ops.knobs.RoundKnobs` pytree plus per-scenario
+PRNG keys — the input the vmapped drivers (``fleet/engine.py``)
+consume.
+
+The compile-key / data-axis split (ops/knobs.py): ``fanout``,
+``budget``, ``n``, ``services_per_node``, ``cache_lines`` and the
+FaultPlan *structure* shape the program and must be batch-uniform —
+a spec that disagrees is rejected HERE with a named error
+(``sim/scenarios.validate_protocol_config``), not 400 rounds into a
+compiled scan as a shape failure.  Everything else — transmit limit,
+loss, cadences, suspicion window, lifespans, churn, fault seed — is
+data and varies freely within a batch.
+
+Bit-identity contract (tests/test_fleet.py): scenario *i* of a batch
+run is bit-identical to an unbatched run of the matching classic sim —
+``scenario_params(i)`` / ``scenario_timecfg(i)`` build exactly that
+sim's config, and :func:`restart_churn_perturb` with a static ``prob``
+is the unbatched twin of the fleet's knob-driven churn hook.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops.knobs import RoundKnobs
+from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, pack, unpack_status, unpack_ts
+from sidecar_tpu.sim.scenarios import validate_protocol_config
+
+# TimeConfig fields a spec may override per scenario (all data axes:
+# they resolve to tick/round scalars the knobbed round consumes).
+_TIMECFG_FIELDS = (
+    "push_pull_interval_s", "sweep_interval_s", "refresh_interval_s",
+    "suspicion_window_s", "alive_lifespan_s", "draining_lifespan_s",
+    "tombstone_lifespan_s",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScenarioSpec:
+    """One scenario of a fleet batch, in host units.
+
+    ``None`` means "inherit the batch base".  ``fanout``/``budget`` may
+    be stated for self-documentation but MUST match the batch's static
+    params (compile-key axes — ``fleet/grid.py`` groups grid points by
+    them so a mixed grid still sweeps them, across batches)."""
+
+    name: str
+    seed: int = 0
+    retransmit_limit: Optional[int] = None   # None/0 = params rule
+    drop_prob: Optional[float] = None
+    churn_prob: float = 0.0          # exact family: per-round restart churn
+    fault_seed: Optional[int] = None  # chaos: per-scenario FaultPlan seed
+    fanout: Optional[int] = None     # compile-key; must match the batch
+    budget: Optional[int] = None     # compile-key; must match the batch
+    mint_frac: float = 0.0           # compressed: initial churn burst
+    mint_tick: int = 10
+    push_pull_interval_s: Optional[float] = None
+    sweep_interval_s: Optional[float] = None
+    refresh_interval_s: Optional[float] = None
+    suspicion_window_s: Optional[float] = None
+    alive_lifespan_s: Optional[float] = None
+    draining_lifespan_s: Optional[float] = None
+    tombstone_lifespan_s: Optional[float] = None
+
+    def axes(self) -> dict:
+        """The non-default knobs, for report/Pareto tables."""
+        out: dict = {}
+        for f in dataclasses.fields(self):
+            if f.name in ("name",):
+                continue
+            v = getattr(self, f.name)
+            d = f.default
+            if v is not None and v != d:
+                out[f.name] = v
+        return out
+
+
+@dataclasses.dataclass
+class ScenarioBatch:
+    """S validated scenarios stacked for one vmapped dispatch."""
+
+    family: str                      # "exact" | "compressed"
+    params: Any                      # SimParams | CompressedParams (base)
+    timecfg: TimeConfig              # batch base clock
+    specs: tuple                     # [S] ScenarioSpec
+    knobs: RoundKnobs                # [S]-stacked data axes
+    keys: jax.Array                  # [S] per-scenario PRNG keys
+    plan: Any = None                 # shared FaultPlan structure, or None
+
+    @property
+    def size(self) -> int:
+        return len(self.specs)
+
+    @property
+    def has_churn(self) -> bool:
+        return any(s.churn_prob > 0 for s in self.specs)
+
+    # -- per-scenario classic configs (the unbatched twins) ---------------
+
+    def scenario_params(self, i: int):
+        """The classic static params of scenario ``i`` — the unbatched
+        sim the lockstep oracle (and the sequential sweep baseline)
+        runs."""
+        s = self.specs[i]
+        kw = {}
+        if s.retransmit_limit is not None:
+            kw["retransmit_limit"] = s.retransmit_limit
+        if s.drop_prob is not None:
+            kw["drop_prob"] = s.drop_prob
+        return dataclasses.replace(self.params, **kw)
+
+    def scenario_timecfg(self, i: int) -> TimeConfig:
+        s = self.specs[i]
+        kw = {f: getattr(s, f) for f in _TIMECFG_FIELDS
+              if getattr(s, f) is not None}
+        return dataclasses.replace(self.timecfg, **kw)
+
+    def scenario_plan(self, i: int):
+        """Scenario ``i``'s FaultPlan: the shared structure re-seeded
+        with its fault seed."""
+        if self.plan is None:
+            return None
+        s = self.specs[i]
+        if s.fault_seed is None:
+            return self.plan
+        return dataclasses.replace(self.plan, seed=s.fault_seed)
+
+    def mint_slots(self, i: int) -> Optional[np.ndarray]:
+        """Compressed family: scenario ``i``'s initial churn-burst slot
+        list (None when the spec mints nothing) — deterministic from
+        the scenario seed, the ``sim/scenarios._mint_churn`` recipe."""
+        s = self.specs[i]
+        if s.mint_frac <= 0:
+            return None
+        m = self.params.m
+        count = max(1, int(m * s.mint_frac))
+        rng = np.random.default_rng(s.seed)
+        return np.sort(rng.choice(m, size=count,
+                                  replace=False)).astype(np.int32)
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def build(cls, specs, params, timecfg: TimeConfig = TimeConfig(),
+              *, family: str = "exact", plan=None) -> "ScenarioBatch":
+        """Validate ``specs`` against the batch statics and stack the
+        knobs.  Raises ``ValueError`` naming the offending scenario and
+        knob — the registration-time guard the ROADMAP asks for."""
+        specs = tuple(specs)
+        if not specs:
+            raise ValueError("a ScenarioBatch needs at least 1 scenario")
+        if family not in ("exact", "compressed"):
+            raise ValueError(
+                f"family must be 'exact' or 'compressed', got {family!r}")
+        if plan is not None and family != "exact":
+            raise ValueError(
+                "FaultPlan scenarios run on the exact family only "
+                "(the chaos plane, sidecar_tpu/chaos/)")
+
+        seen: set = set()
+        for s in specs:
+            if s.name in seen:
+                raise ValueError(
+                    f"duplicate scenario name {s.name!r} in batch (two "
+                    "scenarios silently shadowing each other would make "
+                    "the sweep report the wrong config's numbers)")
+            seen.add(s.name)
+            # Compile-key axes must match the batch statics.
+            if s.fanout is not None and s.fanout != params.fanout:
+                raise ValueError(
+                    f"{s.name}: fanout={s.fanout} is a compile-key axis "
+                    f"and must equal the batch's fanout={params.fanout} "
+                    "(it shapes the sampled-peer tensor; sweep it "
+                    "ACROSS batches — fleet/grid.py groups by it)")
+            if s.budget is not None and s.budget != params.budget:
+                raise ValueError(
+                    f"{s.name}: budget={s.budget} is a compile-key axis "
+                    f"and must equal the batch's budget={params.budget}")
+            validate_protocol_config(
+                params.n, fanout=params.fanout, budget=params.budget,
+                retransmit_limit=s.retransmit_limit or 0,
+                services_per_node=params.services_per_node, name=s.name)
+            for knob in ("drop_prob", "churn_prob", "mint_frac"):
+                v = getattr(s, knob)
+                if v is not None and not 0.0 <= v <= 1.0:
+                    raise ValueError(
+                        f"{s.name}: {knob}={v} not in [0, 1]")
+            for f in _TIMECFG_FIELDS:
+                v = getattr(s, f)
+                if v is not None and v < 0:
+                    raise ValueError(f"{s.name}: {f}={v} must be >= 0")
+            if s.fault_seed is not None and plan is None:
+                raise ValueError(
+                    f"{s.name}: fault_seed={s.fault_seed} needs a "
+                    "batch FaultPlan (the seed re-roots the shared "
+                    "plan structure)")
+            if family == "compressed" and s.churn_prob > 0:
+                raise ValueError(
+                    f"{s.name}: churn_prob is the exact family's "
+                    "restart-churn hook; compressed scenarios churn "
+                    "via mint_frac (the initial burst)")
+            if family == "exact" and s.mint_frac > 0:
+                raise ValueError(
+                    f"{s.name}: mint_frac is the compressed family's "
+                    "churn burst; exact scenarios churn via churn_prob")
+
+        def stack(fn, dtype):
+            return jnp.asarray(np.array([fn(i) for i in
+                                         range(len(specs))]), dtype)
+
+        def p_of(i):
+            return dataclasses.replace(
+                params,
+                **({"retransmit_limit": specs[i].retransmit_limit}
+                   if specs[i].retransmit_limit is not None else {}))
+
+        def t_of(i):
+            s = specs[i]
+            kw = {f: getattr(s, f) for f in _TIMECFG_FIELDS
+                  if getattr(s, f) is not None}
+            return dataclasses.replace(timecfg, **kw)
+
+        recover = getattr(params, "recover_rounds", 1)
+        knobs = RoundKnobs(
+            limit=stack(lambda i: p_of(i).resolved_retransmit_limit(),
+                        np.int32),
+            # keep_prob precomputed host-side in double precision — the
+            # PRNG bit-identity rule (ops/knobs.py module docstring).
+            # A spec without its own drop_prob inherits the BASE
+            # params' (matching scenario_params(i), like the
+            # retransmit-limit fallback).
+            keep_prob=stack(
+                lambda i: 1.0 - (specs[i].drop_prob
+                                 if specs[i].drop_prob is not None
+                                 else params.drop_prob),
+                np.float32),
+            push_pull_rounds=stack(lambda i: t_of(i).push_pull_rounds,
+                                   np.int32),
+            sweep_rounds=stack(lambda i: t_of(i).sweep_rounds, np.int32),
+            refresh_rounds=stack(lambda i: t_of(i).refresh_rounds,
+                                 np.int32),
+            recover_rounds=stack(lambda i: recover, np.int32),
+            suspicion_window=stack(lambda i: t_of(i).suspicion_window,
+                                   np.int32),
+            alive_lifespan=stack(lambda i: t_of(i).alive_lifespan,
+                                 np.int32),
+            draining_lifespan=stack(lambda i: t_of(i).draining_lifespan,
+                                    np.int32),
+            tombstone_lifespan=stack(
+                lambda i: t_of(i).tombstone_lifespan, np.int32),
+            stale_ticks=stack(lambda i: t_of(i).stale_ticks, np.int32),
+            churn_prob=stack(lambda i: specs[i].churn_prob, np.float32),
+            fault_seed=stack(
+                lambda i: (specs[i].fault_seed
+                           if specs[i].fault_seed is not None
+                           else (plan.seed if plan is not None else 0)),
+                np.int32),
+        )
+        keys = jnp.stack([jax.random.PRNGKey(s.seed) for s in specs])
+        return cls(family=family, params=params, timecfg=timecfg,
+                   specs=specs, knobs=knobs, keys=keys, plan=plan)
+
+
+def restart_churn_perturb(params, prob: Optional[float] = None):
+    """The config3-shaped restart churn as a perturb hook: each round a
+    Bernoulli subset of live slots restarts — the old instance
+    tombstoned by its owner half the time, else re-announced ALIVE.
+
+    With ``prob=None`` the hook is knob-aware (``wants_knobs``): the
+    per-round probability comes from ``kn.churn_prob`` — the fleet's
+    per-scenario churn axis.  With a static ``prob`` it is the
+    unbatched twin (bit-identical draw: the probability reaches the
+    Bernoulli without arithmetic on either path)."""
+    spn = params.services_per_node
+
+    def perturb(state, key, now, kn=None):
+        churn_p = prob if prob is not None else kn.churn_prob
+        owner = jnp.arange(params.m, dtype=jnp.int32) // spn
+        cols = jnp.arange(params.m, dtype=jnp.int32)
+        churn = jax.random.bernoulli(key, churn_p, (params.m,))
+        own = state.known[owner, cols]
+        flip = churn & (unpack_ts(own) > 0) & state.node_alive[owner]
+        st = unpack_status(own)
+        new_status = jnp.where(st == ALIVE, TOMBSTONE, ALIVE)
+        new_val = jnp.where(flip, pack(now, new_status), own)
+        known = state.known.at[owner, cols].set(new_val)
+        reset_rows = jnp.where(flip, owner, params.n)
+        sent = state.sent.at[reset_rows, cols].set(jnp.int8(0),
+                                                   mode="drop")
+        return dataclasses.replace(state, known=known, sent=sent)
+
+    perturb.wants_knobs = prob is None
+    return perturb

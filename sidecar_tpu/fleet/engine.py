@@ -1,0 +1,405 @@
+"""FleetSim — the vmapped scenario-fleet drivers (docs/sweep.md).
+
+One compiled scan runs S *independent* scenarios: the classic round
+(``ExactSim._step`` / ``ChaosExactSim._step`` / ``CompressedSim._step``
+— literally those functions, knob-parameterized via ops/knobs.py) is
+``jax.vmap``-ed over (stacked state, per-scenario key, stacked knobs),
+so a parameter search that used to be S traces + S compiles + S
+dispatches becomes ONE of each.
+
+Early exit (the converged-mask contract): a per-scenario ``live`` mask
+freezes finished scenarios — their state, curve, round count, and
+byte accounting stop advancing (a ``select`` per leaf; under vmap the
+per-scenario work itself still executes, as any batched ``lax.cond``
+does) — and once EVERY scenario has crossed, a batch-level ``lax.cond``
+skips whole round bodies, which is where the tail's compute actually
+drops.  ``stop=False`` disables freezing entirely: the run is then
+bit-identical, per scenario, to S unbatched runs (the lockstep oracle,
+tests/test_fleet.py).
+
+Scenario-axis sharding: pass ``mesh=fleet_mesh(sd, nd)`` to lay the
+stacked batch over a ``("scenario", "node")`` device mesh — scenario
+parallelism is embarrassingly data-parallel (GSPMD never communicates
+across it); the node axis composes on the exact family the all_gather
+way (GSPMD inserts the gathers the sharded twins issue explicitly).
+The ring / all_to_all exchange modes remain single-scenario features
+of ``sidecar_tpu/parallel`` — see docs/sweep.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sidecar_tpu import metrics
+from sidecar_tpu.fleet.batch import ScenarioBatch, restart_churn_perturb
+from sidecar_tpu.models.exact import clone_state
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import trace as trace_ops
+from sidecar_tpu.ops.kernels import eligible_lines
+from sidecar_tpu.ops.topology import Topology, complete
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class FleetStats:
+    """Per-scenario summary accumulators riding the scan carry — the
+    fleet's round-trace summary (the flight recorder's census columns,
+    folded instead of streamed: S full ``RoundTrace`` buffers would be
+    S × cap × width of carry for numbers the sweep only needs
+    aggregated)."""
+
+    rounds: jax.Array        # int32 [S] — rounds actually executed
+    eps_round: jax.Array     # int32 [S] — first round conv >= 1-eps (-1)
+    exchange_bytes: jax.Array  # float32 [S] — analytic offer bytes
+    frontier_max: jax.Array  # int32 [S] — sender-frontier high water
+
+
+def _zero_stats(s: int) -> FleetStats:
+    return FleetStats(rounds=jnp.zeros((s,), jnp.int32),
+                      eps_round=jnp.full((s,), -1, jnp.int32),
+                      exchange_bytes=jnp.zeros((s,), jnp.float32),
+                      frontier_max=jnp.zeros((s,), jnp.int32))
+
+
+def _select_scen(live, new_tree, old_tree):
+    """Per-leaf scenario select: leaf[i] advances only while live[i]."""
+    def sel(new_leaf, old_leaf):
+        m = live.reshape(live.shape + (1,) * (new_leaf.ndim - 1))
+        return jnp.where(m, new_leaf, old_leaf)
+    return jax.tree_util.tree_map(sel, new_tree, old_tree)
+
+
+@dataclasses.dataclass
+class FleetRun:
+    """Host-side result of one fleet dispatch."""
+
+    names: list
+    convergence: np.ndarray       # [R // conv_every, S]
+    rounds: np.ndarray            # [S] executed rounds
+    eps_round: list               # [S] Optional[int]
+    exchange_bytes: np.ndarray    # [S] analytic offer bytes (to freeze)
+    frontier_max: np.ndarray      # [S]
+    conv_every: int
+    wall_seconds: float
+    scenarios_per_sec: float
+    final_states: object = None   # stacked states (oracle / chaining)
+
+    def table(self, round_ticks: int, ticks_per_second: int) -> list:
+        """Per-scenario rows for the /sweep Pareto table."""
+        out = []
+        for i, name in enumerate(self.names):
+            er = self.eps_round[i]
+            out.append({
+                "name": name,
+                "rounds_to_eps": er,
+                "seconds_to_eps": (er * round_ticks / ticks_per_second
+                                   if er is not None else None),
+                "exchange_bytes": int(self.exchange_bytes[i]),
+                "frontier_max": int(self.frontier_max[i]),
+                "rounds_run": int(self.rounds[i]),
+                "final_convergence": float(self.convergence[-1, i])
+                if len(self.convergence) else None,
+            })
+        return out
+
+
+def fleet_mesh(scenario_devices: int, node_devices: int = 1,
+               devices=None):
+    """A ``("scenario", "node")`` device mesh for the sharded fleet."""
+    devs = list(devices if devices is not None else jax.devices())
+    need = scenario_devices * node_devices
+    if len(devs) < need:
+        raise ValueError(
+            f"fleet mesh needs {need} devices "
+            f"({scenario_devices}x{node_devices}), have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(scenario_devices, node_devices)
+    from jax.sharding import Mesh
+    return Mesh(arr, ("scenario", "node"))
+
+
+FLEET_MESH_ENV = "SIDECAR_TPU_FLEET_MESH"
+
+
+def resolve_fleet_mesh(mesh=None):
+    """Explicit mesh wins; else ``SIDECAR_TPU_FLEET_MESH`` ("S" or
+    "SxN" — scenario×node device counts) builds one; unset → single
+    device."""
+    if mesh is not None:
+        return mesh
+    v = os.environ.get(FLEET_MESH_ENV, "").strip().lower()
+    if not v:
+        return None
+    parts = v.split("x")
+    try:
+        sd = int(parts[0])
+        nd = int(parts[1]) if len(parts) > 1 else 1
+    except ValueError:
+        raise ValueError(
+            f"{FLEET_MESH_ENV}={v!r}: expected 'S' or 'SxN' device "
+            "counts (e.g. '4' or '4x2')")
+    return fleet_mesh(sd, nd)
+
+
+class FleetSim:
+    """S scenarios of one :class:`ScenarioBatch` in one compiled scan."""
+
+    def __init__(self, batch: ScenarioBatch,
+                 topo: Optional[Topology] = None, mesh=None):
+        self.batch = batch
+        self.mesh = mesh = resolve_fleet_mesh(mesh)
+        p = batch.params
+        topo = topo if topo is not None else complete(p.n)
+        perturb = None
+        if batch.has_churn:
+            perturb = restart_churn_perturb(p)   # knob-driven churn
+        if batch.family == "exact":
+            if batch.plan is not None:
+                from sidecar_tpu.chaos.sim_inject import ChaosExactSim
+                self.sim = ChaosExactSim(p, topo, batch.timecfg,
+                                         plan=batch.plan,
+                                         perturb=perturb)
+            else:
+                from sidecar_tpu.models.exact import ExactSim
+                self.sim = ExactSim(p, topo, batch.timecfg,
+                                    perturb=perturb)
+        else:
+            from sidecar_tpu.models.compressed import CompressedSim
+            self.sim = CompressedSim(p, topo, batch.timecfg)
+            # The fleet round must stay a pure-XLA program: a traced
+            # per-scenario transmit limit cannot enter a Pallas kernel
+            # signature.  The XLA twins are bit-identical by the kernel
+            # parity contract (docs/kernels.md), so lockstep vs a
+            # Pallas-pathed unbatched sim still holds.
+            self.sim._kernels, self.sim._kernels_interpret = "xla", False
+            self.sim._fused_gather = False
+        if mesh is not None:
+            sd, nd = mesh.devices.shape
+            if batch.size % sd:
+                raise ValueError(
+                    f"batch size {batch.size} must divide the scenario "
+                    f"mesh axis ({sd})")
+            if nd > 1 and batch.family != "exact":
+                raise ValueError(
+                    "the node mesh axis composes on the exact family "
+                    "only (compressed state is not node-major on every "
+                    "leaf); use node_devices=1")
+            if nd > 1 and p.n % nd:
+                raise ValueError(
+                    f"n={p.n} must divide the node mesh axis ({nd})")
+
+    # -- state construction -------------------------------------------------
+
+    def init_states(self):
+        """Stacked per-scenario initial states ([S] on every leaf):
+        cold start on the exact family, converged-boot + per-scenario
+        mint burst on the compressed family."""
+        b = self.batch
+        parts = []
+        for i in range(b.size):
+            st = self.sim.init_state()
+            slots = b.mint_slots(i) if b.family == "compressed" else None
+            if slots is not None:
+                st = self.sim.mint(st, slots, b.specs[i].mint_tick)
+            parts.append(st)
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *parts)
+        return self._place(stacked)
+
+    def _place(self, tree):
+        """Lay a stacked pytree over the fleet mesh: axis 0 over
+        ``scenario``; on the exact family, node-major second axes over
+        ``node``."""
+        if self.mesh is None:
+            return tree
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        n, s = self.batch.params.n, self.batch.size
+        nd = self.mesh.devices.shape[1]
+
+        def put(leaf):
+            if leaf.ndim >= 1 and leaf.shape[0] == s:
+                if nd > 1 and leaf.ndim >= 2 and leaf.shape[1] == n:
+                    spec = P("scenario", "node")
+                else:
+                    spec = P("scenario")
+            else:
+                spec = P()
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+        return jax.tree_util.tree_map(put, tree)
+
+    # -- per-scenario probes (run under vmap) -------------------------------
+
+    def _offer_census(self, st, kn):
+        """(sender frontier, analytic exchange bytes) from the
+        PRE-round eligibility — the flight recorder's census
+        (ops/trace.py), per scenario."""
+        p = self.batch.params
+        if self.batch.family == "exact":
+            sim_st = st.sim if hasattr(st, "sim") else st
+            elig = gossip_ops.eligible_records(sim_st.known, sim_st.sent,
+                                               kn.limit)
+            budget = min(p.budget, p.m)
+        else:
+            elig = eligible_lines(st.cache_slot, st.cache_sent, kn.limit)
+            budget = min(p.budget, p.cache_lines)
+        return trace_ops.offer_census(elig, budget, p.fanout)
+
+    # -- drivers ------------------------------------------------------------
+    # The fleet scan drivers: donate the stacked state (the
+    # check_jit_entrypoints donate-or-waiver contract extends to the
+    # fleet plane — tests/test_jit_entrypoints.py pins both are seen).
+
+    def _scan_body(self, keys, knobs, conv_every, eps, stop):
+        """The shared round body: ``conv_every`` vmapped rounds under
+        the batch-level skip cond, then one convergence sample with
+        crossing detection."""
+        step_v = jax.vmap(lambda st, k, kn: self.sim._step(st, k, kn=kn))
+        conv_v = jax.vmap(self.sim.convergence)
+        census_v = jax.vmap(self._offer_census)
+        fold_v = jax.vmap(jax.random.fold_in)
+
+        def inner(carry, _):
+            states, live, fs = carry
+
+            def active(args):
+                states, live, fs = args
+                frontier, xbytes = census_v(states, knobs)
+                keys_r = fold_v(keys, states.round_idx)
+                nxt = step_v(states, keys_r, knobs)
+                states = _select_scen(live, nxt, states)
+                live_i = live.astype(jnp.int32)
+                fs = FleetStats(
+                    rounds=fs.rounds + live_i,
+                    eps_round=fs.eps_round,
+                    exchange_bytes=fs.exchange_bytes
+                    + jnp.where(live, xbytes.astype(jnp.float32), 0.0),
+                    frontier_max=jnp.maximum(
+                        fs.frontier_max, jnp.where(live, frontier, 0)))
+                return states, live, fs
+
+            # The whole-batch skip: once every scenario crossed, the
+            # remaining rounds compile to a no-op branch — the actual
+            # tail saving (a PER-scenario cond under vmap would still
+            # execute both branches).
+            return lax.cond(jnp.any(live), active, lambda a: a,
+                            carry), None
+
+        def body(carry, _):
+            carry, _ = lax.scan(inner, carry, None, length=conv_every)
+            states, live, fs = carry
+            conv = conv_v(states)
+            crossed = live & (conv >= 1.0 - eps) & (fs.eps_round < 0)
+            fs = dataclasses.replace(
+                fs, eps_round=jnp.where(crossed, fs.rounds,
+                                        fs.eps_round))
+            if stop:
+                live = live & (conv < 1.0 - eps)
+            return (states, live, fs), conv
+
+        return body
+
+    @functools.partial(jax.jit,
+                       static_argnums=(0, 4, 5, 6, 7),
+                       donate_argnums=1)
+    def _run_conv_fleet_jit(self, states, keys, knobs, num_rounds,
+                            conv_every, eps, stop):
+        body = self._scan_body(keys, knobs, conv_every, eps, stop)
+        s = self.batch.size
+        (final, live, fs), conv = lax.scan(
+            body, (states, jnp.ones((s,), bool), _zero_stats(s)), None,
+            length=num_rounds // conv_every)
+        return final, conv, fs
+
+    @functools.partial(jax.jit,
+                       static_argnums=(0, 4, 5, 6, 7),
+                       donate_argnums=1)
+    def _run_fast_fleet_jit(self, states, keys, knobs, num_rounds,
+                            conv_every, eps, stop):
+        # The bench path: same body, curve discarded on device.
+        body = self._scan_body(keys, knobs, conv_every, eps, stop)
+        s = self.batch.size
+
+        def drop_curve(carry, _):
+            carry, _ = body(carry, None)
+            return carry, None
+
+        (final, live, fs), _ = lax.scan(
+            drop_curve, (states, jnp.ones((s,), bool), _zero_stats(s)),
+            None, length=num_rounds // conv_every)
+        return final, fs
+
+    # -- public API ---------------------------------------------------------
+
+    def run(self, states, num_rounds: int, conv_every: int = 1,
+            eps: float = 0.01, stop: bool = False, donate: bool = True,
+            curve: bool = True) -> FleetRun:
+        """Run every scenario ``num_rounds`` rounds (fewer where the
+        converged-mask freezes them, ``stop=True``), sampling the
+        per-scenario convergence metric every ``conv_every`` rounds.
+
+        ``stop=False`` (the lockstep contract) runs the full horizon —
+        bit-identical per scenario to unbatched runs; ``eps`` still
+        only sets where ``eps_round`` is recorded."""
+        b = self.batch
+        if num_rounds % conv_every:
+            raise ValueError(
+                f"num_rounds={num_rounds} not divisible by "
+                f"conv_every={conv_every}")
+        start = int(np.max(np.asarray(
+            jax.device_get(states.round_idx))))
+        b.timecfg.validate_horizon(start + num_rounds)
+        if not donate:
+            states = clone_state(states)
+        t0 = time.perf_counter()
+        if curve:
+            final, conv, fs = self._run_conv_fleet_jit(
+                states, b.keys, b.knobs, num_rounds, conv_every,
+                float(eps), bool(stop))
+        else:
+            final, fs = self._run_fast_fleet_jit(
+                states, b.keys, b.knobs, num_rounds, conv_every,
+                float(eps), bool(stop))
+            conv = jnp.zeros((0, b.size), jnp.float32)
+        jax.block_until_ready(fs.rounds)
+        wall = time.perf_counter() - t0
+
+        rounds = np.asarray(jax.device_get(fs.rounds))
+        eps_round = [int(r) if r >= 0 else None
+                     for r in np.asarray(jax.device_get(fs.eps_round))]
+        metrics.incr("fleet.batches")
+        metrics.incr("fleet.scenarios", b.size)
+        metrics.incr("fleet.rounds", int(rounds.sum()))
+        metrics.incr("fleet.rounds_saved",
+                     int(b.size * num_rounds - rounds.sum()))
+        if b.plan is not None:
+            # Chaos fleet: publish the batch's injection totals the way
+            # the classic chaos drivers do (fault pressure is never
+            # silent).
+            for name, field in (
+                    ("chaos.sim.droppedPackets", "injected_drops"),
+                    ("chaos.sim.delayedPackets", "injected_delays"),
+                    ("chaos.sim.duplicatedPackets", "injected_dups")):
+                total = int(np.asarray(
+                    jax.device_get(getattr(final, field))).sum())
+                if total:
+                    metrics.incr(name, total)
+        return FleetRun(
+            names=[s.name for s in b.specs],
+            convergence=np.asarray(jax.device_get(conv)),
+            rounds=rounds,
+            eps_round=eps_round,
+            exchange_bytes=np.asarray(jax.device_get(fs.exchange_bytes)),
+            frontier_max=np.asarray(jax.device_get(fs.frontier_max)),
+            conv_every=conv_every,
+            wall_seconds=wall,
+            scenarios_per_sec=b.size / wall if wall > 0 else 0.0,
+            final_states=final,
+        )

@@ -1,0 +1,149 @@
+"""Grid expansion → ScenarioBatches, and the Pareto-front helper.
+
+A grid spec is a dict of axis name → list of values (the ``POST
+/sweep`` wire form).  Axes split exactly as ops/knobs.py splits the
+parameter space:
+
+* **data axes** (vary within a batch): ``retransmit_limit``,
+  ``drop_prob``, ``churn_prob``, ``mint_frac``, ``fault_seed``,
+  ``seed``, and the per-scenario TimeConfig overrides
+  (``push_pull_interval_s``, ``sweep_interval_s``,
+  ``refresh_interval_s``, ``suspicion_window_s``,
+  ``alive_lifespan_s``, ``draining_lifespan_s``,
+  ``tombstone_lifespan_s``);
+* **compile-key axes** (group into separate batches, each its own
+  compiled program): ``fanout``, ``budget``.
+
+Grids larger than one batch are chunked at
+``SIDECAR_TPU_FLEET_MAX_BATCH`` scenarios (default 64) — the chunk
+boundary is invisible to results (scenarios are independent), it only
+bounds one dispatch's memory footprint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+from typing import Optional
+
+from sidecar_tpu.fleet.batch import ScenarioBatch, ScenarioSpec
+from sidecar_tpu.models.timecfg import TimeConfig
+
+_DATA_AXES = (
+    "seed", "retransmit_limit", "drop_prob", "churn_prob", "mint_frac",
+    "fault_seed", "push_pull_interval_s", "sweep_interval_s",
+    "refresh_interval_s", "suspicion_window_s", "alive_lifespan_s",
+    "draining_lifespan_s", "tombstone_lifespan_s",
+)
+_STATIC_AXES = ("fanout", "budget")
+KNOWN_AXES = _DATA_AXES + _STATIC_AXES
+
+DEFAULT_MAX_BATCH = 64
+
+
+def max_batch_size() -> int:
+    """The per-dispatch scenario cap (``SIDECAR_TPU_FLEET_MAX_BATCH``,
+    default 64) — bounds one batch's stacked-state footprint; larger
+    grids chunk across dispatches."""
+    try:
+        v = int(os.environ.get("SIDECAR_TPU_FLEET_MAX_BATCH",
+                               str(DEFAULT_MAX_BATCH)))
+    except ValueError:
+        return DEFAULT_MAX_BATCH
+    return max(1, v)
+
+
+def expand_grid(axes: dict, base: Optional[dict] = None) -> list:
+    """Cartesian-expand a grid spec into ``ScenarioSpec``s.
+
+    ``axes`` maps axis names (:data:`KNOWN_AXES`) to value lists;
+    ``base`` supplies fixed spec fields every point shares.  Unknown
+    axis names are rejected loudly (a typoed knob silently sweeping
+    nothing would report the base config 64 times)."""
+    base = dict(base or {})
+    bad = [k for k in axes if k not in KNOWN_AXES]
+    if bad:
+        raise ValueError(
+            f"unknown grid axis(es) {sorted(bad)}; expected a subset of "
+            f"{sorted(KNOWN_AXES)}")
+    bad = [k for k in base if k not in KNOWN_AXES]
+    if bad:
+        raise ValueError(
+            f"unknown base field(s) {sorted(bad)}; expected a subset of "
+            f"{sorted(KNOWN_AXES)}")
+    for k, vs in axes.items():
+        if not isinstance(vs, (list, tuple)) or not vs:
+            raise ValueError(
+                f"grid axis {k!r} must be a non-empty list of values, "
+                f"got {vs!r}")
+    names = sorted(axes)
+    specs = []
+    for i, combo in enumerate(itertools.product(
+            *(axes[k] for k in names))):
+        kw = dict(base)
+        kw.update(dict(zip(names, combo)))
+        tag = "-".join(f"{k}={v}" for k, v in zip(names, combo))
+        specs.append(ScenarioSpec(name=f"pt{i:03d}" + (f"-{tag}"
+                                                       if tag else ""),
+                                  **kw))
+    return specs
+
+
+def build_batches(specs, params, timecfg: TimeConfig = TimeConfig(),
+                  *, family: str = "exact", plan=None,
+                  max_batch: Optional[int] = None) -> list:
+    """Group specs by their compile-key axes, chunk each group at the
+    batch cap, and build validated ``ScenarioBatch``es.
+
+    Returns ``[(batch, indices)]`` where ``indices`` maps each batch
+    scenario back to its position in ``specs`` (so a chunked sweep
+    reassembles one flat result table)."""
+    specs = list(specs)
+    cap = max_batch or max_batch_size()
+    groups: dict = {}
+    for idx, s in enumerate(specs):
+        key = (s.fanout if s.fanout is not None else params.fanout,
+               s.budget if s.budget is not None else params.budget)
+        groups.setdefault(key, []).append(idx)
+    out = []
+    for (fanout, budget), idxs in sorted(groups.items()):
+        p = dataclasses.replace(params, fanout=fanout, budget=budget)
+        for lo in range(0, len(idxs), cap):
+            chunk = idxs[lo:lo + cap]
+            batch = ScenarioBatch.build(
+                [specs[i] for i in chunk], p, timecfg, family=family,
+                plan=plan)
+            out.append((batch, chunk))
+    return out
+
+
+def pareto_front(rows: list, *, keys=("rounds_to_eps",
+                                      "exchange_bytes")) -> list:
+    """Indices of the non-dominated rows, minimizing every key (the
+    convergence-time-vs-bytes trade the capacity planner reads).
+    Rows with a ``None`` key (never converged within the horizon) are
+    excluded from the front outright: a config that never reaches ε is
+    not a capacity-planning candidate however cheap its wire bytes —
+    the table still lists it, flagged by its ``None``."""
+    def val(row, k):
+        v = row.get(k)
+        return float("inf") if v is None else float(v)
+
+    front = []
+    for i, a in enumerate(rows):
+        av = [val(a, k) for k in keys]
+        if any(v == float("inf") for v in av):
+            continue
+        dominated = False
+        for j, b in enumerate(rows):
+            if i == j:
+                continue
+            bv = [val(b, k) for k in keys]
+            if all(x <= y for x, y in zip(bv, av)) and \
+                    any(x < y for x, y in zip(bv, av)):
+                dominated = True
+                break
+        if not dominated:
+            front.append(i)
+    return front

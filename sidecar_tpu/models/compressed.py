@@ -118,6 +118,7 @@ from sidecar_tpu.models.exact import clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import knobs as knob_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
@@ -325,6 +326,11 @@ class CompressedSim:
         # resolved once at construction like the kernel path; the caps
         # are static — they shape the compacted program.
         self._sparse_mode = sparse_ops.resolve_sparse(sparse)
+        # Static data-axis knob bundle (ops/knobs.py): Python scalars
+        # that const-fold the round into the pre-knob program; the
+        # fleet engine passes a stacked traced bundle per round instead.
+        self._knobs = knob_ops.from_protocol(
+            params, timecfg, recover_rounds=params.recover_rounds)
         cap = params.sparse_cap or sparse_ops.default_frontier_cap(params.n)
         self._sparse_caps = (min(params.n, cap),
                              min(params.n, cap * params.fanout),
@@ -449,7 +455,7 @@ class CompressedSim:
         return jnp.where(adv, cv, wv), jnp.where(adv, cs, ws)
 
     def _pull_merge(self, state: CompressedState, sent, bval, bslot, src,
-                    alive, now, drop_key=None):
+                    alive, now, drop_key=None, kn=None):
         """Deliver: each receiver pulls the boards of its ``src`` peers
         and lex-merges them into its cache, entirely elementwise — the
         global line hash aligns every board with every cache, so slot
@@ -464,16 +470,18 @@ class CompressedSim:
         gathered candidate ([N, F, K]) — candidates are copies of board
         entries evaluated at the same ``now``, so filtering before the
         gather is identical and F× cheaper."""
-        bval = jnp.where(staleness_mask(bval, now, self.t.stale_ticks),
+        kn = self._knobs if kn is None else kn
+        bval = jnp.where(staleness_mask(bval, now, kn.stale_ticks),
                          0, bval)
         pv = bval[src]    # [nl, F, K] — row gathers, contiguous in K
         ps = bslot[src]
         ok = alive[src] & state.node_alive[:, None]      # [nl, F]
         return self._merge_pulled(state, sent, pv, ps, ok, now,
-                                  drop_key=drop_key, stale_filtered=True)
+                                  drop_key=drop_key, stale_filtered=True,
+                                  kn=kn)
 
     def _fold_pulled(self, cv0, cs0, wv, ws, pv, ps, ok, now, keep=None,
-                     stale_filtered=False):
+                     stale_filtered=False, kn=None):
         """Fold a GROUP of pulled candidates ``pv``/``ps`` ([nl, G, K])
         into the running line winners ``(wv, ws)``.
 
@@ -487,11 +495,12 @@ class CompressedSim:
         ``keep`` is a pre-drawn ``drop_prob`` keep-mask slice (the
         caller draws ONE mask over the full candidate set so splitting
         groups never changes the PRNG stream)."""
+        kn = self._knobs if kn is None else kn
         pv = jnp.where(ok[:, :, None], pv, 0)
         if keep is not None:
             pv = jnp.where(keep, pv, 0)
         if not stale_filtered:
-            pv = jnp.where(staleness_mask(pv, now, self.t.stale_ticks),
+            pv = jnp.where(staleness_mask(pv, now, kn.stale_ticks),
                            0, pv)
         ps = jnp.where(pv > 0, ps, -1)
         for f in range(pv.shape[1]):
@@ -515,7 +524,8 @@ class CompressedSim:
             + jnp.sum(evicted.astype(jnp.int32)))
 
     def _merge_pulled(self, state: CompressedState, sent, pv, ps, ok,
-                      now, drop_key=None, stale_filtered=False):
+                      now, drop_key=None, stale_filtered=False,
+                      kn=None):
         """Merge pre-gathered peer board rows ``pv``/``ps`` ([nl, F, K])
         into the cache.
 
@@ -528,14 +538,15 @@ class CompressedSim:
         advancing ALIVE to DRAINING.  (Fold + finalize are split out so
         the sharded twins can fold candidate groups as they arrive —
         :meth:`_fold_pulled`.)"""
+        kn = self._knobs if kn is None else kn
         keep = None
-        if self.p.drop_prob > 0.0:
-            keep = jax.random.bernoulli(drop_key, 1.0 - self.p.drop_prob,
+        if kn.needs_drop_draw:
+            keep = jax.random.bernoulli(drop_key, kn.keep_prob,
                                         pv.shape)
         wv, ws = self._fold_pulled(
             state.cache_val, state.cache_slot, state.cache_val,
             state.cache_slot, pv, ps, ok, now, keep=keep,
-            stale_filtered=stale_filtered)
+            stale_filtered=stale_filtered, kn=kn)
         return self._finalize_merge(state, sent, wv, ws)
 
     def _insert_own_offers(self, cache_val, cache_slot, cache_sent,
@@ -603,7 +614,7 @@ class CompressedSim:
         return cache_val, cache_slot, cache_sent, ev
 
     def _announce(self, state: CompressedState, round_idx, now,
-                  row_offset=0):
+                  row_offset=0, kn=None):
         """Owner refresh + recovery — fully elementwise: owner slots are
         row-aligned with the floor (``floor.reshape(N, S)``), so the
         refresh fold needs no scatter, and cache inserts go through the
@@ -631,7 +642,7 @@ class CompressedSim:
         services_state.go:538)."""
         own, floor, offer_val, base_slot = self._announce_offers(
             state.own, state.floor, state.node_alive, round_idx, now,
-            row_offset=row_offset)
+            row_offset=row_offset, kn=kn)
         cv, cs, se, ev = self._insert_own_offers(
             state.cache_val, state.cache_slot, state.cache_sent,
             offer_val, base_slot, reset_on_hold=True)
@@ -640,7 +651,7 @@ class CompressedSim:
             cache_sent=se, evictions=state.evictions + ev)
 
     def _announce_offers(self, own0, floor0, node_alive, round_idx, now,
-                         row_offset=0):
+                         row_offset=0, kn=None):
         """The BOARD-INDEPENDENT half of announce: the refresh/fold
         update of ``own``/``floor`` plus the offer values, none of which
         read the cache — so the sharded split-phase round runs this
@@ -648,6 +659,7 @@ class CompressedSim:
         cache insert (:meth:`_insert_own_offers`) only in the final
         phase.  Returns ``(own, floor, offer_val, base_slot)``."""
         p, t = self.p, self.t
+        kn = self._knobs if kn is None else kn
         s = p.services_per_node
         n = own0.shape[0]             # local row count (= p.n single-chip)
         node = jnp.arange(n, dtype=jnp.int32)[:, None]          # [N, 1]
@@ -661,7 +673,7 @@ class CompressedSim:
         present = is_known(own0) & node_alive[:, None]
 
         refresh_due = gossip_ops.refresh_due(
-            own0, slots, round_idx, refresh_rounds=t.refresh_rounds,
+            own0, slots, round_idx, refresh_rounds=kn.refresh_rounds,
             round_ticks=t.round_ticks, now=now) & present \
             & (st != TOMBSTONE)
         # Lifeguard self-refutation (ops/suspicion.py): a SUSPECT own
@@ -670,7 +682,7 @@ class CompressedSim:
         # the floor — anti-entropy-guaranteed delivery, the refresh-fold
         # contract below).  Compiles to nothing at window 0.
         refresh_due, st = suspicion_ops.announce_refute(
-            refresh_due, st, present, t.suspicion_window > 0)
+            refresh_due, st, present, kn.suspicion_enabled)
         new_val = pack(now, st)
         fold = refresh_due & (own0 == floor_l)
         own = jnp.where(refresh_due, new_val, own0)
@@ -678,15 +690,16 @@ class CompressedSim:
         floor = lax.dynamic_update_slice(
             floor0, floor_l.reshape(-1), (row_offset * s,))
 
-        rphase = gnode % p.recover_rounds
-        recover_due = ((round_idx % p.recover_rounds) == rphase) & present \
+        rphase = gnode % kn.recover_rounds
+        recover_due = ((round_idx % kn.recover_rounds) == rphase) & present \
             & (own > floor_l)
 
         offer = (refresh_due & ~fold) | recover_due
         offer_val = jnp.where(offer, own, 0)
         return own, floor, offer_val, slots[:, 0]
 
-    def _push_pull_stride(self, state: CompressedState, key, now):
+    def _push_pull_stride(self, state: CompressedState, key, now,
+                          kn=None):
         """Anti-entropy: two-way exchange with the node ``stride``
         positions away — each side's full cache plus its own rows.
         Caches are line-aligned across nodes, so the cache half is
@@ -696,6 +709,7 @@ class CompressedSim:
         broadcast-compare insert (``_insert_own_offers``).  Split scenarios mask the exchange where the two sides
         differ (a partition severs TCP push-pull too)."""
         p, t = self.p, self.t
+        kn = self._knobs if kn is None else kn
         s = p.services_per_node
         stride = jax.random.randint(key, (), 1, p.n, dtype=jnp.int32)
         alive = state.node_alive
@@ -715,7 +729,7 @@ class CompressedSim:
             p_slot = jnp.roll(cs0, roll_amt, 0)
             p_val = jnp.roll(cv0, roll_amt, 0)
             p_val = jnp.where(okc & (p_slot >= 0), p_val, 0)
-            p_val = jnp.where(staleness_mask(p_val, now, t.stale_ticks),
+            p_val = jnp.where(staleness_mask(p_val, now, kn.stale_ticks),
                               0, p_val)
             p_slot = jnp.where(p_val > 0, p_slot, -1)
             p_val = sticky_adjust(p_val, cv0,
@@ -728,7 +742,7 @@ class CompressedSim:
             t_val = jnp.where(okc, jnp.roll(state.own, roll_amt, 0), 0)
             t_floor = jnp.roll(floor_rs, roll_amt, 0)
             t_val = jnp.where(t_val > t_floor, t_val, 0)
-            t_val = jnp.where(staleness_mask(t_val, now, t.stale_ticks),
+            t_val = jnp.where(staleness_mask(t_val, now, kn.stale_ticks),
                               0, t_val)
             wv, ws, sent, _ = self._insert_own_offers(
                 wv, ws, sent, t_val, t_slot[:, 0])
@@ -780,7 +794,8 @@ class CompressedSim:
         owner_holds = (ws >= 0) & owner_alive & (own_at >= wv)
         return ws, wv, count + owner_holds.astype(jnp.int32)
 
-    def _floor_advance_and_sweep(self, state: CompressedState, now):
+    def _floor_advance_and_sweep(self, state: CompressedState, now,
+                                 kn=None):
         """Per-line census → floor advance → line free → TTL sweep.
 
         The fold is per cache line: each line's winning (slot, version)
@@ -803,6 +818,7 @@ class CompressedSim:
         REFRESH folds (fold-freed lines are already handled inline) —
         runs every ``deep_sweep_every``-th sweep."""
         p, t = self.p, self.t
+        kn = self._knobs if kn is None else kn
         ws, wv, hits = self._line_census(state)
         n_alive = jnp.sum(state.node_alive.astype(jnp.int32))
         safe_ws = jnp.maximum(ws, 0)
@@ -831,7 +847,7 @@ class CompressedSim:
                 jnp.float32(p.fold_quorum)
                 * n_alive.astype(jnp.float32)).astype(jnp.int32)
             age_ok = now - unpack_ts(wv) >= \
-                t.push_pull_rounds * t.round_ticks
+                kn.push_pull_rounds * t.round_ticks
             caught_up = caught_up | (above & (hits >= q_hits) & age_ok)
 
         fold_idx = jnp.where(caught_up, safe_ws, p.m)
@@ -862,11 +878,11 @@ class CompressedSim:
         cache_val = jnp.where(below, 0, state.cache_val)
         cache_sent = jnp.where(below, jnp.int8(0), state.cache_sent)
 
-        kw = dict(alive_lifespan=t.alive_lifespan,
-                  draining_lifespan=t.draining_lifespan,
-                  tombstone_lifespan=t.tombstone_lifespan,
+        kw = dict(alive_lifespan=kn.alive_lifespan,
+                  draining_lifespan=kn.draining_lifespan,
+                  tombstone_lifespan=kn.tombstone_lifespan,
                   one_second=t.one_second,
-                  suspicion_window=t.suspicion_window)
+                  suspicion_window=kn.suspicion_window)
         own, _ = ttl_sweep(state.own, now, **kw)
         floor_swept, _ = ttl_sweep(floor, now, **kw)
         swept_val, _ = ttl_sweep(cache_val, now, **kw)
@@ -886,7 +902,7 @@ class CompressedSim:
         deep_due = jnp.any(deep_due)
         if p.deep_sweep_every > 0:
             round_idx = now // t.round_ticks
-            deep_rounds = t.sweep_rounds * p.deep_sweep_every
+            deep_rounds = kn.sweep_rounds * p.deep_sweep_every
             deep_due = deep_due | (round_idx % deep_rounds == 0)
 
         def deep_free(args):
@@ -907,7 +923,7 @@ class CompressedSim:
 
     def _round_gossip_announce(self, state: CompressedState, src, k_drop,
                                round_idx, now, force_xla=False,
-                               ann=None):
+                               ann=None, kn=None):
         """Phases 1 + 2 of the round — publish/pull/merge + announce —
         the DENSE form, extracted so the sparse step's overflow
         fallback (``_step_sparse``) is literally this function.
@@ -920,7 +936,8 @@ class CompressedSim:
         frontier either way) — identical values, one O(N·S) pass
         instead of two on overflow rounds."""
         p, t = self.p, self.t
-        limit = p.resolved_retransmit_limit()
+        kn = self._knobs if kn is None else kn
+        limit = kn.limit
 
         # 1. publish the board (pre-round snapshot) + pull deliveries.
         if self._fused_gather and not force_xla:
@@ -929,25 +946,25 @@ class CompressedSim:
             # touches HBM (ops/kernels, bit-identical to the XLA path).
             sent, pv, ps = kernel_ops.fused_publish_gather_pallas(
                 state.cache_val, state.cache_slot, state.cache_sent,
-                src, now, stale_ticks=t.stale_ticks,
+                src, now, stale_ticks=kn.stale_ticks,
                 budget=min(p.budget, p.cache_lines), limit=limit,
                 fanout=p.fanout, cache_lines=p.cache_lines,
                 interpret=self._kernels_interpret)
             ok = state.node_alive[src] & state.node_alive[:, None]
             state = self._merge_pulled(state, sent, pv, ps, ok, now,
                                        drop_key=k_drop,
-                                       stale_filtered=True)
+                                       stale_filtered=True, kn=kn)
         else:
             bval, bslot, sent = self._publish(state, limit,
                                               force_xla=force_xla)
             state = self._pull_merge(state, sent, bval, bslot, src,
                                      state.node_alive, now,
-                                     drop_key=k_drop)
+                                     drop_key=k_drop, kn=kn)
 
         # 2. announce re-stamps + recovery offers (end of round, like the
         # exact model: broadcastable the following round).
         if ann is None:
-            return self._announce(state, round_idx, now)
+            return self._announce(state, round_idx, now, kn=kn)
         own1, floor1, offer_val, base_slot = ann
         cv, cs, se, ev = self._insert_own_offers(
             state.cache_val, state.cache_slot, state.cache_sent,
@@ -956,32 +973,36 @@ class CompressedSim:
             state, own=own1, floor=floor1, cache_slot=cs, cache_val=cv,
             cache_sent=se, evictions=state.evictions + ev)
 
-    def _step(self, state: CompressedState,
-              key: jax.Array) -> CompressedState:
+    def _step(self, state: CompressedState, key: jax.Array,
+              kn=None) -> CompressedState:
         p, t = self.p, self.t
+        kn = self._knobs if kn is None else kn
         round_idx = state.round_idx + 1
         now = round_idx * t.round_ticks
         k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
 
         if self.perturb is not None:
-            state = self.perturb(state, k_perturb, now)
+            if getattr(self.perturb, "wants_knobs", False):
+                state = self.perturb(state, k_perturb, now, kn)
+            else:
+                state = self.perturb(state, k_perturb, now)
 
         src = gossip_ops.sample_peers(
             k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
             node_alive=state.node_alive, cut_mask=self._cut)
         state = self._round_gossip_announce(state, src, k_drop,
-                                            round_idx, now)
+                                            round_idx, now, kn=kn)
 
         # 3. anti-entropy.
         state = lax.cond(
-            round_idx % t.push_pull_rounds == 0,
-            lambda st: self._push_pull_stride(st, k_pp, now),
+            round_idx % kn.push_pull_rounds == 0,
+            lambda st: self._push_pull_stride(st, k_pp, now, kn=kn),
             lambda st: st, state)
 
         # 4. floor advance + sweep.
         state = lax.cond(
-            round_idx % t.sweep_rounds == 0,
-            lambda st: self._floor_advance_and_sweep(st, now),
+            round_idx % kn.sweep_rounds == 0,
+            lambda st: self._floor_advance_and_sweep(st, now, kn=kn),
             lambda st: st, state)
 
         return dataclasses.replace(state, round_idx=round_idx)

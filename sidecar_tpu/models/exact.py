@@ -51,6 +51,7 @@ from jax import lax
 
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import knobs as knob_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
@@ -161,6 +162,11 @@ class ExactSim:
         self._nbrs = None if topo.nbrs is None else jnp.asarray(topo.nbrs)
         self._deg = None if topo.deg is None else jnp.asarray(topo.deg)
         self._cut = None if cut_mask is None else jnp.asarray(cut_mask)
+        # The static data-axis knob bundle (ops/knobs.py): plain Python
+        # scalars that const-fold the round into exactly the pre-knob
+        # program; the fleet engine overrides per round with a stacked,
+        # traced bundle instead (docs/sweep.md).
+        self._knobs = knob_ops.from_protocol(params, timecfg)
         # owner[m] = node that announces slot m.
         self.owner = jnp.arange(params.m, dtype=jnp.int32) // params.services_per_node
 
@@ -188,7 +194,8 @@ class ExactSim:
 
     # -- kernels -----------------------------------------------------------
 
-    def _announce_updates(self, known, node_alive, round_idx, now_tick):
+    def _announce_updates(self, known, node_alive, round_idx, now_tick,
+                          kn=None):
         """Update triples for the owners' refresh re-stamps
         (``BroadcastServices``'s 1-minute path, services_state.go:547-549,
         staggered per record — hash-spread phase + elapsed-time guard,
@@ -196,49 +203,61 @@ class ExactSim:
         OOB so the combined scatter drops them.  Tombstones are never
         refreshed — they age out via the 3 h GC."""
         p, t = self.p, self.t
+        kn = self._knobs if kn is None else kn
         cols = jnp.arange(p.m, dtype=jnp.int32)
         own = known[self.owner, cols]              # [M] owners' own cells
         st = unpack_status(own)
         present = is_known(own) & node_alive[self.owner]
 
         due = gossip_ops.refresh_due(
-            own, cols, round_idx, refresh_rounds=t.refresh_rounds,
+            own, cols, round_idx, refresh_rounds=kn.refresh_rounds,
             round_ticks=t.round_ticks, now=now_tick) & present \
             & (st != TOMBSTONE)
         # Lifeguard self-refutation (ops/suspicion.py): a SUSPECT own
         # record announces a refuting ALIVE immediately; compiles to
         # nothing while the suspicion window is 0.
         due, st = suspicion_ops.announce_refute(
-            due, st, present, t.suspicion_window > 0)
+            due, st, present, kn.suspicion_enabled)
 
         vals = jnp.where(due, pack(now_tick, st), 0)
         rows = jnp.where(due, self.owner, p.n)     # OOB row drops the entry
         return rows, cols, vals, due
 
     def _round_deliver_announce(self, known, sent, node_alive, dst,
-                                k_drop, round_idx, now):
+                                k_drop, round_idx, now, kn=None):
         """Phases 1 + 2 of the round (select → deliveries → announce →
         the combined scatter) — the DENSE form, extracted so the sparse
         step's overflow fallback is literally this function.  Returns
         ``(known, sent)``."""
         p, t = self.p, self.t
-        limit = p.resolved_retransmit_limit()
+        kn = self._knobs if kn is None else kn
+        limit = kn.limit
 
         # 1. select + gossip deliveries (from the pre-round state).
         svc_idx, msg = gossip_ops.select_messages(
             known, sent, p.budget, limit)
         sent = gossip_ops.record_transmissions(
             sent, svc_idx, msg, p.fanout, limit)
+        # Packet loss: the keep mask is drawn HERE (same key, prob, and
+        # dense shape as the in-call draw the pre-knob program made —
+        # bit-identical, and the shape the sparse path slices) so a
+        # traced per-scenario keep_prob works; a static keep_prob of 1
+        # compiles no draw at all, as before.
+        record_keep = None
+        if kn.needs_drop_draw:
+            record_keep = jax.random.bernoulli(
+                k_drop, kn.keep_prob,
+                (p.n, p.fanout, svc_idx.shape[1]))
         d_rows, d_cols, d_vals, d_adv = gossip_ops.prepare_deliveries(
             known, dst, svc_idx, msg,
-            now_tick=now, stale_ticks=t.stale_ticks,
+            now_tick=now, stale_ticks=kn.stale_ticks,
             node_alive=node_alive,
-            drop_prob=p.drop_prob, drop_key=k_drop,
+            record_keep=record_keep,
         )
 
         # 2. announce re-stamps, folded into the same scatter.
         a_rows, a_cols, a_vals, a_due = self._announce_updates(
-            known, node_alive, round_idx, now)
+            known, node_alive, round_idx, now, kn=kn)
 
         rows = jnp.concatenate([d_rows, a_rows])
         cols = jnp.concatenate([d_cols, a_cols])
@@ -298,14 +317,22 @@ class ExactSim:
         return gossip_ops.apply_updates(
             known, sent, rows, cols, vals, advanced)
 
-    def _step(self, state: SimState, key: jax.Array) -> SimState:
+    def _step(self, state: SimState, key: jax.Array,
+              kn=None) -> SimState:
         p, t = self.p, self.t
+        kn = self._knobs if kn is None else kn
         round_idx = state.round_idx + 1
         now = round_idx * t.round_ticks
         k_perturb, k_peers, k_drop, k_pp = jax.random.split(key, 4)
 
         if self.perturb is not None:
-            state = self.perturb(state, k_perturb, now)
+            # Knob-aware perturb hooks (the fleet's per-scenario churn,
+            # fleet/batch.py) opt in via a ``wants_knobs`` attribute;
+            # the classic 3-arg contract is unchanged.
+            if getattr(self.perturb, "wants_knobs", False):
+                state = self.perturb(state, k_perturb, now, kn)
+            else:
+                state = self.perturb(state, k_perturb, now)
         known, sent, node_alive = state.known, state.sent, state.node_alive
 
         dst = gossip_ops.sample_peers(
@@ -314,7 +341,7 @@ class ExactSim:
             node_alive=node_alive, cut_mask=self._cut,
         )
         known, sent = self._round_deliver_announce(
-            known, sent, node_alive, dst, k_drop, round_idx, now)
+            known, sent, node_alive, dst, k_drop, round_idx, now, kn=kn)
 
         # 3. anti-entropy push-pull (amortized: every push_pull_rounds).
         pp_partner = gossip_ops.sample_peers(
@@ -324,33 +351,33 @@ class ExactSim:
         )[:, 0]
 
         def do_push_pull(kn_se):
-            kn, se = kn_se
+            kn_, se = kn_se
             merged = gossip_ops.push_pull(
-                kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
-                node_alive=node_alive)
-            se = jnp.where(merged != kn, jnp.int8(0), se)
+                kn_, pp_partner, now_tick=now,
+                stale_ticks=kn.stale_ticks, node_alive=node_alive)
+            se = jnp.where(merged != kn_, jnp.int8(0), se)
             return merged, se
 
         known, sent = lax.cond(
-            round_idx % t.push_pull_rounds == 0,
+            round_idx % kn.push_pull_rounds == 0,
             do_push_pull, lambda kn_se: kn_se, (known, sent))
 
         # 4. lifespan sweep (amortized: every sweep_rounds).  Expired
         # cells get their counts reset — the 10× tombstone rebroadcast.
         def do_sweep(kn_se):
-            kn, se = kn_se
+            kn_, se = kn_se
             swept, expired = ttl_sweep(
-                kn, now,
-                alive_lifespan=t.alive_lifespan,
-                draining_lifespan=t.draining_lifespan,
-                tombstone_lifespan=t.tombstone_lifespan,
+                kn_, now,
+                alive_lifespan=kn.alive_lifespan,
+                draining_lifespan=kn.draining_lifespan,
+                tombstone_lifespan=kn.tombstone_lifespan,
                 one_second=t.one_second,
-                suspicion_window=t.suspicion_window)
-            se = jnp.where(swept != kn, jnp.int8(0), se)
+                suspicion_window=kn.suspicion_window)
+            se = jnp.where(swept != kn_, jnp.int8(0), se)
             return swept, se
 
         known, sent = lax.cond(
-            round_idx % t.sweep_rounds == 0,
+            round_idx % kn.sweep_rounds == 0,
             do_sweep, lambda kn_se: kn_se, (known, sent))
 
         return SimState(known=known, sent=sent, node_alive=node_alive,

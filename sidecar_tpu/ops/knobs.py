@@ -1,0 +1,121 @@
+"""Per-round protocol knobs — the values a round reads that do NOT
+shape the program.
+
+Historically every protocol parameter was baked into the jitted round
+as a Python constant (``SimParams``/``TimeConfig`` are static w.r.t.
+jit), so evaluating a configuration grid meant one trace + compile +
+dispatch per point.  The scenario-fleet engine (``sidecar_tpu/fleet``,
+docs/sweep.md) batches S *independent* scenarios into ONE compiled
+scan by ``jax.vmap``-ing the round over a stacked :class:`RoundKnobs`
+pytree — which requires splitting the parameter space in two:
+
+* **Compile-key axes** (stay static): anything that shapes a tensor or
+  selects program structure — ``n``, ``services_per_node``, ``fanout``
+  (the sampled-peer width), ``budget`` (the message width),
+  ``cache_lines``, ``round_ticks`` (the tick resolution every cadence
+  is derived from), ``fold_quorum``/``deep_sweep_every`` (static
+  Python branches), the topology, and the FaultPlan *structure*.
+  ``fleet/grid.py`` sweeps these ACROSS batches, not within one.
+* **Data axes** (this bundle): values consumed only by elementwise
+  math and ``lax.cond`` predicates — the transmit limit, packet-loss
+  keep probability, push-pull/sweep/refresh cadences, suspicion
+  window, record lifespans, staleness bound, per-round churn
+  probability, and the FaultPlan seed.  These may be Python scalars
+  (the classic static path — they const-fold into exactly the
+  pre-knob program) or traced jax scalars (the fleet path — one
+  program serves every value).
+
+The models build a static bundle once at construction
+(``self._knobs``) and every round helper takes an optional ``kn``
+override; a caller that passes nothing gets the pre-knob program bit
+for bit.  The fleet engine passes a ``[S]``-stacked bundle through
+``jax.vmap`` instead (tests/test_fleet.py pins batched == unbatched
+per scenario, bit-identically, on every model family).
+
+Float-knob bit-identity rule: traced float knobs must reach the PRNG
+*without arithmetic* — ``keep_prob`` is precomputed host-side
+(``1 - drop_prob`` in double precision) rather than derived in traced
+f32, because ``f32(1) - f32(p)`` can differ from ``f32(1 - p)`` by one
+ulp and flip a Bernoulli draw sitting exactly on the boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+
+
+def _static(v) -> bool:
+    """True when a knob is a host scalar (const-folds under jit)."""
+    return isinstance(v, (int, float))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class RoundKnobs:
+    """One scenario's data-axis protocol values (see module docstring).
+
+    Every field is either a Python scalar (static path) or a rank-0 —
+    under the fleet's ``vmap``, rank-1 stacked — jax array (fleet
+    path).  Durations are logical ticks; cadences are gossip rounds.
+    """
+
+    limit: Any              # resolved TransmitLimited limit
+    keep_prob: Any          # 1 - drop_prob, precomputed host-side
+    push_pull_rounds: Any   # anti-entropy cadence (rounds)
+    sweep_rounds: Any       # TTL sweep cadence (rounds)
+    refresh_rounds: Any     # owner refresh cadence (rounds)
+    recover_rounds: Any     # compressed recovery re-offer cadence
+    suspicion_window: Any   # SWIM quarantine window (ticks; 0 = off)
+    alive_lifespan: Any     # ticks
+    draining_lifespan: Any  # ticks
+    tombstone_lifespan: Any  # ticks
+    stale_ticks: Any        # merge staleness bound (ticks)
+    churn_prob: Any = 0.0   # per-round restart-churn probability
+                            # (consumed by knob-aware perturb hooks)
+    fault_seed: Any = 0     # FaultPlan seed (chaos family)
+
+    @property
+    def suspicion_enabled(self) -> bool:
+        """Static gate for :func:`ops.suspicion.announce_refute`: False
+        only when the window is PROVABLY zero (a static 0 compiles the
+        refutation away, exactly the pre-knob program); a traced window
+        keeps the refutation compiled — value-identical at window 0
+        because no SUSPECT cell can exist then."""
+        return not (_static(self.suspicion_window)
+                    and self.suspicion_window <= 0)
+
+    @property
+    def needs_drop_draw(self) -> bool:
+        """Static gate for the packet-loss Bernoulli: skip the draw
+        only when the keep probability is PROVABLY 1 (static path —
+        the pre-knob program drew nothing either).  A traced keep_prob
+        always draws; at keep_prob 1.0 the mask is all-True, a value
+        no-op on its own key (per-purpose keys never shift siblings'
+        streams)."""
+        return not (_static(self.keep_prob) and self.keep_prob >= 1.0)
+
+
+def from_protocol(params, timecfg, *, recover_rounds: int = 1,
+                  fault_seed: int = 0, churn_prob: float = 0.0
+                  ) -> RoundKnobs:
+    """The static bundle for a classic single-scenario sim: plain
+    Python scalars read off ``SimParams``/``CompressedParams`` +
+    ``TimeConfig`` — const-folds into the pre-knob program."""
+    return RoundKnobs(
+        limit=params.resolved_retransmit_limit(),
+        keep_prob=1.0 - params.drop_prob,
+        push_pull_rounds=timecfg.push_pull_rounds,
+        sweep_rounds=timecfg.sweep_rounds,
+        refresh_rounds=timecfg.refresh_rounds,
+        recover_rounds=recover_rounds,
+        suspicion_window=timecfg.suspicion_window,
+        alive_lifespan=timecfg.alive_lifespan,
+        draining_lifespan=timecfg.draining_lifespan,
+        tombstone_lifespan=timecfg.tombstone_lifespan,
+        stale_ticks=timecfg.stale_ticks,
+        churn_prob=churn_prob,
+        fault_seed=fault_seed,
+    )

@@ -73,7 +73,17 @@ def ttl_sweep(known, now_tick, *, alive_lifespan, draining_lifespan,
     is_tomb = present & (st == TOMBSTONE)
     gc = is_tomb & (ts < now_tick - tombstone_lifespan)
 
-    if suspicion_window > 0:
+    static_window = isinstance(suspicion_window, (int, float))
+
+    def plain():
+        lifespan = jnp.where(st == DRAINING, draining_lifespan,
+                             alive_lifespan)
+        expired = present & ~is_tomb & (ts < now_tick - lifespan)
+        swept = jnp.where(expired, pack(ts + one_second, TOMBSTONE),
+                          known)
+        return swept, expired
+
+    def quarantine():
         # Quarantine-before-tombstone: fresh expiries of suspectable
         # records become SUSPECT at the original ts; a SUSPECT record
         # tombstones only once the grace window has ALSO lapsed.
@@ -87,12 +97,25 @@ def ttl_sweep(known, now_tick, *, alive_lifespan, draining_lifespan,
         swept = jnp.where(to_suspect, pack(ts, SUSPECT), known)
         swept = jnp.where(expired, pack(ts + one_second, TOMBSTONE),
                           swept)
-        swept = jnp.where(gc, 0, swept)
         return swept, expired
 
-    lifespan = jnp.where(st == DRAINING, draining_lifespan, alive_lifespan)
-    expired = present & ~is_tomb & (ts < now_tick - lifespan)
+    if static_window and suspicion_window <= 0:
+        swept, expired = plain()
+    elif static_window:
+        swept, expired = quarantine()
+    else:
+        # Traced window (the fleet's per-scenario knob, ops/knobs.py):
+        # BOTH forms are computed elementwise and selected on
+        # ``window > 0`` — a plain jnp.where, NOT the quarantine math
+        # evaluated at window 0, because the two differ there: the
+        # quarantine form parks a fresh expiry in SUSPECT for one sweep
+        # even with a zero window, while the static window-0 contract
+        # (pinned bit-for-bit since PR 7) tombstones it immediately.
+        on = jnp.asarray(suspicion_window) > 0
+        swept_q, expired_q = quarantine()
+        swept_p, expired_p = plain()
+        swept = jnp.where(on, swept_q, swept_p)
+        expired = jnp.where(on, expired_q, expired_p)
 
-    swept = jnp.where(expired, pack(ts + one_second, TOMBSTONE), known)
     swept = jnp.where(gc, 0, swept)
     return swept, expired

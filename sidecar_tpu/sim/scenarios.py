@@ -32,10 +32,9 @@ import jax
 import numpy as np
 
 from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
-from sidecar_tpu.models.exact import ExactSim, SimParams, SimState
+from sidecar_tpu.models.exact import ExactSim, SimParams
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import topology as topo_mod
-from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, pack, unpack_status, unpack_ts
 
 
 @dataclasses.dataclass
@@ -159,31 +158,17 @@ def _churn_perturb(params: SimParams, timecfg: TimeConfig,
                    churn_prob_per_round: float):
     """Service churn: each round a Bernoulli subset of slots restarts —
     old instance tombstoned by its owner, a successor announced with a
-    fresh timestamp (the owner-side analog of Docker die/start events)."""
-    spn = params.services_per_node
+    fresh timestamp (the owner-side analog of Docker die/start events).
 
-    def perturb(state: SimState, key: jax.Array, now):
-        import jax.numpy as jnp
+    ONE implementation shared with the fleet plane
+    (``fleet/batch.restart_churn_perturb`` — lazy import, the fleet
+    package imports this module's validators at load time): the fleet
+    runs it knob-driven per scenario, the scenarios run it at a static
+    probability."""
+    del timecfg  # cadence-free: the probability is already per round
+    from sidecar_tpu.fleet.batch import restart_churn_perturb
 
-        owner = jnp.arange(params.m, dtype=jnp.int32) // spn
-        cols = jnp.arange(params.m, dtype=jnp.int32)
-        churn = jax.random.bernoulli(key, churn_prob_per_round,
-                                     (params.m,))
-        own = state.known[owner, cols]
-        live = unpack_ts(own) > 0
-        flip = churn & live & state.node_alive[owner]
-        st = unpack_status(own)
-        # Restart: the record's status flips through TOMBSTONE half the
-        # time, else it re-announces ALIVE at now (a redeploy).
-        new_status = jnp.where(st == ALIVE, TOMBSTONE, ALIVE)
-        new_val = jnp.where(flip, pack(now, new_status), own)
-        known = state.known.at[owner, cols].set(new_val)
-        reset_rows = jnp.where(flip, owner, params.n)
-        sent = state.sent.at[reset_rows, cols].set(jnp.int8(0),
-                                                   mode="drop")
-        return dataclasses.replace(state, known=known, sent=sent)
-
-    return perturb
+    return restart_churn_perturb(params, prob=churn_prob_per_round)
 
 
 def config3_er_churn(eps: float = 0.01, rounds: int = 1200,
@@ -417,14 +402,79 @@ def config6_chaos(eps: float = 1e-3, scale: float = 1.0,
                       "throughout; heal drains the backlog")
 
 
-ALL_SCENARIOS: dict[str, Callable[..., ScenarioResult]] = {
-    "config1": config1_static_merge,
-    "config2": config2_ring,
-    "config3": config3_er_churn,
-    "config4": config4_ba_antientropy,
-    "config5": config5_split_heal,
-    "config6": config6_chaos,
-}
+# -- registration + validation ----------------------------------------------
+# Scenario configs are validated at REGISTRATION time: a bad fanout or
+# transmit limit must fail here with a named error, not 400 rounds into
+# a compiled scan as an inscrutable shape/int8 failure.  The fleet
+# plane (sidecar_tpu/fleet/batch.py) routes every grid point through
+# :func:`validate_protocol_config` for the same reason.
+
+ALL_SCENARIOS: dict[str, Callable[..., ScenarioResult]] = {}
+
+
+def register_scenario(name: str, fn: Callable[..., ScenarioResult],
+                      *, replace: bool = False) -> None:
+    """Register a runnable scenario under ``name``.
+
+    Duplicate names are rejected (two scenarios silently shadowing each
+    other is how a sweep reports the wrong config's numbers); pass
+    ``replace=True`` to overwrite deliberately."""
+    if not callable(fn):
+        raise TypeError(f"scenario {name!r}: fn must be callable, got "
+                        f"{type(fn).__name__}")
+    if not replace and name in ALL_SCENARIOS:
+        raise ValueError(
+            f"scenario {name!r} is already registered "
+            f"(to {ALL_SCENARIOS[name].__name__}); pick a distinct name "
+            "or pass replace=True")
+    ALL_SCENARIOS[name] = fn
+
+
+def validate_protocol_config(n: int, *, fanout: int, budget: int,
+                             retransmit_limit: int = 0,
+                             services_per_node: int = 1,
+                             name: str = "scenario") -> None:
+    """Range-check the protocol knobs a scenario/grid point declares.
+
+    Raises ``ValueError`` naming the offending knob and its bound —
+    the registration-time twin of the mid-scan failures these values
+    would otherwise cause (fanout shapes the sampled-peer tensor;
+    the transmit limit must keep the int8 ``sent`` counters
+    representable, ops/gossip.record_transmissions)."""
+    label = f"{name}: " if name else ""
+    if n < 1:
+        raise ValueError(f"{label}n={n} must be >= 1")
+    if services_per_node < 1:
+        raise ValueError(
+            f"{label}services_per_node={services_per_node} must be >= 1")
+    if not 1 <= fanout:
+        raise ValueError(f"{label}fanout={fanout} must be >= 1")
+    if n > 1 and fanout >= n:
+        raise ValueError(
+            f"{label}fanout={fanout} must be < n={n} (a node cannot "
+            "gossip to more distinct peers than exist)")
+    if budget < 1:
+        raise ValueError(f"{label}budget={budget} must be >= 1")
+    if retransmit_limit < 0:
+        raise ValueError(
+            f"{label}retransmit_limit={retransmit_limit} must be >= 0 "
+            "(0 = auto: RetransmitMult x ceil(log10(n+1)))")
+    resolved = retransmit_limit if retransmit_limit > 0 else \
+        4 * math.ceil(math.log10(n + 1))
+    if resolved + fanout - 1 > 127:
+        raise ValueError(
+            f"{label}retransmit_limit={resolved} + fanout={fanout} - 1 "
+            "exceeds the int8 transmit counter range (127)")
+
+
+for _name, _fn in (
+        ("config1", config1_static_merge),
+        ("config2", config2_ring),
+        ("config3", config3_er_churn),
+        ("config4", config4_ba_antientropy),
+        ("config5", config5_split_heal),
+        ("config6", config6_chaos)):
+    register_scenario(_name, _fn)
 
 _SCALED = ("config3", "config4", "config5", "config6")
 

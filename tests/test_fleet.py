@@ -1,0 +1,472 @@
+"""Scenario-fleet engine tests (sidecar_tpu/fleet, docs/sweep.md).
+
+The load-bearing contract is the vmap lockstep oracle: a batch of S
+scenarios must be bit-identical, PER SCENARIO, to S independent
+unbatched runs of the matching classic sims — on the exact family
+(incl. a suspicion-window scenario and knob-driven churn), the
+compressed family (per-scenario mint bursts), and the chaos family
+(shared FaultPlan structure, per-scenario fault seeds).  Plus: the
+converged-mask early-exit contract, grid expansion/chunking/Pareto,
+registration-time validation, the ("scenario", "node") mesh, and the
+``POST /sweep`` HTTP round trip.
+"""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from sidecar_tpu.fleet import (
+    FleetSim,
+    ScenarioBatch,
+    ScenarioSpec,
+    build_batches,
+    expand_grid,
+    pareto_front,
+    restart_churn_perturb,
+)
+from sidecar_tpu.fleet.engine import fleet_mesh
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology as topo_mod
+
+BASE = TimeConfig(refresh_interval_s=10_000.0, push_pull_interval_s=2.0)
+
+EXACT_PARAMS = SimParams(n=16, services_per_node=2, fanout=3, budget=5)
+
+# The exact-family oracle matrix: loss, transmit limit, push-pull
+# cadence, an ACTIVE suspicion window (tight clocks so expiry +
+# quarantine + refutation all happen inside the horizon), and
+# knob-driven churn.
+EXACT_SPECS = (
+    ScenarioSpec(name="plain", seed=1),
+    ScenarioSpec(name="lossy", seed=2, drop_prob=0.15),
+    ScenarioSpec(name="limit8", seed=3, retransmit_limit=8),
+    ScenarioSpec(name="pp1", seed=4, push_pull_interval_s=1.0),
+    ScenarioSpec(name="suspicion", seed=5, suspicion_window_s=1.0,
+                 alive_lifespan_s=2.0, sweep_interval_s=0.4,
+                 refresh_interval_s=4.0),
+    ScenarioSpec(name="churny", seed=6, churn_prob=0.01),
+)
+
+
+def exact_reference(batch, i, rounds, topo):
+    """Scenario ``i``'s unbatched classic run — the oracle side."""
+    spec = batch.specs[i]
+    perturb = (restart_churn_perturb(batch.scenario_params(i),
+                                     prob=spec.churn_prob)
+               if spec.churn_prob > 0 else None)
+    sim = ExactSim(batch.scenario_params(i), topo,
+                   batch.scenario_timecfg(i), perturb=perturb)
+    return sim.run(sim.init_state(), jax.random.PRNGKey(spec.seed),
+                   rounds)
+
+
+class TestExactLockstep:
+    R = 40
+
+    @pytest.fixture(scope="class")
+    def fleet_run(self):
+        batch = ScenarioBatch.build(EXACT_SPECS, EXACT_PARAMS, BASE,
+                                    family="exact")
+        fleet = FleetSim(batch)
+        return batch, fleet.run(fleet.init_states(), self.R, eps=0.01,
+                                stop=False)
+
+    def test_batch_matches_unbatched_runs(self, fleet_run):
+        batch, run = fleet_run
+        topo = topo_mod.complete(EXACT_PARAMS.n)
+        for i, spec in enumerate(batch.specs):
+            final, conv = exact_reference(batch, i, self.R, topo)
+            for name in ("known", "sent", "node_alive", "round_idx"):
+                assert np.array_equal(
+                    np.asarray(getattr(run.final_states, name))[i],
+                    np.asarray(getattr(final, name))), \
+                    f"{spec.name}: {name} diverged from unbatched run"
+            assert np.array_equal(run.convergence[:, i],
+                                  np.asarray(conv)), \
+                f"{spec.name}: convergence curve diverged"
+
+    def test_suspicion_scenario_quarantined(self, fleet_run):
+        """The suspicion lane actually exercised the subprotocol: its
+        knobs differ from its window-0 twin's outcome."""
+        batch, run = fleet_run
+        i = [s.name for s in batch.specs].index("suspicion")
+        topo = topo_mod.complete(EXACT_PARAMS.n)
+        twin_cfg = dataclasses.replace(batch.scenario_timecfg(i),
+                                       suspicion_window_s=0.0)
+        sim = ExactSim(batch.scenario_params(i), topo, twin_cfg)
+        final, _ = sim.run(sim.init_state(),
+                           jax.random.PRNGKey(batch.specs[i].seed),
+                           self.R)
+        assert not np.array_equal(
+            np.asarray(run.final_states.known)[i],
+            np.asarray(final.known)), \
+            "suspicion window had no effect — the scenario never " \
+            "entered quarantine (tighten the clocks)"
+
+    def test_stats_census(self, fleet_run):
+        batch, run = fleet_run
+        assert (run.rounds == self.R).all()          # stop=False
+        assert (run.exchange_bytes > 0).all()
+        assert (run.frontier_max > 0).all()
+        assert (run.frontier_max <= EXACT_PARAMS.n).all()
+
+
+class TestCompressedLockstep:
+    R = 30
+    PARAMS = CompressedParams(n=32, services_per_node=4, cache_lines=16)
+    SPECS = (
+        ScenarioSpec(name="a", seed=1, mint_frac=0.05),
+        ScenarioSpec(name="b", seed=2, mint_frac=0.05, drop_prob=0.1),
+        ScenarioSpec(name="c", seed=3, mint_frac=0.08,
+                     retransmit_limit=8),
+        ScenarioSpec(name="d", seed=4, mint_frac=0.05,
+                     push_pull_interval_s=1.0, suspicion_window_s=1.0,
+                     alive_lifespan_s=3.0, sweep_interval_s=0.4,
+                     refresh_interval_s=4.0),
+    )
+
+    def test_batch_matches_unbatched_runs(self):
+        batch = ScenarioBatch.build(self.SPECS, self.PARAMS, BASE,
+                                    family="compressed")
+        fleet = FleetSim(batch)
+        run = fleet.run(fleet.init_states(), self.R, eps=1e-3,
+                        stop=False)
+        topo = topo_mod.complete(self.PARAMS.n)
+        for i, spec in enumerate(batch.specs):
+            sim = CompressedSim(batch.scenario_params(i), topo,
+                                batch.scenario_timecfg(i))
+            st = sim.mint(sim.init_state(), batch.mint_slots(i),
+                          spec.mint_tick)
+            final, conv = sim.run(st, jax.random.PRNGKey(spec.seed),
+                                  self.R)
+            for name in ("own", "cache_slot", "cache_val", "cache_sent",
+                         "floor", "node_alive", "round_idx",
+                         "evictions", "dropped"):
+                assert np.array_equal(
+                    np.asarray(getattr(run.final_states, name))[i],
+                    np.asarray(getattr(final, name))), \
+                    f"{spec.name}: {name} diverged from unbatched run"
+            assert np.array_equal(run.convergence[:, i],
+                                  np.asarray(conv)), \
+                f"{spec.name}: convergence curve diverged"
+
+
+class TestChaosLockstep:
+    """A FaultPlan-bearing batch: shared structure (20% one-way loss +
+    a pause window), per-scenario fault seeds re-rooting the fault
+    PRNG."""
+
+    R = 25
+
+    def _plan(self, n):
+        from sidecar_tpu.chaos import EdgeFault, FaultPlan, NodeFault
+        side_a = tuple(range(n // 2))
+        side_b = tuple(range(n // 2, n))
+        return FaultPlan(
+            seed=7,
+            edges=(EdgeFault(src=side_a, dst=side_b, drop_prob=0.2),),
+            nodes=(NodeFault(nodes=(1, 2), start_round=5, end_round=15,
+                             kind="pause"),))
+
+    def test_batch_matches_unbatched_chaos_runs(self):
+        from sidecar_tpu.chaos import ChaosExactSim
+
+        n = 16
+        params = SimParams(n=n, services_per_node=2, fanout=3, budget=5)
+        plan = self._plan(n)
+        specs = (
+            ScenarioSpec(name="fs7", seed=1, fault_seed=7),
+            ScenarioSpec(name="fs8", seed=1, fault_seed=8),
+            ScenarioSpec(name="fs9-lossy", seed=2, fault_seed=9,
+                         drop_prob=0.05),
+            # Churn under chaos: pins the wants_knobs perturb dispatch
+            # on ChaosExactSim (post-review regression).
+            ScenarioSpec(name="fs7-churny", seed=3, fault_seed=7,
+                         churn_prob=0.01),
+        )
+        batch = ScenarioBatch.build(specs, params, BASE, family="exact",
+                                    plan=plan)
+        fleet = FleetSim(batch)
+        run = fleet.run(fleet.init_states(), self.R, stop=False)
+        topo = topo_mod.complete(n)
+        for i, spec in enumerate(batch.specs):
+            perturb = (restart_churn_perturb(batch.scenario_params(i),
+                                             prob=spec.churn_prob)
+                       if spec.churn_prob > 0 else None)
+            sim = ChaosExactSim(batch.scenario_params(i), topo,
+                                batch.scenario_timecfg(i),
+                                plan=batch.scenario_plan(i),
+                                perturb=perturb)
+            final, conv = sim.run(sim.init_state(),
+                                  jax.random.PRNGKey(spec.seed), self.R)
+            for name in ("known", "sent", "node_alive", "round_idx"):
+                assert np.array_equal(
+                    np.asarray(getattr(run.final_states.sim, name))[i],
+                    np.asarray(getattr(final.sim, name))), \
+                    f"{spec.name}: {name} diverged"
+            for name in ("injected_drops", "injected_delays",
+                         "injected_dups"):
+                assert int(np.asarray(
+                    getattr(run.final_states, name))[i]) == \
+                    int(np.asarray(getattr(final, name))), \
+                    f"{spec.name}: {name} diverged"
+            assert np.array_equal(run.convergence[:, i],
+                                  np.asarray(conv))
+        # Distinct fault seeds produce distinct fault schedules.
+        drops = np.asarray(run.final_states.injected_drops)
+        assert drops[0] != drops[1]
+
+
+class TestEarlyExit:
+    R = 60
+
+    def _batch(self):
+        specs = [ScenarioSpec(name=f"s{i}", seed=i,
+                              drop_prob=0.02 * (i % 3))
+                 for i in range(4)]
+        return ScenarioBatch.build(specs, EXACT_PARAMS, BASE,
+                                   family="exact")
+
+    def test_stop_freezes_at_crossing(self):
+        batch = self._batch()
+        fleet = FleetSim(batch)
+        run = fleet.run(fleet.init_states(), self.R, eps=0.0, stop=True)
+        assert all(er is not None for er in run.eps_round)
+        for i, er in enumerate(run.eps_round):
+            assert run.rounds[i] == er, \
+                "a frozen scenario kept executing rounds"
+            # The curve is flat (and converged) from the crossing on.
+            tail = run.convergence[er - 1:, i]
+            assert np.all(tail == tail[0])
+            assert tail[0] >= 1.0
+        assert (run.rounds < self.R).all()
+
+    def test_stop_false_is_bitidentical_and_records_eps(self):
+        b1, b2 = self._batch(), self._batch()
+        f1, f2 = FleetSim(b1), FleetSim(b2)
+        full = f1.run(f1.init_states(), self.R, eps=0.0, stop=False)
+        stop = f2.run(f2.init_states(), self.R, eps=0.0, stop=True)
+        assert full.eps_round == stop.eps_round
+        assert (full.rounds == self.R).all()
+        # Early exit only ever REDUCES the accounted bytes.
+        assert (stop.exchange_bytes <= full.exchange_bytes).all()
+
+    def test_fast_driver_matches_conv_driver(self):
+        """The curve-free bench driver (`_run_fast_fleet_jit`) runs the
+        same body: identical final states and summary stats, empty
+        curve."""
+        b1, b2 = self._batch(), self._batch()
+        f1, f2 = FleetSim(b1), FleetSim(b2)
+        r1 = f1.run(f1.init_states(), 20, eps=0.0, stop=False)
+        r2 = f2.run(f2.init_states(), 20, eps=0.0, stop=False,
+                    curve=False)
+        for name in ("known", "sent", "node_alive", "round_idx"):
+            assert np.array_equal(
+                np.asarray(getattr(r1.final_states, name)),
+                np.asarray(getattr(r2.final_states, name)))
+        assert r1.eps_round == r2.eps_round
+        assert np.array_equal(r1.exchange_bytes, r2.exchange_bytes)
+        assert r2.convergence.shape[0] == 0
+
+
+class TestMeshFleet:
+    """The ("scenario", "node") sharded fleet is bit-identical on the
+    integer protocol state to the single-device fleet (float curves
+    compare with tolerance — GSPMD reduction order)."""
+
+    R = 30
+
+    def _run(self, mesh=None):
+        specs = [ScenarioSpec(name=f"s{i}", seed=i) for i in range(8)]
+        batch = ScenarioBatch.build(specs, EXACT_PARAMS, BASE,
+                                    family="exact")
+        fleet = FleetSim(batch, mesh=mesh)
+        return fleet.run(fleet.init_states(), self.R, stop=False)
+
+    @pytest.mark.parametrize("shape", [(8, 1), (2, 4)])
+    def test_mesh_lockstep(self, shape):
+        ref = self._run()
+        run = self._run(mesh=fleet_mesh(*shape))
+        for name in ("known", "sent", "node_alive", "round_idx"):
+            assert np.array_equal(
+                np.asarray(getattr(run.final_states, name)),
+                np.asarray(getattr(ref.final_states, name)))
+        assert np.allclose(run.convergence, ref.convergence, atol=1e-6)
+
+    def test_mesh_validation(self):
+        specs = [ScenarioSpec(name=f"s{i}", seed=i) for i in range(3)]
+        batch = ScenarioBatch.build(specs, EXACT_PARAMS, BASE,
+                                    family="exact")
+        with pytest.raises(ValueError, match="divide the scenario"):
+            FleetSim(batch, mesh=fleet_mesh(2, 1))
+
+
+class TestBatchValidation:
+    def test_duplicate_names_rejected(self):
+        with pytest.raises(ValueError, match="duplicate scenario name"):
+            ScenarioBatch.build(
+                [ScenarioSpec(name="x"), ScenarioSpec(name="x")],
+                EXACT_PARAMS, BASE)
+
+    def test_fanout_is_compile_key(self):
+        with pytest.raises(ValueError, match="compile-key"):
+            ScenarioBatch.build(
+                [ScenarioSpec(name="x", fanout=5)], EXACT_PARAMS, BASE)
+
+    def test_limit_overflow_named_error(self):
+        with pytest.raises(ValueError, match="int8 transmit"):
+            ScenarioBatch.build(
+                [ScenarioSpec(name="x", retransmit_limit=126)],
+                EXACT_PARAMS, BASE)
+
+    def test_probability_range(self):
+        with pytest.raises(ValueError, match="drop_prob"):
+            ScenarioBatch.build(
+                [ScenarioSpec(name="x", drop_prob=1.5)],
+                EXACT_PARAMS, BASE)
+
+    def test_fault_seed_needs_plan(self):
+        with pytest.raises(ValueError, match="fault_seed"):
+            ScenarioBatch.build(
+                [ScenarioSpec(name="x", fault_seed=3)],
+                EXACT_PARAMS, BASE)
+
+    def test_base_params_drop_prob_inherited(self):
+        """A spec without its own drop_prob inherits the BASE params'
+        loss (post-review regression: the knob must match
+        ``scenario_params(i)``, which keeps the base drop_prob)."""
+        import dataclasses as dc
+        params = dc.replace(EXACT_PARAMS, drop_prob=0.1)
+        batch = ScenarioBatch.build(
+            [ScenarioSpec(name="inherit"),
+             ScenarioSpec(name="own", drop_prob=0.3)], params, BASE)
+        keep = np.asarray(batch.knobs.keep_prob)
+        assert keep[0] == np.float32(0.9)
+        assert keep[1] == np.float32(0.7)
+        assert batch.scenario_params(0).drop_prob == 0.1
+
+    def test_family_churn_mismatch(self):
+        with pytest.raises(ValueError, match="mint_frac"):
+            ScenarioBatch.build(
+                [ScenarioSpec(name="x", churn_prob=0.1)],
+                CompressedParams(n=16, services_per_node=2,
+                                 cache_lines=16, budget=5),
+                BASE, family="compressed")
+        with pytest.raises(ValueError, match="churn_prob"):
+            ScenarioBatch.build(
+                [ScenarioSpec(name="x", mint_frac=0.1)],
+                EXACT_PARAMS, BASE, family="exact")
+
+
+class TestGrid:
+    def test_expand_and_chunk(self):
+        specs = expand_grid({"drop_prob": [0.0, 0.1],
+                             "push_pull_interval_s": [1.0, 2.0]})
+        assert len(specs) == 4
+        assert len({s.name for s in specs}) == 4
+        batches = build_batches(specs, EXACT_PARAMS, BASE,
+                                max_batch=3)
+        sizes = [b.size for b, _ in batches]
+        assert sizes == [3, 1]
+        covered = sorted(i for _, idxs in batches for i in idxs)
+        assert covered == [0, 1, 2, 3]
+
+    def test_compile_key_axes_group(self):
+        specs = expand_grid({"fanout": [2, 3], "drop_prob": [0.0, 0.1]})
+        batches = build_batches(specs, EXACT_PARAMS, BASE)
+        assert len(batches) == 2
+        fanouts = sorted(b.params.fanout for b, _ in batches)
+        assert fanouts == [2, 3]
+        for b, _ in batches:
+            assert all((s.fanout or b.params.fanout) == b.params.fanout
+                       for s in b.specs)
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError, match="unknown grid axis"):
+            expand_grid({"fanuot": [2, 3]})
+
+    def test_pareto_front(self):
+        rows = [
+            {"rounds_to_eps": 10, "exchange_bytes": 100},   # on front
+            {"rounds_to_eps": 5, "exchange_bytes": 200},    # on front
+            {"rounds_to_eps": 12, "exchange_bytes": 100},   # dominated
+            {"rounds_to_eps": None, "exchange_bytes": 1},   # never conv
+            {"rounds_to_eps": 5, "exchange_bytes": 300},    # dominated
+        ]
+        assert pareto_front(rows) == [0, 1]
+
+
+class TestSweepHttp:
+    """POST /sweep round trip on the bridge (grid in → Pareto table
+    out; malformed grid → 400 with a parseable error body)."""
+
+    def _bridge(self):
+        from tests.test_bridge import CFG, make_state
+
+        from sidecar_tpu.bridge import SimBridge
+        return SimBridge(make_state(), CFG)
+
+    def test_round_trip(self):
+        from sidecar_tpu.bridge import serve_bridge
+
+        server = serve_bridge(self._bridge(), port=0)
+        try:
+            port = server.server_address[1]
+            body = json.dumps({
+                "axes": {"drop_prob": [0.0, 0.1],
+                         "push_pull_interval_s": [1.0, 2.0]},
+                "rounds": 30, "eps": 0.05, "n": 12,
+                "services_per_node": 2, "budget": 5,
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/sweep", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                doc = json.loads(resp.read())
+            assert doc["points"] == 4
+            assert len(doc["table"]) == 4
+            for row in doc["table"]:
+                assert "rounds_to_eps" in row
+                assert "exchange_bytes" in row
+                assert "config" in row
+            front = doc["pareto_front"]
+            assert front and all(0 <= i < 4 for i in front)
+            # Front rows genuinely converged.
+            for i in front:
+                assert doc["table"][i]["rounds_to_eps"] is not None
+        finally:
+            server.shutdown()
+
+    def test_malformed_grid_is_400(self):
+        from sidecar_tpu.bridge import serve_bridge
+
+        server = serve_bridge(self._bridge(), port=0)
+        try:
+            port = server.server_address[1]
+            for bad in (
+                    {"axes": {"fanuot": [2]}},          # unknown axis
+                    {"axes": {}},                        # empty
+                    {"axes": {"drop_prob": [2.0]},       # out of range
+                     "n": 12},
+                    {"rounds": 10},                      # missing axes
+                    {"axes": {"fault_seed": [1, 2]}},    # library-only
+                    {"axes": {"mint_frac": [0.01]}},     # library-only
+            ):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/sweep",
+                    data=json.dumps(bad).encode(),
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 400
+                doc = json.loads(err.value.read())
+                assert doc["message"]
+        finally:
+            server.shutdown()

@@ -50,6 +50,21 @@ class TestRepoIsClean:
                 f"{expected} not seen by the checker:\n{names}")
         assert all(d.endswith(" donates") for d in sparse), names
 
+    def test_fleet_scan_drivers_are_covered(self):
+        """Round-10 satellite: the vmapped fleet drivers
+        (fleet/engine.py) must be SEEN by the donate-or-waiver
+        contract — the donation invariant extends to the fleet plane —
+        and all of them donate their stacked state."""
+        drivers = list_drivers(REPO / "sidecar_tpu")
+        fleet = [d for d in drivers if "_fleet_jit" in d]
+        names = "\n".join(fleet)
+        for expected in (
+                "fleet/engine.py:_run_conv_fleet_jit",
+                "fleet/engine.py:_run_fast_fleet_jit"):
+            assert any(expected in d for d in fleet), (
+                f"{expected} not seen by the checker:\n{names}")
+        assert all(d.endswith(" donates") for d in fleet), names
+
     def test_cli_list_mode(self):
         proc = subprocess.run(
             [sys.executable, str(REPO / "tools" /

@@ -216,3 +216,55 @@ class TestCheckpoint:
         save_state(tmp_path / "stale.npz", bad, p)
         with pytest.raises(ValueError, match="cache layout mismatch"):
             load_state(tmp_path / "stale.npz")
+
+
+class TestRegistration:
+    """Round-10 satellite: scenario configs are validated at
+    REGISTRATION — duplicate names and out-of-range fanout/transmit
+    values fail with a named error, not a mid-scan shape failure."""
+
+    def test_builtin_scenarios_registered(self):
+        for name in ("config1", "config2", "config3", "config4",
+                     "config5", "config6"):
+            assert name in scenarios.ALL_SCENARIOS
+
+    def test_duplicate_name_rejected(self):
+        with pytest.raises(ValueError, match="already registered"):
+            scenarios.register_scenario("config1", lambda: None)
+
+    def test_replace_is_explicit(self):
+        original = scenarios.ALL_SCENARIOS["config1"]
+        try:
+            scenarios.register_scenario("config1", original,
+                                        replace=True)
+        finally:
+            scenarios.ALL_SCENARIOS["config1"] = original
+
+    def test_non_callable_rejected(self):
+        with pytest.raises(TypeError, match="callable"):
+            scenarios.register_scenario("bogus", 42)
+
+    def test_fanout_out_of_range(self):
+        with pytest.raises(ValueError, match="fanout=16 must be < n=16"):
+            scenarios.validate_protocol_config(16, fanout=16, budget=5)
+        with pytest.raises(ValueError, match="fanout=0"):
+            scenarios.validate_protocol_config(16, fanout=0, budget=5)
+
+    def test_transmit_limit_out_of_range(self):
+        with pytest.raises(ValueError, match="int8 transmit"):
+            scenarios.validate_protocol_config(
+                16, fanout=3, budget=5, retransmit_limit=126)
+        with pytest.raises(ValueError, match="retransmit_limit=-1"):
+            scenarios.validate_protocol_config(
+                16, fanout=3, budget=5, retransmit_limit=-1)
+
+    def test_budget_and_sizes(self):
+        with pytest.raises(ValueError, match="budget=0"):
+            scenarios.validate_protocol_config(16, fanout=3, budget=0)
+        with pytest.raises(ValueError, match="n=0"):
+            scenarios.validate_protocol_config(0, fanout=1, budget=1)
+
+    def test_valid_config_passes(self):
+        scenarios.validate_protocol_config(
+            16, fanout=3, budget=15, retransmit_limit=8,
+            services_per_node=4, name="ok")
