@@ -607,6 +607,28 @@ def main() -> None:
             _watchdog_note("robustness", {"robustness": robustness})
         except Exception as exc:  # the headline must survive a side bench
             print(f"# robustness bench failed: {exc}", file=sys.stderr)
+    # Clock-skew sub-block (benchmarks/robustness.run_skew): one
+    # rushing + one slow node, future-admission bound OFF vs ON.
+    # BENCH_ROBUSTNESS_SKEW=0 skips it; BENCH_ROBUSTNESS_SKEW_RUSH_S /
+    # BENCH_ROBUSTNESS_SKEW_SLOW_S set the skew magnitudes (seconds),
+    # BENCH_ROBUSTNESS_SKEW_FUDGE_S the bound used for the ON run.
+    if robustness is not None and \
+            os.environ.get("BENCH_ROBUSTNESS_SKEW", "1") != "0":
+        try:
+            from benchmarks.robustness import run_skew
+            _watchdog_note("robustness-skew")
+            robustness["clock_skew"] = run_skew(
+                n=int(os.environ.get("BENCH_ROBUSTNESS_NODES", "128")),
+                rush_s=float(os.environ.get(
+                    "BENCH_ROBUSTNESS_SKEW_RUSH_S", "60")),
+                slow_s=float(os.environ.get(
+                    "BENCH_ROBUSTNESS_SKEW_SLOW_S", "120")),
+                future_fudge_s=float(os.environ.get(
+                    "BENCH_ROBUSTNESS_SKEW_FUDGE_S", "0.5")))
+            _watchdog_note("robustness-skew",
+                           {"clock_skew": robustness["clock_skew"]})
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# clock-skew bench failed: {exc}", file=sys.stderr)
 
     # Scenario-fleet sweep (benchmarks/sweep.py, docs/sweep.md): the
     # 64-point protocol grid in ONE vmapped dispatch vs the per-point

@@ -33,8 +33,18 @@ carried state count:
 the headline ratio is read at comparable convergence — suspicion must
 not buy robustness by simply converging slower.
 
+The ``clock_skew`` sub-block (:func:`run_skew`) swaps the pause
+windows for a clock-skew pair — one node rushing minutes ahead, one
+the same amount behind (``ClockFault``) — and runs the
+future-admission bound (``TimeConfig.future_fudge_s``,
+ops/merge.admit_gate) OFF vs ON: bound off, the rushing node's
+future-stamped records and tombstones win every LWW merge and cannot
+be refuted until real time catches up; bound on, receivers reject
+them at admission and convergence matches the no-skew baseline.
+
 Run standalone: ``python benchmarks/robustness.py [n]`` — prints the
-JSON block bench.py embeds (BENCH_ROBUSTNESS=0 skips it there).
+JSON block bench.py embeds (BENCH_ROBUSTNESS=0 skips it there;
+BENCH_ROBUSTNESS_SKEW=0 skips just the skew sub-block).
 """
 
 from __future__ import annotations
@@ -70,6 +80,191 @@ def robustness_plan(n: int, seed: int = 6, pause_len: int = 35,
         edges=(EdgeFault(src=side_a, dst=side_b, drop_prob=0.2),),
         nodes=tuple(node_faults),
     )
+
+
+def skew_plan(n: int, rush_ticks: int, slow_ticks: int,
+              start_round: int = 10, end_round: int = 300,
+              seed: int = 6):
+    """Config6-style loss plus a clock-skew pair: one RUSHING node
+    stamping ``rush_ticks`` in the future and one SLOW node
+    ``slow_ticks`` behind, both inside a bounded window (the fault
+    "ends" when NTP fixes the clock) — the docs/chaos.md skew
+    methodology.  With both skews 0 the plan has no clock entries (the
+    no-skew baseline compiles the pre-skew round).
+
+    The rushing skew must stay under ``alive_lifespan − refresh``:
+    past it, the rushing node's own TTL sweep expires every record it
+    sees and mints tombstones at *original ts + 1 s* (the ops/ttl.py
+    +1 s rule) — HONEST stamps the future bound rightly admits, a
+    separate pathology the suspicion plane owns (docs/chaos.md)."""
+    from sidecar_tpu.chaos import ClockFault, EdgeFault, FaultPlan
+
+    side_a = tuple(range(n // 2))
+    side_b = tuple(range(n // 2, n))
+    clocks = ()
+    if rush_ticks or slow_ticks:
+        clocks = (
+            ClockFault(nodes=(n - 1,), start_round=start_round,
+                       end_round=end_round, offset_ticks=rush_ticks),
+            ClockFault(nodes=(n - 2,), start_round=start_round,
+                       end_round=end_round, offset_ticks=-slow_ticks),
+        )
+    return FaultPlan(
+        seed=seed,
+        edges=(EdgeFault(src=side_a, dst=side_b, drop_prob=0.2),),
+        clocks=clocks,
+    )
+
+
+def _measure_skew(n: int, spn: int, rounds: int, rush_s: float,
+                  slow_s: float, future_fudge_s: float, eps: float,
+                  seed: int) -> dict:
+    """One skew run: the loss backdrop plus the rushing/slow pair,
+    measured for the poisoning the future-admission bound exists to
+    stop.
+
+    * ``poisoned_rows_final`` — cells in HONEST nodes' tables whose
+      stamp is ahead of the true clock at the end of the run.  Bound
+      off, the rushing node's future refresh stamps win every LWW
+      merge and out-stamp any refutation or tombstone until real time
+      catches up (a minute away — steady poison); bound on they are
+      rejected at admission and the count is zero.
+    * ``slow_fp_tombstones_final`` — the slow node's services sitting
+      TOMBSTONE in honest tables at the end.  While skewed, the slow
+      node's re-announces carry ancient stamps, so receivers expire
+      its services (the suspicion window, not the bound, is the
+      defense on this side — docs/chaos.md).
+    * ``fp_tombstones`` — every tombstone minted is a false positive
+      here (no process ever stops; the only faults are loss + clocks).
+
+    The two skewed nodes' own tables are excluded from the poison
+    count: the bound protects the CLUSTER from a bad clock, not the
+    bad-clock node from itself."""
+    import jax
+    import numpy as np
+
+    from sidecar_tpu.chaos import ChaosExactSim
+    from sidecar_tpu.models.exact import SimParams
+    from sidecar_tpu.models.timecfg import TimeConfig
+    from sidecar_tpu.ops import topology
+    from sidecar_tpu.ops.status import TOMBSTONE
+
+    # Refresh-scale clocks with a LONG alive lifespan: the rushing
+    # skew must stay under alive_lifespan − refresh_interval or the
+    # rushing node's own sweep tombstone-storms the cluster with
+    # honest (+1 s rule) stamps — the pathology the suspicion plane
+    # owns, which would drown the future-stamp poison this block
+    # isolates (see skew_plan).  The slow node's kill chain DOES run
+    # inside the fault window (the rushing node's skewed sweep expires
+    # the mute slow node's records around round 140): the minted
+    # tombstones carry ts+1 s stamps that are FUTURE relative to the
+    # slow node's floored clock, so with the bound on the slow node
+    # rejects its own eviction, keeps announcing, and resurrects when
+    # NTP fixes its clock — with the bound off it admits the tombstone
+    # into its own row and (tombstones are never refreshed) stays dead.
+    cfg = TimeConfig(refresh_interval_s=4.0, alive_lifespan_s=80.0,
+                     sweep_interval_s=0.4, push_pull_interval_s=1.0,
+                     suspicion_window_s=6.0,
+                     future_fudge_s=future_fudge_s)
+    params = SimParams(n=n, services_per_node=spn, fanout=3, budget=15)
+    skewed = bool(rush_s or slow_s)
+    sim = ChaosExactSim(params, topology.complete(n), cfg,
+                        plan=skew_plan(n, cfg.ticks(rush_s),
+                                       cfg.ticks(slow_s)))
+    cst = sim.init_state()
+    key = jax.random.PRNGKey(seed)
+
+    owner = np.arange(params.m) // spn
+    honest = np.ones(n, dtype=bool)
+    if skewed:
+        honest[[n - 1, n - 2]] = False
+
+    def status_of(row):
+        known = (row >> 3) > 0
+        return np.where(known, row & 7, -1)
+
+    prev_known = np.asarray(cst.sim.known)
+    fp_total = 0
+    eps_round = None
+    conv = 0.0
+    conv_tail = []
+
+    for r in range(rounds):
+        cst = sim.step(cst, jax.random.fold_in(key, cst.sim.round_idx))
+        known = np.asarray(cst.sim.known)
+        alive = np.asarray(cst.sim.node_alive)
+        st = status_of(known)
+        prev_st = status_of(prev_known)
+        entered = (st == TOMBSTONE) & (prev_st != TOMBSTONE)
+        fp_total += int((entered & alive[owner][None, :]).sum())
+        prev_known = known
+        conv = float(sim.convergence(cst))
+        if r >= (3 * rounds) // 4:
+            conv_tail.append(conv)
+        if eps_round is None and conv >= 1.0 - eps:
+            eps_round = r + 1
+
+    now_tick = int(cst.sim.round_idx) * cfg.round_ticks
+    ts = known >> 3
+    poisoned = int(((ts > now_tick) & honest[:, None]).sum())
+    slow_tomb = 0
+    if skewed:
+        slow_cols = owner == (n - 2)
+        slow_tomb = int(((st == TOMBSTONE) & slow_cols[None, :]
+                         & honest[:, None]).sum())
+
+    return {
+        "rush_s": rush_s,
+        "slow_s": slow_s,
+        "future_fudge_s": future_fudge_s,
+        "poisoned_rows_final": poisoned,
+        "slow_fp_tombstones_final": slow_tomb,
+        "fp_tombstones": fp_total,
+        "rejected_future": sim.injection_counts(cst)["rejected_future"],
+        "rounds_to_eps": eps_round,
+        "final_convergence": round(conv, 6),
+        "mean_tail_convergence": round(
+            sum(conv_tail) / max(len(conv_tail), 1), 6),
+    }
+
+
+def run_skew(n: int = 128, spn: int = 2, rounds: int = 400,
+             rush_s: float = 60.0, slow_s: float = 120.0,
+             future_fudge_s: float = 0.5, eps: float = 0.2,
+             seed: int = 6) -> dict:
+    """The bench ``robustness.clock_skew`` block: one rushing node at
+    +``rush_s`` and one slow node at −``slow_s`` under config6-style
+    loss, future-admission bound OFF vs ON, plus the no-skew baseline
+    the matched-convergence claim is read against.
+
+    The default fudge is 0.5 s — deliberately UNDER the ttl sweep's
+    +1 s supersede bump: a tombstone minted for a mute node's record
+    is stamped ``last_stamp + 1 s``, so a behind-clock node (floored
+    near its last stamp or below) sees its own premature eviction at
+    least ~1 s in its future and rejects it; a fudge over 1 s would
+    let the eviction into the node's own row, where it is permanent
+    (tombstones are never refreshed).  Legitimate traffic is stamped
+    at or before the receiver's present, so any non-negative fudge
+    admits it (docs/chaos.md)."""
+    from sidecar_tpu import metrics
+
+    baseline = _measure_skew(n, spn, rounds, 0.0, 0.0, -1.0, eps, seed)
+    off = _measure_skew(n, spn, rounds, rush_s, slow_s, -1.0, eps, seed)
+    on = _measure_skew(n, spn, rounds, rush_s, slow_s, future_fudge_s,
+                       eps, seed)
+
+    metrics.incr("clock.sim.rejectedFuture", on["rejected_future"])
+
+    return {
+        "scenario": "config6-style 20%% A->B loss + clock-skew pair "
+                    "(+%.0fs rushing / -%.0fs slow, rounds [10, 300)) "
+                    "(docs/chaos.md)" % (rush_s, slow_s),
+        "n": n,
+        "rounds": rounds,
+        "baseline_no_skew": baseline,
+        "bound_off": off,
+        "bound_on": on,
+    }
 
 
 def _measure(n: int, spn: int, rounds: int, suspicion_window_s: float,
@@ -219,7 +414,9 @@ def run_robustness(n: int = 128, spn: int = 2, rounds: int = 200,
 
 def main() -> int:
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
-    print(json.dumps(run_robustness(n=n), indent=2))
+    block = run_robustness(n=n)
+    block["clock_skew"] = run_skew(n=n)
+    print(json.dumps(block, indent=2))
     return 0
 
 

@@ -641,9 +641,11 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
     "trace": N (flight-recorder records for the first N rounds —
     docs/telemetry.md),
     "protocol": {"suspicion_window_s": S, "damping_half_life_s": H,
-    "damping_threshold": T, ...} — the suspicion/flap-damping knob
-    bundle (ops/suspicion.ProtocolParams); the report's ``robustness``
-    block carries the damping prediction (docs/chaos.md)}.
+    "damping_threshold": T, "future_fudge_s": F, ...} — the
+    suspicion/flap-damping/clock-bound knob bundle
+    (ops/suspicion.ProtocolParams; ``future_fudge_s`` < 0 disables the
+    future-admission gate — docs/chaos.md); the report's
+    ``robustness`` block carries the damping prediction}.
 
     POST /sweep {"axes": {axis: [values...]}, "rounds": N, "eps": E,
     "n": nodes, "services_per_node": S, "fanout": F, "budget": B,

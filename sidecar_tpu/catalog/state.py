@@ -179,6 +179,13 @@ class ServicesState:
         # resource generators consult it for admission.  None = the
         # subprotocol is off (SIDECAR_DAMPING_THRESHOLD unset).
         self.flap_damper = None
+        # Future-admission bound (SIDECAR_TPU_FUTURE_FUDGE, the live
+        # twin of ops/merge.future_mask): a record stamped beyond
+        # now + this many seconds is REJECTED at the writer — the
+        # symmetric counterpart of the is_stale staleness fudge, the
+        # defense against a rushing peer clock poisoning LWW.
+        # Negative = disabled (the reference behavior).
+        self.future_fudge_s: float = -1.0
 
     # -- time injection (tests) -------------------------------------------
 
@@ -292,6 +299,17 @@ class ServicesState:
                 log.warning("Dropping stale service received on gossip: "
                             "%s:%s (%s)", new_svc.hostname, new_svc.name,
                             new_svc.id)
+                return
+            if self.future_fudge_s >= 0 and new_svc.updated > \
+                    now + int(self.future_fudge_s * svc_mod.NS_PER_SECOND):
+                # Reject — never clamp: a clamped stamp would still win
+                # LWW against honest peers and freeze the record.
+                log.warning(
+                    "Dropping future-stamped service received on "
+                    "gossip: %s:%s (%s) is %.3fs ahead",
+                    new_svc.hostname, new_svc.name, new_svc.id,
+                    (new_svc.updated - now) / svc_mod.NS_PER_SECOND)
+                metrics.incr("clock.live.rejectedFuture")
                 return
 
             if not self.has_server(new_svc.hostname):

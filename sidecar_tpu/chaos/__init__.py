@@ -14,6 +14,7 @@ See docs/chaos.md for the schema and the reproduce-from-seed workflow.
 """
 
 from sidecar_tpu.chaos.plan import (
+    ClockFault,
     EdgeFault,
     FaultPlan,
     HealthFault,
@@ -30,6 +31,7 @@ from sidecar_tpu.chaos.sim_inject import (
 __all__ = [
     "ChaosExactSim",
     "ChaosSimState",
+    "ClockFault",
     "CompiledFaultPlan",
     "EdgeFault",
     "FaultPlan",

@@ -15,7 +15,11 @@ The injection shim sits at the Python boundaries the issue names:
   :meth:`LiveChaosController.tick`, so SWIM probes and TCP push-pull
   are cut exactly like user gossip;
 * ``health/checks.py`` — :class:`ChaosChecker` wraps any Checker and
-  injects the plan's slow/failing health-check windows.
+  injects the plan's slow/failing health-check windows;
+* ``catalog/state.py`` — :meth:`LiveInjector.install_clock` shims the
+  catalog's injectable clock (``ServicesState.set_clock``) with the
+  plan's ClockFault skew, so a node stamps/admits/expires by its own
+  skewed clock — the live twin of the sim's per-node ``now`` threading.
 
 Determinism: every probabilistic decision is :func:`plan.coin` — a
 blake2b hash of (seed, src, dst, per-edge counter) — so the DECISION
@@ -52,10 +56,13 @@ class LiveInjector:
     """
 
     def __init__(self, plan: FaultPlan, node_names: list[str], node: str,
-                 round_s: float) -> None:
+                 round_s: float, tick_s: float = 0.001) -> None:
         if round_s <= 0:
             raise ValueError("round_s must be positive")
+        if tick_s <= 0:
+            raise ValueError("tick_s must be positive")
         self.plan = plan
+        self.tick_s = tick_s
         self.index = {name: i for i, name in enumerate(node_names)}
         if node not in self.index:
             raise ValueError(f"node {node!r} not in {node_names}")
@@ -90,6 +97,34 @@ class LiveInjector:
         if self._t0 is None:
             return 0
         return int((time.monotonic() - self._t0) / self.round_s) + 1
+
+    # -- clock shim --------------------------------------------------------
+
+    def skew_ns(self) -> int:
+        """This node's net ClockFault offset right now, in nanoseconds
+        (plan offsets are logical ticks at ``tick_s`` seconds per tick
+        — the sim's default 1 ms resolution, models/timecfg.py).  0
+        before :meth:`start` anchors the clock or when the plan has no
+        clock entries."""
+        if not self.active or not self.plan.clocks:
+            return 0
+        off = self.plan.clock_offset(self.me, self.round_now())
+        return int(off * self.tick_s * 1e9)
+
+    def install_clock(self, state) -> None:
+        """Shim the catalog's injectable clock
+        (:meth:`ServicesState.set_clock`) so THIS node stamps records,
+        admits merges, and expires lifespans by its skewed plan clock —
+        the live twin of the sim's per-node ``now`` threading
+        (chaos/sim_inject.py).  Receivers keep their own (possibly
+        unskewed) clocks, so a rushing node's records arrive
+        future-stamped exactly as in the sim."""
+        base = state._now
+
+        def skewed() -> int:
+            return int(base()) + self.skew_ns()
+
+        state.set_clock(skewed)
 
     # -- transport shim: inbound -------------------------------------------
 

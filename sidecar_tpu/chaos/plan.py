@@ -152,6 +152,79 @@ class HealthFault:
 
 
 @dataclasses.dataclass(frozen=True)
+class ClockFault:
+    """Per-node clock skew: inside ``[start_round, end_round)`` the
+    selected nodes STAMP records with their own skewed clock — a static
+    ``offset_ticks``, plus ``drift_ticks_per_round`` accumulating from
+    the window start, plus an optional one-shot ``step_ticks`` jump
+    from ``step_round`` on (an operator fat-fingering ``date``, a leap
+    smear gone wrong).  Receivers keep judging admission and TTL expiry
+    by their OWN clocks, so a rushing node (+offset) mints records the
+    rest of the cluster sees as from the future — LWW poison the
+    future-admission bound (ops/merge.future_mask) exists to reject —
+    and a slow node (−offset) looks stale early, the false-positive
+    tombstone workload.  Offsets of overlapping entries add.
+
+    Drift is computed as ``floor(float32(drift) * float32(r - start))``
+    — float32 multiply then floor — identically in the XLA and NumPy
+    compilers so the oracle lockstep holds tick for tick.
+    """
+
+    nodes: NodeSel = "all"
+    start_round: int = 0
+    end_round: int = FOREVER
+    offset_ticks: int = 0
+    drift_ticks_per_round: float = 0.0
+    step_ticks: int = 0
+    step_round: int = 0
+
+    def __post_init__(self):
+        object.__setattr__(self, "nodes", _as_sel(self.nodes))
+        if self.start_round < 0:
+            raise ValueError(
+                f"negative window start {self.start_round}")
+        if self.start_round >= self.end_round:
+            raise ValueError(
+                f"empty window [{self.start_round}, {self.end_round})")
+        if self.drift_ticks_per_round != 0.0 and \
+                self.end_round >= FOREVER:
+            raise ValueError(
+                "drift requires a bounded window (end_round < FOREVER): "
+                "unbounded drift overflows the int32 tick clock")
+
+    def offset_at(self, round_idx: int) -> int:
+        """This entry's skew (ticks) at a round — 0 outside the window.
+        The host/NumPy twin of the compiled offset math (float32
+        multiply + floor, see class docstring)."""
+        if not self.start_round <= round_idx < self.end_round:
+            return 0
+        import numpy as np
+        off = self.offset_ticks
+        if self.drift_ticks_per_round != 0.0:
+            off += int(np.floor(
+                np.float32(self.drift_ticks_per_round)
+                * np.float32(round_idx - self.start_round)))
+        if self.step_ticks and round_idx >= self.step_round:
+            off += self.step_ticks
+        return off
+
+    @property
+    def max_offset(self) -> int:
+        """Largest positive skew this entry can inject over its window
+        — the horizon-guard contribution (models/timecfg.validate_horizon).
+        The offset is monotone in |drift|, so the max over the window is
+        attained at one of the candidate rounds checked here."""
+        cands = [self.start_round, min(self.end_round, FOREVER) - 1]
+        if self.step_ticks:
+            # Each monotone piece of the offset attains its max at a
+            # piece endpoint: the step boundary adds two candidates.
+            cands += [max(self.step_round, self.start_round),
+                      max(self.step_round - 1, self.start_round)]
+        return max(0, max(self.offset_at(r) for r in cands
+                          if r >= self.start_round))
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """The whole chaos schedule, rooted at one seed."""
 
@@ -159,11 +232,13 @@ class FaultPlan:
     edges: tuple = ()
     nodes: tuple = ()
     health: tuple = ()
+    clocks: tuple = ()
 
     def __post_init__(self):
         object.__setattr__(self, "edges", tuple(self.edges))
         object.__setattr__(self, "nodes", tuple(self.nodes))
         object.__setattr__(self, "health", tuple(self.health))
+        object.__setattr__(self, "clocks", tuple(self.clocks))
         for e in self.edges:
             if not isinstance(e, EdgeFault):
                 raise TypeError(f"edges entries must be EdgeFault, "
@@ -175,6 +250,10 @@ class FaultPlan:
         for e in self.health:
             if not isinstance(e, HealthFault):
                 raise TypeError(f"health entries must be HealthFault, "
+                                f"got {type(e).__name__}")
+        for e in self.clocks:
+            if not isinstance(e, ClockFault):
+                raise TypeError(f"clocks entries must be ClockFault, "
                                 f"got {type(e).__name__}")
 
     # -- builders ----------------------------------------------------------
@@ -233,6 +312,23 @@ class FaultPlan:
                 return True
         return False
 
+    def clock_offset(self, node: int, round_idx: int) -> int:
+        """Net clock skew (ticks) node ``node`` stamps with at a round
+        — overlapping entries add (the live injector's shim and the
+        NumPy oracle both read this)."""
+        off = 0
+        for f in self.clocks:
+            if f.nodes == "all" or node in f.nodes:
+                off += f.offset_at(round_idx)
+        return off
+
+    @property
+    def max_clock_offset(self) -> int:
+        """Largest positive skew any node can stamp with under this
+        plan — folded into the packed-key overflow guard
+        (models/timecfg.validate_horizon)."""
+        return sum(f.max_offset for f in self.clocks)
+
     # -- serialization (reproduction recipes, docs/chaos.md) ---------------
 
     def to_json(self) -> dict:
@@ -241,7 +337,8 @@ class FaultPlan:
         return {"seed": self.seed,
                 "edges": [enc(e) for e in self.edges],
                 "nodes": [enc(e) for e in self.nodes],
-                "health": [enc(e) for e in self.health]}
+                "health": [enc(e) for e in self.health],
+                "clocks": [enc(e) for e in self.clocks]}
 
     @classmethod
     def from_json(cls, d: dict) -> "FaultPlan":
@@ -249,7 +346,9 @@ class FaultPlan:
                    edges=tuple(EdgeFault(**e) for e in d.get("edges", [])),
                    nodes=tuple(NodeFault(**e) for e in d.get("nodes", [])),
                    health=tuple(HealthFault(**e)
-                                for e in d.get("health", [])))
+                                for e in d.get("health", [])),
+                   clocks=tuple(ClockFault(**e)
+                                for e in d.get("clocks", [])))
 
     def dumps(self) -> str:
         return json.dumps(self.to_json(), sort_keys=True)

@@ -58,7 +58,7 @@ from sidecar_tpu.chaos.plan import FaultPlan, resolve_nodes
 from sidecar_tpu.models.exact import ExactSim, SimParams, SimState
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
-from sidecar_tpu.ops.merge import staleness_mask
+from sidecar_tpu.ops.merge import future_mask, staleness_mask
 from sidecar_tpu.ops.status import TOMBSTONE, pack, unpack_status, unpack_ts
 from sidecar_tpu.ops.topology import Topology
 
@@ -74,6 +74,8 @@ class ChaosSimState:
     injected_drops: jax.Array    # int32 — fault-dropped non-empty packets
     injected_delays: jax.Array   # int32 — packets diverted to a delay ring
     injected_dups: jax.Array     # int32 — packets copied for re-delivery
+    rejected_future: jax.Array   # int32 — record copies the receiver's
+                                 # future-admission bound rejected
 
     # The ExactSim drivers address state through these two names; the
     # properties make a ChaosSimState drop into the inherited scan
@@ -118,6 +120,11 @@ class CompiledFaultPlan:
         self.has_drop = any(e.drop_prob > 0 for e in plan.edges)
         self.has_full_cut = any(e.full_cut for e in plan.edges)
         self.has_crash = any(f.kind == "crash" for f in plan.nodes)
+        self.clock_entries = []
+        for f in plan.clocks:
+            mask = np.zeros(n, bool)
+            mask[list(resolve_nodes(f.nodes, n))] = True
+            self.clock_entries.append((jnp.asarray(mask), f))
 
     # -- per-round fault evaluation (traced) -------------------------------
 
@@ -215,6 +222,31 @@ class CompiledFaultPlan:
                 wipe = wipe | (mask & (round_idx == f.end_round))
         return wipe
 
+    def clock_offsets(self, round_idx):
+        """int32 [N]: each node's net clock skew this round (overlapping
+        entries add) — or None when the plan has no clock entries, so a
+        clock-free plan compiles the global-clock round bit for bit.
+        Drift is float32 multiply + floor, matching
+        :meth:`ClockFault.offset_at` (the NumPy/oracle twin) tick for
+        tick."""
+        if not self.clock_entries:
+            return None
+        off = jnp.zeros((self.n,), jnp.int32)
+        for mask, e in self.clock_entries:
+            act = self._active(e, round_idx)
+            o = jnp.int32(e.offset_ticks)
+            if e.drift_ticks_per_round != 0.0:
+                o = o + jnp.floor(
+                    jnp.float32(e.drift_ticks_per_round)
+                    * jnp.asarray(round_idx - e.start_round
+                                  ).astype(jnp.float32)
+                ).astype(jnp.int32)
+            if e.step_ticks:
+                o = jnp.where(round_idx >= e.step_round,
+                              o + jnp.int32(e.step_ticks), o)
+            off = off + jnp.where(mask & act, o, 0)
+        return off
+
 
 class ChaosExactSim(ExactSim):
     """ExactSim under a FaultPlan.  Drivers (``run``/``run_fast``/
@@ -243,6 +275,10 @@ class ChaosExactSim(ExactSim):
         self._knobs = dataclasses.replace(self._knobs,
                                           fault_seed=plan.seed)
         self._prog = CompiledFaultPlan(plan, params.n)
+        # The horizon guard (models/timecfg.validate_horizon) must
+        # cover the highest tick any SKEWED stamp can reach, not just
+        # the global clock — checked at every driver dispatch.
+        self._skew_ticks = plan.max_clock_offset
         # owner_row[i, m] — slot m belongs to node i (the crash-restart
         # wipe's "keep only my own records" mask).
         self._owner_row = None
@@ -263,12 +299,13 @@ class ChaosExactSim(ExactSim):
              jnp.zeros((d, flat), jnp.int32),
              jnp.zeros((d, flat), jnp.int32))
             for d in self._prog.ring_specs)
-        # Three DISTINCT zero buffers: the run drivers donate the whole
+        # Four DISTINCT zero buffers: the run drivers donate the whole
         # state pytree, and XLA rejects donating one buffer twice.
         return ChaosSimState(sim=base, rings=rings,
                              injected_drops=jnp.zeros((), jnp.int32),
                              injected_delays=jnp.zeros((), jnp.int32),
-                             injected_dups=jnp.zeros((), jnp.int32))
+                             injected_dups=jnp.zeros((), jnp.int32),
+                             rejected_future=jnp.zeros((), jnp.int32))
 
     # -- the chaos round ---------------------------------------------------
 
@@ -289,6 +326,21 @@ class ChaosExactSim(ExactSim):
         down = prog.down_mask(round_idx)
         alive = base_alive if down is None else base_alive & ~down
 
+        # Per-node clocks (ClockFault): a skewed node STAMPS with its
+        # own clock — mint, refresh re-stamp, crash re-announce — while
+        # every RECEIVER keeps admitting, anti-entropying, and sweeping
+        # by its own.  ``off is None`` (no clock entries) leaves every
+        # scalar-``now`` path below untouched, so a clock-free plan
+        # compiles the pre-skew round bit for bit.
+        off = prog.clock_offsets(round_idx)
+        # Epoch floor: a slow clock cannot read before tick 0 — an
+        # unclamped negative would mint a sign-corrupted packed key
+        # (ts=0 is the unknown sentinel, so a floored mint is simply
+        # an empty cell until the clock recovers).
+        now_n = None if off is None else jnp.maximum(now + off, 0)  # [N]
+        ft = kn.future_arg()
+        rej = cst.rejected_future
+
         # Crash restarts: wipe the row to a cold re-announce of own
         # records the round the window closes.
         wipe = prog.restart_mask(round_idx)
@@ -298,7 +350,8 @@ class ChaosExactSim(ExactSim):
             cold = jnp.where(
                 self._owner_row & (unpack_ts(known) > 0)
                 & (st_codes != TOMBSTONE),
-                pack(now, st_codes), 0)
+                pack(now if off is None else now_n[:, None],
+                     st_codes), 0)
             known = jnp.where(wipe[:, None], cold, known)
             sent = jnp.where(wipe[:, None], jnp.int8(0), sent)
         state = dataclasses.replace(state, known=known, sent=sent,
@@ -345,10 +398,20 @@ class ChaosExactSim(ExactSim):
         if kn.needs_drop_draw:
             record_keep = jax.random.bernoulli(
                 k_drop, kn.keep_prob, (n, fanout, budget))
+        recv_now = now if off is None else now_n[dst][:, :, None]
+        if ft is not None:
+            # Count the wire copies the receiver-side bound rejects —
+            # tallied on the raw candidate set, before the unrelated
+            # loss/liveness gates, because that is what the bound sees.
+            cand = jnp.broadcast_to(msg[:, None, :], (n, fanout, budget))
+            rej = rej + jnp.sum(
+                (future_mask(cand, recv_now, ft)
+                 & (cand > 0)).astype(jnp.int32))
         rows, cols, vals = gossip_ops.expand_deliveries(
-            dst, svc_idx, msg, now_tick=now, stale_ticks=kn.stale_ticks,
+            dst, svc_idx, msg, now_tick=recv_now,
+            stale_ticks=kn.stale_ticks,
             node_alive=alive, record_keep=record_keep,
-            edge_keep=keep)
+            edge_keep=keep, future_ticks=ft)
 
         def flat(mask):
             return jnp.broadcast_to(mask[:, :, None],
@@ -382,9 +445,17 @@ class ChaosExactSim(ExactSim):
             # receiver liveness are re-evaluated against *now* (the
             # pre-round stickiness resolution happens with the combined
             # batch below).
-            m_vals = jnp.where(staleness_mask(m_vals, now, kn.stale_ticks),
+            m_idx = jnp.minimum(m_rows, p.n - 1)
+            m_now = now if off is None else now_n[m_idx]
+            m_vals = jnp.where(staleness_mask(m_vals, m_now,
+                                              kn.stale_ticks),
                                0, m_vals)
-            ok = (m_rows < p.n) & alive[jnp.minimum(m_rows, p.n - 1)]
+            if ft is not None:
+                fm = future_mask(m_vals, m_now, ft)
+                rej = rej + jnp.sum(
+                    (fm & (m_vals > 0)).astype(jnp.int32))
+                m_vals = jnp.where(fm, 0, m_vals)
+            ok = (m_rows < p.n) & alive[m_idx]
             m_vals = jnp.where(ok, m_vals, 0)
             all_rows.append(m_rows)
             all_cols.append(m_cols)
@@ -406,7 +477,8 @@ class ChaosExactSim(ExactSim):
 
         # 2. announce re-stamps, folded into the same scatter.
         a_rows, a_cols, a_vals, a_due = self._announce_updates(
-            known, alive, round_idx, now, kn=kn)
+            known, alive, round_idx,
+            now if off is None else now_n[self.owner], kn=kn)
         rows = jnp.concatenate([rows, a_rows])
         cols = jnp.concatenate([cols, a_cols])
         vals = jnp.concatenate([d_vals, a_vals])
@@ -423,24 +495,56 @@ class ChaosExactSim(ExactSim):
             pp_partner = jnp.where(
                 sever, jnp.arange(p.n, dtype=jnp.int32), pp_partner)
 
-        def do_push_pull(kn_se):
-            kn_, se = kn_se
-            merged = gossip_ops.push_pull(
-                kn_, pp_partner, now_tick=now,
-                stale_ticks=kn.stale_ticks, node_alive=alive)
-            se = jnp.where(merged != kn_, jnp.int8(0), se)
-            return merged, se
+        # Each push-pull leg admits at the RECEIVER's clock: the pull
+        # leg lands on me (my clock), the push leg lands on my partner
+        # (theirs).  Self-exchanges (severed/remapped partners) are
+        # merge no-ops under any clock, so pre-remap indexing is safe.
+        pp_now = now if off is None else now_n[:, None]
+        pp_push = None if off is None else now_n[pp_partner][:, None]
 
-        known, sent = lax.cond(
-            round_idx % kn.push_pull_rounds == 0,
-            do_push_pull, lambda kn_se: kn_se, (known, sent))
+        if ft is None:
+            def do_push_pull(kn_se):
+                kn_, se = kn_se
+                merged = gossip_ops.push_pull(
+                    kn_, pp_partner, now_tick=pp_now,
+                    stale_ticks=kn.stale_ticks, node_alive=alive,
+                    now_push=pp_push)
+                se = jnp.where(merged != kn_, jnp.int8(0), se)
+                return merged, se
+
+            known, sent = lax.cond(
+                round_idx % kn.push_pull_rounds == 0,
+                do_push_pull, lambda kn_se: kn_se, (known, sent))
+        else:
+            def do_push_pull(kn_se):
+                kn_, se = kn_se
+                merged = gossip_ops.push_pull(
+                    kn_, pp_partner, now_tick=pp_now,
+                    stale_ticks=kn.stale_ticks, node_alive=alive,
+                    future_ticks=ft, now_push=pp_push)
+                se = jnp.where(merged != kn_, jnp.int8(0), se)
+                pulled = kn_[pp_partner]
+                r = jnp.sum((future_mask(pulled, pp_now, ft)
+                             & (pulled > 0)).astype(jnp.int32))
+                push_now = pp_now if pp_push is None else pp_push
+                r = r + jnp.sum((future_mask(kn_, push_now, ft)
+                                 & (kn_ > 0)).astype(jnp.int32))
+                return merged, se, r
+
+            known, sent, pp_rej = lax.cond(
+                round_idx % kn.push_pull_rounds == 0,
+                do_push_pull,
+                lambda kn_se: (kn_se[0], kn_se[1],
+                               jnp.zeros((), jnp.int32)),
+                (known, sent))
+            rej = rej + pp_rej
 
         # 4. lifespan sweep.
         def do_sweep(kn_se):
             from sidecar_tpu.ops.ttl import ttl_sweep
             kn_, se = kn_se
             swept, _ = ttl_sweep(
-                kn_, now,
+                kn_, now if off is None else now_n[:, None],
                 alive_lifespan=kn.alive_lifespan,
                 draining_lifespan=kn.draining_lifespan,
                 tombstone_lifespan=kn.tombstone_lifespan,
@@ -457,7 +561,8 @@ class ChaosExactSim(ExactSim):
             sim=SimState(known=known, sent=sent, node_alive=base_alive,
                          round_idx=round_idx),
             rings=tuple(new_rings), injected_drops=drops,
-            injected_delays=delays, injected_dups=dups)
+            injected_delays=delays, injected_dups=dups,
+            rejected_future=rej)
 
     # -- metric + drivers --------------------------------------------------
 
@@ -477,18 +582,20 @@ class ChaosExactSim(ExactSim):
         return trace_ops.exact_record(
             prev.sim, nxt.sim, budget=min(self.p.budget, self.p.m),
             fanout=self.p.fanout,
-            limit=self.p.resolved_retransmit_limit(), stats=stats)
+            limit=self.p.resolved_retransmit_limit(), stats=stats,
+            rejected_future=nxt.rejected_future - prev.rejected_future)
 
     def injection_counts(self, cst: ChaosSimState) -> dict:
         return {"dropped": int(cst.injected_drops),
                 "delayed": int(cst.injected_delays),
-                "duplicated": int(cst.injected_dups)}
+                "duplicated": int(cst.injected_dups),
+                "rejected_future": int(cst.rejected_future)}
 
     @staticmethod
     def _counter_snapshot(cst: ChaosSimState) -> dict:
         return {f: int(getattr(cst, f))
                 for f in ("injected_drops", "injected_delays",
-                          "injected_dups")}
+                          "injected_dups", "rejected_future")}
 
     def _publish_injection_metrics(self, before: dict,
                                    after: ChaosSimState) -> None:
@@ -497,7 +604,9 @@ class ChaosExactSim(ExactSim):
         for name, field in (("chaos.sim.droppedPackets", "injected_drops"),
                             ("chaos.sim.delayedPackets", "injected_delays"),
                             ("chaos.sim.duplicatedPackets",
-                             "injected_dups")):
+                             "injected_dups"),
+                            ("clock.sim.rejectedFuture",
+                             "rejected_future")):
             delta = int(getattr(after, field)) - before[field]
             if delta:
                 metrics.incr(name, delta)

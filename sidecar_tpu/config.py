@@ -139,6 +139,14 @@ class ServicesConfig:
         )
 
 
+# Spelled out in full for the docs/env.md catalog scanner
+# (tools/check_env_docs.py named-constant form): the knob deliberately
+# lives in the simulator's SIDECAR_TPU_* namespace — it is the live
+# twin of the sim's ops/merge.future_mask bound
+# (TimeConfig.future_fudge_s) and one value should drive both planes.
+FUTURE_FUDGE_ENV = "SIDECAR_TPU_FUTURE_FUDGE"
+
+
 @dataclasses.dataclass
 class SidecarConfig:
     """SIDECAR_ (config.go:41-59)."""
@@ -167,6 +175,10 @@ class SidecarConfig:
     suspicion_window: float = 0.0     # SWIM quarantine window (0 = off)
     damping_half_life: float = 60.0   # flap-penalty decay half-life
     damping_threshold: float = 0.0    # suppress at penalty >= (0 = off)
+    # Future-admission bound (ops/merge.future_mask, docs/chaos.md):
+    # reject records stamped beyond now + this many seconds at every
+    # merge/catalog-add site.  Negative (default) disables the gate.
+    future_fudge: float = -1.0
 
     @classmethod
     def from_env(cls) -> "SidecarConfig":
@@ -202,6 +214,8 @@ class SidecarConfig:
                                    d.damping_half_life),
             damping_threshold=_env("SIDECAR", "DAMPING_THRESHOLD",
                                    d.damping_threshold, cast=float),
+            future_fudge=_env(*FUTURE_FUDGE_ENV.split("_", 1),
+                              d.future_fudge),
         )
 
 

@@ -44,7 +44,7 @@ from sidecar_tpu.sim.scenarios import validate_protocol_config
 _TIMECFG_FIELDS = (
     "push_pull_interval_s", "sweep_interval_s", "refresh_interval_s",
     "suspicion_window_s", "alive_lifespan_s", "draining_lifespan_s",
-    "tombstone_lifespan_s",
+    "tombstone_lifespan_s", "future_fudge_s",
 )
 
 
@@ -74,6 +74,7 @@ class ScenarioSpec:
     alive_lifespan_s: Optional[float] = None
     draining_lifespan_s: Optional[float] = None
     tombstone_lifespan_s: Optional[float] = None
+    future_fudge_s: Optional[float] = None   # negative = bound disabled
 
     def axes(self) -> dict:
         """The non-default knobs, for report/Pareto tables."""
@@ -200,6 +201,8 @@ class ScenarioBatch:
                         f"{s.name}: {knob}={v} not in [0, 1]")
             for f in _TIMECFG_FIELDS:
                 v = getattr(s, f)
+                if f == "future_fudge_s":
+                    continue  # any negative value means "bound off"
                 if v is not None and v < 0:
                     raise ValueError(f"{s.name}: {f}={v} must be >= 0")
             if s.fault_seed is not None and plan is None:
@@ -262,6 +265,11 @@ class ScenarioBatch:
             tombstone_lifespan=stack(
                 lambda i: t_of(i).tombstone_lifespan, np.int32),
             stale_ticks=stack(lambda i: t_of(i).stale_ticks, np.int32),
+            # -1 = disabled; the traced knob path maps negatives to an
+            # always-pass MAX_TICK bound (RoundKnobs.future_arg).
+            future_ticks=stack(
+                lambda i: (-1 if t_of(i).future_ticks is None
+                           else t_of(i).future_ticks), np.int32),
             churn_prob=stack(lambda i: specs[i].churn_prob, np.float32),
             fault_seed=stack(
                 lambda i: (specs[i].fault_seed

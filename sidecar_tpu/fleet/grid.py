@@ -10,7 +10,7 @@ parameter space:
   (``push_pull_interval_s``, ``sweep_interval_s``,
   ``refresh_interval_s``, ``suspicion_window_s``,
   ``alive_lifespan_s``, ``draining_lifespan_s``,
-  ``tombstone_lifespan_s``);
+  ``tombstone_lifespan_s``, ``future_fudge_s``);
 * **compile-key axes** (group into separate batches, each its own
   compiled program): ``fanout``, ``budget``.
 
@@ -34,7 +34,7 @@ _DATA_AXES = (
     "seed", "retransmit_limit", "drop_prob", "churn_prob", "mint_frac",
     "fault_seed", "push_pull_interval_s", "sweep_interval_s",
     "refresh_interval_s", "suspicion_window_s", "alive_lifespan_s",
-    "draining_lifespan_s", "tombstone_lifespan_s",
+    "draining_lifespan_s", "tombstone_lifespan_s", "future_fudge_s",
 )
 _STATIC_AXES = ("fanout", "budget")
 KNOWN_AXES = _DATA_AXES + _STATIC_AXES

@@ -101,6 +101,9 @@ class SidecarNode:
         self.state = ServicesState(
             hostname=self.hostname,
             cluster_name=self.config.sidecar.cluster_name)
+        # Future-admission bound (SIDECAR_TPU_FUTURE_FUDGE, docs/env.md):
+        # negative leaves the reference-exact writer path untouched.
+        self.state.future_fudge_s = self.config.sidecar.future_fudge
         # Flap damping (catalog/damping.py, docs/chaos.md): attached
         # only when SIDECAR_DAMPING_THRESHOLD enables it — the damper
         # then observes every catalog status transition and the proxy

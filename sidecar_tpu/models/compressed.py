@@ -123,8 +123,9 @@ from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.merge import (
+    admit_gate,
     apply_stickiness,
-    staleness_mask,
+    future_mask,
     sticky_adjust,
 )
 from sidecar_tpu.ops.status import (
@@ -471,8 +472,7 @@ class CompressedSim:
         entries evaluated at the same ``now``, so filtering before the
         gather is identical and F× cheaper."""
         kn = self._knobs if kn is None else kn
-        bval = jnp.where(staleness_mask(bval, now, kn.stale_ticks),
-                         0, bval)
+        bval = admit_gate(bval, now, kn.stale_ticks, kn.future_arg())
         pv = bval[src]    # [nl, F, K] — row gathers, contiguous in K
         ps = bslot[src]
         ok = alive[src] & state.node_alive[:, None]      # [nl, F]
@@ -500,8 +500,7 @@ class CompressedSim:
         if keep is not None:
             pv = jnp.where(keep, pv, 0)
         if not stale_filtered:
-            pv = jnp.where(staleness_mask(pv, now, kn.stale_ticks),
-                           0, pv)
+            pv = admit_gate(pv, now, kn.stale_ticks, kn.future_arg())
         ps = jnp.where(pv > 0, ps, -1)
         for f in range(pv.shape[1]):
             cand_v, cand_s = pv[:, f], ps[:, f]
@@ -729,8 +728,8 @@ class CompressedSim:
             p_slot = jnp.roll(cs0, roll_amt, 0)
             p_val = jnp.roll(cv0, roll_amt, 0)
             p_val = jnp.where(okc & (p_slot >= 0), p_val, 0)
-            p_val = jnp.where(staleness_mask(p_val, now, kn.stale_ticks),
-                              0, p_val)
+            p_val = admit_gate(p_val, now, kn.stale_ticks,
+                               kn.future_arg())
             p_slot = jnp.where(p_val > 0, p_slot, -1)
             p_val = sticky_adjust(p_val, cv0,
                                   (p_slot == cs0) & (p_val > cv0))
@@ -742,8 +741,8 @@ class CompressedSim:
             t_val = jnp.where(okc, jnp.roll(state.own, roll_amt, 0), 0)
             t_floor = jnp.roll(floor_rs, roll_amt, 0)
             t_val = jnp.where(t_val > t_floor, t_val, 0)
-            t_val = jnp.where(staleness_mask(t_val, now, kn.stale_ticks),
-                              0, t_val)
+            t_val = admit_gate(t_val, now, kn.stale_ticks,
+                               kn.future_arg())
             wv, ws, sent, _ = self._insert_own_offers(
                 wv, ws, sent, t_val, t_slot[:, 0])
 
@@ -950,6 +949,16 @@ class CompressedSim:
                 budget=min(p.budget, p.cache_lines), limit=limit,
                 fanout=p.fanout, cache_lines=p.cache_lines,
                 interpret=self._kernels_interpret)
+            ft = kn.future_arg()
+            if ft is not None:
+                # The kernel only gates staleness; apply the future
+                # bound on the gathered candidates ([N, F, K]) — the
+                # candidates are board copies evaluated at the same
+                # ``now``, so post-kernel gating is equivalent to the
+                # XLA twin's pre-gather board gate.  Only compiled when
+                # the bound is enabled, so the disabled program stays
+                # bit-identical to the pre-bound kernel path.
+                pv = jnp.where(future_mask(pv, now, ft), 0, pv)
             ok = state.node_alive[src] & state.node_alive[:, None]
             state = self._merge_pulled(state, sent, pv, ps, ok, now,
                                        drop_key=k_drop,
@@ -1065,8 +1074,7 @@ class CompressedSim:
         # row at index cs_cap is the "inactive sender" — an all-zero
         # board, the merge no-op every non-frontier row serves in the
         # dense round too.
-        bval_c = jnp.where(staleness_mask(bval_c, now, t.stale_ticks),
-                           0, bval_c)
+        bval_c = admit_gate(bval_c, now, t.stale_ticks, t.future_ticks)
         bval_p = jnp.concatenate(
             [bval_c, jnp.zeros((1, k), jnp.int32)])
         bslot_p = jnp.concatenate(

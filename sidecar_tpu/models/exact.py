@@ -167,6 +167,11 @@ class ExactSim:
         # program; the fleet engine overrides per round with a stacked,
         # traced bundle instead (docs/sweep.md).
         self._knobs = knob_ops.from_protocol(params, timecfg)
+        # Max positive clock-skew offset any stamping site can add to a
+        # tick (0 outside the chaos family) — the horizon guard folds it
+        # in so an injected rushing clock cannot silently run the packed
+        # key into the sign bit (models/timecfg.validate_horizon).
+        self._skew_ticks = 0
         # owner[m] = node that announces slot m.
         self.owner = jnp.arange(params.m, dtype=jnp.int32) // params.services_per_node
 
@@ -253,6 +258,7 @@ class ExactSim:
             now_tick=now, stale_ticks=kn.stale_ticks,
             node_alive=node_alive,
             record_keep=record_keep,
+            future_ticks=kn.future_arg(),
         )
 
         # 2. announce re-stamps, folded into the same scatter.
@@ -305,6 +311,7 @@ class ExactSim:
             node_alive=node_alive,
             sender_alive=node_alive[row_s] & valid_s,
             record_keep=keep_c,
+            future_ticks=t.future_ticks,
         )
 
         a_rows, a_cols, a_vals, a_due = self._announce_updates(
@@ -354,7 +361,8 @@ class ExactSim:
             kn_, se = kn_se
             merged = gossip_ops.push_pull(
                 kn_, pp_partner, now_tick=now,
-                stale_ticks=kn.stale_ticks, node_alive=node_alive)
+                stale_ticks=kn.stale_ticks, node_alive=node_alive,
+                future_ticks=kn.future_arg())
             se = jnp.where(merged != kn_, jnp.int8(0), se)
             return merged, se
 
@@ -431,7 +439,7 @@ class ExactSim:
             kn, se = kn_se
             merged = gossip_ops.push_pull(
                 kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
-                node_alive=node_alive)
+                node_alive=node_alive, future_ticks=t.future_ticks)
             se = jnp.where(merged != kn, jnp.int8(0), se)
             return merged, se
 
@@ -492,7 +500,8 @@ class ExactSim:
         # serialize the dispatch pipeline (see bridge/sim_bridge.py).
         if start_round is None:
             start_round = int(state.round_idx)
-        self.t.validate_horizon(start_round + num_rounds)
+        self.t.validate_horizon(start_round + num_rounds,
+                                skew_ticks=self._skew_ticks)
 
     def _resolve_sparse_request(self, sparse):
         return sparse_ops.resolve_request(self._sparse_mode, sparse,

@@ -60,6 +60,13 @@ class TimeConfig:
     # timeout the live engine already carries (transport/gossip.py
     # suspect_timeout).
     suspicion_window_s: float = 0.0
+    # Future-admission bound (ops/merge.future_mask, docs/chaos.md): a
+    # record stamped beyond ``now + future_fudge_s`` at the receiver is
+    # REJECTED at merge — the symmetric twin of the 1-minute staleness
+    # fudge, the defense against rushing-clock LWW poison.  Negative
+    # (the default) disables the bound; every merge site then compiles
+    # the pre-bound program bit for bit (the lockstep suites pin this).
+    future_fudge_s: float = -1.0
 
     def ticks(self, seconds: float) -> int:
         return int(round(seconds * self.ticks_per_second))
@@ -91,6 +98,15 @@ class TimeConfig:
         """Suspicion grace window in ticks (0 = subprotocol disabled)."""
         return self.ticks(self.suspicion_window_s)
 
+    @property
+    def future_ticks(self):
+        """Future-admission bound in ticks, or None when disabled —
+        callers skip the gate entirely on None, so the disabled program
+        is the pre-bound program."""
+        if self.future_fudge_s < 0:
+            return None
+        return self.ticks(self.future_fudge_s)
+
     def rounds(self, seconds: float) -> int:
         """Number of gossip rounds in a wall-clock duration."""
         return max(1, self.ticks(seconds) // self.round_ticks)
@@ -107,9 +123,22 @@ class TimeConfig:
     def sweep_rounds(self) -> int:
         return self.rounds(self.sweep_interval_s)
 
-    def validate_horizon(self, num_rounds: int) -> None:
-        if num_rounds * self.round_ticks > MAX_TICK:
+    @property
+    def max_safe_rounds(self) -> int:
+        """Largest round count whose tick clock stays inside the int32
+        packed-key range (no injected skew)."""
+        return MAX_TICK // self.round_ticks
+
+    def validate_horizon(self, num_rounds: int, skew_ticks: int = 0) -> None:
+        """Raise when ``num_rounds`` rounds of tick advance — plus any
+        injected clock-skew offset (``skew_ticks``, the max positive
+        ClockFault offset a chaos plan can add to a stamp) — would run
+        the int32 packed-key clock into the sign bit."""
+        horizon = num_rounds * self.round_ticks + skew_ticks
+        if horizon > MAX_TICK:
+            skew = (f" + {skew_ticks} skew ticks" if skew_ticks else "")
             raise ValueError(
-                f"{num_rounds} rounds x {self.round_ticks} ticks overflows the "
-                f"int32 packed-key tick range ({MAX_TICK}); use a coarser tick"
+                f"{num_rounds} rounds x {self.round_ticks} ticks{skew} "
+                f"overflows the int32 packed-key tick range ({MAX_TICK}); "
+                f"use a coarser tick"
             )

@@ -60,6 +60,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from sidecar_tpu.ops.merge import (
+    admit_gate,
     merge_packed,
     staleness_mask,
     sticky_adjust,
@@ -276,7 +277,7 @@ def select_messages(known, sent, budget, limit, row_offset=0,
 def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
                       node_alive=None, drop_prob=0.0, drop_key=None,
                       edge_keep=None, sender_alive=None,
-                      record_keep=None):
+                      record_keep=None, future_ticks=None):
     """Expand each sender's message batch into RAW flat (row, col, val)
     update triples — every gate applied EXCEPT the pre-round stickiness
     resolution (:func:`finalize_deliveries`), which callers that defer
@@ -294,7 +295,14 @@ def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
     ``record_keep`` is a pre-drawn bool ``[rows, F, B]`` keep mask
     replacing the in-call ``drop_prob`` draw: the sparse path draws ONE
     dense-shaped mask and slices its frontier rows, so the loss stream
-    is mode-independent (pass ``drop_prob=0`` with it)."""
+    is mode-independent (pass ``drop_prob=0`` with it).
+
+    ``now_tick`` may be a broadcastable per-RECEIVER tensor (shape
+    ``[rows, F, 1]`` against the ``[rows, F, B]`` values) — the chaos
+    family's per-node clocks evaluate staleness and the
+    future-admission bound (``future_ticks``, ops/merge.future_mask;
+    None = bound disabled, the pre-bound program) at each receiver's
+    own clock."""
     n, fanout = dst.shape
     budget = svc_idx.shape[1]
 
@@ -302,7 +310,7 @@ def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
     tgt = jnp.broadcast_to(dst[:, :, None], (n, fanout, budget))
     svc = jnp.broadcast_to(svc_idx[:, None, :], (n, fanout, budget))
 
-    val = jnp.where(staleness_mask(val, now_tick, stale_ticks), 0, val)
+    val = admit_gate(val, now_tick, stale_ticks, future_ticks)
 
     if node_alive is not None:
         snd = sender_alive if sender_alive is not None else node_alive
@@ -340,7 +348,7 @@ def finalize_deliveries(known, rows, cols, vals):
 def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
                        node_alive=None, drop_prob=0.0, drop_key=None,
                        edge_keep=None, sender_alive=None,
-                       record_keep=None):
+                       record_keep=None, future_ticks=None):
     """Expand each sender's message batch into flat (row, col, val) update
     triples with all merge semantics pre-applied.
 
@@ -360,7 +368,7 @@ def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
         dst, svc_idx, msg, now_tick=now_tick, stale_ticks=stale_ticks,
         node_alive=node_alive, drop_prob=drop_prob, drop_key=drop_key,
         edge_keep=edge_keep, sender_alive=sender_alive,
-        record_keep=record_keep)
+        record_keep=record_keep, future_ticks=future_ticks)
     vals, advanced = finalize_deliveries(known, rows, cols, vals)
     return rows, cols, vals, advanced
 
@@ -409,7 +417,8 @@ def record_transmissions(sent, svc_idx, msg, fanout, limit, row_ids=None):
     return sent.at[rows, svc_idx].add(bump, mode="drop")
 
 
-def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None):
+def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None,
+              future_ticks=None, now_push=None):
     """Anti-entropy: each node initiates a full two-way state exchange with
     one reachable peer (services_delegate.go:146-167).
 
@@ -422,20 +431,31 @@ def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None):
     Pull: merge the partner's full row into ours (gather + elementwise
     LWW merge).  Push: row-scatter our state onto the partner with the
     same max combiner.
+
+    ``future_ticks`` enables the future-admission bound on both legs
+    (None = disabled, the pre-bound program).  ``now_push`` overrides
+    the receiver clock for the PUSH leg (the chaos family's per-node
+    clocks: the pull leg admits at the initiator's clock ``now_tick``,
+    the push leg at the partner's ``now_push`` — both may be
+    broadcastable ``[N, 1]`` tensors; a self-exchange is a merge no-op
+    under any clock, so remapped dead partners stay inert).
     """
     self_idx = jnp.arange(known.shape[0], dtype=jnp.int32)
     if node_alive is not None:
         partner = jnp.where(node_alive & node_alive[partner], partner, self_idx)
+    if now_push is None:
+        now_push = now_tick
 
     # Pull: our row ← partner's row (stickiness inside merge_packed is
     # evaluated against the pre-exchange state).
-    pulled = merge_packed(known, known[partner], now_tick, stale_ticks)
+    pulled = merge_packed(known, known[partner], now_tick, stale_ticks,
+                          future_ticks)
 
     # Push: partner's row ← our (pre-exchange) row.  Stickiness is
     # applied to the offered values against the RECEIVER's pre-exchange
     # row — both phases resolve vs the same snapshot, matching the
     # oracle's batch resolution.
-    offered = jnp.where(staleness_mask(known, now_tick, stale_ticks), 0, known)
+    offered = admit_gate(known, now_push, stale_ticks, future_ticks)
     pre_tgt = known[partner]
     offered = sticky_adjust(offered, pre_tgt, offered > pre_tgt)
     return pulled.at[partner].max(offered, mode="drop")

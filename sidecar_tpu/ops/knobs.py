@@ -76,6 +76,8 @@ class RoundKnobs:
     churn_prob: Any = 0.0   # per-round restart-churn probability
                             # (consumed by knob-aware perturb hooks)
     fault_seed: Any = 0     # FaultPlan seed (chaos family)
+    future_ticks: Any = -1  # future-admission bound (ticks;
+                            # negative = disabled — ops/merge.future_mask)
 
     @property
     def suspicion_enabled(self) -> bool:
@@ -96,6 +98,24 @@ class RoundKnobs:
         no-op on its own key (per-purpose keys never shift siblings'
         streams)."""
         return not (_static(self.keep_prob) and self.keep_prob >= 1.0)
+
+    def future_arg(self):
+        """The ``future_ticks`` argument for the merge gates
+        (ops/merge.admit_gate): None when the bound is PROVABLY
+        disabled (a static negative compiles the pre-bound program bit
+        for bit); a static non-negative passes through as a Python int
+        (const-folds); a traced value keeps the gate compiled with the
+        disabled sentinel mapped to MAX_TICK — ``ts > now + MAX_TICK``
+        is never true on valid ticks, and ``now + MAX_TICK ≤ 2^29 − 2``
+        cannot overflow int32."""
+        ft = self.future_ticks
+        if _static(ft):
+            return None if ft < 0 else int(ft)
+        import jax.numpy as jnp
+
+        from sidecar_tpu.ops.status import MAX_TICK
+        ft = jnp.asarray(ft, jnp.int32)
+        return jnp.where(ft < 0, MAX_TICK, ft)
 
 
 def from_protocol(params, timecfg, *, recover_rounds: int = 1,
@@ -118,4 +138,6 @@ def from_protocol(params, timecfg, *, recover_rounds: int = 1,
         stale_ticks=timecfg.stale_ticks,
         churn_prob=churn_prob,
         fault_seed=fault_seed,
+        future_ticks=(-1 if timecfg.future_ticks is None
+                      else timecfg.future_ticks),
     )

@@ -59,6 +59,40 @@ def staleness_mask(packed, now_tick, stale_ticks):
     return (ts > 0) & (ts < jnp.asarray(now_tick, jnp.int32) - jnp.asarray(stale_ticks, jnp.int32))
 
 
+def future_mask(packed, now_tick, future_ticks):
+    """True where a packed record is stamped too far in the FUTURE to
+    merge — the symmetric twin of :func:`staleness_mask`.
+
+    The reference only defends the past side of clock error (the
+    1-minute staleness fudge); a node with a rushing clock therefore
+    mints records that win every max-merge, can never be refuted by
+    honest refreshes, and never expire at their receivers — the classic
+    LWW poison.  This bound REJECTS (never clamps — a clamped stamp
+    would silently rewrite the sender's claim and still win merges)
+    any record stamped beyond ``now + future_ticks`` at the receiver.
+
+    ``future_ticks`` is the admission fudge in ticks
+    (``TimeConfig.future_ticks``); callers that carry a "disabled"
+    sentinel skip calling this entirely so the disabled program stays
+    bit-identical to the pre-bound kernel.  Overflow-safe at the traced
+    MAX_TICK sentinel: ``now + MAX_TICK ≤ 2^29 − 2 < 2^31``.
+    """
+    ts = unpack_ts(packed)
+    return ts > jnp.asarray(now_tick, jnp.int32) + jnp.asarray(future_ticks, jnp.int32)
+
+
+def admit_gate(vals, now_tick, stale_ticks, future_ticks=None):
+    """Zero out packed values outside the admission window: older than
+    the staleness bound, or — when the future bound is enabled
+    (``future_ticks`` is not None) — stamped beyond ``now +
+    future_ticks``.  With ``future_ticks=None`` this compiles exactly
+    the bare staleness gate, bit for bit."""
+    vals = jnp.where(staleness_mask(vals, now_tick, stale_ticks), 0, vals)
+    if future_ticks is not None:
+        vals = jnp.where(future_mask(vals, now_tick, future_ticks), 0, vals)
+    return vals
+
+
 def sticky_adjust(vals, pre_vals, advanced):
     """Apply DRAINING stickiness to incoming message values against the
     receiver's pre-batch state (services_state.go:329-331): where an
@@ -96,7 +130,7 @@ def apply_stickiness(pre, post):
     return jnp.where(sticky, pack(unpack_ts(post), DRAINING), post)
 
 
-def merge_packed(known, incoming, now_tick, stale_ticks):
+def merge_packed(known, incoming, now_tick, stale_ticks, future_ticks=None):
     """Merge an aligned tensor of incoming packed records into ``known``.
 
     This is the full-state anti-entropy merge (``MergeRemoteState`` →
@@ -106,21 +140,26 @@ def merge_packed(known, incoming, now_tick, stale_ticks):
     (node, service) belief.
 
     Returns the merged tensor.  Cells where ``incoming`` is unknown
-    (ts == 0) or stale are left untouched.
+    (ts == 0), stale, or — when the future-admission bound is enabled —
+    stamped beyond ``now + future_ticks`` are left untouched.  The
+    default ``future_ticks=None`` compiles the pre-bound kernel bit for
+    bit.
     """
     # Canonicalize: a ts==0 key is the unknown sentinel regardless of its
     # status bits — never merge it.
     incoming = jnp.where(is_known(incoming), incoming, 0)
-    incoming = jnp.where(staleness_mask(incoming, now_tick, stale_ticks), 0, incoming)
+    incoming = admit_gate(incoming, now_tick, stale_ticks, future_ticks)
     post = jnp.maximum(known, incoming)
     return apply_stickiness(known, post)
 
 
-def merge_records(known_ts, known_status, inc_ts, inc_status, now_tick, stale_ticks):
+def merge_records(known_ts, known_status, inc_ts, inc_status, now_tick,
+                  stale_ticks, future_ticks=None):
     """Unpacked-tensor variant of :func:`merge_packed` for callers that keep
     separate ts/status tensors. Returns (ts, status, accepted-mask)."""
     known = pack(known_ts, known_status)
     incoming = pack(inc_ts, inc_status)
-    merged = merge_packed(known, incoming, now_tick, stale_ticks)
+    merged = merge_packed(known, incoming, now_tick, stale_ticks,
+                          future_ticks)
     accepted = merged != known
     return unpack_ts(merged), unpack_status(merged), accepted

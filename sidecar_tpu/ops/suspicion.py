@@ -101,6 +101,8 @@ class ProtocolParams:
     damping_reuse_threshold: float = 0.0  # readmit below this
                                       # (0 = auto: threshold / 2)
     damping_flap_penalty: float = 1.0  # penalty added per observed flap
+    future_fudge_s: float = -1.0      # future-admission bound
+                                      # (negative = off; ops/merge)
 
     def __post_init__(self):
         if self.suspicion_window_s < 0:
@@ -127,7 +129,8 @@ class ProtocolParams:
         applied — how the bridge/bench thread per-request protocol
         params into the jitted round."""
         return dataclasses.replace(
-            base, suspicion_window_s=self.suspicion_window_s)
+            base, suspicion_window_s=self.suspicion_window_s,
+            future_fudge_s=self.future_fudge_s)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -155,4 +158,5 @@ class ProtocolParams:
             suspicion_window_s=sidecar_cfg.suspicion_window,
             damping_half_life_s=sidecar_cfg.damping_half_life,
             damping_threshold=sidecar_cfg.damping_threshold,
+            future_fudge_s=sidecar_cfg.future_fudge,
         )

@@ -58,6 +58,11 @@ one, which tests/test_telemetry.py pins at d ∈ {1, 2, 4, 8}):
   columns cover ``own`` + ``floor`` (the authoritative structures);
   transient cache copies of tombstones ride the tombstone census but
   not this transition count.
+* ``rejected_future`` — record copies the receiver-side
+  future-admission bound rejected this round (ops/merge.future_mask,
+  docs/chaos.md).  Only the chaos family under an active ClockFault
+  can produce a nonzero value — a global-clock round never stamps
+  beyond ``now`` — so the column is truthfully 0 everywhere else.
 """
 
 from __future__ import annotations
@@ -93,10 +98,11 @@ TRACE_OVERFLOW = 6
 TRACE_TOMBSTONES = 7
 TRACE_SUSPECTS = 8
 TRACE_FP_TOMBSTONES = 9
-TRACE_WIDTH = 10
+TRACE_REJECTED_FUTURE = 10
+TRACE_WIDTH = 11
 TRACE_FIELDS = ("round", "frontier", "behind", "admitted",
                 "exchange_bytes", "sparse", "overflow", "tombstones",
-                "suspects", "fp_tombstones")
+                "suspects", "fp_tombstones", "rejected_future")
 
 
 @jax.tree_util.register_dataclass
@@ -175,7 +181,7 @@ def fp_tombstone_entries(prev, nxt, owner_alive) -> jax.Array:
 
 def build_record(round_idx, frontier, behind, admitted, exchange_bytes,
                  tombstones, suspects, fp_tombstones,
-                 stats=None) -> jax.Array:
+                 stats=None, rejected_future=0) -> jax.Array:
     """Assemble the [TRACE_WIDTH] int32 record; ``stats`` is the sparse
     step's int32 [3] vector (sparse-taken, overflowed, frontier-hwm) or
     None on dense rounds."""
@@ -195,11 +201,12 @@ def build_record(round_idx, frontier, behind, admitted, exchange_bytes,
         jnp.asarray(tombstones, jnp.int32),
         jnp.asarray(suspects, jnp.int32),
         jnp.asarray(fp_tombstones, jnp.int32),
+        jnp.asarray(rejected_future, jnp.int32),
     ])
 
 
 def exact_record(prev, nxt, *, budget: int, fanout: int, limit: int,
-                 stats=None) -> jax.Array:
+                 stats=None, rejected_future=0) -> jax.Array:
     """One round's record for the EXACT family (``SimState`` in, both
     the single-chip model and the sharded twin — the reductions shard
     cleanly under GSPMD)."""
@@ -217,7 +224,8 @@ def exact_record(prev, nxt, *, budget: int, fanout: int, limit: int,
     fp = fp_tombstone_entries(prev.known, nxt.known,
                               alive[owner][None, :])
     return build_record(nxt.round_idx, frontier, behind, admitted,
-                        xbytes, tombs, suspects, fp, stats)
+                        xbytes, tombs, suspects, fp, stats,
+                        rejected_future=rejected_future)
 
 
 def compressed_record(prev, nxt, behind, *, budget: int, fanout: int,
@@ -294,4 +302,6 @@ def summarize(trace: RoundTrace) -> dict:
         "suspects_max": int(recorded[:, TRACE_SUSPECTS].max()),
         "fp_tombstones_total": int(
             recorded[:, TRACE_FP_TOMBSTONES].astype(np.int64).sum()),
+        "rejected_future_total": int(
+            recorded[:, TRACE_REJECTED_FUTURE].astype(np.int64).sum()),
     }

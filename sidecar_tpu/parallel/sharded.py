@@ -55,7 +55,7 @@ from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
-from sidecar_tpu.ops.merge import merge_packed, staleness_mask, sticky_adjust
+from sidecar_tpu.ops.merge import admit_gate, merge_packed, sticky_adjust
 from sidecar_tpu.ops.status import (
     TOMBSTONE,
     is_known,
@@ -195,7 +195,7 @@ class ShardedSim:
         tgt = jnp.broadcast_to(dst_b[:, :, None], (bn, fanout, budget))
         svc = jnp.broadcast_to(svc_b[:, None, :], (bn, fanout, budget))
 
-        val = jnp.where(staleness_mask(val, now, t.stale_ticks), 0, val)
+        val = admit_gate(val, now, t.stale_ticks, t.future_ticks)
         val = jnp.where(alive[senders][:, None, None], val, 0)
         val = jnp.where(alive[tgt], val, 0)
         if keep_b is not None:
@@ -437,11 +437,12 @@ class ShardedSim:
         if self._side is not None:
             ok &= self._side == jnp.roll(self._side, -stride)
         fwd = jnp.where(ok[:, None], jnp.roll(known, -stride, axis=0), 0)
-        pulled = merge_packed(known, fwd, now, t.stale_ticks)
+        pulled = merge_packed(known, fwd, now, t.stale_ticks,
+                              t.future_ticks)
 
         # Push = the reverse roll, stickiness vs the receiver's
         # pre-exchange row (same batch resolution as ops/gossip.push_pull).
-        offered = jnp.where(staleness_mask(known, now, t.stale_ticks), 0, known)
+        offered = admit_gate(known, now, t.stale_ticks, t.future_ticks)
         ok_back = alive & jnp.roll(alive, stride)
         if self._side is not None:
             ok_back &= self._side == jnp.roll(self._side, stride)

@@ -96,7 +96,7 @@ from sidecar_tpu.models.compressed import (
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import sparse as sparse_ops
-from sidecar_tpu.ops.merge import staleness_mask
+from sidecar_tpu.ops.merge import admit_gate
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.parallel.mesh import (
     NODE_AXIS,
@@ -398,8 +398,7 @@ class ShardedCompressedSim(CompressedSim):
         # Phase 1 — local board rows + transmit accounting, then the
         # board staleness gate once per shard (rows travel filtered).
         bval_l, bslot_l, sent = self._publish(local, limit, row_offset=r0)
-        bval_f = jnp.where(staleness_mask(bval_l, now, t.stale_ticks),
-                           0, bval_l)
+        bval_f = admit_gate(bval_l, now, t.stale_ticks, t.future_ticks)
 
         ok = alive[dst] & alive[gi][:, None]             # [nl, F]
         keep = None
@@ -559,8 +558,7 @@ class ShardedCompressedSim(CompressedSim):
             limit=limit, fanout=p.fanout, cache_lines=k,
             row_ids=idx_s + r0)
         sent = jnp.where(sender_l[:, None], sent_c[pos_s], csent_l)
-        bval_c = jnp.where(staleness_mask(bval_c, now, t.stale_ticks),
-                           0, bval_c)
+        bval_c = admit_gate(bval_c, now, t.stale_ticks, t.future_ticks)
         snd_c = sender_l[:, None]
         bval_f = jnp.where(snd_c, bval_c[pos_s], 0)
         bslot_f = jnp.where(snd_c, bslot_c[pos_s], -1)

@@ -70,6 +70,29 @@ class OracleSim:
         self.round_idx = int(state.round_idx)
         self.owner = np.asarray(sim.owner)
         self.limit = sim.p.resolved_retransmit_limit()
+        # ClockFault mirror (chaos/plan.py): a CLOCK-ONLY chaos plan
+        # leaves the round structurally identical to ExactSim's, so the
+        # oracle can lockstep a ChaosExactSim by reading the per-node
+        # skew off the plan (edge/node faults are NOT mirrored here).
+        plan = getattr(sim, "plan", None)
+        self.clocks = plan if plan is not None and plan.clocks else None
+        # Future-admission bound (ops/merge.future_mask): None = off.
+        self.future_ticks = sim.t.future_ticks
+
+    def _offsets(self) -> np.ndarray | None:
+        """Per-node skew ticks for the CURRENT round, or None — the
+        NumPy twin of CompiledFaultPlan.clock_offsets (identical
+        float32-multiply + floor drift math)."""
+        if self.clocks is None:
+            return None
+        return np.array([self.clocks.clock_offset(i, self.round_idx)
+                         for i in range(self.p.n)], dtype=np.int64)
+
+    def _too_future(self, ts: int, now_r: int) -> bool:
+        """Receiver-side future-admission bound (ops/merge.future_mask)
+        against the RECEIVER's clock ``now_r``; False when disabled."""
+        return self.future_ticks is not None and \
+            ts > now_r + self.future_ticks
 
     # -- one delivered/announced value, vs the pre-round snapshot ----------
 
@@ -134,7 +157,15 @@ class OracleSim:
                 k_drop, 1.0 - p.drop_prob, (p.n, p.fanout, budget))
             drop = ~np.asarray(keep)
 
-        stale_floor = now - t.stale_ticks
+        # Per-node clocks (ClockFault): senders already stamped with
+        # their own skewed clocks; every RECEIVER gates admission,
+        # refresh, and expiry by its own.
+        offs = self._offsets()
+
+        def clock(node: int) -> int:
+            # Epoch floor, mirroring the sim's jnp.maximum(now+off, 0).
+            return now if offs is None else max(0, now + int(offs[node]))
+
         for s in range(p.n):
             if not self.node_alive[s]:
                 continue
@@ -142,12 +173,16 @@ class OracleSim:
                 tgt = int(dst[s, f])
                 if not self.node_alive[tgt]:
                     continue
+                now_r = clock(tgt)
+                stale_floor = now_r - t.stale_ticks
                 for b in range(budget):
                     if drop is not None and drop[s, f, b]:
                         continue
                     val = int(msg[s, b])
                     ts = val >> STATUS_BITS
                     if ts > 0 and ts < stale_floor:  # staleness gate
+                        continue
+                    if self._too_future(ts, now_r):  # future bound
                         continue
                     self.apply_one(tgt, int(svc_idx[s, b]), val, pre)
 
@@ -165,16 +200,17 @@ class OracleSim:
             ts, st = _ts(cur), _st(cur)
             if ts == 0 or st == TOMBSTONE:
                 continue
+            now_o = clock(o)   # the OWNER's clock stamps its refresh
             phase = ((m * 2654435761) & 0xFFFFFFFF) % t.refresh_rounds
             due = (self.round_idx % t.refresh_rounds) == phase \
-                and (now - ts) >= guard
+                and (now_o - ts) >= guard
             if t.suspicion_window > 0 and st == SUSPECT:
                 # Lifeguard self-refutation (ops/suspicion.py): an
                 # alive owner whose own record is quarantined announces
                 # a refuting ALIVE immediately, phase regardless.
                 due, st = True, ALIVE
             if due:
-                self.apply_one(o, m, _pack(now, st), pre)
+                self.apply_one(o, m, _pack(now_o, st), pre)
 
         # 3. anti-entropy push-pull.
         if self.round_idx % t.push_pull_rounds == 0:
@@ -187,24 +223,25 @@ class OracleSim:
             alive = self.node_alive
             partner = np.where(alive & alive[partner], partner,
                                np.arange(p.n))
-            self.push_pull(partner, now)
+            self.push_pull(partner, now, offs)
 
         # 4. lifespan sweep.
         if self.round_idx % t.sweep_rounds == 0:
-            self.sweep(now)
+            self.sweep(now, offs)
 
     # -- anti-entropy ------------------------------------------------------
 
-    def push_pull(self, partner: np.ndarray, now: int) -> None:
+    def push_pull(self, partner: np.ndarray, now: int,
+                  offs: np.ndarray | None = None) -> None:
         """Two-way full-state exchange per initiator (LocalState/
         MergeRemoteState, services_delegate.go:146-167). All exchanged
         payloads are read from the pre-exchange snapshot — in the kernel
         every pull gathers and every push offers pre-round state, so the
-        oracle does the same to stay bit-identical."""
+        oracle does the same to stay bit-identical.  Each leg admits at
+        the RECEIVING node's clock (``offs`` per-node skew)."""
         n = self.known.shape[0]
         t = self.t
         pre = self.known.copy()
-        stale_floor = now - t.stale_ticks
         for i in range(n):
             tgt = int(partner[i])
             if tgt == i:
@@ -212,21 +249,30 @@ class OracleSim:
             for m in range(self.known.shape[1]):
                 for node, val in ((i, int(pre[tgt, m])),   # pull
                                   (tgt, int(pre[i, m]))):  # push
+                    now_r = now if offs is None \
+                        else max(0, now + int(offs[node]))
                     ts = val >> STATUS_BITS
-                    if ts == 0 or ts < stale_floor:
+                    if ts == 0 or ts < now_r - t.stale_ticks:
+                        continue
+                    if self._too_future(ts, now_r):
                         continue
                     self.apply_one(node, m, val, pre)
 
     # -- lifespan sweep ----------------------------------------------------
 
-    def sweep(self, now: int) -> None:
+    def sweep(self, now: int, offs: np.ndarray | None = None) -> None:
         """TombstoneOthersServices per node (services_state.go:635-683),
         plus the SWIM suspicion quarantine when the window is enabled
-        (ops/ttl.py suspicion_window, docs/chaos.md)."""
+        (ops/ttl.py suspicion_window, docs/chaos.md).  Each node expires
+        by its OWN clock (``offs`` per-node skew) — a slow node sees
+        everyone else as early-stale, the FP-tombstone workload."""
         t = self.t
         window = t.suspicion_window
         n, m_tot = self.known.shape
+        now_g = now
         for node in range(n):
+            now = now_g if offs is None \
+                else max(0, now_g + int(offs[node]))
             for m in range(m_tot):
                 cur = int(self.known[node, m])
                 ts, st = _ts(cur), _st(cur)

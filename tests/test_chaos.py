@@ -17,6 +17,7 @@ from sidecar_tpu import service as S
 from sidecar_tpu.catalog import ServicesState
 from sidecar_tpu.chaos import (
     ChaosExactSim,
+    ClockFault,
     CompiledFaultPlan,
     EdgeFault,
     FaultPlan,
@@ -87,6 +88,108 @@ class TestPlanSchema:
         draws = [coin(7, i) for i in range(2000)]
         assert all(0.0 <= d < 1.0 for d in draws)
         assert 0.4 < np.mean(draws) < 0.6
+
+
+class TestClockFaultPlan:
+    def test_validation_named_errors(self):
+        with pytest.raises(ValueError, match="negative window start"):
+            ClockFault(start_round=-1)
+        with pytest.raises(ValueError, match="empty window"):
+            ClockFault(start_round=5, end_round=5)
+        with pytest.raises(ValueError,
+                           match="drift requires a bounded window"):
+            ClockFault(drift_ticks_per_round=1.5)
+
+    def test_json_round_trip_with_clocks(self):
+        plan = FaultPlan(seed=3, clocks=(
+            ClockFault(nodes=(1,), start_round=2, end_round=30,
+                       offset_ticks=500, drift_ticks_per_round=1.5,
+                       step_ticks=100, step_round=7),
+            ClockFault(nodes="all", offset_ticks=-250),))
+        assert FaultPlan.loads(plan.dumps()) == plan
+
+    def test_offset_window_drift_step_and_addition(self):
+        f = ClockFault(nodes=(0,), start_round=10, end_round=20,
+                       offset_ticks=100, drift_ticks_per_round=2.5,
+                       step_ticks=1000, step_round=15)
+        assert f.offset_at(9) == 0 and f.offset_at(20) == 0
+        assert f.offset_at(10) == 100
+        assert f.offset_at(12) == 105          # floor(2.5 * 2)
+        assert f.offset_at(16) == 100 + 15 + 1000
+        plan = FaultPlan(seed=1, clocks=(
+            f, ClockFault(nodes=(0,), offset_ticks=7)))
+        # Overlapping entries add; uncovered nodes stamp honestly.
+        assert plan.clock_offset(0, 16) == f.offset_at(16) + 7
+        assert plan.clock_offset(1, 16) == 0
+
+
+class TestClockSkewSim:
+    """ChaosExactSim clock threading: a skewed node stamps with ITS
+    clock, every receiver gates with its own, the NumPy oracle tracks
+    it tick for tick, and the epoch floor keeps a behind clock from
+    minting sign-corrupted keys."""
+
+    SKEW_CFG = dataclasses.replace(
+        CFG, refresh_interval_s=3.0, push_pull_interval_s=2.0,
+        sweep_interval_s=1.0)
+
+    def _plan(self):
+        return FaultPlan(seed=11, clocks=(
+            ClockFault(nodes=(0,), start_round=3, end_round=18,
+                       offset_ticks=30_000, drift_ticks_per_round=7.5),
+            ClockFault(nodes=(1,), start_round=5, end_round=25,
+                       offset_ticks=-9_000, step_ticks=2_000,
+                       step_round=12),))
+
+    def test_oracle_lockstep_with_skew_and_bound(self):
+        """The acceptance pin: model vs oracle, ClockFault ACTIVE
+        (rushing + slow-with-step) and the future bound ENABLED —
+        every stamping site and every receiver-clock gate must agree
+        bit for bit."""
+        from sidecar_tpu.sim.oracle import OracleSim
+
+        cfg = dataclasses.replace(self.SKEW_CFG, future_fudge_s=0.5)
+        sim = ChaosExactSim(
+            SimParams(n=8, services_per_node=2, fanout=2, budget=5),
+            topology.complete(8), cfg, plan=self._plan())
+        cst = sim.init_state()
+        oracle = OracleSim(sim, cst.sim)
+        keys = jax.random.split(jax.random.PRNGKey(2), 25)
+        for i in range(25):
+            cst = sim.step(cst, keys[i])
+            oracle.step(keys[i])
+            np.testing.assert_array_equal(
+                np.asarray(cst.sim.known), oracle.known,
+                err_msg=f"known diverged at round {i + 1}")
+            np.testing.assert_array_equal(
+                np.asarray(cst.sim.sent).astype(np.int32), oracle.sent,
+                err_msg=f"sent diverged at round {i + 1}")
+        # The rushing node's re-stamps actually hit the gate.
+        assert sim.injection_counts(cst)["rejected_future"] > 0
+
+    def test_rejections_counted_and_published(self):
+        before = metrics.counter("clock.sim.rejectedFuture")
+        cfg = dataclasses.replace(self.SKEW_CFG, future_fudge_s=0.2)
+        sim = make_sim(n=8, cfg=cfg, plan=self._plan())
+        state, _ = run_conv(sim, 40)
+        rejected = sim.injection_counts(state)["rejected_future"]
+        assert rejected > 0
+        assert metrics.counter("clock.sim.rejectedFuture") >= \
+            before + rejected
+
+    def test_bound_disabled_never_rejects(self):
+        sim = make_sim(n=8, cfg=self.SKEW_CFG, plan=self._plan())
+        state, _ = run_conv(sim, 40)
+        assert sim.injection_counts(state)["rejected_future"] == 0
+
+    def test_epoch_floor_no_negative_packed_keys(self):
+        """A clock 10^7 ticks behind reads tick 0, not a negative — an
+        unclamped negative would mint a sign-corrupted packed key."""
+        plan = FaultPlan(seed=5, clocks=(
+            ClockFault(nodes=(0,), offset_ticks=-10_000_000),))
+        sim = make_sim(n=8, cfg=self.SKEW_CFG, plan=plan)
+        state, _ = run_conv(sim, 30)
+        assert int(np.asarray(state.sim.known).min()) >= 0
 
 
 class TestSimBitCompat:

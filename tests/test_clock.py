@@ -1,0 +1,368 @@
+"""Clock-skew resilience (docs/chaos.md): the future-admission bound.
+
+Covers the gate semantics in ops/merge (strict ``>``, reject-not-clamp,
+``None`` = not compiled), the int32 packed-key horizon guard with
+injected skew folded in, the host/sim staleness cross-pin
+(``Service.is_stale`` vs ``ops/merge.staleness_mask`` must draw the
+same line), the live writer's reject path and its interplay with
+``send_services``' +50 ns re-broadcast bump, and the bound-disabled /
+bound-enabled bit-identity pins across every model family (single-chip
+dense + sparse, compressed, and both sharded twins at every mesh width
+x board_exchange mode — an honest cluster must compile and run the
+SAME trajectory whether the bound is off or generously on).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sidecar_tpu import metrics
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.chaos import ChaosExactSim, ClockFault, FaultPlan
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops.merge import (
+    admit_gate,
+    future_mask,
+    merge_packed,
+    staleness_mask,
+)
+from sidecar_tpu.ops.status import ALIVE, MAX_TICK, pack
+from sidecar_tpu.parallel.mesh import make_mesh
+from sidecar_tpu.runtime.looper import FreeLooper
+
+from tests.test_sharded import DetShardedSim, det_sample_peers
+from tests.test_sharded_compressed import (
+    DET,
+    DetShardedCompressedSim,
+    assert_states_equal,
+)
+
+MODES = ("all_gather", "all_to_all", "ring")
+DENSE_MODES = ("all_gather", "ring")
+DS = (1, 2, 4, 8)
+
+DET_DENSE = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=1e6,
+                       sweep_interval_s=1.0)
+
+
+def key(ts, st=ALIVE):
+    return int(pack(ts, st))
+
+
+class TestFutureGateSemantics:
+    """ops/merge.future_mask + admit_gate: strict ``>``, tie admitted,
+    reject never clamps, ``None`` compiles no gate at all."""
+
+    NOW = 50_000
+    FT = 500
+
+    def _merge(self, known, inc, ft):
+        out = merge_packed(jnp.asarray([known], jnp.int32),
+                           jnp.asarray([inc], jnp.int32),
+                           self.NOW, stale_ticks=40_000, future_ticks=ft)
+        return int(out[0])
+
+    def test_boundary_tie_admitted(self):
+        inc = key(self.NOW + self.FT)
+        assert self._merge(key(10), inc, self.FT) == inc
+
+    def test_one_tick_beyond_rejected_not_clamped(self):
+        cur = key(10)
+        inc = key(self.NOW + self.FT + 1)
+        out = self._merge(cur, inc, self.FT)
+        assert out == cur            # rejected outright — no clamped stamp
+
+    def test_rejected_even_on_unknown_cell(self):
+        assert self._merge(0, key(self.NOW + self.FT + 1), self.FT) == 0
+
+    def test_none_disables_the_gate(self):
+        inc = key(self.NOW + 10 * self.FT)
+        assert self._merge(key(10), inc, None) == inc
+
+    def test_future_mask_strictness(self):
+        vals = jnp.asarray([key(self.NOW + self.FT),
+                            key(self.NOW + self.FT + 1),
+                            key(self.NOW - 1), 0], jnp.int32)
+        m = np.asarray(future_mask(vals, self.NOW, self.FT))
+        assert m.tolist() == [False, True, False, False]
+
+    def test_admit_gate_zeroes_future_values(self):
+        vals = jnp.asarray([key(self.NOW + self.FT + 1), key(100)],
+                           jnp.int32)
+        out = np.asarray(admit_gate(vals, self.NOW, 1_000_000, self.FT))
+        assert out.tolist() == [0, key(100)]
+
+
+class TestHorizonGuard:
+    """int32 packed-key overflow guard: ``max_safe_rounds`` is the
+    boundary, injected ClockFault skew counts against it, and the chaos
+    driver refuses a run that would wrap the clock into the sign bit."""
+
+    def test_max_safe_rounds_boundary(self):
+        t = TimeConfig()
+        assert t.max_safe_rounds == MAX_TICK // t.round_ticks
+        t.validate_horizon(t.max_safe_rounds)           # exactly safe
+        with pytest.raises(ValueError, match="overflows the int32"):
+            t.validate_horizon(t.max_safe_rounds + 1)
+
+    def test_skew_counts_against_horizon(self):
+        t = TimeConfig()
+        # Shift rounds into skew tick-for-tick: still exactly safe.
+        t.validate_horizon(t.max_safe_rounds - 10,
+                           skew_ticks=10 * t.round_ticks)
+        with pytest.raises(ValueError, match="skew ticks"):
+            t.validate_horizon(t.max_safe_rounds,
+                               skew_ticks=t.round_ticks + 1)
+
+    def test_plan_max_offset_folds_drift_and_step(self):
+        f = ClockFault(nodes=(0,), start_round=10, end_round=20,
+                       offset_ticks=100, drift_ticks_per_round=2.5,
+                       step_ticks=1000, step_round=15)
+        # Window peak: offset + floor(2.5 * 9) + step.
+        assert f.max_offset == 100 + 22 + 1000
+        plan = FaultPlan(seed=1, clocks=(
+            f, ClockFault(nodes=(1,), offset_ticks=7)))
+        assert plan.max_clock_offset == f.max_offset + 7
+
+    def test_chaos_driver_refuses_overflowing_skew(self):
+        plan = FaultPlan(seed=1, clocks=(
+            ClockFault(nodes=(0,), start_round=0, end_round=10,
+                       offset_ticks=MAX_TICK),))
+        sim = ChaosExactSim(
+            SimParams(n=4, services_per_node=1, fanout=2, budget=3),
+            topology.complete(4), TimeConfig(), plan=plan)
+        with pytest.raises(ValueError, match="overflows the int32"):
+            sim.run(sim.init_state(), jax.random.PRNGKey(0), 1)
+
+
+class TestStalenessCrossPin:
+    """The host merge path (Service.is_stale, ns clocks) and the sim
+    merge path (ops/merge.staleness_mask, tick clocks) must draw the
+    SAME staleness line at the same logical instants — the cross-path
+    equivalence the clock-skew work leans on."""
+
+    def test_host_and_sim_agree_across_the_boundary(self):
+        t = TimeConfig()
+        # The two planes must start from the same wall-clock constants.
+        assert t.tombstone_lifespan_s == S.TOMBSTONE_LIFESPAN
+        assert t.staleness_fudge_s == S.STALENESS_FUDGE
+        ns_per_tick = S.NS_PER_SECOND // t.ticks_per_second
+        now_tick = 20_000_000
+        now_ns = now_tick * ns_per_tick
+        ages = (1, t.stale_ticks - 1, t.stale_ticks, t.stale_ticks + 1,
+                now_tick - 1)
+        for age in ages:
+            ts = now_tick - age
+            sim_stale = bool(np.asarray(staleness_mask(
+                jnp.asarray([key(ts)], jnp.int32), now_tick,
+                t.stale_ticks))[0])
+            svc = S.Service(id="x", name="web", image="i:1",
+                            hostname="h", updated=ts * ns_per_tick,
+                            status=S.ALIVE, ports=[])
+            host_stale = svc.is_stale(t.tombstone_lifespan_s, now=now_ns)
+            assert sim_stale == host_stale, \
+                f"paths disagree at age={age} ticks " \
+                f"(sim={sim_stale}, host={host_stale})"
+
+
+FIXED_NOW = 1_700_000_000 * S.NS_PER_SECOND
+
+
+class TestLiveFutureGate:
+    """catalog/state.py writer-path twin of the sim gate: reject (and
+    count) beyond ``now + fudge``, admit the tie, pass everything when
+    disabled."""
+
+    def make_state(self, fudge):
+        st = ServicesState(hostname="recv")
+        st.future_fudge_s = fudge
+        st.set_clock(lambda: FIXED_NOW)
+        return st
+
+    def svc(self, updated, sid="svc-1"):
+        return S.Service(id=sid, name="web", image="i:1", hostname="src",
+                         updated=updated, status=S.ALIVE,
+                         ports=[S.Port("tcp", 1000, 80, "127.0.0.1")])
+
+    def _admitted(self, st, svc):
+        st.add_service_entry(svc)
+        server = st.servers.get(svc.hostname)
+        return server is not None and svc.id in server.services
+
+    def test_future_record_rejected_and_counted(self):
+        st = self.make_state(0.5)
+        before = metrics.counter("clock.live.rejectedFuture")
+        too_far = FIXED_NOW + int(0.5 * S.NS_PER_SECOND) + 1
+        assert not self._admitted(st, self.svc(too_far))
+        assert metrics.counter("clock.live.rejectedFuture") == before + 1
+
+    def test_tie_admitted(self):
+        st = self.make_state(0.5)
+        at_bound = FIXED_NOW + int(0.5 * S.NS_PER_SECOND)
+        assert self._admitted(st, self.svc(at_bound))
+
+    def test_disabled_admits_any_future_stamp(self):
+        st = self.make_state(-1.0)
+        assert self._admitted(
+            st, self.svc(FIXED_NOW + 3600 * S.NS_PER_SECOND))
+
+
+class TestSendServicesBumpWithinBound:
+    """Regression pin: the +50 ns/round re-broadcast bump
+    (catalog/state.send_services, services_state.go:585-599) must stay
+    FAR inside any practical future-admission bound over a full
+    1-minute refresh window — the bound must never eat the protocol's
+    own retransmit nudge."""
+
+    REFRESH_ROUNDS = 60     # 1 Hz re-enqueue over the 1-min window
+
+    def test_bump_is_nanoseconds_while_the_bound_is_milliseconds(self):
+        sender = ServicesState(hostname="send")
+        svc = S.Service(id="svc-1", name="web", image="i:1",
+                        hostname="send", updated=FIXED_NOW,
+                        status=S.ALIVE,
+                        ports=[S.Port("tcp", 1000, 80, "127.0.0.1")])
+        sender.send_services([svc], FreeLooper(self.REFRESH_ROUNDS),
+                             background=False)
+        stamps = []
+        while not sender.broadcasts.empty():
+            for payload in sender.broadcasts.get_nowait():
+                stamps.append(S.decode(payload).updated)
+        assert len(stamps) == self.REFRESH_ROUNDS
+        worst = max(stamps) - FIXED_NOW
+        assert worst == 50 * (self.REFRESH_ROUNDS - 1)
+        # Tightest bound the skew bench ships (0.5 s): five orders of
+        # magnitude of headroom over the worst in-window bump.
+        assert worst < 0.5 * S.NS_PER_SECOND / 1e5
+
+        # And end-to-end: the most-bumped copy clears a 0.5 s gate at a
+        # receiver whose clock still reads the ORIGINAL stamp time.
+        recv = ServicesState(hostname="recv")
+        recv.future_fudge_s = 0.5
+        recv.set_clock(lambda: FIXED_NOW)
+        before = metrics.counter("clock.live.rejectedFuture")
+        bumped = svc.copy()
+        bumped.updated = FIXED_NOW + worst
+        recv.add_service_entry(bumped)
+        assert metrics.counter("clock.live.rejectedFuture") == before
+        assert "send" in recv.servers
+
+
+class TestBoundBitIdentity:
+    """An honest (skew-free) cluster must run the SAME trajectory with
+    the bound disabled (gate not compiled) and with it generously
+    enabled (gate compiled, never firing) — pinned bit-for-bit on every
+    model family.  Any off-by-one in the gate (e.g. rejecting the tie,
+    or gating against the wrong clock) breaks equality at the first
+    diverging round."""
+
+    ON = 2.0                # seconds — generous vs honest stamps
+
+    def test_exact_dense_and_sparse(self):
+        params = SimParams(n=16, services_per_node=2, fanout=2,
+                           budget=4, drop_prob=0.3)
+        off_cfg = DET_DENSE
+        on_cfg = dataclasses.replace(DET_DENSE, future_fudge_s=self.ON)
+        off = ExactSim(params, topology.complete(16), off_cfg)
+        on = ExactSim(params, topology.complete(16), on_cfg)
+        on_sparse = ExactSim(params, topology.complete(16), on_cfg)
+        so, sn, ss = (off.init_state(), on.init_state(),
+                      on_sparse.init_state())
+        for i in range(12):
+            k = jax.random.PRNGKey(i)
+            so = off.step(so, k)
+            sn = on.step(sn, k)
+            ss, _ = on_sparse.step_sparse(ss, k)
+            for name, got in (("dense", sn), ("sparse", ss)):
+                np.testing.assert_array_equal(
+                    np.asarray(so.known), np.asarray(got.known),
+                    err_msg=f"known {name} r{i + 1}")
+                np.testing.assert_array_equal(
+                    np.asarray(so.sent), np.asarray(got.sent),
+                    err_msg=f"sent {name} r{i + 1}")
+
+    def _compressed_run(self, sim, rounds=8):
+        rng = np.random.default_rng(7)
+        schedule = {i: np.sort(rng.choice(
+            sim.p.m, size=5, replace=False)).astype(np.int32)
+            for i in (0, 3)}
+        st = sim.init_state()
+        states = []
+        for i in range(rounds):
+            if i in schedule:
+                tick = int(st.round_idx) * sim.t.round_ticks + 7
+                st = sim.mint(st, schedule[i], tick)
+            st = sim.step(st, jax.random.PRNGKey(100 + i))
+            states.append(st)
+        return states
+
+    def test_compressed_single_chip(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        off = CompressedSim(params, topology.complete(16), DET)
+        on = CompressedSim(params, topology.complete(16),
+                           dataclasses.replace(DET,
+                                               future_fudge_s=self.ON))
+        ref = self._compressed_run(off)
+        got = self._compressed_run(on)
+        for i, (a, b) in enumerate(zip(ref, got)):
+            assert_states_equal(a, b, f"compressed r{i + 1}")
+
+    def test_sharded_dense_twin_modes_by_d(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        rounds = 8
+        exact = ExactSim(params, topology.complete(16), DET_DENSE)
+        se = exact.init_state()
+        ref = []
+        for i in range(rounds):
+            se = exact.step(se, jax.random.PRNGKey(i))
+            ref.append(se)
+        on_cfg = dataclasses.replace(DET_DENSE, future_fudge_s=self.ON)
+        for d in DS:
+            for mode in DENSE_MODES:
+                sharded = DetShardedSim(
+                    params, topology.complete(16), on_cfg,
+                    mesh=make_mesh(jax.devices()[:d]),
+                    board_exchange=mode)
+                ss = sharded.init_state()
+                for i in range(rounds):
+                    ss = sharded.step(ss, jax.random.PRNGKey(i))
+                    np.testing.assert_array_equal(
+                        np.asarray(ref[i].known), np.asarray(ss.known),
+                        err_msg=f"known {mode}/d={d} r{i + 1}")
+                    np.testing.assert_array_equal(
+                        np.asarray(ref[i].sent), np.asarray(ss.sent),
+                        err_msg=f"sent {mode}/d={d} r{i + 1}")
+
+    @pytest.mark.pallas
+    def test_sharded_compressed_twin_modes_by_d(self, monkeypatch):
+        """Pallas kernels active: the post-kernel publish gate must be a
+        no-op on honest stamps at every mode x d."""
+        monkeypatch.setenv(kernel_ops.ENV_VAR, "pallas")
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        single = CompressedSim(params, topology.complete(16), DET)
+        assert single._kernels == "pallas"
+        ref = self._compressed_run(single)
+        on_cfg = dataclasses.replace(DET, future_fudge_s=self.ON)
+        for d in DS:
+            for mode in MODES:
+                sharded = DetShardedCompressedSim(
+                    params, topology.complete(16), on_cfg,
+                    mesh=make_mesh(jax.devices()[:d]),
+                    board_exchange=mode)
+                got = self._compressed_run(sharded)
+                for i, (a, b) in enumerate(zip(ref, got)):
+                    assert_states_equal(a, b, f"{mode}/d={d} r{i + 1}")

@@ -52,6 +52,9 @@ EXACT_SPECS = (
                  alive_lifespan_s=2.0, sweep_interval_s=0.4,
                  refresh_interval_s=4.0),
     ScenarioSpec(name="churny", seed=6, churn_prob=0.01),
+    # Future-admission bound active (ops/merge.future_mask): the knob
+    # must stack as a data axis and lockstep the unbatched run.
+    ScenarioSpec(name="fudged", seed=7, future_fudge_s=0.5),
 )
 
 
@@ -392,6 +395,19 @@ class TestGrid:
         with pytest.raises(ValueError, match="unknown grid axis"):
             expand_grid({"fanuot": [2, 3]})
 
+    def test_future_fudge_axis(self):
+        """future_fudge_s is a data axis (negative = bound disabled is
+        a legal grid point, not a validation error)."""
+        specs = expand_grid({"future_fudge_s": [-1.0, 0.5]})
+        assert sorted(s.future_fudge_s for s in specs) == [-1.0, 0.5]
+        batch = ScenarioBatch.build(specs, EXACT_PARAMS, BASE,
+                                    family="exact")
+        ft = np.asarray(batch.knobs.future_ticks)
+        assert sorted(ft.tolist()) == [-1, 500]
+        assert batch.scenario_timecfg(
+            [s.future_fudge_s for s in specs].index(0.5)).future_ticks \
+            == 500
+
     def test_pareto_front(self):
         rows = [
             {"rounds_to_eps": 10, "exchange_bytes": 100},   # on front
@@ -441,6 +457,34 @@ class TestSweepHttp:
             # Front rows genuinely converged.
             for i in front:
                 assert doc["table"][i]["rounds_to_eps"] is not None
+        finally:
+            server.shutdown()
+
+    def test_future_fudge_axis_round_trip(self):
+        """``future_fudge_s`` sweeps over the wire: bound off vs on as
+        grid points, echoed back in each row's config."""
+        from sidecar_tpu.bridge import serve_bridge
+
+        server = serve_bridge(self._bridge(), port=0)
+        try:
+            port = server.server_address[1]
+            body = json.dumps({
+                "axes": {"future_fudge_s": [-1.0, 0.5]},
+                "rounds": 20, "eps": 0.05, "n": 12,
+                "services_per_node": 2, "budget": 5,
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/sweep", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                doc = json.loads(resp.read())
+            assert doc["points"] == 2
+            fudges = sorted(row["config"]["future_fudge_s"]
+                            for row in doc["table"])
+            assert fudges == [-1.0, 0.5]
+            # An honest (skew-free) sweep: the bound changes nothing.
+            for row in doc["table"]:
+                assert row["rounds_to_eps"] is not None
         finally:
             server.shutdown()
 
