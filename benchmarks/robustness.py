@@ -116,6 +116,41 @@ def skew_plan(n: int, rush_ticks: int, slow_ticks: int,
     )
 
 
+def _phase_lag(sim, rounds: int, seed: int, tracers: int = 8,
+               origin_nodes=()) -> dict:
+    """Per-phase propagation-lag summary (ops/provenance.py): the
+    phase's EXACT trajectory re-run under the record-level tracer (the
+    scan folds the same per-round keys as the measurement loop, so the
+    traced run is bit-identical) and reduced to the pooled per-record
+    lag percentiles in rounds.  One jitted scan — cheap next to the
+    phase's per-round host loop.
+
+    With ``origin_nodes`` (the faulted/skewed set), one record per
+    origin is force-tracked and the summary gains a ``blast_radius``
+    block: how much of the cluster each origin's record reached, and
+    via which paths (docs/telemetry.md)."""
+    import jax
+    import numpy as np
+
+    from sidecar_tpu.ops import provenance as prov_ops
+
+    spn = sim.p.services_per_node
+    tracked = set(prov_ops.default_tracked(sim.p.m, tracers))
+    tracked.update(int(node) * spn for node in origin_nodes)
+    tracked = tuple(sorted(tracked))
+    _final, pv, _conv = sim.run_with_provenance(
+        sim.init_state(), jax.random.PRNGKey(seed), rounds, tracked)
+    lag = prov_ops.pooled_lag(
+        np.asarray(jax.device_get(pv.first_seen)))
+    lag["tracers"] = len(tracked)
+    lag["seconds_per_round"] = \
+        sim.t.round_ticks / sim.t.ticks_per_second
+    if origin_nodes:
+        lag["blast_radius"] = prov_ops.blast_radius(
+            pv, tracked, spn, origin_nodes)
+    return lag
+
+
 def _measure_skew(n: int, spn: int, rounds: int, rush_s: float,
                   slow_s: float, future_fudge_s: float, eps: float,
                   seed: int) -> dict:
@@ -225,6 +260,13 @@ def _measure_skew(n: int, spn: int, rounds: int, rush_s: float,
         "final_convergence": round(conv, 6),
         "mean_tail_convergence": round(
             sum(conv_tail) / max(len(conv_tail), 1), 6),
+        # Per-phase record-level lag (satellites the totals above:
+        # the skew headlines used to report poison/tombstone COUNTS
+        # only — this says how much the skew slowed actual spread),
+        # with blast-radius accounting for the two skewed origins.
+        "round_trace": _phase_lag(
+            sim, rounds, seed,
+            origin_nodes=(n - 1, n - 2) if skewed else ()),
     }
 
 
@@ -372,6 +414,10 @@ def _measure(n: int, spn: int, rounds: int, suspicion_window_s: float,
         # must agree on for the fp/churn ratios to be meaningful.
         "mean_tail_convergence": round(
             sum(conv_tail) / max(len(conv_tail), 1), 6),
+        # Per-phase record-level lag: the suspicion headlines used to
+        # report fp/churn totals only — this adds how fast records
+        # actually spread under each knob setting.
+        "round_trace": _phase_lag(sim, rounds, seed),
     }
 
 
@@ -398,7 +444,7 @@ def run_robustness(n: int = 128, spn: int = 2, rounds: int = 200,
     metrics.incr("suspicion.fp_tombstones", on["fp_tombstones"])
     metrics.set_gauge("suspicion.suspects_max", on["suspects_max"])
 
-    return {
+    block = {
         "scenario": "config6-seeded: 20% A->B loss + staggered pause "
                     "windows, expiry-scale clocks (docs/chaos.md)",
         "n": n,
@@ -410,6 +456,17 @@ def run_robustness(n: int = 128, spn: int = 2, rounds: int = 200,
         "proxy_churn_reduction": ratio(off["proxy_churn_observer"],
                                        on["proxy_churn_observer"]),
     }
+    # Convergence-SLO verdict over the suspicion-ON phase's lag
+    # (telemetry/slo.py; BENCH_SLO=0 skips, BENCH_SLO_RULES overrides
+    # the rule set — docs/env.md).
+    from sidecar_tpu.telemetry.slo import SloEvaluator
+
+    evaluator = SloEvaluator.from_env()
+    if evaluator is not None:
+        lag = on["round_trace"]
+        block["slo"] = evaluator.evaluate_lag(
+            lag, seconds_per_round=lag.get("seconds_per_round"))
+    return block
 
 
 def main() -> int:

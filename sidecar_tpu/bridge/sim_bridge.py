@@ -93,6 +93,12 @@ class SimulationReport:
     # their flap counts (the sim-side twin of catalog/damping.py,
     # cross-validated in tests/test_damping.py).
     robustness: Optional[dict] = None
+    # Record-level provenance (ops/provenance.py, docs/telemetry.md),
+    # present when the caller passed ``provenance``: per tracked record
+    # the lag CDF / hop histogram / reach summary, the pooled lag
+    # percentiles, and the exportable propagation tree — with ABSOLUTE
+    # round numbers (chunked dispatches chain the carried trace).
+    provenance: Optional[dict] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -202,7 +208,8 @@ class SimBridge:
                  board_exchange: Optional[str] = None,
                  sparse: Optional[bool] = None,
                  trace: int = 0,
-                 protocol=None) -> SimulationReport:
+                 protocol=None,
+                 provenance: Optional[dict] = None) -> SimulationReport:
         """Run the catalog forward ``rounds`` gossip rounds.
 
         ``cold_nodes``: hostnames whose knowledge is blanked to their own
@@ -250,7 +257,21 @@ class SimBridge:
         side of the sim↔live damping cross-validation
         (tests/test_damping.py).  Damping prediction consumes the
         delta stream, so it is single-chip only (like ``deltas_cap``)
-        and raises with ``sharded=True``."""
+        and raises with ``sharded=True``.
+
+        ``provenance`` turns on the record-level tracer
+        (ops/provenance.py, docs/telemetry.md): ``{"count": T}``
+        spreads T tracers evenly over the catalog's real records, or
+        ``{"services": [{"node": host, "service": id}, ...]}`` names
+        them; optional ``"cap"`` bounds the per-round coverage window
+        (default: ``rounds``).  The report's ``provenance`` block
+        carries per-record lag CDFs, hop histograms, the pooled lag
+        percentiles, and the propagation tree — round numbers are
+        ABSOLUTE across the chunked dispatch (the carried ProvTrace
+        chains chunk to chunk).  Works on both the single-chip and
+        sharded twins and under forced/auto sparse; mutually
+        exclusive with ``deltas_cap``, ``trace``, and damping
+        prediction (one scan carries one extra stream)."""
         from sidecar_tpu.ops.suspicion import ProtocolParams
 
         if protocol is not None and not isinstance(protocol,
@@ -276,6 +297,25 @@ class SimBridge:
                 "trace and damping prediction are mutually exclusive "
                 "(damping consumes the delta stream; one scan streams "
                 "one record kind)")
+        prov_on = provenance is not None
+        if prov_on and not isinstance(provenance, dict):
+            raise ValueError(
+                "'provenance' must be an object: {\"count\": T} or "
+                "{\"services\": [{\"node\": ..., \"service\": ...}]}, "
+                "optional \"cap\"")
+        if prov_on and deltas_cap > 0:
+            raise ValueError(
+                "provenance and deltas_cap are mutually exclusive "
+                "(one scan carries one extra stream)")
+        if prov_on and trace > 0:
+            raise ValueError(
+                "provenance and trace are mutually exclusive "
+                "(one scan carries one extra stream)")
+        if prov_on and damping_on:
+            raise ValueError(
+                "provenance and damping prediction are mutually "
+                "exclusive (damping consumes the delta stream; one "
+                "scan carries one extra stream)")
         # Damping prediction needs the per-round change stream even when
         # the caller didn't ask for deltas in the report.
         report_deltas = deltas_cap > 0
@@ -299,6 +339,12 @@ class SimBridge:
                 known[ni, ni * spn:(ni + 1) * spn] = own
             state = dataclasses.replace(state,
                                         known=self._put_known(sim, known))
+
+        tracked: tuple = ()
+        prov_cap = 0
+        if prov_on:
+            tracked, prov_cap = self._resolve_tracked(
+                provenance, params, mapping, rounds)
 
         key = jax.random.PRNGKey(seed)
         sizes = []
@@ -334,7 +380,16 @@ class SimBridge:
             traced_n = max(0, min(trace - start, n_rounds)) \
                 if trace > 0 else 0
             with profiling.annotate("sidecar.bridge.dispatch"):
-                if deltas_cap > 0:
+                if prov_on:
+                    # The carried ProvTrace chains chunk→chunk through
+                    # the mutable box: run_with_provenance donates the
+                    # previous chunk's buffers and the returned trace
+                    # is an async future, so dispatch stays pipelined.
+                    out = sim.run_with_provenance(
+                        st, key, n_rounds, tracked, cap=prov_cap,
+                        prov=prov_box[0], start_round=start, **kw)
+                    prov_box[0] = out[1]
+                elif deltas_cap > 0:
                     out = sim.run_with_deltas(
                         st, key, n_rounds, deltas_cap,
                         start_round=start, **kw)
@@ -350,6 +405,7 @@ class SimBridge:
 
         delta_stream = [] if deltas_cap > 0 else None
         trace_rounds = [] if trace > 0 else None
+        prov_box = [None]
         conv_parts = []
 
         def consume(out, start, n_rounds, traced):
@@ -366,6 +422,10 @@ class SimBridge:
             elif traced:
                 final, tr, conv = out
                 trace_rounds.extend(trace_ops.trace_to_dicts(tr))
+            elif prov_on:
+                # The cumulative trace lives in prov_box (the chained
+                # carry); each chunk only contributes its conv slice.
+                final, _pv, conv = out
             else:
                 final, conv = out
             conv_h = np.asarray(jax.device_get(conv))
@@ -421,6 +481,11 @@ class SimBridge:
                 robustness.update(self._predict_damping(
                     protocol, delta_stream, mapping))
 
+        prov_doc = None
+        if prov_on:
+            prov_doc = self._prov_report(prov_box[0], tracked, params,
+                                         mapping)
+
         hits = np.nonzero(conv >= 1.0 - eps)[0]
         metrics.histogram_since("bridge.simulate", t_req)
         return SimulationReport(
@@ -438,7 +503,75 @@ class SimBridge:
             trace=(None if trace_rounds is None
                    else {"requested": trace, "rounds": trace_rounds}),
             robustness=robustness,
+            provenance=prov_doc,
         )
+
+    @staticmethod
+    def _resolve_tracked(req: dict, params: SimParams,
+                         mapping: BridgeMapping,
+                         rounds: int) -> tuple[tuple, int]:
+        """Resolve a wire ``provenance`` object to (tracked slots,
+        coverage cap).  ``{"count": T}`` spreads T tracers evenly over
+        the REAL records (padded slots hold nothing and would only
+        dilute the lag CDF); ``{"services": [...]}`` names records as
+        (hostname, service id) pairs.  Unknown keys and unknown
+        services are 400s at the HTTP surface."""
+        from sidecar_tpu.ops import provenance as prov_ops
+
+        unknown = set(req) - {"count", "services", "cap"}
+        if unknown:
+            raise ValueError(
+                f"provenance: unknown key(s) {sorted(unknown)}; "
+                "expected 'count' or 'services', optional 'cap'")
+        cap = int(req.get("cap", 0))
+        if cap < 0:
+            raise ValueError(f"provenance.cap={cap} must be >= 0")
+        cap = cap or rounds
+        spn = params.services_per_node
+        if "services" in req:
+            ents = req["services"]
+            if not isinstance(ents, list) or not ents:
+                raise ValueError(
+                    "provenance.services must be a non-empty list of "
+                    "{\"node\": hostname, \"service\": id} objects")
+            slots = set()
+            for ent in ents:
+                host, sid = ent["node"], ent["service"]
+                if host not in mapping.hostnames:
+                    raise KeyError(host)
+                ni = mapping.hostnames.index(host)
+                if sid not in mapping.slots[ni]:
+                    raise KeyError(f"{host}/{sid}")
+                slots.add(ni * spn + mapping.slots[ni].index(sid))
+            return tuple(sorted(slots)), cap
+        count = int(req.get("count", 8))
+        if count < 1:
+            raise ValueError(
+                f"provenance.count={count} must be >= 1")
+        real = [ni * spn + si
+                for ni in range(len(mapping.hostnames))
+                for si, sid in enumerate(mapping.slots[ni])
+                if sid is not None]
+        picks = prov_ops.default_tracked(len(real),
+                                         min(count, len(real)))
+        return tuple(sorted({real[p] for p in picks})), cap
+
+    @staticmethod
+    def _prov_report(prov, tracked: tuple, params: SimParams,
+                     mapping: BridgeMapping) -> dict:
+        """Reduce the finished ProvTrace into the report block:
+        summarize + the exportable tree, with each tracked slot mapped
+        back to its (hostname, service id) identity."""
+        from sidecar_tpu.ops import provenance as prov_ops
+
+        spn = params.services_per_node
+        doc = prov_ops.summarize(prov, tracked, spn)
+        for rec in doc["records"]:
+            slot = rec["slot"]
+            rec["node"] = mapping.hostnames[slot // spn]
+            rec["service"] = mapping.slots[slot // spn][slot % spn]
+        doc["tree"] = prov_ops.tree_to_dict(prov, tracked)
+        return doc
 
     def _predict_damping(self, protocol, delta_stream,
                          mapping: BridgeMapping) -> dict:
@@ -505,7 +638,8 @@ class SimBridge:
               fanout: int = 3, budget: int = 15, seed: int = 0,
               conv_every: int = 1, stop: bool = True,
               base: Optional[dict] = None,
-              max_batch: Optional[int] = None) -> dict:
+              max_batch: Optional[int] = None,
+              provenance: int = 8) -> dict:
         """Evaluate a protocol-configuration grid in batched fleet
         dispatches (sidecar_tpu/fleet) and return the Pareto table.
 
@@ -519,9 +653,22 @@ class SimBridge:
         reports rounds/seconds-to-ε and the analytic exchange bytes
         spent getting there (early exit freezes both at the crossing);
         ``pareto_front`` lists the non-dominated configs on
-        (rounds_to_eps, exchange_bytes)."""
+        (rounds_to_eps, exchange_bytes).
+
+        ``provenance`` tracers (default 8, 0 disables) ride every
+        fleet dispatch (fleet/engine.py first_seen provenance,
+        docs/telemetry.md), adding a per-scenario ``p99_lag_rounds``
+        column to the table — the capacity-planning answer to "which
+        config meets the lag SLO", not just "which converges".
+
+        Each phase of the dispatch path records a span
+        (``bridge.sweep.expand`` → ``.build`` → ``.run`` →
+        ``.pareto``) into the /api/trace ring, and the request's grid
+        size lands in the ``bridge.sweep.points`` histogram."""
         from sidecar_tpu.fleet import FleetSim, expand_grid
         from sidecar_tpu.fleet.grid import pareto_front
+        from sidecar_tpu.ops import provenance as prov_ops
+        from sidecar_tpu.telemetry.span import span as _span
 
         if n is None:
             with self.state._lock:
@@ -548,32 +695,50 @@ class SimBridge:
                 "ScenarioBatch directly (sidecar_tpu/fleet, "
                 "docs/sweep.md); POST /sweep runs the plain exact "
                 "family")
-        specs = expand_grid(axes, base)
+        if provenance < 0:
+            raise ValueError(
+                f"provenance={provenance} must be >= 0 (tracer count; "
+                "0 disables the lag column)")
+        t_req = time.perf_counter()
+        with _span("bridge.sweep.expand"):
+            specs = expand_grid(axes, base)
         params = SimParams(n=int(n),
                            services_per_node=int(services_per_node),
                            fanout=int(fanout), budget=int(budget))
+        tracked = prov_ops.default_tracked(
+            params.m, int(provenance)) if provenance else ()
         # Cold-start study clock: refresh pinned out so rounds-to-ε
         # measures pure epidemic spread (the sim/scenarios convention).
         cfg = dataclasses.replace(self.t, refresh_interval_s=10_000.0)
+        # Grid size per request — the capacity signal for sizing
+        # max_batch and the fleet (docs/metrics.md: a count histogram,
+        # not a latency).
+        metrics.histogram("bridge.sweep.points", float(len(specs)))
 
-        t_req = time.perf_counter()
         table: list = [None] * len(specs)
         batches = 0
-        for batch, idxs in self._build_sweep_batches(
-                specs, params, cfg, max_batch):
+        with _span("bridge.sweep.build"):
+            built = list(self._build_sweep_batches(
+                specs, params, cfg, max_batch))
+        for batch, idxs in built:
             fleet = FleetSim(batch)
-            run = fleet.run(fleet.init_states(), rounds,
-                            conv_every=conv_every, eps=eps, stop=stop)
-            rows = run.table(cfg.round_ticks, cfg.ticks_per_second)
+            with _span("bridge.sweep.run"):
+                run = fleet.run(fleet.init_states(), rounds,
+                                conv_every=conv_every, eps=eps,
+                                stop=stop, tracked=tracked)
+                rows = run.table(cfg.round_ticks, cfg.ticks_per_second)
             for j, src_idx in enumerate(idxs):
                 rows[j]["config"] = batch.specs[j].axes()
                 table[src_idx] = rows[j]
             batches += 1
+        with _span("bridge.sweep.pareto"):
+            front = pareto_front(table)
         wall = time.perf_counter() - t_req
         metrics.histogram_since("bridge.sweep", t_req)
         return {
             "points": len(specs),
             "batches": batches,
+            "provenance": int(provenance),
             "n": int(n),
             "services_per_node": int(services_per_node),
             "rounds": rounds,
@@ -583,7 +748,7 @@ class SimBridge:
             "scenarios_per_sec": round(len(specs) / wall, 2)
             if wall > 0 else None,
             "table": table,
-            "pareto_front": pareto_front(table),
+            "pareto_front": front,
         }
 
     @staticmethod
@@ -640,6 +805,12 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
     "sparse": bool|null (null → SIDECAR_TPU_SPARSE / arbiter),
     "trace": N (flight-recorder records for the first N rounds —
     docs/telemetry.md),
+    "provenance": {"count": T} | {"services": [{"node": host,
+    "service": id}, ...]} with optional "cap" (record-level
+    propagation tracing — per-record lag CDFs, hop histograms, and
+    the propagation tree in the report's ``provenance`` block;
+    mutually exclusive with deltas_cap/trace/damping —
+    docs/telemetry.md),
     "protocol": {"suspicion_window_s": S, "damping_half_life_s": H,
     "damping_threshold": T, "future_fudge_s": F, ...} — the
     suspicion/flap-damping/clock-bound knob bundle
@@ -650,7 +821,9 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
     POST /sweep {"axes": {axis: [values...]}, "rounds": N, "eps": E,
     "n": nodes, "services_per_node": S, "fanout": F, "budget": B,
     "base": {fixed spec fields}, "conv_every": K, "stop": bool,
-    "seed": S} — the batched capacity-planning sweep
+    "seed": S, "provenance": T (lag tracers per scenario, default 8;
+    adds the per-scenario ``p99_lag_rounds`` column)} — the batched
+    capacity-planning sweep
     (sidecar_tpu/fleet, docs/sweep.md): the grid is expanded, chunked
     into vmapped fleet dispatches, and answered with a per-config
     Pareto table (rounds/seconds-to-ε, analytic exchange bytes,
@@ -683,7 +856,8 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                 sparse=(None if sparse_req is None
                         else bool(sparse_req)),
                 trace=int(req.get("trace", 0)),
-                protocol=req.get("protocol"))
+                protocol=req.get("protocol"),
+                provenance=req.get("provenance"))
             return report.to_json()
 
         def _do_sweep(self, req: dict) -> dict:
@@ -707,7 +881,8 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                 seed=int(req.get("seed", 0)),
                 conv_every=int(req.get("conv_every", 1)),
                 stop=bool(req.get("stop", True)),
-                base=base)
+                base=base,
+                provenance=int(req.get("provenance", 8)))
 
         def do_POST(self):
             route = self.path.split("?")[0]

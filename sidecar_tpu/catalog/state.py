@@ -29,6 +29,7 @@ from sidecar_tpu import metrics
 from sidecar_tpu import service as svc_mod
 from sidecar_tpu.output import time_ago
 from sidecar_tpu.telemetry.span import span as _span
+from sidecar_tpu.telemetry import propagation as _propagation
 from sidecar_tpu.runtime.looper import Looper, TimedLooper
 from sidecar_tpu.service import (
     ALIVE_LIFESPAN,
@@ -320,6 +321,7 @@ class ServicesState:
                 server.services[new_svc.id] = new_svc
                 self.service_changed(new_svc, UNKNOWN, new_svc.updated)
                 self.retransmit(new_svc)
+                self._observe_propagation(new_svc, now)
             elif new_svc.invalidates(server.services[new_svc.id]):
                 server.last_updated = new_svc.updated
                 old = server.services[new_svc.id]
@@ -331,6 +333,17 @@ class ServicesState:
                 if old.status != new_svc.status:
                     self.service_changed(new_svc, old.status, new_svc.updated)
                 self.retransmit(new_svc)
+                self._observe_propagation(new_svc, now)
+
+    def _observe_propagation(self, svc: Service, now: int) -> None:
+        """Admission-time propagation lag — the live twin of the sim's
+        record-level provenance plane (telemetry/propagation.py,
+        docs/telemetry.md): merge time minus the record's origin stamp,
+        accounted per origin host.  Own records show ~0 lag (they are
+        stamped on this node's clock moments before the writer drains
+        them), which keeps the per-origin table an honest baseline."""
+        _propagation.observe("catalog", svc.hostname,
+                             (now - svc.updated) / 1e6)
 
     def merge(self, other: "ServicesState") -> None:
         """Full-state anti-entropy merge (services_state.go:367-373)."""

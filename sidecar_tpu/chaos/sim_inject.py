@@ -564,6 +564,61 @@ class ChaosExactSim(ExactSim):
             injected_delays=delays, injected_dups=dups,
             rejected_future=rej)
 
+    # -- provenance hooks (ops/provenance.py) ------------------------------
+
+    def _prov_belief(self, cst: ChaosSimState,
+                     tracked: jax.Array) -> jax.Array:
+        return cst.sim.known[:, tracked]
+
+    def _prov_channels(self, cst: ChaosSimState, key: jax.Array,
+                       kn=None):
+        """The chaos round's OPEN channels: gossip pushes surviving the
+        plan's edge drops minus the delayed edges (a delayed packet is
+        not delivered this round — its eventual ring maturity arrives
+        with no live channel and surfaces as ``PARENT_UNATTRIBUTED``),
+        plus the push-pull edge where the plan hasn't severed it.
+        Node-fault windows gate sampling exactly as the step does
+        (faulted senders self-remap); the perturb hook is NOT re-run —
+        the chaos contract forbids it from touching ``node_alive``."""
+        p, prog = self.p, self._prog
+        kn = self._knobs if kn is None else kn
+        state = cst.sim
+        round_idx = state.round_idx + 1
+        _k_perturb, k_peers, _k_drop, k_pp = jax.random.split(key, 4)
+
+        down = prog.down_mask(round_idx)
+        alive = state.node_alive if down is None else \
+            state.node_alive & ~down
+
+        dst = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
+            node_alive=alive, cut_mask=self._cut)
+        keep, diverts = prog.edge_masks(dst, round_idx,
+                                        fault_seed=kn.fault_seed)
+        delay_any = None
+        for _, delay_sel, _dup_sel in diverts:
+            if delay_sel is not None:
+                delay_any = delay_sel if delay_any is None else \
+                    delay_any | delay_sel
+        push_mask = keep
+        if delay_any is not None:
+            push_mask = ~delay_any if push_mask is None else \
+                push_mask & ~delay_any
+
+        pp_partner = gossip_ops.sample_peers(
+            k_pp, p.n, 1, nbrs=self._nbrs, deg=self._deg,
+            node_alive=alive, cut_mask=self._cut)[:, 0]
+        sever = prog.pp_severed(pp_partner, round_idx)
+        if sever is not None:
+            pp_partner = jnp.where(
+                sever, jnp.arange(p.n, dtype=jnp.int32), pp_partner)
+        partner = pp_partner[:, None]
+        pp_on = jnp.broadcast_to(round_idx % kn.push_pull_rounds == 0,
+                                 (p.n, 1))
+        pushes = [(dst, push_mask), (partner, pp_on)]
+        pulls = [(partner, pp_on)]
+        return pushes, pulls
+
     # -- metric + drivers --------------------------------------------------
 
     def convergence(self, cst: ChaosSimState) -> jax.Array:
@@ -640,3 +695,13 @@ class ChaosExactSim(ExactSim):
             start_round=start_round, sparse=sparse)
         self._publish_injection_metrics(before, final)
         return final, tr, conv
+
+    def run_with_provenance(self, state, key, num_rounds: int, tracked,
+                            cap: int = 0, prov=None, donate: bool = True,
+                            start_round=None, sparse=None):
+        before = self._counter_snapshot(state)
+        final, pv, conv = super().run_with_provenance(
+            state, key, num_rounds, tracked, cap=cap, prov=prov,
+            donate=donate, start_round=start_round, sparse=sparse)
+        self._publish_injection_metrics(before, final)
+        return final, pv, conv

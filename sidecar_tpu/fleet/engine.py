@@ -43,6 +43,7 @@ from sidecar_tpu import metrics
 from sidecar_tpu.fleet.batch import ScenarioBatch, restart_churn_perturb
 from sidecar_tpu.models.exact import clone_state
 from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.kernels import eligible_lines
 from sidecar_tpu.ops.topology import Topology, complete
@@ -61,13 +62,22 @@ class FleetStats:
     eps_round: jax.Array     # int32 [S] — first round conv >= 1-eps (-1)
     exchange_bytes: jax.Array  # float32 [S] — analytic offer bytes
     frontier_max: jax.Array  # int32 [S] — sender-frontier high water
+    # Record-level provenance (ops/provenance.py), fleet-shaped: the
+    # sweep only needs lag CDFs, so the fleet carries first_seen (the
+    # exact part of the trace) and skips parent attribution — channel
+    # replay under vmap would re-derive S × per-family streams for a
+    # column no sweep consumer reads.
+    prov_ref: jax.Array      # int32 [S, T] traced packed-key threshold
+    first_seen: jax.Array    # int32 [S, T, N] absolute round; -1
 
 
-def _zero_stats(s: int) -> FleetStats:
+def _zero_stats(s: int, t: int, n: int) -> FleetStats:
     return FleetStats(rounds=jnp.zeros((s,), jnp.int32),
                       eps_round=jnp.full((s,), -1, jnp.int32),
                       exchange_bytes=jnp.zeros((s,), jnp.float32),
-                      frontier_max=jnp.zeros((s,), jnp.int32))
+                      frontier_max=jnp.zeros((s,), jnp.int32),
+                      prov_ref=jnp.zeros((s, t), jnp.int32),
+                      first_seen=jnp.full((s, t, n), -1, jnp.int32))
 
 
 def _select_scen(live, new_tree, old_tree):
@@ -92,12 +102,23 @@ class FleetRun:
     wall_seconds: float
     scenarios_per_sec: float
     final_states: object = None   # stacked states (oracle / chaining)
+    tracked: tuple = ()           # traced slots (ops/provenance.py)
+    first_seen: np.ndarray = None  # [S, T, N] absolute rounds; -1
+
+    def lag_summary(self, i: int):
+        """Scenario ``i``'s pooled per-record lag CDF, or None when the
+        run traced nothing."""
+        if not self.tracked:
+            return None
+        from sidecar_tpu.ops import provenance as prov_ops
+        return prov_ops.pooled_lag(self.first_seen[i])
 
     def table(self, round_ticks: int, ticks_per_second: int) -> list:
         """Per-scenario rows for the /sweep Pareto table."""
         out = []
         for i, name in enumerate(self.names):
             er = self.eps_round[i]
+            lag = self.lag_summary(i)
             out.append({
                 "name": name,
                 "rounds_to_eps": er,
@@ -108,6 +129,7 @@ class FleetRun:
                 "rounds_run": int(self.rounds[i]),
                 "final_convergence": float(self.convergence[-1, i])
                 if len(self.convergence) else None,
+                "p99_lag_rounds": None if lag is None else lag["p99"],
             })
         return out
 
@@ -257,7 +279,7 @@ class FleetSim:
     # check_jit_entrypoints donate-or-waiver contract extends to the
     # fleet plane — tests/test_jit_entrypoints.py pins both are seen).
 
-    def _scan_body(self, keys, knobs, conv_every, eps, stop):
+    def _scan_body(self, keys, knobs, conv_every, eps, stop, tracked):
         """The shared round body: ``conv_every`` vmapped rounds under
         the batch-level skip cond, then one convergence sample with
         crossing detection."""
@@ -265,6 +287,8 @@ class FleetSim:
         conv_v = jax.vmap(self.sim.convergence)
         census_v = jax.vmap(self._offer_census)
         fold_v = jax.vmap(jax.random.fold_in)
+        tr = jnp.asarray(tracked, jnp.int32)
+        belief_v = jax.vmap(lambda st: self.sim._prov_belief(st, tr))
 
         def inner(carry, _):
             states, live, fs = carry
@@ -275,6 +299,16 @@ class FleetSim:
                 keys_r = fold_v(keys, states.round_idx)
                 nxt = step_v(states, keys_r, knobs)
                 states = _select_scen(live, nxt, states)
+                first_seen = fs.first_seen
+                if tracked:
+                    # Frozen scenarios kept their old state above, so
+                    # they produce no new holders — no live gate needed.
+                    hold = prov_ops.holders_batch(
+                        fs.prov_ref, belief_v(states))     # [S, N, T]
+                    newly = jnp.swapaxes(hold, 1, 2) & (first_seen < 0)
+                    first_seen = jnp.where(
+                        newly, states.round_idx[:, None, None],
+                        first_seen)
                 live_i = live.astype(jnp.int32)
                 fs = FleetStats(
                     rounds=fs.rounds + live_i,
@@ -282,7 +316,9 @@ class FleetSim:
                     exchange_bytes=fs.exchange_bytes
                     + jnp.where(live, xbytes.astype(jnp.float32), 0.0),
                     frontier_max=jnp.maximum(
-                        fs.frontier_max, jnp.where(live, frontier, 0)))
+                        fs.frontier_max, jnp.where(live, frontier, 0)),
+                    prov_ref=fs.prov_ref,
+                    first_seen=first_seen)
                 return states, live, fs
 
             # The whole-batch skip: once every scenario crossed, the
@@ -306,25 +342,47 @@ class FleetSim:
 
         return body
 
+    def _seed_stats(self, states, tracked) -> FleetStats:
+        """Zero stats, with the provenance plane seeded: per scenario,
+        pin the traced refs to the freshest current keys and mark the
+        origin holders (ops/provenance.seed, fleet-shaped)."""
+        fs = _zero_stats(self.batch.size, len(tracked),
+                         self.batch.params.n)
+        if not tracked:
+            return fs
+        tr = jnp.asarray(tracked, jnp.int32)
+        belief0 = jax.vmap(
+            lambda st: self.sim._prov_belief(st, tr))(states)
+        ref = jnp.max(belief0, axis=1).astype(jnp.int32)    # [S, T]
+        hold0 = prov_ops.holders_batch(ref, belief0)
+        return dataclasses.replace(
+            fs, prov_ref=ref,
+            first_seen=jnp.where(jnp.swapaxes(hold0, 1, 2),
+                                 states.round_idx[:, None, None],
+                                 fs.first_seen))
+
     @functools.partial(jax.jit,
-                       static_argnums=(0, 4, 5, 6, 7),
+                       static_argnums=(0, 4, 5, 6, 7, 8),
                        donate_argnums=1)
     def _run_conv_fleet_jit(self, states, keys, knobs, num_rounds,
-                            conv_every, eps, stop):
-        body = self._scan_body(keys, knobs, conv_every, eps, stop)
+                            conv_every, eps, stop, tracked):
+        body = self._scan_body(keys, knobs, conv_every, eps, stop,
+                               tracked)
         s = self.batch.size
         (final, live, fs), conv = lax.scan(
-            body, (states, jnp.ones((s,), bool), _zero_stats(s)), None,
+            body, (states, jnp.ones((s,), bool),
+                   self._seed_stats(states, tracked)), None,
             length=num_rounds // conv_every)
         return final, conv, fs
 
     @functools.partial(jax.jit,
-                       static_argnums=(0, 4, 5, 6, 7),
+                       static_argnums=(0, 4, 5, 6, 7, 8),
                        donate_argnums=1)
     def _run_fast_fleet_jit(self, states, keys, knobs, num_rounds,
-                            conv_every, eps, stop):
+                            conv_every, eps, stop, tracked):
         # The bench path: same body, curve discarded on device.
-        body = self._scan_body(keys, knobs, conv_every, eps, stop)
+        body = self._scan_body(keys, knobs, conv_every, eps, stop,
+                               tracked)
         s = self.batch.size
 
         def drop_curve(carry, _):
@@ -332,7 +390,8 @@ class FleetSim:
             return carry, None
 
         (final, live, fs), _ = lax.scan(
-            drop_curve, (states, jnp.ones((s,), bool), _zero_stats(s)),
+            drop_curve, (states, jnp.ones((s,), bool),
+                         self._seed_stats(states, tracked)),
             None, length=num_rounds // conv_every)
         return final, fs
 
@@ -340,15 +399,25 @@ class FleetSim:
 
     def run(self, states, num_rounds: int, conv_every: int = 1,
             eps: float = 0.01, stop: bool = False, donate: bool = True,
-            curve: bool = True) -> FleetRun:
+            curve: bool = True, tracked=None) -> FleetRun:
         """Run every scenario ``num_rounds`` rounds (fewer where the
         converged-mask freezes them, ``stop=True``), sampling the
         per-scenario convergence metric every ``conv_every`` rounds.
 
         ``stop=False`` (the lockstep contract) runs the full horizon —
         bit-identical per scenario to unbatched runs; ``eps`` still
-        only sets where ``eps_round`` is recorded."""
+        only sets where ``eps_round`` is recorded.
+
+        ``tracked`` (static tuple of service slots) turns on the
+        record-level provenance plane: per-scenario ``first_seen``
+        rides the carry and the run's table gains the pooled
+        ``p99_lag_rounds`` column (ops/provenance.py)."""
         b = self.batch
+        tracked = tuple(int(x) for x in tracked) if tracked else ()
+        for slot in tracked:
+            if not 0 <= slot < b.params.m:
+                raise ValueError(
+                    f"tracked slot {slot} outside [0, {b.params.m})")
         if num_rounds % conv_every:
             raise ValueError(
                 f"num_rounds={num_rounds} not divisible by "
@@ -362,11 +431,11 @@ class FleetSim:
         if curve:
             final, conv, fs = self._run_conv_fleet_jit(
                 states, b.keys, b.knobs, num_rounds, conv_every,
-                float(eps), bool(stop))
+                float(eps), bool(stop), tracked)
         else:
             final, fs = self._run_fast_fleet_jit(
                 states, b.keys, b.knobs, num_rounds, conv_every,
-                float(eps), bool(stop))
+                float(eps), bool(stop), tracked)
             conv = jnp.zeros((0, b.size), jnp.float32)
         jax.block_until_ready(fs.rounds)
         wall = time.perf_counter() - t0
@@ -402,4 +471,6 @@ class FleetSim:
             wall_seconds=wall,
             scenarios_per_sec=b.size / wall if wall > 0 else 0.0,
             final_states=final,
+            tracked=tracked,
+            first_seen=np.asarray(jax.device_get(fs.first_seen)),
         )

@@ -119,6 +119,7 @@ from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import knobs as knob_ops
+from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
@@ -1322,6 +1323,74 @@ class CompressedSim:
                         jnp.int32(0))
         return lax.switch(idx, (exact, fast, fast_list), state)
 
+    # -- provenance hooks (ops/provenance.py, docs/telemetry.md) -------------
+
+    def _prov_belief(self, state: CompressedState,
+                     tracked: jax.Array) -> jax.Array:
+        """Packed [N, T] belief matrix for the tracked slots — the
+        column-wise restriction of ops/delta.compressed_belief:
+        ``max(floor, cache hit, own row)``.  The version threshold in
+        the ProvTrace (``ref``) is what makes this meaningful — the
+        floor holds a stale copy of every converged slot."""
+        p = self.p
+        s = p.services_per_node
+        lines = hash_line(tracked, p.cache_lines, s)
+        hit = state.cache_slot[:, lines] == tracked[None, :]
+        cached = jnp.where(hit, state.cache_val[:, lines], 0)
+        owner = tracked // s
+        col = tracked - owner * s
+        own_b = jnp.where(
+            owner[None, :] == jnp.arange(p.n, dtype=jnp.int32)[:, None],
+            state.own[:, col], 0)
+        return jnp.maximum(
+            jnp.maximum(state.floor[tracked][None, :], cached), own_b)
+
+    def _prov_sample_src(self, k_peers, node_alive):
+        """The round's pull sources — overridden by the sharded twin,
+        which replays its per-shard PRNG streams at the jit level."""
+        p = self.p
+        return gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut)
+
+    def _prov_channels(self, state: CompressedState, key: jax.Array,
+                       kn=None):
+        """Re-derive the round's sampled channels from ``key``: the
+        board pulls ``src`` plus (on cadence) the stride push-pull's two
+        legs.  All compressed exchanges are pull-shaped; the floor fold
+        is not a peer channel, so floor-advance infections surface as
+        ``PARENT_UNATTRIBUTED``."""
+        p = self.p
+        kn = self._knobs if kn is None else kn
+        round_idx = state.round_idx + 1
+        now = round_idx * self.t.round_ticks
+        k_perturb, k_peers, _k_drop, k_pp = jax.random.split(key, 4)
+
+        if self.perturb is not None:
+            if getattr(self.perturb, "wants_knobs", False):
+                state = self.perturb(state, k_perturb, now, kn)
+            else:
+                state = self.perturb(state, k_perturb, now)
+        alive = state.node_alive
+
+        src = self._prov_sample_src(k_peers, alive)
+        pulls = [(src, None)]
+
+        # The stride exchange (_push_pull_stride): node i merges the
+        # cache+own rows of BOTH the node stride ahead and the node
+        # stride behind — two pull legs with the same liveness/side
+        # gating as the roll-based exchange.
+        stride = jax.random.randint(k_pp, (), 1, p.n, dtype=jnp.int32)
+        idx = jnp.arange(p.n, dtype=jnp.int32)
+        pp_on = round_idx % kn.push_pull_rounds == 0
+        for roll_amt, partner in ((-stride, (idx + stride) % p.n),
+                                  (stride, (idx - stride) % p.n)):
+            ok = alive & jnp.roll(alive, roll_amt)
+            if self._side is not None:
+                ok = ok & (self._side == jnp.roll(self._side, roll_amt))
+            pulls.append((partner[:, None], (ok & pp_on)[:, None]))
+        return [], pulls
+
     # -- drivers ------------------------------------------------------------
     # Donation: the _run*_jit entry points donate the input state so the
     # cache/floor tensors are rewritten in place across chunked
@@ -1463,6 +1532,39 @@ class CompressedSim:
         self.last_sparse_stats = None
         return self._run_deltas_jit(state, key, num_rounds, cap)
 
+    def run_with_provenance(self, state, key, num_rounds: int, tracked,
+                            cap: int = 0, prov=None, donate: bool = True,
+                            start_round=None, sparse=None):
+        """Scan with the record-level provenance tracer
+        (ops/provenance.py): returns ``(final state, ProvTrace)`` —
+        the compressed drivers' no-conv arity, like
+        :meth:`run_with_trace`.  Chunked callers pass the previous
+        chunk's ``ProvTrace`` as ``prov``."""
+        tracked = tuple(int(s) for s in tracked)
+        if not tracked:
+            raise ValueError("provenance needs at least one tracked slot")
+        for slot in tracked:
+            if not 0 <= slot < self.p.m:
+                raise ValueError(
+                    f"tracked slot {slot} outside [0, {self.p.m})")
+        cap = cap or num_rounds
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if prov is None:
+            prov = prov_ops.zero_prov(len(tracked), self.p.n, cap)
+            prov = prov_ops.seed(
+                prov,
+                self._prov_belief(state, jnp.asarray(tracked, jnp.int32)),
+                state.round_idx)
+        if self._resolve_sparse_request(sparse):
+            final, prov, stats = self._run_prov_sparse_jit(
+                state, key, num_rounds, prov, tracked)
+            self.last_sparse_stats = stats
+            return final, prov
+        self.last_sparse_stats = None
+        return self._run_prov_jit(state, key, num_rounds, prov, tracked)
+
     # no-donate: single-round stepping is the oracle/replay path — those
     # callers diff pre- vs post-step states, so the input must survive.
     @functools.partial(jax.jit, static_argnums=0)
@@ -1538,6 +1640,29 @@ class CompressedSim:
             body, (state, trace_ops.zero_trace(cap)), None,
             length=num_rounds)
         return final, buf
+
+    # Donates the ProvTrace too (argnum 4): it chains chunk-to-chunk the
+    # way the state does.
+    @functools.partial(jax.jit, static_argnums=(0, 3, 5),
+                       donate_argnums=(1, 4))
+    def _run_prov_jit(self, state, key, num_rounds, prov, tracked):
+        tr = jnp.asarray(tracked, jnp.int32)
+
+        def body(carry, _):
+            st, pv = carry
+            k = jax.random.fold_in(key, st.round_idx)
+            st2 = self._step(st, k)
+            pushes, pulls = self._prov_channels(st, k)
+            pv = prov_ops.observe(
+                pv,
+                prov_ops.holders(pv, self._prov_belief(st, tr)),
+                prov_ops.holders(pv, self._prov_belief(st2, tr)),
+                st2.round_idx, pushes, pulls)
+            return (st2, pv), None
+
+        (final, prov), _ = lax.scan(body, (state, prov), None,
+                                    length=num_rounds)
+        return final, prov
 
     # -- sparse-path scan drivers (docs/sparse.md) ---------------------------
     # Mirrors of the dense drivers above: same donation, same per-round
@@ -1629,6 +1754,32 @@ class CompressedSim:
             body, (state, trace_ops.zero_trace(cap),
                    sparse_ops.zero_stats()), None, length=num_rounds)
         return final, buf, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 5),
+                       donate_argnums=(1, 4))
+    def _run_prov_sparse_jit(self, state, key, num_rounds, prov,
+                             tracked):
+        # The sparse round consumes the same peer/push-pull draws as the
+        # dense one (docs/sparse.md bit-identity), so the channel
+        # re-derivation is shared.
+        tr = jnp.asarray(tracked, jnp.int32)
+
+        def body(carry, _):
+            st, pv, acc = carry
+            k = jax.random.fold_in(key, st.round_idx)
+            st2, s = self._step_sparse(st, k)
+            pushes, pulls = self._prov_channels(st, k)
+            pv = prov_ops.observe(
+                pv,
+                prov_ops.holders(pv, self._prov_belief(st, tr)),
+                prov_ops.holders(pv, self._prov_belief(st2, tr)),
+                st2.round_idx, pushes, pulls)
+            return (st2, pv, sparse_ops.accumulate_stats(acc, s)), None
+
+        (final, prov, stats), _ = lax.scan(
+            body, (state, prov, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, prov, stats
 
 
 # -- host-path kernels ------------------------------------------------------

@@ -52,6 +52,7 @@ from jax import lax
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import knobs as knob_ops
+from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
@@ -478,6 +479,54 @@ class ExactSim:
         per_node = jnp.mean(agree.astype(jnp.float32), axis=1)
         return jnp.sum(per_node * alive_f) / jnp.maximum(jnp.sum(alive_f), 1.0)
 
+    # -- provenance hooks (ops/provenance.py, docs/telemetry.md) -----------
+    # The provenance plane rides BESIDE the round: belief is a pure read
+    # of the state, and channels re-derives the round's peer samples from
+    # the very key the step consumed (sample_peers is pure) — the step's
+    # own tensors are never touched, which keeps provenance-enabled runs
+    # bit-identical to untraced ones.
+
+    def _prov_belief(self, state: SimState,
+                     tracked: jax.Array) -> jax.Array:
+        """Packed [N, T] belief matrix for the tracked slots."""
+        return state.known[:, tracked]
+
+    def _prov_channels(self, state: SimState, key: jax.Array, kn=None):
+        """Re-derive the round's sampled channels from ``key`` (the same
+        key the step folds): gossip pushes ``dst``, plus the two-way
+        push-pull edge when the cadence fires.  The perturb hook is
+        re-applied first (pure; same key ⇒ same result) because the step
+        samples peers with the POST-perturb liveness."""
+        p = self.p
+        kn = self._knobs if kn is None else kn
+        round_idx = state.round_idx + 1
+        now = round_idx * self.t.round_ticks
+        k_perturb, k_peers, _k_drop, k_pp = jax.random.split(key, 4)
+
+        if self.perturb is not None:
+            if getattr(self.perturb, "wants_knobs", False):
+                state = self.perturb(state, k_perturb, now, kn)
+            else:
+                state = self.perturb(state, k_perturb, now)
+        node_alive = state.node_alive
+
+        dst = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+        )
+        pp_partner = gossip_ops.sample_peers(
+            k_pp, p.n, 1,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+        )
+        pp_on = jnp.broadcast_to(round_idx % kn.push_pull_rounds == 0,
+                                 (p.n, 1))
+        # push-pull is two-way: i pulls from its partner AND pushes to it.
+        pushes = [(dst, None), (pp_partner, pp_on)]
+        pulls = [(pp_partner, pp_on)]
+        return pushes, pulls
+
     # -- drivers -----------------------------------------------------------
     # Public drivers validate the tick horizon against the *starting*
     # round_idx (state is concrete between calls) before dispatching to the
@@ -601,6 +650,42 @@ class ExactSim:
         self.last_sparse_stats = None
         return self._run_deltas_jit(state, key, num_rounds, cap)
 
+    def run_with_provenance(self, state: SimState, key: jax.Array,
+                            num_rounds: int, tracked, cap: int = 0,
+                            prov=None, donate: bool = True,
+                            start_round=None, sparse=None):
+        """Scan with the record-level provenance tracer
+        (ops/provenance.py, docs/telemetry.md): returns ``(final state,
+        ProvTrace, conv[num_rounds])``.  ``tracked`` is a static tuple
+        of ≤T service slots; ``cap`` bounds the per-round coverage
+        window (0 = ``num_rounds``).  Pass the previous chunk's
+        ``ProvTrace`` as ``prov`` to pipeline chunked dispatches — the
+        trace carries absolute round numbers, so chunking is free."""
+        tracked = tuple(int(s) for s in tracked)
+        if not tracked:
+            raise ValueError("provenance needs at least one tracked slot")
+        for slot in tracked:
+            if not 0 <= slot < self.p.m:
+                raise ValueError(
+                    f"tracked slot {slot} outside [0, {self.p.m})")
+        cap = cap or num_rounds
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if prov is None:
+            prov = prov_ops.zero_prov(len(tracked), self.p.n, cap)
+            prov = prov_ops.seed(
+                prov,
+                self._prov_belief(state, jnp.asarray(tracked, jnp.int32)),
+                state.round_idx)
+        if self._resolve_sparse_request(sparse):
+            final, prov, conv, stats = self._run_prov_sparse_jit(
+                state, key, num_rounds, prov, tracked)
+            self.last_sparse_stats = stats
+            return final, prov, conv
+        self.last_sparse_stats = None
+        return self._run_prov_jit(state, key, num_rounds, prov, tracked)
+
     # no-donate: single-round stepping is the oracle/replay path — those
     # callers diff pre- vs post-step states, so the input must survive.
     @functools.partial(jax.jit, static_argnums=0)
@@ -664,6 +749,30 @@ class ExactSim:
             body, (state, trace_ops.zero_trace(cap)), None,
             length=num_rounds)
         return final, buf, conv
+
+    # Donates the ProvTrace too (argnum 4): it chains chunk-to-chunk the
+    # way the state does.
+    @functools.partial(jax.jit, static_argnums=(0, 3, 5),
+                       donate_argnums=(1, 4))
+    def _run_prov_jit(self, state: SimState, key: jax.Array,
+                      num_rounds: int, prov, tracked):
+        tr = jnp.asarray(tracked, jnp.int32)
+
+        def body(carry, _):
+            st, pv = carry
+            k = jax.random.fold_in(key, st.round_idx)
+            st2 = self._step(st, k)
+            pushes, pulls = self._prov_channels(st, k)
+            pv = prov_ops.observe(
+                pv,
+                prov_ops.holders(pv, self._prov_belief(st, tr)),
+                prov_ops.holders(pv, self._prov_belief(st2, tr)),
+                st2.round_idx, pushes, pulls)
+            return (st2, pv), self.convergence(st2)
+
+        (final, prov), conv = lax.scan(body, (state, prov), None,
+                                       length=num_rounds)
+        return final, prov, conv
 
     # -- sparse-path scan drivers (docs/sparse.md) ---------------------------
     # Mirrors of the dense drivers: same donation, same per-round key
@@ -736,3 +845,30 @@ class ExactSim:
             body, (state, trace_ops.zero_trace(cap),
                    sparse_ops.zero_stats()), None, length=num_rounds)
         return final, buf, conv, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 5),
+                       donate_argnums=(1, 4))
+    def _run_prov_sparse_jit(self, state: SimState, key: jax.Array,
+                             num_rounds: int, prov, tracked):
+        # The sparse round consumes the same peer/push-pull draws as the
+        # dense one (docs/sparse.md bit-identity), so the channel
+        # re-derivation is shared.
+        tr = jnp.asarray(tracked, jnp.int32)
+
+        def body(carry, _):
+            st, pv, acc = carry
+            k = jax.random.fold_in(key, st.round_idx)
+            st2, s = self._step_sparse(st, k)
+            pushes, pulls = self._prov_channels(st, k)
+            pv = prov_ops.observe(
+                pv,
+                prov_ops.holders(pv, self._prov_belief(st, tr)),
+                prov_ops.holders(pv, self._prov_belief(st2, tr)),
+                st2.round_idx, pushes, pulls)
+            return (st2, pv, sparse_ops.accumulate_stats(acc, s)), \
+                self.convergence(st2)
+
+        (final, prov, stats), conv = lax.scan(
+            body, (state, prov, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, prov, conv, stats

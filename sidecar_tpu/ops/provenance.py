@@ -1,0 +1,324 @@
+"""Record-level propagation provenance (docs/telemetry.md).
+
+The flight recorder (ops/trace.py) answers "how is the CLUSTER doing
+this round"; this module answers "where is THIS record, how did it get
+there, and how long did the tail wait".  A run picks ≤T tracer slots
+(service records; the owner is ``slot // services_per_node``) and a
+:class:`ProvTrace` rides the scan carry behind a static cap — the
+RoundTrace/DeltaBatch contract: fixed shapes, an exact ``count``, and
+an ``overflow`` flag instead of silent truncation.
+
+Per tracked record the trace holds, per node:
+
+* ``first_seen`` — the absolute round the node first held the record
+  (−1 = never reached);
+* ``parent`` — the infection parent: the peer whose sampled channel
+  first plausibly delivered it (``PARENT_ORIGIN`` for seeded/minted
+  copies, ``PARENT_UNATTRIBUTED`` when no sampled channel from a prior
+  holder reached the node that round — e.g. a chaos delay-ring
+  arrival, or the compressed model's floor fold);
+* ``hops`` — infection-tree depth (0 at the origin and at
+  unattributed arrivals, which restart the count conservatively);
+
+plus a per-round ``coverage`` row (holder count per record).
+
+Attribution rule (shared with the pure-NumPy oracle,
+sim/oracle.ProvenanceOracle): a node newly holding a record is
+attributed to the candidate holder with the minimal ``(hops, node id)``
+among every peer channel sampled that round whose sender already held
+the record.  The rule is deterministic and channel-exact — the channels
+are re-derived from the very PRNG keys the step consumed — but it does
+not re-derive per-message budget/loss gates: when several sampled
+channels could have delivered, the minimal-(hops, id) one is charged.
+Infection DETECTION is exact either way (a state diff), so
+``first_seen`` — and every lag statistic — is exact; only the parent
+choice among same-round multi-path deliveries is canonicalized.
+
+The update is O(T·N·F) elementwise work plus one scatter-min — it
+never touches the round's own tensors, which is what keeps
+provenance-enabled runs bit-identical to untraced ones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ``parent`` sentinels (host-side consumers: the bridge, bench).
+PARENT_ORIGIN = -1
+PARENT_UNATTRIBUTED = -2
+
+_INF = jnp.iinfo(jnp.int32).max
+
+# The smallest packed key with a real tick: pack(tick=1, status=0) =
+# 1 << 3.  A ``ref`` below it (an empty slot at seed time) degrades the
+# holder test to plain is_known — "the first version to appear".
+_MIN_KNOWN = 8
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class ProvTrace:
+    """The carried provenance stream — static shapes, exact count.
+
+    ``ref`` pins the traced VERSION: the globally-freshest packed key
+    each tracer had when observation started (:func:`seed`).  A node
+    "holds" the record once its belief reaches ``ref`` — without this,
+    any stale copy (the compressed model's floor, a warm bridge
+    snapshot) would count as already-infected and every lag would read
+    zero.  LWW beliefs are monotone, so holding is monotone too."""
+
+    ref: jax.Array         # int32 [T] traced packed-key threshold
+    first_seen: jax.Array  # int32 [T, N] absolute round; -1 unreached
+    parent: jax.Array      # int32 [T, N] infector node id / sentinel
+    hops: jax.Array        # int32 [T, N] tree depth; -1 unreached
+    coverage: jax.Array    # int32 [cap, T] holder count per observed round
+    count: jax.Array       # int32 — rounds observed
+    overflow: jax.Array    # bool — more rounds than coverage capacity
+
+
+def zero_prov(tracked: int, n: int, cap: int) -> ProvTrace:
+    """An empty trace for ``tracked`` records over ``n`` nodes with a
+    ``cap``-round coverage window."""
+    return ProvTrace(
+        ref=jnp.zeros((tracked,), jnp.int32),
+        first_seen=jnp.full((tracked, n), -1, jnp.int32),
+        parent=jnp.full((tracked, n), PARENT_ORIGIN, jnp.int32),
+        hops=jnp.full((tracked, n), -1, jnp.int32),
+        coverage=jnp.zeros((cap, tracked), jnp.int32),
+        count=jnp.zeros((), jnp.int32),
+        overflow=jnp.zeros((), bool),
+    )
+
+
+def holders(prov: ProvTrace, belief: jax.Array) -> jax.Array:
+    """Bool [N, T] holder matrix: which nodes' beliefs (packed [N, T])
+    have reached the traced version."""
+    return belief >= jnp.maximum(prov.ref, _MIN_KNOWN)[None, :]
+
+
+def holders_batch(ref: jax.Array, belief: jax.Array) -> jax.Array:
+    """Holder test against explicit refs — the fleet engine's batched
+    twin of :func:`holders`: ``belief`` [..., N, T] vs ``ref``
+    [..., T] → bool [..., N, T]."""
+    return belief >= jnp.maximum(ref[..., None, :], _MIN_KNOWN)
+
+
+def seed(prov: ProvTrace, belief: jax.Array, round_idx) -> ProvTrace:
+    """Pin ``ref`` to the freshest current key per tracer and mark the
+    nodes already holding it as origin copies: ``first_seen =
+    round_idx``, hop 0, ``PARENT_ORIGIN``.  ``belief`` is the packed
+    [N, T] belief matrix of the starting state."""
+    prov = dataclasses.replace(
+        prov, ref=jnp.max(belief, axis=0).astype(jnp.int32))
+    hit = holders(prov, belief).T & (prov.first_seen < 0)
+    round_idx = jnp.asarray(round_idx, jnp.int32)
+    return dataclasses.replace(
+        prov,
+        first_seen=jnp.where(hit, round_idx, prov.first_seen),
+        parent=jnp.where(hit, PARENT_ORIGIN, prov.parent),
+        hops=jnp.where(hit, 0, prov.hops),
+    )
+
+
+def observe(prov: ProvTrace, prev_cols: jax.Array, nxt_cols: jax.Array,
+            round_idx, pushes=(), pulls=()) -> ProvTrace:
+    """Fold one round into the trace.
+
+    ``prev_cols``/``nxt_cols``: bool [N, T] holder matrices before and
+    after the step.  ``pushes``: list of ``(dst, mask)`` — sender ``s``
+    offered to ``dst[s, k]`` where ``mask`` (broadcastable to ``dst``'s
+    shape, or None) holds.  ``pulls``: list of ``(src, mask)`` —
+    receiver ``i`` read from ``src[i, k]``.  Masks gate the channel,
+    not the infection: a node that newly holds a record with no open
+    candidate channel is recorded ``PARENT_UNATTRIBUTED``.
+    """
+    t_n, n = prov.first_seen.shape
+    ids = jnp.arange(n, dtype=jnp.int32)
+    # Candidate score: lexicographic (hops, node id) packed into one
+    # int32 — valid while hops * n + n < 2^31 (hops is bounded by the
+    # round horizon, so even n = 10^6 leaves >2000 hops of headroom).
+    hops_c = jnp.maximum(prov.hops, 0)
+    score = jnp.where(prev_cols.T, hops_c * n + ids[None, :], _INF)
+
+    best = jnp.full((t_n, n + 1), _INF, jnp.int32)
+    for idx, mask in pushes:
+        contrib = jnp.broadcast_to(score[:, :, None],
+                                   (t_n,) + idx.shape)
+        if mask is not None:
+            m = jnp.broadcast_to(mask, idx.shape)
+            contrib = jnp.where(m[None], contrib, _INF)
+        best = best.at[:, idx.reshape(-1)].min(
+            contrib.reshape(t_n, -1), mode="drop")
+    for idx, mask in pulls:
+        cand = score[:, idx]                       # [T, N, K]
+        if mask is not None:
+            m = jnp.broadcast_to(mask, idx.shape)
+            cand = jnp.where(m[None], cand, _INF)
+        best = best.at[:, :n].min(jnp.min(cand, axis=2))
+
+    bn = best[:, :n]
+    attributed = bn != _INF
+    parent_new = jnp.where(attributed, bn % n, PARENT_UNATTRIBUTED)
+    hops_new = jnp.where(attributed, bn // n + 1, 0)
+
+    newly = nxt_cols.T & (prov.first_seen < 0)
+    round_idx = jnp.asarray(round_idx, jnp.int32)
+    cov = jnp.sum(nxt_cols.astype(jnp.int32), axis=0)
+    cap = prov.coverage.shape[0]
+    coverage = prov.coverage.at[prov.count].set(cov, mode="drop")
+    count = prov.count + 1
+    return ProvTrace(
+        ref=prov.ref,
+        first_seen=jnp.where(newly, round_idx, prov.first_seen),
+        parent=jnp.where(newly, parent_new, prov.parent),
+        hops=jnp.where(newly, hops_new, prov.hops),
+        coverage=coverage,
+        count=count,
+        overflow=prov.overflow | (count > cap),
+    )
+
+
+# -- tracer-key selection ---------------------------------------------------
+
+def default_tracked(m: int, count: int) -> tuple:
+    """``count`` tracer slots spread evenly over the slot space (so the
+    tracers cover distinct owners on the owner-run layout)."""
+    if m < 1 or count < 1:
+        return ()
+    count = min(count, m)
+    return tuple(sorted({int(round(i * (m - 1) / max(count - 1, 1)))
+                         for i in range(count)}))
+
+
+# -- host-side reductions ---------------------------------------------------
+
+def _pctl(vals, q: float):
+    """Nearest-rank percentile, matching metrics._percentile so the SLO
+    plane and the process histograms quote the same statistic."""
+    if len(vals) == 0:
+        return None
+    vals = sorted(vals)
+    k = max(0, min(len(vals) - 1,
+                   int(round(q / 100.0 * len(vals) + 0.5)) - 1))
+    return vals[k]
+
+
+def lag_values(first_seen_row: np.ndarray) -> list:
+    """Per-node lag samples for one record: rounds from the record's
+    origin (its minimum first_seen) to each reached node."""
+    fs = np.asarray(first_seen_row)
+    seen = fs >= 0
+    if not seen.any():
+        return []
+    origin = int(fs[seen].min())
+    return [int(v) - origin for v in fs[seen]]
+
+
+def pooled_lag(first_seen: np.ndarray) -> dict:
+    """Lag CDF summary pooled across every tracked record: the
+    per-(record, reached node) lag distribution in rounds."""
+    lags: list = []
+    for row in np.asarray(first_seen):
+        lags.extend(lag_values(row))
+    return {
+        "samples": len(lags),
+        "p50": _pctl(lags, 50.0),
+        "p95": _pctl(lags, 95.0),
+        "p99": _pctl(lags, 99.0),
+        "max": max(lags) if lags else None,
+    }
+
+
+def p99_lag_rounds(first_seen: np.ndarray):
+    """The /sweep column: pooled p99 rounds-lag, or None without
+    samples (no tracers, or nothing reached)."""
+    return pooled_lag(first_seen)["p99"]
+
+
+def summarize(prov: ProvTrace, tracked, services_per_node: int) -> dict:
+    """Host-side reduction of a finished trace: per-record lag CDFs,
+    hop histograms, reach accounting, and the pooled lag summary."""
+    fs = np.asarray(jax.device_get(prov.first_seen))
+    hops = np.asarray(jax.device_get(prov.hops))
+    parent = np.asarray(jax.device_get(prov.parent))
+    count = int(jax.device_get(prov.count))
+    cap = prov.coverage.shape[0]
+    n = fs.shape[1]
+
+    records = []
+    for ti, slot in enumerate(tracked):
+        seen = fs[ti] >= 0
+        lags = lag_values(fs[ti])
+        hop_vals = hops[ti][seen & (hops[ti] >= 0)]
+        hist = np.bincount(hop_vals).tolist() if hop_vals.size else []
+        records.append({
+            "slot": int(slot),
+            "origin_node": int(slot) // services_per_node,
+            "origin_round": int(fs[ti][seen].min()) if seen.any()
+            else None,
+            "reached": int(seen.sum()),
+            "rounds_to_reach_all": (max(lags) if seen.all() else None),
+            "unattributed": int(np.sum(
+                seen & (parent[ti] == PARENT_UNATTRIBUTED))),
+            "lag": {"p50": _pctl(lags, 50.0), "p95": _pctl(lags, 95.0),
+                    "p99": _pctl(lags, 99.0)},
+            "hop_histogram": hist,
+        })
+    return {
+        "tracked": [int(s) for s in tracked],
+        "records": records,
+        "lag": pooled_lag(fs),
+        "rounds_observed": count,
+        "overflow": bool(jax.device_get(prov.overflow)),
+        "coverage": np.asarray(jax.device_get(
+            prov.coverage))[:min(count, cap)].T.tolist(),
+        "nodes": n,
+    }
+
+
+def tree_to_dict(prov: ProvTrace, tracked) -> list:
+    """The exportable propagation-tree JSON: per record, the per-node
+    parent/hop/first-seen arrays (parent sentinels: −1 origin, −2
+    unattributed; first_seen −1 = never reached)."""
+    fs = np.asarray(jax.device_get(prov.first_seen))
+    parent = np.asarray(jax.device_get(prov.parent))
+    hops = np.asarray(jax.device_get(prov.hops))
+    return [{"slot": int(slot),
+             "first_seen": fs[ti].tolist(),
+             "parent": parent[ti].tolist(),
+             "hops": hops[ti].tolist()}
+            for ti, slot in enumerate(tracked)]
+
+
+def blast_radius(prov: ProvTrace, tracked, services_per_node: int,
+                 origin_nodes) -> dict:
+    """Chaos/adversary accounting: which tracked records owned by a
+    faulted origin set reached how much of the cluster, and via which
+    paths (max tree depth + the unattributed count — deliveries the
+    sampled channels cannot explain, i.e. delayed/duplicated paths)."""
+    origin_nodes = set(int(x) for x in origin_nodes)
+    fs = np.asarray(jax.device_get(prov.first_seen))
+    hops = np.asarray(jax.device_get(prov.hops))
+    parent = np.asarray(jax.device_get(prov.parent))
+    n = fs.shape[1]
+    out = []
+    for ti, slot in enumerate(tracked):
+        owner = int(slot) // services_per_node
+        if owner not in origin_nodes:
+            continue
+        seen = fs[ti] >= 0
+        hop_vals = hops[ti][seen & (hops[ti] >= 0)]
+        out.append({
+            "slot": int(slot),
+            "origin_node": owner,
+            "reached": int(seen.sum()),
+            "reach_fraction": float(seen.sum()) / n,
+            "max_hops": int(hop_vals.max()) if hop_vals.size else 0,
+            "unattributed_paths": int(np.sum(
+                seen & (parent[ti] == PARENT_UNATTRIBUTED))),
+        })
+    return {"origins": sorted(origin_nodes), "records": out}

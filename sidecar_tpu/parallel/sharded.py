@@ -52,6 +52,7 @@ from sidecar_tpu import metrics
 from sidecar_tpu.models.exact import SimParams, SimState, clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
@@ -452,6 +453,56 @@ class ShardedSim:
         sent = jnp.where(merged != known, jnp.int8(0), sent)
         return merged, sent
 
+    # -- provenance hooks (ops/provenance.py, docs/telemetry.md) -----------
+    # Channel re-derivation replays the per-shard PRNG streams at the jit
+    # level: the same fold_in(ax)/split draws _gossip_shard consumes,
+    # stitched back into global [N, F] tensors.  Derivation only — the
+    # step's own tensors are never touched, so provenance-enabled runs
+    # stay bit-identical to untraced ones.
+
+    def _prov_belief(self, state: SimState,
+                     tracked: jax.Array) -> jax.Array:
+        """Packed [N, T] belief matrix for the tracked slots."""
+        return state.known[:, tracked]
+
+    def _prov_channels(self, state: SimState, key: jax.Array):
+        p, t = self.p, self.t
+        round_idx = state.round_idx + 1
+        alive = state.node_alive
+        k_round, k_pp = jax.random.split(key)
+        nl = p.n // self.d
+        parts = []
+        for ax in range(self.d):
+            key_shard = jax.random.fold_in(k_round, ax)
+            k_peers, _k_drop = jax.random.split(key_shard)
+            gi = ax * nl + jnp.arange(nl, dtype=jnp.int32)
+            if self._nbrs is None:
+                parts.append(
+                    self._sample_dst_complete(k_peers, gi, alive, nl))
+            else:
+                nbrs_l = self._nbrs[ax * nl:(ax + 1) * nl]
+                deg_l = self._deg[ax * nl:(ax + 1) * nl]
+                cut_l = (None if self._cut is None
+                         else self._cut[ax * nl:(ax + 1) * nl])
+                parts.append(self._sample_dst_nbrs(
+                    k_peers, gi, alive, nl, nbrs_l, deg_l, cut_l))
+        pushes = [(jnp.concatenate(parts, axis=0), None)]
+
+        # The stride exchange is two one-way pulls from the receiver's
+        # point of view: i pulls the forward partner's full state and
+        # receives the backward partner's push.
+        stride = jax.random.randint(k_pp, (), 1, p.n, dtype=jnp.int32)
+        idx = jnp.arange(p.n, dtype=jnp.int32)
+        pp_on = round_idx % t.push_pull_rounds == 0
+        pulls = []
+        for roll_amt, partner in ((-stride, (idx + stride) % p.n),
+                                  (stride, (idx - stride) % p.n)):
+            ok = alive & jnp.roll(alive, roll_amt)
+            if self._side is not None:
+                ok = ok & (self._side == jnp.roll(self._side, roll_amt))
+            pulls.append((partner[:, None], (ok & pp_on)[:, None]))
+        return pushes, pulls
+
     # -- drivers -----------------------------------------------------------
 
     def _step_impl(self, state: SimState, key: jax.Array,
@@ -605,6 +656,38 @@ class ShardedSim:
         self.last_sparse_stats = None
         return self._run_trace_jit(state, key, num_rounds, cap)
 
+    def run_with_provenance(self, state: SimState, key: jax.Array,
+                            num_rounds: int, tracked, cap: int = 0,
+                            prov=None, donate: bool = True,
+                            start_round=None, sparse=None):
+        """Scan with the record-level provenance tracer — the ExactSim
+        contract: ``(final, ProvTrace, conv[num_rounds])``, chunkable by
+        passing the previous chunk's ``ProvTrace`` as ``prov``."""
+        tracked = tuple(int(s) for s in tracked)
+        if not tracked:
+            raise ValueError("provenance needs at least one tracked slot")
+        for slot in tracked:
+            if not 0 <= slot < self.p.m:
+                raise ValueError(
+                    f"tracked slot {slot} outside [0, {self.p.m})")
+        cap = cap or num_rounds
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if prov is None:
+            prov = prov_ops.zero_prov(len(tracked), self.p.n, cap)
+            prov = prov_ops.seed(
+                prov,
+                self._prov_belief(state, jnp.asarray(tracked, jnp.int32)),
+                state.round_idx)
+        if self._resolve_sparse_request(sparse):
+            final, prov, conv, stats = self._run_prov_sparse_jit(
+                state, key, num_rounds, prov, tracked)
+            self.last_sparse_stats = stats
+            return final, prov, conv
+        self.last_sparse_stats = None
+        return self._run_prov_jit(state, key, num_rounds, prov, tracked)
+
     def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
                  donate: bool = True, start_round=None, sparse=None):
         self._check_horizon(state, num_rounds, start_round)
@@ -678,6 +761,52 @@ class ShardedSim:
             body, (state, trace_ops.zero_trace(cap),
                    sparse_ops.zero_stats()), None, length=num_rounds)
         return final, buf, conv, stats
+
+    # Donates the ProvTrace too (argnum 4): it chains chunk-to-chunk the
+    # way the state does.
+    @functools.partial(jax.jit, static_argnums=(0, 3, 5),
+                       donate_argnums=(1, 4))
+    def _run_prov_jit(self, state, key, num_rounds, prov, tracked):
+        tr = jnp.asarray(tracked, jnp.int32)
+
+        def body(carry, _):
+            st, pv = carry
+            k = jax.random.fold_in(key, st.round_idx)
+            st2 = self._step(st, k)
+            pushes, pulls = self._prov_channels(st, k)
+            pv = prov_ops.observe(
+                pv,
+                prov_ops.holders(pv, self._prov_belief(st, tr)),
+                prov_ops.holders(pv, self._prov_belief(st2, tr)),
+                st2.round_idx, pushes, pulls)
+            return (st2, pv), self.convergence(st2)
+
+        (final, prov), conv = lax.scan(body, (state, prov), None,
+                                       length=num_rounds)
+        return final, prov, conv
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 5),
+                       donate_argnums=(1, 4))
+    def _run_prov_sparse_jit(self, state, key, num_rounds, prov, tracked):
+        tr = jnp.asarray(tracked, jnp.int32)
+
+        def body(carry, _):
+            st, pv, acc = carry
+            k = jax.random.fold_in(key, st.round_idx)
+            st2, s = self._step_sparse(st, k)
+            pushes, pulls = self._prov_channels(st, k)
+            pv = prov_ops.observe(
+                pv,
+                prov_ops.holders(pv, self._prov_belief(st, tr)),
+                prov_ops.holders(pv, self._prov_belief(st2, tr)),
+                st2.round_idx, pushes, pulls)
+            return (st2, pv, sparse_ops.accumulate_stats(acc, s)), \
+                self.convergence(st2)
+
+        (final, prov, stats), conv = lax.scan(
+            body, (state, prov, sparse_ops.zero_stats()), None,
+            length=num_rounds)
+        return final, prov, conv, stats
 
     # Sparse-path scan drivers (docs/sparse.md): same donation and key
     # folding as the dense drivers, plus the stats accumulator.

@@ -689,6 +689,12 @@ class ShardedCompressedSim(CompressedSim):
                     k_p, gi, alive, nl, nbrs_l, deg_l, cut_l))
         return jnp.concatenate(parts)
 
+    # Provenance hook (ops/provenance.py): the pull channels must replay
+    # the per-shard sampling streams, not the single-chip stream — the
+    # rest of the provenance plane is inherited from CompressedSim.
+    def _prov_sample_src(self, k_peers, node_alive):
+        return self._sample_dst_jit(k_peers, node_alive)
+
     def _step_sparse(self, state: CompressedState, key: jax.Array):
         """The sharded sparse round: frontiers and the overflow check
         run at the jit level (GSPMD elementwise over the sharded

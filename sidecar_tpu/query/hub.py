@@ -32,6 +32,7 @@ from typing import Optional
 
 from sidecar_tpu import metrics
 from sidecar_tpu.telemetry.span import span as _span
+from sidecar_tpu.telemetry import propagation as _propagation
 from sidecar_tpu.query.snapshot import (
     CatalogSnapshot,
     ServerView,
@@ -259,6 +260,13 @@ class QueryHub:
             for sub in subs:
                 sub._offer(qevent)
             metrics.histogram_since("query.hub.fanout", t0)
+        # End-to-end propagation lag at the query plane — the second
+        # site of the live provenance twin (telemetry/propagation.py):
+        # how far behind the origin's stamp this record was when it
+        # became visible to /watch consumers.
+        _propagation.observe("query", event.service.hostname,
+                             (time.time_ns() - event.service.updated)
+                             / 1e6)
         return snap
 
     # -- subscriptions -----------------------------------------------------

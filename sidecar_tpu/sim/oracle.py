@@ -317,3 +317,91 @@ class OracleSim:
         truth = np.max(np.where(alive[:, None], self.known, 0), axis=0)
         agree = (self.known == truth[None, :]).mean(axis=1)
         return float((agree * alive).sum() / max(alive.sum(), 1))
+
+
+class ProvenanceOracle:
+    """Sequential NumPy mirror of ops/provenance (docs/telemetry.md):
+    the same version-ref holder test and the same minimal-(hops, node
+    id) attribution rule, evolved receiver by receiver with plain
+    loops instead of the kernel's packed-score scatter-min.  Feed it
+    the SAME holder matrices and channel lists the kernel consumes
+    (``sim._prov_belief`` / ``sim._prov_channels``) and ``first_seen``
+    / ``parent`` / ``hops`` / ``coverage`` must match element-for-
+    element."""
+
+    # pack(tick=1, status=0): the floor of ops/provenance._MIN_KNOWN.
+    MIN_KNOWN = 8
+
+    def __init__(self, belief0: np.ndarray, round0: int):
+        belief0 = np.asarray(belief0)                  # packed [N, T]
+        self.n, self.t_n = belief0.shape
+        self.ref = np.maximum(belief0.max(axis=0).astype(np.int64),
+                              self.MIN_KNOWN)
+        self.first_seen = np.full((self.t_n, self.n), -1, np.int64)
+        self.parent = np.full((self.t_n, self.n), -1, np.int64)
+        self.hops = np.full((self.t_n, self.n), -1, np.int64)
+        self.coverage: list = []
+        hold = self.holders(belief0)
+        for ti in range(self.t_n):
+            for node in range(self.n):
+                if hold[node, ti]:
+                    self.first_seen[ti, node] = int(round0)
+                    self.hops[ti, node] = 0   # parent stays ORIGIN (-1)
+
+    def holders(self, belief) -> np.ndarray:
+        """Bool [N, T]: beliefs that reached the traced version."""
+        return np.asarray(belief) >= self.ref[None, :]
+
+    def observe(self, prev_hold, nxt_hold, round_idx: int,
+                pushes=(), pulls=()) -> None:
+        """Fold one round: for every node newly holding a record, scan
+        every sampled channel whose sender already held it and charge
+        the minimal-(hops, sender id) candidate; no open candidate ⇒
+        PARENT_UNATTRIBUTED (−2) at hop 0."""
+        prev_hold = np.asarray(prev_hold)
+        nxt_hold = np.asarray(nxt_hold)
+        pushes = [(np.asarray(i),
+                   None if m is None
+                   else np.broadcast_to(np.asarray(m), np.shape(i)))
+                  for i, m in pushes]
+        pulls = [(np.asarray(i),
+                  None if m is None
+                  else np.broadcast_to(np.asarray(m), np.shape(i)))
+                 for i, m in pulls]
+        for ti in range(self.t_n):
+            for node in range(self.n):
+                if not nxt_hold[node, ti] \
+                        or self.first_seen[ti, node] >= 0:
+                    continue
+                best = None                            # (hops, sender)
+                for idx, mask in pushes:
+                    for s in range(idx.shape[0]):
+                        if not prev_hold[s, ti]:
+                            continue
+                        for k in range(idx.shape[1]):
+                            if int(idx[s, k]) != node:
+                                continue
+                            if mask is not None and not mask[s, k]:
+                                continue
+                            cand = (max(int(self.hops[ti, s]), 0), s)
+                            if best is None or cand < best:
+                                best = cand
+                for idx, mask in pulls:
+                    for k in range(idx.shape[1]):
+                        if mask is not None and not mask[node, k]:
+                            continue
+                        src = int(idx[node, k])
+                        if not prev_hold[src, ti]:
+                            continue
+                        cand = (max(int(self.hops[ti, src]), 0), src)
+                        if best is None or cand < best:
+                            best = cand
+                self.first_seen[ti, node] = int(round_idx)
+                if best is None:
+                    self.parent[ti, node] = -2
+                    self.hops[ti, node] = 0
+                else:
+                    self.parent[ti, node] = best[1]
+                    self.hops[ti, node] = best[0] + 1
+        self.coverage.append(
+            nxt_hold.sum(axis=0).astype(np.int64).tolist())

@@ -18,6 +18,8 @@ lives with the other device ops in :mod:`sidecar_tpu.ops.trace`.
 """
 
 from sidecar_tpu.telemetry.prometheus import render_prometheus
-from sidecar_tpu.telemetry.span import span, spans, reset_spans
+from sidecar_tpu.telemetry.span import (span, spans, spans_since,
+                                        reset_spans)
 
-__all__ = ["render_prometheus", "span", "spans", "reset_spans"]
+__all__ = ["render_prometheus", "span", "spans", "spans_since",
+           "reset_spans"]

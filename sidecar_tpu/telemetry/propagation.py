@@ -1,0 +1,161 @@
+"""Live-path propagation lag — the sim provenance plane's live twin.
+
+The simulator's record-level provenance tracer (ops/provenance.py)
+answers "how many rounds until record X reached everyone, and through
+whom".  A live node cannot see other nodes' receive times, but it CAN
+see its own: every gossiped record carries its origin's wall-clock
+``Updated`` stamp, so ``merge time − record stamp`` at this node IS the
+propagation lag of that record's path to us — the same quantity the
+sim's per-record first_seen lag measures in rounds (docs/telemetry.md).
+
+Two observation sites, mirroring the sim's round/coverage split:
+
+* ``catalog`` — the catalog writer admitted a remote record
+  (``ServicesState._add_service_entry``): gossip transport + merge lag.
+* ``query``  — the QueryHub published the change to subscribers
+  (``QueryHub.publish``): end-to-end lag to the query plane, the stamp
+  a /watch consumer's view trails the origin by.
+
+Each observation lands in a pooled ``propagation.<site>.lag``
+histogram (Prometheus summary via /metrics) AND a per-origin reservoir
+so the /api/propagation endpoint can show which peer's records arrive
+slow — the live analog of the sim report's per-record lag CDFs.
+
+Env contract (docs/env.md):
+
+* ``SIDECAR_TPU_PROVENANCE`` — "0" disables the meter entirely
+  (default on; the hot-path cost is one histogram insert per admitted
+  record).
+* ``SIDECAR_TPU_PROVENANCE_ORIGINS`` — max distinct per-origin series
+  (default 64).  Beyond the cap, observations still feed the pooled
+  histogram; the origin table stops growing and the snapshot reports
+  ``overflow_origins`` (truncation is surfaced, never silent — the
+  DeltaBatch convention).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Optional
+
+from sidecar_tpu import metrics
+from sidecar_tpu.metrics import _percentile
+
+DEFAULT_MAX_ORIGINS = 64
+# Per-origin reservoir bound: smaller than the registry's (the origin
+# table is max_origins × sites wide).
+RESERVOIR = 256
+
+SITES = ("catalog", "query")
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("SIDECAR_TPU_PROVENANCE", "1") != "0"
+
+
+def _env_max_origins() -> int:
+    raw = os.environ.get("SIDECAR_TPU_PROVENANCE_ORIGINS", "")
+    try:
+        return max(1, int(raw)) if raw else DEFAULT_MAX_ORIGINS
+    except ValueError:
+        return DEFAULT_MAX_ORIGINS
+
+
+class PropagationMeter:
+    """Thread-safe per-(site, origin) lag accounting."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_origins: Optional[int] = None) -> None:
+        self.enabled = _env_enabled() if enabled is None else enabled
+        self.max_origins = _env_max_origins() if max_origins is None \
+            else max_origins
+        self._lock = threading.Lock()
+        # site → origin → [count, total_ms, last_ms, max_ms, samples]
+        self._origins: dict[str, dict[str, list]] = {}
+        self._overflow: dict[str, int] = {}
+        self._rand = random.Random(0x51DECA)
+
+    def observe(self, site: str, origin: str, lag_ms: float) -> None:
+        """Record one admitted record's lag at ``site``.  Negative lags
+        (clock skew within the admission fudge) clamp to 0 — the gate
+        (docs/chaos.md) already rejected anything further ahead."""
+        if not self.enabled:
+            return
+        lag_ms = max(0.0, float(lag_ms))
+        metrics.histogram(f"propagation.{site}.lag", lag_ms)
+        with self._lock:
+            table = self._origins.setdefault(site, {})
+            ent = table.get(origin)
+            if ent is None:
+                if len(table) >= self.max_origins:
+                    self._overflow[site] = \
+                        self._overflow.get(site, 0) + 1
+                    return
+                ent = table[origin] = [0, 0.0, 0.0, 0.0, []]
+            ent[0] += 1
+            ent[1] += lag_ms
+            ent[2] = lag_ms
+            ent[3] = max(ent[3], lag_ms)
+            samples = ent[4]
+            if len(samples) < RESERVOIR:
+                samples.append(lag_ms)
+            else:
+                j = self._rand.randrange(ent[0])
+                if j < RESERVOIR:
+                    samples[j] = lag_ms
+
+    def snapshot(self) -> dict:
+        """The /api/propagation document: per site, the per-origin lag
+        percentiles plus the overflow accounting."""
+        with self._lock:
+            doc: dict = {"enabled": self.enabled,
+                         "max_origins": self.max_origins, "sites": {}}
+            for site, table in self._origins.items():
+                origins = {}
+                for origin, ent in table.items():
+                    s = sorted(ent[4])
+                    origins[origin] = {
+                        "count": ent[0],
+                        "mean_ms": round(ent[1] / ent[0], 3)
+                        if ent[0] else 0.0,
+                        "last_ms": round(ent[2], 3),
+                        "max_ms": round(ent[3], 3),
+                        "p50_ms": round(_percentile(s, 0.50), 3),
+                        "p95_ms": round(_percentile(s, 0.95), 3),
+                        "p99_ms": round(_percentile(s, 0.99), 3),
+                    }
+                doc["sites"][site] = {
+                    "origins": origins,
+                    "overflow_origins": self._overflow.get(site, 0),
+                }
+            return doc
+
+    def reset(self) -> None:
+        """Clear the origin tables (tests)."""
+        with self._lock:
+            self._origins.clear()
+            self._overflow.clear()
+
+
+# The process-global meter (the metrics-registry convention) — the
+# catalog writer and QueryHub record through it, /api/propagation
+# reads it.  ``configure`` swaps gates for tests/embedders.
+meter = PropagationMeter()
+
+
+def configure(enabled: Optional[bool] = None,
+              max_origins: Optional[int] = None) -> None:
+    """Re-read the env gates (or force them) on the global meter."""
+    meter.enabled = _env_enabled() if enabled is None else enabled
+    if max_origins is not None:
+        meter.max_origins = max_origins
+
+
+def observe(site: str, origin: str, lag_ms: float) -> None:
+    meter.observe(site, origin, lag_ms)
+
+
+def snapshot() -> dict:
+    return meter.snapshot()

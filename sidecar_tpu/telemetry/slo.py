@@ -1,0 +1,168 @@
+"""Convergence-SLO evaluator (docs/telemetry.md).
+
+The provenance plane measures propagation lag — per-record
+rounds-to-reach-all in the simulator (ops/provenance.py), merge-time −
+record-stamp milliseconds on the live path
+(telemetry/propagation.py).  This module turns those measurements into
+VERDICTS: declarative rules of the form "p99 lag ≤ R rounds" or
+"p99 lag ≤ S seconds", evaluated against a lag summary and exposed
+three ways:
+
+* ``slo.<rule>.observed`` / ``slo.<rule>.ok`` gauges in the metrics
+  registry (scrapeable — an alert on ``sidecar_slo_<rule>_ok == 0``
+  is the whole integration);
+* a ``slo`` verdict block in the bench JSON (bench.py /
+  benchmarks/robustness.py) — the regression-gate surface;
+* the ``slo`` block of ``GET /api/propagation.json`` when an
+  evaluator is attached to the catalog (``state.slo_evaluator``).
+
+Rule syntax (one string per rule): ``"<pctl> <= <threshold> <unit>"``
+with pctl ∈ {p50, p95, p99, max} and unit ∈ {rounds, s, seconds, ms}
+— e.g. ``"p99 <= 12 rounds"``, ``"p95<=1.5s"``.
+
+Env contract (docs/env.md):
+
+* ``BENCH_SLO`` — "0" skips SLO evaluation entirely (no verdict
+  block, no gauges).
+* ``BENCH_SLO_RULES`` — comma-separated rule strings replacing the
+  defaults (``p99 <= 16 rounds, p99 <= 2 s``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Optional
+
+from sidecar_tpu import metrics
+
+DEFAULT_RULES = ("p99 <= 16 rounds", "p99 <= 2 s")
+
+_RULE_RE = re.compile(
+    r"^\s*(p50|p95|p99|max)\s*<=\s*([0-9.]+)\s*"
+    r"(rounds?|seconds?|s|ms)\s*$", re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class SloRule:
+    """One declarative bound on a lag percentile."""
+
+    percentile: str          # p50 | p95 | p99 | max
+    threshold: float         # in `unit`
+    unit: str                # "rounds" | "s" | "ms"
+
+    @classmethod
+    def parse(cls, text: str) -> "SloRule":
+        m = _RULE_RE.match(text)
+        if not m:
+            raise ValueError(
+                f"bad SLO rule {text!r}: expected "
+                "'<p50|p95|p99|max> <= <threshold> <rounds|s|ms>'")
+        pctl, raw, unit = m.group(1).lower(), m.group(2), \
+            m.group(3).lower()
+        unit = {"round": "rounds", "rounds": "rounds", "s": "s",
+                "second": "s", "seconds": "s", "ms": "ms"}[unit]
+        return cls(percentile=pctl, threshold=float(raw), unit=unit)
+
+    @property
+    def key(self) -> str:
+        """The metric-name fragment: ``slo.<key>.ok`` /
+        ``slo.<key>.observed``."""
+        thr = f"{self.threshold:g}".replace(".", "_")
+        return f"{self.percentile}_{thr}{self.unit}"
+
+    def text(self) -> str:
+        return (f"{self.percentile} lag <= {self.threshold:g} "
+                f"{self.unit}")
+
+
+def _threshold_seconds(rule: SloRule) -> float:
+    return rule.threshold / 1e3 if rule.unit == "ms" \
+        else rule.threshold
+
+
+class SloEvaluator:
+    """Evaluate a rule set against lag summaries and publish the
+    verdicts as gauges."""
+
+    def __init__(self, rules) -> None:
+        self.rules = tuple(SloRule.parse(r) if isinstance(r, str)
+                           else r for r in rules)
+
+    @classmethod
+    def from_env(cls) -> Optional["SloEvaluator"]:
+        """The ``BENCH_SLO`` contract: None when skipped, otherwise
+        the ``BENCH_SLO_RULES`` (or default) rule set."""
+        if os.environ.get("BENCH_SLO", "1") == "0":
+            return None
+        raw = os.environ.get("BENCH_SLO_RULES", "")
+        rules = [r for r in (p.strip() for p in raw.split(","))
+                 if r] or list(DEFAULT_RULES)
+        return cls(rules)
+
+    # -- evaluation ---------------------------------------------------------
+
+    def evaluate_lag(self, lag: Optional[dict],
+                     seconds_per_round: Optional[float] = None,
+                     publish: bool = True) -> dict:
+        """Verdict block for a sim-side pooled lag summary
+        (ops/provenance.pooled_lag: percentiles in ROUNDS).  Rules in
+        seconds are checked through ``seconds_per_round`` (the
+        protocol clock) and skipped — verdict null — when no clock or
+        no samples are available; a rule that cannot be evaluated
+        never passes silently."""
+        verdicts = []
+        for rule in self.rules:
+            observed = None
+            if lag and lag.get("samples"):
+                rounds_v = lag.get(rule.percentile)
+                if rounds_v is not None:
+                    if rule.unit == "rounds":
+                        observed, thr = float(rounds_v), rule.threshold
+                    elif seconds_per_round is not None:
+                        observed = float(rounds_v) * seconds_per_round
+                        thr = _threshold_seconds(rule)
+            ok = None if observed is None else observed <= thr
+            verdicts.append(self._verdict(rule, observed, ok, publish))
+        return self._block(verdicts)
+
+    def evaluate_live(self, publish: bool = True) -> dict:
+        """Verdict block for the LIVE path: seconds/ms rules checked
+        against the pooled ``propagation.query.lag`` histogram (the
+        end-to-end site); rounds rules are sim-only and report null
+        here."""
+        hists = metrics.snapshot().get("histograms", {})
+        h = hists.get("propagation.query.lag")
+        verdicts = []
+        for rule in self.rules:
+            observed = None
+            if rule.unit != "rounds" and h and h.get("count"):
+                pct_ms = h.get(f"{rule.percentile}_ms") \
+                    if rule.percentile != "max" else h.get("max_ms")
+                if pct_ms is not None:
+                    observed = float(pct_ms) / 1e3
+                    thr = _threshold_seconds(rule)
+            ok = None if observed is None else observed <= thr
+            verdicts.append(self._verdict(rule, observed, ok, publish))
+        return self._block(verdicts)
+
+    def _verdict(self, rule: SloRule, observed, ok,
+                 publish: bool) -> dict:
+        if publish and ok is not None:
+            metrics.set_gauge(f"slo.{rule.key}.observed", observed)
+            metrics.set_gauge(f"slo.{rule.key}.ok", 1.0 if ok else 0.0)
+        return {"rule": rule.text(),
+                "percentile": rule.percentile,
+                "threshold": rule.threshold,
+                "unit": rule.unit,
+                "observed": observed,
+                "pass": ok}
+
+    @staticmethod
+    def _block(verdicts: list) -> dict:
+        evaluated = [v for v in verdicts if v["pass"] is not None]
+        return {"rules": verdicts,
+                "evaluated": len(evaluated),
+                "pass": all(v["pass"] for v in evaluated)
+                if evaluated else None}

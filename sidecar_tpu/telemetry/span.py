@@ -17,6 +17,16 @@ new trace, which is exactly the boundary where the queue's own metrics
 
 Served at ``GET /api/trace`` (web/api.py) newest-last; ``reset_spans``
 exists for tests.
+
+Every COMPLETED span carries a monotonic ``seq`` (assigned under the
+ring lock at completion, so seq order == ring order).  ``spans_since``
+is the cursor read behind ``GET /api/trace?since=<seq>``: spans with
+``seq > since``, the resume cursor, and — because the ring overwrites
+oldest-first — an explicit ``dropped`` count when the cursor fell
+behind the ring (truncation is surfaced, never silent: the DeltaBatch
+convention).  ``seq`` is a Python int and never wraps; it keeps
+counting across ``reset_spans`` so stale cursors stay safe
+(docs/telemetry.md).
 """
 
 from __future__ import annotations
@@ -34,6 +44,13 @@ RING_CAPACITY = 1024
 _lock = threading.Lock()
 _ring: "collections.deque[dict]" = collections.deque(maxlen=RING_CAPACITY)
 _ids = itertools.count(1)
+# Completion-order cursor: assigned under the lock as a span enters the
+# ring, so ring order and seq order agree (span_id is ENTRY order and
+# can't page the ring — children complete before their parents).
+# ``_last_seq`` mirrors the newest assigned value so the cursor survives
+# an empty ring (reset, or everything evicted).
+_seq = itertools.count(1)
+_last_seq = 0
 _tls = threading.local()
 
 
@@ -68,8 +85,11 @@ class span:
         stack = getattr(_tls, "stack", [])
         if stack and stack[-1] is self:
             stack.pop()
+        global _last_seq
         with _lock:
+            _last_seq = next(_seq)
             _ring.append({
+                "seq": _last_seq,
                 "name": self.name,
                 "span_id": self.span_id,
                 "parent_id": self.parent_id,
@@ -92,7 +112,32 @@ def spans(limit: Optional[int] = None) -> list[dict]:
     return items
 
 
+def spans_since(since: int, limit: Optional[int] = None) -> dict:
+    """Cursor read (``GET /api/trace?since=<seq>``): spans completed
+    after cursor ``since``, OLDEST first so ``limit`` pages forward.
+
+    Returns ``{"spans": [...], "next_since": s, "dropped": d}`` —
+    resume with ``since=next_since`` to read exactly once.  ``dropped``
+    counts spans the ring overwrote before this read (cursor fell more
+    than RING_CAPACITY behind); it is never silent truncation.  With
+    ``limit``, the FIRST ``limit`` matching spans are returned and
+    ``next_since`` points at the last returned one, so a lagging
+    reader catches up over successive pages."""
+    since = max(0, int(since))
+    with _lock:
+        items = [s for s in _ring if s["seq"] > since]
+        oldest = _ring[0]["seq"] if _ring else _last_seq + 1
+        newest = _last_seq
+    dropped = max(0, oldest - 1 - since)
+    if limit is not None and limit >= 0:
+        items = items[:limit]
+    next_since = items[-1]["seq"] if items else max(since, newest)
+    return {"spans": items, "next_since": next_since,
+            "dropped": dropped}
+
+
 def reset_spans() -> None:
-    """Clear the ring (tests)."""
+    """Clear the ring (tests).  ``seq`` keeps counting — a cursor from
+    before the reset stays valid and simply reads nothing new."""
     with _lock:
         _ring.clear()

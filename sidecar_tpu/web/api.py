@@ -17,7 +17,12 @@ Routes (http.go:64-76, http_api.go:35-45):
   GET  /metrics (+ /api/metrics)    Prometheus text exposition of the
                                     registry (docs/telemetry.md)
   GET  /api/trace (+ /trace)        span-tracer ring buffer as JSON
-                                    (?limit=N newest spans)
+                                    (?limit=N newest spans; ?since=S
+                                    sequence cursor — docs/telemetry.md)
+  GET  /api/propagation.json        per-origin propagation-lag
+                                    percentiles + SLO verdicts
+                                    (telemetry/propagation.py)
+  GET  /api/propagation             human-readable lag table
   GET  /api/debug/profile           live sampling CPU profile (pprof analog)
   GET  /api/haproxy/stats.csv       relay of the managed HAProxy's stats CSV
   GET  /api/damping.json            flap-damper penalties + suppressed set
@@ -198,6 +203,10 @@ class SidecarApi:
             return self.metrics_prometheus()
         if parts == ["trace"]:
             return self.trace_dump(query)
+        if parts == ["propagation.json"]:
+            return self.propagation_dump()
+        if parts == ["propagation"]:
+            return self.propagation_page()
         if parts == ["damping.json"] or parts == ["damping"]:
             return self.damping_dump()
         if parts == ["debug", "stacks"]:
@@ -336,8 +345,13 @@ class SidecarApi:
         """The span tracer's ring buffer as JSON (``GET /api/trace`` —
         end-to-end timing of the live propagation path, receive →
         merge → publish → watcher delivery; docs/telemetry.md).
-        ``?limit=N`` returns only the newest N spans."""
-        from sidecar_tpu.telemetry import spans
+        ``?limit=N`` returns only the newest N spans; ``?since=<seq>``
+        is the incremental cursor — spans completed after that
+        sequence number, oldest first, with ``next_since`` to resume
+        from and ``dropped`` when the ring overwrote spans the cursor
+        never read (with both, ``limit`` pages FORWARD from the
+        cursor)."""
+        from sidecar_tpu.telemetry import spans, spans_since
 
         limit = None
         raw = query.get("limit", [None])[0]
@@ -346,8 +360,64 @@ class SidecarApi:
                 limit = int(raw)
             except ValueError:
                 return self._error(400, "limit must be an integer")
-        body = json.dumps({"spans": spans(limit)}, indent=2).encode()
+        raw_since = query.get("since", [None])[0]
+        if raw_since is not None:
+            try:
+                since = int(raw_since)
+            except ValueError:
+                return self._error(
+                    400, "since must be an integer span cursor")
+            doc = spans_since(since, limit)
+        else:
+            doc = {"spans": spans(limit)}
+        body = json.dumps(doc, indent=2).encode()
         return 200, "application/json", body, CORS_HEADERS
+
+    def propagation_dump(self):
+        """Live propagation-lag view (``GET /api/propagation.json`` —
+        telemetry/propagation.py, the sim provenance plane's live
+        twin): per observation site (catalog writer, query hub) the
+        per-origin merge-lag percentiles, plus the convergence-SLO
+        verdicts when an evaluator is attached (telemetry/slo.py)."""
+        from sidecar_tpu.telemetry import propagation
+
+        doc = propagation.snapshot()
+        slo = getattr(self.state, "slo_evaluator", None)
+        if slo is not None:
+            doc["slo"] = slo.evaluate_live()
+        return self._json(200, doc)
+
+    def propagation_page(self):
+        """Auto-refreshing human view of the propagation meter
+        (``GET /api/propagation`` — the /servers convention): one row
+        per (site, origin) with the lag percentiles."""
+        from sidecar_tpu.telemetry import propagation
+
+        doc = propagation.snapshot()
+        rows = []
+        for site, block in sorted(doc.get("sites", {}).items()):
+            for origin, ent in sorted(block["origins"].items()):
+                rows.append(
+                    f"<tr><td>{site}</td><td>{origin}</td>"
+                    f"<td>{ent['count']}</td>"
+                    f"<td>{ent['p50_ms']}</td><td>{ent['p95_ms']}</td>"
+                    f"<td>{ent['p99_ms']}</td><td>{ent['max_ms']}</td>"
+                    f"</tr>")
+            if block.get("overflow_origins"):
+                rows.append(
+                    f"<tr><td>{site}</td><td><i>(+"
+                    f"{block['overflow_origins']} origins beyond cap)"
+                    f"</i></td><td colspan=5></td></tr>")
+        body = (
+            "\n\t\t\t<head>\n\t\t\t<meta http-equiv=\"refresh\" "
+            "content=\"4\">\n\t\t\t</head>\n\t\t\t"
+            "<h3>Propagation lag (ms) — merge time − record stamp"
+            "</h3>\n<table border=1 cellpadding=4>"
+            "<tr><th>site</th><th>origin</th><th>count</th>"
+            "<th>p50</th><th>p95</th><th>p99</th><th>max</th></tr>"
+            + "".join(rows) + "</table>"
+        ).encode()
+        return 200, "text/html", body, CORS_HEADERS
 
     def debug_stacks(self):
         """Per-thread stack dump — the live-pprof analog the reference

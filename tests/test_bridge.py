@@ -187,3 +187,117 @@ class TestShardedSimulate:
             assert doc["devices"] == 8
         finally:
             server.shutdown()
+
+
+class TestProvenanceBridge:
+    """PR 11: ``simulate(provenance=...)`` rides the record tracer
+    through the chunked pipeline.  One scan carries one extra stream,
+    so provenance excludes ``deltas_cap`` / ``trace`` / damping
+    prediction — each combination must fail loudly with a parseable
+    message, and every allowed combination must compose."""
+
+    def test_report_block_shape(self):
+        state = make_state(hosts=tuple(f"h{i}" for i in range(1, 8)),
+                           spn=3)
+        report = SimBridge(state, CFG).simulate(
+            rounds=30, cold_nodes=["h3"],
+            provenance={"count": 4})
+        doc = report.provenance
+        assert doc is not None
+        assert len(doc["records"]) == 4
+        for rec in doc["records"]:
+            assert rec["node"] in {f"h{i}" for i in range(1, 8)}
+            assert rec["service"] is not None
+        assert {"p50", "p95", "p99"} <= set(doc["lag"])
+        assert doc["tree"]
+
+    def test_chunked_equals_single_dispatch(self):
+        kw = dict(rounds=20, seed=3, cold_nodes=["h2"],
+                  provenance={"count": 3})
+        single = SimBridge(make_state(), CFG).simulate(**kw)
+        chunked_bridge = SimBridge(make_state(), CFG)
+        chunked_bridge.CHUNK_ROUNDS = 7     # force 7+7+6 chunks
+        chunked = chunked_bridge.simulate(**kw)
+        assert chunked.convergence == single.convergence
+        assert chunked.provenance == single.provenance
+
+    def test_traced_run_is_bit_identical_to_untraced(self):
+        plain = SimBridge(make_state(), CFG).simulate(
+            rounds=15, seed=5, cold_nodes=["h3"])
+        traced = SimBridge(make_state(), CFG).simulate(
+            rounds=15, seed=5, cold_nodes=["h3"],
+            provenance={"count": 2})
+        assert traced.convergence == plain.convergence
+        assert traced.projected == plain.projected
+        assert traced.eps_round == plain.eps_round
+
+    def test_services_selector(self):
+        report = SimBridge(make_state(), CFG).simulate(
+            rounds=10, provenance={"services": [
+                {"node": "h2", "service": "h2-svc1"}]})
+        recs = report.provenance["records"]
+        assert len(recs) == 1
+        assert recs[0]["node"] == "h2"
+        assert recs[0]["service"] == "h2-svc1"
+
+    def test_composes_with_sharded(self):
+        hosts = tuple(f"h{i}" for i in range(8))
+        report = SimBridge(make_state(hosts=hosts), CFG).simulate(
+            rounds=8, sharded=True, provenance={"count": 3})
+        assert len(report.provenance["records"]) == 3
+
+    @pytest.mark.parametrize("bad_kw, msg", [
+        (dict(deltas_cap=10), "deltas_cap"),
+        (dict(trace=5), "trace"),
+        (dict(protocol={"damping_threshold": 2.0}), "damping"),
+    ])
+    def test_exclusion_matrix(self, bad_kw, msg):
+        bridge = SimBridge(make_state(), CFG)
+        with pytest.raises(ValueError, match=msg):
+            bridge.simulate(rounds=5, provenance={"count": 2},
+                            **bad_kw)
+
+    @pytest.mark.parametrize("bad_req, exc, msg", [
+        ("not-an-object", ValueError, "must be an object"),
+        ({"tracers": 3}, ValueError, "unknown key"),
+        ({"count": 0}, ValueError, "count"),
+        ({"count": 2, "cap": -1}, ValueError, "cap"),
+        ({"services": []}, ValueError, "non-empty"),
+        ({"services": [{"node": "ghost", "service": "x"}]},
+         KeyError, "ghost"),
+        ({"services": [{"node": "h1", "service": "nope"}]},
+         KeyError, "h1/nope"),
+    ])
+    def test_bad_provenance_objects(self, bad_req, exc, msg):
+        bridge = SimBridge(make_state(), CFG)
+        with pytest.raises(exc, match=msg):
+            bridge.simulate(rounds=5, provenance=bad_req)
+
+    def test_http_round_trip_and_400_contract(self):
+        bridge = SimBridge(make_state(), CFG)
+        server = serve_bridge(bridge, port=0)
+        try:
+            port = server.server_address[1]
+
+            def post(payload):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/simulate",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read())
+
+            doc = post({"rounds": 10, "provenance": {"count": 2}})
+            assert len(doc["provenance"]["records"]) == 2
+            assert "lag" in doc["provenance"]
+
+            # The exclusion is a 400 with a parseable message, not a
+            # connection reset or a 500.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post({"rounds": 10, "trace": 4,
+                      "provenance": {"count": 2}})
+            assert err.value.code == 400
+            body = json.loads(err.value.read())
+            assert "mutually exclusive" in body["message"]
+        finally:
+            server.shutdown()

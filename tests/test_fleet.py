@@ -514,3 +514,38 @@ class TestSweepHttp:
                 assert doc["message"]
         finally:
             server.shutdown()
+
+    def test_provenance_column_and_spans(self):
+        """PR 11: tracers ride every fleet dispatch and each scenario
+        row ranks by tail propagation lag; the dispatch path is
+        span-instrumented end to end (docs/telemetry.md)."""
+        from sidecar_tpu.telemetry.span import reset_spans, spans
+
+        reset_spans()
+        doc = self._bridge().sweep(
+            axes={"fanout": [2, 3]}, rounds=40, eps=0.05, n=12,
+            services_per_node=2, budget=5, provenance=4)
+        assert doc["provenance"] == 4
+        for row in doc["table"]:
+            assert row["p99_lag_rounds"] is not None
+            assert 1 <= row["p99_lag_rounds"] <= 40
+        names = {s["name"] for s in spans()}
+        assert {"bridge.sweep.expand", "bridge.sweep.build",
+                "bridge.sweep.run", "bridge.sweep.pareto"} <= names
+        from sidecar_tpu import metrics
+        hist = metrics.snapshot()["histograms"]["bridge.sweep.points"]
+        assert hist["count"] >= 1 and hist["last_ms"] == 2.0
+
+    def test_provenance_zero_disables_column(self):
+        doc = self._bridge().sweep(
+            axes={"fanout": [2]}, rounds=20, eps=0.05, n=12,
+            services_per_node=2, budget=5, provenance=0)
+        assert doc["provenance"] == 0
+        assert all(row["p99_lag_rounds"] is None
+                   for row in doc["table"])
+
+    def test_negative_provenance_rejected(self):
+        with pytest.raises(ValueError, match="provenance"):
+            self._bridge().sweep(
+                axes={"fanout": [2]}, rounds=10, n=12,
+                services_per_node=2, provenance=-1)

@@ -12,7 +12,12 @@ import textwrap
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
                        / "tools"))
 
-from check_metric_docs import check, documented_names, emitted_names  # noqa: E402
+from check_metric_docs import (  # noqa: E402
+    check,
+    check_prometheus,
+    documented_names,
+    emitted_names,
+)
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
@@ -113,3 +118,60 @@ class TestDetection:
         docs_file = tmp_path / "metrics.md"
         docs_file.write_text(self.DOCS)
         assert check(tmp_path, docs_file) == []
+
+
+class TestPrometheusRendering:
+    """PR 11: the documented names must survive the REAL Prometheus
+    sanitizer as distinct, well-formed families — a rename that makes
+    two names collide after ``.``→``_`` breaks the scrape silently
+    unless this check catches it."""
+
+    def _docs(self, tmp_path, text):
+        docs_file = tmp_path / "metrics.md"
+        docs_file.write_text(textwrap.dedent(text))
+        return docs_file
+
+    def test_repo_docs_render_cleanly(self):
+        problems = check_prometheus(REPO / "docs" / "metrics.md")
+        assert problems == [], "\n".join(problems)
+
+    def test_flags_sanitization_collision(self, tmp_path):
+        docs = self._docs(tmp_path, """\
+            | `query.hub.published` | a |
+            | `query.hub_published` | b |
+            """)
+        problems = check_prometheus(docs)
+        assert len(problems) == 1
+        assert "collide" in problems[0]
+        assert "sidecar_query_hub_published" in problems[0]
+
+    def test_placeholders_substituted_before_render(self, tmp_path):
+        docs = self._docs(tmp_path, """\
+            | `propagation.<site>.lag` | lag |
+            | `sparse.mode.<m>` | mode |
+            """)
+        assert check_prometheus(docs) == []
+
+    def test_every_family_appears_in_exposition(self, tmp_path):
+        # A clean doc set round-trips through render_prometheus: every
+        # documented name yields its `sidecar_*_total` family line.
+        docs = self._docs(tmp_path, """\
+            | `bridge.sweep.points` | points |
+            | `slo.<rule>.ok` | verdict |
+            """)
+        assert check_prometheus(docs) == []
+
+    def test_cli_includes_prometheus_check(self, tmp_path):
+        docs = self._docs(tmp_path, """\
+            | `a.b.c` | one |
+            | `a.b_c` | two |
+            """)
+        src = tmp_path / "src"
+        src.mkdir()
+        proc = subprocess.run(
+            [sys.executable,
+             str(REPO / "tools" / "check_metric_docs.py"),
+             str(src), str(docs)],
+            capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "collide" in proc.stderr

@@ -82,8 +82,12 @@ class TestCatalogTimers:
             id="aaa111", name="web", image="w:1", hostname="h1",
             updated=T0, status=S.ALIVE,
             ports=[S.Port("tcp", 32768, 8080, "10.0.0.1")]))
-        grams = drain(statsd)
+        # Admission emits the propagation-lag histogram (PR 11) before
+        # the timer — drain both datagrams.
+        grams = drain(statsd, min_count=2)
         assert any(g.startswith("sidecar.addServiceEntry:")
+                   and g.endswith("|ms") for g in grams)
+        assert any(g.startswith("sidecar.propagation.catalog.lag:")
                    and g.endswith("|ms") for g in grams)
         assert metrics.snapshot()["timers"]["addServiceEntry"]["count"] >= 1
 

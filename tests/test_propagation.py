@@ -1,0 +1,390 @@
+"""PR 11 host-side provenance surfaces (docs/telemetry.md):
+
+* the span cursor (``telemetry/span.spans_since`` behind
+  ``GET /api/trace?since=``) — exact-once reads, forward paging, and
+  the dropped/never-wraps contract;
+* the live propagation meter (``telemetry/propagation.py``) — the sim
+  provenance plane's live twin at the catalog writer and QueryHub,
+  origin-cap overflow accounting, and the env gates;
+* the convergence-SLO evaluator (``telemetry/slo.py``) — rule parsing,
+  sim-side and live-side evaluation, gauge publication, and the
+  ``BENCH_SLO`` env contract;
+* the web exposition: ``/api/propagation.json``, ``/api/propagation``,
+  and the cursor round trip on ``/api/trace``.
+"""
+
+import json
+
+import pytest
+
+from sidecar_tpu import metrics
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState
+from sidecar_tpu.telemetry import propagation
+from sidecar_tpu.telemetry.slo import (
+    DEFAULT_RULES,
+    SloEvaluator,
+    SloRule,
+)
+from sidecar_tpu.telemetry.span import (
+    RING_CAPACITY,
+    reset_spans,
+    span,
+    spans_since,
+)
+from sidecar_tpu.web.api import SidecarApi
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+
+# -- the span cursor ---------------------------------------------------------
+
+class TestSpanCursor:
+    def setup_method(self):
+        reset_spans()
+
+    @staticmethod
+    def _cursor():
+        """seq keeps counting across reset_spans, so tests baseline at
+        the current position instead of assuming 0."""
+        return spans_since(0)["next_since"]
+
+    def test_exact_once_resume(self):
+        base = self._cursor()
+        for i in range(3):
+            with span(f"c{i}"):
+                pass
+        first = spans_since(base)
+        assert [s["name"] for s in first["spans"]] == ["c0", "c1", "c2"]
+        assert first["dropped"] == 0
+        # The resume cursor reads nothing until new spans complete.
+        again = spans_since(first["next_since"])
+        assert again["spans"] == []
+        assert again["next_since"] == first["next_since"]
+        with span("c3"):
+            pass
+        assert [s["name"] for s in
+                spans_since(first["next_since"])["spans"]] == ["c3"]
+
+    def test_limit_pages_forward(self):
+        base = self._cursor()
+        for i in range(5):
+            with span(f"p{i}"):
+                pass
+        cur, seen = base, []
+        while True:
+            page = spans_since(cur, limit=2)
+            if not page["spans"]:
+                break
+            seen += [s["name"] for s in page["spans"]]
+            cur = page["next_since"]
+        assert seen == [f"p{i}" for i in range(5)]
+
+    def test_ring_eviction_is_counted_not_silent(self):
+        base = self._cursor()
+        overrun = 7
+        for i in range(RING_CAPACITY + overrun):
+            with span("bulk"):
+                pass
+        doc = spans_since(base)
+        assert len(doc["spans"]) == RING_CAPACITY
+        assert doc["dropped"] == overrun
+
+    def test_seq_survives_reset(self):
+        with span("before"):
+            pass
+        cursor = spans_since(0)["next_since"]
+        reset_spans()
+        # Stale cursor stays valid on the empty ring: nothing new, no
+        # phantom drops, and the counter has NOT rewound.
+        doc = spans_since(cursor)
+        assert doc["spans"] == [] and doc["dropped"] == 0
+        assert doc["next_since"] == cursor
+        with span("after"):
+            pass
+        doc = spans_since(cursor)
+        assert [s["name"] for s in doc["spans"]] == ["after"]
+        assert doc["spans"][0]["seq"] > cursor
+
+    def test_negative_cursor_clamps(self):
+        base = self._cursor()
+        with span("neg"):
+            pass
+        names = [s["name"] for s in spans_since(-5)["spans"]]
+        assert "neg" in names
+        assert spans_since(base)["dropped"] == 0
+
+
+# -- the live propagation meter ----------------------------------------------
+
+class TestPropagationMeter:
+    def _meter(self, **kw):
+        kw.setdefault("enabled", True)
+        kw.setdefault("max_origins", 4)
+        return propagation.PropagationMeter(**kw)
+
+    def test_observe_and_snapshot(self):
+        m = self._meter()
+        for lag in (10.0, 20.0, 30.0):
+            m.observe("catalog", "h1", lag)
+        m.observe("query", "h2", 5.0)
+        doc = m.snapshot()
+        h1 = doc["sites"]["catalog"]["origins"]["h1"]
+        assert h1["count"] == 3
+        assert h1["mean_ms"] == 20.0
+        assert h1["last_ms"] == 30.0 and h1["max_ms"] == 30.0
+        assert h1["p50_ms"] == 20.0
+        assert doc["sites"]["query"]["origins"]["h2"]["count"] == 1
+        assert doc["sites"]["catalog"]["overflow_origins"] == 0
+
+    def test_negative_lag_clamps_to_zero(self):
+        m = self._meter()
+        m.observe("catalog", "h1", -50.0)
+        ent = m.snapshot()["sites"]["catalog"]["origins"]["h1"]
+        assert ent["last_ms"] == 0.0 and ent["max_ms"] == 0.0
+
+    def test_origin_cap_overflow_is_surfaced(self):
+        m = self._meter(max_origins=2)
+        for host in ("a", "b", "c", "d"):
+            m.observe("catalog", host, 1.0)
+        doc = m.snapshot()["sites"]["catalog"]
+        assert sorted(doc["origins"]) == ["a", "b"]
+        assert doc["overflow_origins"] == 2
+        # A capped-out origin still feeds ITS EXISTING series.
+        m.observe("catalog", "a", 2.0)
+        assert m.snapshot()["sites"]["catalog"]["origins"]["a"][
+            "count"] == 2
+
+    def test_disabled_gate(self):
+        m = self._meter(enabled=False)
+        m.observe("catalog", "h1", 10.0)
+        assert m.snapshot()["sites"] == {}
+
+    def test_pooled_histogram_feed(self):
+        before = metrics.snapshot().get("histograms", {}).get(
+            "propagation.catalog.lag", {}).get("count", 0)
+        self._meter().observe("catalog", "h1", 7.0)
+        after = metrics.snapshot()["histograms"][
+            "propagation.catalog.lag"]["count"]
+        assert after == before + 1
+
+    def test_env_gates(self, monkeypatch):
+        monkeypatch.setenv("SIDECAR_TPU_PROVENANCE", "0")
+        monkeypatch.setenv("SIDECAR_TPU_PROVENANCE_ORIGINS", "7")
+        m = propagation.PropagationMeter()
+        assert not m.enabled
+        assert m.max_origins == 7
+        monkeypatch.setenv("SIDECAR_TPU_PROVENANCE", "1")
+        monkeypatch.setenv("SIDECAR_TPU_PROVENANCE_ORIGINS", "junk")
+        m = propagation.PropagationMeter()
+        assert m.enabled
+        assert m.max_origins == propagation.DEFAULT_MAX_ORIGINS
+
+    def test_reset(self):
+        m = self._meter(max_origins=1)
+        m.observe("catalog", "a", 1.0)
+        m.observe("catalog", "b", 1.0)   # overflow
+        m.reset()
+        assert m.snapshot()["sites"] == {}
+
+
+class TestLiveSites:
+    """The real wiring: the catalog writer and QueryHub record into the
+    process-global meter per admitted record."""
+
+    def setup_method(self):
+        propagation.meter.reset()
+        propagation.configure(enabled=True)
+
+    def teardown_method(self):
+        propagation.meter.reset()
+        propagation.configure()
+
+    def test_catalog_and_query_sites_observe(self):
+        state = ServicesState(hostname="h1")
+        state.set_clock(lambda: T0)
+        state.query_hub()    # attach the hub → the query site is live
+        # A remote record stamped 2 s before merge time.
+        state.add_service_entry(S.Service(
+            id="r1", name="web", image="i:1", hostname="h2",
+            updated=T0 - 2 * NS, status=S.ALIVE))
+        doc = propagation.snapshot()
+        cat = doc["sites"]["catalog"]["origins"]["h2"]
+        assert cat["count"] == 1
+        assert cat["last_ms"] == pytest.approx(2000.0)
+        # The query site stamps against the real wall clock; the exact
+        # value is huge against the synthetic T0 — presence + origin
+        # attribution are the contract here.
+        assert doc["sites"]["query"]["origins"]["h2"]["count"] == 1
+
+    def test_own_records_are_a_zero_lag_baseline(self):
+        state = ServicesState(hostname="h1")
+        state.set_clock(lambda: T0)
+        state.add_service_entry(S.Service(
+            id="own", name="web", image="i:1", hostname="h1",
+            updated=T0, status=S.ALIVE))
+        own = propagation.snapshot()["sites"]["catalog"]["origins"]["h1"]
+        assert own["count"] == 1 and own["last_ms"] == 0.0
+
+
+# -- the SLO evaluator -------------------------------------------------------
+
+class TestSloRules:
+    def test_parse_and_key(self):
+        r = SloRule.parse("p99 <= 16 rounds")
+        assert (r.percentile, r.threshold, r.unit) == ("p99", 16.0,
+                                                       "rounds")
+        assert r.key == "p99_16rounds"
+        assert SloRule.parse("p95<=1.5s").key == "p95_1_5s"
+        assert SloRule.parse("max <= 250 MS").unit == "ms"
+        assert SloRule.parse("p50 <= 3 seconds").unit == "s"
+
+    def test_bad_rule_rejected(self):
+        for bad in ("p42 <= 1 rounds", "p99 >= 1 rounds",
+                    "p99 <= rounds", "p99 <= 1 fortnights", ""):
+            with pytest.raises(ValueError, match="bad SLO rule"):
+                SloRule.parse(bad)
+
+
+class TestSloEvaluator:
+    LAG = {"samples": 100, "p50": 3, "p95": 7, "p99": 9, "max": 12}
+
+    def test_sim_rounds_rule_pass_and_fail(self):
+        block = SloEvaluator(["p99 <= 16 rounds"]).evaluate_lag(
+            self.LAG, publish=False)
+        assert block["pass"] is True
+        assert block["rules"][0]["observed"] == 9.0
+        block = SloEvaluator(["p99 <= 8 rounds"]).evaluate_lag(
+            self.LAG, publish=False)
+        assert block["pass"] is False
+
+    def test_time_rule_needs_the_protocol_clock(self):
+        ev = SloEvaluator(["p99 <= 2 s"])
+        # No seconds_per_round → the rule cannot be evaluated, and an
+        # unevaluable rule NEVER passes silently.
+        block = ev.evaluate_lag(self.LAG, publish=False)
+        assert block["pass"] is None and block["evaluated"] == 0
+        block = ev.evaluate_lag(self.LAG, seconds_per_round=0.2,
+                                publish=False)
+        assert block["pass"] is True    # 9 rounds × 0.2 s = 1.8 s
+        block = ev.evaluate_lag(self.LAG, seconds_per_round=0.3,
+                                publish=False)
+        assert block["pass"] is False   # 2.7 s
+
+    def test_empty_lag_is_null_verdict(self):
+        ev = SloEvaluator(DEFAULT_RULES)
+        for lag in (None, {"samples": 0}):
+            block = ev.evaluate_lag(lag, seconds_per_round=0.2,
+                                    publish=False)
+            assert block["pass"] is None
+
+    def test_gauges_published(self):
+        block = SloEvaluator(["p99 <= 16 rounds"]).evaluate_lag(
+            self.LAG)
+        assert block["pass"] is True
+        gauges = metrics.snapshot()["gauges"]
+        assert gauges["slo.p99_16rounds.observed"] == 9.0
+        assert gauges["slo.p99_16rounds.ok"] == 1.0
+
+    def test_evaluate_live_reads_query_histogram(self, monkeypatch):
+        # The process-global registry accumulates across tests (other
+        # suites feed real wall-clock lags into the same histogram), so
+        # pin the snapshot the evaluator reads.
+        monkeypatch.setattr(
+            "sidecar_tpu.metrics.snapshot",
+            lambda: {"histograms": {"propagation.query.lag": {
+                "count": 10, "p99_ms": 200.0, "max_ms": 250.0}}})
+        block = SloEvaluator(
+            ["p99 <= 2 s", "p99 <= 16 rounds"]).evaluate_live(
+            publish=False)
+        by_unit = {v["unit"]: v for v in block["rules"]}
+        # The seconds rule evaluates against the pooled histogram...
+        assert by_unit["s"]["pass"] is True
+        assert by_unit["s"]["observed"] <= 2.0
+        # ...rounds rules are sim-only on the live path.
+        assert by_unit["rounds"]["pass"] is None
+
+    def test_from_env_contract(self, monkeypatch):
+        monkeypatch.setenv("BENCH_SLO", "0")
+        assert SloEvaluator.from_env() is None
+        monkeypatch.setenv("BENCH_SLO", "1")
+        monkeypatch.delenv("BENCH_SLO_RULES", raising=False)
+        ev = SloEvaluator.from_env()
+        assert tuple(r.text() for r in ev.rules) == tuple(
+            SloRule.parse(r).text() for r in DEFAULT_RULES)
+        monkeypatch.setenv("BENCH_SLO_RULES",
+                           "p50 <= 4 rounds , p95 <= 900 ms")
+        ev = SloEvaluator.from_env()
+        assert [r.key for r in ev.rules] == ["p50_4rounds",
+                                             "p95_900ms"]
+
+
+# -- web exposition ----------------------------------------------------------
+
+def make_api(**kw):
+    state = ServicesState(hostname="h1")
+    state.set_clock(lambda: T0)
+    for key, val in kw.items():
+        setattr(state, key, val)
+    state.add_service_entry(S.Service(
+        id="aaa111", name="web", image="img:1", hostname="h1",
+        updated=T0, status=S.ALIVE))
+    return SidecarApi(state, members_fn=lambda: ["h1"],
+                      cluster_name="test-cluster")
+
+
+class TestPropagationEndpoints:
+    def setup_method(self):
+        propagation.meter.reset()
+        propagation.configure(enabled=True)
+
+    def teardown_method(self):
+        propagation.meter.reset()
+        propagation.configure()
+
+    def test_propagation_json(self):
+        api = make_api()   # the add_service_entry observed h1@catalog
+        status, ctype, body, _ = api.dispatch(
+            "GET", "/api/propagation.json")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["sites"]["catalog"]["origins"]["h1"]["count"] == 1
+        assert "slo" not in doc   # no evaluator attached
+
+    def test_propagation_json_with_slo_block(self, monkeypatch):
+        monkeypatch.setattr(
+            "sidecar_tpu.metrics.snapshot",
+            lambda: {"histograms": {"propagation.query.lag": {
+                "count": 4, "p99_ms": 100.0, "max_ms": 120.0}}})
+        api = make_api(slo_evaluator=SloEvaluator(["p99 <= 2 s"]))
+        _, _, body, _ = api.dispatch("GET", "/api/propagation.json")
+        doc = json.loads(body)
+        assert doc["slo"]["pass"] is True
+
+    def test_propagation_html(self):
+        api = make_api()
+        status, ctype, body, _ = api.dispatch("GET",
+                                              "/api/propagation")
+        assert status == 200 and ctype.startswith("text/html")
+        text = body.decode()
+        assert "catalog" in text and "h1" in text
+
+    def test_trace_cursor_round_trip(self):
+        reset_spans()
+        api = make_api()   # add_service_entry → a catalog.merge span
+        _, _, body, _ = api.dispatch("GET", "/api/trace",
+                                     {"since": ["0"]})
+        doc = json.loads(body)
+        assert any(s["name"] == "catalog.merge" for s in doc["spans"])
+        cursor = doc["next_since"]
+        _, _, body, _ = api.dispatch("GET", "/api/trace",
+                                     {"since": [str(cursor)]})
+        assert json.loads(body)["spans"] == []
+
+    def test_bad_cursor_is_400(self):
+        api = make_api()
+        status, _, body, _ = api.dispatch("GET", "/api/trace",
+                                          {"since": ["banana"]})
+        assert status == 400
+        assert "cursor" in json.loads(body)["message"]

@@ -1,0 +1,368 @@
+"""Record-level propagation provenance (ops/provenance.py,
+docs/telemetry.md).
+
+Centerpieces:
+
+* **NumPy-oracle lockstep** — the kernel's scatter-min attribution vs
+  :class:`sim.oracle.ProvenanceOracle`, the sequential re-implementation
+  of the minimal-(hops, node id) rule, fed the SAME holder matrices and
+  channel lists.  ``first_seen`` / ``parent`` / ``hops`` / ``coverage``
+  must match element-for-element, on both single-chip families and on
+  the sharded twin (whose channels replay per-shard PRNG streams).
+* **Bit-identity** — provenance-enabled runs must leave the state and
+  the convergence curve bit-identical to untraced runs on every family
+  (the plane only re-derives channels; it never touches step tensors).
+* **Chunking** — a run split across chunks with the ProvTrace chained
+  must equal the straight run (absolute rounds in the carry).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sidecar_tpu.chaos import ChaosExactSim, EdgeFault, FaultPlan, NodeFault
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import provenance as prov_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.parallel.mesh import make_mesh
+from sidecar_tpu.parallel.sharded import ShardedSim
+from sidecar_tpu.parallel.sharded_compressed import ShardedCompressedSim
+from sidecar_tpu.sim.oracle import ProvenanceOracle
+
+# Refresh far out (cold-start propagation has a fixed target), push-pull
+# on a short cadence so the stride/partner channels are exercised.
+CFG = TimeConfig(refresh_interval_s=10_000.0, push_pull_interval_s=2.0)
+
+N, SPN = 12, 2
+TRACKED = prov_ops.default_tracked(N * SPN, 4)
+
+
+def exact_sim(topo=None, **kw):
+    params = SimParams(n=N, services_per_node=SPN, fanout=2, budget=4,
+                       **kw)
+    return ExactSim(params, topo or topology.complete(N), CFG)
+
+
+def compressed_sim(**kw):
+    params = CompressedParams(n=N, services_per_node=SPN, fanout=2,
+                              budget=4, cache_lines=16, **kw)
+    return CompressedSim(params, topology.complete(N), CFG)
+
+
+def lockstep_oracle(sim, state, key, rounds, tracked):
+    """Step the sim one round at a time (the no-donate probe), deriving
+    each round's channels from the very key the step folds, and feed
+    the NumPy oracle."""
+    tr = jnp.asarray(tracked, jnp.int32)
+    orc = ProvenanceOracle(np.asarray(sim._prov_belief(state, tr)),
+                           int(state.round_idx))
+    st = state
+    for _ in range(rounds):
+        k = jax.random.fold_in(key, st.round_idx)
+        st2 = sim.step(st, k)
+        pushes, pulls = sim._prov_channels(st, k)
+        orc.observe(
+            orc.holders(np.asarray(sim._prov_belief(st, tr))),
+            orc.holders(np.asarray(sim._prov_belief(st2, tr))),
+            int(st2.round_idx), pushes, pulls)
+        st = st2
+    return orc
+
+
+def assert_matches_oracle(pv, orc, rounds):
+    np.testing.assert_array_equal(np.asarray(pv.first_seen),
+                                  orc.first_seen)
+    np.testing.assert_array_equal(np.asarray(pv.parent), orc.parent)
+    np.testing.assert_array_equal(np.asarray(pv.hops), orc.hops)
+    assert int(pv.count) == rounds
+    np.testing.assert_array_equal(np.asarray(pv.coverage)[:rounds],
+                                  np.asarray(orc.coverage))
+
+
+# -- oracle lockstep ---------------------------------------------------------
+
+@pytest.mark.parametrize("topo_kind", ["complete", "ring"])
+def test_exact_matches_oracle(topo_kind):
+    topo = (topology.complete(N) if topo_kind == "complete"
+            else topology.ring(N, 2))
+    sim = exact_sim(topo)
+    state = sim.init_state()
+    key = jax.random.PRNGKey(5)
+    rounds = 10
+    orc = lockstep_oracle(sim, state, key, rounds, TRACKED)
+    _, pv, _ = sim.run_with_provenance(state, key, rounds, TRACKED,
+                                       donate=False)
+    assert_matches_oracle(pv, orc, rounds)
+
+
+def test_compressed_matches_oracle():
+    sim = compressed_sim()
+    st = sim.init_state()
+    key = jax.random.PRNGKey(2)
+    st = sim.run(st, key, 4, donate=False)[0]
+    # Mint fresh versions so there is a propagating wave to attribute
+    # (the converged floor copies are below the traced ref).
+    st = sim.mint(st, np.asarray(TRACKED),
+                  now_tick=int(st.round_idx) * sim.t.round_ticks + 1)
+    key2 = jax.random.PRNGKey(9)
+    rounds = 10
+    orc = lockstep_oracle(sim, st, key2, rounds, TRACKED)
+    _, pv = sim.run_with_provenance(st, key2, rounds, TRACKED,
+                                    donate=False)
+    assert_matches_oracle(pv, orc, rounds)
+
+
+def test_sharded_matches_oracle():
+    params = SimParams(n=16, services_per_node=SPN, fanout=2, budget=4)
+    sim = ShardedSim(params, topology.complete(16), CFG,
+                     mesh=make_mesh(jax.devices()[:2]))
+    state = sim.init_state()
+    key = jax.random.PRNGKey(13)
+    rounds = 8
+    tracked = prov_ops.default_tracked(16 * SPN, 4)
+    orc = lockstep_oracle(sim, state, key, rounds, tracked)
+    _, pv, _ = sim.run_with_provenance(state, key, rounds, tracked,
+                                       donate=False)
+    assert_matches_oracle(pv, orc, rounds)
+
+
+# -- bit-identity: traced runs never perturb the run -------------------------
+
+def test_exact_traced_is_bit_identical():
+    sim = exact_sim()
+    state = sim.init_state()
+    key = jax.random.PRNGKey(0)
+    f0, conv0 = sim.run(state, key, 12, donate=False)
+    f1, pv, conv1 = sim.run_with_provenance(state, key, 12, TRACKED,
+                                            donate=False)
+    assert jnp.array_equal(f0.known, f1.known)
+    assert jnp.array_equal(f0.sent, f1.sent)
+    assert jnp.array_equal(conv0, conv1)
+    # Sparse drivers produce the identical trace.
+    f2, pv2, conv2 = sim.run_with_provenance(state, key, 12, TRACKED,
+                                             donate=False, sparse=True)
+    assert jnp.array_equal(f1.known, f2.known)
+    assert jnp.array_equal(conv1, conv2)
+    np.testing.assert_array_equal(np.asarray(pv.first_seen),
+                                  np.asarray(pv2.first_seen))
+    np.testing.assert_array_equal(np.asarray(pv.parent),
+                                  np.asarray(pv2.parent))
+
+
+def test_compressed_traced_is_bit_identical():
+    sim = compressed_sim()
+    st = sim.init_state()
+    key = jax.random.PRNGKey(4)
+    st = sim.mint(st, np.asarray(TRACKED), now_tick=1)
+    f0, _ = sim.run(st, key, 10, donate=False)
+    f1, _pv = sim.run_with_provenance(st, key, 10, TRACKED,
+                                      donate=False)
+    for fld in ("own", "floor", "cache_slot", "cache_val", "cache_sent"):
+        assert jnp.array_equal(getattr(f0, fld), getattr(f1, fld)), fld
+
+
+def test_chaos_traced_is_bit_identical_and_attributes():
+    plan = FaultPlan(
+        seed=4,
+        edges=(EdgeFault(drop_prob=0.3, delay_rounds=2, delay_prob=0.2),),
+        nodes=(NodeFault(nodes=(2,), start_round=3, end_round=8,
+                         kind="pause"),))
+    params = SimParams(n=N, services_per_node=SPN, fanout=3, budget=8)
+    sim = ChaosExactSim(params, topology.complete(N), CFG, plan=plan)
+    state = sim.init_state()
+    key = jax.random.PRNGKey(1)
+    f0, conv0 = sim.run(state, key, 14, donate=False)
+    f1, pv, conv1 = sim.run_with_provenance(state, key, 14, TRACKED,
+                                            donate=False)
+    assert jnp.array_equal(f0.sim.known, f1.sim.known)
+    assert jnp.array_equal(conv0, conv1)
+    parent = np.asarray(pv.parent)
+    assert parent.min() >= prov_ops.PARENT_UNATTRIBUTED
+    assert parent.max() < N
+    # Blast-radius accounting over the faulted origin set.
+    br = prov_ops.blast_radius(pv, TRACKED, SPN, origin_nodes=(2,))
+    assert br["origins"] == [2]
+    for rec in br["records"]:
+        assert rec["origin_node"] == 2
+        assert 0.0 <= rec["reach_fraction"] <= 1.0
+
+
+@pytest.mark.parametrize("d", [1, 2, 4, 8])
+@pytest.mark.parametrize("board_exchange", ["all_gather", "ring"])
+def test_sharded_traced_is_bit_identical(d, board_exchange):
+    n = 16
+    params = SimParams(n=n, services_per_node=SPN, fanout=2, budget=4)
+    sim = ShardedSim(params, topology.complete(n), CFG,
+                     mesh=make_mesh(jax.devices()[:d]),
+                     board_exchange=board_exchange)
+    state = sim.init_state()
+    key = jax.random.PRNGKey(7)
+    tracked = prov_ops.default_tracked(n * SPN, 3)
+    f0, conv0 = sim.run(state, key, 10, donate=False)
+    f1, pv, conv1 = sim.run_with_provenance(state, key, 10, tracked,
+                                            donate=False)
+    assert jnp.array_equal(f0.known, f1.known)
+    assert jnp.array_equal(f0.sent, f1.sent)
+    assert jnp.array_equal(conv0, conv1)
+    fs = np.asarray(pv.first_seen)
+    assert (fs >= 0).all(), "complete graph, 10 rounds: all reached"
+
+
+def test_sharded_compressed_traced_is_bit_identical():
+    n = 16
+    params = CompressedParams(n=n, services_per_node=SPN, fanout=2,
+                              budget=4, cache_lines=16)
+    sim = ShardedCompressedSim(params, topology.complete(n), CFG,
+                               mesh=make_mesh(jax.devices()[:4]))
+    st = sim.init_state()
+    tracked = prov_ops.default_tracked(n * SPN, 3)
+    st = sim.mint(st, np.asarray(tracked), now_tick=1)
+    key = jax.random.PRNGKey(6)
+    f0, _ = sim.run(st, key, 10, donate=False)
+    f1, pv = sim.run_with_provenance(st, key, 10, tracked,
+                                     donate=False)
+    for fld in ("own", "floor", "cache_slot", "cache_val", "cache_sent"):
+        assert jnp.array_equal(getattr(f0, fld), getattr(f1, fld)), fld
+    assert (np.asarray(pv.first_seen) >= 0).any()
+
+
+# -- chunking ----------------------------------------------------------------
+
+def test_chunked_provenance_equals_straight():
+    sim = exact_sim()
+    state = sim.init_state()
+    key = jax.random.PRNGKey(3)
+    _, pv_all, _ = sim.run_with_provenance(state, key, 12, TRACKED,
+                                           donate=False)
+    mid, pv, _ = sim.run_with_provenance(state, key, 5, TRACKED,
+                                         cap=12, donate=False)
+    _, pv2, _ = sim.run_with_provenance(mid, key, 7, TRACKED,
+                                        prov=pv, donate=False)
+    for fld in ("ref", "first_seen", "parent", "hops", "coverage",
+                "count"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(pv_all, fld)),
+            np.asarray(getattr(pv2, fld)), err_msg=fld)
+
+
+# -- carry semantics ---------------------------------------------------------
+
+def test_origin_seeding_and_ref():
+    sim = exact_sim()
+    state = sim.init_state()
+    tr = jnp.asarray(TRACKED, jnp.int32)
+    pv = prov_ops.zero_prov(len(TRACKED), N, 4)
+    pv = prov_ops.seed(pv, sim._prov_belief(state, tr), state.round_idx)
+    fs = np.asarray(pv.first_seen)
+    parent = np.asarray(pv.parent)
+    hops = np.asarray(pv.hops)
+    for ti, slot in enumerate(TRACKED):
+        owner = slot // SPN
+        assert fs[ti, owner] == 0
+        assert parent[ti, owner] == prov_ops.PARENT_ORIGIN
+        assert hops[ti, owner] == 0
+        others = np.delete(np.arange(N), owner)
+        assert (fs[ti, others] == -1).all()
+
+
+def test_coverage_overflow_flag():
+    sim = exact_sim()
+    state = sim.init_state()
+    key = jax.random.PRNGKey(8)
+    _, pv, _ = sim.run_with_provenance(state, key, 6, TRACKED, cap=3,
+                                       donate=False)
+    assert bool(pv.overflow)
+    assert int(pv.count) == 6
+    # first_seen stays exact past the coverage window: infections in
+    # rounds > cap are still recorded.
+    assert (np.asarray(pv.first_seen) > 3).any()
+
+
+def test_run_with_provenance_validates_tracked():
+    sim = exact_sim()
+    state = sim.init_state()
+    key = jax.random.PRNGKey(0)
+    with pytest.raises(ValueError):
+        sim.run_with_provenance(state, key, 2, (), donate=False)
+    with pytest.raises(ValueError):
+        sim.run_with_provenance(state, key, 2, (N * SPN,), donate=False)
+
+
+# -- host-side reductions ----------------------------------------------------
+
+def test_default_tracked_spread():
+    assert prov_ops.default_tracked(100, 4) == (0, 33, 66, 99)
+    assert prov_ops.default_tracked(3, 8) == (0, 1, 2)
+    assert prov_ops.default_tracked(0, 4) == ()
+    assert prov_ops.default_tracked(10, 1) == (0,)
+
+
+def test_summarize_and_tree():
+    sim = exact_sim()
+    state = sim.init_state()
+    key = jax.random.PRNGKey(5)
+    _, pv, _ = sim.run_with_provenance(state, key, 12, TRACKED,
+                                       donate=False)
+    summ = prov_ops.summarize(pv, TRACKED, SPN)
+    assert summ["tracked"] == list(TRACKED)
+    assert summ["rounds_observed"] == 12
+    assert summ["lag"]["samples"] > 0
+    assert summ["lag"]["p50"] <= summ["lag"]["p99"]
+    for rec in summ["records"]:
+        assert rec["reached"] == N
+        assert rec["origin_round"] == 0
+        assert rec["rounds_to_reach_all"] is not None
+        assert sum(rec["hop_histogram"]) == N
+    tree = prov_ops.tree_to_dict(pv, TRACKED)
+    assert len(tree) == len(TRACKED)
+    for rec in tree:
+        assert len(rec["first_seen"]) == N
+        assert len(rec["parent"]) == N
+
+
+def test_fleet_first_seen_matches_unbatched():
+    """The fleet plane's carried first_seen equals the unbatched
+    run_with_provenance stream per scenario, and the table grows the
+    p99 lag column."""
+    from sidecar_tpu.fleet.batch import ScenarioBatch, ScenarioSpec
+    from sidecar_tpu.fleet.engine import FleetSim
+
+    params = SimParams(n=16, services_per_node=2, fanout=3, budget=5)
+    specs = (ScenarioSpec(name="plain", seed=1),
+             ScenarioSpec(name="lossy", seed=2, drop_prob=0.15))
+    batch = ScenarioBatch.build(specs, params, CFG, family="exact")
+    fleet = FleetSim(batch)
+    tracked = prov_ops.default_tracked(params.m, 4)
+    run = fleet.run(fleet.init_states(), 20, eps=0.01, stop=False,
+                    tracked=tracked)
+    assert run.first_seen.shape == (2, len(tracked), 16)
+    for i, spec in enumerate(specs):
+        sim = ExactSim(batch.scenario_params(i),
+                       topology.complete(params.n),
+                       batch.scenario_timecfg(i))
+        _, pv, _ = sim.run_with_provenance(
+            sim.init_state(), jax.random.PRNGKey(spec.seed), 20,
+            tracked, donate=False)
+        np.testing.assert_array_equal(run.first_seen[i],
+                                      np.asarray(pv.first_seen),
+                                      err_msg=spec.name)
+    rows = run.table(CFG.round_ticks, CFG.ticks_per_second)
+    for row in rows:
+        assert row["p99_lag_rounds"] is not None
+    # Untraced runs keep the old arity and a None column.
+    run0 = fleet.run(fleet.init_states(), 20, eps=0.01, stop=False)
+    assert run0.table(CFG.round_ticks,
+                      CFG.ticks_per_second)[0]["p99_lag_rounds"] is None
+
+
+def test_pooled_lag_empty():
+    fs = np.full((2, 5), -1)
+    out = prov_ops.pooled_lag(fs)
+    assert out["samples"] == 0
+    assert out["p99"] is None
+    assert prov_ops.p99_lag_rounds(fs) is None

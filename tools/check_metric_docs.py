@@ -118,17 +118,77 @@ def check(src_root: pathlib.Path, docs_file: pathlib.Path) -> list[str]:
     return problems
 
 
+_PROM_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def check_prometheus(docs_file: pathlib.Path) -> list[str]:
+    """Sanitization-drift check (PR 11): every documented metric name
+    must render to a well-formed, COLLISION-FREE Prometheus family
+    through the REAL exposition pipeline
+    (``sidecar_tpu.telemetry.prometheus``).
+
+    The ``/metrics`` scrape names are derived, not documented — an
+    operator looks up ``sidecar_query_hub_published_total`` by
+    mentally reversing the sanitizer.  That reversal only works while
+    sanitization stays injective over the documented set: if a rename
+    (or a sanitizer change) maps two documented names onto one family,
+    Prometheus rejects the duplicate family or silently merges
+    series, and nothing else in the build notices.  So this check
+    substitutes placeholder names (``<x>`` → ``x``), renders ALL
+    documented names through ``render_prometheus`` as counters, and
+    fails on invalid family names, collisions, or a renderer that
+    stops emitting a documented name."""
+    here = pathlib.Path(__file__).resolve().parent.parent
+    sys.path.insert(0, str(here))
+    from sidecar_tpu.telemetry.prometheus import (  # noqa: E402
+        _sanitize,
+        render_prometheus,
+    )
+
+    tokens = documented_names(docs_file.read_text())
+    concrete = sorted({re.sub(r"<[^>]*>", "x", t) for t in tokens})
+    problems = []
+    families: dict[str, str] = {}
+    for name in concrete:
+        family = _sanitize(name)
+        if not _PROM_NAME.fullmatch(family):
+            problems.append(
+                f"{docs_file.name}: `{name}` sanitizes to invalid "
+                f"Prometheus family {family!r}")
+            continue
+        if family in families:
+            problems.append(
+                f"{docs_file.name}: `{name}` and "
+                f"`{families[family]}` collide on Prometheus family "
+                f"{family!r} after sanitization")
+            continue
+        families[family] = name
+    # End-to-end: the renderer must expose every documented name.  A
+    # synthetic counters-only snapshot is enough — sanitization is
+    # kind-independent, and counters exercise the `_total` suffixing.
+    rendered = render_prometheus(
+        {"counters": {name: 1 for name in concrete}})
+    exposed = {line.split()[0] for line in rendered.splitlines()
+               if line and not line.startswith("#")}
+    for family, name in sorted(families.items()):
+        if f"{family}_total" not in exposed:
+            problems.append(
+                f"{docs_file.name}: `{name}` did not render to "
+                f"{family}_total in the Prometheus exposition")
+    return problems
+
+
 def main(argv: list[str]) -> int:
     here = pathlib.Path(__file__).resolve().parent.parent
     src = pathlib.Path(argv[1]) if len(argv) > 1 else here / "sidecar_tpu"
     docs = pathlib.Path(argv[2]) if len(argv) > 2 else \
         here / "docs" / "metrics.md"
-    problems = check(src, docs)
+    problems = check(src, docs) + check_prometheus(docs)
     for p in problems:
         print(p, file=sys.stderr)
     if problems:
-        print(f"{len(problems)} undocumented metric name(s) — add them "
-              f"to {docs}", file=sys.stderr)
+        print(f"{len(problems)} metric-doc problem(s) — fix them "
+              f"against {docs}", file=sys.stderr)
         return 1
     print(f"check_metric_docs: OK ({src} vs {docs})")
     return 0
