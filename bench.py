@@ -80,7 +80,7 @@ import time
 # SIGALRM when BENCH_WATCHDOG_S arms a self-timer below the harness
 # deadline), then exits 124.
 
-_WATCHDOG: dict = {"phase": "init", "partial": None}
+_WATCHDOG: dict = {"phase": "init", "partial": None, "deadline": None}
 
 
 def _watchdog_note(phase: str, partial=None) -> None:
@@ -112,6 +112,20 @@ def install_watchdog() -> None:
     if alarm_s > 0:
         signal.signal(signal.SIGALRM, _watchdog_handler)
         signal.alarm(alarm_s)
+        # Remembered so host-side sleeps (the device-init retry) can
+        # bound themselves by the remaining budget instead of sleeping
+        # through the deadline (BENCH_r05 postmortem, part 3).
+        _WATCHDOG["deadline"] = time.monotonic() + alarm_s
+
+
+def watchdog_budget_s():
+    """Seconds left before the self-timer fires, or None when unarmed
+    (no BENCH_WATCHDOG_S) — the bound host-side retry sleeps must
+    respect."""
+    deadline = _WATCHDOG.get("deadline")
+    if deadline is None:
+        return None
+    return max(0.0, deadline - time.monotonic())
 
 
 def disarm_watchdog() -> None:
@@ -436,6 +450,132 @@ def _bench_north_star(n, spn, churn_frac, eps, conv_every, max_rounds,
     return out
 
 
+def _bench_cost(n, spn, dense_rps=None, compressed_rps=None,
+                north_star=None, trace_dir=None):
+    """The kernel-cost block (docs/perf.md): compile each single-chip
+    family's step ONCE with phase scopes on (a fresh jit wrapper — the
+    production programs and caches are untouched) and report where the
+    compiled bytes, FLOPs, and HBM go, with the per-phase shares
+    reconciled against the measured ms/round.
+
+    Attribution is static (compiled-output-bytes per ``sidecar.phase``
+    metadata label); when this run also captured a profiler trace, the
+    trace's per-phase device-time reduction and its reconciliation
+    against the north star's wall ms/round ride along."""
+    import jax
+
+    from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+    from sidecar_tpu.models.exact import ExactSim, SimParams
+    from sidecar_tpu.models.timecfg import TimeConfig
+    from sidecar_tpu.ops.topology import erdos_renyi
+    from sidecar_tpu.telemetry import cost
+
+    # Probe shape: every phase's cost is linear in the same [N, M]
+    # state, so the byte SHARES are scale-stable and the probe compiles
+    # at a bounded size — compile time, not attribution accuracy, is
+    # what scales with N.
+    cn = min(n, int(os.environ.get("BENCH_COST_NODES", "1024")))
+    key = jax.random.PRNGKey(0)
+
+    exact = ExactSim(SimParams(n=cn, services_per_node=spn, fanout=3,
+                               budget=15),
+                     erdos_renyi(cn, avg_degree=8.0, seed=3))
+    ex_state = exact.init_state()
+    cfg = TimeConfig(refresh_interval_s=10_000.0,
+                     push_pull_interval_s=4.0)
+    comp = CompressedSim(
+        CompressedParams(n=cn, services_per_node=spn, fanout=3,
+                         budget=15, cache_lines=256, deep_sweep_every=0),
+        erdos_renyi(cn, avg_degree=8.0, seed=3), cfg)
+    co_state = comp.init_state()
+
+    measured = {
+        "exact.step": (1000.0 / dense_rps) if dense_rps else None,
+        "compressed.step": (1000.0 / compressed_rps)
+        if compressed_rps else None,
+    }
+    out = {"probe_nodes": cn,
+           "attribution": "compiled-output-bytes (docs/perf.md)",
+           "programs": {}, "reconciliation": {}}
+    with cost.forced_phases(True):
+        probes = {
+            "exact.step": (lambda st, k: exact._step(st, k),
+                           (ex_state, key)),
+            "compressed.step": (lambda st, k: comp._step(st, k),
+                                (co_state, key)),
+        }
+        for fam, (fn, args) in probes.items():
+            rep = cost.program_report(fam, fn, *args)
+            prog = {k: rep[k] for k in ("lower_ms", "compile_ms",
+                                        "flops", "bytes_accessed")
+                    if k in rep}
+            if "memory" in rep:
+                prog["hbm_peak_bytes"] = rep["memory"]["peak_bytes"]
+                prog["hbm"] = rep["memory"]
+            if "collectives" in rep:
+                prog["collectives"] = rep["collectives"]
+            out["programs"][fam] = prog
+            table = cost.phase_share_table(rep.get("phase_bytes", {}),
+                                           measured[fam])
+            out["reconciliation"][fam] = {
+                "measured_ms_per_round":
+                    round(measured[fam], 4) if measured[fam] else None,
+                "phases": table["phases"],
+                "attributed_fraction": table["attributed_fraction"],
+                "min_attributed_fraction":
+                    cost.MIN_ATTRIBUTED_FRACTION,
+                "within_tolerance": (table["attributed_fraction"]
+                                     >= cost.MIN_ATTRIBUTED_FRACTION),
+            }
+    if trace_dir and os.path.isdir(trace_dir):
+        prof = cost.parse_profile_dir(trace_dir)
+        out["profile"] = prof
+        if north_star and prof.get("attributed_ms"):
+            rr = north_star.get("rounds_executed")
+            wmr = north_star.get("wall_ms_per_round")
+            if rr and wmr:
+                out["profile_reconciliation"] = cost.reconcile(
+                    prof["attributed_ms"] / rr, wmr)
+    out["compile"] = cost.snapshot()["compile"]
+    cost.record_report("bench.cost", out)
+    return out
+
+
+def _bench_regression(record):
+    """Verdict vs the previous bench record (tools/bench_compare):
+    BENCH_COMPARE names the baseline record (or a directory of them —
+    newest wins); unset, the newest ``BENCH_r*.json`` next to bench.py
+    is used; ``0`` disables.  Returns None when there is nothing to
+    compare against."""
+    target = os.environ.get("BENCH_COMPARE")
+    if target == "0":
+        return None
+    import glob as _glob
+    import importlib.util as _ilu
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    if target and os.path.isdir(target):
+        hits = sorted(_glob.glob(os.path.join(target, "BENCH_*.json")))
+        prev_path = hits[-1] if hits else None
+    elif target:
+        prev_path = target
+    else:
+        hits = sorted(_glob.glob(os.path.join(root, "BENCH_r*.json")))
+        prev_path = hits[-1] if hits else None
+    if not prev_path or not os.path.exists(prev_path):
+        return None
+    spec = _ilu.spec_from_file_location(
+        "bench_compare",
+        os.path.join(root, "tools", "bench_compare.py"))
+    bc = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+    with open(prev_path, "r", encoding="utf-8") as fh:
+        prev = json.load(fh)
+    verdict = bc.compare(prev, record)
+    verdict["base_record"] = os.path.basename(prev_path)
+    return verdict
+
+
 def main() -> None:
     import jax
 
@@ -468,21 +608,37 @@ def main() -> None:
         # the env override — see the backend-cache hazard above.
         attempts = 1
     backoffs = (5, 15)
+    # Emit-before-sleep margin: a retry sleep is only taken when the
+    # error record could still be flushed with this much watchdog
+    # budget to spare AFTER the sleep — otherwise the watchdog (or the
+    # harness timeout behind it) would reduce the whole run to a bare
+    # rc=124 with `parsed: null` while we slept (BENCH_r05 postmortem).
+    init_margin_s = 5.0
     platform = None
     for attempt in range(attempts):
         try:
             platform = jax.devices()[0].platform
             break
         except RuntimeError as exc:
-            if attempt == attempts - 1:
+            # Progress into the watchdog record FIRST: even a SIGTERM
+            # that beats the margin math now carries the init failure.
+            _watchdog_note("device_init", {"device_init": {
+                "attempt": attempt + 1, "attempts": attempts,
+                "message": str(exc)[:200]}})
+            delay = backoffs[min(attempt, len(backoffs) - 1)]
+            budget = watchdog_budget_s()
+            exhausted = (budget is not None
+                         and budget <= delay + init_margin_s)
+            if attempt == attempts - 1 or exhausted:
                 print(json.dumps({
                     "error": "device_init_failed",
                     "platform_requested": want or "default",
-                    "attempts": attempts,
+                    "attempts": attempt + 1,
+                    **({"watchdog_budget_exhausted": True}
+                       if exhausted and attempt < attempts - 1 else {}),
                     "message": str(exc),
-                }))
+                }), flush=True)
                 sys.exit(1)
-            delay = backoffs[min(attempt, len(backoffs) - 1)]
             print(f"# device init failed ({exc}); retry "
                   f"{attempt + 2}/{attempts} in {delay} s",
                   file=sys.stderr)
@@ -649,6 +805,21 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# sweep bench failed: {exc}", file=sys.stderr)
 
+    # Kernel-cost observatory block (sidecar_tpu/telemetry/cost.py,
+    # docs/perf.md): per-phase attribution + compile/HBM telemetry for
+    # the single-chip families, reconciled against the measured
+    # ms/round above.  BENCH_COST=0 skips it.
+    cost_block = None
+    if os.environ.get("BENCH_COST", "1") != "0":
+        try:
+            _watchdog_note("cost")
+            cost_block = _bench_cost(
+                n, spn, dense_rps=dense_rps,
+                compressed_rps=compressed_rps, north_star=north_star,
+                trace_dir=trace_dir)
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# cost block failed: {exc}", file=sys.stderr)
+
     # Baseline: the reference's wall-clock gossip cadence — 5 rounds/sec
     # (GossipInterval 200 ms), hardware-independent.
     disarm_watchdog()
@@ -662,7 +833,7 @@ def main() -> None:
         "histograms": metrics_mod.snapshot()["histograms"],
         "round_trace_tail": north_star.get("round_trace_tail"),
     }
-    print(json.dumps({
+    record = {
         "metric": f"simulated gossip rounds/sec/chip (n={n}, spn={spn}, "
                   f"{platform})",
         "kernels": kernel_ops.resolve_path(record=False)[0],
@@ -679,8 +850,19 @@ def main() -> None:
         **({"query": query_bench} if query_bench else {}),
         **({"robustness": robustness} if robustness else {}),
         **({"sweep": sweep} if sweep else {}),
+        **({"cost": cost_block} if cost_block else {}),
         "telemetry": telemetry,
-    }))
+    }
+    # Regression verdict vs the previous trajectory record
+    # (tools/bench_compare.py; BENCH_COMPARE=0 disables, =path pins
+    # the baseline).
+    try:
+        verdict = _bench_regression(record)
+        if verdict:
+            record["regression"] = verdict
+    except Exception as exc:  # the headline must survive the verdict
+        print(f"# regression verdict failed: {exc}", file=sys.stderr)
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -49,6 +49,7 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 
 from sidecar_tpu import metrics  # noqa: E402
+from sidecar_tpu.telemetry import cost  # noqa: E402
 from sidecar_tpu.models.compressed import CompressedParams  # noqa: E402
 from sidecar_tpu.models.timecfg import TimeConfig  # noqa: E402
 from sidecar_tpu.ops.topology import erdos_renyi  # noqa: E402
@@ -62,6 +63,36 @@ def build(d, params, topo, cfg, exchange, stub=False):
     return ShardedCompressedSim(
         params, topo, cfg, mesh=make_mesh(jax.devices()[:d]),
         board_exchange=exchange, exchange_stub=stub)
+
+
+def cost_row(sim, exchange, d):
+    """Per-mode cost row (telemetry/cost.py): compile a FRESH phase-
+    instrumented step, report lower/compile ms, HBM peak, and the
+    measured-from-HLO exchange bytes cross-checked against the sim's
+    analytic ``exchange_bytes_per_round``.  The pinned agreement bound
+    (docs/perf.md): EXACT for d > 1; at d = 1 the collective is elided
+    by XLA so measured is 0 (all_to_all's analytic formula still counts
+    self-rows there)."""
+    st0 = sim.init_state()
+    key = jax.random.PRNGKey(0)
+    with cost.forced_phases(True):
+        rep = cost.program_report(
+            f"sharded_scaling.{exchange}.d{d}",
+            (lambda s: (lambda st, k: s._step(st, k)))(sim),
+            st0, key, exchange_mode=exchange, num_devices=d)
+    analytic = sim.exchange_bytes_per_round
+    measured = rep.get("measured_exchange_bytes", 0)
+    match = measured == (analytic if d > 1 else 0)
+    return {
+        "lower_ms": rep.get("lower_ms"),
+        "compile_ms": rep.get("compile_ms"),
+        "flops": rep.get("flops"),
+        "bytes_accessed": rep.get("bytes_accessed"),
+        "hbm_peak_bytes": rep.get("memory", {}).get("peak_bytes"),
+        "exchange_bytes_measured": measured,
+        "exchange_bytes_analytic": analytic,
+        "exchange_bytes_match": match,
+    }
 
 
 def time_sim(sim, slots, rounds):
@@ -97,10 +128,13 @@ def main():
     slots = np.sort(rng.choice(params.m, size=max(1, params.m // 1000),
                                replace=False)).astype(np.int32)
 
-    curve, bytes_by_d, dropped = {}, {}, 0
+    curve, bytes_by_d, cost_by_d, dropped = {}, {}, {}, 0
     sim_dmax = None
+    want_cost = os.environ.get("BENCH_COST", "1") != "0"
     for d in (1, 2, 4, 8):
         sim = build(d, params, topo, cfg, opts.exchange)
+        if want_cost:
+            cost_by_d[str(d)] = cost_row(sim, opts.exchange, d)
         ms, drops = time_sim(sim, slots, opts.rounds)
         curve[str(d)] = round(ms, 3)
         bytes_by_d[str(d)] = sim.exchange_bytes_per_round
@@ -136,6 +170,7 @@ def main():
         "total_work_overhead_vs_d1": {
             d: round(v / d1 - 1.0, 3) for d, v in curve.items()},
         "exchange_bytes_per_round_per_device_by_d": bytes_by_d,
+        **({"cost_by_d": cost_by_d} if cost_by_d else {}),
         "overlap_exposed_ms_d8": round(exposed, 3),
         "overlap_stub_ms_per_round_d8": round(stub_ms, 3),
         "dropped_pulls": dropped,

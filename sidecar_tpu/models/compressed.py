@@ -140,6 +140,7 @@ from sidecar_tpu.ops.status import (
 )
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
+from sidecar_tpu.telemetry import cost
 
 _K1 = np.uint32(2654435761)   # Knuth multiplicative
 _K3 = np.uint32(0xC2B2AE35)   # murmur3 finalizer constant
@@ -405,6 +406,7 @@ class CompressedSim:
 
     # -- kernels ------------------------------------------------------------
 
+    @cost.phased("publish")
     def _publish(self, state: CompressedState, limit: int,
                  row_offset=0, force_xla=False):
         """The message board: each node's top-``budget`` freshest
@@ -456,6 +458,7 @@ class CompressedSim:
         adv = (cv > wv) | ((cv == wv) & (cs > ws))
         return jnp.where(adv, cv, wv), jnp.where(adv, cs, ws)
 
+    @cost.phased("gather")
     def _pull_merge(self, state: CompressedState, sent, bval, bslot, src,
                     alive, now, drop_key=None, kn=None):
         """Deliver: each receiver pulls the boards of its ``src`` peers
@@ -481,6 +484,7 @@ class CompressedSim:
                                   drop_key=drop_key, stale_filtered=True,
                                   kn=kn)
 
+    @cost.phased("fold")
     def _fold_pulled(self, cv0, cs0, wv, ws, pv, ps, ok, now, keep=None,
                      stale_filtered=False, kn=None):
         """Fold a GROUP of pulled candidates ``pv``/``ps`` ([nl, G, K])
@@ -613,6 +617,7 @@ class CompressedSim:
         ev = jnp.sum(((cache_slot != cs0) & (cs0 >= 0)).astype(jnp.int32))
         return cache_val, cache_slot, cache_sent, ev
 
+    @cost.phased("announce")
     def _announce(self, state: CompressedState, round_idx, now,
                   row_offset=0, kn=None):
         """Owner refresh + recovery — fully elementwise: owner slots are
@@ -650,6 +655,7 @@ class CompressedSim:
             state, own=own, floor=floor, cache_slot=cs, cache_val=cv,
             cache_sent=se, evictions=state.evictions + ev)
 
+    @cost.phased("announce")
     def _announce_offers(self, own0, floor0, node_alive, round_idx, now,
                          row_offset=0, kn=None):
         """The BOARD-INDEPENDENT half of announce: the refresh/fold
@@ -698,6 +704,7 @@ class CompressedSim:
         offer_val = jnp.where(offer, own, 0)
         return own, floor, offer_val, slots[:, 0]
 
+    @cost.phased("exchange", tag="push_pull")
     def _push_pull_stride(self, state: CompressedState, key, now,
                           kn=None):
         """Anti-entropy: two-way exchange with the node ``stride``
@@ -794,6 +801,7 @@ class CompressedSim:
         owner_holds = (ws >= 0) & owner_alive & (own_at >= wv)
         return ws, wv, count + owner_holds.astype(jnp.int32)
 
+    @cost.phased("ttl_sweep")
     def _floor_advance_and_sweep(self, state: CompressedState, now,
                                  kn=None):
         """Per-line census → floor advance → line free → TTL sweep.
@@ -944,12 +952,13 @@ class CompressedSim:
             # Fused Pallas path: publish selection + staleness gate +
             # board row-gather in one kernel — the [N, K] board never
             # touches HBM (ops/kernels, bit-identical to the XLA path).
-            sent, pv, ps = kernel_ops.fused_publish_gather_pallas(
-                state.cache_val, state.cache_slot, state.cache_sent,
-                src, now, stale_ticks=kn.stale_ticks,
-                budget=min(p.budget, p.cache_lines), limit=limit,
-                fanout=p.fanout, cache_lines=p.cache_lines,
-                interpret=self._kernels_interpret)
+            with cost.phase("publish"):
+                sent, pv, ps = kernel_ops.fused_publish_gather_pallas(
+                    state.cache_val, state.cache_slot, state.cache_sent,
+                    src, now, stale_ticks=kn.stale_ticks,
+                    budget=min(p.budget, p.cache_lines), limit=limit,
+                    fanout=p.fanout, cache_lines=p.cache_lines,
+                    interpret=self._kernels_interpret)
             ft = kn.future_arg()
             if ft is not None:
                 # The kernel only gates staleness; apply the future

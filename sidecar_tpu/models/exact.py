@@ -58,6 +58,7 @@ from sidecar_tpu.ops import suspicion as suspicion_ops
 from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, is_known, pack, unpack_status, unpack_ts
 from sidecar_tpu.ops.topology import Topology
+from sidecar_tpu.telemetry import cost
 from sidecar_tpu.ops.ttl import ttl_sweep
 
 
@@ -200,6 +201,7 @@ class ExactSim:
 
     # -- kernels -----------------------------------------------------------
 
+    @cost.phased("announce")
     def _announce_updates(self, known, node_alive, round_idx, now_tick,
                           kn=None):
         """Update triples for the owners' refresh re-stamps

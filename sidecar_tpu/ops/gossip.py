@@ -66,6 +66,7 @@ from sidecar_tpu.ops.merge import (
     sticky_adjust,
 )
 from sidecar_tpu.ops.status import unpack_ts
+from sidecar_tpu.telemetry import cost
 
 # Knuth's multiplicative constant — the slot-phase spreader for the
 # refresh stagger (and the cache-line hash in models/compressed.py).
@@ -168,6 +169,7 @@ def eligible_records(known, sent, limit):
     return eligible_mask(sent, limit) & (known > 0)
 
 
+@cost.phased("publish")
 def select_messages(known, sent, budget, limit, row_offset=0,
                     row_ids=None):
     """Top-``budget`` freshest *eligible* records per node.
@@ -345,6 +347,7 @@ def finalize_deliveries(known, rows, cols, vals):
     return vals, advanced
 
 
+@cost.phased("gather")
 def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
                        node_alive=None, drop_prob=0.0, drop_key=None,
                        edge_keep=None, sender_alive=None,
@@ -373,6 +376,7 @@ def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
     return rows, cols, vals, advanced
 
 
+@cost.phased("apply_scatter")
 def apply_updates(known, sent, rows, cols, vals, advanced,
                   num_rows=None):
     """The two scatters of a gossip round: merge ``vals`` into ``known``
@@ -393,6 +397,7 @@ def apply_updates(known, sent, rows, cols, vals, advanced,
     return known, sent
 
 
+@cost.phased("publish")
 def record_transmissions(sent, svc_idx, msg, fanout, limit, row_ids=None):
     """Bump transmit counts for the records offered this round —
     ``fanout`` sends each (TransmitLimited's per-message accounting).
@@ -417,6 +422,7 @@ def record_transmissions(sent, svc_idx, msg, fanout, limit, row_ids=None):
     return sent.at[rows, svc_idx].add(bump, mode="drop")
 
 
+@cost.phased("exchange", tag="push_pull")
 def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None,
               future_ticks=None, now_push=None):
     """Anti-entropy: each node initiates a full two-way state exchange with

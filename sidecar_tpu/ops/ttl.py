@@ -32,8 +32,10 @@ from sidecar_tpu.ops.status import (
     unpack_status,
     unpack_ts,
 )
+from sidecar_tpu.telemetry import cost
 
 
+@cost.phased("ttl_sweep")
 def ttl_sweep(known, now_tick, *, alive_lifespan, draining_lifespan,
               tombstone_lifespan, one_second, suspicion_window=0):
     """Apply the lifespan sweep to a tensor of packed records.

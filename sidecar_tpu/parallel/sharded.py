@@ -65,6 +65,7 @@ from sidecar_tpu.ops.status import (
 )
 from sidecar_tpu.ops.topology import Topology
 from sidecar_tpu.ops.ttl import ttl_sweep
+from sidecar_tpu.telemetry import cost
 from sidecar_tpu.parallel.mesh import (
     NODE_AXIS,
     make_mesh,
@@ -315,9 +316,10 @@ class ShardedSim:
         # Phase 2 — issue the exchange (mode-dependent; the only
         # cross-shard gossip traffic is the bounded offer tensors).
         if self.board_exchange == "all_gather" and not self._exchange_stub:
-            dst_all = lax.all_gather(dst, NODE_AXIS, tiled=True)     # [N, F]
-            svc_all = lax.all_gather(svc_idx, NODE_AXIS, tiled=True)  # [N, B]
-            msg_all = lax.all_gather(msg, NODE_AXIS, tiled=True)     # [N, B]
+            with cost.phase("exchange"):
+                dst_all = lax.all_gather(dst, NODE_AXIS, tiled=True)      # [N, F]
+                svc_all = lax.all_gather(svc_idx, NODE_AXIS, tiled=True)  # [N, B]
+                msg_all = lax.all_gather(msg, NODE_AXIS, tiled=True)      # [N, B]
 
         # Phase 3a — own-shard deliveries (no exchange needed).
         groups = [self._block_candidates(
@@ -371,8 +373,9 @@ class ShardedSim:
                 perm = [(i, (i - 1) % d) for i in range(d)]
 
                 def hop(blocks):
-                    return tuple(lax.ppermute(b, NODE_AXIS, perm)
-                                 for b in blocks)
+                    with cost.phase("exchange"):
+                        return tuple(lax.ppermute(b, NODE_AXIS, perm)
+                                     for b in blocks)
 
                 cur = hop((dst, svc_idx, msg))
                 for h in range(1, d):
@@ -427,6 +430,7 @@ class ShardedSim:
 
     # -- anti-entropy stride exchange (jit level, sharding-propagated) -----
 
+    @cost.phased("exchange", tag="push_pull")
     def _push_pull_stride(self, known, sent, alive, key, now, round_idx):
         """Two-way full-state exchange with the node `stride` positions
         away on the ring; jnp.roll on the sharded axis becomes an XLA

@@ -98,6 +98,7 @@ from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops.merge import admit_gate
 from sidecar_tpu.ops.topology import Topology
+from sidecar_tpu.telemetry import cost
 from sidecar_tpu.parallel.mesh import (
     NODE_AXIS,
     make_mesh,
@@ -392,7 +393,8 @@ class ShardedCompressedSim(CompressedSim):
         if mode == "all_to_all" and not self._exchange_stub:
             (req, src_shard, src_row, is_local, valid, rank,
              n_drop) = self._a2a_route(dst, ax, nl)
-            req_in = lax.all_to_all(req, NODE_AXIS, 0, 0)  # [d, C] rows
+            with cost.phase("exchange"):
+                req_in = lax.all_to_all(req, NODE_AXIS, 0, 0)  # [d, C] rows
             is_local_f = is_local.reshape(nl, p.fanout)
 
         # Phase 1 — local board rows + transmit accounting, then the
@@ -440,16 +442,18 @@ class ShardedCompressedSim(CompressedSim):
         if self._exchange_stub:
             pass  # measurement-only: exposed-comm probe, no collectives
         elif mode == "all_gather":
-            bval = lax.all_gather(bval_f, NODE_AXIS, tiled=True)  # [N, K]
-            bslot = lax.all_gather(bslot_l, NODE_AXIS, tiled=True)
+            with cost.phase("exchange"):
+                bval = lax.all_gather(bval_f, NODE_AXIS, tiled=True)  # [N, K]
+                bslot = lax.all_gather(bslot_l, NODE_AXIS, tiled=True)
             pv, ps = self._serve_local(bval, bslot, dst, 0)
             wv, ws = self._fold_pulled(cv0, cs0, wv, ws, pv, ps, ok,
                                        now, keep=keep,
                                        stale_filtered=True)
         elif mode == "all_to_all":
             rows = jnp.clip(req_in, 0, nl - 1)
-            resp_v = lax.all_to_all(bval_f[rows], NODE_AXIS, 0, 0)
-            resp_s = lax.all_to_all(bslot_l[rows], NODE_AXIS, 0, 0)
+            with cost.phase("exchange"):
+                resp_v = lax.all_to_all(bval_f[rows], NODE_AXIS, 0, 0)
+                resp_s = lax.all_to_all(bslot_l[rows], NODE_AXIS, 0, 0)
             safe_shard = jnp.where(valid, src_shard, 0)
             safe_rank = jnp.where(valid, rank, 0)
             cross_v = jnp.where(valid[:, None],
@@ -466,8 +470,9 @@ class ShardedCompressedSim(CompressedSim):
             src_row_r = dst - src_shard_r * nl
             if d > 1:
                 perm = [(i, (i - 1) % d) for i in range(d)]
-                cur_v = lax.ppermute(bval_f, NODE_AXIS, perm)
-                cur_s = lax.ppermute(bslot_l, NODE_AXIS, perm)
+                with cost.phase("exchange"):
+                    cur_v = lax.ppermute(bval_f, NODE_AXIS, perm)
+                    cur_s = lax.ppermute(bslot_l, NODE_AXIS, perm)
                 for h in range(1, d):
                     if h < d - 1:
                         # Double buffer: hop h+1's transfer is issued
@@ -476,8 +481,9 @@ class ShardedCompressedSim(CompressedSim):
                         # gate/fold.  Live footprint: two [nl, K]
                         # block pairs, O(N/d·K) — never the
                         # replicated O(N·K) board.
-                        nxt_v = lax.ppermute(cur_v, NODE_AXIS, perm)
-                        nxt_s = lax.ppermute(cur_s, NODE_AXIS, perm)
+                        with cost.phase("exchange"):
+                            nxt_v = lax.ppermute(cur_v, NODE_AXIS, perm)
+                            nxt_s = lax.ppermute(cur_s, NODE_AXIS, perm)
                     sel = src_shard_r == (ax + h) % d
                     rows_h = jnp.where(sel, src_row_r, 0)
                     wv, ws = self._fold_pulled(
@@ -545,7 +551,8 @@ class ShardedCompressedSim(CompressedSim):
         if mode == "all_to_all" and not self._exchange_stub:
             (req, src_shard, src_row, is_local, valid, rank,
              n_drop) = self._a2a_route(dst, ax, nl)
-            req_in = lax.all_to_all(req, NODE_AXIS, 0, 0)
+            with cost.phase("exchange"):
+                req_in = lax.all_to_all(req, NODE_AXIS, 0, 0)
             is_local_f = is_local.reshape(nl, p.fanout)
 
         # Phase 1 — compacted publish, reconstructed to the dense block.
@@ -590,8 +597,9 @@ class ShardedCompressedSim(CompressedSim):
         if self._exchange_stub:
             pass
         elif mode == "all_gather":
-            bval = lax.all_gather(bval_f, NODE_AXIS, tiled=True)
-            bslot = lax.all_gather(bslot_f, NODE_AXIS, tiled=True)
+            with cost.phase("exchange"):
+                bval = lax.all_gather(bval_f, NODE_AXIS, tiled=True)
+                bslot = lax.all_gather(bslot_f, NODE_AXIS, tiled=True)
             pv, ps = kernel_ops.board_row_gather_xla(bval, bslot,
                                                      dst_c, 0)
             wv, ws = self._fold_pulled(cv0_c, cs0_c, wv, ws, pv, ps,
@@ -599,8 +607,9 @@ class ShardedCompressedSim(CompressedSim):
                                        stale_filtered=True)
         elif mode == "all_to_all":
             rows = jnp.clip(req_in, 0, nl - 1)
-            resp_v = lax.all_to_all(bval_f[rows], NODE_AXIS, 0, 0)
-            resp_s = lax.all_to_all(bslot_f[rows], NODE_AXIS, 0, 0)
+            with cost.phase("exchange"):
+                resp_v = lax.all_to_all(bval_f[rows], NODE_AXIS, 0, 0)
+                resp_s = lax.all_to_all(bslot_f[rows], NODE_AXIS, 0, 0)
             valid_c = valid.reshape(nl, p.fanout)[row_r]
             shard_c = jnp.where(valid, src_shard, 0) \
                 .reshape(nl, p.fanout)[row_r]
@@ -619,12 +628,14 @@ class ShardedCompressedSim(CompressedSim):
             src_row_r = dst_c - src_shard_r * nl
             if d > 1:
                 perm = [(i, (i - 1) % d) for i in range(d)]
-                cur_v = lax.ppermute(bval_f, NODE_AXIS, perm)
-                cur_s = lax.ppermute(bslot_f, NODE_AXIS, perm)
+                with cost.phase("exchange"):
+                    cur_v = lax.ppermute(bval_f, NODE_AXIS, perm)
+                    cur_s = lax.ppermute(bslot_f, NODE_AXIS, perm)
                 for h in range(1, d):
                     if h < d - 1:
-                        nxt_v = lax.ppermute(cur_v, NODE_AXIS, perm)
-                        nxt_s = lax.ppermute(cur_s, NODE_AXIS, perm)
+                        with cost.phase("exchange"):
+                            nxt_v = lax.ppermute(cur_v, NODE_AXIS, perm)
+                            nxt_s = lax.ppermute(cur_s, NODE_AXIS, perm)
                     sel = src_shard_r == (ax + h) % d
                     rows_h = jnp.where(sel, src_row_r, 0)
                     wv, ws = self._fold_pulled(

@@ -1,6 +1,6 @@
 """The host-side telemetry plane (docs/telemetry.md).
 
-Three surfaces, one package:
+Four surfaces, one package:
 
 * :mod:`sidecar_tpu.telemetry.span` — the lightweight span tracer: a
   thread-safe ring buffer of timed, parent/child-linked spans across
@@ -12,6 +12,10 @@ Three surfaces, one package:
 * :mod:`sidecar_tpu.telemetry.profiling` — ``jax.profiler`` trace
   hooks behind ``SIDECAR_TPU_PROFILE_DIR`` (bench.py north-star chunks
   and ``SimBridge`` dispatches annotate themselves when it is set).
+* :mod:`sidecar_tpu.telemetry.cost` — the kernel-cost observatory
+  (docs/perf.md): ``sidecar.phase.*`` scoping, compiled-program
+  cost/memory reports, profile-trace reduction, and the registry
+  behind ``GET /api/cost.json``.
 
 The jit-side half — the in-scan per-round :class:`RoundTrace` stream —
 lives with the other device ops in :mod:`sidecar_tpu.ops.trace`.

@@ -1,0 +1,594 @@
+"""Kernel-cost observatory — where each round's milliseconds, bytes,
+and HBM actually go.
+
+The trace plane (ops/trace.py) and the provenance plane (PR 11) observe
+the PROTOCOL; this module observes the PROGRAMS that run it.  Three
+instruments, all built on machinery XLA already exposes:
+
+1. **Phase scopes** — :func:`phase` wraps each step-function phase
+   (publish / gather / fold / exchange / ttl_sweep / announce /
+   apply_scatter) in a ``jax.named_scope`` carrying the
+   ``sidecar.phase.<name>`` label, so every compiled op's metadata
+   names the protocol phase that produced it and xprof device
+   timelines group by phase.  **Default OFF and free**: unless
+   ``SIDECAR_TPU_COST_PHASES=1`` (or a profile dir is configured,
+   ``SIDECAR_TPU_PROFILE_DIR``) every scope is a ``nullcontext`` and
+   the traced program is bit-identical to the un-instrumented one —
+   tests/test_cost.py pins that per model family.  In-jit scopes use
+   ``named_scope`` (a ``TraceAnnotation`` cannot label device ops from
+   inside a traced function — it would time TRACING, not execution);
+   the host-side dispatch boundaries keep their ``TraceAnnotation``
+   via telemetry/profiling.annotate.
+
+2. **Compiled-program reports** — :func:`program_report` lowers +
+   compiles a callable once, timing both stages, and extracts
+   ``cost_analysis()`` FLOP/byte estimates, ``memory_analysis()`` HBM
+   sizes, the collective ops (kind + payload bytes, parsed from the
+   compiled HLO), and the per-phase byte attribution (op metadata →
+   ``sidecar.phase.*``).  Reports are cached per label (the jit-cache
+   -hit telemetry: ``compile.count`` / ``compile.cache_hit``) and
+   published into a process-global registry served at
+   ``GET /api/cost.json``.
+
+3. **Profile-trace reduction** — :func:`parse_profile_dir` reduces a
+   captured ``SIDECAR_TPU_PROFILE_DIR`` run (TensorBoard/xprof chrome
+   trace-event JSON) into per-phase device-time totals and shares,
+   and :func:`reconcile` checks them against a measured ms/round
+   (docs/perf.md documents the tolerance contract).
+
+Everything here is measurement-side: nothing in this module runs on
+the hot path unless explicitly invoked, and a ``program_report`` is a
+SEPARATE compile of the same function — production dispatches never
+pay for it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import glob
+import gzip
+import json
+import os
+import re
+import threading
+import time
+from typing import Optional
+
+from sidecar_tpu import metrics
+from sidecar_tpu.telemetry import profiling
+
+PHASE_ENV = "SIDECAR_TPU_COST_PHASES"
+PHASE_PREFIX = "sidecar.phase."
+
+# The canonical phase taxonomy (docs/perf.md).  Single-chip models use
+# `exchange` for the anti-entropy push-pull; the sharded twins reuse it
+# for the board exchange collectives — the HLO call path
+# (`_push_pull_stride` vs the board section) keeps them separable, see
+# measured_exchange_bytes.
+PHASES = ("publish", "gather", "fold", "exchange", "ttl_sweep",
+          "announce", "apply_scatter")
+
+# Reconciliation contract (docs/perf.md): per-phase attributions are
+# accepted when they cover at least this fraction of the measured
+# ms/round (device attribution on an async pipeline legitimately misses
+# host-side time, gaps, and unannotated ops) and at most COVERAGE_MAX
+# (above it the attribution double-counted something).
+COVERAGE_MIN = 0.2
+COVERAGE_MAX = 1.25
+# Static byte attribution: minimum fraction of compiled output bytes
+# that must carry a phase label for the share table to be meaningful.
+MIN_ATTRIBUTED_FRACTION = 0.5
+
+
+def phases_enabled() -> bool:
+    """Phase scopes compile into traced programs only when explicitly
+    requested: ``SIDECAR_TPU_COST_PHASES=1`` wins, else a configured
+    profile dir enables them (a profiled run wants labelled ops).  The
+    check happens at TRACE time — programs already compiled keep
+    whatever they were traced with."""
+    raw = os.environ.get(PHASE_ENV)
+    if raw is not None:
+        return raw.strip() not in ("", "0")
+    return profiling.profile_dir() is not None
+
+
+def phase(name: str):
+    """A ``jax.named_scope("sidecar.phase.<name>")`` labelling every op
+    traced inside the block when cost phases are enabled; a free
+    ``nullcontext`` otherwise (the bit-identity contract)."""
+    if not phases_enabled():
+        return contextlib.nullcontext()
+    try:
+        import jax
+        return jax.named_scope(PHASE_PREFIX + name)
+    except Exception:  # pragma: no cover — profiler/jax API drift
+        return contextlib.nullcontext()
+
+
+def phased(name: str, tag: Optional[str] = None):
+    """Decorator form of :func:`phase` — the ops-layer spelling.  The
+    enablement check runs per CALL (trace), not at decoration, so a
+    decorated kernel traced with phases off stays bit-identical.
+
+    ``tag`` nests a second named scope inside the phase, putting an
+    extra token on every op's metadata path — how the anti-entropy
+    push-pull (phase ``exchange``, tag ``push_pull``) stays separable
+    from the sharded BOARD exchange (same phase) when
+    :func:`measured_exchange_bytes` filters collectives."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not phases_enabled():
+                return fn(*args, **kwargs)
+            with phase(name):
+                if tag is None:
+                    return fn(*args, **kwargs)
+                try:
+                    import jax
+                    scope = jax.named_scope(tag)
+                except Exception:  # pragma: no cover
+                    scope = contextlib.nullcontext()
+                with scope:
+                    return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+@contextlib.contextmanager
+def forced_phases(enabled: bool = True):
+    """Pin the phase-scope env knob for the duration (measurement
+    probes re-trace a fresh jit wrapper under this so the production
+    jit caches stay un-instrumented)."""
+    old = os.environ.get(PHASE_ENV)
+    os.environ[PHASE_ENV] = "1" if enabled else "0"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(PHASE_ENV, None)
+        else:
+            os.environ[PHASE_ENV] = old
+
+
+# -- compiled-HLO parsing ----------------------------------------------------
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s4": 1, "u4": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+_SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+# `%name = <type> op-kind(` — <type> is a shape (maybe with layout) or
+# a tuple of shapes; shapes never contain parentheses.
+_OP_RE = re.compile(
+    r"=\s+((?:\([^)]*\))|(?:[a-z]+[0-9]*\[[0-9,]*\](?:\{[^}]*\})?))\s+"
+    r"([a-z][a-z0-9-]*)\(")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_PHASE_TOKEN_RE = re.compile(r"sidecar\.phase\.([A-Za-z0-9_]+)")
+
+COLLECTIVE_KINDS = ("all-gather", "all-to-all", "collective-permute",
+                    "all-reduce", "reduce-scatter")
+
+
+def shape_bytes(type_text: str) -> int:
+    """Total buffer bytes of an HLO type string (``s32[64,32]{1,0}``
+    or a tuple of shapes).  Unknown element types count 0 — the parser
+    must never invent bytes."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_text):
+        nbytes = _DTYPE_BYTES.get(dtype)
+        if nbytes is None:
+            continue
+        count = 1
+        for d in dims.split(","):
+            if d:
+                count *= int(d)
+        total += count * nbytes
+    return total
+
+
+def _op_lines(hlo_text: str):
+    """Yield ``(output_bytes, op_kind, op_name_metadata_or_"")`` per
+    HLO instruction line."""
+    for line in hlo_text.splitlines():
+        m = _OP_RE.search(line)
+        if not m:
+            continue
+        name_m = _OPNAME_RE.search(line)
+        yield (shape_bytes(m.group(1)), m.group(2),
+               name_m.group(1) if name_m else "")
+
+
+def collective_ops(hlo_text: str) -> list[dict]:
+    """Every collective instruction in a compiled HLO module:
+    ``{"kind", "bytes", "op_name"}`` with bytes = the op's output
+    buffer size (for a tiled all-gather that is the FULL gathered
+    tensor per device).  ``-start`` async forms count once; their
+    ``-done`` halves produce no separate payload."""
+    out = []
+    for nbytes, kind, op_name in _op_lines(hlo_text):
+        base = kind[:-6] if kind.endswith("-start") else kind
+        if base in COLLECTIVE_KINDS:
+            out.append({"kind": base, "bytes": nbytes,
+                        "op_name": op_name})
+        elif base.endswith("-done") and base[:-5] in COLLECTIVE_KINDS:
+            continue
+    return out
+
+
+def collective_summary(hlo_text: str) -> dict:
+    """Per-kind op counts + total payload bytes of a compiled module —
+    the bench/benchmark exposition row."""
+    ops = collective_ops(hlo_text)
+    by_kind: dict[str, dict] = {}
+    for op in ops:
+        ent = by_kind.setdefault(op["kind"], {"ops": 0, "bytes": 0})
+        ent["ops"] += 1
+        ent["bytes"] += op["bytes"]
+    return {"ops": len(ops), "by_kind": by_kind,
+            "total_bytes": sum(o["bytes"] for o in ops)}
+
+
+def measured_exchange_bytes(hlo_text: str, mode: str, d: int,
+                            exclude: tuple = ("push_pull",)) -> int:
+    """Measured per-round per-device receive bytes of the sharded
+    BOARD exchange, from the compiled collective sizes — the number
+    the trace plane's analytic 93 B/record column is cross-checked
+    against (``exchange_bytes_per_round`` on both sharded twins).
+
+    Selection: the collective kind the mode compiles to (all_gather →
+    ``all-gather``, all_to_all → ``all-to-all``, ring →
+    ``collective-permute``), AND the op's metadata path must carry the
+    ``sidecar.phase.exchange`` scope — stray collectives (e.g. the
+    all-reduce/all-gather pairs a sharded ``_roll_dynamic`` lowers to
+    inside a cond branch) carry no phase scope and are skipped.  Ops
+    whose path contains an ``exclude`` token (default: the anti-entropy
+    ``_push_pull_stride``, which also lowers to collective-permutes)
+    are left out.  A tiled all-gather's output is the FULL gathered
+    tensor, of which ``(d-1)/d`` actually crossed the interconnect.
+    Requires the program to have been compiled with phases ON
+    (``forced_phases(True)`` / program_report does this)."""
+    kind = {"all_gather": "all-gather", "all_to_all": "all-to-all",
+            "ring": "collective-permute"}[mode]
+    scope = PHASE_PREFIX + "exchange"
+    total = 0
+    for op in collective_ops(hlo_text):
+        if op["kind"] != kind:
+            continue
+        if scope not in op["op_name"]:
+            continue
+        if any(tok in op["op_name"] for tok in exclude):
+            continue
+        if mode == "all_gather":
+            total += op["bytes"] * (d - 1) // max(d, 1)
+        else:
+            total += op["bytes"]
+    return total
+
+
+# Buffer plumbing no protocol phase can own — excluded from the
+# attribution denominator (docs/perf.md): parameters and tuple shells
+# are the calling convention, copies/bitcasts are layout moves, and
+# none of them carry op metadata in the first place.
+STRUCTURAL_KINDS = frozenset((
+    "parameter", "tuple", "get-tuple-element", "constant", "copy",
+    "bitcast"))
+
+
+def hlo_phase_bytes(hlo_text: str) -> dict:
+    """Static per-phase attribution of a compiled module: each
+    instruction's OUTPUT buffer bytes accrue to the ``sidecar.phase.*``
+    token in its metadata (the write-side weight — these models are
+    memory-bound, docs/perf.md).  Compute ops without a phase label
+    accrue to ``unattributed``; STRUCTURAL_KINDS (calling-convention
+    and layout plumbing) are tallied separately and sit outside the
+    ``attributed_fraction`` denominator.  All zeros + fraction 0 when
+    the program was compiled with phases off."""
+    by_phase: dict[str, int] = {}
+    unattributed = 0
+    structural = 0
+    for nbytes, kind, op_name in _op_lines(hlo_text):
+        m = _PHASE_TOKEN_RE.search(op_name)
+        if m:
+            by_phase[m.group(1)] = by_phase.get(m.group(1), 0) + nbytes
+        elif kind in STRUCTURAL_KINDS:
+            structural += nbytes
+        else:
+            unattributed += nbytes
+    attributed = sum(by_phase.values())
+    total = attributed + unattributed
+    return {"by_phase": by_phase, "unattributed_bytes": unattributed,
+            "structural_bytes": structural,
+            "attributed_bytes": attributed,
+            "attributed_fraction": round(attributed / total, 4)
+            if total else 0.0}
+
+
+def phase_share_table(phase_bytes: dict,
+                      measured_ms_per_round: Optional[float] = None
+                      ) -> dict:
+    """Byte-weighted phase shares (over ATTRIBUTED bytes) and, given a
+    measured ms/round, the per-phase ms estimate ``share × measured``.
+    The estimates reconcile to the measurement by construction; the
+    meaningful quality gate is ``attributed_fraction`` ≥
+    MIN_ATTRIBUTED_FRACTION (docs/perf.md)."""
+    by_phase = phase_bytes.get("by_phase", {})
+    attributed = sum(by_phase.values())
+    table = {}
+    for name, nbytes in sorted(by_phase.items(),
+                               key=lambda kv: -kv[1]):
+        share = nbytes / attributed if attributed else 0.0
+        row = {"bytes": nbytes, "share": round(share, 4)}
+        if measured_ms_per_round is not None:
+            row["est_ms_per_round"] = round(
+                share * measured_ms_per_round, 4)
+            metrics.set_gauge(f"phase.{name}.share", round(share, 4))
+        table[name] = row
+    return {"phases": table,
+            "attributed_fraction": phase_bytes.get(
+                "attributed_fraction", 0.0),
+            "attribution": "compiled-output-bytes"}
+
+
+# -- profile-trace reduction -------------------------------------------------
+
+def parse_profile_dir(path: str) -> dict:
+    """Reduce a captured profile directory (``SIDECAR_TPU_PROFILE_DIR``
+    — TensorBoard ``plugins/profile/<run>/*.trace.json.gz``, chrome
+    trace-event format) into per-phase device-time totals: every
+    complete ("X") event whose name or args carry a
+    ``sidecar.phase.<p>`` token accrues its duration to phase ``p``.
+
+    Best-effort by design — a trace with no phase events (phases were
+    off, or the backend emits no device events) reduces to
+    ``{"phases": {}, "attributed_ms": 0.0}``, never an error."""
+    phases: dict[str, dict] = {}
+    files = sorted(
+        glob.glob(os.path.join(path, "**", "*.trace.json.gz"),
+                  recursive=True)
+        + glob.glob(os.path.join(path, "**", "*.trace.json"),
+                    recursive=True))
+    parsed_files = 0
+    for fname in files:
+        try:
+            if fname.endswith(".gz"):
+                with gzip.open(fname, "rb") as fh:
+                    doc = json.loads(fh.read())
+            else:
+                with open(fname, "rb") as fh:
+                    doc = json.loads(fh.read())
+        except (OSError, ValueError):
+            continue
+        parsed_files += 1
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            blob = str(ev.get("name", ""))
+            args = ev.get("args")
+            if isinstance(args, dict):
+                blob += " " + " ".join(str(v) for v in args.values())
+            m = _PHASE_TOKEN_RE.search(blob)
+            if not m:
+                continue
+            ent = phases.setdefault(
+                m.group(1), {"events": 0, "duration_us": 0.0})
+            ent["events"] += 1
+            ent["duration_us"] += float(ev.get("dur", 0) or 0)
+    total_us = sum(e["duration_us"] for e in phases.values())
+    out = {}
+    for name, ent in sorted(phases.items(),
+                            key=lambda kv: -kv[1]["duration_us"]):
+        out[name] = {
+            "events": ent["events"],
+            "ms": round(ent["duration_us"] / 1000.0, 4),
+            "share": round(ent["duration_us"] / total_us, 4)
+            if total_us else 0.0,
+        }
+        metrics.histogram(f"phase.{name}.ms",
+                          ent["duration_us"] / 1000.0)
+    return {"files": parsed_files, "phases": out,
+            "attributed_ms": round(total_us / 1000.0, 4)}
+
+
+def reconcile(attributed_ms: float, measured_ms: float,
+              coverage_min: float = COVERAGE_MIN,
+              coverage_max: float = COVERAGE_MAX) -> dict:
+    """The reconciliation contract (docs/perf.md): per-phase attributed
+    time vs the measured ms for the same span of work.  ``coverage`` =
+    attributed/measured; within tolerance when it lands inside
+    ``[coverage_min, coverage_max]``."""
+    coverage = (attributed_ms / measured_ms) if measured_ms else None
+    return {
+        "attributed_ms": round(attributed_ms, 4),
+        "measured_ms": round(measured_ms, 4),
+        "coverage": round(coverage, 4) if coverage is not None else None,
+        "tolerance": [coverage_min, coverage_max],
+        "within_tolerance": (coverage is not None
+                             and coverage_min <= coverage
+                             <= coverage_max),
+    }
+
+
+# -- compiled-program reports ------------------------------------------------
+
+_lock = threading.Lock()
+_REPORTS: dict[str, dict] = {}
+
+
+@contextlib.contextmanager
+def no_persistent_cache():
+    """Disable jax's on-disk compilation cache for the duration.  The
+    cache keys programs WITHOUT op metadata
+    (``jax_compilation_cache_include_metadata_in_key`` defaults False),
+    so a cached scope-free executable can be served for a
+    phase-instrumented program — ``as_text()`` would then show the
+    STALE metadata and every attribution read zero.  Measurement
+    compiles must be real compiles.
+
+    Flipping the config flag alone is NOT enough: ``is_cache_used``
+    latches its verdict once per process, so the latch has to be
+    dropped (``reset_cache``) on both sides of the toggle."""
+    import jax
+    try:
+        from jax._src import compilation_cache as _cc
+    except Exception:  # pragma: no cover — jax internals drift
+        _cc = None
+    try:
+        old = jax.config.jax_enable_compilation_cache
+    except AttributeError:  # pragma: no cover — config drift
+        yield
+        return
+
+    def _drop_latch():
+        if _cc is not None:
+            try:
+                _cc.reset_cache()
+            except Exception:  # pragma: no cover
+                pass
+
+    jax.config.update("jax_enable_compilation_cache", False)
+    _drop_latch()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", old)
+        _drop_latch()
+
+
+def compiled_hlo(fn, *args) -> str:
+    """Optimized-HLO text of ``fn(*args)`` from a FRESH jit wrapper and
+    a REAL compile (persistent cache bypassed) — the input every parser
+    in this module expects."""
+    import jax
+    with no_persistent_cache():
+        return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def _cost_analysis_doc(compiled) -> dict:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    if not isinstance(ca, dict):
+        return {}
+    out = {}
+    if "flops" in ca:
+        out["flops"] = float(ca["flops"])
+    if "bytes accessed" in ca:
+        out["bytes_accessed"] = float(ca["bytes accessed"])
+    return out
+
+
+def _memory_analysis_doc(compiled) -> dict:
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return {}
+    if ma is None:
+        return {}
+    fields = ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes")
+    out = {}
+    for f in fields:
+        v = getattr(ma, f, None)
+        if v is not None:
+            out[f.replace("_size_in_bytes", "_bytes")] = int(v)
+    if out:
+        # Resident-watermark estimate: arguments + outputs + temps,
+        # minus donated aliases (an aliased output is not a second
+        # buffer).  XLA's own peak accounting is not exposed here.
+        out["peak_bytes"] = max(
+            0,
+            out.get("argument_bytes", 0) + out.get("output_bytes", 0)
+            + out.get("temp_bytes", 0) - out.get("alias_bytes", 0))
+    return out
+
+
+def program_report(label: str, fn, *args, donate_argnums=(),
+                   static_argnums=(), refresh: bool = False,
+                   exchange_mode: Optional[str] = None,
+                   num_devices: Optional[int] = None) -> dict:
+    """Lower + compile ``fn(*args)`` under a FRESH ``jax.jit`` wrapper
+    and report what the compiler says it costs: lower/compile wall
+    time, ``cost_analysis`` FLOP/byte estimates, ``memory_analysis``
+    HBM sizes (with a peak-watermark estimate), the collective summary,
+    and the per-phase byte attribution.  Cached per ``label`` — a
+    repeat call is the jit-cache-hit telemetry (``compile.cache_hit``)
+    and returns the stored report without recompiling."""
+    with _lock:
+        cached = _REPORTS.get(label)
+    if cached is not None and not refresh:
+        metrics.incr("compile.cache_hit")
+        return cached
+    import jax
+
+    metrics.incr("compile.count")
+    with no_persistent_cache():
+        t0 = time.perf_counter()
+        jitted = jax.jit(fn, donate_argnums=donate_argnums,
+                         static_argnums=static_argnums)
+        lowered = jitted.lower(*args)
+        t_lower = time.perf_counter()
+        compiled = lowered.compile()
+        t_compile = time.perf_counter()
+    report: dict = {
+        "program": label,
+        "lower_ms": round((t_lower - t0) * 1000.0, 2),
+        "compile_ms": round((t_compile - t_lower) * 1000.0, 2),
+        "phases_enabled": phases_enabled(),
+    }
+    metrics.histogram("compile.ms", (t_compile - t_lower) * 1000.0)
+    report.update(_cost_analysis_doc(compiled))
+    mem = _memory_analysis_doc(compiled)
+    if mem:
+        report["memory"] = mem
+        metrics.set_gauge(f"hbm.{label}.peak_bytes", mem["peak_bytes"])
+    try:
+        hlo = compiled.as_text()
+    except Exception:
+        hlo = ""
+    if hlo:
+        report["collectives"] = collective_summary(hlo)
+        report["phase_bytes"] = hlo_phase_bytes(hlo)
+        report["hlo_chars"] = len(hlo)
+        if exchange_mode is not None and num_devices is not None:
+            report["measured_exchange_bytes"] = measured_exchange_bytes(
+                hlo, exchange_mode, num_devices)
+    with _lock:
+        _REPORTS[label] = report
+    return report
+
+
+def record_report(label: str, doc: dict) -> None:
+    """Publish an externally-assembled cost block (e.g. bench.py's
+    reconciliation rows) into the registry served at /api/cost.json."""
+    with _lock:
+        _REPORTS[label] = doc
+
+
+def snapshot() -> dict:
+    """The registry view behind ``GET /api/cost.json``: every program
+    report recorded this process, plus the phase-scope state and the
+    ``compile.*`` counters."""
+    with _lock:
+        programs = {k: dict(v) for k, v in _REPORTS.items()}
+    return {
+        "phases_enabled": phases_enabled(),
+        "phase_taxonomy": list(PHASES),
+        "programs": programs,
+        "compile": {
+            "count": metrics.counter("compile.count"),
+            "cache_hits": metrics.counter("compile.cache_hit"),
+        },
+    }
+
+
+def reset() -> None:
+    """Clear the report registry (tests)."""
+    with _lock:
+        _REPORTS.clear()

@@ -141,3 +141,37 @@ def reset_spans() -> None:
     before the reset stays valid and simply reads nothing new."""
     with _lock:
         _ring.clear()
+
+
+def spans_to_chrome(span_dicts: list, pid: int = 1) -> list:
+    """Chrome trace-event form of a span list (Perfetto / chrome://
+    tracing loadable; ``GET /api/trace?format=chrome``).
+
+    Each span becomes one complete ("X") event — ``ts``/``dur`` in
+    microseconds per the trace-event spec — with its linkage ids
+    (span/parent/trace, plus the ring ``seq``) riding in ``args``.
+    Thread names map to stable small integer ``tid``s, announced via
+    ``thread_name`` metadata events so the viewer shows real names."""
+    tids: dict = {}
+    events = []
+    for s in span_dicts:
+        thread = str(s.get("thread") or "main")
+        tid = tids.setdefault(thread, len(tids) + 1)
+        args = {k: s[k] for k in ("span_id", "parent_id", "trace_id",
+                                  "seq") if s.get(k) is not None}
+        if s.get("error"):
+            args["error"] = s["error"]
+        events.append({
+            "name": s.get("name", ""),
+            "cat": "span",
+            "ph": "X",
+            "ts": round(float(s.get("start_unix_s") or 0.0) * 1e6, 3),
+            "dur": round(float(s.get("duration_ms") or 0.0) * 1e3, 3),
+            "pid": pid,
+            "tid": tid,
+            "args": args,
+        })
+    meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": thread}}
+            for thread, tid in tids.items()]
+    return meta + events

@@ -203,6 +203,8 @@ class SidecarApi:
             return self.metrics_prometheus()
         if parts == ["trace"]:
             return self.trace_dump(query)
+        if parts == ["cost.json"]:
+            return self.cost_dump()
         if parts == ["propagation.json"]:
             return self.propagation_dump()
         if parts == ["propagation"]:
@@ -350,9 +352,15 @@ class SidecarApi:
         sequence number, oldest first, with ``next_since`` to resume
         from and ``dropped`` when the ring overwrote spans the cursor
         never read (with both, ``limit`` pages FORWARD from the
-        cursor)."""
+        cursor).  ``?format=chrome`` returns the same selection as
+        Chrome trace-event JSON (Perfetto-loadable; the cursor keys
+        ride along at the top level next to ``traceEvents``)."""
         from sidecar_tpu.telemetry import spans, spans_since
+        from sidecar_tpu.telemetry.span import spans_to_chrome
 
+        fmt = query.get("format", ["json"])[0]
+        if fmt not in ("json", "chrome"):
+            return self._error(400, "format must be json or chrome")
         limit = None
         raw = query.get("limit", [None])[0]
         if raw is not None:
@@ -370,7 +378,26 @@ class SidecarApi:
             doc = spans_since(since, limit)
         else:
             doc = {"spans": spans(limit)}
+        if fmt == "chrome":
+            chrome = {"traceEvents": spans_to_chrome(doc["spans"]),
+                      "displayTimeUnit": "ms"}
+            for key in ("next_since", "dropped"):
+                if key in doc:
+                    chrome[key] = doc[key]
+            doc = chrome
         body = json.dumps(doc, indent=2).encode()
+        return 200, "application/json", body, CORS_HEADERS
+
+    def cost_dump(self):
+        """Kernel-cost observatory registry (``GET /api/cost.json`` —
+        telemetry/cost.py, docs/perf.md): every compiled-program cost
+        report recorded in this process (compile/lower wall time,
+        FLOP/byte estimates, HBM watermarks, collective payloads,
+        per-phase byte attribution) plus the phase-scope state and
+        ``compile.*`` counters."""
+        from sidecar_tpu.telemetry import cost
+
+        body = json.dumps(cost.snapshot(), indent=2).encode()
         return 200, "application/json", body, CORS_HEADERS
 
     def propagation_dump(self):

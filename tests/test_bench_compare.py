@@ -1,0 +1,158 @@
+"""The perf-regression verdict plane (tools/bench_compare.py): verdict
+fixtures for regression / improvement / neutral / incomparable, driver
+wrapper unwrapping, trajectory mode, and the CLI exit-code contract.
+"""
+
+import json
+import pathlib
+import sys
+
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tools"))
+import bench_compare as bc  # noqa: E402
+
+
+def result(value=30.0, ns_ms=50.0, rounds=250):
+    return {
+        "metric": "rounds_per_sec", "unit": "1/s", "value": value,
+        "north_star": {"wall_ms_per_round": ns_ms,
+                       "rounds_to_eps": rounds},
+    }
+
+
+def wrap(parsed, rc=0):
+    return {"cmd": "python bench.py", "n": 4, "parsed": parsed,
+            "rc": rc, "tail": ""}
+
+
+class TestExtractRecord:
+    def test_wrapper_unwraps_to_result(self):
+        kind, doc = bc.extract_record(wrap(result()))
+        assert kind == "result"
+        assert doc["value"] == 30.0
+
+    def test_null_parsed_is_incomparable(self):
+        kind, info = bc.extract_record(wrap(None, rc=124))
+        assert kind == "incomparable"
+        assert info["rc"] == 124
+
+    def test_watchdog_and_error_records(self):
+        kind, _ = bc.extract_record(
+            {"error": "bench_timeout", "watchdog": True,
+             "phase": "cost", "partial": {}})
+        assert kind == "watchdog"
+        kind2, _ = bc.extract_record(
+            {"error": "device_init_failed", "attempts": 3})
+        assert kind2 == "error"
+
+    def test_garbage(self):
+        assert bc.extract_record([1, 2])[0] == "incomparable"
+        assert bc.extract_record({"what": "?"})[0] == "incomparable"
+
+
+class TestCompareVerdicts:
+    def test_neutral_inside_tolerance(self):
+        # value +5% with 8% tolerance, wall +5% with 10% tolerance.
+        v = bc.compare(result(), result(value=31.5, ns_ms=52.5))
+        assert v["overall"] == "neutral"
+        assert all(r["verdict"] == "neutral" for r in v["metrics"])
+
+    def test_regression_on_slower_wall(self):
+        v = bc.compare(result(), result(ns_ms=60.0))   # +20% wall
+        assert v["overall"] == "regression"
+        bad = {r["metric"]: r["verdict"] for r in v["metrics"]}
+        assert bad["north_star.wall_ms_per_round"] == "regression"
+
+    def test_regression_on_lower_throughput(self):
+        v = bc.compare(result(), result(value=24.0))   # -20% value
+        assert v["overall"] == "regression"
+
+    def test_improvement(self):
+        v = bc.compare(result(), result(value=40.0, ns_ms=40.0))
+        assert v["overall"] == "improvement"
+
+    def test_regression_beats_improvement(self):
+        # Faster headline but more rounds-to-eps: regression wins.
+        v = bc.compare(result(), result(value=40.0, rounds=300))
+        assert v["overall"] == "regression"
+
+    def test_rounds_to_eps_tight_tolerance(self):
+        # rounds are deterministic: 2% tolerance, so +4% regresses.
+        v = bc.compare(result(rounds=250), result(rounds=260))
+        assert v["overall"] == "regression"
+
+    def test_absent_metrics_skipped_not_failed(self):
+        a = {"metric": "m", "unit": "u", "value": 10.0}
+        b = {"metric": "m", "unit": "u", "value": 10.1}
+        v = bc.compare(a, b)
+        assert v["overall"] == "neutral"
+        assert v["compared"] == 1              # only `value` present
+
+    def test_incomparable_sides(self):
+        v = bc.compare(wrap(None, rc=124), result())
+        assert v["overall"] == "incomparable"
+        assert v["base_kind"] == "incomparable"
+        assert v["metrics"] == []
+
+
+class TestTrajectory:
+    def test_incomparable_anchor_skipped(self):
+        docs = [result(value=30.0), wrap(None, rc=124),
+                result(value=24.0)]
+        out = bc.compare_trajectory(docs, labels=["r1", "r2", "r3"])
+        assert out["overall"] == "regression"
+        steps = {s["record"]: s for s in out["steps"]}
+        assert steps["r2"]["verdict"] == "incomparable"
+        # r3 compares against r1 (last COMPARABLE), not the watchdog.
+        assert steps["r3"]["base_record"] == "r1"
+
+    def test_all_neutral(self):
+        docs = [result(), result(value=30.5), result(value=29.8)]
+        out = bc.compare_trajectory(docs)
+        assert out["overall"] == "neutral"
+
+
+class TestCli:
+    def _write(self, tmp_path, name, doc):
+        p = tmp_path / name
+        p.write_text(json.dumps(doc))
+        return str(p)
+
+    def test_exit_codes(self, tmp_path, capsys):
+        base = self._write(tmp_path, "a.json", result())
+        same = self._write(tmp_path, "b.json", result(value=30.1))
+        slow = self._write(tmp_path, "c.json", result(value=20.0))
+        dead = self._write(tmp_path, "d.json", wrap(None, rc=124))
+        assert bc.main([base, same]) == 0
+        assert bc.main([base, slow]) == 3
+        assert bc.main([base, dead]) == 2
+        assert bc.main([base, str(tmp_path / "missing.json")]) == 1
+        capsys.readouterr()
+
+    def test_glob_trajectory(self, tmp_path, capsys):
+        self._write(tmp_path, "BENCH_r01.json", result(value=30.0))
+        self._write(tmp_path, "BENCH_r02.json", result(value=31.0))
+        self._write(tmp_path, "BENCH_r03.json", result(value=20.0))
+        rc = bc.main([str(tmp_path / "BENCH_r0*.json")])
+        assert rc == 3
+        out = json.loads(capsys.readouterr().out)
+        assert out["overall"] == "regression"
+        assert len(out["steps"]) == 3
+
+
+def test_repo_records_compare_without_crash():
+    """The real BENCH_r0*.json trajectory must always produce a
+    verdict document (r05 is parsed-null — the incomparable path)."""
+    import glob
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r0*.json")))
+    if len(paths) < 2:
+        pytest.skip("no recorded bench trajectory in repo")
+    docs = [json.load(open(p)) for p in paths]
+    out = bc.compare_trajectory(docs, labels=paths)
+    assert out["overall"] in ("regression", "improvement", "neutral")
+    assert len(out["steps"]) == len(paths)

@@ -270,3 +270,81 @@ class TestDeviceInitFailure:
         record = json.loads(capsys.readouterr().out.strip()
                             .splitlines()[-1])
         assert record["error"] == "device_init_failed"
+
+
+class TestDeviceInitBudget:
+    """This round's satellite: the retry loop must note progress into
+    the watchdog record BEFORE sleeping, and must not take a sleep the
+    remaining watchdog budget cannot afford — emit the error record
+    early instead of dying rc=124 mid-backoff."""
+
+    def _dead(self, monkeypatch, calls, sleeps):
+        import jax
+
+        import bench
+
+        def dead_devices(*a, **k):
+            calls.append(1)
+            raise RuntimeError("tunnel worker unavailable")
+
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("BENCH_INIT_ATTEMPTS", "3")
+        monkeypatch.setattr(jax, "devices", dead_devices)
+        monkeypatch.setattr(bench.time, "sleep",
+                            lambda s: sleeps.append(s))
+
+    def test_exhausted_budget_emits_instead_of_sleeping(
+            self, monkeypatch, capsys):
+        import json
+        import time as _time
+
+        import bench
+
+        bench._WATCHDOG.update({"phase": "init", "partial": None})
+        calls, sleeps = [], []
+        self._dead(monkeypatch, calls, sleeps)
+        # 8 s left; first backoff is 5 s + 5 s emit margin > 8 s, so
+        # the sleep must be refused and the record emitted NOW.
+        bench._WATCHDOG["deadline"] = _time.monotonic() + 8.0
+        try:
+            try:
+                bench.main()
+                code = None
+            except SystemExit as exc:
+                code = exc.code
+        finally:
+            bench._WATCHDOG["deadline"] = None
+        assert code == 1
+        assert len(calls) == 1
+        assert sleeps == []                     # never slept
+        record = json.loads(capsys.readouterr().out.strip()
+                            .splitlines()[-1])
+        assert record["error"] == "device_init_failed"
+        assert record["attempts"] == 1
+        assert record["watchdog_budget_exhausted"] is True
+
+    def test_progress_noted_before_any_sleep(self, monkeypatch, capsys):
+        """A SIGTERM that lands mid-backoff must find the init failure
+        already merged into the watchdog partial — the note happens
+        before the sleep, not after the loop."""
+        import bench
+
+        bench._WATCHDOG.update({"phase": "init", "partial": None,
+                                "deadline": None})
+        calls, sleeps = [], []
+        self._dead(monkeypatch, calls, sleeps)
+        seen = []
+        real_sleep = lambda s: (sleeps.append(s), seen.append(
+            bench._WATCHDOG["partial"]["device_init"]["attempt"]))
+        monkeypatch.setattr(bench.time, "sleep", real_sleep)
+        try:
+            bench.main()
+        except SystemExit as exc:
+            assert exc.code == 1
+        capsys.readouterr()
+        assert len(calls) == 3
+        assert sleeps == [5, 15]                # bounded backoff
+        assert seen == [1, 2]                   # noted BEFORE sleeping
+        assert bench._WATCHDOG["phase"] == "device_init"
+        assert (bench._WATCHDOG["partial"]["device_init"]["attempt"]
+                == 3)

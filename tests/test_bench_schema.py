@@ -1,0 +1,124 @@
+"""BENCH_*.json schema contract (tools/check_bench_schema.py): shape
+fixtures for every known record kind — including the BENCH_r05
+postmortem shapes (watchdog partials, null-parsed wrappers) — plus the
+repo's real recorded trajectory, validated in tier-1 so drift in what
+bench.py emits fails loudly here instead of in a human's editor.
+"""
+
+import glob
+import json
+import os
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tools"))
+import check_bench_schema as cbs  # noqa: E402
+
+ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+
+
+def issues_for(doc):
+    issues = []
+    cbs.validate(doc, issues)
+    return issues
+
+
+GOOD_RESULT = {
+    "metric": "rounds_per_sec", "unit": "1/s", "value": 30.0,
+    "vs_baseline": 1.2,
+    "north_star": {"rounds_to_eps": 250},
+    "cost": {"programs": {"exact.step": {"compile_ms": 100.0}},
+             "reconciliation": {"within_tolerance": True}},
+    "regression": {"overall": "neutral", "metrics": []},
+}
+
+
+class TestResultRecords:
+    def test_good_record_clean(self):
+        assert issues_for(GOOD_RESULT) == []
+
+    def test_missing_required_keys(self):
+        issues = issues_for({"metric": "m"})
+        assert any("value" in i for i in issues)
+        assert any("unit" in i for i in issues)
+
+    def test_bad_block_types_flagged(self):
+        doc = dict(GOOD_RESULT, north_star="fast")
+        assert any("north_star" in i for i in issues_for(doc))
+
+    def test_bad_regression_overall(self):
+        doc = dict(GOOD_RESULT, regression={"overall": "maybe"})
+        assert any("regression.overall" in i for i in issues_for(doc))
+
+    def test_bad_cost_blocks(self):
+        doc = dict(GOOD_RESULT, cost={"programs": [1, 2]})
+        assert any("cost.programs" in i for i in issues_for(doc))
+
+
+class TestErrorRecords:
+    def test_device_init_failed(self):
+        good = {"error": "device_init_failed",
+                "platform_requested": "axon", "attempts": 3,
+                "message": "tunnel worker unavailable"}
+        assert issues_for(good) == []
+        assert any("attempts" in i
+                   for i in issues_for({"error": "device_init_failed",
+                                        "platform_requested": "axon",
+                                        "message": "x"}))
+
+    def test_bench_timeout_needs_watchdog_and_partial(self):
+        good = {"error": "bench_timeout", "watchdog": True,
+                "phase": "north_star", "partial": {"n": 1000}}
+        assert issues_for(good) == []
+        bad = {"error": "bench_timeout", "phase": "x", "partial": {}}
+        assert any("watchdog" in i for i in issues_for(bad))
+
+    def test_unknown_error_kind_forward_compatible(self):
+        assert issues_for({"error": "novel_failure"}) == []
+
+
+class TestDriverWrappers:
+    def wrap(self, parsed, rc=0):
+        return {"cmd": "timeout 870 python bench.py", "n": 3,
+                "parsed": parsed, "rc": rc, "tail": "..."}
+
+    def test_good_wrapper(self):
+        assert issues_for(self.wrap(GOOD_RESULT)) == []
+
+    def test_null_parsed_with_nonzero_rc_legal(self):
+        # BENCH_r05: the watchdogged run — legal shape, sad content.
+        assert issues_for(self.wrap(None, rc=124)) == []
+
+    def test_null_parsed_with_rc0_flagged(self):
+        issues = issues_for(self.wrap(None, rc=0))
+        assert any("parsed: null" in i for i in issues)
+
+    def test_result_with_nonzero_rc_flagged(self):
+        issues = issues_for(self.wrap(GOOD_RESULT, rc=1))
+        assert any("non-zero rc" in i for i in issues)
+
+    def test_error_record_with_nonzero_rc_legal(self):
+        err = {"error": "device_init_failed",
+               "platform_requested": "axon", "attempts": 3,
+               "message": "x"}
+        assert issues_for(self.wrap(err, rc=1)) == []
+
+
+class TestRealRecords:
+    def test_repo_bench_records_validate(self):
+        paths = sorted(glob.glob(os.path.join(ROOT, "BENCH_*.json")))
+        assert paths, "repo should carry recorded bench trajectory"
+        for p in paths:
+            issues = cbs.check_file(p)
+            assert issues == [], f"{p}: {issues}"
+
+    def test_cli_default_run_clean(self, capsys):
+        assert cbs.main([]) == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_cli_flags_broken_file(self, tmp_path, capsys):
+        bad = tmp_path / "BENCH_bad.json"
+        bad.write_text(json.dumps({"metric": "m"}))
+        assert cbs.main([str(bad)]) == 1
+        assert "issue" in capsys.readouterr().out

@@ -1,0 +1,358 @@
+"""Kernel-cost observatory (telemetry/cost.py): HLO parsing fixtures,
+phase-scope gating, the OFF-is-bit-identical pin per model family, the
+attribution quality gate, and the measured-vs-analytic exchange-bytes
+cross-check on both sharded twins at d ∈ {1, 2, 4, 8}.
+
+The cross-check bound is pinned EXACT for d > 1 (compiled collective
+output bytes equal the analytic per-device receive bytes to the byte)
+and ZERO at d = 1, where XLA elides the collective entirely — the
+all_to_all analytic formula still counts self-rows there (docs/perf.md).
+"""
+
+import gzip
+import json
+import os
+
+import jax
+import pytest
+
+from sidecar_tpu import metrics
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology
+from sidecar_tpu.parallel.mesh import make_mesh
+from sidecar_tpu.parallel.sharded import ShardedSim
+from sidecar_tpu.parallel.sharded_compressed import ShardedCompressedSim
+from sidecar_tpu.telemetry import cost
+
+DET = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=4.0,
+                 sweep_interval_s=1.0)
+DET_DENSE = TimeConfig(refresh_interval_s=1000.0,
+                       push_pull_interval_s=1e6, sweep_interval_s=1.0)
+
+
+def fresh_step(sim):
+    """A NEW function object wrapping sim._step — jax keys its trace
+    cache on function identity, so reusing one lambda across a phase
+    toggle would replay the previously traced (differently
+    instrumented) program."""
+    return (lambda s: (lambda st, k: s._step(st, k)))(sim)
+
+
+# -- pure-parser fixtures ----------------------------------------------------
+
+SYNTH_HLO = """\
+HloModule jit_step
+
+fused_computation {
+  p0 = s32[16,32]{1,0} parameter(0)
+  ROOT add.0 = s32[16,32]{1,0} add(p0, p0), metadata={op_name="jit(f)/jit(main)/sidecar.phase.publish/add"}
+}
+
+ENTRY main {
+  %arg0 = s32[16,32]{1,0} parameter(0)
+  %big = s32[1024,64]{1,0} broadcast(s32[] %c), dimensions={}
+  %ag.1 = s32[64,32]{1,0} all-gather(s32[16,32]{1,0} %arg0), channel_id=1, metadata={op_name="jit(f)/jit(main)/sidecar.phase.exchange/all_gather"}
+  %ag.stray = s32[64,32]{1,0} all-gather(s32[16,32]{1,0} %arg0), channel_id=2, metadata={op_name="jit(f)/jit(main)/cond/jit(_roll_dynamic)/dynamic_slice"}
+  %cp.1 = s32[16,32]{1,0} collective-permute(s32[16,32]{1,0} %arg0), channel_id=3, metadata={op_name="jit(f)/jit(main)/sidecar.phase.exchange/ppermute"}
+  %cp.pp = s32[16,32]{1,0} collective-permute(s32[16,32]{1,0} %arg0), channel_id=4, metadata={op_name="jit(f)/jit(main)/sidecar.phase.exchange/push_pull/ppermute"}
+  %a2a-start = s32[8,64]{1,0} all-to-all-start(s32[8,64]{1,0} %arg0), channel_id=5, metadata={op_name="jit(f)/jit(main)/sidecar.phase.exchange/all_to_all"}
+  %a2a-done = s32[8,64]{1,0} all-to-all-done(s32[8,64]{1,0} %a2a-start)
+  %pub = s32[16,32]{1,0} fusion(s32[16,32]{1,0} %arg0), kind=kLoop, calls=fused_computation, metadata={op_name="jit(f)/jit(main)/sidecar.phase.publish/add"}
+  %ttl = f32[100]{0} exponential(f32[100]{0} %x), metadata={op_name="jit(f)/jit(main)/sidecar.phase.ttl_sweep/exp"}
+  %glue = s32[50]{0} iota(), iota_dimension=0, metadata={op_name="jit(f)/jit(main)/helper/iota"}
+  ROOT %t = (s32[16,32]{1,0}) tuple(s32[16,32]{1,0} %pub)
+}
+"""
+
+
+class TestShapeBytes:
+    def test_simple_and_layout(self):
+        assert cost.shape_bytes("s32[64,32]{1,0}") == 64 * 32 * 4
+        assert cost.shape_bytes("f32[100]") == 400
+        assert cost.shape_bytes("pred[8]") == 8
+        assert cost.shape_bytes("bf16[2,3]") == 12
+
+    def test_tuple_and_scalar(self):
+        assert cost.shape_bytes("(s32[4], f32[2])") == 16 + 8
+        assert cost.shape_bytes("s32[]") == 4
+
+    def test_unknown_dtype_counts_zero(self):
+        assert cost.shape_bytes("token[]") == 0
+
+
+class TestCollectiveParsing:
+    def test_kinds_bytes_and_async_once(self):
+        ops = cost.collective_ops(SYNTH_HLO)
+        kinds = sorted(o["kind"] for o in ops)
+        # 2 all-gathers, 2 permutes, 1 all-to-all (the -start; -done
+        # contributes no second payload).
+        assert kinds == ["all-gather", "all-gather", "all-to-all",
+                        "collective-permute", "collective-permute"]
+        ag = [o for o in ops if o["kind"] == "all-gather"]
+        assert all(o["bytes"] == 64 * 32 * 4 for o in ag)
+
+    def test_summary(self):
+        s = cost.collective_summary(SYNTH_HLO)
+        assert s["ops"] == 5
+        assert s["by_kind"]["all-gather"]["ops"] == 2
+        assert s["total_bytes"] == sum(
+            o["bytes"] for o in cost.collective_ops(SYNTH_HLO))
+
+
+class TestMeasuredExchangeBytes:
+    def test_all_gather_scoped_and_tiled(self):
+        # Only the exchange-scoped all-gather counts, at (d-1)/d of the
+        # full gathered output; the _roll_dynamic stray is skipped.
+        got = cost.measured_exchange_bytes(SYNTH_HLO, "all_gather", 4)
+        assert got == 64 * 32 * 4 * 3 // 4
+
+    def test_ring_excludes_push_pull(self):
+        got = cost.measured_exchange_bytes(SYNTH_HLO, "ring", 4)
+        assert got == 16 * 32 * 4           # cp.1 only, not cp.pp
+
+    def test_all_to_all_counts_start_once(self):
+        got = cost.measured_exchange_bytes(SYNTH_HLO, "all_to_all", 4)
+        assert got == 8 * 64 * 4
+
+
+class TestPhaseBytes:
+    def test_attribution_and_structural_denominator(self):
+        pb = cost.hlo_phase_bytes(SYNTH_HLO)
+        assert set(pb["by_phase"]) >= {"publish", "exchange",
+                                       "ttl_sweep"}
+        # Parameters/tuples sit OUTSIDE the fraction denominator; the
+        # unlabeled broadcast+iota+done stay inside it.
+        assert pb["structural_bytes"] > 0
+        total = pb["attributed_bytes"] + pb["unattributed_bytes"]
+        assert pb["attributed_fraction"] == round(
+            pb["attributed_bytes"] / total, 4)
+
+    def test_share_table_sums_to_one_and_reconciles(self):
+        pb = cost.hlo_phase_bytes(SYNTH_HLO)
+        table = cost.phase_share_table(pb, measured_ms_per_round=10.0)
+        shares = [r["share"] for r in table["phases"].values()]
+        assert abs(sum(shares) - 1.0) < 1e-3
+        est = sum(r["est_ms_per_round"]
+                  for r in table["phases"].values())
+        assert abs(est - 10.0) < 0.05       # reconciles by construction
+        snap = metrics.snapshot()
+        assert "phase.publish.share" in snap["gauges"]
+
+    def test_phases_off_program_attributes_nothing(self):
+        pb = cost.hlo_phase_bytes("ENTRY main {\n  %a = s32[4]{0} "
+                                  "add(s32[4]{0} %x, s32[4]{0} %y)\n}")
+        assert pb["by_phase"] == {}
+        assert pb["attributed_fraction"] == 0.0
+
+
+class TestReconcile:
+    def test_within_and_outside_tolerance(self):
+        ok = cost.reconcile(5.0, 10.0)      # coverage 0.5
+        assert ok["within_tolerance"] is True
+        low = cost.reconcile(1.0, 10.0)     # 0.1 < COVERAGE_MIN
+        assert low["within_tolerance"] is False
+        high = cost.reconcile(20.0, 10.0)   # 2.0 > COVERAGE_MAX
+        assert high["within_tolerance"] is False
+
+    def test_zero_measurement(self):
+        r = cost.reconcile(1.0, 0.0)
+        assert r["coverage"] is None
+        assert r["within_tolerance"] is False
+
+
+class TestParseProfileDir:
+    def _write_trace(self, tmp_path, events, gz=True):
+        run = tmp_path / "plugins" / "profile" / "2026_08_05"
+        run.mkdir(parents=True)
+        doc = json.dumps({"traceEvents": events}).encode()
+        if gz:
+            with gzip.open(run / "host.trace.json.gz", "wb") as fh:
+                fh.write(doc)
+        else:
+            (run / "host.trace.json").write_bytes(doc)
+        return str(tmp_path)
+
+    def test_reduces_phase_events(self, tmp_path):
+        path = self._write_trace(tmp_path, [
+            {"ph": "X", "name": "sidecar.phase.publish/fusion.1",
+             "dur": 300, "ts": 0},
+            {"ph": "X", "name": "fusion.2", "dur": 1000, "ts": 0,
+             "args": {"tf_op": "sidecar.phase.exchange/all_gather"}},
+            {"ph": "X", "name": "sidecar.phase.publish/fusion.3",
+             "dur": 700, "ts": 400},
+            {"ph": "X", "name": "unrelated", "dur": 99, "ts": 0},
+            {"ph": "M", "name": "sidecar.phase.gather", "ts": 0},
+        ])
+        out = cost.parse_profile_dir(path)
+        assert out["files"] == 1
+        assert out["phases"]["publish"] == {
+            "events": 2, "ms": 1.0, "share": 0.5}
+        assert out["phases"]["exchange"]["ms"] == 1.0
+        assert out["attributed_ms"] == 2.0
+        assert "gather" not in out["phases"]     # M events don't count
+
+    def test_empty_and_missing_dirs_degrade(self, tmp_path):
+        out = cost.parse_profile_dir(str(tmp_path))
+        assert out == {"files": 0, "phases": {}, "attributed_ms": 0.0}
+        out2 = cost.parse_profile_dir(str(tmp_path / "nope"))
+        assert out2["phases"] == {}
+
+    def test_corrupt_file_skipped(self, tmp_path):
+        run = tmp_path / "plugins" / "profile" / "r"
+        run.mkdir(parents=True)
+        (run / "bad.trace.json").write_bytes(b"not json")
+        out = cost.parse_profile_dir(str(tmp_path))
+        assert out["files"] == 0
+
+
+class TestPhaseGate:
+    def test_env_wins_over_profile_dir(self, monkeypatch):
+        from sidecar_tpu.telemetry import profiling
+
+        monkeypatch.delenv(cost.PHASE_ENV, raising=False)
+        monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+        assert cost.phases_enabled() is False
+        monkeypatch.setenv(profiling.PROFILE_ENV, "/tmp/prof")
+        assert cost.phases_enabled() is True
+        monkeypatch.setenv(cost.PHASE_ENV, "0")    # explicit 0 wins
+        assert cost.phases_enabled() is False
+        monkeypatch.setenv(cost.PHASE_ENV, "1")
+        assert cost.phases_enabled() is True
+
+    def test_forced_phases_restores(self, monkeypatch):
+        monkeypatch.delenv(cost.PHASE_ENV, raising=False)
+        with cost.forced_phases(True):
+            assert cost.phases_enabled() is True
+        assert os.environ.get(cost.PHASE_ENV) is None
+        monkeypatch.setenv(cost.PHASE_ENV, "1")
+        with cost.forced_phases(False):
+            assert cost.phases_enabled() is False
+        assert os.environ[cost.PHASE_ENV] == "1"
+
+    def test_phased_decorator_checks_per_call(self, monkeypatch):
+        calls = []
+
+        @cost.phased("publish")
+        def fn(x):
+            calls.append(x)
+            return x + 1
+
+        with cost.forced_phases(False):
+            assert fn(1) == 2
+        with cost.forced_phases(True):
+            assert fn(2) == 3
+        assert calls == [1, 2]
+
+
+class TestProgramReport:
+    def test_report_cache_and_compile_counters(self):
+        cost.reset()
+        before = metrics.counter("compile.count")
+        rep = cost.program_report(
+            "test.tiny", lambda x: x * 2,
+            jax.numpy.ones((8, 8), jax.numpy.float32))
+        assert rep["compile_ms"] >= 0
+        assert rep["memory"]["peak_bytes"] > 0
+        again = cost.program_report(
+            "test.tiny", lambda x: x,
+            jax.numpy.ones((2,), jax.numpy.float32))
+        assert again is rep or again == rep        # cached, no recompile
+        assert metrics.counter("compile.count") == before + 1
+        snap = cost.snapshot()
+        assert "test.tiny" in snap["programs"]
+        assert snap["phase_taxonomy"] == list(cost.PHASES)
+        cost.reset()
+        assert cost.snapshot()["programs"] == {}
+
+
+# -- per-family pins: OFF is bit-identical, ON attributes -------------------
+
+def _families():
+    p = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+    cp = CompressedParams(n=16, services_per_node=2, fanout=2,
+                          budget=4, cache_lines=32)
+    topo = topology.complete(16)
+    mesh = make_mesh(jax.devices()[:2])
+    return {
+        "exact": lambda: ExactSim(p, topo, DET),
+        "compressed": lambda: CompressedSim(cp, topo, DET),
+        "sharded": lambda: ShardedSim(p, topo, DET_DENSE, mesh=mesh,
+                                      board_exchange="all_gather"),
+        "sharded_compressed": lambda: ShardedCompressedSim(
+            cp, topo, DET, mesh=mesh, board_exchange="all_gather"),
+    }
+
+
+@pytest.mark.parametrize("family", ["exact", "compressed", "sharded",
+                                    "sharded_compressed"])
+def test_phases_off_compiles_bit_identical(family):
+    """The bit-identity contract: with phases off a fresh compile
+    carries no sidecar.phase scope and two fresh compiles of the same
+    step produce byte-identical HLO."""
+    build = _families()[family]
+    with cost.forced_phases(False):
+        sim = build()
+        st0 = sim.init_state()
+        key = jax.random.PRNGKey(0)
+        h1 = cost.compiled_hlo(fresh_step(sim), st0, key)
+        h2 = cost.compiled_hlo(fresh_step(sim), st0, key)
+    assert cost.PHASE_PREFIX not in h1
+    assert h1 == h2
+
+
+@pytest.mark.parametrize("family", ["exact", "compressed", "sharded",
+                                    "sharded_compressed"])
+def test_phases_on_attributes_majority_of_bytes(family):
+    """The attribution quality gate: with phases on, at least
+    MIN_ATTRIBUTED_FRACTION of non-structural compiled output bytes
+    carry a phase label, and the labels come from the taxonomy."""
+    build = _families()[family]
+    with cost.forced_phases(True):
+        sim = build()
+        st0 = sim.init_state()
+        key = jax.random.PRNGKey(0)
+        hlo = cost.compiled_hlo(fresh_step(sim), st0, key)
+    pb = cost.hlo_phase_bytes(hlo)
+    assert pb["attributed_fraction"] >= cost.MIN_ATTRIBUTED_FRACTION
+    assert set(pb["by_phase"]) <= set(cost.PHASES)
+    assert len(pb["by_phase"]) >= 3
+
+
+# -- the exchange-bytes cross-check matrix ----------------------------------
+
+def _cross_check(build_sim, mode, analytic_of):
+    for d in (1, 2, 4, 8):
+        sim = build_sim(d)
+        st0 = sim.init_state()
+        key = jax.random.PRNGKey(0)
+        with cost.forced_phases(True):
+            hlo = cost.compiled_hlo(fresh_step(sim), st0, key)
+        measured = cost.measured_exchange_bytes(hlo, mode, d)
+        expected = analytic_of(sim) if d > 1 else 0
+        assert measured == expected, (
+            f"{mode} d={d}: measured {measured} != {expected}")
+
+
+@pytest.mark.parametrize("mode", ["all_gather", "ring"])
+def test_exchange_bytes_dense_twin(mode):
+    p = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+    topo = topology.complete(16)
+    _cross_check(
+        lambda d: ShardedSim(p, topo, DET_DENSE,
+                             mesh=make_mesh(jax.devices()[:d]),
+                             board_exchange=mode),
+        mode, lambda sim: sim.exchange_bytes_per_round)
+
+
+@pytest.mark.parametrize("mode", ["all_gather", "all_to_all", "ring"])
+def test_exchange_bytes_compressed_twin(mode):
+    cp = CompressedParams(n=16, services_per_node=2, fanout=2,
+                          budget=4, cache_lines=32)
+    topo = topology.complete(16)
+    _cross_check(
+        lambda d: ShardedCompressedSim(
+            cp, topo, DET, mesh=make_mesh(jax.devices()[:d]),
+            board_exchange=mode),
+        mode, lambda sim: sim.exchange_bytes_per_round)

@@ -1,0 +1,98 @@
+"""telemetry/profiling.py: the SIDECAR_TPU_PROFILE_DIR gate, the
+process-singleton trace semaphore, annotate's null-context contract,
+and trace-directory creation on a real (tiny) traced dispatch.
+"""
+
+import contextlib
+import os
+
+import jax
+import jax.numpy as jnp
+
+from sidecar_tpu.telemetry import profiling
+
+
+class TestGate:
+    def test_profile_dir_unset_and_empty(self, monkeypatch):
+        monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+        assert profiling.profile_dir() is None
+        monkeypatch.setenv(profiling.PROFILE_ENV, "")
+        assert profiling.profile_dir() is None   # empty string is off
+
+    def test_profile_dir_set(self, monkeypatch):
+        monkeypatch.setenv(profiling.PROFILE_ENV, "/tmp/prof")
+        assert profiling.profile_dir() == "/tmp/prof"
+
+
+class TestMaybeTrace:
+    def test_noop_without_env(self, monkeypatch):
+        monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+        with profiling.maybe_trace() as started:
+            assert started is False
+
+    def test_second_concurrent_trace_skipped(self, monkeypatch,
+                                             tmp_path):
+        monkeypatch.setenv(profiling.PROFILE_ENV, str(tmp_path))
+        # Hold the gate: the inner maybe_trace must yield False rather
+        # than fight the process-global profiler state.
+        assert profiling._gate.acquire(blocking=False)
+        try:
+            with profiling.maybe_trace() as started:
+                assert started is False
+        finally:
+            profiling._gate.release()
+
+    def test_trace_creates_dir_and_releases_gate(self, tmp_path,
+                                                 monkeypatch):
+        target = tmp_path / "prof"
+        monkeypatch.setenv(profiling.PROFILE_ENV, str(target))
+        with profiling.maybe_trace() as started:
+            if started:      # profiler can be unavailable on CPU CI
+                jax.block_until_ready(jnp.ones((8, 8)) * 2)
+        # Whatever happened, the gate must be free again...
+        assert profiling._gate.acquire(blocking=False)
+        profiling._gate.release()
+        # ...and a started trace must have materialized the directory.
+        if started:
+            assert target.is_dir()
+
+    def test_explicit_log_dir_overrides_env(self, tmp_path,
+                                            monkeypatch):
+        monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+        with profiling.maybe_trace(str(tmp_path / "x")) as started:
+            assert started in (True, False)
+        assert profiling._gate.acquire(blocking=False)
+        profiling._gate.release()
+
+
+class TestAnnotate:
+    def test_null_context_when_disabled(self, monkeypatch):
+        monkeypatch.delenv(profiling.PROFILE_ENV, raising=False)
+        ctx = profiling.annotate("publish")
+        assert isinstance(ctx, contextlib.nullcontext)
+
+    def test_real_annotation_when_enabled(self, monkeypatch):
+        monkeypatch.setenv(profiling.PROFILE_ENV, "/tmp/prof")
+        ctx = profiling.annotate("publish")
+        assert not isinstance(ctx, contextlib.nullcontext)
+
+    def test_nesting_and_error_unwind(self, monkeypatch):
+        """Annotations nest and unwind cleanly through exceptions —
+        the enclosing scope stays usable after an inner raise."""
+        monkeypatch.setenv(profiling.PROFILE_ENV, "/tmp/prof")
+        with profiling.annotate("outer"):
+            try:
+                with profiling.annotate("inner"):
+                    raise ValueError("boom")
+            except ValueError:
+                pass
+            # Still inside `outer` after the unwind; a sibling scope
+            # must open and close without the profiler complaining.
+            with profiling.annotate("sibling"):
+                pass
+
+    def test_annotation_wraps_dispatch(self, monkeypatch):
+        monkeypatch.setenv(profiling.PROFILE_ENV, "/tmp/prof")
+        with profiling.annotate("chunk[0:8]"):
+            out = jax.block_until_ready(jnp.arange(8) + 1)
+        assert int(out[-1]) == 8

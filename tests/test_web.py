@@ -381,3 +381,117 @@ class TestEnvoyV1Routes:
         api = SidecarApi(make_state())
         status, *_ = api.dispatch("GET", "/v1/clusters")
         assert status == 404
+
+
+class TestCostEndpoint:
+    def test_cost_json_shape_and_recorded_program(self):
+        from sidecar_tpu.telemetry import cost
+
+        cost.record_report("web_test.prog", {
+            "program": "web_test.prog", "compile_ms": 12.5,
+            "flops": 1000, "bytes_accessed": 2048,
+        })
+        try:
+            status, ctype, body, _ = make_api().dispatch(
+                "GET", "/api/cost.json")
+            assert status == 200 and ctype == "application/json"
+            doc = json.loads(body)
+            assert set(doc) >= {"phases_enabled", "phase_taxonomy",
+                                "programs", "compile"}
+            assert doc["phase_taxonomy"] == list(cost.PHASES)
+            assert doc["programs"]["web_test.prog"]["compile_ms"] == 12.5
+            assert set(doc["compile"]) == {"count", "cache_hits"}
+        finally:
+            cost.reset()
+
+    def test_cost_json_empty_registry_still_valid(self):
+        from sidecar_tpu.telemetry import cost
+
+        cost.reset()
+        status, _, body, _ = make_api().dispatch("GET", "/api/cost.json")
+        assert status == 200
+        assert json.loads(body)["programs"] == {}
+
+
+class TestChromeTraceExport:
+    """``GET /api/trace?format=chrome`` — the span ring rendered as
+    Chrome trace-event JSON (docs/telemetry.md)."""
+
+    def _spans(self, api):
+        # Build the api FIRST: make_state() itself emits catalog.merge
+        # spans which would otherwise pollute the ring we just reset.
+        from sidecar_tpu.telemetry import reset_spans
+        from sidecar_tpu.telemetry.span import span
+
+        reset_spans()
+        with span("web.outer"):
+            with span("web.inner"):
+                pass
+        return reset_spans
+
+    def test_chrome_format_events(self):
+        api = make_api()
+        cleanup = self._spans(api)
+        try:
+            status, ctype, body, _ = api.dispatch(
+                "GET", "/api/trace", {"format": ["chrome"]})
+            assert status == 200 and ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["displayTimeUnit"] == "ms"
+            events = doc["traceEvents"]
+            xs = [e for e in events if e["ph"] == "X"]
+            metas = [e for e in events if e["ph"] == "M"]
+            assert {e["name"] for e in xs} == {"web.outer", "web.inner"}
+            assert metas and all(m["name"] == "thread_name"
+                                 for m in metas)
+            inner = next(e for e in xs if e["name"] == "web.inner")
+            outer = next(e for e in xs if e["name"] == "web.outer")
+            # Linkage ids ride in args; inner points at outer.
+            assert inner["args"]["parent_id"] == \
+                outer["args"]["span_id"]
+            # ts/dur are microseconds (spans record ms internally).
+            assert inner["dur"] <= outer["dur"]
+        finally:
+            cleanup()
+
+    def test_chrome_format_carries_cursor_keys(self):
+        from sidecar_tpu.telemetry import spans
+
+        api = make_api()
+        cleanup = self._spans(api)
+        try:
+            # Cursor just below our oldest live span: nothing dropped.
+            since = min(s["seq"] for s in spans()) - 1
+            status, _, body, _ = api.dispatch(
+                "GET", "/api/trace",
+                {"format": ["chrome"], "since": [str(since)]})
+            assert status == 200
+            doc = json.loads(body)
+            assert "next_since" in doc and "dropped" in doc
+            assert doc["dropped"] == 0
+            assert len([e for e in doc["traceEvents"]
+                        if e["ph"] == "X"]) == 2
+            # Resuming from next_since yields nothing new.
+            status2, _, body2, _ = api.dispatch(
+                "GET", "/api/trace",
+                {"format": ["chrome"],
+                 "since": [str(doc["next_since"])]})
+            assert json.loads(body2)["traceEvents"] == []
+        finally:
+            cleanup()
+
+    def test_bad_format_400(self):
+        status, _, body, _ = make_api().dispatch(
+            "GET", "/api/trace", {"format": ["perfetto"]})
+        assert status == 400
+        assert "format" in json.loads(body)["message"]
+
+    def test_default_json_format_unchanged(self):
+        api = make_api()
+        cleanup = self._spans(api)
+        try:
+            status, _, body, _ = api.dispatch("GET", "/api/trace")
+            doc = json.loads(body)
+            assert "spans" in doc and "traceEvents" not in doc
+        finally:
+            cleanup()

@@ -1,0 +1,232 @@
+#!/usr/bin/env python3
+"""Perf-regression verdict plane over bench.py JSON records.
+
+The bench trajectory (``BENCH_r*.json``) is the repo's only
+longitudinal performance record, but until now reading it meant
+eyeballing floats: the headline has been flat since PR 5 and nothing
+would have SAID SO had it regressed.  This tool turns any two bench
+records — or the whole trajectory — into parseable per-metric verdicts
+with explicit noise tolerances.
+
+Record shapes understood (see tools/check_bench_schema.py for the
+enforced schema):
+
+* a bare bench.py result object (``{"metric": ..., "value": ...}``),
+* a driver wrapper (``{"cmd", "n", "parsed", "rc", "tail"}``) — the
+  ``parsed`` payload is unwrapped, ``parsed: null`` is incomparable,
+* error records (``{"error": "device_init_failed" | "bench_timeout"}``)
+  — never compared, always surfaced as incomparable with the reason.
+
+Verdict semantics, per metric: the relative delta in the metric's
+GOOD direction (higher rounds/sec is good, lower ms/round is good) is
+compared against that metric's noise tolerance.  Inside the band →
+``neutral``; better beyond it → ``improvement``; worse beyond it →
+``regression``.  The overall verdict is the worst per-metric one
+(any regression ⇒ regression).
+
+Exit codes (CLI): 0 verdict computed and no regression, 3 regression
+found, 2 records incomparable, 1 usage/IO error — so CI can gate on
+``rc == 3`` without parsing, while the JSON on stdout carries the
+details.
+
+Library use (bench.py's ``regression`` block, tests):
+
+    from tools.bench_compare import compare, extract_record
+    verdict = compare(extract_record(prev_doc), extract_record(cur_doc))
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+from typing import Optional
+
+# (dotted key path, good direction, relative noise tolerance).
+# Tolerances are per-metric because their noise floors differ: wall
+# times on a busy host jitter far more than round counts, which are
+# deterministic given a seed.  A tolerance is the HALF-WIDTH of the
+# neutral band around zero delta.
+DEFAULT_SPECS = (
+    ("value", "higher", 0.08),
+    ("compressed_rounds_per_sec", "higher", 0.08),
+    ("north_star.wall_ms_per_round", "lower", 0.10),
+    ("north_star.wall_seconds_to_eps", "lower", 0.10),
+    ("north_star.rounds_to_eps", "lower", 0.02),
+    ("north_star_faithful.wall_ms_per_round", "lower", 0.10),
+    ("north_star_faithful.wall_seconds_to_eps", "lower", 0.10),
+    ("sharded.wall_ms_per_round", "lower", 0.10),
+)
+
+VERDICTS = ("regression", "improvement", "neutral")
+
+
+def get_path(doc: dict, path: str):
+    """``get_path({"a": {"b": 3}}, "a.b") -> 3``; None when any hop is
+    missing or not a dict."""
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def extract_record(doc) -> tuple:
+    """Normalize any known record shape to ``(kind, payload)``:
+    ``("result", parsed_dict)`` for a comparable bench result,
+    ``("error"|"watchdog"|"incomparable", info)`` otherwise."""
+    if not isinstance(doc, dict):
+        return ("incomparable", {"reason": "not an object"})
+    if "parsed" in doc and "rc" in doc:  # driver wrapper
+        inner = doc.get("parsed")
+        if inner is None:
+            return ("incomparable",
+                    {"reason": "parsed: null", "rc": doc.get("rc")})
+        return extract_record(inner)
+    if "error" in doc:
+        kind = "watchdog" if doc.get("watchdog") else "error"
+        return (kind, {"reason": doc["error"]})
+    if "metric" in doc or "value" in doc:
+        return ("result", doc)
+    return ("incomparable", {"reason": "unrecognized record shape"})
+
+
+def compare_metric(path: str, direction: str, tolerance: float,
+                   base: dict, cand: dict) -> Optional[dict]:
+    """One per-metric verdict, or None when either side lacks the
+    metric (absent metrics are skipped, not failed — older records
+    predate newer blocks)."""
+    b = get_path(base, path)
+    c = get_path(cand, path)
+    if not isinstance(b, (int, float)) or not isinstance(c, (int, float)):
+        return None
+    if b == 0:
+        return None  # no meaningful relative delta
+    raw = (c - b) / abs(b)
+    good = raw if direction == "higher" else -raw
+    if good > tolerance:
+        verdict = "improvement"
+    elif good < -tolerance:
+        verdict = "regression"
+    else:
+        verdict = "neutral"
+    return {
+        "metric": path,
+        "direction": direction,
+        "tolerance": tolerance,
+        "base": b,
+        "candidate": c,
+        "delta": round(raw, 6),
+        "delta_good": round(good, 6),
+        "verdict": verdict,
+    }
+
+
+def compare(base, cand, specs=DEFAULT_SPECS) -> dict:
+    """Verdict document for candidate-vs-base.  Either argument may be
+    any known record shape; incomparable inputs produce an
+    ``{"overall": "incomparable"}`` verdict rather than an exception."""
+    bkind, bdoc = extract_record(base)
+    ckind, cdoc = extract_record(cand)
+    if bkind != "result" or ckind != "result":
+        return {
+            "overall": "incomparable",
+            "base_kind": bkind,
+            "candidate_kind": ckind,
+            "base_info": bdoc if bkind != "result" else None,
+            "candidate_info": cdoc if ckind != "result" else None,
+            "metrics": [],
+        }
+    rows = []
+    for path, direction, tol in specs:
+        row = compare_metric(path, direction, tol, bdoc, cdoc)
+        if row is not None:
+            rows.append(row)
+    if any(r["verdict"] == "regression" for r in rows):
+        overall = "regression"
+    elif any(r["verdict"] == "improvement" for r in rows):
+        overall = "improvement"
+    elif rows:
+        overall = "neutral"
+    else:
+        overall = "incomparable"
+    return {"overall": overall, "metrics": rows,
+            "compared": len(rows)}
+
+
+def compare_trajectory(docs: list, labels: Optional[list] = None,
+                       specs=DEFAULT_SPECS) -> dict:
+    """Consecutive-pair verdicts over an ordered record sequence
+    (incomparable records are reported but skipped as comparison
+    anchors — the next comparable record compares against the last
+    comparable one, so one watchdogged run doesn't blind the plane)."""
+    labels = labels or [str(i) for i in range(len(docs))]
+    steps = []
+    last = None      # (label, doc) of last comparable record
+    worst = "neutral"
+    for label, doc in zip(labels, docs):
+        kind, info = extract_record(doc)
+        if kind != "result":
+            steps.append({"record": label, "kind": kind,
+                          "info": info, "verdict": "incomparable"})
+            continue
+        if last is not None:
+            v = compare(last[1], doc, specs)
+            v["base_record"] = last[0]
+            v["record"] = label
+            steps.append(v)
+            if v["overall"] == "regression":
+                worst = "regression"
+            elif v["overall"] == "improvement" and worst != "regression":
+                worst = "improvement"
+        else:
+            steps.append({"record": label, "kind": kind,
+                          "verdict": "baseline"})
+        last = (label, doc)
+    return {"overall": worst, "steps": steps}
+
+
+def _load(path: str):
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff bench.py JSON records with noise-tolerant "
+                    "regression verdicts.")
+    ap.add_argument("records", nargs="+",
+                    help="Two records (base candidate), or 3+ / a glob "
+                         "for trajectory mode.")
+    ap.add_argument("--trajectory", action="store_true",
+                    help="Force trajectory mode even with two records.")
+    args = ap.parse_args(argv)
+
+    paths = []
+    for pat in args.records:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else [pat])
+    try:
+        docs = [_load(p) for p in paths]
+    except (OSError, ValueError) as exc:
+        print(json.dumps({"error": "load_failed", "message": str(exc)}))
+        return 1
+
+    if len(docs) == 2 and not args.trajectory:
+        out = compare(docs[0], docs[1])
+        out["base_record"] = paths[0]
+        out["record"] = paths[1]
+    else:
+        out = compare_trajectory(docs, labels=paths)
+    print(json.dumps(out, indent=2, sort_keys=True))
+    if out["overall"] == "regression":
+        return 3
+    if out["overall"] == "incomparable":
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+#!/usr/bin/env python3
+"""Schema check for ``BENCH_*.json`` records — the bench trajectory's
+contract with its consumers.
+
+Three layers read these records: tools/bench_compare.py (the verdict
+plane), benchmarks/RESULTS.md (humans), and the harness driver that
+wraps bench.py's stdout.  The BENCH_r05 postmortem showed the failure
+mode this guards: a run can die in ways that leave a record SHAPED
+wrong (``parsed: null`` with rc 124 and no watchdog payload), and
+nothing complained until a human opened the file.  This tool validates
+every known record shape and fails loudly on drift; tier-1 runs it
+over fixtures and the repo's real records (tests/test_bench_schema.py).
+
+Shapes validated:
+
+* **driver wrapper** — ``{"cmd": str, "n": int, "parsed": object|null,
+  "rc": int, "tail": str}``.  ``parsed: null`` is legal ONLY for a
+  non-zero rc (a successful run must parse).
+* **result record** — requires ``metric``/``value``/``unit``;
+  optional blocks (``north_star``, ``north_star_faithful``, ``cost``,
+  ``regression``, ``sharded``…) are type-checked when present.
+* **error records** — ``{"error": "device_init_failed", ...}`` needs
+  ``platform_requested``/``attempts``/``message``;
+  ``{"error": "bench_timeout", "watchdog": true, ...}`` needs
+  ``phase``/``partial``.
+
+Usage: ``python tools/check_bench_schema.py [FILES...]`` — defaults to
+``BENCH_*.json`` in the repo root; exits 0 when clean, 1 with a
+per-record report otherwise.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+from typing import List
+
+NUMBER = (int, float)
+
+# Optional result-record blocks: name -> minimal type contract, checked
+# only when present (older records legitimately predate newer blocks).
+KNOWN_RESULT_BLOCKS = {
+    "north_star": dict,
+    "north_star_faithful": dict,
+    "sharded": dict,
+    "query": dict,
+    "robustness": dict,
+    "sweep": dict,
+    "cost": dict,
+    "regression": dict,
+    "telemetry": dict,
+    "kernels": (str, dict),
+}
+
+
+def _require(doc: dict, key: str, types, issues: List[str],
+             ctx: str) -> bool:
+    if key not in doc:
+        issues.append(f"{ctx}: missing required key {key!r}")
+        return False
+    if not isinstance(doc[key], types):
+        issues.append(
+            f"{ctx}: key {key!r} has type "
+            f"{type(doc[key]).__name__}, expected "
+            f"{types if isinstance(types, type) else types}")
+        return False
+    return True
+
+
+def validate_result(doc: dict, issues: List[str],
+                    ctx: str = "result") -> None:
+    _require(doc, "metric", str, issues, ctx)
+    _require(doc, "value", NUMBER, issues, ctx)
+    _require(doc, "unit", str, issues, ctx)
+    if "vs_baseline" in doc and not isinstance(doc["vs_baseline"],
+                                               NUMBER):
+        issues.append(f"{ctx}: vs_baseline is not a number")
+    for name, types in KNOWN_RESULT_BLOCKS.items():
+        if name in doc and not isinstance(doc[name], types):
+            issues.append(
+                f"{ctx}: block {name!r} has type "
+                f"{type(doc[name]).__name__}")
+    if isinstance(doc.get("regression"), dict):
+        overall = doc["regression"].get("overall")
+        if overall not in ("regression", "improvement", "neutral",
+                           "incomparable"):
+            issues.append(
+                f"{ctx}: regression.overall is {overall!r}")
+    if isinstance(doc.get("cost"), dict):
+        cost = doc["cost"]
+        for key in ("programs", "reconciliation"):
+            if key in cost and not isinstance(cost[key], dict):
+                issues.append(f"{ctx}: cost.{key} is not an object")
+
+
+def validate_error(doc: dict, issues: List[str],
+                   ctx: str = "error") -> None:
+    err = doc.get("error")
+    if not isinstance(err, str):
+        issues.append(f"{ctx}: error key is not a string")
+        return
+    if err == "device_init_failed":
+        _require(doc, "platform_requested", str, issues, ctx)
+        _require(doc, "attempts", int, issues, ctx)
+        _require(doc, "message", str, issues, ctx)
+    elif err == "bench_timeout":
+        if doc.get("watchdog") is not True:
+            issues.append(f"{ctx}: bench_timeout without watchdog: true")
+        _require(doc, "phase", str, issues, ctx)
+        _require(doc, "partial", dict, issues, ctx)
+    # Unknown error kinds are legal (forward compatible) as long as the
+    # error key itself is a string.
+
+
+def validate_record(doc, issues: List[str], ctx: str = "record") -> None:
+    """Validate a bare bench record (result or error)."""
+    if not isinstance(doc, dict):
+        issues.append(f"{ctx}: not a JSON object")
+        return
+    if "error" in doc:
+        validate_error(doc, issues, ctx)
+    else:
+        validate_result(doc, issues, ctx)
+
+
+def validate_wrapper(doc: dict, issues: List[str],
+                     ctx: str = "wrapper") -> None:
+    """Validate a driver wrapper (``{"cmd", "n", "parsed", "rc",
+    "tail"}``) including its ``parsed`` payload."""
+    _require(doc, "cmd", str, issues, ctx)
+    _require(doc, "rc", int, issues, ctx)
+    _require(doc, "tail", str, issues, ctx)
+    if "n" in doc and not isinstance(doc["n"], int):
+        issues.append(f"{ctx}: n is not an int")
+    if "parsed" not in doc:
+        issues.append(f"{ctx}: missing parsed key")
+        return
+    parsed = doc["parsed"]
+    if parsed is None:
+        if doc.get("rc") == 0:
+            issues.append(
+                f"{ctx}: rc 0 with parsed: null — a successful run "
+                "must emit a parseable record")
+        return
+    validate_record(parsed, issues, f"{ctx}.parsed")
+    rc = doc.get("rc")
+    if isinstance(parsed, dict) and "error" not in parsed and rc not in (0, None):
+        issues.append(
+            f"{ctx}: result record with non-zero rc {rc}")
+
+
+def validate(doc, issues: List[str], ctx: str = "record") -> None:
+    """Validate any known top-level shape (wrapper or bare record)."""
+    if isinstance(doc, dict) and "parsed" in doc and "rc" in doc:
+        validate_wrapper(doc, issues, ctx)
+    else:
+        validate_record(doc, issues, ctx)
+
+
+def check_file(path: str) -> List[str]:
+    issues: List[str] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as exc:
+        return [f"{path}: unreadable ({exc})"]
+    validate(doc, issues, ctx=os.path.basename(path))
+    return issues
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:])
+    paths = args or sorted(
+        glob.glob(os.path.join(os.path.dirname(__file__), os.pardir,
+                               "BENCH_*.json")))
+    if not paths:
+        print("check_bench_schema: no records found")
+        return 1
+    all_issues: List[str] = []
+    for p in paths:
+        all_issues.extend(check_file(p))
+    if all_issues:
+        print(f"check_bench_schema: {len(all_issues)} issue(s)")
+        for issue in all_issues:
+            print(f"  {issue}")
+        return 1
+    print(f"check_bench_schema: {len(paths)} record(s) OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
