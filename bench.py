@@ -805,6 +805,27 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# sweep bench failed: {exc}", file=sys.stderr)
 
+    # Locality-aware overlay block (benchmarks/topology_sweep.py,
+    # docs/topology.md): zoned overlay + board_exchange="zoned" vs
+    # complete + all_gather on a sharded mesh — analytic AND
+    # measured-from-HLO cross-shard byte cut at matched rounds-to-ε.
+    # Skipped outright below 2 devices (no cross-shard wire exists).
+    # BENCH_TOPOLOGY=0 skips it; BENCH_TOPOLOGY_NODES sizes the
+    # cluster; BENCH_TOPOLOGY_ROUNDS caps the convergence horizon.
+    topology_block = None
+    if os.environ.get("BENCH_TOPOLOGY", "1") != "0" \
+            and len(jax.devices()) >= 2:
+        try:
+            from benchmarks.topology_sweep import run_topology_bench
+            _watchdog_note("topology")
+            topology_block = run_topology_bench(
+                n=int(os.environ.get("BENCH_TOPOLOGY_NODES", "4096")),
+                rounds=int(os.environ.get("BENCH_TOPOLOGY_ROUNDS",
+                                          "64"))) or None
+            _watchdog_note("topology", {"topology": topology_block})
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# topology bench failed: {exc}", file=sys.stderr)
+
     # Kernel-cost observatory block (sidecar_tpu/telemetry/cost.py,
     # docs/perf.md): per-phase attribution + compile/HBM telemetry for
     # the single-chip families, reconciled against the measured
@@ -850,6 +871,7 @@ def main() -> None:
         **({"query": query_bench} if query_bench else {}),
         **({"robustness": robustness} if robustness else {}),
         **({"sweep": sweep} if sweep else {}),
+        **({"topology": topology_block} if topology_block else {}),
         **({"cost": cost_block} if cost_block else {}),
         "telemetry": telemetry,
     }

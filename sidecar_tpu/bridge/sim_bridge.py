@@ -23,6 +23,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -648,7 +649,8 @@ class SimBridge:
         point shares.  Scenarios are synthetic cold-start clusters of
         ``n`` nodes (default: the live catalog's node count, so the
         sweep plans capacity for THIS cluster's shape) on the exact
-        model.  Compile-key axes (fanout, budget) group into separate
+        model.  Compile-key axes (fanout, budget, topology — an
+        ``ops/topology.from_name`` overlay) group into separate
         batches; data axes vary within one compiled scan.  Each row
         reports rounds/seconds-to-ε and the analytic exchange bytes
         spent getting there (early exit freezes both at the crossing);
@@ -682,6 +684,20 @@ class SimBridge:
                 f"conv_every={conv_every}")
         base = dict(base or {})
         base.setdefault("seed", seed)
+        # Process-wide default overlay for sweep points that don't name
+        # one (docs/topology.md); an explicit base/axis value wins.
+        env_topo = os.environ.get("SIDECAR_TPU_TOPOLOGY", "").strip()
+        if env_topo and "topology" not in axes:
+            base.setdefault("topology", env_topo)
+        # Overlay names are validated BEFORE the grid expands — an
+        # unknown/invalid name is a named 400 up front, not a compile
+        # failure batches into the dispatch loop.
+        tvals = axes.get("topology")
+        tvals = list(tvals) if isinstance(tvals, (list, tuple)) else []
+        if base.get("topology"):
+            tvals.append(base["topology"])
+        for t_name in dict.fromkeys(tvals):
+            topo_mod.from_name(str(t_name), int(n))  # ValueError → 400
         # Library-only axes get a NAMED rejection here rather than the
         # batch builder's family/plan error: the HTTP surface has no
         # way to supply a FaultPlan structure or select the compressed

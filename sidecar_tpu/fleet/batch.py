@@ -65,6 +65,7 @@ class ScenarioSpec:
     fault_seed: Optional[int] = None  # chaos: per-scenario FaultPlan seed
     fanout: Optional[int] = None     # compile-key; must match the batch
     budget: Optional[int] = None     # compile-key; must match the batch
+    topology: Optional[str] = None   # compile-key; ops/topology.from_name
     mint_frac: float = 0.0           # compressed: initial churn burst
     mint_tick: int = 10
     push_pull_interval_s: Optional[float] = None
@@ -100,6 +101,8 @@ class ScenarioBatch:
     knobs: RoundKnobs                # [S]-stacked data axes
     keys: jax.Array                  # [S] per-scenario PRNG keys
     plan: Any = None                 # shared FaultPlan structure, or None
+    topology: Optional[str] = None   # batch-uniform overlay name, or None
+    #                                  (= complete; ops/topology.from_name)
 
     @property
     def size(self) -> int:
@@ -190,6 +193,13 @@ class ScenarioBatch:
                 raise ValueError(
                     f"{s.name}: budget={s.budget} is a compile-key axis "
                     f"and must equal the batch's budget={params.budget}")
+            if s.topology != specs[0].topology:
+                raise ValueError(
+                    f"{s.name}: topology={s.topology!r} is a compile-key "
+                    "axis (it shapes the neighbor tables baked into the "
+                    f"round) and must be batch-uniform; this batch is "
+                    f"{specs[0].topology!r} — sweep it ACROSS batches "
+                    "(fleet/grid.py groups by it)")
             validate_protocol_config(
                 params.n, fanout=params.fanout, budget=params.budget,
                 retransmit_limit=s.retransmit_limit or 0,
@@ -279,7 +289,8 @@ class ScenarioBatch:
         )
         keys = jnp.stack([jax.random.PRNGKey(s.seed) for s in specs])
         return cls(family=family, params=params, timecfg=timecfg,
-                   specs=specs, knobs=knobs, keys=keys, plan=plan)
+                   specs=specs, knobs=knobs, keys=keys, plan=plan,
+                   topology=specs[0].topology)
 
 
 def restart_churn_perturb(params, prob: Optional[float] = None):

@@ -46,7 +46,7 @@ from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.kernels import eligible_lines
-from sidecar_tpu.ops.topology import Topology, complete
+from sidecar_tpu.ops.topology import Topology, complete, from_name
 
 
 @jax.tree_util.register_dataclass
@@ -179,7 +179,12 @@ class FleetSim:
         self.batch = batch
         self.mesh = mesh = resolve_fleet_mesh(mesh)
         p = batch.params
-        topo = topo if topo is not None else complete(p.n)
+        if topo is None:
+            # The batch's compile-key overlay name (fleet/grid.py groups
+            # grid points by it); None/"" = the complete graph.
+            batch_topo = getattr(batch, "topology", None)
+            topo = (from_name(batch_topo, p.n) if batch_topo
+                    else complete(p.n))
         perturb = None
         if batch.has_churn:
             perturb = restart_churn_perturb(p)   # knob-driven churn

@@ -12,7 +12,9 @@ parameter space:
   ``alive_lifespan_s``, ``draining_lifespan_s``,
   ``tombstone_lifespan_s``, ``future_fudge_s``);
 * **compile-key axes** (group into separate batches, each its own
-  compiled program): ``fanout``, ``budget``.
+  compiled program): ``fanout``, ``budget``, ``topology``
+  (an ``ops/topology.from_name`` overlay name — the neighbor tables
+  are baked into the compiled round).
 
 Grids larger than one batch are chunked at
 ``SIDECAR_TPU_FLEET_MAX_BATCH`` scenarios (default 64) — the chunk
@@ -36,7 +38,7 @@ _DATA_AXES = (
     "refresh_interval_s", "suspicion_window_s", "alive_lifespan_s",
     "draining_lifespan_s", "tombstone_lifespan_s", "future_fudge_s",
 )
-_STATIC_AXES = ("fanout", "budget")
+_STATIC_AXES = ("fanout", "budget", "topology")
 KNOWN_AXES = _DATA_AXES + _STATIC_AXES
 
 DEFAULT_MAX_BATCH = 64
@@ -104,10 +106,11 @@ def build_batches(specs, params, timecfg: TimeConfig = TimeConfig(),
     groups: dict = {}
     for idx, s in enumerate(specs):
         key = (s.fanout if s.fanout is not None else params.fanout,
-               s.budget if s.budget is not None else params.budget)
+               s.budget if s.budget is not None else params.budget,
+               s.topology if s.topology is not None else "")
         groups.setdefault(key, []).append(idx)
     out = []
-    for (fanout, budget), idxs in sorted(groups.items()):
+    for (fanout, budget, _topology), idxs in sorted(groups.items()):
         p = dataclasses.replace(params, fanout=fanout, budget=budget)
         for lo in range(0, len(idxs), cap):
             chunk = idxs[lo:lo + cap]

@@ -317,6 +317,13 @@ class CompressedSim:
         self._nbrs = None if topo.nbrs is None else jnp.asarray(topo.nbrs)
         self._deg = None if topo.deg is None else jnp.asarray(topo.deg)
         self._cut = None if cut_mask is None else jnp.asarray(cut_mask)
+        # Round-stagger phase offsets (ops/topology.with_stagger,
+        # docs/topology.md): None compiles the unstaggered program bit
+        # for bit — the round only passes the gating kwargs when active.
+        self._stagger = (None if topo.stagger is None
+                         or topo.stagger_period <= 1
+                         else jnp.asarray(topo.stagger, jnp.int32))
+        self._stagger_period = int(topo.stagger_period)
         self._side = None if node_side is None else \
             jnp.asarray(node_side, jnp.int32)
         # Kernel path (ops/kernels): resolved ONCE at construction — the
@@ -343,6 +350,17 @@ class CompressedSim:
         # a DEVICE array, so grabbing the handle right after a
         # pipelined dispatch never blocks; None after dense dispatches.
         self.last_sparse_stats = None
+
+    def _stagger_kw(self, round_idx):
+        """The ``sample_peers`` stagger kwargs for this round — ``{}``
+        when no stagger is attached, so the call (and the compiled
+        program) is byte-identical to the pre-stagger form.  Gossip
+        fan-out only; the stride push-pull draw never takes these."""
+        if self._stagger is None:
+            return {}
+        return dict(stagger=self._stagger,
+                    stagger_period=self._stagger_period,
+                    round_idx=round_idx)
 
     # -- state construction -------------------------------------------------
 
@@ -1008,7 +1026,8 @@ class CompressedSim:
 
         src = gossip_ops.sample_peers(
             k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
-            node_alive=state.node_alive, cut_mask=self._cut)
+            node_alive=state.node_alive, cut_mask=self._cut,
+            **self._stagger_kw(round_idx))
         state = self._round_gossip_announce(state, src, k_drop,
                                             round_idx, now, kn=kn)
 
@@ -1156,7 +1175,8 @@ class CompressedSim:
 
         src = gossip_ops.sample_peers(
             k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
-            node_alive=state.node_alive, cut_mask=self._cut)
+            node_alive=state.node_alive, cut_mask=self._cut,
+            **self._stagger_kw(round_idx))
 
         sender, recv, announcer, ann = self._sparse_frontiers(
             state, src, limit, round_idx, now)
@@ -1383,6 +1403,8 @@ class CompressedSim:
         alive = state.node_alive
 
         src = self._prov_sample_src(k_peers, alive)
+        src = gossip_ops.stagger_gate(src, round_idx, self._stagger,
+                                      self._stagger_period)
         pulls = [(src, None)]
 
         # The stride exchange (_push_pull_stride): node i merges the

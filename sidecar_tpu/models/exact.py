@@ -164,6 +164,13 @@ class ExactSim:
         self._nbrs = None if topo.nbrs is None else jnp.asarray(topo.nbrs)
         self._deg = None if topo.deg is None else jnp.asarray(topo.deg)
         self._cut = None if cut_mask is None else jnp.asarray(cut_mask)
+        # Round-stagger phase offsets (ops/topology.with_stagger,
+        # docs/topology.md): None compiles the unstaggered program bit
+        # for bit — the round only passes the gating kwargs when active.
+        self._stagger = (None if topo.stagger is None
+                         or topo.stagger_period <= 1
+                         else jnp.asarray(topo.stagger, jnp.int32))
+        self._stagger_period = int(topo.stagger_period)
         # The static data-axis knob bundle (ops/knobs.py): plain Python
         # scalars that const-fold the round into exactly the pre-knob
         # program; the fleet engine overrides per round with a stacked,
@@ -176,6 +183,17 @@ class ExactSim:
         self._skew_ticks = 0
         # owner[m] = node that announces slot m.
         self.owner = jnp.arange(params.m, dtype=jnp.int32) // params.services_per_node
+
+    def _stagger_kw(self, round_idx):
+        """The ``sample_peers`` stagger kwargs for this round — ``{}``
+        when no stagger is attached, so the call (and the compiled
+        program) is byte-identical to the pre-stagger form.  Gossip
+        fan-out only; the push-pull partner draw never takes these."""
+        if self._stagger is None:
+            return {}
+        return dict(stagger=self._stagger,
+                    stagger_period=self._stagger_period,
+                    round_idx=round_idx)
 
     # -- state construction ------------------------------------------------
 
@@ -349,6 +367,7 @@ class ExactSim:
             k_peers, p.n, p.fanout,
             nbrs=self._nbrs, deg=self._deg,
             node_alive=node_alive, cut_mask=self._cut,
+            **self._stagger_kw(round_idx),
         )
         known, sent = self._round_deliver_announce(
             known, sent, node_alive, dst, k_drop, round_idx, now, kn=kn)
@@ -415,6 +434,7 @@ class ExactSim:
             k_peers, p.n, p.fanout,
             nbrs=self._nbrs, deg=self._deg,
             node_alive=node_alive, cut_mask=self._cut,
+            **self._stagger_kw(round_idx),
         )
         sender = jnp.any(
             gossip_ops.eligible_records(known, sent, limit), axis=1)
@@ -516,6 +536,7 @@ class ExactSim:
             k_peers, p.n, p.fanout,
             nbrs=self._nbrs, deg=self._deg,
             node_alive=node_alive, cut_mask=self._cut,
+            **self._stagger_kw(round_idx),
         )
         pp_partner = gossip_ops.sample_peers(
             k_pp, p.n, 1,

@@ -116,8 +116,26 @@ def refresh_due(own, slots, round_idx, *, refresh_rounds: int,
     return at_phase & (elapsed >= guard)
 
 
+def stagger_gate(dst, round_idx, stagger, stagger_period: int,
+                 self_idx=None):
+    """Round-stagger phase gate (pipelined gossiping, docs/topology.md):
+    a node whose phase is off this round — ``(round_idx + stagger[i]) %
+    period != 0`` — resolves every sampled target to itself (the merge
+    no-op self-send, like dead senders and cut edges).  ``stagger=None``
+    or period ≤ 1 returns ``dst`` untouched — the unstaggered program,
+    bit for bit.  Gossip fan-out only; anti-entropy push-pull is never
+    staggered (it is the catch-up channel)."""
+    if stagger is None or stagger_period <= 1:
+        return dst
+    if self_idx is None:
+        self_idx = jnp.arange(dst.shape[0], dtype=jnp.int32)
+    off = ((round_idx + stagger) % stagger_period) != 0
+    return jnp.where(off[:, None], self_idx.reshape(-1, 1), dst)
+
+
 def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
-                 cut_mask=None):
+                 cut_mask=None, stagger=None, stagger_period=1,
+                 round_idx=None):
     """Sample ``fanout`` gossip targets per node.
 
     Returns dst[int32 N, fanout].  Dead senders and cut edges resolve to
@@ -126,6 +144,10 @@ def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
     nbrs/deg: padded neighbor list (see ops/topology.py); None = complete
     graph, sampled without self via the shift trick.
     cut_mask: bool[N, K] marking partitioned-away edges.
+    stagger/stagger_period: per-node round-phase offsets
+    (:func:`stagger_gate`; needs ``round_idx``).  The PRNG draw happens
+    unconditionally — staggering gates delivery, never the stream — so
+    staggered and unstaggered runs stay key-comparable.
     """
     self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
     if nbrs is None:
@@ -147,6 +169,11 @@ def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
             dst = jnp.where(cut, self_idx, dst)
     if node_alive is not None:
         dst = jnp.where(node_alive[:, None], dst, self_idx)
+    if stagger is not None and stagger_period > 1:
+        if round_idx is None:
+            raise ValueError("stagger gating needs the current round_idx")
+        dst = stagger_gate(dst, round_idx, stagger, stagger_period,
+                           self_idx=self_idx[:, 0])
     return dst
 
 

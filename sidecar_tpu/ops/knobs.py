@@ -14,8 +14,10 @@ pytree — which requires splitting the parameter space in two:
   (the sampled-peer width), ``budget`` (the message width),
   ``cache_lines``, ``round_ticks`` (the tick resolution every cadence
   is derived from), ``fold_quorum``/``deep_sweep_every`` (static
-  Python branches), the topology, and the FaultPlan *structure*.
-  ``fleet/grid.py`` sweeps these ACROSS batches, not within one.
+  Python branches), the topology (a ``ScenarioSpec.topology`` overlay
+  name — its neighbor tables are constants baked into the round), and
+  the FaultPlan *structure*.  ``fleet/grid.py`` sweeps these ACROSS
+  batches, not within one.
 * **Data axes** (this bundle): values consumed only by elementwise
   math and ``lax.cond`` predicates — the transmit limit, packet-loss
   keep probability, push-pull/sweep/refresh cadences, suspicion

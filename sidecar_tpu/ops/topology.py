@@ -21,6 +21,7 @@ not the hot path) and return device-ready arrays.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Optional
 
 import numpy as np
@@ -30,16 +31,28 @@ import numpy as np
 class Topology:
     """Peer-adjacency for N nodes.
 
-    ``nbrs`` is None for the complete graph.  ``cut_mask`` (optional,
-    bool[N, K]) marks edges disabled while a network partition is active
-    (the split+heal scenario, BASELINE.json config 5); the gossip kernel
-    treats a cut edge as a self-loop (no-op delivery).
+    ``nbrs`` is None for the complete graph.  Partition cuts are NOT a
+    ``Topology`` attribute: a ``cut_mask`` (bool[N, K], built by
+    :func:`partition_mask`) is passed separately to the gossip kernel /
+    sim constructors, because a cut is transient round state (the
+    split+heal scenario, BASELINE.json config 5) while the adjacency is
+    compile-time structure; the kernel treats a cut edge as a self-loop
+    (no-op delivery).
+
+    ``stagger``/``stagger_period`` (optional) carry per-node round-phase
+    offsets for pipelined gossiping (docs/topology.md): node ``i``
+    gossips only on rounds where ``(round + stagger[i]) % period == 0``
+    and self-loops otherwise.  ``None``/period ≤ 1 compiles to the
+    unstaggered program bit for bit.  Anti-entropy push-pull is never
+    staggered — it is the catch-up channel.
     """
 
     n: int
     nbrs: Optional[np.ndarray] = None  # int32 [N, K], padded with self-index
     deg: Optional[np.ndarray] = None   # int32 [N]
     name: str = "complete"
+    stagger: Optional[np.ndarray] = None  # int32 [N] phase offsets
+    stagger_period: int = 1
 
     @property
     def max_degree(self) -> int:
@@ -73,10 +86,21 @@ def ring(n: int, hops: int = 1) -> Topology:
 
 
 def erdos_renyi(n: int, avg_degree: float, seed: int = 0) -> Topology:
-    """Erdős–Rényi G(n, p) with p = avg_degree/(n-1) (config 3)."""
+    """Erdős–Rényi G(n, p) with p = avg_degree/(n-1) (config 3).
+
+    Fully vectorized (the original per-row Python append loop took tens
+    of seconds at 100k+ nodes; builder cost matters once ``/sweep``
+    builds per-scenario overlays) and bit-identical to it: the RNG
+    draws are the same block-of-rows ``random((rows, n))`` calls, and
+    the append order of the loop left every adjacency row ascending —
+    node v collected its smaller neighbors while their rows were
+    processed (in ascending i) and its larger ones from its own row (in
+    ascending j) — so a lexsorted edge list reproduces the exact padded
+    rows.
+    """
     rng = np.random.default_rng(seed)
     p = min(1.0, avg_degree / max(1, n - 1))
-    adj: list[list[int]] = [[] for _ in range(n)]
+    srcs, dsts = [], []
     # Sample undirected edges in blocks of rows to bound memory.
     block = max(1, min(n, 4_000_000 // max(n, 1) + 1))
     for start in range(0, n, block):
@@ -85,11 +109,23 @@ def erdos_renyi(n: int, avg_degree: float, seed: int = 0) -> Topology:
         mask = rng.random((stop - start, n)) < p
         # Keep upper triangle only (i < j) to avoid double-sampling.
         mask &= np.arange(n)[None, :] > rows[:, None]
-        for r, i in enumerate(rows):
-            for j in np.nonzero(mask[r])[0]:
-                adj[i].append(int(j))
-                adj[j].append(int(i))
-    return _pad_neighbor_list(n, adj, f"er{avg_degree:g}")
+        r, c = np.nonzero(mask)
+        srcs.append(rows[r])
+        dsts.append(c)
+    i = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
+    j = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
+    # Both directions of every undirected edge, ascending per node.
+    src = np.concatenate([i, j])
+    dst = np.concatenate([j, i])
+    order = np.lexsort((dst, src))
+    src, dst = src[order], dst[order]
+    deg = np.bincount(src, minlength=n).astype(np.int32)
+    k = max(1, int(deg.max())) if n else 1
+    nbrs = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, k))
+    starts = np.cumsum(deg, dtype=np.int64) - deg
+    col = np.arange(src.shape[0], dtype=np.int64) - starts[src]
+    nbrs[src, col] = dst.astype(np.int32)
+    return Topology(n=n, nbrs=nbrs, deg=deg, name=f"er{avg_degree:g}")
 
 
 def barabasi_albert(n: int, m: int, seed: int = 0) -> Topology:
@@ -145,6 +181,166 @@ def mesh2d(rows: int, cols: int) -> Topology:
     return Topology(n=n, nbrs=nbrs, deg=deg, name=f"mesh{rows}x{cols}")
 
 
+def ring_chord(n: int) -> Topology:
+    """Ring ±1 plus symmetric power-of-two chord fingers (±2, ±4, …):
+    the classic O(log n)-diameter structured overlay.  Undirected —
+    every finger is added in both directions."""
+    offsets = [1, -1]
+    f = 2
+    while f <= (n - 1) // 2:
+        offsets.extend((f, -f))
+        f *= 2
+    idx = np.arange(n, dtype=np.int32)
+    cols, seen = [], set()
+    for d in offsets:
+        d_mod = d % n
+        if d_mod == 0 or d_mod in seen:
+            continue
+        seen.add(d_mod)
+        cols.append((idx + d) % n)
+    nbrs = np.stack(cols, axis=1).astype(np.int32)
+    deg = np.full(n, nbrs.shape[1], dtype=np.int32)
+    return Topology(n=n, nbrs=nbrs, deg=deg, name="chord")
+
+
+def expander(n: int, k: int = 4, seed: int = 0) -> Topology:
+    """Random k-regular-ish expander: the union of ``k // 2`` seeded
+    Hamiltonian cycles (each cycle contributes one left and one right
+    neighbor per node).  Connected by construction — every cycle visits
+    all nodes — and undirected; coincident cycle edges are deduped per
+    node, so ``deg`` may dip slightly below k on small n."""
+    if k < 2 or k % 2:
+        raise ValueError(f"expander degree k must be even and >= 2, got {k}")
+    rng = np.random.default_rng(seed)
+    adj: list[list[int]] = [[] for _ in range(n)]
+    for _ in range(k // 2):
+        perm = rng.permutation(n)
+        nxt = np.roll(perm, -1)
+        for a, b in zip(perm, nxt):
+            a, b = int(a), int(b)
+            if b not in adj[a]:
+                adj[a].append(b)
+            if a not in adj[b]:
+                adj[b].append(a)
+    return _pad_neighbor_list(n, adj, f"expander{k}")
+
+
+def zoned(n: int, zones: int, *, local_hops: int = 2, remote_deg: int = 2,
+          local_bias: float = 0.5, gateways: int = 2,
+          seed: int = 0) -> Topology:
+    """Zone-aware two-tier sampling table (docs/topology.md).
+
+    Nodes are grouped into ``zones`` contiguous blocks.  The LOCAL tier
+    is a within-zone ring lattice (``local_hops`` each side —
+    deterministic, symmetric, connected within the zone).  The REMOTE
+    tier gives every node ``remote_deg`` directed links into ONE seeded
+    target zone (not its own) — concentrating each node's cross-zone
+    reach on a single zone is what keeps the zoned board exchange's
+    per-shard-pair row blocks narrow (:func:`zoned_exchange_plan`).
+    The first ``gateways`` nodes of each zone additionally link (both
+    directions) to their positional twin in the next zone, so the zone
+    graph contains a deterministic inter-zone ring and the overlay is
+    connected by construction.
+
+    ``local_bias`` sets the probability that a uniform neighbor-table
+    draw lands in the local tier: local entries are replicated an
+    integer number of times so the local fraction of the padded row
+    approximates it (quantized — the realized bias is
+    ``r·L / (r·L + R)``).
+
+    Shard alignment rule: with ``n % d == 0`` meshes, choosing
+    ``zones`` as a multiple of d makes every zone fall entirely inside
+    one shard, so sampling locality becomes shard locality and the
+    ``board_exchange="zoned"`` mode ships only the narrow cross-shard
+    blocks (docs/sharding.md).
+    """
+    if n % zones:
+        raise ValueError(f"zones={zones} must divide n={n}")
+    zl = n // zones
+    if zl < 2:
+        raise ValueError(f"zoned needs >= 2 nodes per zone, got {zl}")
+    if not 0.0 < local_bias < 1.0:
+        raise ValueError(f"local_bias must be in (0, 1), got {local_bias}")
+    rng = np.random.default_rng(seed)
+    idx = np.arange(n, dtype=np.int32)
+    zone_of = idx // zl
+    z0 = zone_of * zl                      # zone block start
+    pos = idx - z0                         # position within zone
+
+    # Local tier: within-zone ring lattice, ±1..±local_hops.
+    hops = [h for step in range(1, local_hops + 1) for h in (step, -step)]
+    # A zone of zl nodes has at most zl-1 distinct others.
+    local_cols = []
+    seen_off = set()
+    for h in hops:
+        if h % zl == 0 or (h % zl) in seen_off:
+            continue
+        seen_off.add(h % zl)
+        local_cols.append(z0 + (pos + h) % zl)
+    local = np.stack(local_cols, axis=1).astype(np.int32)   # [n, L]
+
+    # Remote tier: remote_deg directed links into one seeded zone.
+    if zones < 2:
+        raise ValueError("zoned needs >= 2 zones for the remote tier")
+    tz = rng.integers(0, zones - 1, size=n)
+    tz = tz + (tz >= zone_of)              # exclude own zone
+    rr = rng.integers(0, zl, size=(n, remote_deg))
+    remote = (tz[:, None] * zl + rr).astype(np.int32)       # [n, R]
+
+    # Gateway ring: node (z, g) <-> node (z+1, g), both directions, so
+    # the zone graph is connected independent of the seeded targets.
+    gw = min(gateways, zl)
+    gcols = np.full((n, 2), -1, dtype=np.int32)
+    is_gw = pos < gw
+    gcols[is_gw, 0] = (idx[is_gw] + zl) % n                  # next zone
+    gcols[is_gw, 1] = (idx[is_gw] - zl) % n                  # prev zone
+
+    # Bias quantization: replicate the local block r times so the
+    # local fraction r·L/(r·L + R) lands nearest local_bias.
+    L, R = local.shape[1], remote.shape[1]
+    best_r, best_err = 1, float("inf")
+    for r in range(1, 9):
+        err = abs(r * L / (r * L + R) - local_bias)
+        if err < best_err - 1e-12:
+            best_r, best_err = r, err
+    parts = [local] * best_r + [remote]
+    row_parts = np.concatenate(parts, axis=1)
+    width = row_parts.shape[1] + 2
+    nbrs = np.tile(idx[:, None], (1, width))
+    nbrs[:, :row_parts.shape[1]] = row_parts
+    deg = np.full(n, row_parts.shape[1], dtype=np.int32)
+    has_g = gcols >= 0
+    for g in range(2):
+        sel = has_g[:, g]
+        nbrs[sel, deg[sel]] = gcols[sel, g]
+        deg[sel] += 1
+    # Self-pad strictly past deg (rows differ in width only via gateways).
+    pad = np.arange(width)[None, :] >= deg[:, None]
+    nbrs = np.where(pad, idx[:, None], nbrs).astype(np.int32)
+    return Topology(n=n, nbrs=nbrs, deg=deg, name=f"zoned{zones}")
+
+
+def with_stagger(topo: Topology, period: int,
+                 offsets: Optional[np.ndarray] = None,
+                 seed: int = 0) -> Topology:
+    """Attach per-node round-stagger phase offsets (pipelined gossiping,
+    docs/topology.md): node i gossips only when ``(round + offsets[i]) %
+    period == 0``.  ``offsets`` defaults to a seeded uniform draw over
+    ``[0, period)``; period ≤ 1 strips any stagger (the unstaggered
+    program, bit for bit)."""
+    if period <= 1:
+        return dataclasses.replace(topo, stagger=None, stagger_period=1)
+    if offsets is None:
+        offsets = np.random.default_rng(seed).integers(
+            0, period, size=topo.n)
+    offsets = np.asarray(offsets, dtype=np.int32)
+    if offsets.shape != (topo.n,):
+        raise ValueError(
+            f"stagger offsets must be shape ({topo.n},), got {offsets.shape}")
+    return dataclasses.replace(topo, stagger=offsets,
+                               stagger_period=int(period))
+
+
 def partition_mask(topo: Topology, side_of: np.ndarray) -> np.ndarray:
     """Bool[N, K] mask of edges crossing a partition boundary.
 
@@ -155,3 +351,160 @@ def partition_mask(topo: Topology, side_of: np.ndarray) -> np.ndarray:
     if topo.nbrs is None:
         raise ValueError("partition_mask requires an explicit neighbor list")
     return side_of[topo.nbrs] != side_of[:, None]
+
+
+# -- the overlay registry (name → builder; the /sweep + bench axis) --------
+
+
+def topology_names() -> tuple[str, ...]:
+    """The name families :func:`from_name` resolves — ``{x}`` marks an
+    integer parameter baked into the name (``ring2``, ``zoned64``, …)."""
+    return ("complete", "ring{h}", "chord", "expander{k}", "er{deg}",
+            "ba{m}", "zoned{z}", "mesh{r}x{c}")
+
+
+def from_name(name: str, n: int, seed: int = 0) -> Topology:
+    """Resolve an overlay NAME into a built :class:`Topology` —
+    deterministic for a (name, n, seed) triple, so a ``/sweep`` grid
+    point and its unbatched rerun build the identical overlay.  Raises
+    a named ``ValueError`` for unknown names (the ``POST /sweep`` 400
+    contract, docs/sweep.md)."""
+    from sidecar_tpu import metrics
+
+    s = str(name).strip().lower()
+    m = re.fullmatch(
+        r"(complete|chord)"
+        r"|ring(\d+)|expander(\d+)|er(\d+(?:\.\d+)?)|ba(\d+)"
+        r"|zoned(\d+)|mesh(\d+)x(\d+)", s)
+    if m is None:
+        raise ValueError(
+            f"unknown topology {name!r}: known families are "
+            f"{', '.join(topology_names())}")
+    try:
+        if m.group(1) == "complete":
+            family, topo = "complete", complete(n)
+        elif m.group(1) == "chord":
+            family, topo = "chord", ring_chord(n)
+        elif m.group(2):
+            family, topo = "ring", ring(n, hops=int(m.group(2)))
+        elif m.group(3):
+            family, topo = "expander", expander(n, k=int(m.group(3)),
+                                                seed=seed)
+        elif m.group(4):
+            family, topo = "er", erdos_renyi(
+                n, avg_degree=float(m.group(4)), seed=seed)
+        elif m.group(5):
+            family, topo = "ba", barabasi_albert(n, m=int(m.group(5)),
+                                                 seed=seed)
+        elif m.group(6):
+            family, topo = "zoned", zoned(n, zones=int(m.group(6)),
+                                          seed=seed)
+        else:
+            r, c = int(m.group(7)), int(m.group(8))
+            if r * c != n:
+                raise ValueError(
+                    f"mesh{r}x{c} has {r * c} nodes, cluster has {n}")
+            family, topo = "mesh", mesh2d(r, c)
+    except ValueError as exc:
+        raise ValueError(f"topology {name!r} invalid for n={n}: {exc}") \
+            from exc
+    metrics.incr(f"topology.from_name.{family}")
+    return topo
+
+
+# -- the zoned board-exchange plan (docs/sharding.md) ----------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ZonedHop:
+    """One hop of the zoned exchange: the static per-sender-shard row
+    blocks shipped at ring offset h (shard s → shard (s-h) mod d).
+
+    ``rows[d, R]`` are each sender shard's local row ids (0-padded past
+    ``valid``); ``pos[d, nl]`` inverts them (local row → block position,
+    R for absent rows — the receiver-side lookup of the compressed
+    twin's pull fold)."""
+
+    rows: np.ndarray   # int32 [d, R]
+    valid: np.ndarray  # bool  [d, R]
+    pos: np.ndarray    # int32 [d, nl]
+
+    @property
+    def width(self) -> int:
+        return int(self.rows.shape[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class ZonedExchangePlan:
+    """Static reachability tables for ``board_exchange="zoned"``: which
+    of each shard's rows the overlay can actually make another shard
+    sample.  ``hops[h-1]`` is the block plan for ring offset h (None
+    when no ordered pair needs that offset and the hop is skipped
+    entirely); built once host-side at sim construction.
+
+    ``direction="push"`` (dense twin: offers travel to targets) marks
+    row r of shard s reachable into shard t when some neighbor of r
+    lives on t; ``"pull"`` (compressed twin: boards are pulled by
+    samplers) when some node of t has r in its neighbor table.  Either
+    way the set is a static superset of every cross-shard (sender,
+    receiver) pair a round can sample, which is what makes the mode
+    bit-identical to ``all_gather`` for the same sampled peers."""
+
+    d: int
+    nl: int
+    direction: str
+    hops: tuple  # tuple[Optional[ZonedHop]], length d-1
+
+    @property
+    def total_rows(self) -> int:
+        """Σ hop widths — the per-device per-round row blocks received."""
+        return sum(h.width for h in self.hops if h is not None)
+
+
+def zoned_exchange_plan(topo: Topology, d: int,
+                        direction: str = "push") -> ZonedExchangePlan:
+    """Build the static per-(sender shard, ring offset) row-block tables
+    of the zoned board exchange (see :class:`ZonedExchangePlan`).
+
+    Requires a neighbor-list topology — the complete graph's reach is
+    every shard, which is exactly the ``all_gather`` this mode exists to
+    shrink."""
+    if topo.nbrs is None:
+        raise ValueError(
+            "zoned exchange requires a neighbor-list topology: the "
+            "complete graph reaches every shard (use all_gather there)")
+    if direction not in ("push", "pull"):
+        raise ValueError(f"direction must be push|pull, got {direction!r}")
+    n = topo.n
+    if n % d:
+        raise ValueError(f"n={n} must divide the {d}-device mesh")
+    nl = n // d
+    K = topo.nbrs.shape[1]
+    edge_ok = np.arange(K)[None, :] < topo.deg[:, None]
+    src = np.repeat(np.arange(n, dtype=np.int64), K)[edge_ok.ravel()]
+    tgt = topo.nbrs.ravel().astype(np.int64)[edge_ok.ravel()]
+    if direction == "push":
+        rows_of, needed_by = src, tgt // nl
+    else:
+        rows_of, needed_by = tgt, src // nl
+    reach = np.zeros((n, d), dtype=bool)
+    reach[rows_of, needed_by] = True
+    reach[np.arange(n), np.arange(n) // nl] = False  # own shard is local
+    hops = []
+    for h in range(1, d):
+        blocks = [np.nonzero(reach[s * nl:(s + 1) * nl, (s - h) % d])[0]
+                  for s in range(d)]
+        R = max((len(b) for b in blocks), default=0)
+        if R == 0:
+            hops.append(None)
+            continue
+        rows = np.zeros((d, R), dtype=np.int32)
+        valid = np.zeros((d, R), dtype=bool)
+        pos = np.full((d, nl), R, dtype=np.int32)
+        for s, b in enumerate(blocks):
+            rows[s, :len(b)] = b
+            valid[s, :len(b)] = True
+            pos[s, b] = np.arange(len(b), dtype=np.int32)
+        hops.append(ZonedHop(rows=rows, valid=valid, pos=pos))
+    return ZonedExchangePlan(d=d, nl=nl, direction=direction,
+                             hops=tuple(hops))

@@ -25,7 +25,7 @@ NODE_AXIS = "nodes"
 # SIDECAR_TPU_KERNELS, the choice is baked into the jitted round, so
 # toggling the env var affects sims built afterwards.
 BOARD_EXCHANGE_ENV = "SIDECAR_TPU_BOARD_EXCHANGE"
-BOARD_EXCHANGES = ("all_gather", "all_to_all", "ring")
+BOARD_EXCHANGES = ("all_gather", "all_to_all", "ring", "zoned")
 
 
 def resolve_board_exchange(explicit: Optional[str] = None, *,

@@ -63,7 +63,7 @@ from sidecar_tpu.ops.status import (
     pack,
     unpack_status,
 )
-from sidecar_tpu.ops.topology import Topology
+from sidecar_tpu.ops.topology import Topology, zoned_exchange_plan
 from sidecar_tpu.ops.ttl import ttl_sweep
 from sidecar_tpu.telemetry import cost
 from sidecar_tpu.parallel.mesh import (
@@ -102,11 +102,21 @@ class ShardedSim:
         self.last_sparse_stats = None
         # The dense twin exchanges bounded OFFER tensors, not boards:
         # all_gather replicates them, ring streams sender blocks hop by
-        # hop.  all_to_all request routing only exists on the
-        # compressed twin (its pulls have a row-id request shape; dense
-        # offers are pushes) — docs/sharding.md.
+        # hop, zoned ships only the row blocks the overlay can make
+        # another shard sample (docs/topology.md).  all_to_all request
+        # routing only exists on the compressed twin (its pulls have a
+        # row-id request shape; dense offers are pushes) —
+        # docs/sharding.md.
+        if board_exchange == "zoned" and topo.nbrs is None:
+            raise ValueError(
+                "board_exchange='zoned' requires a neighbor-list "
+                "topology: the complete graph reaches every shard "
+                "(use all_gather there)")
+        supported = ("all_gather", "ring")
+        if topo.nbrs is not None:
+            supported += ("zoned",)
         self.board_exchange = resolve_board_exchange(
-            board_exchange, supported=("all_gather", "ring"))
+            board_exchange, supported=supported)
         # Measurement-only (benchmarks/sharded_scaling.py): consume only
         # own-shard offers, skip the collectives — the exposed-comm
         # probe; the trajectory is wrong by construction.
@@ -123,9 +133,25 @@ class ShardedSim:
                   or sparse_ops.default_frontier_cap(params.n))
         self._sparse_cap_shard = min(nl, max(16, -(-cap // self.d) * 2))
         payload_ints = params.fanout + 2 * min(params.budget, params.m)
+        # Zoned: static reachability plan (ops/topology.py) — which of
+        # each shard's offer rows some other shard's overlay can sample.
+        # Push direction: the dense twin ships offers toward targets.
+        self._zoned_plan = None
+        self._zoned_tabs = None
+        if self.board_exchange == "zoned":
+            self._zoned_plan = zoned_exchange_plan(topo, self.d,
+                                                   direction="push")
+            self._zoned_tabs = tuple(
+                None if h is None
+                else (jnp.asarray(h.rows), jnp.asarray(h.valid))
+                for h in self._zoned_plan.hops)
+            metrics.set_gauge("parallel.exchange.zoned_rows",
+                              float(self._zoned_plan.total_rows))
         self.exchange_bytes_per_round = {
             "all_gather": (params.n - nl) * payload_ints * 4,
             "ring": (self.d - 1) * nl * payload_ints * 4,
+            "zoned": (0 if self._zoned_plan is None
+                      else self._zoned_plan.total_rows * payload_ints * 4),
         }[self.board_exchange]
         metrics.set_gauge("parallel.exchange.bytes",
                           float(self.exchange_bytes_per_round))
@@ -141,6 +167,13 @@ class ShardedSim:
         self._side = (None if node_side is None
                       else jax.device_put(jnp.asarray(node_side, dtype=jnp.int32),
                                           NamedSharding(self.mesh, P())))
+        # Round-stagger phase offsets (ops/topology.with_stagger,
+        # docs/topology.md): replicated constant; None compiles the
+        # unstaggered program bit for bit.
+        self._stagger = (None if topo.stagger is None
+                         or topo.stagger_period <= 1
+                         else jnp.asarray(topo.stagger, jnp.int32))
+        self._stagger_period = int(topo.stagger_period)
 
     # -- state -------------------------------------------------------------
 
@@ -179,6 +212,17 @@ class ShardedSim:
             cut = jnp.take_along_axis(cut_l, slot, axis=1)
             dst = jnp.where(cut, gi[:, None], dst)
         return jnp.where(alive[gi][:, None], dst, gi[:, None])
+
+    def _stagger_gate(self, dst, gi, round_idx):
+        """Round-stagger gating (docs/topology.md), applied AFTER the
+        sampling draw so the per-shard PRNG streams stay key-comparable
+        with the unstaggered run; compiles away when no stagger is
+        attached.  Gossip fan-out only — the stride push-pull is the
+        catch-up channel and never staggers."""
+        if self._stagger is None:
+            return dst
+        off = ((round_idx + self._stagger[gi]) % self._stagger_period) != 0
+        return jnp.where(off[:, None], gi[:, None], dst)
 
     def _block_candidates(self, known0, dst_b, svc_b, msg_b, senders,
                           alive, r0, nl, now, keep_b):
@@ -245,6 +289,7 @@ class ShardedSim:
         else:
             dst = self._sample_dst_nbrs(k_peers, gi, alive, nl,
                                         nbrs_l, deg_l, cut_l)
+        dst = self._stagger_gate(dst, gi, round_idx)
 
         # Phase 1 — select offers from the local block + transmit
         # accounting.  row_offset ties the tie-break rotation to GLOBAL
@@ -368,6 +413,44 @@ class ShardedSim:
                     jnp.roll(svc_all, -shift, axis=0)[:rem],
                     jnp.roll(msg_all, -shift, axis=0)[:rem],
                     senders_r, alive, r0, nl, now, keep_r))
+        elif self.board_exchange == "zoned":
+            # Zoned: per ring offset h, each shard ships ONLY the
+            # statically-reachable offer rows of its block (plan built
+            # at construction; docs/topology.md).  Pad rows ship msg=0
+            # — provable scatter-max no-ops — so the consume is
+            # bit-identical to all_gather for the same sampled peers.
+            if d > 1:
+                live = [h for h in range(1, d)
+                        if self._zoned_tabs[h - 1] is not None]
+
+                def zoned_send(h):
+                    zrows, zvalid = self._zoned_tabs[h - 1]
+                    rows_s = zrows[ax]                      # [R_h]
+                    blocks = (dst[rows_s], svc_idx[rows_s],
+                              jnp.where(zvalid[ax][:, None],
+                                        msg[rows_s], 0))
+                    perm = [(i, (i - h) % d) for i in range(d)]
+                    with cost.phase("exchange"):
+                        return tuple(lax.ppermute(b, NODE_AXIS, perm)
+                                     for b in blocks)
+
+                cur = zoned_send(live[0]) if live else None
+                for j, h in enumerate(live):
+                    if j + 1 < len(live):
+                        # Double buffer: the next hop's (smaller)
+                        # transfer is issued before this hop's block is
+                        # consumed, same overlap shape as the ring leg.
+                        nxt = zoned_send(live[j + 1])
+                    zrows, _zvalid = self._zoned_tabs[h - 1]
+                    ss = (ax + h) % d                       # sender shard
+                    senders_h = ss * nl + zrows[ss]
+                    keep_b = (None if keepmask is None
+                              else keepmask[senders_h])
+                    groups.append(self._block_candidates(
+                        known0, cur[0], cur[1], cur[2], senders_h,
+                        alive, r0, nl, now, keep_b))
+                    if j + 1 < len(live):
+                        cur = nxt
         else:  # ring — stream offer blocks hop by hop over ppermute
             if d > 1:
                 perm = [(i, (i - 1) % d) for i in range(d)]
@@ -490,7 +573,10 @@ class ShardedSim:
                          else self._cut[ax * nl:(ax + 1) * nl])
                 parts.append(self._sample_dst_nbrs(
                     k_peers, gi, alive, nl, nbrs_l, deg_l, cut_l))
-        pushes = [(jnp.concatenate(parts, axis=0), None)]
+        dst_all = gossip_ops.stagger_gate(
+            jnp.concatenate(parts, axis=0), round_idx, self._stagger,
+            self._stagger_period)
+        pushes = [(dst_all, None)]
 
         # The stride exchange is two one-way pulls from the receiver's
         # point of view: i pulls the forward partner's full state and

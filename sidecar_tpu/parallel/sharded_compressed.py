@@ -94,10 +94,11 @@ from sidecar_tpu.models.compressed import (
     CompressedState,
 )
 from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops.merge import admit_gate
-from sidecar_tpu.ops.topology import Topology
+from sidecar_tpu.ops.topology import Topology, zoned_exchange_plan
 from sidecar_tpu.telemetry import cost
 from sidecar_tpu.parallel.mesh import (
     NODE_AXIS,
@@ -129,8 +130,21 @@ class ShardedCompressedSim(CompressedSim):
             raise ValueError("a2a_slack must be >= 1")
         # None → SIDECAR_TPU_BOARD_EXCHANGE, default all_gather
         # (docs/sharding.md); the resolution is recorded in the metrics
-        # registry (parallel.exchange.mode.<mode>).
-        self.board_exchange = resolve_board_exchange(board_exchange)
+        # registry (parallel.exchange.mode.<mode>).  zoned ships only
+        # the board row blocks the overlay can make another shard
+        # sample (docs/topology.md), so it needs a neighbor-list
+        # topology: explicit zoned on the complete graph is a hard
+        # error, env-derived zoned falls back to all_gather.
+        if board_exchange == "zoned" and topo.nbrs is None:
+            raise ValueError(
+                "board_exchange='zoned' requires a neighbor-list "
+                "topology: the complete graph reaches every shard "
+                "(use all_gather there)")
+        supported = ("all_gather", "all_to_all", "ring")
+        if topo.nbrs is not None:
+            supported += ("zoned",)
+        self.board_exchange = resolve_board_exchange(
+            board_exchange, supported=supported)
         self.a2a_slack = a2a_slack
         # Measurement-only knob (benchmarks/sharded_scaling.py): skip
         # the cross-shard exchange and consume only own-shard rows.
@@ -180,6 +194,23 @@ class ShardedCompressedSim(CompressedSim):
         if self._side is not None:
             self._side = jax.device_put(self._side, repl)
 
+        # Zoned: static reachability plan (ops/topology.py).  Pull
+        # direction — the compressed twin's samplers PULL board rows,
+        # so shard s must ship row r wherever some node holds r in its
+        # neighbor table.
+        self._zoned_plan = None
+        self._zoned_tabs = None
+        if self.board_exchange == "zoned":
+            self._zoned_plan = zoned_exchange_plan(topo, self.d,
+                                                   direction="pull")
+            self._zoned_tabs = tuple(
+                None if h is None
+                else (jnp.asarray(h.rows), jnp.asarray(h.valid),
+                      jnp.asarray(h.pos))
+                for h in self._zoned_plan.hops)
+            metrics.set_gauge("parallel.exchange.zoned_rows",
+                              float(self._zoned_plan.total_rows))
+
         # Analytic per-round per-device RECEIVE bytes of the board
         # exchange (docs/metrics.md: parallel.exchange.bytes) — the
         # int32 bval + bslot payloads each mode moves.
@@ -191,6 +222,9 @@ class ShardedCompressedSim(CompressedSim):
             "all_to_all": d * cap * 4 + 2 * d * cap * k * 4,
             # d-1 hops of one [nl, K] block pair
             "ring": (d - 1) * nl * k * 4 * 2,
+            # the statically-reachable row blocks only, val + slot
+            "zoned": (0 if self._zoned_plan is None
+                      else self._zoned_plan.total_rows * k * 4 * 2),
         }[self.board_exchange]
         metrics.set_gauge("parallel.exchange.bytes",
                           float(self.exchange_bytes_per_round))
@@ -351,6 +385,10 @@ class ShardedCompressedSim(CompressedSim):
         else:
             dst = self._sample_dst_nbrs(k_peers, gi, alive, nl,
                                         nbrs_l, deg_l, cut_l)
+        if self._stagger is not None:
+            dst = gossip_ops.stagger_gate(
+                dst, round_idx, self._stagger[gi], self._stagger_period,
+                self_idx=gi)
         return self._gossip_shard_body(own_l, cslot_l, cval_l, csent_l,
                                        floor, alive, dst, k_drop,
                                        round_idx)
@@ -465,6 +503,52 @@ class ShardedCompressedSim(CompressedSim):
             wv, ws = self._fold_pulled(cv0, cs0, wv, ws, cross_v, cross_s,
                                        ok & ~is_local_f, now,
                                        keep=keep, stale_filtered=True)
+        elif mode == "zoned":
+            # Zoned: per ring offset h, each shard ships ONLY the
+            # statically-reachable board rows of its block (pull-plan
+            # built at construction; docs/topology.md).  The receiver
+            # looks sampled rows up through the hop's pos table; pad
+            # rows carry (0, -1) — the merge no-op — so the fold is
+            # bit-identical to all_gather for the same sampled peers.
+            src_shard_r = dst // nl
+            src_row_r = dst - src_shard_r * nl
+            if d > 1:
+                live = [h for h in range(1, d)
+                        if self._zoned_tabs[h - 1] is not None]
+
+                def zoned_send(h):
+                    zrows, zvalid, _ = self._zoned_tabs[h - 1]
+                    vmask = zvalid[ax][:, None]
+                    blk_v = jnp.where(vmask, bval_f[zrows[ax]], 0)
+                    blk_s = jnp.where(vmask, bslot_l[zrows[ax]], -1)
+                    perm = [(i, (i - h) % d) for i in range(d)]
+                    with cost.phase("exchange"):
+                        return (lax.ppermute(blk_v, NODE_AXIS, perm),
+                                lax.ppermute(blk_s, NODE_AXIS, perm))
+
+                cur = zoned_send(live[0]) if live else None
+                for j, h in enumerate(live):
+                    if j + 1 < len(live):
+                        # Double buffer, same overlap as the ring leg.
+                        nxt = zoned_send(live[j + 1])
+                    _, _, zpos = self._zoned_tabs[h - 1]
+                    ss = (ax + h) % d
+                    sel = src_shard_r == ss
+                    posr = zpos[ss][jnp.where(sel, src_row_r, 0)]
+                    # Append one (0, -1) pad row: pos is R for rows the
+                    # plan never ships (only ever looked up when the
+                    # fold is masked off anyway).
+                    pad_v = jnp.concatenate(
+                        [cur[0],
+                         jnp.zeros((1, p.cache_lines), cur[0].dtype)])
+                    pad_s = jnp.concatenate(
+                        [cur[1],
+                         jnp.full((1, p.cache_lines), -1, cur[1].dtype)])
+                    wv, ws = self._fold_pulled(
+                        cv0, cs0, wv, ws, pad_v[posr], pad_s[posr],
+                        ok & sel, now, keep=keep, stale_filtered=True)
+                    if j + 1 < len(live):
+                        cur = nxt
         else:  # ring — lax.ppermute streams block pairs hop by hop
             src_shard_r = dst // nl
             src_row_r = dst - src_shard_r * nl
@@ -623,6 +707,44 @@ class ShardedCompressedSim(CompressedSim):
                                        cross_s,
                                        ok_c & ~is_local_f[row_r], now,
                                        keep=keep_c, stale_filtered=True)
+        elif mode == "zoned":
+            # The dense zoned leg verbatim on the compacted receiver
+            # rows; the shipped blocks keep their dense shape (the
+            # mode's documented byte envelope).
+            src_shard_r = dst_c // nl
+            src_row_r = dst_c - src_shard_r * nl
+            if d > 1:
+                live = [h for h in range(1, d)
+                        if self._zoned_tabs[h - 1] is not None]
+
+                def zoned_send(h):
+                    zrows, zvalid, _ = self._zoned_tabs[h - 1]
+                    vmask = zvalid[ax][:, None]
+                    blk_v = jnp.where(vmask, bval_f[zrows[ax]], 0)
+                    blk_s = jnp.where(vmask, bslot_f[zrows[ax]], -1)
+                    perm = [(i, (i - h) % d) for i in range(d)]
+                    with cost.phase("exchange"):
+                        return (lax.ppermute(blk_v, NODE_AXIS, perm),
+                                lax.ppermute(blk_s, NODE_AXIS, perm))
+
+                cur = zoned_send(live[0]) if live else None
+                for j, h in enumerate(live):
+                    if j + 1 < len(live):
+                        nxt = zoned_send(live[j + 1])
+                    _, _, zpos = self._zoned_tabs[h - 1]
+                    ss = (ax + h) % d
+                    sel = src_shard_r == ss
+                    posr = zpos[ss][jnp.where(sel, src_row_r, 0)]
+                    pad_v = jnp.concatenate(
+                        [cur[0], jnp.zeros((1, k), cur[0].dtype)])
+                    pad_s = jnp.concatenate(
+                        [cur[1], jnp.full((1, k), -1, cur[1].dtype)])
+                    wv, ws = self._fold_pulled(
+                        cv0_c, cs0_c, wv, ws, pad_v[posr], pad_s[posr],
+                        ok_c & sel, now, keep=keep_c,
+                        stale_filtered=True)
+                    if j + 1 < len(live):
+                        cur = nxt
         else:  # ring
             src_shard_r = dst_c // nl
             src_row_r = dst_c - src_shard_r * nl
@@ -723,9 +845,10 @@ class ShardedCompressedSim(CompressedSim):
         if self.perturb is not None:
             state = self.perturb(state, k_perturb, now)
 
-        dst = lax.with_sharding_constraint(
+        dst = gossip_ops.stagger_gate(
             self._sample_dst_jit(k_peers, state.node_alive),
-            self._row_sharding)
+            round_idx, self._stagger, self._stagger_period)
+        dst = lax.with_sharding_constraint(dst, self._row_sharding)
 
         sender = jnp.any(kernel_ops.eligible_lines(
             state.cache_slot, state.cache_sent, limit), axis=1)

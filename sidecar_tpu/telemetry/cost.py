@@ -251,7 +251,8 @@ def measured_exchange_bytes(hlo_text: str, mode: str, d: int,
     Requires the program to have been compiled with phases ON
     (``forced_phases(True)`` / program_report does this)."""
     kind = {"all_gather": "all-gather", "all_to_all": "all-to-all",
-            "ring": "collective-permute"}[mode]
+            "ring": "collective-permute",
+            "zoned": "collective-permute"}[mode]
     scope = PHASE_PREFIX + "exchange"
     total = 0
     for op in collective_ops(hlo_text):

@@ -419,6 +419,108 @@ class TestGrid:
         assert pareto_front(rows) == [0, 1]
 
 
+class TestTopologyAxis:
+    """Topology as a compile-key sweep axis: grid points group into
+    per-overlay batches, each batch's fleet rows stay bit-identical to
+    the unbatched classic sim on the SAME ``from_name`` overlay, and
+    the HTTP surface rejects unknown overlay names up front with a
+    named 400 (before any batch compiles)."""
+
+    R = 30
+    NAMES = ["complete", "ring2", "chord", "expander4"]
+
+    def test_fleet_rows_match_unbatched_on_overlay(self):
+        specs = (ScenarioSpec(name="plain", seed=1, topology="chord"),
+                 ScenarioSpec(name="lossy", seed=2, drop_prob=0.15,
+                              topology="chord"))
+        batch = ScenarioBatch.build(specs, EXACT_PARAMS, BASE,
+                                    family="exact")
+        fleet = FleetSim(batch)
+        run = fleet.run(fleet.init_states(), self.R, eps=0.01,
+                        stop=False)
+        topo = topo_mod.from_name("chord", EXACT_PARAMS.n)
+        for i, spec in enumerate(batch.specs):
+            final, conv = exact_reference(batch, i, self.R, topo)
+            for name in ("known", "sent", "node_alive", "round_idx"):
+                assert np.array_equal(
+                    np.asarray(getattr(run.final_states, name))[i],
+                    np.asarray(getattr(final, name))), \
+                    f"{spec.name}: {name} diverged from unbatched " \
+                    "run on the chord overlay"
+            assert np.array_equal(run.convergence[:, i],
+                                  np.asarray(conv)), \
+                f"{spec.name}: convergence curve diverged"
+
+    def test_grid_groups_by_topology(self):
+        specs = expand_grid({"topology": self.NAMES,
+                             "drop_prob": [0.0, 0.1]})
+        assert len(specs) == 8
+        batches = build_batches(specs, EXACT_PARAMS, BASE)
+        assert len(batches) == 4
+        seen = set()
+        for b, idxs in batches:
+            topos = {s.topology for s in b.specs}
+            assert len(topos) == 1, "batch mixes overlays"
+            seen |= topos
+            assert len(idxs) == 2          # both drop_prob points
+        assert seen == set(self.NAMES)
+
+    def test_mixed_topology_batch_rejected(self):
+        specs = (ScenarioSpec(name="a", topology="ring2"),
+                 ScenarioSpec(name="b", topology="chord"))
+        with pytest.raises(ValueError, match="batch-uniform"):
+            ScenarioBatch.build(specs, EXACT_PARAMS, BASE,
+                                family="exact")
+
+    def test_sweep_topology_grid_rows_match_singletons(self):
+        """The 4-overlay grid's per-topology Pareto rows are
+        bit-identical to running each overlay as its own sweep — the
+        compile-key grouping changes scheduling, never results."""
+        from tests.test_bridge import CFG, make_state
+
+        from sidecar_tpu.bridge import SimBridge
+        bridge = SimBridge(make_state(), CFG)
+        kw = dict(rounds=self.R, eps=0.05, n=16, services_per_node=2,
+                  budget=5, provenance=0)
+        doc = bridge.sweep(axes={"topology": self.NAMES}, **kw)
+        assert doc["points"] == 4
+        rows = {row["config"]["topology"]: row for row in doc["table"]}
+        assert set(rows) == set(self.NAMES)
+        assert doc["pareto_front"]
+        for i in doc["pareto_front"]:
+            assert doc["table"][i]["rounds_to_eps"] is not None
+        for t in self.NAMES:
+            single = bridge.sweep(axes={"topology": [t]}, **kw)
+            srow = single["table"][0]
+            for col in ("rounds_to_eps", "exchange_bytes"):
+                assert srow[col] == rows[t][col], \
+                    f"{t}: {col} differs between grid and singleton"
+
+    def test_sweep_unknown_topology_is_400(self):
+        from tests.test_bridge import CFG, make_state
+
+        from sidecar_tpu.bridge import SimBridge, serve_bridge
+        server = serve_bridge(SimBridge(make_state(), CFG), port=0)
+        try:
+            port = server.server_address[1]
+            for bad, frag in ((["frobnitz"], "unknown topology"),
+                              (["zoned7"], "invalid for n")):
+                body = json.dumps({
+                    "axes": {"topology": bad}, "rounds": 10, "n": 12,
+                    "services_per_node": 2, "budget": 5,
+                }).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/sweep", data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 400
+                doc = json.loads(err.value.read())
+                assert frag in doc["message"]
+        finally:
+            server.shutdown()
+
+
 class TestSweepHttp:
     """POST /sweep round trip on the bridge (grid in → Pareto table
     out; malformed grid → 400 with a parseable error body)."""

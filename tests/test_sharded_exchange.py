@@ -287,3 +287,280 @@ class TestExchangeSelection:
         nl = params.n // d
         assert sim.exchange_bytes_per_round == \
             (d - 1) * nl * params.cache_lines * 4 * 2
+
+
+# A small overlay with all three zoned tiers active (local lattice,
+# remote links, gateway ring) — the zoned exchange's acceptance graph.
+def _zoned_topo(n=16, zones=4):
+    return topology.zoned(n, zones, local_hops=1, remote_deg=2,
+                          gateways=1)
+
+
+class TestZonedExchangeLockstep:
+    """board_exchange="zoned" ships only the plan's cross-shard row
+    blocks, yet must stay bit-identical to all_gather: the plan is a
+    static superset of every cross-shard pair a round can sample
+    (docs/sharding.md)."""
+
+    def test_dense_twin_zoned_by_d(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        topo = _zoned_topo()
+        exact = ExactSim(params, topo, DET_DENSE)
+        se = exact.init_state()
+        ref = []
+        for i in range(10):
+            se = exact.step(se, jax.random.PRNGKey(i))
+            ref.append(se)
+        for d in DS:
+            sharded = DetShardedSim(params, topo, DET_DENSE,
+                                    mesh=make_mesh(jax.devices()[:d]),
+                                    board_exchange="zoned")
+            ss = sharded.init_state()
+            for i in range(10):
+                ss = sharded.step(ss, jax.random.PRNGKey(i))
+                np.testing.assert_array_equal(
+                    np.asarray(ref[i].known), np.asarray(ss.known),
+                    err_msg=f"known zoned/d={d} r{i + 1}")
+                np.testing.assert_array_equal(
+                    np.asarray(ref[i].sent), np.asarray(ss.sent),
+                    err_msg=f"sent zoned/d={d} r{i + 1}")
+
+    def test_compressed_twin_zoned_by_d(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        topo = _zoned_topo()
+        schedule, rounds = _compressed_schedule(params, 8)
+        single = CompressedSim(params, topo, DET)
+        ref = _run_compressed(single, schedule, rounds)
+        for d in DS:
+            sharded = DetShardedCompressedSim(
+                params, topo, DET, mesh=make_mesh(jax.devices()[:d]),
+                board_exchange="zoned")
+            got = _run_compressed(sharded, schedule, rounds)
+            for i, (a, b) in enumerate(zip(ref, got)):
+                assert_states_equal(a, b, f"zoned/d={d} r{i + 1}")
+            assert sharded.sync_exchange_metrics(got[-1]) == 0
+
+    def test_compressed_twin_zoned_sparse(self, monkeypatch):
+        """The sparse body's zoned leg against the single-chip DENSE
+        model — sparse compaction composing with the pulled-block
+        fold."""
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        topo = _zoned_topo()
+        schedule, rounds = _compressed_schedule(params, 8)
+        single = CompressedSim(params, topo, DET)
+        ref = _run_compressed(single, schedule, rounds)
+        for d in (2, 4, 8):
+            sh = DetShardedCompressedSim(
+                params, topo, DET, mesh=make_mesh(jax.devices()[:d]),
+                board_exchange="zoned")
+            ss = sh.init_state()
+            for i in range(rounds):
+                key = jax.random.PRNGKey(100 + i)
+                if i in schedule:
+                    ss = sh.mint(ss, schedule[i],
+                                 int(ss.round_idx) * DET.round_ticks + 7)
+                ss, stats = sh.step_sparse(ss, key)
+                assert_states_equal(ref[i], ss,
+                                    f"zoned-sparse/d={d} r{i + 1}")
+            assert int(stats[1]) == 0
+
+    def test_zoned_with_cut_mask(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        topo = _zoned_topo()
+        side = (np.arange(16) >= 8).astype(np.int32)
+        cut = topology.partition_mask(topo, side)
+        exact = ExactSim(params, topo, DET_DENSE, cut_mask=cut)
+        sharded = DetShardedSim(params, topo, DET_DENSE, cut_mask=cut,
+                                mesh=make_mesh(jax.devices()[:4]),
+                                board_exchange="zoned")
+        se, ss = exact.init_state(), sharded.init_state()
+        for i in range(10):
+            se = exact.step(se, jax.random.PRNGKey(i))
+            ss = sharded.step(ss, jax.random.PRNGKey(i))
+            np.testing.assert_array_equal(
+                np.asarray(se.known), np.asarray(ss.known),
+                err_msg=f"cut zoned r{i + 1}")
+
+
+class TestZonedSelection:
+    def test_explicit_zoned_requires_neighbor_list(self):
+        with pytest.raises(ValueError, match="neighbor-list"):
+            ShardedSim(SimParams(n=16, services_per_node=2),
+                       topology.complete(16), DET_DENSE,
+                       board_exchange="zoned")
+        with pytest.raises(ValueError, match="neighbor-list"):
+            ShardedCompressedSim(
+                CompressedParams(n=16, services_per_node=2,
+                                 cache_lines=32),
+                topology.complete(16), DET, board_exchange="zoned")
+
+    def test_env_zoned_falls_back_on_complete(self, monkeypatch):
+        """Process-wide env knob on a complete-graph build: fall back
+        to all_gather (counted), never hard-fail (the explicit-arg
+        rejection above keeps misconfiguration loud)."""
+        monkeypatch.setenv(BOARD_EXCHANGE_ENV, "zoned")
+        before = metrics.counter("parallel.exchange.mode.fallback")
+        sim = ShardedSim(SimParams(n=16, services_per_node=2),
+                         topology.complete(16), DET_DENSE)
+        assert sim.board_exchange == "all_gather"
+        assert metrics.counter("parallel.exchange.mode.fallback") == \
+            before + 1
+
+    def test_env_zoned_resolves_on_neighbor_list(self, monkeypatch):
+        monkeypatch.setenv(BOARD_EXCHANGE_ENV, "zoned")
+        sim = ShardedSim(SimParams(n=16, services_per_node=2),
+                         _zoned_topo(), DET_DENSE)
+        assert sim.board_exchange == "zoned"
+
+    def test_zoned_bytes_and_gauge(self):
+        from sidecar_tpu.ops.topology import zoned_exchange_plan
+        topo = _zoned_topo()
+        d = 4
+        params = CompressedParams(n=16, services_per_node=2,
+                                  cache_lines=32, budget=4)
+        sim = ShardedCompressedSim(params, topo, DET,
+                                   mesh=make_mesh(jax.devices()[:d]),
+                                   board_exchange="zoned")
+        plan = zoned_exchange_plan(topo, d, direction="pull")
+        assert sim.exchange_bytes_per_round == \
+            plan.total_rows * params.cache_lines * 4 * 2
+        gauges = metrics.snapshot()["gauges"]
+        assert gauges["parallel.exchange.zoned_rows"] == \
+            float(plan.total_rows)
+        # The mode's reason to exist: cheaper than the full board.
+        ag = ShardedCompressedSim(params, topo, DET,
+                                  mesh=make_mesh(jax.devices()[:d]),
+                                  board_exchange="all_gather")
+        assert sim.exchange_bytes_per_round < ag.exchange_bytes_per_round
+
+        dparams = SimParams(n=16, services_per_node=2, fanout=2,
+                            budget=4)
+        dz = ShardedSim(dparams, topo, DET_DENSE,
+                        mesh=make_mesh(jax.devices()[:d]),
+                        board_exchange="zoned")
+        da = ShardedSim(dparams, topo, DET_DENSE,
+                        mesh=make_mesh(jax.devices()[:d]),
+                        board_exchange="all_gather")
+        push = zoned_exchange_plan(topo, d, direction="push")
+        payload = dparams.fanout + 2 * min(dparams.budget, dparams.m)
+        assert dz.exchange_bytes_per_round == \
+            push.total_rows * payload * 4
+        assert dz.exchange_bytes_per_round < da.exchange_bytes_per_round
+
+
+def det_sample_peers_staggered(key, n, fanout, *, nbrs=None, deg=None,
+                               node_alive=None, cut_mask=None,
+                               stagger=None, stagger_period=1,
+                               round_idx=None):
+    """det_sample_peers extended with the stagger kwargs a staggered
+    single-chip sim passes (ops/gossip.sample_peers gates last; so
+    does this)."""
+    dst = det_sample_peers(key, n, fanout, nbrs=nbrs, deg=deg,
+                           node_alive=node_alive, cut_mask=cut_mask)
+    return gossip_ops.stagger_gate(dst, round_idx, stagger,
+                                   stagger_period)
+
+
+class TestStaggeredRounds:
+    """Round-stagger phase offsets (ops/topology.with_stagger): gated
+    nodes self-loop their gossip fan-out; period 1 compiles the
+    unstaggered program bit for bit."""
+
+    def test_period_one_is_bit_identical(self):
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        topo = topology.ring(16, hops=2)
+        a = ExactSim(params, topo, DET_DENSE)
+        b = ExactSim(params, topology.with_stagger(topo, 1), DET_DENSE)
+        assert b._stagger is None
+        sa, sb = a.init_state(), b.init_state()
+        for i in range(6):
+            key = jax.random.PRNGKey(i)
+            sa, sb = a.step(sa, key), b.step(sb, key)
+            np.testing.assert_array_equal(np.asarray(sa.known),
+                                          np.asarray(sb.known))
+        sh = ShardedSim(params, topology.with_stagger(topo, 1),
+                        DET_DENSE, board_exchange="zoned")
+        assert sh._stagger is None
+
+    def test_off_round_freezes_gossip(self):
+        """Offsets all one, period 2: every EVEN in-step round index
+        (the step's 1-based ``state.round_idx + 1``) gates the whole
+        cluster — no gossip delivery may land (announce re-stamps are
+        disabled by the DET clock)."""
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        topo = topology.with_stagger(topology.ring(16, hops=2), 2,
+                                     offsets=np.ones(16, np.int32))
+        sim = ExactSim(params, topo, DET_DENSE)
+        st = sim.init_state()
+        st = sim.step(st, jax.random.PRNGKey(0))      # round idx 1: on
+        k1 = np.asarray(st.known).copy()
+        st = sim.step(st, jax.random.PRNGKey(1))      # round idx 2: off
+        np.testing.assert_array_equal(k1, np.asarray(st.known))
+        st = sim.step(st, jax.random.PRNGKey(2))      # round idx 3: on
+        assert (np.asarray(st.known) != k1).any()
+
+    def test_staggered_dense_lockstep(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers",
+                            det_sample_peers_staggered)
+        params = SimParams(n=16, services_per_node=2, fanout=2, budget=4)
+        topo = topology.with_stagger(_zoned_topo(), 2, seed=3)
+        exact = ExactSim(params, topo, DET_DENSE)
+        se = exact.init_state()
+        ref = []
+        for i in range(8):
+            se = exact.step(se, jax.random.PRNGKey(i))
+            ref.append(se)
+        for mode in ("all_gather", "zoned"):
+            sharded = DetShardedSim(params, topo, DET_DENSE,
+                                    mesh=make_mesh(jax.devices()[:4]),
+                                    board_exchange=mode)
+            ss = sharded.init_state()
+            for i in range(8):
+                ss = sharded.step(ss, jax.random.PRNGKey(i))
+                np.testing.assert_array_equal(
+                    np.asarray(ref[i].known), np.asarray(ss.known),
+                    err_msg=f"stagger {mode} r{i + 1}")
+
+    def test_staggered_compressed_lockstep(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers",
+                            det_sample_peers_staggered)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        topo = topology.with_stagger(_zoned_topo(), 2, seed=5)
+        schedule, rounds = _compressed_schedule(params, 8)
+        single = CompressedSim(params, topo, DET)
+        ref = _run_compressed(single, schedule, rounds)
+        for mode in ("all_gather", "zoned"):
+            sharded = DetShardedCompressedSim(
+                params, topo, DET, mesh=make_mesh(jax.devices()[:4]),
+                board_exchange=mode)
+            got = _run_compressed(sharded, schedule, rounds)
+            for i, (a, b) in enumerate(zip(ref, got)):
+                assert_states_equal(a, b, f"stagger {mode} r{i + 1}")
+
+    def test_staggered_compressed_sparse_lockstep(self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers",
+                            det_sample_peers_staggered)
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        topo = topology.with_stagger(_zoned_topo(), 2, seed=5)
+        schedule, rounds = _compressed_schedule(params, 8)
+        single = CompressedSim(params, topo, DET)
+        ref = _run_compressed(single, schedule, rounds)
+        sh = DetShardedCompressedSim(
+            params, topo, DET, mesh=make_mesh(jax.devices()[:4]),
+            board_exchange="zoned")
+        ss = sh.init_state()
+        for i in range(rounds):
+            key = jax.random.PRNGKey(100 + i)
+            if i in schedule:
+                ss = sh.mint(ss, schedule[i],
+                             int(ss.round_idx) * DET.round_ticks + 7)
+            ss, _stats = sh.step_sparse(ss, key)
+            assert_states_equal(ref[i], ss, f"stagger-sparse r{i + 1}")

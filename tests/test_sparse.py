@@ -466,6 +466,162 @@ class TestShardedTwinsLockstep:
         assert_states_equal(straight, chunked, "chunked")
 
 
+class TestNeighborListSparse:
+    """The sparse round over neighbor-list overlays (the /sweep
+    topology axis): the frontier contract and dense==sparse lockstep
+    must hold when ``sample_peers`` draws from ``nbrs``/``deg`` with a
+    ``cut_mask`` — on both single-chip families and both sharded
+    twins."""
+
+    TOPO_N = 16
+
+    def _topo_and_cut(self):
+        topo = topology.zoned(self.TOPO_N, 4, local_hops=1,
+                              remote_deg=2, gateways=1)
+        side = (np.arange(self.TOPO_N) >= 8).astype(np.int32)
+        return topo, topology.partition_mask(topo, side)
+
+    def test_frontier_superset_of_publishers(self):
+        """Sender-frontier ⊇ publishers: every row holding a record
+        with transmits left — in particular every owner right after
+        boot — must survive the compaction into the sparse sender set,
+        or the sparse round would silently drop its publishes."""
+        params = SimParams(n=self.TOPO_N, services_per_node=2, fanout=2,
+                           budget=4)
+        topo, _ = self._topo_and_cut()
+        sim = ExactSim(params, topo, DET_DENSE)
+        st = sim.init_state()
+        limit = params.resolved_retransmit_limit()
+        owners = np.unique(np.asarray(sim.owner))
+
+        def compacted_set(state):
+            frontier = jnp.any(gossip_ops.eligible_records(
+                state.known, state.sent, limit), axis=1)
+            idx, _, valid, _ = compact_rows(frontier, sim._sparse_cap)
+            return (set(np.asarray(idx)[np.asarray(valid)].tolist()),
+                    np.asarray(frontier))
+
+        got, frontier = compacted_set(st)
+        assert frontier[owners].all()       # every booted owner publishes
+        assert set(owners.tolist()) <= got
+        # After a few rounds the compacted set still equals the full
+        # eligible-row set (under cap nothing is dropped) — the
+        # invariant the sparse publish rides on.
+        for i in range(4):
+            st, _ = sim.step_sparse(st, jax.random.PRNGKey(i))
+        got, frontier = compacted_set(st)
+        assert frontier.any()
+        assert got == set(np.nonzero(frontier)[0].tolist())
+
+    def test_compressed_frontier_superset_of_publishers(self):
+        params = CompressedParams(n=self.TOPO_N, services_per_node=2,
+                                  fanout=2, budget=4, cache_lines=32)
+        topo, _ = self._topo_and_cut()
+        sim = CompressedSim(params, topo, DET)
+        st = sim.init_state()
+        slots = np.asarray([1, 5, 9], np.int32)
+        st = sim.mint(st, slots, 7)
+        sender = np.asarray(jnp.any(kernel_ops.eligible_lines(
+            st.cache_slot, st.cache_sent,
+            params.resolved_retransmit_limit()), axis=1))
+        owners = slots // params.services_per_node
+        assert sender[owners].all()         # minters are in the frontier
+
+    def test_exact_dense_equals_sparse_on_nbrs_with_cut(self,
+                                                        monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = SimParams(n=self.TOPO_N, services_per_node=2, fanout=2,
+                           budget=4)
+        topo, cut = self._topo_and_cut()
+        dense = ExactSim(params, topo, DET_DENSE, cut_mask=cut)
+        sp = ExactSim(params, topo, DET_DENSE, cut_mask=cut)
+        sd, ss = dense.init_state(), sp.init_state()
+        for i in range(10):
+            key = jax.random.PRNGKey(i)
+            sd = dense.step(sd, key)
+            ss, _ = sp.step_sparse(ss, key)
+            np.testing.assert_array_equal(
+                np.asarray(sd.known), np.asarray(ss.known),
+                err_msg=f"nbrs+cut r{i + 1}")
+            np.testing.assert_array_equal(
+                np.asarray(sd.sent), np.asarray(ss.sent),
+                err_msg=f"nbrs+cut sent r{i + 1}")
+
+    def test_compressed_dense_equals_sparse_on_nbrs_with_cut(
+            self, monkeypatch):
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        params = CompressedParams(n=self.TOPO_N, services_per_node=2,
+                                  fanout=2, budget=4, cache_lines=32)
+        topo, cut = self._topo_and_cut()
+        schedule = _mint_schedule(params)
+        dense = CompressedSim(params, topo, DET, cut_mask=cut)
+        sp = CompressedSim(params, topo, DET, cut_mask=cut)
+        sd, ss = dense.init_state(), sp.init_state()
+        for i in range(10):
+            key = jax.random.PRNGKey(100 + i)
+            if i in schedule:
+                tick = int(sd.round_idx) * DET.round_ticks + 7
+                sd = dense.mint(sd, schedule[i], tick)
+                ss = sp.mint(ss, schedule[i], tick)
+            sd = dense.step(sd, key)
+            ss, stats = sp.step_sparse(ss, key)
+            assert_states_equal(sd, ss, f"nbrs+cut r{i + 1}")
+
+    def test_sharded_twins_sparse_on_nbrs(self, monkeypatch):
+        """Both sharded twins' sparse rounds over a neighbor-list
+        overlay with a partition cut, vs the single-chip DENSE
+        models."""
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+        topo, cut = self._topo_and_cut()
+        cfg = TimeConfig(refresh_interval_s=1000.0,
+                         push_pull_interval_s=1e6, sweep_interval_s=1.0)
+        dparams = SimParams(n=self.TOPO_N, services_per_node=2,
+                            fanout=2, budget=4)
+        exact = ExactSim(dparams, topo, cfg, cut_mask=cut)
+        se = exact.init_state()
+        dref = []
+        for i in range(8):
+            se = exact.step(se, jax.random.PRNGKey(i))
+            dref.append(se)
+        cparams = CompressedParams(n=self.TOPO_N, services_per_node=2,
+                                   fanout=2, budget=4, cache_lines=32)
+        schedule = _mint_schedule(cparams)
+        single = CompressedSim(cparams, topo, DET, cut_mask=cut)
+        st = single.init_state()
+        cref = []
+        for i in range(8):
+            if i in schedule:
+                st = single.mint(st, schedule[i],
+                                 int(st.round_idx) * DET.round_ticks + 7)
+            st = single.step(st, jax.random.PRNGKey(100 + i))
+            cref.append(st)
+        for d in (2, 4):
+            sh = DetShardedSim(dparams, topo, cfg, cut_mask=cut,
+                               mesh=make_mesh(jax.devices()[:d]),
+                               board_exchange="zoned")
+            ss = sh.init_state()
+            for i in range(8):
+                ss, stats = sh.step_sparse(ss, jax.random.PRNGKey(i))
+                np.testing.assert_array_equal(
+                    np.asarray(dref[i].known), np.asarray(ss.known),
+                    err_msg=f"dense twin d={d} r{i + 1}")
+            assert int(stats[1]) == 0
+            shc = DetShardedCompressedSim(
+                cparams, topo, DET, cut_mask=cut,
+                mesh=make_mesh(jax.devices()[:d]),
+                board_exchange="zoned")
+            sc = shc.init_state()
+            for i in range(8):
+                if i in schedule:
+                    sc = shc.mint(sc, schedule[i],
+                                  int(sc.round_idx) * DET.round_ticks + 7)
+                sc, stats = shc.step_sparse(sc,
+                                            jax.random.PRNGKey(100 + i))
+                assert_states_equal(cref[i], sc,
+                                    f"compressed twin d={d} r{i + 1}")
+            assert int(stats[1]) == 0
+
+
 class TestResolutionContract:
     def test_env_resolution(self, monkeypatch):
         monkeypatch.setenv(SPARSE_ENV, "1")

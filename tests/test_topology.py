@@ -1,0 +1,313 @@
+"""The overlay catalog (ops/topology.py): builder invariants via
+tools/check_topology.py (which runs IN tier-1 here), the vectorized
+Erdős–Rényi builder's bit-identity to the original per-row loop, the
+``from_name`` registry (the /sweep + bench topology axis), round
+stagger (``with_stagger`` + ``ops/gossip.stagger_gate``), and the
+zoned board-exchange plan's reach-superset contract (the static
+guarantee that makes ``board_exchange="zoned"`` bit-identical to
+``all_gather`` — docs/topology.md, docs/sharding.md)."""
+
+import dataclasses
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from sidecar_tpu import metrics
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops.topology import (
+    Topology,
+    from_name,
+    topology_names,
+    with_stagger,
+    zoned_exchange_plan,
+)
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                       / "tools"))
+
+from check_topology import (  # noqa: E402
+    check_topology,
+    components,
+    default_catalog,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+class TestCheckerIsClean:
+    def test_catalog_invariants(self):
+        for topo in default_catalog(64):
+            assert check_topology(topo) == [], topo.name
+
+    def test_cli_exit_code(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "check_topology.py"),
+             "48"],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestCheckerDetection:
+    """The checker must actually flag offenders — a checker that can't
+    fail proves nothing green."""
+
+    def test_flags_pad_not_self(self):
+        t = topology.ring(8, hops=1)
+        nbrs = np.array(t.nbrs).copy()
+        deg = np.array(t.deg).copy()
+        deg[3] = 1                         # col 1 becomes pad, but holds
+        bad = dataclasses.replace(t, nbrs=nbrs, deg=deg)  # a neighbor
+        assert any("pad" in p for p in check_topology(bad))
+
+    def test_flags_self_loop_in_valid_region(self):
+        t = topology.ring(8, hops=1)
+        nbrs = np.array(t.nbrs).copy()
+        nbrs[2, 0] = 2
+        bad = dataclasses.replace(t, nbrs=nbrs)
+        assert any("self-loop" in p for p in check_topology(bad))
+
+    def test_flags_asymmetry(self):
+        t = topology.ring(8, hops=1)
+        nbrs = np.array(t.nbrs).copy()
+        nbrs[0, 0] = 4                      # 0→4 without 4→0
+        bad = dataclasses.replace(t, nbrs=nbrs)
+        assert any("asymmetric" in p for p in check_topology(bad))
+
+    def test_flags_disconnection(self):
+        # Two disjoint 4-rings labeled as a connected family.
+        half = topology.ring(4, hops=1)
+        nbrs = np.concatenate([np.array(half.nbrs),
+                               np.array(half.nbrs) + 4])
+        deg = np.concatenate([np.array(half.deg)] * 2)
+        bad = Topology(n=8, nbrs=nbrs.astype(np.int32),
+                       deg=deg.astype(np.int32), name="ring1")
+        assert components(np.asarray(bad.nbrs), np.asarray(bad.deg)) == 2
+        assert any("components" in p for p in check_topology(bad))
+
+    def test_flags_out_of_range_ids(self):
+        t = topology.ring(8, hops=1)
+        nbrs = np.array(t.nbrs).copy()
+        nbrs[1, 0] = 99
+        bad = dataclasses.replace(t, nbrs=nbrs)
+        assert any("outside" in p for p in check_topology(bad))
+
+
+def _er_reference(n, avg_degree, seed):
+    """The original per-row append-loop ER builder, kept verbatim as
+    the bit-identity oracle for the vectorized rewrite."""
+    rng = np.random.default_rng(seed)
+    p = min(1.0, avg_degree / max(1, n - 1))
+    adj = [[] for _ in range(n)]
+    block = max(1, min(n, 4_000_000 // max(n, 1) + 1))
+    for start in range(0, n, block):
+        stop = min(n, start + block)
+        rows = np.arange(start, stop)
+        mask = rng.random((stop - start, n)) < p
+        mask &= np.arange(n)[None, :] > rows[:, None]
+        for r, c in zip(*np.nonzero(mask)):
+            i, j = int(rows[r]), int(c)
+            adj[i].append(j)
+            adj[j].append(i)
+    deg = np.array([len(a) for a in adj], dtype=np.int32)
+    k = max(1, int(deg.max()))
+    nbrs = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, k))
+    for i, a in enumerate(adj):
+        if a:
+            nbrs[i, : len(a)] = np.asarray(sorted(a), dtype=np.int32)
+    return nbrs, deg
+
+
+class TestErdosRenyiVectorized:
+    @pytest.mark.parametrize("n,deg,seed", [(64, 8, 0), (128, 4, 3),
+                                            (33, 6, 1)])
+    def test_bit_identical_to_loop_builder(self, n, deg, seed):
+        t = topology.erdos_renyi(n, deg, seed=seed)
+        ref_nbrs, ref_deg = _er_reference(n, deg, seed)
+        np.testing.assert_array_equal(np.asarray(t.deg), ref_deg)
+        np.testing.assert_array_equal(np.asarray(t.nbrs), ref_nbrs)
+
+
+class TestRegistry:
+    def test_known_families_resolve(self):
+        for name, expect in [("complete", "complete"), ("ring2", "ring2"),
+                             ("chord", "chord"),
+                             ("expander4", "expander4"), ("er8", "er8"),
+                             ("ba2", "ba2"), ("zoned8", "zoned8"),
+                             ("mesh8x8", "mesh8x8")]:
+            topo = from_name(name, 64)
+            assert topo.name == expect
+            assert topo.n == 64
+
+    def test_unknown_name_is_named_error(self):
+        with pytest.raises(ValueError, match="unknown topology"):
+            from_name("hypercube", 64)
+        # The families the error lists are the registry's contract.
+        for fam in topology_names():
+            with pytest.raises(ValueError, match=fam.split("{")[0]):
+                from_name("hypercube", 64)
+            break
+
+    def test_invalid_for_n_is_named_error(self):
+        with pytest.raises(ValueError, match="invalid for n"):
+            from_name("mesh8x9", 64)     # 72 nodes != 64
+        with pytest.raises(ValueError, match="invalid for n"):
+            from_name("zoned7", 64)      # 7 does not divide 64
+
+    def test_deterministic_rebuild(self):
+        a = from_name("zoned8", 64)
+        b = from_name("zoned8", 64)
+        np.testing.assert_array_equal(np.asarray(a.nbrs),
+                                      np.asarray(b.nbrs))
+        c = from_name("er8", 64, seed=1)
+        assert not np.array_equal(np.asarray(c.nbrs),
+                                  np.asarray(from_name("er8", 64).nbrs))
+
+    def test_family_counter_incremented(self):
+        before = metrics.counter("topology.from_name.zoned")
+        from_name("zoned8", 64)
+        assert metrics.counter("topology.from_name.zoned") == before + 1
+
+    def test_case_and_whitespace_tolerant(self):
+        assert from_name(" Ring2 ", 16).name == "ring2"
+
+
+class TestWithStagger:
+    def test_period_one_strips(self):
+        t = with_stagger(topology.ring(8), 1)
+        assert t.stagger is None and t.stagger_period == 1
+        t2 = with_stagger(with_stagger(topology.ring(8), 4), 0)
+        assert t2.stagger is None
+
+    def test_seeded_default_in_range(self):
+        t = with_stagger(topology.ring(16), 4, seed=2)
+        assert t.stagger.shape == (16,)
+        assert (t.stagger >= 0).all() and (t.stagger < 4).all()
+        assert t.stagger_period == 4
+
+    def test_explicit_offsets_shape_checked(self):
+        with pytest.raises(ValueError, match="shape"):
+            with_stagger(topology.ring(8), 2, offsets=np.zeros(7))
+
+    def test_stagger_gate_semantics(self):
+        n, fanout = 8, 2
+        dst = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None],
+                               (n, fanout)) + 1
+        dst = dst % n
+        off = jnp.asarray([0, 1] * 4, jnp.int32)
+        # round 0: odd-offset nodes are gated to self-loops.
+        gated = gossip_ops.stagger_gate(dst, jnp.int32(0), off, 2)
+        expect = np.where((np.arange(n) % 2 == 1)[:, None],
+                          np.arange(n)[:, None], np.asarray(dst))
+        np.testing.assert_array_equal(np.asarray(gated), expect)
+        # round 1: roles flip.
+        gated1 = gossip_ops.stagger_gate(dst, jnp.int32(1), off, 2)
+        expect1 = np.where((np.arange(n) % 2 == 0)[:, None],
+                           np.arange(n)[:, None], np.asarray(dst))
+        np.testing.assert_array_equal(np.asarray(gated1), expect1)
+        # None / period <= 1 is the identity (the bit-identity gate).
+        assert gossip_ops.stagger_gate(dst, jnp.int32(0), None, 4) is dst
+        assert gossip_ops.stagger_gate(dst, jnp.int32(0), off, 1) is dst
+        # Idempotent: a staggered row is already a self-loop.
+        np.testing.assert_array_equal(
+            np.asarray(gossip_ops.stagger_gate(gated, jnp.int32(0),
+                                               off, 2)),
+            np.asarray(gated))
+
+
+class TestZonedExchangePlan:
+    def _edges(self, topo):
+        K = topo.nbrs.shape[1]
+        ok = np.arange(K)[None, :] < np.asarray(topo.deg)[:, None]
+        src = np.repeat(np.arange(topo.n), K)[ok.ravel()]
+        dst = np.asarray(topo.nbrs).ravel()[ok.ravel()]
+        return src, dst
+
+    @pytest.mark.parametrize("direction", ["push", "pull"])
+    def test_reach_is_superset_of_cross_shard_edges(self, direction):
+        topo = topology.zoned(32, 4, local_hops=1, remote_deg=2,
+                              gateways=1)
+        d, nl = 4, 8
+        plan = zoned_exchange_plan(topo, d, direction=direction)
+        assert plan.d == d and plan.nl == nl
+        src, dst = self._edges(topo)
+        for i, j in zip(src.tolist(), dst.tolist()):
+            row, target = ((i, j // nl) if direction == "push"
+                           else (j, i // nl))
+            s = row // nl
+            if s == target:
+                continue                    # own-shard rows never ship
+            h = (s - target) % d
+            hop = plan.hops[h - 1]
+            assert hop is not None, (i, j, h)
+            pos = hop.pos[s, row - s * nl]
+            assert pos < hop.width, (i, j, h)
+            assert hop.rows[s, pos] == row - s * nl
+            assert hop.valid[s, pos]
+
+    def test_pad_and_pos_inverse(self):
+        topo = topology.zoned(32, 4, local_hops=1, remote_deg=2,
+                              gateways=1)
+        plan = zoned_exchange_plan(topo, 4)
+        assert plan.total_rows == sum(h.width for h in plan.hops
+                                      if h is not None)
+        for hop in plan.hops:
+            if hop is None:
+                continue
+            assert hop.rows.dtype == np.int32
+            # Pad slots are zero-row + invalid; pos marks absent rows
+            # with the block width (the receiver's pad sentinel).
+            assert (hop.rows[~hop.valid] == 0).all()
+            for s in range(plan.d):
+                present = hop.rows[s][hop.valid[s]]
+                assert (hop.pos[s][present]
+                        == np.arange(len(present))).all()
+                absent = np.setdiff1d(np.arange(plan.nl), present)
+                assert (hop.pos[s][absent] == hop.width).all()
+
+    def test_complete_graph_rejected(self):
+        with pytest.raises(ValueError, match="neighbor-list"):
+            zoned_exchange_plan(topology.complete(16), 4)
+
+    def test_bad_args_rejected(self):
+        topo = topology.zoned(32, 4)
+        with pytest.raises(ValueError, match="push|pull"):
+            zoned_exchange_plan(topo, 4, direction="sideways")
+        with pytest.raises(ValueError, match="divide"):
+            zoned_exchange_plan(topo, 5)
+
+    def test_plan_narrower_than_all_gather(self):
+        """The point of the mode: the plan ships fewer rows than the
+        (d-1)/d·n rows all_gather moves per device."""
+        topo = topology.zoned(64, 8, local_hops=2, remote_deg=2)
+        plan = zoned_exchange_plan(topo, 8)
+        assert plan.total_rows < 64 * 7 // 8
+
+
+class TestZonedBuilder:
+    def test_zone_and_bias_structure(self):
+        n, zones = 64, 8
+        t = topology.zoned(n, zones, local_hops=2, remote_deg=2,
+                           local_bias=0.5)
+        assert check_topology(t) == []
+        zl = n // zones
+        nbrs, deg = np.asarray(t.nbrs), np.asarray(t.deg)
+        zone_of = np.arange(n) // zl
+        for i in (0, 5, 17, 63):
+            real = nbrs[i, :deg[i]]
+            local = zone_of[real] == zone_of[i]
+            # Both tiers present; the local fraction tracks the bias.
+            assert local.any() and (~local).any()
+
+    def test_invalid_args_named(self):
+        with pytest.raises(ValueError, match="divide"):
+            topology.zoned(10, 3)
+        with pytest.raises(ValueError, match="local_bias"):
+            topology.zoned(16, 4, local_bias=1.5)
+        with pytest.raises(ValueError, match="nodes per zone"):
+            topology.zoned(16, 16)

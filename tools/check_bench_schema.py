@@ -48,6 +48,7 @@ KNOWN_RESULT_BLOCKS = {
     "query": dict,
     "robustness": dict,
     "sweep": dict,
+    "topology": dict,
     "cost": dict,
     "regression": dict,
     "telemetry": dict,

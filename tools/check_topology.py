@@ -1,0 +1,166 @@
+#!/usr/bin/env python3
+"""Structural invariant checker for the overlay builders
+(``sidecar_tpu/ops/topology.py``) — runs IN tier-1
+(tests/test_topology.py) and standalone.
+
+Every overlay the registry can hand to a sim must satisfy the padded
+neighbor-list contract the gossip kernel samples against
+(``nbrs[n, randint(deg[n])]``, docs/topology.md):
+
+* **shape/domain** — ``nbrs`` int32 ``[n, K]``, ``deg`` int32 ``[n]``
+  with ``0 <= deg <= K``; every entry a valid node id.
+* **self-pad only past deg** — columns ``>= deg[i]`` hold exactly
+  ``i`` (the self-loop no-op the sampler may land on is ONLY ever the
+  pad region), and no column ``< deg[i]`` is a self-loop (a real
+  neighbor slot wasting fan-out on a self-send would silently slow
+  convergence, invisible to any correctness test).
+* **symmetry** — for the undirected families (ring, chord, er, ba,
+  expander, mesh) the edge SET is symmetric: ``j in nbrs[i]`` iff
+  ``i in nbrs[j]`` (multiplicity ignored — zoned's bias replication
+  is a sampling weight, and zoned's remote tier is directed by
+  design, so the zoned family is exempt).
+* **connectivity** — families connected by construction (ring, chord,
+  expander, zoned via its gateway ring, mesh) must yield ONE
+  undirected component.  Erdős–Rényi and Barabási–Albert make no such
+  promise (the headline ER graph carries ~40 isolated nodes — bench.py
+  docstring) and are exempt.
+
+Usage: ``python tools/check_topology.py [n]`` — checks the default
+catalog at cluster size n (default 64); exits 0 when clean, 1 with a
+per-overlay report otherwise.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+# Families whose builders promise an undirected edge set / a connected
+# graph — see the module docstring for the exemptions.
+SYMMETRIC_FAMILIES = ("ring", "chord", "er", "ba", "expander", "mesh")
+CONNECTED_FAMILIES = ("ring", "chord", "expander", "zoned", "mesh")
+
+
+def _family(name: str) -> str:
+    return name.rstrip("0123456789x0123456789") or name
+
+
+def components(nbrs: np.ndarray, deg: np.ndarray) -> int:
+    """Count undirected components over the valid (non-pad) edges."""
+    n = nbrs.shape[0]
+    K = nbrs.shape[1]
+    ok = np.arange(K)[None, :] < deg[:, None]
+    src = np.repeat(np.arange(n), K)[ok.ravel()]
+    dst = nbrs.ravel()[ok.ravel()]
+    # Union-find, path-halving.
+    parent = np.arange(n)
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for a, b in zip(src.tolist(), dst.tolist()):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+    return len({find(i) for i in range(n)})
+
+
+def check_topology(topo, *, symmetric: Optional[bool] = None,
+                   connected: Optional[bool] = None) -> List[str]:
+    """All invariant violations for one built overlay (empty = clean).
+
+    ``symmetric``/``connected`` default by family (the module
+    docstring's lists); pass explicitly for custom-built overlays."""
+    name = topo.name
+    issues: List[str] = []
+    if topo.nbrs is None:
+        # The complete graph has no materialized structure to check.
+        if topo.deg is not None:
+            issues.append(f"{name}: complete graph with a deg vector")
+        return issues
+    fam = _family(name)
+    if symmetric is None:
+        symmetric = fam in SYMMETRIC_FAMILIES
+    if connected is None:
+        connected = fam in CONNECTED_FAMILIES
+    nbrs, deg, n = np.asarray(topo.nbrs), np.asarray(topo.deg), topo.n
+    if nbrs.ndim != 2 or nbrs.shape[0] != n:
+        return [f"{name}: nbrs shape {nbrs.shape}, expected ({n}, K)"]
+    if deg.shape != (n,):
+        return [f"{name}: deg shape {deg.shape}, expected ({n},)"]
+    K = nbrs.shape[1]
+    if nbrs.dtype != np.int32 or deg.dtype != np.int32:
+        issues.append(f"{name}: dtypes {nbrs.dtype}/{deg.dtype}, "
+                      "expected int32/int32")
+    if (deg < 0).any() or (deg > K).any():
+        issues.append(f"{name}: deg outside [0, K={K}]")
+    if (nbrs < 0).any() or (nbrs >= n).any():
+        issues.append(f"{name}: neighbor ids outside [0, {n})")
+    idx = np.arange(n, dtype=nbrs.dtype)
+    col = np.arange(K)[None, :]
+    valid = col < deg[:, None]
+    pad_ok = np.where(~valid, nbrs == idx[:, None], True).all()
+    if not pad_ok:
+        bad = int(np.argwhere(~valid & (nbrs != idx[:, None]))[0][0])
+        issues.append(f"{name}: pad column not self (first bad row "
+                      f"{bad}) — self-pad must fill strictly past deg")
+    if np.where(valid, nbrs == idx[:, None], False).any():
+        bad = int(np.argwhere(valid & (nbrs == idx[:, None]))[0][0])
+        issues.append(f"{name}: self-loop inside the valid region "
+                      f"(row {bad}, col < deg)")
+    if symmetric and not issues:
+        fwd = set(zip(
+            np.repeat(idx, K)[valid.ravel()].tolist(),
+            nbrs.ravel()[valid.ravel()].tolist()))
+        asym = [e for e in fwd if (e[1], e[0]) not in fwd]
+        if asym:
+            issues.append(f"{name}: {len(asym)} asymmetric edge(s), "
+                          f"first {asym[0]} — undirected families must "
+                          "add both directions")
+    if connected and not issues:
+        c = components(nbrs, deg)
+        if c != 1:
+            issues.append(f"{name}: {c} components — this family is "
+                          "connected by construction")
+    return issues
+
+
+def default_catalog(n: int = 64):
+    """The registry families at cluster size n (ops/topology.from_name
+    resolves the same names for /sweep grids)."""
+    from sidecar_tpu.ops import topology
+
+    names = ["complete", "ring2", "chord", "expander4", "er8", "ba2",
+             f"zoned{max(2, n // 8)}"]
+    r = 8
+    if n % r == 0:
+        names.append(f"mesh{r}x{n // r}")
+    return [topology.from_name(name, n) for name in names]
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    n = int(args[0]) if args else 64
+    issues: List[str] = []
+    topos = default_catalog(n)
+    for topo in topos:
+        issues.extend(check_topology(topo))
+    if issues:
+        print(f"check_topology: {len(issues)} issue(s) at n={n}")
+        for issue in issues:
+            print(f"  {issue}")
+        return 1
+    print(f"check_topology: {len(topos)} overlay(s) OK at n={n}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
