@@ -786,6 +786,24 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# clock-skew bench failed: {exc}", file=sys.stderr)
 
+    # Byzantine blast radius (benchmarks/adversary.py, docs/chaos.md):
+    # the combined tombstone-bomb + future-flood + sybil attack with
+    # the defense ladder OFF vs ON — poisoned rows, FP tombstones,
+    # proxy churn, bytes amplification, and the convergence tax.
+    # BENCH_ADVERSARY=0 skips it; BENCH_ADVERSARY_NODES sizes the
+    # cluster.  Watchdog notes bracket the block so a hung run leaves
+    # a partial record naming the phase.
+    adversary = None
+    if os.environ.get("BENCH_ADVERSARY", "1") != "0":
+        try:
+            from benchmarks.adversary import run_adversary
+            _watchdog_note("adversary")
+            adversary = run_adversary(
+                n=int(os.environ.get("BENCH_ADVERSARY_NODES", "128")))
+            _watchdog_note("adversary", {"adversary": adversary})
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# adversary bench failed: {exc}", file=sys.stderr)
+
     # Scenario-fleet sweep (benchmarks/sweep.py, docs/sweep.md): the
     # 64-point protocol grid in ONE vmapped dispatch vs the per-point
     # trace+compile+dispatch status quo, with the per-scenario
@@ -870,6 +888,7 @@ def main() -> None:
            if north_star_k1024 else {}),
         **({"query": query_bench} if query_bench else {}),
         **({"robustness": robustness} if robustness else {}),
+        **({"adversary": adversary} if adversary else {}),
         **({"sweep": sweep} if sweep else {}),
         **({"topology": topology_block} if topology_block else {}),
         **({"cost": cost_block} if cost_block else {}),

@@ -1,0 +1,265 @@
+"""Byzantine-peer blast radius: the combined attack program with the
+defense ladder OFF vs ON — the bench `adversary` block.
+
+The scenario is the config6 cluster shape (docs/chaos.md) under the
+COMBINED attack program docs/chaos.md's defense-ladder section names:
+
+* a **tombstone bomb** — two colluding nodes forge TOMBSTONE records
+  for the victim half's slots at their current tick, every round
+  (LWW poison that kills live services until the next refresh, then
+  kills them again);
+* a **future flood** — one node stamps forged ALIVE records a minute
+  into the future (unrefreshable poison only the future-admission
+  bound or the origin budget can stop);
+* a **sybil flood** — one node floods forged-fresh ALIVE records
+  *under* the future bound (caught only by the per-origin budget and
+  the quarantine it feeds).
+
+Three runs share one driver seed and one AdversaryPlan:
+
+* ``baseline`` — attack OFF, defenses OFF: the honest rounds-to-ε the
+  headline's convergence-tax claim is read against;
+* ``defense_off`` — attack ON, every defense knob off (the pre-PR
+  protocol under attack): the unmitigated blast radius;
+* ``defense_on`` — attack ON, the full ladder on
+  (``future_fudge_s`` + ``origin_budget`` + ``origin_quarantine``).
+
+Per round, host-side numpy diffs of the carried state count the blast:
+
+* ``fp_tombstones`` — belief cells ENTERING tombstone status with a
+  live owner (the flight recorder's definition, ops/trace.py).  The
+  alive lifespan is longer than the run, so no honest expiry fires:
+  every single one is attack damage.
+* ``poisoned_rows_final`` — cells in HONEST (non-attacker) tables
+  stamped ahead of the true clock at the end of the run — the future
+  flood's footprint (the sybil flood's small displacement ages out).
+* ``proxy_churn_observer`` — alive↔not-alive flips in an honest
+  victim's row: routing reloads an attached proxy would take.
+* ``bytes`` — two components, reported separately: the analytic
+  honest offer volume (ops/trace.offer_census — attack-induced churn
+  re-arms transmissions, so the bomb amplifies HONEST bytes too) and
+  the forged wire volume (forged columns × fanout ×
+  RECORD_WIRE_BYTES).  Quarantine zeroes an attacker's send channel,
+  so the ON run's forged volume stops growing at the quarantine
+  round.
+* ``rounds_to_eps`` — defenses must not buy their reduction by
+  converging slower (the headline pins ON ≤ 1.10× baseline).
+
+Run standalone: ``python benchmarks/adversary.py [n]`` — prints the
+JSON block bench.py embeds (BENCH_ADVERSARY=0 skips it there).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+if __name__ == "__main__":  # standalone: resolve the repo root
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# Defense-knob values for the ON run: the fudge sits under the ttl
+# sweep's +1 s supersede bump (the run_skew rationale,
+# benchmarks/robustness.py); the budget admits one suspicious
+# third-party record per packet (honest packets almost never carry
+# more — a relayed tombstone travels alone); the quarantine threshold
+# is under one round of sustained config6-fanout flooding beyond the
+# budget, yet several isolated noisy packets away for an honest node.
+DEFENSE_FUDGE_S = 0.5
+DEFENSE_BUDGET = 1
+DEFENSE_QUARANTINE = 12
+
+
+def combined_attack(n: int, start_round: int = 10,
+                    future_s: float = 60.0, sybil_s: float = 0.4,
+                    seed: int = 6):
+    """The headline AdversaryPlan: bomb + future flood + sybil flood
+    from four colluding nodes, for the rest of the run."""
+    from sidecar_tpu.chaos.adversary import AdversaryPlan, Attack
+    from sidecar_tpu.models.timecfg import TimeConfig
+
+    tc = TimeConfig()  # tick scale only (ticks() is cfg-independent)
+    victims = tuple(range(n // 2, n))
+    return AdversaryPlan(seed=seed, attacks=(
+        Attack(kind="tombstone_bomb", nodes=(0, 1), victims=victims,
+               rate=0.5, start_round=start_round),
+        Attack(kind="future_flood", nodes=(2,), victims=victims,
+               rate=0.4, magnitude_ticks=tc.ticks(future_s),
+               start_round=start_round),
+        Attack(kind="sybil_flood", nodes=(3,), victims=victims,
+               rate=0.4, magnitude_ticks=tc.ticks(sybil_s),
+               start_round=start_round),
+    ))
+
+
+def _measure_adv(n: int, spn: int, rounds: int, *, attack: bool,
+                 defenses: bool, eps: float, seed: int,
+                 topo=None) -> dict:
+    """One run of the scenario.  ``attack`` arms the AdversaryPlan;
+    ``defenses`` turns the whole ladder on.  Defenses-off runs leave
+    every knob at its negative sentinel, so they execute the pre-PR
+    merge program bit for bit (tests/test_adversary.py pins this).
+    ``topo`` overrides the complete-graph overlay — the ``--chaos``
+    topology chart (benchmarks/topology_sweep.py) reuses this loop
+    per overlay."""
+    import jax
+    import numpy as np
+
+    from sidecar_tpu.chaos import ChaosExactSim, FaultPlan
+    from sidecar_tpu.models.exact import SimParams
+    from sidecar_tpu.models.timecfg import TimeConfig
+    from sidecar_tpu.ops import topology
+    from sidecar_tpu.ops.gossip import eligible_records
+    from sidecar_tpu.ops.status import ALIVE, TOMBSTONE
+    from sidecar_tpu.ops.trace import RECORD_WIRE_BYTES, offer_census
+
+    cfg = TimeConfig(
+        refresh_interval_s=4.0, alive_lifespan_s=80.0,
+        sweep_interval_s=0.4, push_pull_interval_s=1.0,
+        future_fudge_s=DEFENSE_FUDGE_S if defenses else -1.0,
+        origin_budget=DEFENSE_BUDGET if defenses else -1,
+        origin_quarantine=DEFENSE_QUARANTINE if defenses else -1)
+    params = SimParams(n=n, services_per_node=spn, fanout=3, budget=15)
+    adv = combined_attack(n) if attack else None
+    sim = ChaosExactSim(params, topo or topology.complete(n), cfg,
+                        plan=FaultPlan(seed=6), adversary=adv)
+    cst = sim.init_state()
+    key = jax.random.PRNGKey(seed)
+
+    owner = np.arange(params.m) // spn
+    attackers = np.zeros(n, dtype=bool)
+    if attack:
+        attackers[list(adv.attackers(n))] = True
+    honest = ~attackers
+    observer = n - 1  # an honest victim's routing view
+    limit = params.resolved_retransmit_limit()
+    budget = min(params.budget, params.m)
+
+    def status_of(row):
+        known = (row >> 3) > 0
+        return np.where(known, row & 7, -1)
+
+    prev_known = np.asarray(cst.sim.known)
+    prev_obs = status_of(prev_known[observer])
+    fp_total = 0
+    churn_total = 0
+    honest_bytes = 0
+    eps_round = None
+    conv = 0.0
+    conv_tail = []
+
+    for r in range(rounds):
+        # Pre-round analytic offer census (the flight recorder's
+        # exchange_bytes definition) — the attack's HONEST-traffic
+        # amplification: poisoned cells re-arm their transmissions.
+        elig = np.asarray(eligible_records(
+            cst.sim.known, cst.sim.sent, limit))
+        per_row = elig.sum(axis=1)
+        honest_bytes += int(np.minimum(per_row, budget).sum()
+                            * params.fanout * RECORD_WIRE_BYTES)
+        cst = sim.step(cst, jax.random.fold_in(key, cst.sim.round_idx))
+        known = np.asarray(cst.sim.known)
+        alive = np.asarray(cst.sim.node_alive)
+        st = status_of(known)
+        prev_st = status_of(prev_known)
+        entered = (st == TOMBSTONE) & (prev_st != TOMBSTONE)
+        fp_total += int((entered & alive[owner][None, :]).sum())
+        obs = st[observer]
+        moved = ((prev_obs == ALIVE) != (obs == ALIVE)) & (prev_obs >= 0)
+        churn_total += int(moved.sum())
+        prev_obs = obs
+        prev_known = known
+        conv = float(sim.convergence(cst))
+        if r >= (3 * rounds) // 4:
+            conv_tail.append(conv)
+        if eps_round is None and conv >= 1.0 - eps:
+            eps_round = r + 1
+
+    now_tick = int(cst.sim.round_idx) * cfg.round_ticks
+    ts = known >> 3
+    poisoned = int(((ts > now_tick) & honest[:, None]).sum())
+    counts = sim.injection_counts(cst)
+    forged_bytes = counts["forged"] * params.fanout * RECORD_WIRE_BYTES
+
+    return {
+        "attack": attack,
+        "defenses": defenses,
+        "fp_tombstones": fp_total,
+        "poisoned_rows_final": poisoned,
+        "proxy_churn_observer": churn_total,
+        "honest_offer_bytes": honest_bytes,
+        "forged_wire_bytes": forged_bytes,
+        "forged_records": counts["forged"],
+        "rejected_future": counts["rejected_future"],
+        "rejected_budget": counts["rejected_budget"],
+        "quarantined_origins": counts["quarantined"],
+        "rounds_to_eps": eps_round,
+        "final_convergence": round(conv, 6),
+        "mean_tail_convergence": round(
+            sum(conv_tail) / max(len(conv_tail), 1), 6),
+    }
+
+
+def run_adversary(n: int = 128, spn: int = 2, rounds: int = 200,
+                  eps: float = 0.2, seed: int = 6) -> dict:
+    """The bench ``adversary`` block: baseline (no attack), attack with
+    defenses OFF, attack with the full ladder ON, and the headline
+    reduction ratios (docs/chaos.md pins ≥ 10× on poisoned rows and FP
+    tombstones at ≤ 1.10× baseline rounds-to-ε)."""
+    from sidecar_tpu import metrics
+
+    baseline = _measure_adv(n, spn, rounds, attack=False,
+                            defenses=False, eps=eps, seed=seed)
+    off = _measure_adv(n, spn, rounds, attack=True, defenses=False,
+                       eps=eps, seed=seed)
+    on = _measure_adv(n, spn, rounds, attack=True, defenses=True,
+                      eps=eps, seed=seed)
+
+    def ratio(a, b):
+        if b == 0:
+            return None if a == 0 else float("inf")
+        return round(a / b, 2)
+
+    metrics.incr("adversary.sim.forgedRecords", on["forged_records"])
+    metrics.incr("defense.sim.rejectedBudget", on["rejected_budget"])
+
+    conv_tax = None
+    if baseline["rounds_to_eps"] and on["rounds_to_eps"]:
+        conv_tax = round(on["rounds_to_eps"] / baseline["rounds_to_eps"],
+                         3)
+    return {
+        "scenario": "config6 scale, combined tombstone-bomb + "
+                    "future-flood + sybil-flood from 4 colluding "
+                    "nodes; defense ladder OFF vs ON (docs/chaos.md)",
+        "n": n,
+        "rounds": rounds,
+        "defense_knobs": {"future_fudge_s": DEFENSE_FUDGE_S,
+                          "origin_budget": DEFENSE_BUDGET,
+                          "origin_quarantine": DEFENSE_QUARANTINE},
+        "baseline": baseline,
+        "defense_off": off,
+        "defense_on": on,
+        "poisoned_row_reduction": ratio(off["poisoned_rows_final"],
+                                        on["poisoned_rows_final"]),
+        "fp_tombstone_reduction": ratio(off["fp_tombstones"],
+                                        on["fp_tombstones"]),
+        "proxy_churn_reduction": ratio(off["proxy_churn_observer"],
+                                       on["proxy_churn_observer"]),
+        "bytes_amplification_off": ratio(
+            off["honest_offer_bytes"] + off["forged_wire_bytes"],
+            baseline["honest_offer_bytes"]),
+        "bytes_amplification_on": ratio(
+            on["honest_offer_bytes"] + on["forged_wire_bytes"],
+            baseline["honest_offer_bytes"]),
+        "convergence_tax_on": conv_tax,
+    }
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    print(json.dumps(run_adversary(n=n), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -180,12 +180,56 @@ def run_topology_bench(n: int = 4096, *, d=None, zones=None,
     return out
 
 
+CHAOS_OVERLAYS = ("complete", "chord", "expander4", "er6", "ba2")
+
+
+def run_chaos_topologies(n: int = 128, overlays=CHAOS_OVERLAYS, *,
+                         spn: int = 2, rounds: int = 60, eps: float = 0.2,
+                         seed: int = 6) -> dict:
+    """``--chaos`` mode: the combined config6 attack program
+    (benchmarks/adversary.combined_attack — tombstone bomb + future
+    flood + sybil flood) with the full defense ladder ON, charted
+    PER OVERLAY: rounds-to-ε vs the honest offer bytes each overlay
+    spends getting there (docs/topology.md records the chart).
+
+    Sparse random overlays are :func:`sidecar_tpu.ops.topology.repair`'d
+    first — a fragmented ER draw never converges, and that would read
+    as attack damage when it is a builder artifact.  The chart answers
+    a capacity question the complete-graph headline cannot: which
+    overlay families keep converging under Byzantine pressure, and at
+    what wire cost.
+    """
+    from benchmarks.adversary import _measure_adv
+    from sidecar_tpu.ops import topology as topo_mod
+
+    out = {"n": n, "rounds_horizon": rounds, "eps": eps,
+           "attack": "config6 combined plan, defense ladder ON",
+           "overlays": {}}
+    for name in overlays:
+        topo = topo_mod.repair(topo_mod.from_name(name, n, seed=seed))
+        row = _measure_adv(n, spn, rounds, attack=True, defenses=True,
+                           eps=eps, seed=seed, topo=topo)
+        out["overlays"][topo.name] = {
+            "rounds_to_eps": row["rounds_to_eps"],
+            "final_convergence": row["final_convergence"],
+            "honest_offer_bytes": row["honest_offer_bytes"],
+            "fp_tombstones": row["fp_tombstones"],
+            "quarantined_origins": row["quarantined_origins"],
+        }
+    return out
+
+
 def main() -> int:
     # The environment's sitecustomize pins jax to the default platform
     # at interpreter start; re-assert an explicit JAX_PLATFORMS choice.
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    args = [a for a in sys.argv[1:] if a != "--chaos"]
+    if "--chaos" in sys.argv[1:]:
+        n = int(args[0]) if args else 128
+        print(json.dumps(run_chaos_topologies(n=n), indent=2))
+        return 0
+    n = int(args[0]) if args else 4096
     print(json.dumps(run_topology_bench(n=n), indent=2))
     return 0
 

@@ -187,6 +187,13 @@ class ServicesState:
         # defense against a rushing peer clock poisoning LWW.
         # Negative = disabled (the reference behavior).
         self.future_fudge_s: float = -1.0
+        # Origin-admission gate (ops/suspicion.QuarantineScorer, the
+        # live twin of the sim's per-origin violation counter): when
+        # attached, every push-pull body is scored against the sender
+        # and records from quarantined origins are rejected at the
+        # writer.  None = the defense rung is off
+        # (SIDECAR_TPU_ORIGIN_BUDGET / _ORIGIN_QUARANTINE unset).
+        self.origin_gate = None
 
     # -- time injection (tests) -------------------------------------------
 
@@ -296,6 +303,22 @@ class ServicesState:
     def _add_service_entry(self, new_svc: Service) -> None:
         with self._lock:
             now = self._now()
+            gate = self.origin_gate
+            if gate is not None:
+                # Transport-origin annotation, NOT the record's hostname
+                # — a forger writes any hostname it likes, the transport
+                # knows who actually pushed.  Un-annotated records (the
+                # per-record UDP path carries no sender) pass: the gate
+                # covers the push-pull plane, exactly where a flood can
+                # carry a whole board in one body.
+                origin = getattr(new_svc, "gossip_origin", None)
+                if origin is not None and gate.is_quarantined(origin):
+                    metrics.incr("defense.live.rejectedQuarantine")
+                    log.warning(
+                        "Dropping record %s:%s (%s) from quarantined "
+                        "origin %s", new_svc.hostname, new_svc.name,
+                        new_svc.id, origin)
+                    return
             if new_svc.is_stale(TOMBSTONE_LIFESPAN, now=now):
                 log.warning("Dropping stale service received on gossip: "
                             "%s:%s (%s)", new_svc.hostname, new_svc.name,
@@ -346,10 +369,32 @@ class ServicesState:
                              (now - svc.updated) / 1e6)
 
     def merge(self, other: "ServicesState") -> None:
-        """Full-state anti-entropy merge (services_state.go:367-373)."""
+        """Full-state anti-entropy merge (services_state.go:367-373).
+
+        When the origin gate is attached, one push-pull body is "one
+        packet" in the defense ladder's sense: the whole body is scored
+        against the sender (``other.hostname`` — the transport origin
+        the peer authenticated as, not any record's claimed hostname)
+        before a single record is enqueued, and every record is
+        annotated with that origin so the writer can reject the push
+        once the origin crosses the quarantine threshold."""
+        origin = other.hostname
+        gate = self.origin_gate
+        if gate is not None and origin:
+            over = gate.observe(
+                origin,
+                [(svc.hostname == origin, svc.updated)
+                 for server in other.servers.values()
+                 for svc in server.services.values()],
+                self._now())
+            if over:
+                metrics.incr("defense.live.originViolations", over)
         for server in other.servers.values():
             for svc in server.services.values():
-                self.update_service(svc.copy())
+                c = svc.copy()
+                if gate is not None and origin:
+                    c.gossip_origin = origin
+                self.update_service(c)
 
     def retransmit(self, svc: Service) -> None:
         """Epidemic relay of non-local changes (services_state.go:377-392);
@@ -370,6 +415,14 @@ class ServicesState:
         directly) gate admission on it."""
         with self._lock:
             self.flap_damper = damper
+
+    def attach_origin_gate(self, scorer) -> None:
+        """Attach an :class:`~sidecar_tpu.ops.suspicion.QuarantineScorer`
+        (same attach pattern as :meth:`attach_damper`): push-pull bodies
+        are scored in :meth:`merge` and quarantined origins' records
+        rejected in the writer."""
+        with self._lock:
+            self.origin_gate = scorer
 
     def service_changed(self, svc: Service, previous_status: int,
                         updated: int) -> None:

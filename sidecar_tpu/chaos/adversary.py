@@ -1,0 +1,374 @@
+"""The AdversaryPlan: declarative Byzantine-peer attack programs,
+beside :mod:`sidecar_tpu.chaos.plan`'s honest-fault FaultPlan.
+
+A FaultPlan breaks the *transport* (loss, partitions, pauses, skewed
+clocks); an AdversaryPlan breaks the *content*: selected nodes LIE in
+the records they gossip.  Each :class:`Attack` entry names who lies
+(``nodes``), about whom (``victims``), when (a half-open
+``[start_round, end_round)`` window, the FaultPlan convention), how
+much of each packet is corrupted (``rate`` of the message budget), and
+how (``kind``):
+
+* ``tombstone_bomb`` — forge TOMBSTONE records for the victims' slots
+  at the attacker's current tick: LWW poison that kills live services.
+* ``future_flood`` — forge ALIVE records stamped ``magnitude_ticks``
+  into the future (beyond any admission fudge): unrefreshable poison
+  that only ``ops/merge.future_mask`` or the origin budget can stop.
+* ``sybil_flood`` — the same forged-ALIVE flood but *within* a small
+  magnitude: an identity flood of plausible fresh records that slips
+  under the future gate, caught only by the per-origin budget.
+* ``past_flood`` / ``replay`` — old-stamped ALIVE floods (a replayed
+  stale catalog): mostly harmless to LWW but a bytes-amplification
+  attack on the transport and the admission gates.
+* ``flap`` — the attacker oscillates its OWN records ALIVE/DRAINING
+  with fresh stamps every round: the proxy-churn attack the
+  FlapDamper (PR 7) gates on the live path.
+
+Design requirements, shared with FaultPlan:
+
+* **Deterministic, PRNG-free.**  An attack corrupts the first
+  ``floor(rate * budget)`` columns of an attacker's packet and targets
+  victim slots by pure rotation (``(round * ncorrupt + col) % V``) —
+  no random draws at all, so the NumPy oracle and the live injector
+  mirror the compiled path tick for tick with plain arithmetic.
+* **Round-indexed, window-scoped.**  Windows are gossip rounds;
+  overlapping windows of the same kind on the same attacker are a
+  validation error (named, tested) rather than an ambiguous overlay.
+* **Horizon-guarded.**  Future-stamped forgeries count toward the
+  packed-key overflow guard exactly like positive clock skew
+  (``max_future_ticks`` → ``models/timecfg.validate_horizon``).
+
+See docs/chaos.md ("Adversarial gossip & the defense ladder") for the
+defense stack this plan is measured against.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Union
+
+import numpy as np
+
+from sidecar_tpu.chaos.plan import FOREVER, NodeSel, _as_sel, resolve_nodes
+from sidecar_tpu.ops import status as svc_status
+
+ATTACK_KINDS = ("tombstone_bomb", "future_flood", "sybil_flood",
+                "past_flood", "replay", "flap")
+
+# Kinds whose forged timestamps sit magnitude_ticks in the future and
+# therefore contribute to the packed-key horizon guard.
+_FUTURE_KINDS = ("future_flood", "sybil_flood")
+# Kinds that need a nonzero timestamp displacement to mean anything.
+_NEEDS_MAGNITUDE = ("future_flood", "sybil_flood", "past_flood", "replay")
+
+
+@dataclasses.dataclass(frozen=True)
+class Attack:
+    """One attack program: WHO lies about WHOM, WHEN, HOW, and HOW MUCH.
+
+    ``rate`` is the corrupted fraction of the per-packet message budget
+    — ``floor(rate * budget)`` columns of every packet the attacker
+    sends inside the window carry forged records instead of (or on top
+    of) its honest payload.  ``magnitude_ticks`` is the forged-stamp
+    displacement for the flood kinds (future for ``future_flood`` /
+    ``sybil_flood``, past for ``past_flood`` / ``replay``); it is
+    ignored by ``tombstone_bomb`` and ``flap``, which stamp at the
+    attacker's current tick.
+    """
+
+    kind: str
+    nodes: NodeSel
+    victims: NodeSel = "all"
+    start_round: int = 0
+    end_round: int = FOREVER
+    rate: float = 1.0
+    magnitude_ticks: int = 0
+
+    def __post_init__(self):
+        if self.kind not in ATTACK_KINDS:
+            raise ValueError(
+                f"unknown attack kind {self.kind!r} (expected one of "
+                f"{', '.join(ATTACK_KINDS)})")
+        object.__setattr__(self, "nodes", _as_sel(self.nodes))
+        object.__setattr__(self, "victims", _as_sel(self.victims))
+        if not 0.0 < self.rate <= 1.0:
+            raise ValueError(f"rate={self.rate} not in (0, 1]")
+        if self.start_round < 0:
+            raise ValueError(f"negative window start {self.start_round}")
+        if self.start_round >= self.end_round:
+            raise ValueError(
+                f"empty window [{self.start_round}, {self.end_round})")
+        if self.magnitude_ticks < 0:
+            raise ValueError(
+                f"magnitude_ticks must be >= 0, got {self.magnitude_ticks}")
+        if self.kind in _NEEDS_MAGNITUDE and self.magnitude_ticks == 0:
+            raise ValueError(
+                f"{self.kind} requires magnitude_ticks >= 1")
+
+    @property
+    def max_future_ticks(self) -> int:
+        """Largest future displacement this entry can stamp — the
+        horizon-guard contribution (models/timecfg.validate_horizon)."""
+        return self.magnitude_ticks if self.kind in _FUTURE_KINDS else 0
+
+    def active_at(self, round_idx: int) -> bool:
+        return self.start_round <= round_idx < self.end_round
+
+
+def _overlap(a: Attack, b: Attack) -> bool:
+    if a.kind != b.kind:
+        return False
+    if a.start_round >= b.end_round or b.start_round >= a.end_round:
+        return False
+    sa = "all" if a.nodes == "all" else set(a.nodes)
+    sb = "all" if b.nodes == "all" else set(b.nodes)
+    if sa == "all" or sb == "all":
+        return True
+    return bool(sa & sb)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdversaryPlan:
+    """The whole Byzantine schedule, rooted at one seed.
+
+    The seed exists for schema parity with FaultPlan (one reproduction
+    recipe names both plans) and for future randomized attack kinds;
+    every current kind is deliberately PRNG-free (module docstring).
+    """
+
+    seed: int
+    attacks: tuple = ()
+
+    def __post_init__(self):
+        object.__setattr__(self, "attacks", tuple(self.attacks))
+        for a in self.attacks:
+            if not isinstance(a, Attack):
+                raise TypeError(f"attacks entries must be Attack, "
+                                f"got {type(a).__name__}")
+        for i, a in enumerate(self.attacks):
+            for b in self.attacks[i + 1:]:
+                if _overlap(a, b):
+                    raise ValueError(
+                        f"overlapping {a.kind} windows "
+                        f"[{a.start_round}, {a.end_round}) and "
+                        f"[{b.start_round}, {b.end_round}) on shared "
+                        f"attacker(s)")
+
+    @property
+    def max_future_ticks(self) -> int:
+        """Largest future stamp any attacker can mint — folded into the
+        packed-key overflow guard beside the plan's clock skew."""
+        return max((a.max_future_ticks for a in self.attacks), default=0)
+
+    def attackers(self, n: int) -> tuple:
+        """Sorted union of every entry's attacker set for an ``n``-node
+        cluster (the live injector's roster and the quarantine tests'
+        expected origin set)."""
+        out: set = set()
+        for a in self.attacks:
+            out.update(resolve_nodes(a.nodes, n))
+        return tuple(sorted(out))
+
+    def active_attackers(self, n: int, round_idx: int) -> tuple:
+        out: set = set()
+        for a in self.attacks:
+            if a.active_at(round_idx):
+                out.update(resolve_nodes(a.nodes, n))
+        return tuple(sorted(out))
+
+    # -- serialization (reproduction recipes, docs/chaos.md) ---------------
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed,
+                "attacks": [dataclasses.asdict(a) for a in self.attacks]}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "AdversaryPlan":
+        return cls(seed=int(d["seed"]),
+                   attacks=tuple(Attack(**a) for a in d.get("attacks", [])))
+
+    def dumps(self) -> str:
+        return json.dumps(self.to_json(), sort_keys=True)
+
+    @classmethod
+    def loads(cls, s: str) -> "AdversaryPlan":
+        return cls.from_json(json.loads(s))
+
+
+@dataclasses.dataclass(frozen=True)
+class _CompiledAttack:
+    """One attack resolved against a concrete cluster: static masks and
+    slot tables the traced corrupt step closes over."""
+
+    kind: str
+    start_round: int
+    end_round: int
+    ncorrupt: int           # corrupted columns per packet
+    magnitude_ticks: int
+    attacker_mask: tuple    # length-n bool tuple (hashable static)
+    victim_slots: tuple     # sorted victim-owned slot ids ("" for flap)
+    own_slots: tuple        # [n][s] per-node owned slots (flap only)
+
+
+class CompiledAdversaryPlan:
+    """An AdversaryPlan resolved against one cluster shape.
+
+    ``corrupt`` is the traced path (jnp, called between
+    ``select_messages`` and ``record_transmissions`` in the chaos sim);
+    ``host_overrides`` is the NumPy compiler of the SAME formulas for
+    the oracle and the live injector.  Both are pure functions of
+    (round, per-node now) — no PRNG, so they agree exactly.
+    """
+
+    def __init__(self, plan: AdversaryPlan, *, n: int, owner,
+                 budget: int):
+        self.plan = plan
+        self.n = int(n)
+        self.budget = int(budget)
+        owner = np.asarray(owner, np.int64)
+        self.num_slots = int(owner.shape[0])
+        entries = []
+        for a in plan.attacks:
+            attackers = resolve_nodes(a.nodes, n)
+            amask = np.zeros(n, bool)
+            amask[list(attackers)] = True
+            ncorrupt = int(np.floor(a.rate * budget))
+            if ncorrupt == 0:
+                ncorrupt = 1  # rate > 0 always corrupts at least a column
+            own_slots: tuple = ()
+            victim_slots: tuple = ()
+            if a.kind == "flap":
+                per_node = [tuple(np.where(owner == i)[0])
+                            for i in range(n)]
+                widths = {len(p) for p in per_node}
+                if len(widths) != 1:
+                    raise ValueError(
+                        "flap attack requires a uniform services-per-node "
+                        f"layout, got widths {sorted(widths)}")
+                own_slots = tuple(per_node)
+            else:
+                victims = resolve_nodes(a.victims, n)
+                vmask = np.isin(owner, np.asarray(victims, np.int64))
+                victim_slots = tuple(np.where(vmask)[0])
+                if not victim_slots:
+                    raise ValueError(
+                        f"{a.kind} attack has no victim-owned slots "
+                        f"(victims={a.victims!r})")
+            entries.append(_CompiledAttack(
+                kind=a.kind, start_round=a.start_round,
+                end_round=a.end_round, ncorrupt=ncorrupt,
+                magnitude_ticks=a.magnitude_ticks,
+                attacker_mask=tuple(bool(x) for x in amask),
+                victim_slots=victim_slots, own_slots=own_slots))
+        self._entries = tuple(entries)
+        amask_any = np.zeros(n, bool)
+        for e in self._entries:
+            amask_any |= np.asarray(e.attacker_mask, bool)
+        self.attacker_mask = amask_any
+
+    # -- traced path (jnp) -------------------------------------------------
+
+    def corrupt(self, round_idx, now_n, svc_idx, msg):
+        """Overwrite the leading ``ncorrupt`` columns of every active
+        attacker's packet with forged records.
+
+        ``round_idx`` is the (possibly traced) round index, ``now_n``
+        the per-node stamping clock ``[n]`` (ClockFault offsets already
+        applied — liars lie relative to their OWN skewed clocks),
+        ``svc_idx``/``msg`` the ``[n, budget]`` packet from
+        ``select_messages``.  Returns ``(svc_idx, msg, nforged)`` where
+        ``nforged`` is the int32 count of forged columns this round
+        (the ``adversary.sim.forgedRecords`` accounting).  Forged
+        columns replace honest payload AND padding, so a high-rate
+        attacker also amplifies bytes on the wire.
+        """
+        import jax.numpy as jnp
+
+        if not self._entries:
+            return svc_idx, msg, jnp.zeros((), jnp.int32)
+        round_idx = jnp.asarray(round_idx, jnp.int32)
+        now_col = jnp.asarray(now_n, jnp.int32)[:, None]
+        col = jnp.arange(self.budget, dtype=jnp.int32)
+        any_mask = jnp.zeros((self.n, self.budget), bool)
+        for e in self._entries:
+            act = (round_idx >= e.start_round) & (round_idx < e.end_round)
+            amask = (jnp.asarray(e.attacker_mask)[:, None]
+                     & (col < e.ncorrupt)[None, :] & act)
+            if e.kind == "flap":
+                own = jnp.asarray(e.own_slots, jnp.int32)
+                s = own.shape[1]
+                slots = own[:, (round_idx + col) % s]
+                stat = jnp.where(round_idx % 2 == 0,
+                                 svc_status.ALIVE, svc_status.DRAINING)
+                val = svc_status.pack(jnp.maximum(now_col, 1), stat)
+            else:
+                vslots = jnp.asarray(e.victim_slots, jnp.int32)
+                idx = (round_idx * e.ncorrupt + col) % vslots.shape[0]
+                slots = jnp.broadcast_to(vslots[idx][None, :],
+                                         (self.n, self.budget))
+                if e.kind == "tombstone_bomb":
+                    ts = jnp.maximum(now_col, 1)
+                    stat = svc_status.TOMBSTONE
+                elif e.kind in _FUTURE_KINDS:
+                    ts = now_col + e.magnitude_ticks
+                    stat = svc_status.ALIVE
+                else:  # past_flood / replay
+                    ts = jnp.maximum(now_col - e.magnitude_ticks, 1)
+                    stat = svc_status.ALIVE
+                val = svc_status.pack(ts, stat)
+            svc_idx = jnp.where(amask, slots,
+                                jnp.asarray(svc_idx, jnp.int32))
+            msg = jnp.where(amask, val, jnp.asarray(msg, jnp.int32))
+            any_mask = any_mask | amask
+        return svc_idx, msg, jnp.sum(any_mask.astype(jnp.int32))
+
+    # -- host mirror (NumPy) -----------------------------------------------
+
+    def host_overrides(self, round_idx: int, now_n):
+        """The NumPy compiler of :meth:`corrupt`'s formulas: returns
+        ``(mask, slots, vals)``, each ``[n, budget]``, where ``mask``
+        is True on forged columns.  The oracle applies these on top of
+        its shared ``select_messages`` packet; the live injector reads
+        per-attacker rows to forge catalog pushes."""
+        mask = np.zeros((self.n, self.budget), bool)
+        slots = np.zeros((self.n, self.budget), np.int64)
+        vals = np.zeros((self.n, self.budget), np.int64)
+        if not self._entries:
+            return mask, slots, vals
+        now_n = np.asarray(now_n, np.int64)
+        col = np.arange(self.budget)
+        bits = svc_status.STATUS_BITS
+        for e in self._entries:
+            if not e.start_round <= round_idx < e.end_round:
+                continue
+            amask = (np.asarray(e.attacker_mask, bool)[:, None]
+                     & (col < e.ncorrupt)[None, :])
+            if e.kind == "flap":
+                own = np.asarray(e.own_slots, np.int64)
+                s = own.shape[1]
+                eslots = own[:, (round_idx + col) % s]
+                stat = (svc_status.ALIVE if round_idx % 2 == 0
+                        else svc_status.DRAINING)
+                ets = np.maximum(now_n, 1)[:, None]
+                ets = np.broadcast_to(ets, (self.n, self.budget))
+            else:
+                vslots = np.asarray(e.victim_slots, np.int64)
+                idx = (round_idx * e.ncorrupt + col) % vslots.shape[0]
+                eslots = np.broadcast_to(vslots[idx][None, :],
+                                         (self.n, self.budget))
+                if e.kind == "tombstone_bomb":
+                    ets = np.maximum(now_n, 1)[:, None]
+                    stat = svc_status.TOMBSTONE
+                elif e.kind in _FUTURE_KINDS:
+                    ets = now_n[:, None] + e.magnitude_ticks
+                    stat = svc_status.ALIVE
+                else:
+                    ets = np.maximum(now_n[:, None] - e.magnitude_ticks, 1)
+                    stat = svc_status.ALIVE
+                ets = np.broadcast_to(ets, (self.n, self.budget))
+            evals = (ets.astype(np.int64) << bits) | stat
+            mask = np.where(amask, True, mask)
+            slots = np.where(amask, eslots, slots)
+            vals = np.where(amask, evals, vals)
+        return mask, slots, vals

@@ -250,6 +250,108 @@ class LiveInjector:
         return self.plan.health_fault_at(check_id, self.round_now())
 
 
+class AdversaryInjector:
+    """AdversaryPlan → the live catalog machinery.
+
+    The sim corrupts packets between ``select_messages`` and
+    ``record_transmissions`` (chaos/sim_inject.py); the live twin
+    forges the equivalent catalog pushes.  Per active attacker per
+    round, :meth:`CompiledAdversaryPlan.host_overrides`' forged
+    ``(slot, packed val)`` columns become :class:`Service` records —
+    hostname is the SLOT OWNER's name (the forger writes any hostname
+    it likes), while ``gossip_origin`` carries the attacker's transport
+    identity, exactly the annotation ``catalog/state.merge`` stamps on
+    push-pull records.  Driving these packets through a
+    :class:`~sidecar_tpu.ops.suspicion.QuarantineScorer`-gated
+    ``ServicesState`` exercises the same defense rung the sim's origin
+    gate models; tests/test_adversary.py pins that both planes
+    quarantine the same origin set.
+
+    Deterministic and PRNG-free like every chaos shim: one forged
+    packet per (round, attacker) is a pure function of the plan.  Tick
+    → ns mapping anchors plan tick 0 at ``base_ns`` on the catalog's
+    injected clock (``tick_s`` seconds per tick, the sim's 1 ms
+    default).
+    """
+
+    def __init__(self, plan, node_names: list[str], *,
+                 services_per_node: int, budget: int,
+                 tick_s: float = 0.001, base_ns: int = 0) -> None:
+        import numpy as np
+
+        from sidecar_tpu.chaos.adversary import CompiledAdversaryPlan
+
+        if services_per_node <= 0:
+            raise ValueError("services_per_node must be positive")
+        self.names = list(node_names)
+        n = len(self.names)
+        owner = np.arange(n * services_per_node) // services_per_node
+        self.compiled = CompiledAdversaryPlan(plan, n=n, owner=owner,
+                                              budget=budget)
+        self.services_per_node = int(services_per_node)
+        self.tick_s = float(tick_s)
+        self.base_ns = int(base_ns)
+
+    def ticks_to_ns(self, ticks: int) -> int:
+        return self.base_ns + int(round(ticks * self.tick_s * 1e9))
+
+    def _record(self, slot: int, val: int):
+        """One forged column → a live Service record.  Status codes are
+        numerically identical across planes (service/service.go:17-23 ↔
+        ops/status.py), so the packed status carries over unchanged."""
+        from sidecar_tpu import service as svc_mod
+        from sidecar_tpu.ops import status as svc_status
+
+        hostname = self.names[slot // self.services_per_node]
+        ts = int(val) >> svc_status.STATUS_BITS
+        stat = int(val) & ((1 << svc_status.STATUS_BITS) - 1)
+        return svc_mod.Service(
+            id=f"slot{slot}", name=f"svc{slot % self.services_per_node}",
+            hostname=hostname, updated=self.ticks_to_ns(ts), status=stat)
+
+    def forged_packets(self, round_idx: int, now_ticks) -> list:
+        """The round's forged pushes: ``[(origin_name, [Service, ...])]``
+        — one entry per active attacker, one Service per forged column.
+        ``now_ticks`` is the per-node stamping clock ``[n]`` in plan
+        ticks (apply any ClockFault offsets first, as the sim does)."""
+        import numpy as np
+
+        mask, slots, vals = self.compiled.host_overrides(
+            round_idx, np.asarray(now_ticks, np.int64))
+        out = []
+        for i in np.where(mask.any(axis=1))[0]:
+            cols = np.where(mask[i])[0]
+            out.append((self.names[int(i)],
+                        [self._record(int(slots[i, c]), int(vals[i, c]))
+                         for c in cols]))
+        return out
+
+    def push_into(self, state, round_idx: int, now_ticks) -> int:
+        """Deliver the round's forged pushes into a live catalog the way
+        the transport's push-pull merge path would: score each packet
+        against the attached origin gate (one packet = one push body),
+        annotate every record with its transport origin, and hand it to
+        the writer.  Returns the number of records enqueued (records
+        from already-quarantined origins are rejected by the writer,
+        not here — rejection accounting stays in one place)."""
+        delivered = 0
+        for origin, records in self.forged_packets(round_idx, now_ticks):
+            gate = state.origin_gate
+            if gate is not None:
+                over = gate.observe(
+                    origin,
+                    [(svc.hostname == origin, svc.updated)
+                     for svc in records],
+                    state._now())
+                if over:
+                    metrics.incr("defense.live.originViolations", over)
+            for svc in records:
+                svc.gossip_origin = origin
+                state.add_service_entry(svc)
+                delivered += 1
+        return delivered
+
+
 class LiveChaosController:
     """Cluster-side plan application: drives the faults that live
     OUTSIDE a single node's record stream — full partitions (via the

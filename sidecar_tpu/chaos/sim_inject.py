@@ -54,11 +54,13 @@ import numpy as np
 from jax import lax
 
 from sidecar_tpu import metrics
+from sidecar_tpu.chaos.adversary import AdversaryPlan, CompiledAdversaryPlan
 from sidecar_tpu.chaos.plan import FaultPlan, resolve_nodes
 from sidecar_tpu.models.exact import ExactSim, SimParams, SimState
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import gossip as gossip_ops
-from sidecar_tpu.ops.merge import future_mask, staleness_mask
+from sidecar_tpu.ops.knobs import _static
+from sidecar_tpu.ops.merge import budget_mask, future_mask, staleness_mask
 from sidecar_tpu.ops.status import TOMBSTONE, pack, unpack_status, unpack_ts
 from sidecar_tpu.ops.topology import Topology
 
@@ -76,6 +78,11 @@ class ChaosSimState:
     injected_dups: jax.Array     # int32 — packets copied for re-delivery
     rejected_future: jax.Array   # int32 — record copies the receiver's
                                  # future-admission bound rejected
+    forged_records: jax.Array    # int32 — AdversaryPlan-forged columns
+    rejected_budget: jax.Array   # int32 — record copies the per-origin
+                                 # budget (ops/merge.budget_mask) rejected
+    origin_violations: jax.Array  # int32 [N] — per-SENDER cumulative
+                                  # budget violations (quarantine evidence)
 
     # The ExactSim drivers address state through these two names; the
     # properties make a ChaosSimState drop into the inherited scan
@@ -265,7 +272,8 @@ class ChaosExactSim(ExactSim):
     def __init__(self, params: SimParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  plan: FaultPlan = FaultPlan(seed=0),
-                 perturb=None, cut_mask: Optional[np.ndarray] = None):
+                 perturb=None, cut_mask: Optional[np.ndarray] = None,
+                 adversary: Optional[AdversaryPlan] = None):
         super().__init__(params, topo, timecfg, perturb=perturb,
                          cut_mask=cut_mask)
         self.plan = plan
@@ -275,10 +283,21 @@ class ChaosExactSim(ExactSim):
         self._knobs = dataclasses.replace(self._knobs,
                                           fault_seed=plan.seed)
         self._prog = CompiledFaultPlan(plan, params.n)
+        # Byzantine attack programs (chaos/adversary.py): compiled
+        # against this cluster's slot-ownership layout; None (the
+        # default) compiles the honest round bit for bit.
+        self.adversary = adversary
+        self._adv = None
+        if adversary is not None and adversary.attacks:
+            self._adv = CompiledAdversaryPlan(
+                adversary, n=params.n, owner=np.asarray(self.owner),
+                budget=min(params.budget, params.m))
         # The horizon guard (models/timecfg.validate_horizon) must
         # cover the highest tick any SKEWED stamp can reach, not just
-        # the global clock — checked at every driver dispatch.
-        self._skew_ticks = plan.max_clock_offset
+        # the global clock — checked at every driver dispatch.  Forged
+        # future stamps count exactly like positive clock skew.
+        self._skew_ticks = plan.max_clock_offset + (
+            adversary.max_future_ticks if adversary is not None else 0)
         # owner_row[i, m] — slot m belongs to node i (the crash-restart
         # wipe's "keep only my own records" mask).
         self._owner_row = None
@@ -299,13 +318,17 @@ class ChaosExactSim(ExactSim):
              jnp.zeros((d, flat), jnp.int32),
              jnp.zeros((d, flat), jnp.int32))
             for d in self._prog.ring_specs)
-        # Four DISTINCT zero buffers: the run drivers donate the whole
+        # DISTINCT zero buffers: the run drivers donate the whole
         # state pytree, and XLA rejects donating one buffer twice.
         return ChaosSimState(sim=base, rings=rings,
                              injected_drops=jnp.zeros((), jnp.int32),
                              injected_delays=jnp.zeros((), jnp.int32),
                              injected_dups=jnp.zeros((), jnp.int32),
-                             rejected_future=jnp.zeros((), jnp.int32))
+                             rejected_future=jnp.zeros((), jnp.int32),
+                             forged_records=jnp.zeros((), jnp.int32),
+                             rejected_budget=jnp.zeros((), jnp.int32),
+                             origin_violations=jnp.zeros((p.n,),
+                                                         jnp.int32))
 
     # -- the chaos round ---------------------------------------------------
 
@@ -341,6 +364,19 @@ class ChaosExactSim(ExactSim):
         ft = kn.future_arg()
         rej = cst.rejected_future
 
+        # Byzantine defenses (docs/chaos.md "the defense ladder"): the
+        # per-origin suspicious-record budget and the origin-quarantine
+        # threshold.  Both carry the future-bound contract — a static
+        # "off" knob compiles the pre-defense round bit for bit.  The
+        # quarantine gate reads the ROUND-START evidence so the NumPy
+        # oracle can mirror it without intra-round ordering ambiguity.
+        tb = kn.budget_arg()
+        qt = kn.quarantine_arg()
+        forged = cst.forged_records
+        brej = cst.rejected_budget
+        viol = cst.origin_violations
+        quar = None if qt is None else (viol >= qt)
+
         # Crash restarts: wipe the row to a cold re-announce of own
         # records the round the window closes.
         wipe = prog.restart_mask(round_idx)
@@ -372,6 +408,18 @@ class ChaosExactSim(ExactSim):
             node_alive=alive, cut_mask=self._cut)
         svc_idx, msg = gossip_ops.select_messages(known, sent, p.budget,
                                                   limit)
+        # Adversary corruption lands between selection and transmit
+        # accounting: attackers REPLACE the leading columns of their
+        # own packets with forged records (chaos/adversary.py), lying
+        # relative to their OWN — possibly skewed — clocks, and their
+        # transmit counters pay for the forged sends.
+        if self._adv is not None:
+            adv_now = (jnp.broadcast_to(jnp.asarray(now, jnp.int32),
+                                        (p.n,))
+                       if off is None else now_n)
+            svc_idx, msg, nforged = self._adv.corrupt(
+                round_idx, adv_now, svc_idx, msg)
+            forged = forged + nforged
         sent = gossip_ops.record_transmissions(sent, svc_idx, msg,
                                                p.fanout, limit)
 
@@ -407,11 +455,57 @@ class ChaosExactSim(ExactSim):
             rej = rej + jnp.sum(
                 (future_mask(cand, recv_now, ft)
                  & (cand > 0)).astype(jnp.int32))
+        own_sel = None
+        if tb is not None:
+            # First-party exemption mask + budget accounting, tallied
+            # per SENDER on the raw candidate set (the rejected-future
+            # precedent above): exactly what the in-kernel budget gate
+            # sees after its staleness/future predecessors, before the
+            # unrelated loss/liveness gates.
+            own_sel = (self.owner[jnp.minimum(svc_idx, p.m - 1)]
+                       == jnp.arange(p.n, dtype=jnp.int32)[:, None])
+            own3 = own_sel[:, None, :]
+            bcand = jnp.broadcast_to(msg[:, None, :], (n, fanout, budget))
+            bcand = jnp.where(
+                staleness_mask(bcand, recv_now, kn.stale_ticks), 0, bcand)
+            # Quarantine EVIDENCE is narrower than the budget gate: a
+            # FRESH third-party claim — a record for a slot the sender
+            # doesn't own, stamped at-or-ahead of the receiver's clock.
+            # An honest relayer cannot produce one (anything it relays
+            # was admitted at least a round earlier, so its stamp
+            # trails the receiver clock by ≥ round_ticks), while every
+            # first-hop forgery of the bomb/flood/sybil kinds is one —
+            # so honest nodes relaying admitted poison never accrue
+            # evidence (the smoking-gun rule; the caveat is honest
+            # clock skew beyond one round_ticks, where the future
+            # bound, not quarantine, is the intended defense —
+            # docs/chaos.md).  Counted BEFORE the future gate — a
+            # beyond-fudge flood is the most damning evidence of all —
+            # with beyond-budget fresh claims charged, per packet copy,
+            # to the sending origin.
+            bts = unpack_ts(bcand)
+            fresh = ((bts > 0) & ~own3
+                     & (bts >= jnp.asarray(recv_now, jnp.int32)))
+            erank = jnp.cumsum(fresh.astype(jnp.int32), axis=-1)
+            ev = fresh & (erank > jnp.asarray(tb, jnp.int32))
+            viol = viol + jnp.sum(ev.astype(jnp.int32), axis=(1, 2))
+            if ft is not None:
+                bcand = jnp.where(future_mask(bcand, recv_now, ft),
+                                  0, bcand)
+            bm = budget_mask(bcand, recv_now, tb, own3)
+            brej = brej + jnp.sum(bm.astype(jnp.int32))
+        # Quarantined origins lose their send channel outright (the
+        # packet-level fault-drop mechanism, reused as a defense).
+        ekeep = keep
+        if quar is not None:
+            qkeep = ~quar[:, None]
+            ekeep = qkeep if ekeep is None else ekeep & qkeep
         rows, cols, vals = gossip_ops.expand_deliveries(
             dst, svc_idx, msg, now_tick=recv_now,
             stale_ticks=kn.stale_ticks,
             node_alive=alive, record_keep=record_keep,
-            edge_keep=keep, future_ticks=ft)
+            edge_keep=ekeep, future_ticks=ft,
+            tomb_budget=tb, sender_own=own_sel)
 
         def flat(mask):
             return jnp.broadcast_to(mask[:, :, None],
@@ -494,6 +588,12 @@ class ChaosExactSim(ExactSim):
         if sever is not None:
             pp_partner = jnp.where(
                 sever, jnp.arange(p.n, dtype=jnp.int32), pp_partner)
+        if quar is not None:
+            # A quarantined origin neither pushes nor is pulled from:
+            # any exchange touching one remaps to the self no-op.
+            pp_partner = jnp.where(
+                quar | quar[pp_partner],
+                jnp.arange(p.n, dtype=jnp.int32), pp_partner)
 
         # Each push-pull leg admits at the RECEIVER's clock: the pull
         # leg lands on me (my clock), the push leg lands on my partner
@@ -502,13 +602,14 @@ class ChaosExactSim(ExactSim):
         pp_now = now if off is None else now_n[:, None]
         pp_push = None if off is None else now_n[pp_partner][:, None]
 
+        pp_owner = self.owner if tb is not None else None
         if ft is None:
             def do_push_pull(kn_se):
                 kn_, se = kn_se
                 merged = gossip_ops.push_pull(
                     kn_, pp_partner, now_tick=pp_now,
                     stale_ticks=kn.stale_ticks, node_alive=alive,
-                    now_push=pp_push)
+                    now_push=pp_push, tomb_budget=tb, owner=pp_owner)
                 se = jnp.where(merged != kn_, jnp.int8(0), se)
                 return merged, se
 
@@ -521,7 +622,8 @@ class ChaosExactSim(ExactSim):
                 merged = gossip_ops.push_pull(
                     kn_, pp_partner, now_tick=pp_now,
                     stale_ticks=kn.stale_ticks, node_alive=alive,
-                    future_ticks=ft, now_push=pp_push)
+                    future_ticks=ft, now_push=pp_push,
+                    tomb_budget=tb, owner=pp_owner)
                 se = jnp.where(merged != kn_, jnp.int8(0), se)
                 pulled = kn_[pp_partner]
                 r = jnp.sum((future_mask(pulled, pp_now, ft)
@@ -562,7 +664,8 @@ class ChaosExactSim(ExactSim):
                          round_idx=round_idx),
             rings=tuple(new_rings), injected_drops=drops,
             injected_delays=delays, injected_dups=dups,
-            rejected_future=rej)
+            rejected_future=rej, forged_records=forged,
+            rejected_budget=brej, origin_violations=viol)
 
     # -- provenance hooks (ops/provenance.py) ------------------------------
 
@@ -644,13 +747,32 @@ class ChaosExactSim(ExactSim):
         return {"dropped": int(cst.injected_drops),
                 "delayed": int(cst.injected_delays),
                 "duplicated": int(cst.injected_dups),
-                "rejected_future": int(cst.rejected_future)}
+                "rejected_future": int(cst.rejected_future),
+                "forged": int(cst.forged_records),
+                "rejected_budget": int(cst.rejected_budget),
+                "quarantined": len(self.quarantined_origins(cst))}
+
+    def quarantined_origins(self, cst: ChaosSimState) -> tuple:
+        """Node ids whose cumulative budget violations crossed the
+        quarantine threshold — the sim side of the sim↔live
+        cross-validation (tests/test_adversary.py).  Empty when the
+        threshold knob is off or traced (the fleet reads the stacked
+        counters itself)."""
+        qt = self._knobs.quarantine_threshold
+        if not _static(qt) or qt < 0:
+            return ()
+        viol = np.asarray(cst.origin_violations)
+        return tuple(int(i) for i in np.where(viol >= qt)[0])
 
     @staticmethod
     def _counter_snapshot(cst: ChaosSimState) -> dict:
-        return {f: int(getattr(cst, f))
-                for f in ("injected_drops", "injected_delays",
-                          "injected_dups", "rejected_future")}
+        out = {f: int(getattr(cst, f))
+               for f in ("injected_drops", "injected_delays",
+                         "injected_dups", "rejected_future",
+                         "forged_records", "rejected_budget")}
+        out["origin_violations"] = int(np.sum(
+            np.asarray(cst.origin_violations)))
+        return out
 
     def _publish_injection_metrics(self, before: dict,
                                    after: ChaosSimState) -> None:
@@ -661,10 +783,21 @@ class ChaosExactSim(ExactSim):
                             ("chaos.sim.duplicatedPackets",
                              "injected_dups"),
                             ("clock.sim.rejectedFuture",
-                             "rejected_future")):
+                             "rejected_future"),
+                            ("adversary.sim.forgedRecords",
+                             "forged_records"),
+                            ("defense.sim.rejectedBudget",
+                             "rejected_budget")):
             delta = int(getattr(after, field)) - before[field]
             if delta:
                 metrics.incr(name, delta)
+        vdelta = int(np.sum(np.asarray(after.origin_violations))) \
+            - before["origin_violations"]
+        if vdelta:
+            metrics.incr("defense.sim.originViolations", vdelta)
+        quarantined = len(self.quarantined_origins(after))
+        if quarantined:
+            metrics.incr("defense.sim.quarantinedOrigins", quarantined)
 
     def run(self, state, key, num_rounds: int, donate: bool = True,
             start_round=None, sparse=None):

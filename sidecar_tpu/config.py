@@ -146,6 +146,13 @@ class ServicesConfig:
 # (TimeConfig.future_fudge_s) and one value should drive both planes.
 FUTURE_FUDGE_ENV = "SIDECAR_TPU_FUTURE_FUDGE"
 
+# Defense-ladder knobs (ops/merge.budget_mask + ops/suspicion.
+# QuarantineScorer, docs/chaos.md): same SIDECAR_TPU_* convention and
+# for the same reason — the live twins of TimeConfig.origin_budget /
+# origin_quarantine, one value driving both planes.
+ORIGIN_BUDGET_ENV = "SIDECAR_TPU_ORIGIN_BUDGET"
+ORIGIN_QUARANTINE_ENV = "SIDECAR_TPU_ORIGIN_QUARANTINE"
+
 
 @dataclasses.dataclass
 class SidecarConfig:
@@ -179,6 +186,12 @@ class SidecarConfig:
     # reject records stamped beyond now + this many seconds at every
     # merge/catalog-add site.  Negative (default) disables the gate.
     future_fudge: float = -1.0
+    # Defense ladder (ops/merge.budget_mask, ops/suspicion.
+    # QuarantineScorer): per-packet cap on third-party suspicious
+    # records, and the violation count that quarantines an origin.
+    # Negative (default) leaves each rung off.
+    origin_budget: int = -1
+    origin_quarantine: int = -1
 
     @classmethod
     def from_env(cls) -> "SidecarConfig":
@@ -216,6 +229,10 @@ class SidecarConfig:
                                    d.damping_threshold, cast=float),
             future_fudge=_env(*FUTURE_FUDGE_ENV.split("_", 1),
                               d.future_fudge),
+            origin_budget=_env(*ORIGIN_BUDGET_ENV.split("_", 1),
+                               d.origin_budget, cast=int),
+            origin_quarantine=_env(*ORIGIN_QUARANTINE_ENV.split("_", 1),
+                                   d.origin_quarantine, cast=int),
         )
 
 

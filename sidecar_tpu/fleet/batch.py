@@ -44,8 +44,14 @@ from sidecar_tpu.sim.scenarios import validate_protocol_config
 _TIMECFG_FIELDS = (
     "push_pull_interval_s", "sweep_interval_s", "refresh_interval_s",
     "suspicion_window_s", "alive_lifespan_s", "draining_lifespan_s",
-    "tombstone_lifespan_s", "future_fudge_s",
+    "tombstone_lifespan_s", "future_fudge_s", "origin_budget",
+    "origin_quarantine",
 )
+
+# _TIMECFG_FIELDS entries where any negative value means "knob off"
+# (exempt from the >= 0 validation below).
+_SIGNED_TIMECFG_FIELDS = ("future_fudge_s", "origin_budget",
+                          "origin_quarantine")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +82,8 @@ class ScenarioSpec:
     draining_lifespan_s: Optional[float] = None
     tombstone_lifespan_s: Optional[float] = None
     future_fudge_s: Optional[float] = None   # negative = bound disabled
+    origin_budget: Optional[int] = None      # negative = budget disabled
+    origin_quarantine: Optional[int] = None  # negative = quarantine off
 
     def axes(self) -> dict:
         """The non-default knobs, for report/Pareto tables."""
@@ -211,8 +219,8 @@ class ScenarioBatch:
                         f"{s.name}: {knob}={v} not in [0, 1]")
             for f in _TIMECFG_FIELDS:
                 v = getattr(s, f)
-                if f == "future_fudge_s":
-                    continue  # any negative value means "bound off"
+                if f in _SIGNED_TIMECFG_FIELDS:
+                    continue  # any negative value means "knob off"
                 if v is not None and v < 0:
                     raise ValueError(f"{s.name}: {f}={v} must be >= 0")
             if s.fault_seed is not None and plan is None:
@@ -280,6 +288,12 @@ class ScenarioBatch:
             future_ticks=stack(
                 lambda i: (-1 if t_of(i).future_ticks is None
                            else t_of(i).future_ticks), np.int32),
+            tomb_budget=stack(
+                lambda i: (-1 if t_of(i).tomb_budget is None
+                           else t_of(i).tomb_budget), np.int32),
+            quarantine_threshold=stack(
+                lambda i: (-1 if t_of(i).quarantine_threshold is None
+                           else t_of(i).quarantine_threshold), np.int32),
             churn_prob=stack(lambda i: specs[i].churn_prob, np.float32),
             fault_seed=stack(
                 lambda i: (specs[i].fault_seed

@@ -10,7 +10,8 @@ parameter space:
   (``push_pull_interval_s``, ``sweep_interval_s``,
   ``refresh_interval_s``, ``suspicion_window_s``,
   ``alive_lifespan_s``, ``draining_lifespan_s``,
-  ``tombstone_lifespan_s``, ``future_fudge_s``);
+  ``tombstone_lifespan_s``, ``future_fudge_s``, ``origin_budget``,
+  ``origin_quarantine``);
 * **compile-key axes** (group into separate batches, each its own
   compiled program): ``fanout``, ``budget``, ``topology``
   (an ``ops/topology.from_name`` overlay name — the neighbor tables
@@ -37,6 +38,7 @@ _DATA_AXES = (
     "fault_seed", "push_pull_interval_s", "sweep_interval_s",
     "refresh_interval_s", "suspicion_window_s", "alive_lifespan_s",
     "draining_lifespan_s", "tombstone_lifespan_s", "future_fudge_s",
+    "origin_budget", "origin_quarantine",
 )
 _STATIC_AXES = ("fanout", "budget", "topology")
 KNOWN_AXES = _DATA_AXES + _STATIC_AXES

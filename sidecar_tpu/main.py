@@ -116,6 +116,19 @@ class SidecarNode:
 
             self.state.attach_damper(FlapDamper.from_protocol(
                 ProtocolParams.from_config(self.config.sidecar)))
+        # Origin-admission gate (ops/suspicion.QuarantineScorer,
+        # docs/chaos.md): attached only when both
+        # SIDECAR_TPU_ORIGIN_BUDGET and _ORIGIN_QUARANTINE enable it —
+        # push-pull bodies are then scored per origin and quarantined
+        # origins rejected at the catalog writer, the live rung of the
+        # sim's defense ladder.
+        if self.config.sidecar.origin_budget >= 0 and \
+                self.config.sidecar.origin_quarantine >= 0:
+            from sidecar_tpu.ops.suspicion import (ProtocolParams,
+                                                   QuarantineScorer)
+
+            self.state.attach_origin_gate(QuarantineScorer(
+                ProtocolParams.from_config(self.config.sidecar)))
         self.disco = configure_discovery(self.config, self.advertise_ip,
                                          self.hostname)
         self.monitor = Monitor(self.advertise_ip,

@@ -126,6 +126,7 @@ from sidecar_tpu.ops import trace as trace_ops
 from sidecar_tpu.ops.merge import (
     admit_gate,
     apply_stickiness,
+    budget_mask,
     future_mask,
     sticky_adjust,
 )
@@ -494,7 +495,22 @@ class CompressedSim:
         entries evaluated at the same ``now``, so filtering before the
         gather is identical and F× cheaper."""
         kn = self._knobs if kn is None else kn
-        bval = admit_gate(bval, now, kn.stale_ticks, kn.future_arg())
+        tb = kn.budget_arg()
+        b_own = None
+        if tb is not None:
+            # Per-origin budget (ops/merge.budget_mask) on the BOARD:
+            # the board row IS the packet one origin publishes, so the
+            # suspicious rank over its K lines is the per-packet rank
+            # every gathered copy would compute.  Sender-owned records
+            # (slot's owner run == publishing row) are exempt — owners
+            # legitimately announce their own tombstones.  Empty lines
+            # carry val 0 (never suspicious), so the -1-slot owner
+            # arithmetic is value-safe.
+            b_own = ((bslot // self.p.services_per_node)
+                     == jnp.arange(bval.shape[0],
+                                   dtype=jnp.int32)[:, None])
+        bval = admit_gate(bval, now, kn.stale_ticks, kn.future_arg(),
+                          tb, b_own)
         pv = bval[src]    # [nl, F, K] — row gathers, contiguous in K
         ps = bslot[src]
         ok = alive[src] & state.node_alive[:, None]      # [nl, F]
@@ -745,6 +761,8 @@ class CompressedSim:
         wv, ws = cv0, cs0
         sent = state.cache_sent
         ev = state.evictions
+        tb = kn.budget_arg()
+        node_ids = jnp.arange(p.n, dtype=jnp.int32)
         for roll_amt in (-stride, stride):
             ok = alive & jnp.roll(alive, roll_amt)
             if self._side is not None:
@@ -754,8 +772,17 @@ class CompressedSim:
             p_slot = jnp.roll(cs0, roll_amt, 0)
             p_val = jnp.roll(cv0, roll_amt, 0)
             p_val = jnp.where(okc & (p_slot >= 0), p_val, 0)
+            p_own = None
+            if tb is not None:
+                # Per-origin budget on the exchanged cache half: the
+                # rolled row is the partner's packet, and records from
+                # the partner's own slot run are exempt.  (The own-rows
+                # half below is ENTIRELY partner-owned — the exemption
+                # covers all of it, so no gate is compiled there.)
+                p_own = ((p_slot // p.services_per_node)
+                         == jnp.roll(node_ids, roll_amt)[:, None])
             p_val = admit_gate(p_val, now, kn.stale_ticks,
-                               kn.future_arg())
+                               kn.future_arg(), tb, p_own)
             p_slot = jnp.where(p_val > 0, p_slot, -1)
             p_val = sticky_adjust(p_val, cv0,
                                   (p_slot == cs0) & (p_val > cv0))
@@ -987,6 +1014,17 @@ class CompressedSim:
                 # the bound is enabled, so the disabled program stays
                 # bit-identical to the pre-bound kernel path.
                 pv = jnp.where(future_mask(pv, now, ft), 0, pv)
+            tb = kn.budget_arg()
+            if tb is not None:
+                # Per-origin budget, post-kernel like the future bound:
+                # each gathered candidate row IS a copy of one origin's
+                # board row, so the suspicious rank over its K axis
+                # equals the XLA twin's pre-gather board rank (same
+                # ``now``, same gate order: staleness → future →
+                # budget).  Origin of candidate [r, f] is ``src[r, f]``.
+                own3 = ((ps // p.services_per_node)
+                        == src[:, :, None])
+                pv = jnp.where(budget_mask(pv, now, tb, own3), 0, pv)
             ok = state.node_alive[src] & state.node_alive[:, None]
             state = self._merge_pulled(state, sent, pv, ps, ok, now,
                                        drop_key=k_drop,
@@ -1103,7 +1141,14 @@ class CompressedSim:
         # row at index cs_cap is the "inactive sender" — an all-zero
         # board, the merge no-op every non-frontier row serves in the
         # dense round too.
-        bval_c = admit_gate(bval_c, now, t.stale_ticks, t.future_ticks)
+        b_own_c = None
+        if t.tomb_budget is not None:
+            # Compacted twin of the dense board budget gate: the global
+            # row id of compacted board row c is ``idx_s[c]``.
+            b_own_c = ((bslot_c // p.services_per_node)
+                       == idx_s[:, None])
+        bval_c = admit_gate(bval_c, now, t.stale_ticks, t.future_ticks,
+                            t.tomb_budget, b_own_c)
         bval_p = jnp.concatenate(
             [bval_c, jnp.zeros((1, k), jnp.int32)])
         bslot_p = jnp.concatenate(

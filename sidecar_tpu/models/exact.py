@@ -274,12 +274,23 @@ class ExactSim:
             record_keep = jax.random.bernoulli(
                 k_drop, kn.keep_prob,
                 (p.n, p.fanout, svc_idx.shape[1]))
+        tb = kn.budget_arg()
+        sender_own = None
+        if tb is not None:
+            # The sender-owned mask for the per-origin budget: a node's
+            # own records never count against its suspicious budget
+            # (ops/merge.budget_mask) — owners legitimately announce
+            # their own tombstones.  OOB svc slots carry msg == 0
+            # (ts 0, never suspicious), so the clamp is value-safe.
+            sender_own = (self.owner[jnp.minimum(svc_idx, p.m - 1)]
+                          == jnp.arange(p.n, dtype=jnp.int32)[:, None])
         d_rows, d_cols, d_vals, d_adv = gossip_ops.prepare_deliveries(
             known, dst, svc_idx, msg,
             now_tick=now, stale_ticks=kn.stale_ticks,
             node_alive=node_alive,
             record_keep=record_keep,
             future_ticks=kn.future_arg(),
+            tomb_budget=tb, sender_own=sender_own,
         )
 
         # 2. announce re-stamps, folded into the same scatter.
@@ -326,6 +337,12 @@ class ExactSim:
                 k_drop, 1.0 - p.drop_prob,
                 (n, p.fanout, svc_c.shape[1]))
             keep_c = keep[row_s]
+        sender_own_c = None
+        if t.tomb_budget is not None:
+            # Compacted twin of the dense sender-owned mask: the sender
+            # of compacted row c is ``row_s[c]``.
+            sender_own_c = (self.owner[jnp.minimum(svc_c, p.m - 1)]
+                            == row_s[:, None])
         d_rows, d_cols, d_vals, d_adv = gossip_ops.prepare_deliveries(
             known, dst[row_s], svc_c, msg_c,
             now_tick=now, stale_ticks=t.stale_ticks,
@@ -333,6 +350,7 @@ class ExactSim:
             sender_alive=node_alive[row_s] & valid_s,
             record_keep=keep_c,
             future_ticks=t.future_ticks,
+            tomb_budget=t.tomb_budget, sender_own=sender_own_c,
         )
 
         a_rows, a_cols, a_vals, a_due = self._announce_updates(
@@ -379,12 +397,16 @@ class ExactSim:
             node_alive=node_alive, cut_mask=self._cut,
         )[:, 0]
 
+        pp_tb = kn.budget_arg()
+
         def do_push_pull(kn_se):
             kn_, se = kn_se
             merged = gossip_ops.push_pull(
                 kn_, pp_partner, now_tick=now,
                 stale_ticks=kn.stale_ticks, node_alive=node_alive,
-                future_ticks=kn.future_arg())
+                future_ticks=kn.future_arg(),
+                tomb_budget=pp_tb,
+                owner=self.owner if pp_tb is not None else None)
             se = jnp.where(merged != kn_, jnp.int8(0), se)
             return merged, se
 
@@ -462,7 +484,10 @@ class ExactSim:
             kn, se = kn_se
             merged = gossip_ops.push_pull(
                 kn, pp_partner, now_tick=now, stale_ticks=t.stale_ticks,
-                node_alive=node_alive, future_ticks=t.future_ticks)
+                node_alive=node_alive, future_ticks=t.future_ticks,
+                tomb_budget=t.tomb_budget,
+                owner=(self.owner if t.tomb_budget is not None
+                       else None))
             se = jnp.where(merged != kn, jnp.int8(0), se)
             return merged, se
 

@@ -67,6 +67,20 @@ class TimeConfig:
     # (the default) disables the bound; every merge site then compiles
     # the pre-bound program bit for bit (the lockstep suites pin this).
     future_fudge_s: float = -1.0
+    # Per-origin suspicious-record budget (ops/merge.budget_mask,
+    # docs/chaos.md "the defense ladder"): at most this many
+    # third-party TOMBSTONE or ahead-of-clock records are admitted per
+    # packet/exchange from one origin — the Byzantine blast-radius cap
+    # the future bound alone cannot provide (a sybil flood stamps
+    # WITHIN the fudge).  A count, not a duration.  Negative (the
+    # default) disables the budget; every merge site then compiles the
+    # pre-budget program bit for bit (the lockstep suites pin this).
+    origin_budget: int = -1
+    # Cumulative budget violations after which an origin is quarantined
+    # outright (senders dropped in the chaos sim, origins gated at the
+    # live catalog writer — chaos/sim_inject.py, ops/suspicion.py).
+    # Negative (the default) disables quarantine.
+    origin_quarantine: int = -1
 
     def ticks(self, seconds: float) -> int:
         return int(round(seconds * self.ticks_per_second))
@@ -106,6 +120,23 @@ class TimeConfig:
         if self.future_fudge_s < 0:
             return None
         return self.ticks(self.future_fudge_s)
+
+    @property
+    def tomb_budget(self):
+        """Per-origin suspicious-record budget (a record count), or
+        None when disabled — callers skip the gate entirely on None, so
+        the disabled program is the pre-budget program."""
+        if self.origin_budget < 0:
+            return None
+        return int(self.origin_budget)
+
+    @property
+    def quarantine_threshold(self):
+        """Origin-quarantine violation threshold, or None when
+        disabled."""
+        if self.origin_quarantine < 0:
+            return None
+        return int(self.origin_quarantine)
 
     def rounds(self, seconds: float) -> int:
         """Number of gossip rounds in a wall-clock duration."""

@@ -306,7 +306,8 @@ def select_messages(known, sent, budget, limit, row_offset=0,
 def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
                       node_alive=None, drop_prob=0.0, drop_key=None,
                       edge_keep=None, sender_alive=None,
-                      record_keep=None, future_ticks=None):
+                      record_keep=None, future_ticks=None,
+                      tomb_budget=None, sender_own=None):
     """Expand each sender's message batch into RAW flat (row, col, val)
     update triples — every gate applied EXCEPT the pre-round stickiness
     resolution (:func:`finalize_deliveries`), which callers that defer
@@ -331,7 +332,14 @@ def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
     family's per-node clocks evaluate staleness and the
     future-admission bound (``future_ticks``, ops/merge.future_mask;
     None = bound disabled, the pre-bound program) at each receiver's
-    own clock."""
+    own clock.
+
+    ``tomb_budget`` enables the per-origin suspicious-record budget
+    (ops/merge.budget_mask — the Byzantine defense; None = disabled,
+    the pre-budget program); ``sender_own`` is its bool ``[rows, B]``
+    first-party mask (True where the sender owns the offered slot),
+    broadcast across the fanout so each packet copy is budgeted at its
+    receiver's clock."""
     n, fanout = dst.shape
     budget = svc_idx.shape[1]
 
@@ -339,7 +347,9 @@ def expand_deliveries(dst, svc_idx, msg, *, now_tick, stale_ticks,
     tgt = jnp.broadcast_to(dst[:, :, None], (n, fanout, budget))
     svc = jnp.broadcast_to(svc_idx[:, None, :], (n, fanout, budget))
 
-    val = admit_gate(val, now_tick, stale_ticks, future_ticks)
+    own = sender_own[:, None, :] if sender_own is not None else None
+    val = admit_gate(val, now_tick, stale_ticks, future_ticks,
+                     tomb_budget, own)
 
     if node_alive is not None:
         snd = sender_alive if sender_alive is not None else node_alive
@@ -378,7 +388,8 @@ def finalize_deliveries(known, rows, cols, vals):
 def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
                        node_alive=None, drop_prob=0.0, drop_key=None,
                        edge_keep=None, sender_alive=None,
-                       record_keep=None, future_ticks=None):
+                       record_keep=None, future_ticks=None,
+                       tomb_budget=None, sender_own=None):
     """Expand each sender's message batch into flat (row, col, val) update
     triples with all merge semantics pre-applied.
 
@@ -398,7 +409,8 @@ def prepare_deliveries(known, dst, svc_idx, msg, *, now_tick, stale_ticks,
         dst, svc_idx, msg, now_tick=now_tick, stale_ticks=stale_ticks,
         node_alive=node_alive, drop_prob=drop_prob, drop_key=drop_key,
         edge_keep=edge_keep, sender_alive=sender_alive,
-        record_keep=record_keep, future_ticks=future_ticks)
+        record_keep=record_keep, future_ticks=future_ticks,
+        tomb_budget=tomb_budget, sender_own=sender_own)
     vals, advanced = finalize_deliveries(known, rows, cols, vals)
     return rows, cols, vals, advanced
 
@@ -451,7 +463,8 @@ def record_transmissions(sent, svc_idx, msg, fanout, limit, row_ids=None):
 
 @cost.phased("exchange", tag="push_pull")
 def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None,
-              future_ticks=None, now_push=None):
+              future_ticks=None, now_push=None, tomb_budget=None,
+              owner=None):
     """Anti-entropy: each node initiates a full two-way state exchange with
     one reachable peer (services_delegate.go:146-167).
 
@@ -472,23 +485,37 @@ def push_pull(known, partner, *, now_tick, stale_ticks, node_alive=None,
     the push leg at the partner's ``now_push`` — both may be
     broadcastable ``[N, 1]`` tensors; a self-exchange is a merge no-op
     under any clock, so remapped dead partners stay inert).
+
+    ``tomb_budget`` enables the per-origin suspicious-record budget on
+    both legs (ops/merge.budget_mask; None = disabled, the pre-budget
+    program).  ``owner`` is the int32 ``[M]`` slot→owner table: each
+    leg exempts the SENDING side's first-party slots (the pull leg's
+    sender is the partner, the push leg's the initiator).  The budget
+    counts per exchanged row — an anti-entropy exchange is one
+    "packet" for budget purposes — so fleets that rely on push-pull to
+    spread genuine mass tombstone events should size the budget for it.
     """
     self_idx = jnp.arange(known.shape[0], dtype=jnp.int32)
     if node_alive is not None:
         partner = jnp.where(node_alive & node_alive[partner], partner, self_idx)
     if now_push is None:
         now_push = now_tick
+    own_pull = own_push = None
+    if tomb_budget is not None and owner is not None:
+        own_pull = owner[None, :] == partner[:, None]
+        own_push = owner[None, :] == self_idx[:, None]
 
     # Pull: our row ← partner's row (stickiness inside merge_packed is
     # evaluated against the pre-exchange state).
     pulled = merge_packed(known, known[partner], now_tick, stale_ticks,
-                          future_ticks)
+                          future_ticks, tomb_budget, own_pull)
 
     # Push: partner's row ← our (pre-exchange) row.  Stickiness is
     # applied to the offered values against the RECEIVER's pre-exchange
     # row — both phases resolve vs the same snapshot, matching the
     # oracle's batch resolution.
-    offered = admit_gate(known, now_push, stale_ticks, future_ticks)
+    offered = admit_gate(known, now_push, stale_ticks, future_ticks,
+                         tomb_budget, own_push)
     pre_tgt = known[partner]
     offered = sticky_adjust(offered, pre_tgt, offered > pre_tgt)
     return pulled.at[partner].max(offered, mode="drop")

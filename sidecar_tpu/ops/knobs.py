@@ -80,6 +80,12 @@ class RoundKnobs:
     fault_seed: Any = 0     # FaultPlan seed (chaos family)
     future_ticks: Any = -1  # future-admission bound (ticks;
                             # negative = disabled — ops/merge.future_mask)
+    tomb_budget: Any = -1   # per-origin suspicious-record budget
+                            # (records/packet; negative = disabled —
+                            # ops/merge.budget_mask)
+    quarantine_threshold: Any = -1  # cumulative budget violations that
+                            # quarantine an origin (negative = off —
+                            # chaos/sim_inject.py, docs/chaos.md)
 
     @property
     def suspicion_enabled(self) -> bool:
@@ -119,6 +125,39 @@ class RoundKnobs:
         ft = jnp.asarray(ft, jnp.int32)
         return jnp.where(ft < 0, MAX_TICK, ft)
 
+    def budget_arg(self):
+        """The ``tomb_budget`` argument for the merge gates
+        (ops/merge.admit_gate) — the ``future_arg`` contract applied to
+        the per-origin suspicious-record budget: None when PROVABLY
+        disabled (a static negative compiles the pre-budget program bit
+        for bit); a static non-negative const-folds as a Python int; a
+        traced value keeps the gate compiled with the disabled sentinel
+        mapped to ``ops/merge.BUDGET_OFF``, which no per-packet
+        suspicious rank can exceed."""
+        tb = self.tomb_budget
+        if _static(tb):
+            return None if tb < 0 else int(tb)
+        import jax.numpy as jnp
+
+        from sidecar_tpu.ops.merge import BUDGET_OFF
+        tb = jnp.asarray(tb, jnp.int32)
+        return jnp.where(tb < 0, BUDGET_OFF, tb)
+
+    def quarantine_arg(self):
+        """The origin-quarantine violation threshold with the same
+        three-state contract (chaos/sim_inject.py): None when PROVABLY
+        disabled; a static non-negative const-folds; a traced value
+        maps the off sentinel to ``BUDGET_OFF`` — no origin accrues
+        2^28 violations, so the quarantine set stays empty."""
+        qt = self.quarantine_threshold
+        if _static(qt):
+            return None if qt < 0 else int(qt)
+        import jax.numpy as jnp
+
+        from sidecar_tpu.ops.merge import BUDGET_OFF
+        qt = jnp.asarray(qt, jnp.int32)
+        return jnp.where(qt < 0, BUDGET_OFF, qt)
+
 
 def from_protocol(params, timecfg, *, recover_rounds: int = 1,
                   fault_seed: int = 0, churn_prob: float = 0.0
@@ -142,4 +181,8 @@ def from_protocol(params, timecfg, *, recover_rounds: int = 1,
         fault_seed=fault_seed,
         future_ticks=(-1 if timecfg.future_ticks is None
                       else timecfg.future_ticks),
+        tomb_budget=(-1 if timecfg.tomb_budget is None
+                     else timecfg.tomb_budget),
+        quarantine_threshold=(-1 if timecfg.quarantine_threshold is None
+                              else timecfg.quarantine_threshold),
     )

@@ -41,11 +41,17 @@ from sidecar_tpu.ops.status import (
     ALIVE,
     DRAINING,
     STATUS_BITS,
+    TOMBSTONE,
     is_known,
     pack,
     unpack_status,
     unpack_ts,
 )
+
+# Traced-sentinel for a disabled origin budget (ops/knobs.budget_arg):
+# per-packet suspicious ranks are bounded by the message budget (≤ a few
+# hundred), so ``rank > BUDGET_OFF`` is never true.
+BUDGET_OFF = 1 << 28
 
 
 def staleness_mask(packed, now_tick, stale_ticks):
@@ -81,15 +87,64 @@ def future_mask(packed, now_tick, future_ticks):
     return ts > jnp.asarray(now_tick, jnp.int32) + jnp.asarray(future_ticks, jnp.int32)
 
 
-def admit_gate(vals, now_tick, stale_ticks, future_ticks=None):
+def budget_mask(vals, now_tick, tomb_budget, own=None):
+    """True where a packed record exceeds its sender's per-packet
+    SUSPICIOUS-record budget — the Byzantine-defense twin of
+    :func:`future_mask` (docs/chaos.md, "the defense ladder").
+
+    The LWW merge admits anything with a bigger timestamp, so a single
+    compromised peer can poison a whole packet with forged tombstones
+    (a tombstone bomb) or plausibly-fresh forged ALIVE records that
+    slip UNDER the future-admission fudge (a sybil flood).  Honest
+    packets carry mostly ALIVE records stamped at-or-behind the
+    receiver's clock; a record is *suspicious* when it is a third-party
+    TOMBSTONE or stamped ahead of the receiver (``ts > now``, i.e.
+    within the fudge the future bound tolerates).  This mask rejects
+    suspicious records beyond the first ``tomb_budget`` per packet
+    (cumulative along the last — message — axis), capping any one
+    origin's per-exchange blast radius while leaving honest traffic
+    (occasional real tombstones, small skew) untouched.
+
+    ``own`` optionally marks records the SENDER originates (its own
+    slots): first-party claims are never counted against the budget —
+    an owner is entitled to tombstone or refresh its own records.
+    Under heavy honest clock skew a skewed-but-honest sender's records
+    do look suspicious to unskewed receivers; that conservatism is the
+    documented robustness/speed tradeoff ("Robust and Tuneable Family
+    of Gossiping Algorithms", PAPERS.md) — tune ``tomb_budget`` up, or
+    rely on the future bound alone, for skew-heavy fleets.
+
+    Callers carry the same disabled-sentinel contract as the future
+    bound: a static "off" skips this call entirely (bit-identical
+    pre-budget program); traced callers map the off sentinel to
+    :data:`BUDGET_OFF`, which no real rank exceeds.
+    """
+    ts = unpack_ts(vals)
+    suspicious = (ts > 0) & (
+        (unpack_status(vals) == TOMBSTONE)
+        | (ts > jnp.asarray(now_tick, jnp.int32)))
+    if own is not None:
+        suspicious = suspicious & ~own
+    rank = jnp.cumsum(suspicious.astype(jnp.int32), axis=-1)
+    return suspicious & (rank > jnp.asarray(tomb_budget, jnp.int32))
+
+
+def admit_gate(vals, now_tick, stale_ticks, future_ticks=None,
+               tomb_budget=None, own=None):
     """Zero out packed values outside the admission window: older than
     the staleness bound, or — when the future bound is enabled
     (``future_ticks`` is not None) — stamped beyond ``now +
-    future_ticks``.  With ``future_ticks=None`` this compiles exactly
-    the bare staleness gate, bit for bit."""
+    future_ticks``, or — when the origin budget is enabled
+    (``tomb_budget`` is not None) — suspicious beyond the sender's
+    per-packet budget (:func:`budget_mask`; ``own`` exempts the
+    sender's first-party records).  With the defenses at None this
+    compiles exactly the bare staleness gate, bit for bit."""
     vals = jnp.where(staleness_mask(vals, now_tick, stale_ticks), 0, vals)
     if future_ticks is not None:
         vals = jnp.where(future_mask(vals, now_tick, future_ticks), 0, vals)
+    if tomb_budget is not None:
+        vals = jnp.where(budget_mask(vals, now_tick, tomb_budget, own),
+                         0, vals)
     return vals
 
 
@@ -130,7 +185,8 @@ def apply_stickiness(pre, post):
     return jnp.where(sticky, pack(unpack_ts(post), DRAINING), post)
 
 
-def merge_packed(known, incoming, now_tick, stale_ticks, future_ticks=None):
+def merge_packed(known, incoming, now_tick, stale_ticks, future_ticks=None,
+                 tomb_budget=None, own=None):
     """Merge an aligned tensor of incoming packed records into ``known``.
 
     This is the full-state anti-entropy merge (``MergeRemoteState`` →
@@ -141,14 +197,17 @@ def merge_packed(known, incoming, now_tick, stale_ticks, future_ticks=None):
 
     Returns the merged tensor.  Cells where ``incoming`` is unknown
     (ts == 0), stale, or — when the future-admission bound is enabled —
-    stamped beyond ``now + future_ticks`` are left untouched.  The
-    default ``future_ticks=None`` compiles the pre-bound kernel bit for
-    bit.
+    stamped beyond ``now + future_ticks``, or — when the origin budget
+    is enabled — suspicious beyond ``tomb_budget`` per exchanged row
+    (:func:`budget_mask`; ``own`` marks the sending origin's own
+    cells) are left untouched.  The defenses default to None and then
+    compile the pre-bound kernel bit for bit.
     """
     # Canonicalize: a ts==0 key is the unknown sentinel regardless of its
     # status bits — never merge it.
     incoming = jnp.where(is_known(incoming), incoming, 0)
-    incoming = admit_gate(incoming, now_tick, stale_ticks, future_ticks)
+    incoming = admit_gate(incoming, now_tick, stale_ticks, future_ticks,
+                          tomb_budget, own)
     post = jnp.maximum(known, incoming)
     return apply_stickiness(known, post)
 

@@ -103,6 +103,13 @@ class ProtocolParams:
     damping_flap_penalty: float = 1.0  # penalty added per observed flap
     future_fudge_s: float = -1.0      # future-admission bound
                                       # (negative = off; ops/merge)
+    # Defense ladder (ops/merge.budget_mask, docs/chaos.md): cap on
+    # third-party suspicious records (tombstones / future stamps) a
+    # single packet may carry, and the misbehavior-evidence count at
+    # which an origin is quarantined.  Negative = rung off; the sim
+    # twins are TimeConfig.origin_budget / origin_quarantine.
+    origin_budget: int = -1
+    origin_quarantine: int = -1
 
     def __post_init__(self):
         if self.suspicion_window_s < 0:
@@ -130,7 +137,9 @@ class ProtocolParams:
         params into the jitted round."""
         return dataclasses.replace(
             base, suspicion_window_s=self.suspicion_window_s,
-            future_fudge_s=self.future_fudge_s)
+            future_fudge_s=self.future_fudge_s,
+            origin_budget=self.origin_budget,
+            origin_quarantine=self.origin_quarantine)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -148,7 +157,9 @@ class ProtocolParams:
             raise ValueError(
                 f"unknown protocol param(s): {sorted(bad)} "
                 f"(expected a subset of {sorted(known)})")
-        return cls(**{k: float(v) for k, v in d.items()})
+        ints = {f.name for f in dataclasses.fields(cls) if f.type == "int"}
+        return cls(**{k: (int(v) if k in ints else float(v))
+                      for k, v in d.items()})
 
     @classmethod
     def from_config(cls, sidecar_cfg) -> "ProtocolParams":
@@ -159,4 +170,60 @@ class ProtocolParams:
             damping_half_life_s=sidecar_cfg.damping_half_life,
             damping_threshold=sidecar_cfg.damping_threshold,
             future_fudge_s=sidecar_cfg.future_fudge,
+            origin_budget=sidecar_cfg.origin_budget,
+            origin_quarantine=sidecar_cfg.origin_quarantine,
         )
+
+
+class QuarantineScorer:
+    """Host-side misbehavior score — the live twin of the sim's
+    per-origin violation counter (chaos/sim_inject.py, sim/oracle.py).
+
+    One push from one origin is "one packet": the scorer counts the
+    FRESH THIRD-PARTY claims it carries — records the sender does not
+    own whose timestamp is at or beyond the receiver's clock (a relay
+    of honestly-aged state always trails it) — and charges one
+    violation per claim beyond ``origin_budget``.  An origin whose
+    violation count reaches ``origin_quarantine`` is quarantined: the
+    catalog writer (catalog/state.py ``attach_origin_gate``) drops its
+    pushes wholesale, exactly as the sim zeroes a quarantined row's
+    deliveries and push-pull legs.  Both knobs negative → the scorer
+    never quarantines and scores nothing.
+    """
+
+    def __init__(self, params: "ProtocolParams"):
+        self.budget = int(params.origin_budget)
+        self.threshold = int(params.origin_quarantine)
+        self.violations: dict = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget >= 0 and self.threshold >= 0
+
+    def observe(self, origin: str, claims, now) -> int:
+        """Score one push.  ``claims`` is an iterable of ``(owned,
+        timestamp)`` pairs — one per record in the packet, ``owned``
+        true when the ORIGIN (transport sender, not the record's
+        hostname — a forger writes any hostname it likes) owns the
+        record; timestamps share ``now``'s clock units (the catalog
+        passes ns).  Returns the violations charged to ``origin``."""
+        if not self.enabled:
+            return 0
+        suspicious = sum(1 for owned, ts in claims
+                         if (not owned) and ts >= now)
+        over = max(0, suspicious - self.budget)
+        if over:
+            self.violations[origin] = self.violations.get(origin, 0) + over
+        return over
+
+    def is_quarantined(self, origin: str) -> bool:
+        return (self.enabled and
+                self.violations.get(origin, 0) >= self.threshold)
+
+    def quarantined(self) -> set:
+        """The quarantined origin set — the live half of the sim↔live
+        agreement check (tests/test_adversary.py)."""
+        if not self.enabled:
+            return set()
+        return {o for o, v in self.violations.items()
+                if v >= self.threshold}

@@ -320,6 +320,88 @@ def zoned(n: int, zones: int, *, local_hops: int = 2, remote_deg: int = 2,
     return Topology(n=n, nbrs=nbrs, deg=deg, name=f"zoned{zones}")
 
 
+def components(topo: Topology) -> np.ndarray:
+    """Connected-component label per node (int32[N], labels are the
+    minimum member id of each component).  Vectorized min-label
+    propagation — converges in O(component diameter) sweeps, which for
+    the fragmented ER/BA graphs :func:`repair` targets is small."""
+    if topo.nbrs is None:
+        return np.zeros(topo.n, dtype=np.int32)
+    n = topo.n
+    K = topo.nbrs.shape[1]
+    edge_ok = np.arange(K)[None, :] < topo.deg[:, None]
+    src = np.repeat(np.arange(n, dtype=np.int64), K)[edge_ok.ravel()]
+    dst = topo.nbrs.ravel().astype(np.int64)[edge_ok.ravel()]
+    label = np.arange(n, dtype=np.int64)
+    while True:
+        new = label.copy()
+        if src.size:
+            np.minimum.at(new, src, label[dst])
+            np.minimum.at(new, dst, label[src])
+        # Pointer-jump: chase each label to its current representative,
+        # collapsing chains so sweeps count diameters, not path lengths.
+        while True:
+            hop = new[new]
+            if np.array_equal(hop, new):
+                break
+            new = hop
+        if np.array_equal(new, label):
+            return label.astype(np.int32)
+        label = new
+
+
+def repair(topo: Topology) -> Topology:
+    """Degree-repair a fragmented overlay: chain its connected
+    components into one at min-degree representatives.
+
+    Random builders can fragment — a sparse :func:`erdos_renyi` draw
+    strands isolated nodes and islands; :func:`barabasi_albert` cannot,
+    but its repaired form is still the documented contract for the
+    chaos sweep (benchmarks/topology_sweep.py ``--chaos``): a
+    fragmented overlay never converges, which reads as an attack
+    finding when it is a builder artifact.
+
+    The repair is minimal and deterministic: components are ordered by
+    their minimum member id and chained consecutively, each link
+    joining the two components' minimum-degree nodes (ties to the
+    lowest id) — the nodes that can best absorb an extra edge without
+    distorting the degree profile.  Adds exactly ``components - 1``
+    undirected edges; a connected topology is returned unchanged.  The
+    repaired overlay is renamed ``{name}+r`` so sweep records show the
+    builder artifact was patched.
+    """
+    if topo.nbrs is None:
+        return topo  # complete graph: connected by definition
+    label = components(topo)
+    reps_of = {}
+    for comp in np.unique(label):
+        members = np.nonzero(label == comp)[0]
+        d = topo.deg[members]
+        reps_of[int(comp)] = int(members[int(np.argmin(d))])
+    if len(reps_of) <= 1:
+        return topo
+    reps = [reps_of[c] for c in sorted(reps_of)]
+    n = topo.n
+    deg = topo.deg.astype(np.int32).copy()
+    extra = np.zeros(n, dtype=np.int32)
+    for a, b in zip(reps, reps[1:]):
+        extra[a] += 1
+        extra[b] += 1
+    width = max(topo.nbrs.shape[1], int((deg + extra).max()))
+    nbrs = np.tile(np.arange(n, dtype=np.int32)[:, None], (1, width))
+    nbrs[:, : topo.nbrs.shape[1]] = topo.nbrs
+    for a, b in zip(reps, reps[1:]):
+        nbrs[a, deg[a]] = b
+        deg[a] += 1
+        nbrs[b, deg[b]] = a
+        deg[b] += 1
+    # Re-pad strictly past each row's degree (the widened columns).
+    pad = np.arange(width)[None, :] >= deg[:, None]
+    nbrs = np.where(pad, np.arange(n, dtype=np.int32)[:, None], nbrs)
+    return dataclasses.replace(topo, nbrs=nbrs.astype(np.int32), deg=deg,
+                               name=f"{topo.name}+r")
+
+
 def with_stagger(topo: Topology, period: int,
                  offsets: Optional[np.ndarray] = None,
                  seed: int = 0) -> Topology:

@@ -241,7 +241,18 @@ class ShardedSim:
         tgt = jnp.broadcast_to(dst_b[:, :, None], (bn, fanout, budget))
         svc = jnp.broadcast_to(svc_b[:, None, :], (bn, fanout, budget))
 
-        val = admit_gate(val, now, t.stale_ticks, t.future_ticks)
+        b_own = None
+        if t.tomb_budget is not None:
+            # Per-origin budget (ops/merge.budget_mask): each
+            # [fanout, budget] block is fanout copies of one sender's
+            # packet — the suspicious rank per copy matches the dense
+            # round's per-packet rank.  Sender-owned slots are exempt;
+            # the no-offer sentinel ``svc = m`` maps to owner ``n``
+            # (never a sender) with msg 0, so it is value-safe.
+            b_own = ((svc // self.p.services_per_node)
+                     == senders[:, None, None])
+        val = admit_gate(val, now, t.stale_ticks, t.future_ticks,
+                         t.tomb_budget, b_own)
         val = jnp.where(alive[senders][:, None, None], val, 0)
         val = jnp.where(alive[tgt], val, 0)
         if keep_b is not None:
@@ -521,16 +532,29 @@ class ShardedSim:
         t = self.t
         stride = jax.random.randint(key, (), 1, self.p.n, dtype=jnp.int32)
 
+        own_pull = own_push = None
+        if t.tomb_budget is not None:
+            # Per-origin budget on the full-row exchange (the packet is
+            # the whole row — ops/gossip.push_pull's contract): the
+            # pulled row's origin is the ``-stride`` partner; the
+            # offered row's origin is the offering node itself.
+            node_ids = jnp.arange(self.p.n, dtype=jnp.int32)
+            slot_owner = (jnp.arange(self.p.m, dtype=jnp.int32)
+                          // self.p.services_per_node)
+            own_pull = (slot_owner[None, :]
+                        == jnp.roll(node_ids, -stride)[:, None])
+            own_push = slot_owner[None, :] == node_ids[:, None]
         ok = alive & jnp.roll(alive, -stride)
         if self._side is not None:
             ok &= self._side == jnp.roll(self._side, -stride)
         fwd = jnp.where(ok[:, None], jnp.roll(known, -stride, axis=0), 0)
         pulled = merge_packed(known, fwd, now, t.stale_ticks,
-                              t.future_ticks)
+                              t.future_ticks, t.tomb_budget, own_pull)
 
         # Push = the reverse roll, stickiness vs the receiver's
         # pre-exchange row (same batch resolution as ops/gossip.push_pull).
-        offered = admit_gate(known, now, t.stale_ticks, t.future_ticks)
+        offered = admit_gate(known, now, t.stale_ticks, t.future_ticks,
+                             t.tomb_budget, own_push)
         ok_back = alive & jnp.roll(alive, stride)
         if self._side is not None:
             ok_back &= self._side == jnp.roll(self._side, stride)

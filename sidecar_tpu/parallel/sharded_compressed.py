@@ -438,7 +438,16 @@ class ShardedCompressedSim(CompressedSim):
         # Phase 1 — local board rows + transmit accounting, then the
         # board staleness gate once per shard (rows travel filtered).
         bval_l, bslot_l, sent = self._publish(local, limit, row_offset=r0)
-        bval_f = admit_gate(bval_l, now, t.stale_ticks, t.future_ticks)
+        b_own = None
+        if t.tomb_budget is not None:
+            # Per-origin budget on the shard's board block: local row r
+            # is published by global node ``gi[r]``; slot owners come
+            # from the global owner-run layout.  Gated once before the
+            # rows travel — every downstream fold consumes budget-
+            # filtered copies, like the single-chip board gate.
+            b_own = ((bslot_l // p.services_per_node) == gi[:, None])
+        bval_f = admit_gate(bval_l, now, t.stale_ticks, t.future_ticks,
+                            t.tomb_budget, b_own)
 
         ok = alive[dst] & alive[gi][:, None]             # [nl, F]
         keep = None
@@ -649,7 +658,15 @@ class ShardedCompressedSim(CompressedSim):
             limit=limit, fanout=p.fanout, cache_lines=k,
             row_ids=idx_s + r0)
         sent = jnp.where(sender_l[:, None], sent_c[pos_s], csent_l)
-        bval_c = admit_gate(bval_c, now, t.stale_ticks, t.future_ticks)
+        b_own_c = None
+        if t.tomb_budget is not None:
+            # Compacted twin of the dense shard board budget gate: the
+            # global publisher of compacted row c is ``gi[idx_s[c]]``
+            # (pad rows reconstruct to all-zero boards, the no-op).
+            b_own_c = ((bslot_c // p.services_per_node)
+                       == (idx_s + r0)[:, None])
+        bval_c = admit_gate(bval_c, now, t.stale_ticks, t.future_ticks,
+                            t.tomb_budget, b_own_c)
         snd_c = sender_l[:, None]
         bval_f = jnp.where(snd_c, bval_c[pos_s], 0)
         bslot_f = jnp.where(snd_c, bslot_c[pos_s], -1)

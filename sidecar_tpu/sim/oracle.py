@@ -78,6 +78,16 @@ class OracleSim:
         self.clocks = plan if plan is not None and plan.clocks else None
         # Future-admission bound (ops/merge.future_mask): None = off.
         self.future_ticks = sim.t.future_ticks
+        # Byzantine mirror (chaos/adversary.py, docs/chaos.md): the
+        # compiled plan's ``host_overrides`` replays the PRNG-free
+        # corruption formulas on the shared select_messages packet, and
+        # the budget/quarantine knobs mirror the kernel's defense gates
+        # — so a ChaosExactSim under attack locksteps exactly like a
+        # clock-only plan does.
+        self.adv = getattr(sim, "_adv", None)
+        self.tomb_budget = sim.t.tomb_budget
+        self.quarantine_threshold = sim.t.quarantine_threshold
+        self.origin_violations = np.zeros(self.p.n, np.int64)
 
     def _offsets(self) -> np.ndarray | None:
         """Per-node skew ticks for the CURRENT round, or None — the
@@ -140,6 +150,35 @@ class OracleSim:
             p.budget, self.limit)
         svc_idx, msg = np.asarray(svc_idx), np.asarray(msg)
 
+        # Per-node clocks (ClockFault): senders already stamped with
+        # their own skewed clocks; every RECEIVER gates admission,
+        # refresh, and expiry by its own.
+        offs = self._offsets()
+
+        def clock(node: int) -> int:
+            # Epoch floor, mirroring the sim's jnp.maximum(now+off, 0).
+            return now if offs is None else max(0, now + int(offs[node]))
+
+        # Adversary corruption lands between selection and transmit
+        # accounting (the kernel order): attackers replace the leading
+        # columns of their packets with forged records, lying relative
+        # to their OWN skewed clocks, and pay transmit counts for the
+        # forged sends.
+        if self.adv is not None:
+            now_vec = np.array([clock(i) for i in range(p.n)], np.int64)
+            fmask, fslots, fvals = self.adv.host_overrides(
+                self.round_idx, now_vec)
+            svc_idx = np.where(fmask, fslots, svc_idx)
+            msg = np.where(fmask, fvals, msg)
+
+        # Byzantine defenses (docs/chaos.md "the defense ladder"): the
+        # quarantine gate reads the ROUND-START evidence, exactly like
+        # the kernel (chaos/sim_inject.py).
+        tb = self.tomb_budget
+        qt = self.quarantine_threshold
+        quar = (np.zeros(p.n, bool) if qt is None
+                else self.origin_violations >= qt)
+
         # Transmit accounting (TransmitLimited: fanout sends per offer).
         # Unclamped, mirroring ops/gossip.record_transmissions: counts
         # stop growing the round a record crosses the limit (it is never
@@ -157,34 +196,64 @@ class OracleSim:
                 k_drop, 1.0 - p.drop_prob, (p.n, p.fanout, budget))
             drop = ~np.asarray(keep)
 
-        # Per-node clocks (ClockFault): senders already stamped with
-        # their own skewed clocks; every RECEIVER gates admission,
-        # refresh, and expiry by its own.
-        offs = self._offsets()
-
-        def clock(node: int) -> int:
-            # Epoch floor, mirroring the sim's jnp.maximum(now+off, 0).
-            return now if offs is None else max(0, now + int(offs[node]))
+        # Quarantine evidence accrual, mirroring the kernel's raw
+        # candidate tally (before the loss/liveness gates): a FRESH
+        # third-party claim — a record for a slot the sender doesn't
+        # own, stamped at-or-ahead of the receiver's clock — beyond the
+        # budget rank charges the SENDING origin, per packet copy.
+        if tb is not None:
+            for s in range(p.n):
+                for f in range(p.fanout):
+                    now_r = clock(int(dst[s, f]))
+                    rank = 0
+                    for b in range(budget):
+                        val = int(msg[s, b])
+                        ts = val >> STATUS_BITS
+                        if ts <= 0 or ts < now_r - t.stale_ticks:
+                            continue  # staleness-zeroed candidates
+                        sv = int(svc_idx[s, b])
+                        own = int(self.owner[min(sv, p.m - 1)]) == s
+                        if (not own) and ts >= now_r:
+                            rank += 1
+                            if rank > tb:
+                                self.origin_violations[s] += 1
 
         for s in range(p.n):
-            if not self.node_alive[s]:
-                continue
+            # A quarantined origin loses its send channel outright (the
+            # kernel's edge_keep fold); the budget rank below is still
+            # computed per packet regardless of the unrelated loss/
+            # liveness gates, exactly like admit_gate's candidate-set
+            # cumsum.
+            send_ok = bool(self.node_alive[s]) and not quar[s]
             for f in range(p.fanout):
                 tgt = int(dst[s, f])
-                if not self.node_alive[tgt]:
-                    continue
                 now_r = clock(tgt)
                 stale_floor = now_r - t.stale_ticks
+                rank = 0
                 for b in range(budget):
-                    if drop is not None and drop[s, f, b]:
-                        continue
                     val = int(msg[s, b])
                     ts = val >> STATUS_BITS
                     if ts > 0 and ts < stale_floor:  # staleness gate
                         continue
                     if self._too_future(ts, now_r):  # future bound
                         continue
-                    self.apply_one(tgt, int(svc_idx[s, b]), val, pre)
+                    sv = int(svc_idx[s, b])
+                    if tb is not None and ts > 0:
+                        # Per-origin budget (ops/merge.budget_mask):
+                        # the first ``tb`` suspicious third-party
+                        # records of a packet pass, the rest drop.
+                        own = int(self.owner[min(sv, p.m - 1)]) == s
+                        suspicious = (not own) and (
+                            _st(val) == TOMBSTONE or ts > now_r)
+                        if suspicious:
+                            rank += 1
+                            if rank > tb:
+                                continue
+                    if not send_ok or not self.node_alive[tgt]:
+                        continue
+                    if drop is not None and drop[s, f, b]:
+                        continue
+                    self.apply_one(tgt, sv, val, pre)
 
         # 2. announce re-stamps (end of round, same scatter in the
         # kernel).  Independent sequential mirror of the kernel's
@@ -223,6 +292,12 @@ class OracleSim:
             alive = self.node_alive
             partner = np.where(alive & alive[partner], partner,
                                np.arange(p.n))
+            if qt is not None:
+                # A quarantined origin neither pushes nor is pulled
+                # from: any exchange touching one remaps to the self
+                # no-op (the kernel's pp_partner remap).
+                partner = np.where(quar | quar[partner],
+                                   np.arange(p.n), partner)
             self.push_pull(partner, now, offs)
 
         # 4. lifespan sweep.
@@ -241,21 +316,38 @@ class OracleSim:
         the RECEIVING node's clock (``offs`` per-node skew)."""
         n = self.known.shape[0]
         t = self.t
+        tb = self.tomb_budget
         pre = self.known.copy()
         for i in range(n):
             tgt = int(partner[i])
             if tgt == i:
                 continue
-            for m in range(self.known.shape[1]):
-                for node, val in ((i, int(pre[tgt, m])),   # pull
-                                  (tgt, int(pre[i, m]))):  # push
-                    now_r = now if offs is None \
-                        else max(0, now + int(offs[node]))
+            # Two legs per initiator, each a full-row packet admitted at
+            # the RECEIVER's clock: pull merges the partner's row into
+            # ``i``; push merges ``i``'s row into the partner.  The
+            # per-origin budget ranks suspicious records across the
+            # exchanged row (ops/gossip.push_pull's contract), with the
+            # sender's own slots exempt.  Legs resolve against the
+            # pre-exchange snapshot, so leg order is immaterial.
+            for node, sender in ((i, tgt), (tgt, i)):
+                now_r = now if offs is None \
+                    else max(0, now + int(offs[node]))
+                rank = 0
+                for m in range(self.known.shape[1]):
+                    val = int(pre[sender, m])
                     ts = val >> STATUS_BITS
                     if ts == 0 or ts < now_r - t.stale_ticks:
                         continue
                     if self._too_future(ts, now_r):
                         continue
+                    if tb is not None:
+                        own = int(self.owner[m]) == sender
+                        suspicious = (not own) and (
+                            _st(val) == TOMBSTONE or ts > now_r)
+                        if suspicious:
+                            rank += 1
+                            if rank > tb:
+                                continue
                     self.apply_one(node, m, val, pre)
 
     # -- lifespan sweep ----------------------------------------------------
@@ -317,6 +409,16 @@ class OracleSim:
         truth = np.max(np.where(alive[:, None], self.known, 0), axis=0)
         agree = (self.known == truth[None, :]).mean(axis=1)
         return float((agree * alive).sum() / max(alive.sum(), 1))
+
+    def quarantined_origins(self) -> tuple:
+        """Origins at/over the quarantine threshold — the host twin of
+        ``ChaosExactSim.quarantined_origins`` (empty when the knob is
+        off)."""
+        qt = self.quarantine_threshold
+        if qt is None:
+            return ()
+        return tuple(int(i) for i in
+                     np.where(self.origin_violations >= qt)[0])
 
 
 class ProvenanceOracle:

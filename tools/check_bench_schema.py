@@ -47,6 +47,7 @@ KNOWN_RESULT_BLOCKS = {
     "sharded": dict,
     "query": dict,
     "robustness": dict,
+    "adversary": dict,
     "sweep": dict,
     "topology": dict,
     "cost": dict,
