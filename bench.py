@@ -844,6 +844,36 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# topology bench failed: {exc}", file=sys.stderr)
 
+    # Coherence-observatory block (benchmarks/coherence.py,
+    # docs/telemetry.md): digest-off vs digest-on from the same minted
+    # churn state — final-state bit-identity (so the rounds-to-ε ratio
+    # the acceptance bound caps at 1.02 is exactly 1.0), the honest
+    # wall-clock overhead of the in-scan digest columns, and the live
+    # writer/lock-free-reader micro-bench.  BENCH_COHERENCE=0 skips
+    # it; BENCH_COHERENCE_NODES / BENCH_COHERENCE_ROUNDS /
+    # BENCH_COHERENCE_BUCKETS size it.
+    coherence_block = None
+    if os.environ.get("BENCH_COHERENCE", "1") != "0":
+        try:
+            from benchmarks.coherence import run_coherence_bench
+            _watchdog_note("coherence")
+            coherence_block = run_coherence_bench(
+                n=int(os.environ.get("BENCH_COHERENCE_NODES", "4096")),
+                rounds=int(os.environ.get("BENCH_COHERENCE_ROUNDS",
+                                          "96")),
+                buckets=int(os.environ.get("BENCH_COHERENCE_BUCKETS",
+                                           "64")))
+            # The coherence SLO verdicts ride inside the block so the
+            # regression gate sees "p99 ttc <= 2 s" / "agreement >=
+            # 0.99" next to the numbers they bound (BENCH_SLO gate).
+            from sidecar_tpu.telemetry.slo import SloEvaluator
+            _ev = SloEvaluator.coherence_from_env()
+            if _ev is not None:
+                coherence_block["slo"] = _ev.evaluate_coherence()
+            _watchdog_note("coherence", {"coherence": coherence_block})
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# coherence bench failed: {exc}", file=sys.stderr)
+
     # Kernel-cost observatory block (sidecar_tpu/telemetry/cost.py,
     # docs/perf.md): per-phase attribution + compile/HBM telemetry for
     # the single-chip families, reconciled against the measured
@@ -891,6 +921,7 @@ def main() -> None:
         **({"adversary": adversary} if adversary else {}),
         **({"sweep": sweep} if sweep else {}),
         **({"topology": topology_block} if topology_block else {}),
+        **({"coherence": coherence_block} if coherence_block else {}),
         **({"cost": cost_block} if cost_block else {}),
         "telemetry": telemetry,
     }

@@ -100,6 +100,14 @@ class SimulationReport:
     # percentiles, and the exportable propagation tree — with ABSOLUTE
     # round numbers (chunked dispatches chain the carried trace).
     provenance: Optional[dict] = None
+    # Coherence digest stream (ops/digest.py, docs/telemetry.md),
+    # present when the caller passed ``digest`` > 0: per digested round
+    # the alive/agree census and differing-bucket divergence lower
+    # bounds vs the alive-max truth catalog, plus the final digest
+    # summary (agreement fraction, per-node differing buckets, and the
+    # quorum digest hex — the wire form ``GET /api/digest.json``
+    # publishes on the live side).
+    digest: Optional[dict] = None
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -209,6 +217,8 @@ class SimBridge:
                  board_exchange: Optional[str] = None,
                  sparse: Optional[bool] = None,
                  trace: int = 0,
+                 digest: int = 0,
+                 digest_buckets: int = 0,
                  protocol=None,
                  provenance: Optional[dict] = None) -> SimulationReport:
         """Run the catalog forward ``rounds`` gossip rounds.
@@ -247,6 +257,20 @@ class SimBridge:
         Available on both the single-chip and sharded twins; mutually
         exclusive with ``deltas_cap`` (one scan streams one record
         kind).
+
+        ``digest`` > 0 records the coherence-digest stream for the
+        first ``digest`` rounds (``run_with_digest`` → ops/digest.py):
+        per round the alive/agree census and differing-bucket
+        divergence lower bounds vs the alive-max truth catalog, under
+        the ONE digest definition the live cluster maintains
+        incrementally — slot identities come from the snapshot's
+        (hostname, service id) mapping via ``ident_of``, so the
+        report's digests are directly comparable with the live
+        ``GET /api/digest.json``.  ``digest_buckets`` overrides the
+        bucket count (0 → the shared default; must be a power of two).
+        Available on both the single-chip and sharded twins; mutually
+        exclusive with ``deltas_cap``, ``trace``, and ``provenance``
+        (one scan streams/carries one record kind).
 
         ``protocol`` (an :class:`ops.suspicion.ProtocolParams` or its
         dict form — the ``POST /simulate`` surface) runs the request
@@ -317,6 +341,29 @@ class SimBridge:
                 "provenance and damping prediction are mutually "
                 "exclusive (damping consumes the delta stream; one "
                 "scan carries one extra stream)")
+        from sidecar_tpu.ops import digest as digest_ops
+        if digest > 0:
+            # Fail fast on a bad bucket count (power-of-two contract).
+            digest_buckets = digest_buckets or digest_ops.DEFAULT_BUCKETS
+            digest_ops.bucket_ids_np(np.zeros(1, np.uint32),
+                                     digest_buckets)
+        if digest > 0 and deltas_cap > 0:
+            raise ValueError(
+                "digest and deltas_cap are mutually exclusive "
+                "(one scan streams one record kind)")
+        if digest > 0 and trace > 0:
+            raise ValueError(
+                "digest and trace are mutually exclusive "
+                "(one scan streams one record kind)")
+        if digest > 0 and prov_on:
+            raise ValueError(
+                "digest and provenance are mutually exclusive "
+                "(one scan carries one extra stream)")
+        if digest > 0 and damping_on:
+            raise ValueError(
+                "digest and damping prediction are mutually exclusive "
+                "(damping consumes the delta stream; one scan streams "
+                "one record kind)")
         # Damping prediction needs the per-round change stream even when
         # the caller didn't ask for deltas in the report.
         report_deltas = deltas_cap > 0
@@ -347,6 +394,18 @@ class SimBridge:
             tracked, prov_cap = self._resolve_tracked(
                 provenance, params, mapping, rounds)
 
+        # Digest identities from the snapshot's canonical (hostname,
+        # service id) mapping — the live path's ident_of, so sim and
+        # live digests bucket the same records identically.  Padding
+        # slots get synthetic names; their cells stay unknown (packed
+        # 0) and never contribute.
+        dig_idents = None
+        if digest > 0:
+            dig_idents = digest_ops.catalog_idents(
+                (hostname, sid if sid is not None else f"\x00pad{si}")
+                for ni, hostname in enumerate(mapping.hostnames)
+                for si, sid in enumerate(mapping.slots[ni]))
+
         key = jax.random.PRNGKey(seed)
         sizes = []
         left = rounds
@@ -376,10 +435,12 @@ class SimBridge:
             # the per-request {"sparse": false} forcing contract).
             use_sparse = arbiter.sparse
             kw = arbiter.dispatch_kwargs()
-            # Rounds of THIS chunk inside the trace budget: chunks past
-            # it dispatch the plain (trace-free) program.
+            # Rounds of THIS chunk inside the trace/digest budget:
+            # chunks past it dispatch the plain program.
             traced_n = max(0, min(trace - start, n_rounds)) \
                 if trace > 0 else 0
+            digested_n = max(0, min(digest - start, n_rounds)) \
+                if digest > 0 else 0
             with profiling.annotate("sidecar.bridge.dispatch"):
                 if prov_on:
                     # The carried ProvTrace chains chunk→chunk through
@@ -398,20 +459,27 @@ class SimBridge:
                     out = sim.run_with_trace(
                         st, key, n_rounds, cap=traced_n,
                         start_round=start, **kw)
+                elif digested_n > 0:
+                    out = sim.run_with_digest(
+                        st, key, n_rounds, cap=digested_n,
+                        buckets=digest_buckets, idents=dig_idents,
+                        start_round=start, **kw)
                 else:
                     out = sim.run(st, key, n_rounds, start_round=start,
                                   **kw)
             return out + ((sim.last_sparse_stats if use_sparse
-                           else None),), traced_n > 0
+                           else None),), (traced_n > 0, digested_n > 0)
 
         delta_stream = [] if deltas_cap > 0 else None
         trace_rounds = [] if trace > 0 else None
+        digest_rounds = [] if digest > 0 else None
         prov_box = [None]
         conv_parts = []
 
-        def consume(out, start, n_rounds, traced):
+        def consume(out, start, n_rounds, flags):
             from sidecar_tpu.ops import trace as trace_ops
 
+            traced, digested = flags
             t0 = time.perf_counter()
             stats = out[-1]
             out = out[:-1]
@@ -423,6 +491,9 @@ class SimBridge:
             elif traced:
                 final, tr, conv = out
                 trace_rounds.extend(trace_ops.trace_to_dicts(tr))
+            elif digested:
+                final, dtr, conv = out
+                digest_rounds.extend(digest_ops.digest_to_dicts(dtr))
             elif prov_on:
                 # The cumulative trace lives in prov_box (the chained
                 # carry); each chunk only contributes its conv slice.
@@ -487,6 +558,12 @@ class SimBridge:
             prov_doc = self._prov_report(prov_box[0], tracked, params,
                                          mapping)
 
+        digest_doc = None
+        if digest > 0:
+            digest_doc = self._digest_report(
+                digest, digest_buckets, digest_rounds, known,
+                np.asarray(final.node_alive), dig_idents, mapping)
+
         hits = np.nonzero(conv >= 1.0 - eps)[0]
         metrics.histogram_since("bridge.simulate", t_req)
         return SimulationReport(
@@ -505,7 +582,41 @@ class SimBridge:
                    else {"requested": trace, "rounds": trace_rounds}),
             robustness=robustness,
             provenance=prov_doc,
+            digest=digest_doc,
         )
+
+    @staticmethod
+    def _digest_report(requested: int, buckets: int, rounds_doc: list,
+                       known: np.ndarray, alive: np.ndarray, idents,
+                       mapping: BridgeMapping) -> dict:
+        """The report's ``digest`` block: the per-round stream plus a
+        final-state summary computed with the NumPy oracle (one O(N·M)
+        pass on the already-fetched belief matrix) — agreement vs the
+        alive-max truth catalog, per-node differing-bucket lower
+        bounds, and the quorum digest in the live wire form."""
+        from sidecar_tpu.ops import digest as digest_ops
+
+        digs = digest_ops.node_digests_np(known, idents, buckets)
+        truth = np.where(alive[:, None], known, 0).max(
+            axis=0, keepdims=True)
+        ref = digest_ops.node_digests_np(truth, idents, buckets)[0]
+        diffs = digest_ops.diff_counts_np(digs, ref)
+        alive_n = int(alive.sum())
+        agree = int(((diffs == 0) & alive).sum())
+        return {
+            "requested": requested,
+            "buckets": buckets,
+            "rounds": rounds_doc,
+            "final": {
+                "agreement": (agree / alive_n) if alive_n else 1.0,
+                "diff_total": int(diffs[alive].sum()),
+                "diff_max": int(diffs[alive].max()) if alive_n else 0,
+                "quorum_hex": digest_ops.digest_to_hex(ref),
+                "node_diff_buckets": {
+                    h: int(diffs[i])
+                    for i, h in enumerate(mapping.hostnames)},
+            },
+        }
 
     @staticmethod
     def _resolve_tracked(req: dict, params: SimParams,
@@ -872,6 +983,8 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                 sparse=(None if sparse_req is None
                         else bool(sparse_req)),
                 trace=int(req.get("trace", 0)),
+                digest=int(req.get("digest", 0)),
+                digest_buckets=int(req.get("digest_buckets", 0)),
                 protocol=req.get("protocol"),
                 provenance=req.get("provenance"))
             return report.to_json()

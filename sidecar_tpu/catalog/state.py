@@ -27,8 +27,10 @@ from typing import Callable, Iterable, Iterator, Optional
 
 from sidecar_tpu import metrics
 from sidecar_tpu import service as svc_mod
+from sidecar_tpu.ops import digest as digest_ops
 from sidecar_tpu.output import time_ago
 from sidecar_tpu.telemetry.span import span as _span
+from sidecar_tpu.telemetry import coherence as _coherence
 from sidecar_tpu.telemetry import propagation as _propagation
 from sidecar_tpu.runtime.looper import Looper, TimedLooper
 from sidecar_tpu.service import (
@@ -57,6 +59,28 @@ ALIVE_SLEEP_INTERVAL = 1.0
 ALIVE_BROADCAST_INTERVAL = 60.0
 LISTENER_EVENT_BUFFER_SIZE = 20
 SERVICE_MSGS_BUFFER = 25       # NewServicesState (services_state.go:95)
+
+
+def _digest_buckets() -> int:
+    """Bucket count for the live coherence digest
+    (``SIDECAR_TPU_DIGEST_BUCKETS``, a power of two; default
+    ops/digest.DEFAULT_BUCKETS).  Read at state construction — one
+    process hosts one digest geometry, matching the sim scan's static
+    ``buckets`` argument.  A malformed value falls back to the default
+    with a warning rather than failing catalog construction."""
+    import os
+
+    raw = os.environ.get("SIDECAR_TPU_DIGEST_BUCKETS", "")
+    if not raw:
+        return digest_ops.DEFAULT_BUCKETS
+    try:
+        buckets = int(raw)
+        digest_ops.IncrementalDigest(buckets)  # validates power-of-two
+        return buckets
+    except (ValueError, TypeError):
+        log.warning("Bad SIDECAR_TPU_DIGEST_BUCKETS=%r; using default %d",
+                    raw, digest_ops.DEFAULT_BUCKETS)
+        return digest_ops.DEFAULT_BUCKETS
 
 
 @dataclasses.dataclass
@@ -194,6 +218,18 @@ class ServicesState:
         # writer.  None = the defense rung is off
         # (SIDECAR_TPU_ORIGIN_BUDGET / _ORIGIN_QUARANTINE unset).
         self.origin_gate = None
+        # The live coherence digest (ops/digest.py — the ONE definition
+        # shared with the sim's run_with_digest scan): maintained
+        # incrementally by the writer under the state lock (every
+        # add/replace/tombstone/expire is an O(1) lane update) and
+        # PUBLISHED as an immutable snapshot tuple so readers — the
+        # push-pull annotation, /api/digest.json, the coherence
+        # monitor — never take the lock (atomic reference read).
+        self._digest = digest_ops.IncrementalDigest(_digest_buckets())
+        self.digest_snapshot: tuple = (0, self._digest.value())
+        # Peer digest annotation captured by decode() from a push-pull
+        # body's "Digest" key — None on states built directly.
+        self.wire_digest: Optional[dict] = None
 
     # -- time injection (tests) -------------------------------------------
 
@@ -243,6 +279,57 @@ class ServicesState:
     def encode(self) -> bytes:
         with self._lock:
             return json.dumps(self.to_json(), separators=(",", ":")).encode()
+
+    def encode_annotated(self) -> bytes:
+        """The push-pull body: :meth:`encode`'s Go-wire document plus
+        the coherence-digest annotation under a ``"Digest"`` key.  Kept
+        OFF :meth:`encode` so decode→encode stays byte-identical to the
+        Go fixtures (tests/test_go_wire.py); Go peers ignore the extra
+        key (encoding/json skips unknown fields), sidecar-tpu peers
+        harvest it in :meth:`merge` via :func:`decode`."""
+        with self._lock:
+            doc = self.to_json()
+            doc["Digest"] = self.digest_doc()
+            return json.dumps(doc, separators=(",", ":")).encode()
+
+    # -- the coherence digest (ops/digest.py live twin) --------------------
+
+    def digest_doc(self) -> dict:
+        """Wire/JSON view of the published digest snapshot — read
+        WITHOUT the state lock (one immutable-tuple reference read;
+        ``buckets`` is fixed at construction).  This is the coherence
+        plane's read-path contract: /api/digest.json and the push-pull
+        annotation never contend with the writer."""
+        count, value = self.digest_snapshot
+        return {"Buckets": self._digest.buckets, "Records": count,
+                "Hex": digest_ops.digest_to_hex(value)}
+
+    def _digest_remove(self, svc: Service) -> None:
+        """Writer-side capture: MUST run BEFORE a record is replaced,
+        deleted, or mutated in place — the digest key includes
+        ``(updated, status)``, so the old pair has to be subtracted
+        while it is still observable."""
+        self._digest.remove(digest_ops.ident_of(svc.hostname, svc.id),
+                            digest_ops.live_key(svc.updated, svc.status))
+
+    def _digest_add(self, svc: Service) -> None:
+        self._digest.add(digest_ops.ident_of(svc.hostname, svc.id),
+                         digest_ops.live_key(svc.updated, svc.status))
+
+    def _digest_publish(self) -> None:
+        """Swap in a fresh immutable snapshot (atomic reference
+        assignment — the lock-free read path) and feed the local view
+        of the coherence monitor, anchored to the query-plane version
+        so time-to-coherence is attributable to a specific publish."""
+        snap = (self._digest.count, self._digest.value())
+        self.digest_snapshot = snap
+        hub = self._query_hub
+        cur = getattr(hub, "_current", None) if hub is not None else None
+        _coherence.observe(self.hostname, snap[1],
+                           buckets=self._digest.buckets,
+                           records=snap[0], local=True,
+                           version=cur.version if cur is not None else 0,
+                           now_ns=self._now())
 
     # -- mutation: the merge kernel ---------------------------------------
 
@@ -342,6 +429,8 @@ class ServicesState:
 
             if not server.has_service(new_svc.id):
                 server.services[new_svc.id] = new_svc
+                self._digest_add(new_svc)
+                self._digest_publish()
                 self.service_changed(new_svc, UNKNOWN, new_svc.updated)
                 self.retransmit(new_svc)
                 self._observe_propagation(new_svc, now)
@@ -352,7 +441,10 @@ class ServicesState:
                 if old.status == svc_mod.DRAINING and \
                         new_svc.status == svc_mod.ALIVE:
                     new_svc.status = old.status
+                self._digest_remove(old)
                 server.services[new_svc.id] = new_svc
+                self._digest_add(new_svc)
+                self._digest_publish()
                 if old.status != new_svc.status:
                     self.service_changed(new_svc, old.status, new_svc.updated)
                 self.retransmit(new_svc)
@@ -379,6 +471,19 @@ class ServicesState:
         annotated with that origin so the writer can reject the push
         once the origin crosses the quarantine threshold."""
         origin = other.hostname
+        # Coherence harvest: one push-pull body carries the peer's
+        # catalog digest ("Digest" annotation captured by decode(), or
+        # the live snapshot when merging an in-process state) — the
+        # monitor learns how far the peer's view diverges from ours
+        # before a single record lands (telemetry/coherence.py).
+        if origin and origin != self.hostname:
+            peer_doc = getattr(other, "wire_digest", None)
+            if peer_doc is None and \
+                    getattr(other, "digest_snapshot", (0,))[0]:
+                peer_doc = other.digest_doc()
+            if peer_doc is not None:
+                _coherence.observe_doc(origin, peer_doc,
+                                       now_ns=self._now())
         gate = self.origin_gate
         if gate is not None and origin:
             over = gate.observe(
@@ -520,9 +625,15 @@ class ServicesState:
             now = self._now()
             for svc in server.services.values():
                 previous = svc.status
+                # tombstone() mutates (status, updated) IN PLACE — the
+                # digest key covers both, so subtract the old pair
+                # first (capture-before-mutate).
+                self._digest_remove(svc)
                 svc.tombstone(now=now)
+                self._digest_add(svc)
                 self.service_changed(svc, previous, svc.updated)
                 tombstones.append(svc.copy())
+            self._digest_publish()
         self.send_services(
             tombstones,
             TimedLooper(self.tombstone_retransmit, TOMBSTONE_COUNT))
@@ -639,12 +750,15 @@ class ServicesState:
         result = []
         now = self._now()
         with self._lock:
+            changed = False
             for hostname in list(self.servers):
                 server = self.servers[hostname]
                 for sid in list(server.services):
                     svc = server.services[sid]
                     if svc.is_tombstone() and svc.updated < now - int(
                             TOMBSTONE_LIFESPAN * NS_PER_SECOND):
+                        self._digest_remove(svc)
+                        changed = True
                         del server.services[sid]
                         if not server.services:
                             del self.servers[hostname]
@@ -658,11 +772,17 @@ class ServicesState:
                             "tombstoning", svc.name, svc.id, svc.hostname)
                         previous = svc.status
                         # Original timestamp + 1 s, NOT now — the "+1 s
-                        # rule" (services_state.go:667-675).
+                        # rule" (services_state.go:667-675).  In-place
+                        # restamp: subtract the old digest key first.
+                        self._digest_remove(svc)
                         svc.status = TOMBSTONE
                         svc.updated = svc.updated + NS_PER_SECOND
+                        self._digest_add(svc)
+                        changed = True
                         self.service_changed(svc, previous, svc.updated)
                         result.append(svc.copy())
+            if changed:
+                self._digest_publish()
         return result
 
     def tombstone_services(self, hostname: str,
@@ -679,9 +799,13 @@ class ServicesState:
                 if svc.id not in mapping and not svc.is_tombstone():
                     log.warning("Tombstoning %s", svc.id)
                     previous = svc.status
+                    self._digest_remove(svc)
                     svc.tombstone(now=now)
+                    self._digest_add(svc)
                     self.service_changed(svc, previous, svc.updated)
                     result.extend([svc.copy(), svc.copy()])
+            if result:
+                self._digest_publish()
         return result
 
     # -- tracking loops ----------------------------------------------------
@@ -801,6 +925,12 @@ def decode(data: bytes | str) -> ServicesState:
         state.last_changed = _ts(d.get("LastChanged"))
         for hostname, sd in (d.get("Servers") or {}).items():
             state.servers[hostname] = Server.from_json(sd)
+        # Coherence annotation (encode_annotated): captured verbatim for
+        # merge() to harvest — never merged into the decoded state's own
+        # (empty) incremental digest, which only the writer maintains.
+        dig = d.get("Digest")
+        if isinstance(dig, dict):
+            state.wire_digest = dig
         return state
     except (json.JSONDecodeError, UnicodeDecodeError, TypeError,
             AttributeError, KeyError, OverflowError) as exc:

@@ -104,6 +104,9 @@ class FleetRun:
     final_states: object = None   # stacked states (oracle / chaining)
     tracked: tuple = ()           # traced slots (ops/provenance.py)
     first_seen: np.ndarray = None  # [S, T, N] absolute rounds; -1
+    # Host-side caches for digest_agreement (fetched once, lazily).
+    _final_known: np.ndarray = None
+    _final_alive: np.ndarray = None
 
     def lag_summary(self, i: int):
         """Scenario ``i``'s pooled per-record lag CDF, or None when the
@@ -112,6 +115,31 @@ class FleetRun:
             return None
         from sidecar_tpu.ops import provenance as prov_ops
         return prov_ops.pooled_lag(self.first_seen[i])
+
+    def digest_agreement(self, i: int) -> Optional[float]:
+        """Scenario ``i``'s end-state coherence: the fraction of alive
+        nodes whose catalog digest (ops/digest.py NumPy oracle over the
+        final belief board) matches the modal digest — 1.0 iff every
+        alive node holds a bit-identical catalog, the same agreement
+        statistic the live CoherenceMonitor publishes."""
+        st = self.final_states
+        if st is None:
+            return None
+        from sidecar_tpu.ops import digest as digest_ops
+        if self._final_known is None:
+            self._final_known = np.asarray(jax.device_get(st.known))
+            self._final_alive = np.asarray(
+                jax.device_get(st.node_alive))
+        rows = self._final_known[i][self._final_alive[i]]
+        if not len(rows):
+            return None
+        digs = digest_ops.node_digests_np(
+            rows, digest_ops.default_idents(rows.shape[1]))
+        counts: dict = {}
+        for d in digs:
+            k = d.tobytes()
+            counts[k] = counts.get(k, 0) + 1
+        return max(counts.values()) / len(rows)
 
     def table(self, round_ticks: int, ticks_per_second: int) -> list:
         """Per-scenario rows for the /sweep Pareto table."""
@@ -130,6 +158,7 @@ class FleetRun:
                 "final_convergence": float(self.convergence[-1, i])
                 if len(self.convergence) else None,
                 "p99_lag_rounds": None if lag is None else lag["p99"],
+                "digest_agreement": self.digest_agreement(i),
             })
         return out
 

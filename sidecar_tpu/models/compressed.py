@@ -116,6 +116,7 @@ from jax import lax
 
 from sidecar_tpu.models.exact import clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import digest as digest_ops
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import knobs as knob_ops
@@ -1588,6 +1589,46 @@ class CompressedSim:
         self.last_sparse_stats = None
         return self._run_trace_jit(state, key, num_rounds, cap)
 
+    def _digest_record(self, nxt, idents, buckets: int):
+        """One round's coherence record (ops/digest.py) over the
+        materialized belief view ``max(floor, cache hit, own)`` —
+        computed at the jit level over the global tensors, so the
+        sharded twin inherits this unchanged (GSPMD shards the gathers
+        and the segment-sum)."""
+        from sidecar_tpu.ops.delta import compressed_belief
+        bel = compressed_belief(nxt.own, nxt.cache_slot, nxt.cache_val,
+                                nxt.floor, self.p.services_per_node)
+        return digest_ops.state_digest_record(
+            nxt.round_idx, bel, nxt.node_alive, idents, buckets)
+
+    def _resolve_digest_idents(self, idents):
+        if idents is None:
+            idents = digest_ops.default_idents(self.p.m)
+        return jnp.asarray(idents, jnp.uint32)
+
+    def run_with_digest(self, state, key, num_rounds: int, cap: int = 0,
+                        buckets: int = digest_ops.DEFAULT_BUCKETS,
+                        idents=None, donate: bool = True,
+                        start_round=None, sparse=None):
+        """Scan with the per-round coherence digest (ops/digest.py):
+        returns ``(final state, DigestTrace)`` — the compressed
+        drivers' no-conv arity, like :meth:`run_with_trace`.  Works
+        unchanged on the sharded twin (the digest is computed at the
+        jit level over the global tensors)."""
+        cap = cap or num_rounds
+        idents = self._resolve_digest_idents(idents)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, dt, stats = self._run_digest_sparse_jit(
+                state, key, num_rounds, cap, idents, buckets)
+            self.last_sparse_stats = stats
+            return final, dt
+        self.last_sparse_stats = None
+        return self._run_digest_jit(state, key, num_rounds, cap, idents,
+                                    buckets)
+
     def run_with_deltas(self, state, key, num_rounds: int, cap: int,
                         donate: bool = True, sparse=None):
         """Scan with per-round changed-belief extraction: returns
@@ -1717,6 +1758,22 @@ class CompressedSim:
             length=num_rounds)
         return final, buf
 
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4, 6),
+                       donate_argnums=1)
+    def _run_digest_jit(self, state, key, num_rounds, cap, idents,
+                        buckets):
+        def body(carry, _):
+            st, buf = carry
+            st2 = self._step(st, jax.random.fold_in(key, st.round_idx))
+            buf = digest_ops.append_digest(
+                buf, self._digest_record(st2, idents, buckets))
+            return (st2, buf), None
+
+        (final, buf), _ = lax.scan(
+            body, (state, digest_ops.zero_digest(cap)), None,
+            length=num_rounds)
+        return final, buf
+
     # Donates the ProvTrace too (argnum 4): it chains chunk-to-chunk the
     # way the state does.
     @functools.partial(jax.jit, static_argnums=(0, 3, 5),
@@ -1828,6 +1885,23 @@ class CompressedSim:
 
         (final, buf, stats), _ = lax.scan(
             body, (state, trace_ops.zero_trace(cap),
+                   sparse_ops.zero_stats()), None, length=num_rounds)
+        return final, buf, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4, 6),
+                       donate_argnums=1)
+    def _run_digest_sparse_jit(self, state, key, num_rounds, cap,
+                               idents, buckets):
+        def body(carry, _):
+            st, buf, acc = carry
+            st2, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            buf = digest_ops.append_digest(
+                buf, self._digest_record(st2, idents, buckets))
+            return (st2, buf, sparse_ops.accumulate_stats(acc, s)), None
+
+        (final, buf, stats), _ = lax.scan(
+            body, (state, digest_ops.zero_digest(cap),
                    sparse_ops.zero_stats()), None, length=num_rounds)
         return final, buf, stats
 

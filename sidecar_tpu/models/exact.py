@@ -50,6 +50,7 @@ import numpy as np
 from jax import lax
 
 from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import digest as digest_ops
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import knobs as knob_ops
 from sidecar_tpu.ops import provenance as prov_ops
@@ -677,6 +678,45 @@ class ExactSim:
         self.last_sparse_stats = None
         return self._run_trace_jit(state, key, num_rounds, cap)
 
+    def _digest_record(self, nxt: SimState, idents, buckets: int):
+        """One round's coherence record (ops/digest.py) over the
+        post-round belief matrix."""
+        return digest_ops.state_digest_record(
+            nxt.round_idx, nxt.known, nxt.node_alive, idents, buckets)
+
+    def _resolve_digest_idents(self, idents):
+        """The digest identity table: caller-supplied (the bridge's
+        canonical (host, sid) idents) or the pure-sim slot default."""
+        if idents is None:
+            idents = digest_ops.default_idents(self.p.m)
+        return jnp.asarray(idents, jnp.uint32)
+
+    def run_with_digest(self, state: SimState, key: jax.Array,
+                        num_rounds: int, cap: int = 0,
+                        buckets: int = digest_ops.DEFAULT_BUCKETS,
+                        idents=None, donate: bool = True,
+                        start_round=None, sparse=None):
+        """Scan with the per-round coherence digest (ops/digest.py):
+        returns ``(final state, DigestTrace, conv[num_rounds])``.  The
+        record stream rides the scan carry behind the static ``cap``
+        (0 = digest every round); rounds past the capacity truncate
+        with ``overflow`` set.  The plain drivers compile none of
+        this: digest-off dispatches are bit-identical to pre-digest
+        programs (tests/test_digest.py pins all four families)."""
+        cap = cap or num_rounds
+        idents = self._resolve_digest_idents(idents)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, dt, conv, stats = self._run_digest_sparse_jit(
+                state, key, num_rounds, cap, idents, buckets)
+            self.last_sparse_stats = stats
+            return final, dt, conv
+        self.last_sparse_stats = None
+        return self._run_digest_jit(state, key, num_rounds, cap, idents,
+                                    buckets)
+
     def run_with_deltas(self, state: SimState, key: jax.Array,
                         num_rounds: int, cap: int, donate: bool = True,
                         start_round=None, sparse=None):
@@ -798,6 +838,22 @@ class ExactSim:
             length=num_rounds)
         return final, buf, conv
 
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4, 6),
+                       donate_argnums=1)
+    def _run_digest_jit(self, state: SimState, key: jax.Array,
+                        num_rounds: int, cap: int, idents, buckets: int):
+        def body(carry, _):
+            st, buf = carry
+            st2 = self._step(st, jax.random.fold_in(key, st.round_idx))
+            buf = digest_ops.append_digest(
+                buf, self._digest_record(st2, idents, buckets))
+            return (st2, buf), self.convergence(st2)
+
+        (final, buf), conv = lax.scan(
+            body, (state, digest_ops.zero_digest(cap)), None,
+            length=num_rounds)
+        return final, buf, conv
+
     # Donates the ProvTrace too (argnum 4): it chains chunk-to-chunk the
     # way the state does.
     @functools.partial(jax.jit, static_argnums=(0, 3, 5),
@@ -891,6 +947,25 @@ class ExactSim:
 
         (final, buf, stats), conv = lax.scan(
             body, (state, trace_ops.zero_trace(cap),
+                   sparse_ops.zero_stats()), None, length=num_rounds)
+        return final, buf, conv, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4, 6),
+                       donate_argnums=1)
+    def _run_digest_sparse_jit(self, state: SimState, key: jax.Array,
+                               num_rounds: int, cap: int, idents,
+                               buckets: int):
+        def body(carry, _):
+            st, buf, acc = carry
+            st2, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            buf = digest_ops.append_digest(
+                buf, self._digest_record(st2, idents, buckets))
+            return (st2, buf, sparse_ops.accumulate_stats(acc, s)), \
+                self.convergence(st2)
+
+        (final, buf, stats), conv = lax.scan(
+            body, (state, digest_ops.zero_digest(cap),
                    sparse_ops.zero_stats()), None, length=num_rounds)
         return final, buf, conv, stats
 

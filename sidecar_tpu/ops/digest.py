@@ -1,0 +1,472 @@
+"""Order-invariant catalog digests — the coherence plane's ONE
+fingerprint definition, shared by the simulator and the live cluster.
+
+A catalog is a multiset of records ``(host, service-id, packed key)``.
+Its digest is computed record-by-record: a 32-bit identity ``ident``
+names the (host, service-id) pair, the record's packed key (tick or
+timestamp, status in the low 3 bits — ops/status.py) is mixed with the
+ident into TWO 32-bit hash lanes (a 64-bit record hash), and the lanes
+are summed mod 2^32 into one of ``B`` buckets chosen by the ident
+alone.  Three properties fall out of that construction:
+
+* **Order-invariant** — per-bucket modular SUM is commutative and
+  associative, so any insertion order (gossip arrival order, merge
+  order, scan order) yields the identical digest.
+* **Incrementally updatable** — modular sum is invertible: removing a
+  record is a modular SUBTRACT of its lanes, so the live catalog can
+  maintain the digest in O(1) per mutation under its writer lock
+  (:class:`IncrementalDigest`), with no rescan.
+* **Divergence lower bound** — the bucket index depends only on the
+  ident, so two versions of the SAME record land in the same bucket:
+  a node that is stale on k distinct records differs from the truth
+  digest in at most k buckets, i.e. the count of differing buckets
+  between two digests LOWER-BOUNDS the number of diverged records
+  (hash collisions can only shrink the count, never inflate it).
+
+Three twins compute the same function and must agree byte-for-byte
+(tests/test_digest.py pins all pairs):
+
+* the jnp path (:func:`node_digests`, :func:`state_digest_record`) —
+  one elementwise hash over the belief matrix plus a ``segment_sum``
+  computes ALL N node digests on-device; it runs inside ``lax.scan``
+  (``run_with_digest``) and shards under GSPMD because the reduce is
+  over the global tensors (the ops/trace.py contract);
+* the pure-NumPy oracle (:func:`node_digests_np`, :func:`digest_np`)
+  — the sequential ground truth the sim path is validated against;
+* the pure-Python live path (:class:`IncrementalDigest`) — the
+  ``catalog/state.py`` writer maintains it under ``_lock`` and
+  publishes immutable snapshots for lock-free readers.
+
+Key domain: one 64-bit packed key ``(ts << 3) | status``.  The sim's
+int32 packed keys embed verbatim (high half zero); the live catalog
+packs its raw ``updated`` nanosecond stamp the same way
+(:func:`live_key`), so two peers holding byte-identical records hold
+byte-identical digests, and a test that stamps live records with
+sim-tick ``updated`` values gets cross-plane byte identity.
+
+Like the flight recorder, digesting is OPT-IN per dispatch
+(``run_with_digest``): the plain drivers compile none of this, so
+digest-off leaves every existing program untouched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sidecar_tpu.ops.status import is_known
+
+# Default bucket count B: 64 buckets x 2 lanes = 512 B per digest —
+# small enough to annotate every push-pull exchange, wide enough that
+# the differing-bucket lower bound stays tight for the diverged-record
+# counts coherence monitoring cares about (ones and tens, not
+# thousands).
+DEFAULT_BUCKETS = 64
+
+_M32 = 0xFFFFFFFF
+_M64 = 0xFFFFFFFFFFFFFFFF
+
+# Multiplicative mixing constants — the ops/kernels hash_line idiom:
+# Knuth's multiplicative constant plus the murmur3 finalizer pair, and
+# the 32-bit golden ratio as the lane separator.
+_K1 = 2654435761
+_K2 = 0x85EBCA6B
+_K3 = 0xC2B2AE35
+_GOLD = 0x9E3779B9
+
+
+def _bucket_shift(buckets: int) -> int:
+    """Validate ``buckets`` (power of two) and return the top-bits
+    shift selecting a bucket from a mixed 32-bit ident."""
+    if buckets < 1 or buckets & (buckets - 1):
+        raise ValueError(f"buckets must be a power of two, got {buckets}")
+    return 32 - (buckets.bit_length() - 1)
+
+
+# -- jnp twin ----------------------------------------------------------------
+
+def mix32(x: jax.Array) -> jax.Array:
+    """murmur3 fmix32 over a uint32 array (wrapping arithmetic)."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(_K2)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(_K3)
+    return x ^ (x >> 16)
+
+
+def record_lanes(idents: jax.Array, lo: jax.Array, hi: jax.Array):
+    """The 64-bit record hash as two uint32 lanes.  All inputs uint32;
+    ``lo``/``hi`` are the halves of the 64-bit packed key (sim int32
+    packed keys pass ``hi = 0``).  The formula is the shared
+    definition — the NumPy and pure-Python twins repeat it verbatim."""
+    k = mix32(lo) ^ (mix32(hi ^ jnp.uint32(_GOLD)) * jnp.uint32(_K1))
+    lane0 = mix32(idents ^ k)
+    lane1 = mix32((idents + jnp.uint32(_GOLD)) ^ (k * jnp.uint32(_K1)))
+    return lane0, lane1
+
+
+def bucket_ids(idents: jax.Array, buckets: int) -> jax.Array:
+    """Per-slot bucket index (int32 [M]) — a function of the ident
+    ALONE, so every version of a record lands in the same bucket (the
+    lower-bound property) and the index is static across rounds."""
+    shift = _bucket_shift(buckets)
+    if shift >= 32:
+        return jnp.zeros(idents.shape, jnp.int32)
+    mixed = mix32(idents.astype(jnp.uint32) * jnp.uint32(_K1))
+    return (mixed >> jnp.uint32(shift)).astype(jnp.int32)
+
+
+def node_digests(packed: jax.Array, idents: jax.Array,
+                 buckets: int) -> jax.Array:
+    """All node digests from a packed belief matrix: int32 [N, M] ->
+    uint32 [N, B, 2].  Unknown cells (tick 0) contribute nothing.  One
+    elementwise hash plus a segment-sum — inside a scan this is the
+    whole per-round cost, and under GSPMD the reduce runs over the
+    global tensors (rows stay on their shards)."""
+    mask = is_known(packed)
+    lo = packed.astype(jnp.uint32)
+    hi = jnp.zeros_like(lo)
+    ids = idents.astype(jnp.uint32)[None, :]
+    lane0, lane1 = record_lanes(ids, lo, hi)
+    zero = jnp.uint32(0)
+    lane0 = jnp.where(mask, lane0, zero)
+    lane1 = jnp.where(mask, lane1, zero)
+    seg = bucket_ids(idents, buckets)
+    d0 = jax.ops.segment_sum(lane0.T, seg, num_segments=buckets)
+    d1 = jax.ops.segment_sum(lane1.T, seg, num_segments=buckets)
+    return jnp.stack([d0.T, d1.T], axis=-1)
+
+
+def diff_counts(dig: jax.Array, ref: jax.Array) -> jax.Array:
+    """Differing-bucket counts vs a reference digest: uint32 [N, B, 2]
+    x [B, 2] -> int32 [N].  Each count lower-bounds that node's
+    diverged-record count vs the reference catalog."""
+    differ = jnp.any(dig != ref[None, :, :], axis=-1)
+    return jnp.sum(differ.astype(jnp.int32), axis=-1)
+
+
+# Digest-record layout — flat int32 [DIGEST_WIDTH], the trace-record
+# idiom: positional columns so the scan carry stays one array.
+DIG_ROUND = 0
+DIG_ALIVE = 1
+DIG_AGREE = 2
+DIG_DIFF_TOTAL = 3
+DIG_DIFF_MAX = 4
+DIGEST_WIDTH = 5
+DIGEST_FIELDS = ("round", "alive", "agree", "diff_total", "diff_max")
+
+
+def state_digest_record(round_idx, packed, node_alive, idents,
+                        buckets: int) -> jax.Array:
+    """One round's coherence record from a packed belief matrix:
+
+    * ``alive``      — live cluster members this round;
+    * ``agree``      — alive nodes whose digest equals the truth
+      digest (the alive-max catalog — the convergence metric's truth);
+    * ``diff_total`` — differing buckets summed over alive nodes: the
+      fleet-wide diverged-record lower bound;
+    * ``diff_max``   — the worst single node's differing buckets.
+    """
+    dig = node_digests(packed, idents, buckets)
+    truth = jnp.max(jnp.where(node_alive[:, None], packed, 0), axis=0)
+    ref = node_digests(truth[None, :], idents, buckets)[0]
+    diffs = diff_counts(dig, ref)
+    alive_i = node_alive.astype(jnp.int32)
+    agree = jnp.sum(alive_i * (diffs == 0).astype(jnp.int32))
+    masked = jnp.where(node_alive, diffs, 0)
+    return jnp.stack([
+        jnp.asarray(round_idx, jnp.int32),
+        jnp.sum(alive_i),
+        agree,
+        jnp.sum(masked),
+        jnp.max(masked),
+    ])
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DigestTrace:
+    """A bounded stream of per-round coherence records — the
+    RoundTrace contract: ``count`` is the TRUE number of rounds
+    digested, rows past ``min(count, cap)`` are zero padding, and
+    ``overflow`` reports truncation (never silent)."""
+
+    count: jax.Array     # int32 scalar — rounds digested (exact)
+    rec: jax.Array       # int32 [cap, DIGEST_WIDTH]
+    overflow: jax.Array  # bool scalar — count exceeded cap
+
+
+def zero_digest(cap: int) -> DigestTrace:
+    return DigestTrace(count=jnp.zeros((), jnp.int32),
+                       rec=jnp.zeros((cap, DIGEST_WIDTH), jnp.int32),
+                       overflow=jnp.zeros((), bool))
+
+
+def append_digest(buf: DigestTrace, rec: jax.Array) -> DigestTrace:
+    """Append one [DIGEST_WIDTH] record; past the capacity the write
+    drops (truncation) while ``count`` keeps the exact total."""
+    cap = buf.rec.shape[0]
+    out = buf.rec.at[buf.count].set(rec, mode="drop")
+    count = buf.count + 1
+    return DigestTrace(count=count, rec=out, overflow=count > cap)
+
+
+# -- NumPy oracle ------------------------------------------------------------
+
+def mix32_np(x: np.ndarray) -> np.ndarray:
+    """fmix32 over a uint32 ndarray — the oracle's mixer."""
+    x = np.asarray(x, np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = x * np.uint32(_K2)
+    x = x ^ (x >> np.uint32(13))
+    x = x * np.uint32(_K3)
+    return x ^ (x >> np.uint32(16))
+
+
+def record_lanes_np(idents, lo, hi):
+    idents = np.asarray(idents, np.uint32)
+    lo = np.asarray(lo, np.uint32)
+    hi = np.asarray(hi, np.uint32)
+    k = mix32_np(lo) ^ (mix32_np(hi ^ np.uint32(_GOLD)) * np.uint32(_K1))
+    lane0 = mix32_np(idents ^ k)
+    lane1 = mix32_np((idents + np.uint32(_GOLD)) ^ (k * np.uint32(_K1)))
+    return lane0, lane1
+
+
+def bucket_ids_np(idents, buckets: int) -> np.ndarray:
+    shift = _bucket_shift(buckets)
+    idents = np.asarray(idents, np.uint32)
+    if shift >= 32:
+        return np.zeros(idents.shape, np.int64)
+    mixed = mix32_np(idents * np.uint32(_K1))
+    return (mixed >> np.uint32(shift)).astype(np.int64)
+
+
+def digest_np(idents, keys, buckets: int = DEFAULT_BUCKETS) -> np.ndarray:
+    """Oracle digest of one catalog given parallel arrays of idents
+    (uint32) and 64-bit packed keys (uint64): -> uint32 [B, 2]."""
+    idents = np.asarray(idents, np.uint32)
+    keys = np.asarray(keys, np.uint64)
+    lo = (keys & np.uint64(_M32)).astype(np.uint32)
+    hi = (keys >> np.uint64(32)).astype(np.uint32)
+    lane0, lane1 = record_lanes_np(idents, lo, hi)
+    seg = bucket_ids_np(idents, buckets)
+    dig = np.zeros((buckets, 2), np.uint32)
+    np.add.at(dig[:, 0], seg, lane0)
+    np.add.at(dig[:, 1], seg, lane1)
+    return dig
+
+
+def node_digests_np(packed, idents, buckets: int = DEFAULT_BUCKETS
+                    ) -> np.ndarray:
+    """Oracle twin of :func:`node_digests`: int32 [N, M] packed belief
+    matrix -> uint32 [N, B, 2], unknown cells skipped."""
+    packed = np.asarray(packed, np.int64)
+    idents = np.asarray(idents, np.uint32)
+    n = packed.shape[0]
+    out = np.zeros((n, buckets, 2), np.uint32)
+    for i in range(n):
+        row = packed[i]
+        known = (row >> 3) > 0
+        out[i] = digest_np(idents[known], row[known].astype(np.uint64),
+                           buckets)
+    return out
+
+
+def diff_counts_np(dig, ref) -> np.ndarray:
+    dig = np.asarray(dig)
+    ref = np.asarray(ref)
+    return np.any(dig != ref[None, :, :], axis=-1).sum(axis=-1)
+
+
+def default_idents(m: int) -> np.ndarray:
+    """The pure-sim slot identity table (uint32 [M]): slot j's ident is
+    a mixed function of j.  Bridge-backed runs replace this with
+    :func:`catalog_idents` over the snapshot's canonical (host, sid)
+    mapping so sim digests are comparable with live ones."""
+    slots = np.arange(1, m + 1, dtype=np.uint32)
+    return mix32_np(slots * np.uint32(_K1))
+
+
+def catalog_idents(slot_names) -> np.ndarray:
+    """Identity table from the bridge's canonical slot mapping: an
+    iterable of ``(hostname, service_id)`` per slot -> uint32 [M] of
+    :func:`ident_of` values (the live path's identity function)."""
+    return np.asarray([ident_of(h, s) for h, s in slot_names], np.uint32)
+
+
+# -- pure-Python live twin ---------------------------------------------------
+
+def fmix32_py(x: int) -> int:
+    x &= _M32
+    x ^= x >> 16
+    x = (x * _K2) & _M32
+    x ^= x >> 13
+    x = (x * _K3) & _M32
+    return x ^ (x >> 16)
+
+
+def ident_of(hostname: str, service_id: str) -> int:
+    """The live identity function: FNV-1a 32 over the canonical
+    ``host\\x1fservice-id`` byte string.  This is the ONE mapping from
+    catalog names to digest identities — the bridge's
+    :func:`catalog_idents` reuses it so sim and live bucket the same
+    records identically."""
+    h = 2166136261
+    for b in f"{hostname}\x1f{service_id}".encode("utf-8"):
+        h = ((h ^ b) * 16777619) & _M32
+    return h
+
+
+def live_key(updated: int, status: int) -> int:
+    """The live record's 64-bit packed key: ``(updated << 3) | status``
+    mod 2^64 — the ops/status.py pack formula over the raw nanosecond
+    stamp.  A sim packed int32 IS already in this domain (its tick in
+    the ts field), so ``live_key(tick, status) == pack(tick, status)``
+    whenever the live stamp numerically equals the sim tick."""
+    return ((int(updated) << 3) | (int(status) & 7)) & _M64
+
+
+def record_hash(ident: int, key: int, buckets: int = DEFAULT_BUCKETS):
+    """(bucket, lane0, lane1) of one record — the shared definition in
+    pure Python (the reference implementation the array twins are
+    pinned against)."""
+    ident &= _M32
+    key &= _M64
+    lo = key & _M32
+    hi = key >> 32
+    k = fmix32_py(lo) ^ ((fmix32_py(hi ^ _GOLD) * _K1) & _M32)
+    lane0 = fmix32_py(ident ^ k)
+    lane1 = fmix32_py(((ident + _GOLD) & _M32) ^ ((k * _K1) & _M32))
+    shift = _bucket_shift(buckets)
+    bucket = 0 if shift >= 32 else fmix32_py((ident * _K1) & _M32) >> shift
+    return bucket, lane0, lane1
+
+
+class IncrementalDigest:
+    """The live catalog's digest: O(1) add/remove per record mutation
+    (modular lane sums are invertible), maintained by the
+    ``catalog/state.py`` writer under its lock.  :meth:`value` returns
+    the canonical immutable form — a flat tuple of ``2 * B`` uint32
+    ints, lane-interleaved per bucket, equal across all three twins
+    for the same record multiset."""
+
+    __slots__ = ("buckets", "count", "_lanes")
+
+    def __init__(self, buckets: int = DEFAULT_BUCKETS):
+        _bucket_shift(buckets)
+        self.buckets = buckets
+        self.count = 0
+        self._lanes = [0] * (2 * buckets)
+
+    def add(self, ident: int, key: int) -> None:
+        b, l0, l1 = record_hash(ident, key, self.buckets)
+        i = 2 * b
+        self._lanes[i] = (self._lanes[i] + l0) & _M32
+        self._lanes[i + 1] = (self._lanes[i + 1] + l1) & _M32
+        self.count += 1
+
+    def remove(self, ident: int, key: int) -> None:
+        b, l0, l1 = record_hash(ident, key, self.buckets)
+        i = 2 * b
+        self._lanes[i] = (self._lanes[i] - l0) & _M32
+        self._lanes[i + 1] = (self._lanes[i + 1] - l1) & _M32
+        self.count -= 1
+
+    def value(self) -> tuple:
+        return tuple(self._lanes)
+
+    def hex(self) -> str:
+        return digest_to_hex(self._lanes)
+
+    @classmethod
+    def of(cls, records, buckets: int = DEFAULT_BUCKETS
+           ) -> "IncrementalDigest":
+        """Build from an iterable of ``(ident, key)`` pairs — the
+        recompute-from-scratch path the churn tests pin the
+        incremental path against."""
+        dig = cls(buckets)
+        for ident, key in records:
+            dig.add(ident, key)
+        return dig
+
+
+def digest_value(dig) -> tuple:
+    """Canonical flat tuple from any digest form: a uint32 [B, 2]
+    array (jnp/NumPy twins) or an already-flat sequence."""
+    arr = np.asarray(dig)
+    if arr.ndim == 2:
+        arr = arr.reshape(-1)
+    return tuple(int(v) & _M32 for v in arr)
+
+
+def digest_to_hex(dig) -> str:
+    """Serialize a digest to hex: 16 chars per bucket
+    (``lane0 lane1``, 8 hex chars each) — the push-pull annotation and
+    ``/api/digest.json`` wire form."""
+    return "".join(f"{v:08x}" for v in digest_value(dig))
+
+
+def digest_from_hex(text: str) -> tuple:
+    """Parse :func:`digest_to_hex` output back to the canonical flat
+    tuple; raises ``ValueError`` on malformed input."""
+    if len(text) % 16 or not text:
+        raise ValueError(f"digest hex length {len(text)} not a "
+                         "multiple of 16")
+    return tuple(int(text[i:i + 8], 16) for i in range(0, len(text), 8))
+
+
+def diff_buckets_py(a, b) -> int:
+    """Differing-bucket count between two canonical digests — the live
+    divergence lower bound (CoherenceMonitor's estimator)."""
+    a = digest_value(a)
+    b = digest_value(b)
+    if len(a) != len(b):
+        raise ValueError(f"digest sizes differ: {len(a)} vs {len(b)}")
+    return sum(1 for i in range(0, len(a), 2)
+               if a[i] != b[i] or a[i + 1] != b[i + 1])
+
+
+# -- host-side views ---------------------------------------------------------
+
+def digest_to_dicts(dt: DigestTrace) -> list:
+    """One dict per RECORDED round (padding dropped), with the derived
+    ``agreement`` fraction (agree / alive) alongside the raw columns —
+    the bridge's ``digest.rounds`` stream."""
+    count = int(np.asarray(dt.count))
+    rec = np.asarray(dt.rec)
+    out = []
+    for row in rec[:min(count, rec.shape[0])]:
+        doc = {name: int(row[i]) for i, name in enumerate(DIGEST_FIELDS)}
+        doc["agreement"] = (doc["agree"] / doc["alive"]
+                            if doc["alive"] else 1.0)
+        out.append(doc)
+    return out
+
+
+def summarize_digest(dt: DigestTrace) -> dict:
+    """Compact tail summary (the bench block / report ``final``): last
+    and worst agreement, peak divergence, and the first fully-coherent
+    round (-1 when never reached in the recorded window)."""
+    count = int(np.asarray(dt.count))
+    rec = np.asarray(dt.rec)
+    recorded = rec[:min(count, rec.shape[0])]
+    if recorded.shape[0] == 0:
+        return {"rounds": 0, "truncated": bool(np.asarray(dt.overflow))}
+    alive = np.maximum(recorded[:, DIG_ALIVE], 1)
+    agreement = recorded[:, DIG_AGREE] / alive
+    coherent = np.flatnonzero(recorded[:, DIG_AGREE]
+                              == recorded[:, DIG_ALIVE])
+    return {
+        "rounds": count,
+        "truncated": bool(np.asarray(dt.overflow)),
+        "agreement_last": float(agreement[-1]),
+        "agreement_min": float(agreement.min()),
+        "diff_total_last": int(recorded[-1, DIG_DIFF_TOTAL]),
+        "diff_max_peak": int(recorded[:, DIG_DIFF_MAX].max()),
+        "round_coherent": int(recorded[coherent[0], DIG_ROUND])
+        if coherent.size else -1,
+    }

@@ -51,6 +51,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from sidecar_tpu import metrics
 from sidecar_tpu.models.exact import SimParams, SimState, clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import digest as digest_ops
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import sparse as sparse_ops
@@ -770,6 +771,41 @@ class ShardedSim:
         self.last_sparse_stats = None
         return self._run_trace_jit(state, key, num_rounds, cap)
 
+    def _digest_record(self, nxt: SimState, idents, buckets: int):
+        """One round's coherence record (ops/digest.py): computed at
+        the jit level over the GLOBAL tensors, so GSPMD shards the
+        hash and the segment-sum — the stream is bit-identical to
+        ExactSim's."""
+        return digest_ops.state_digest_record(
+            nxt.round_idx, nxt.known, nxt.node_alive, idents, buckets)
+
+    def _resolve_digest_idents(self, idents):
+        if idents is None:
+            idents = digest_ops.default_idents(self.p.m)
+        return jnp.asarray(idents, jnp.uint32)
+
+    def run_with_digest(self, state: SimState, key: jax.Array,
+                        num_rounds: int, cap: int = 0,
+                        buckets: int = digest_ops.DEFAULT_BUCKETS,
+                        idents=None, donate: bool = True,
+                        start_round=None, sparse=None):
+        """Scan with the per-round coherence digest — the ExactSim
+        contract: ``(final, DigestTrace, conv[num_rounds])`` with the
+        static-cap truncation rule (docs/telemetry.md)."""
+        cap = cap or num_rounds
+        idents = self._resolve_digest_idents(idents)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if self._resolve_sparse_request(sparse):
+            final, dt, conv, stats = self._run_digest_sparse_jit(
+                state, key, num_rounds, cap, idents, buckets)
+            self.last_sparse_stats = stats
+            return final, dt, conv
+        self.last_sparse_stats = None
+        return self._run_digest_jit(state, key, num_rounds, cap, idents,
+                                    buckets)
+
     def run_with_provenance(self, state: SimState, key: jax.Array,
                             num_rounds: int, tracked, cap: int = 0,
                             prov=None, donate: bool = True,
@@ -873,6 +909,40 @@ class ShardedSim:
 
         (final, buf, stats), conv = lax.scan(
             body, (state, trace_ops.zero_trace(cap),
+                   sparse_ops.zero_stats()), None, length=num_rounds)
+        return final, buf, conv, stats
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4, 6),
+                       donate_argnums=1)
+    def _run_digest_jit(self, state, key, num_rounds, cap, idents,
+                        buckets):
+        def body(carry, _):
+            st, buf = carry
+            st2 = self._step(st, jax.random.fold_in(key, st.round_idx))
+            buf = digest_ops.append_digest(
+                buf, self._digest_record(st2, idents, buckets))
+            return (st2, buf), self.convergence(st2)
+
+        (final, buf), conv = lax.scan(
+            body, (state, digest_ops.zero_digest(cap)), None,
+            length=num_rounds)
+        return final, buf, conv
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4, 6),
+                       donate_argnums=1)
+    def _run_digest_sparse_jit(self, state, key, num_rounds, cap,
+                               idents, buckets):
+        def body(carry, _):
+            st, buf, acc = carry
+            st2, s = self._step_sparse(
+                st, jax.random.fold_in(key, st.round_idx))
+            buf = digest_ops.append_digest(
+                buf, self._digest_record(st2, idents, buckets))
+            return (st2, buf, sparse_ops.accumulate_stats(acc, s)), \
+                self.convergence(st2)
+
+        (final, buf, stats), conv = lax.scan(
+            body, (state, digest_ops.zero_digest(cap),
                    sparse_ops.zero_stats()), None, length=num_rounds)
         return final, buf, conv, stats
 

@@ -55,17 +55,22 @@ class QueryEvent:
     (resync-at-latest: the subscriber fell behind, or this is the
     priming event of a fresh subscription).  ``version`` is the hub
     version AFTER applying the event; ``snapshot`` is the catalog at
-    exactly that version.
+    exactly that version.  ``published_ns`` stamps delta events at
+    fan-out time so delivery can account publish-to-deliver lag
+    (``query.hub.lag``); resync markers are built at delivery and
+    carry 0.
     """
 
-    __slots__ = ("kind", "version", "snapshot", "change")
+    __slots__ = ("kind", "version", "snapshot", "change", "published_ns")
 
     def __init__(self, kind: str, version: int,
-                 snapshot: CatalogSnapshot, change=None) -> None:
+                 snapshot: CatalogSnapshot, change=None,
+                 published_ns: int = 0) -> None:
         self.kind = kind
         self.version = version
         self.snapshot = snapshot
         self.change = change
+        self.published_ns = published_ns
 
     def __repr__(self) -> str:  # pragma: no cover — debugging aid
         return f"QueryEvent({self.kind}, v{self.version})"
@@ -84,6 +89,12 @@ class Subscription:
         self._deque: "collections.deque[QueryEvent]" = collections.deque()
         self._pending_snapshot: Optional[CatalogSnapshot] = None
         self._closed = False
+        # Per-subscriber delivery-lag instrumentation (docs/query.md):
+        # how far behind the hub head this consumer's reads run, in
+        # versions and in wall ms — updated at every delta delivery.
+        self.delivered = 0
+        self.last_lag_versions = 0
+        self.last_lag_ms = 0.0
 
     # -- producer side (hub, under the writer path) ------------------------
 
@@ -115,6 +126,7 @@ class Subscription:
         """Next event, or None on timeout / after :meth:`close`.  A
         pending resync marker is delivered before any newer deltas (it
         is always the oldest information the subscriber is missing)."""
+        event = None
         with self._cond:
             if not self._deque and self._pending_snapshot is None \
                     and not self._closed:
@@ -122,10 +134,12 @@ class Subscription:
             if self._pending_snapshot is not None:
                 snap = self._pending_snapshot
                 self._pending_snapshot = None
-                return QueryEvent("snapshot", snap.version, snap)
-            if self._deque:
-                return self._deque.popleft()
-            return None
+                event = QueryEvent("snapshot", snap.version, snap)
+            elif self._deque:
+                event = self._deque.popleft()
+        if event is not None:
+            self._observe_delivery(event)
+        return event
 
     def drain(self) -> list[QueryEvent]:
         """Every immediately-available event (burst coalescing for
@@ -138,7 +152,31 @@ class Subscription:
                 out.append(QueryEvent("snapshot", snap.version, snap))
             while self._deque:
                 out.append(self._deque.popleft())
+        for event in out:
+            self._observe_delivery(event)
         return out
+
+    def _observe_delivery(self, event: QueryEvent) -> None:
+        """Publish-to-deliver lag accounting, OUTSIDE the queue lock
+        (metrics registry has its own).  Version gap = how far the hub
+        head has moved past the event being handed over right now —
+        the subscriber's staleness in catalog versions; ms = wall time
+        the event sat queued.  Only delta events carry a publish stamp
+        (resync markers are built at delivery — their lag is exactly
+        the coalescing they represent, already counted in
+        ``query.hub.dropped``)."""
+        if not event.published_ns:
+            return
+        cur = self._hub._current
+        gap = max(0, (cur.version if cur is not None
+                      else event.version) - event.version)
+        ms = max(0.0, (time.time_ns() - event.published_ns) / 1e6)
+        self.delivered += 1
+        self.last_lag_versions = gap
+        self.last_lag_ms = ms
+        metrics.histogram("query.hub.lag", ms)
+        metrics.histogram("query.hub.lag.versions", gap)
+        self._hub._observe_lag(gap)
 
     def pending(self) -> int:
         with self._cond:
@@ -169,6 +207,15 @@ class QueryHub:
         self._lock = threading.Lock()      # subscriber set + version
         self._subs: list[Subscription] = []
         self._current: Optional[CatalogSnapshot] = None
+        # High-water mark of the delivery version gap across ALL
+        # subscribers — the query.hub.lag.max gauge (reset with the
+        # metrics registry in tests).
+        self._max_lag_versions = 0
+
+    def _observe_lag(self, gap: int) -> None:
+        if gap > self._max_lag_versions:
+            self._max_lag_versions = gap
+        metrics.set_gauge("query.hub.lag.max", self._max_lag_versions)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -249,7 +296,8 @@ class QueryHub:
             subs = list(self._subs)
         metrics.incr("query.hub.published")
         metrics.set_gauge("query.snapshot.version", snap.version)
-        qevent = QueryEvent("delta", snap.version, snap, change=event)
+        qevent = QueryEvent("delta", snap.version, snap, change=event,
+                            published_ns=time.time_ns())
         # The publish hop of the live propagation path: span for the
         # /api/trace causal chain, fan-out latency (all subscriber
         # offers for one version) into the query.hub.fanout histogram —

@@ -1,0 +1,272 @@
+"""Cluster coherence observatory — the live digest aggregation plane.
+
+The sim's digest scan (ops/digest.py, ``run_with_digest``) can compare
+every node's catalog fingerprint against ground truth each round.  A
+live node has no ground truth, but it DOES have the same order-invariant
+digest of its own catalog (``ServicesState`` maintains it incrementally
+under the writer lock) and it learns peers' digests from the annotation
+on every push-pull body (``catalog.state.encode_annotated`` →
+``merge``).  This module turns those observations into the live
+coherence verdicts the sim reports offline:
+
+* **coherence matrix** — pairwise differing-bucket counts between every
+  pair of known hosts (each count lower-bounds the number of records on
+  which the two catalogs diverge — the ops/digest bucket property);
+* **quorum agreement** — the modal digest across hosts and the fraction
+  of hosts carrying it (1.0 = the cluster is coherent as far as this
+  node can see);
+* **diverged estimate** — the summed differing-bucket counts of the
+  non-quorum hosts: a lower bound on how many records the cluster still
+  has to gossip;
+* **time-to-coherence** — when the LOCAL digest changes (a write left
+  coherence), the change is stamped with the catalog clock and the
+  query-plane version; when every known host agrees again the elapsed
+  ms lands in the ``coherence.ttc`` histogram.  This is the live twin
+  of the sim's rounds-to-ε curve, and the quantity the coherence SLO
+  rules bound (telemetry/slo.py: ``p99 <= 2 s``, ``agreement >= 0.99``).
+
+Metrics (docs/metrics.md): ``coherence.observed``,
+``coherence.agreement``, ``coherence.peers``,
+``coherence.diverged.estimate``, ``coherence.ttc``.  Surfaces:
+``GET /api/coherence.json`` (this module's :func:`snapshot`) and the
+``GET /api/coherence`` heat table (web/api.py).
+
+Env contract (docs/env.md):
+
+* ``SIDECAR_TPU_COHERENCE`` — "0" disables the monitor entirely
+  (default on; the hot-path cost is one dict upsert + modal tally per
+  digest publication).
+* ``SIDECAR_TPU_COHERENCE_PEERS`` — max distinct peer digests tracked
+  (default 64).  Beyond the cap new peers are counted in
+  ``overflow_peers``, never silently dropped (the DeltaBatch
+  truncation convention); the local host always fits.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from sidecar_tpu import metrics
+from sidecar_tpu.ops import digest as digest_ops
+
+DEFAULT_MAX_PEERS = 64
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("SIDECAR_TPU_COHERENCE", "1") != "0"
+
+
+def _env_max_peers() -> int:
+    raw = os.environ.get("SIDECAR_TPU_COHERENCE_PEERS", "")
+    try:
+        return max(1, int(raw)) if raw else DEFAULT_MAX_PEERS
+    except ValueError:
+        return DEFAULT_MAX_PEERS
+
+
+class CoherenceMonitor:
+    """Thread-safe per-host digest table + coherence verdict plane."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 max_peers: Optional[int] = None) -> None:
+        self.enabled = _env_enabled() if enabled is None else enabled
+        self.max_peers = _env_max_peers() if max_peers is None \
+            else max_peers
+        self._lock = threading.Lock()
+        # host → {value, buckets, records, seen_ns, local}
+        self._hosts: dict[str, dict] = {}
+        self._local: Optional[str] = None
+        self._overflow = 0
+        # Earliest un-cohered local change: (hub version, clock ns).
+        # Held (not replaced) across further changes so ttc measures
+        # from the FIRST write that left coherence — the sim's
+        # rounds-to-ε convention, not last-write-to-quiet.
+        self._mark: Optional[tuple] = None
+        self._ttc = {"count": 0, "last_ms": None, "max_ms": 0.0,
+                     "version": None}
+
+    # -- observation (writer paths) ----------------------------------------
+
+    def observe(self, host: str, value, *, buckets: int,
+                records: int = 0, local: bool = False,
+                version: int = 0, now_ns: Optional[int] = None) -> None:
+        """Record one host's digest.  ``local=True`` marks this node's
+        own catalog (fed on every writer-side publication); peers come
+        from push-pull annotations.  ``now_ns`` is the CATALOG clock
+        (``ServicesState._now``) so time-to-coherence is deterministic
+        under injected test clocks."""
+        if not self.enabled or not host:
+            return
+        value = digest_ops.digest_value(value)
+        with self._lock:
+            ent = self._hosts.get(host)
+            if ent is None and not local \
+                    and len(self._hosts) >= self.max_peers:
+                self._overflow += 1
+                return
+            changed = ent is None or ent["value"] != value
+            self._hosts[host] = {"value": value, "buckets": int(buckets),
+                                 "records": int(records),
+                                 "seen_ns": now_ns, "local": local}
+            if local:
+                self._local = host
+                if changed and self._mark is None:
+                    self._mark = (int(version), now_ns)
+            metrics.incr("coherence.observed")
+            self._refresh(now_ns)
+
+    def observe_doc(self, host: str, doc,
+                    now_ns: Optional[int] = None) -> bool:
+        """Harvest a peer's wire annotation (the ``"Digest"`` key of a
+        push-pull body: ``{"Buckets", "Records", "Hex"}``).  Returns
+        False — never raises — on a malformed document: annotations
+        come from (same-cluster but untrusted) peers and a shape
+        surprise must not kill the merge loop."""
+        if not self.enabled or not host or not isinstance(doc, dict):
+            return False
+        try:
+            buckets = int(doc["Buckets"])
+            value = digest_ops.digest_from_hex(str(doc["Hex"]))
+            if len(value) != 2 * buckets:
+                return False
+            records = int(doc.get("Records", 0))
+        except (KeyError, TypeError, ValueError, OverflowError):
+            return False
+        self.observe(host, value, buckets=buckets, records=records,
+                     now_ns=now_ns)
+        return True
+
+    # -- verdict plane (under self._lock) ----------------------------------
+
+    def _comparable(self) -> tuple:
+        """Hosts whose digest geometry matches the local one (or the
+        first-seen geometry when no local digest is known yet)."""
+        if not self._hosts:
+            return (), 0
+        ref = self._hosts.get(self._local) if self._local else None
+        buckets = ref["buckets"] if ref else \
+            next(iter(self._hosts.values()))["buckets"]
+        hosts = tuple(sorted(h for h, e in self._hosts.items()
+                             if e["buckets"] == buckets))
+        return hosts, buckets
+
+    def _quorum(self, hosts) -> tuple:
+        """(modal digest value, modal count) over ``hosts``."""
+        tally: dict = {}
+        for h in hosts:
+            v = self._hosts[h]["value"]
+            tally[v] = tally.get(v, 0) + 1
+        # Deterministic tie-break: largest count, then smallest value.
+        value, count = min(tally.items(), key=lambda kv: (-kv[1], kv[0]))
+        return value, count
+
+    def _refresh(self, now_ns: Optional[int]) -> None:
+        hosts, _ = self._comparable()
+        metrics.set_gauge("coherence.peers", len(self._hosts))
+        if not hosts:
+            return
+        quorum, count = self._quorum(hosts)
+        agreement = count / len(hosts)
+        diverged = sum(
+            digest_ops.diff_buckets_py(self._hosts[h]["value"], quorum)
+            for h in hosts if self._hosts[h]["value"] != quorum)
+        metrics.set_gauge("coherence.agreement", agreement)
+        metrics.set_gauge("coherence.diverged.estimate", diverged)
+        if agreement == 1.0 and self._mark is not None:
+            if len(hosts) >= 2:
+                # Coherence regained across actual peers: close the
+                # change window.  A single-host view holds the mark —
+                # agreement-with-nobody is not convergence evidence.
+                version, t0 = self._mark
+                if now_ns is not None and t0 is not None:
+                    ttc_ms = max(0.0, (now_ns - t0) / 1e6)
+                    metrics.histogram("coherence.ttc", ttc_ms)
+                    self._ttc["count"] += 1
+                    self._ttc["last_ms"] = round(ttc_ms, 3)
+                    self._ttc["max_ms"] = max(self._ttc["max_ms"],
+                                              round(ttc_ms, 3))
+                    self._ttc["version"] = version
+                self._mark = None
+
+    # -- read surface -------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The ``/api/coherence.json`` document."""
+        with self._lock:
+            doc: dict = {"enabled": self.enabled,
+                         "max_peers": self.max_peers,
+                         "local": self._local,
+                         "overflow_peers": self._overflow}
+            if not self.enabled:
+                return doc
+            hosts, buckets = self._comparable()
+            doc["buckets"] = buckets
+            doc["hosts"] = {}
+            if hosts:
+                quorum, count = self._quorum(hosts)
+                diffs = {h: digest_ops.diff_buckets_py(
+                    self._hosts[h]["value"], quorum) for h in hosts}
+                for h in hosts:
+                    ent = self._hosts[h]
+                    doc["hosts"][h] = {
+                        "records": ent["records"],
+                        "local": ent["local"],
+                        "agree": diffs[h] == 0,
+                        "diff_vs_quorum": diffs[h],
+                    }
+                doc["quorum"] = {
+                    "hex": digest_ops.digest_to_hex(quorum),
+                    "count": count,
+                    "agreement": round(count / len(hosts), 6),
+                }
+                doc["diverged_estimate"] = sum(diffs.values())
+                doc["matrix"] = {
+                    "hosts": list(hosts),
+                    "diff": [[digest_ops.diff_buckets_py(
+                        self._hosts[a]["value"], self._hosts[b]["value"])
+                        for b in hosts] for a in hosts],
+                }
+            doc["ttc"] = dict(self._ttc)
+            doc["pending_change"] = self._mark is not None
+            return doc
+
+    def reset(self) -> None:
+        """Clear the host table and ttc accounting (tests)."""
+        with self._lock:
+            self._hosts.clear()
+            self._local = None
+            self._overflow = 0
+            self._mark = None
+            self._ttc = {"count": 0, "last_ms": None, "max_ms": 0.0,
+                         "version": None}
+
+
+# The process-global monitor (the propagation-meter convention): the
+# catalog writer publishes local digests through it, merge() feeds peer
+# annotations, /api/coherence reads it.
+monitor = CoherenceMonitor()
+
+
+def configure(enabled: Optional[bool] = None,
+              max_peers: Optional[int] = None) -> None:
+    """Re-read the env gates (or force them) on the global monitor."""
+    monitor.enabled = _env_enabled() if enabled is None else enabled
+    if max_peers is not None:
+        monitor.max_peers = max_peers
+
+
+def observe(host: str, value, *, buckets: int, records: int = 0,
+            local: bool = False, version: int = 0,
+            now_ns: Optional[int] = None) -> None:
+    monitor.observe(host, value, buckets=buckets, records=records,
+                    local=local, version=version, now_ns=now_ns)
+
+
+def observe_doc(host: str, doc, now_ns: Optional[int] = None) -> bool:
+    return monitor.observe_doc(host, doc, now_ns=now_ns)
+
+
+def snapshot() -> dict:
+    return monitor.snapshot()

@@ -20,12 +20,23 @@ Rule syntax (one string per rule): ``"<pctl> <= <threshold> <unit>"``
 with pctl ∈ {p50, p95, p99, max} and unit ∈ {rounds, s, seconds, ms}
 — e.g. ``"p99 <= 12 rounds"``, ``"p95<=1.5s"``.
 
+The coherence plane (telemetry/coherence.py) adds a FLOOR rule form,
+``"agreement >= <fraction>"``, and :meth:`SloEvaluator
+.evaluate_coherence` checks both: percentile rules against the
+``coherence.ttc`` time-to-coherence histogram ("p99 time-to-coherence
+≤ 2 s") and floor rules against the live ``coherence.agreement``
+gauge ("agreement ≥ 0.99").  Coherence verdict gauges are namespaced
+``slo.coherence.<rule>.*`` so a ttc bound never collides with a
+same-shaped propagation bound.
+
 Env contract (docs/env.md):
 
 * ``BENCH_SLO`` — "0" skips SLO evaluation entirely (no verdict
-  block, no gauges).
+  block, no gauges; also gates the coherence rule set).
 * ``BENCH_SLO_RULES`` — comma-separated rule strings replacing the
   defaults (``p99 <= 16 rounds, p99 <= 2 s``).
+* ``BENCH_SLO_COHERENCE_RULES`` — comma-separated coherence rules
+  replacing ``p99 <= 2 s, agreement >= 0.99``.
 """
 
 from __future__ import annotations
@@ -38,43 +49,63 @@ from typing import Optional
 from sidecar_tpu import metrics
 
 DEFAULT_RULES = ("p99 <= 16 rounds", "p99 <= 2 s")
+DEFAULT_COHERENCE_RULES = ("p99 <= 2 s", "agreement >= 0.99")
 
 _RULE_RE = re.compile(
     r"^\s*(p50|p95|p99|max)\s*<=\s*([0-9.]+)\s*"
     r"(rounds?|seconds?|s|ms)\s*$", re.IGNORECASE)
+# Floor form — a LOWER bound on a unitless fraction gauge
+# ("agreement >= 0.99"): the coherence plane's quorum-agreement SLO.
+_FLOOR_RE = re.compile(
+    r"^\s*(agreement)\s*>=\s*([0-9.]+)\s*$", re.IGNORECASE)
 
 
 @dataclasses.dataclass(frozen=True)
 class SloRule:
-    """One declarative bound on a lag percentile."""
+    """One declarative bound: a lag-percentile ceiling (``<=``) or a
+    fraction floor (``>=``)."""
 
-    percentile: str          # p50 | p95 | p99 | max
+    percentile: str          # p50 | p95 | p99 | max | agreement
     threshold: float         # in `unit`
-    unit: str                # "rounds" | "s" | "ms"
+    unit: str                # "rounds" | "s" | "ms" | "fraction"
+    direction: str = "<="    # "<=" ceiling | ">=" floor
 
     @classmethod
     def parse(cls, text: str) -> "SloRule":
         m = _RULE_RE.match(text)
-        if not m:
-            raise ValueError(
-                f"bad SLO rule {text!r}: expected "
-                "'<p50|p95|p99|max> <= <threshold> <rounds|s|ms>'")
-        pctl, raw, unit = m.group(1).lower(), m.group(2), \
-            m.group(3).lower()
-        unit = {"round": "rounds", "rounds": "rounds", "s": "s",
-                "second": "s", "seconds": "s", "ms": "ms"}[unit]
-        return cls(percentile=pctl, threshold=float(raw), unit=unit)
+        if m:
+            pctl, raw, unit = m.group(1).lower(), m.group(2), \
+                m.group(3).lower()
+            unit = {"round": "rounds", "rounds": "rounds", "s": "s",
+                    "second": "s", "seconds": "s", "ms": "ms"}[unit]
+            return cls(percentile=pctl, threshold=float(raw), unit=unit)
+        m = _FLOOR_RE.match(text)
+        if m:
+            return cls(percentile=m.group(1).lower(),
+                       threshold=float(m.group(2)), unit="fraction",
+                       direction=">=")
+        raise ValueError(
+            f"bad SLO rule {text!r}: expected "
+            "'<p50|p95|p99|max> <= <threshold> <rounds|s|ms>' or "
+            "'agreement >= <fraction>'")
 
     @property
     def key(self) -> str:
         """The metric-name fragment: ``slo.<key>.ok`` /
         ``slo.<key>.observed``."""
         thr = f"{self.threshold:g}".replace(".", "_")
-        return f"{self.percentile}_{thr}{self.unit}"
+        suffix = "" if self.unit == "fraction" else self.unit
+        return f"{self.percentile}_{thr}{suffix}"
 
     def text(self) -> str:
+        if self.direction == ">=":
+            return f"{self.percentile} >= {self.threshold:g}"
         return (f"{self.percentile} lag <= {self.threshold:g} "
                 f"{self.unit}")
+
+    def check(self, observed: float) -> bool:
+        return observed >= self.threshold if self.direction == ">=" \
+            else observed <= self.threshold
 
 
 def _threshold_seconds(rule: SloRule) -> float:
@@ -101,6 +132,19 @@ class SloEvaluator:
                  if r] or list(DEFAULT_RULES)
         return cls(rules)
 
+    @classmethod
+    def coherence_from_env(cls) -> Optional["SloEvaluator"]:
+        """The coherence rule set (``BENCH_SLO`` gate,
+        ``BENCH_SLO_COHERENCE_RULES`` override): the evaluator
+        :meth:`evaluate_coherence` runs — "p99 time-to-coherence ≤
+        2 s" and "agreement ≥ 0.99" by default."""
+        if os.environ.get("BENCH_SLO", "1") == "0":
+            return None
+        raw = os.environ.get("BENCH_SLO_COHERENCE_RULES", "")
+        rules = [r for r in (p.strip() for p in raw.split(","))
+                 if r] or list(DEFAULT_COHERENCE_RULES)
+        return cls(rules)
+
     # -- evaluation ---------------------------------------------------------
 
     def evaluate_lag(self, lag: Optional[dict],
@@ -115,7 +159,7 @@ class SloEvaluator:
         verdicts = []
         for rule in self.rules:
             observed = None
-            if lag and lag.get("samples"):
+            if rule.direction == "<=" and lag and lag.get("samples"):
                 rounds_v = lag.get(rule.percentile)
                 if rounds_v is not None:
                     if rule.unit == "rounds":
@@ -137,7 +181,8 @@ class SloEvaluator:
         verdicts = []
         for rule in self.rules:
             observed = None
-            if rule.unit != "rounds" and h and h.get("count"):
+            if rule.direction == "<=" and rule.unit != "rounds" \
+                    and h and h.get("count"):
                 pct_ms = h.get(f"{rule.percentile}_ms") \
                     if rule.percentile != "max" else h.get("max_ms")
                 if pct_ms is not None:
@@ -147,15 +192,51 @@ class SloEvaluator:
             verdicts.append(self._verdict(rule, observed, ok, publish))
         return self._block(verdicts)
 
+    def evaluate_coherence(self, publish: bool = True) -> dict:
+        """Verdict block for the coherence plane
+        (telemetry/coherence.py): percentile rules (s/ms) bound the
+        ``coherence.ttc`` time-to-coherence histogram; floor rules
+        (``agreement >= f``) bound the live ``coherence.agreement``
+        gauge.  Rounds rules are sim-only and report null, as does any
+        rule whose signal has no observations yet — an unevaluable
+        rule never passes silently.  Gauges land under
+        ``slo.coherence.<rule>.*``."""
+        snap = metrics.snapshot()
+        h = snap.get("histograms", {}).get("coherence.ttc")
+        gauges = snap.get("gauges", {})
+        verdicts = []
+        for rule in self.rules:
+            observed = None
+            thr = rule.threshold
+            if rule.direction == ">=":
+                g = gauges.get("coherence.agreement")
+                if g is not None:
+                    observed = float(g)
+            elif rule.unit != "rounds" and h and h.get("count"):
+                pct_ms = h.get(f"{rule.percentile}_ms") \
+                    if rule.percentile != "max" else h.get("max_ms")
+                if pct_ms is not None:
+                    observed = float(pct_ms) / 1e3
+                    thr = _threshold_seconds(rule)
+            ok = None if observed is None else (
+                observed >= thr if rule.direction == ">="
+                else observed <= thr)
+            verdicts.append(self._verdict(rule, observed, ok, publish,
+                                          prefix="coherence."))
+        return self._block(verdicts)
+
     def _verdict(self, rule: SloRule, observed, ok,
-                 publish: bool) -> dict:
+                 publish: bool, prefix: str = "") -> dict:
         if publish and ok is not None:
-            metrics.set_gauge(f"slo.{rule.key}.observed", observed)
-            metrics.set_gauge(f"slo.{rule.key}.ok", 1.0 if ok else 0.0)
+            metrics.set_gauge(f"slo.{prefix}{rule.key}.observed",
+                              observed)
+            metrics.set_gauge(f"slo.{prefix}{rule.key}.ok",
+                              1.0 if ok else 0.0)
         return {"rule": rule.text(),
                 "percentile": rule.percentile,
                 "threshold": rule.threshold,
                 "unit": rule.unit,
+                "direction": rule.direction,
                 "observed": observed,
                 "pass": ok}
 

@@ -278,9 +278,14 @@ class GossipTransport:
 
     def _push_local_state(self) -> None:
         """Refresh the engine's LocalState snapshot
-        (services_delegate.go:146-151)."""
+        (services_delegate.go:146-151).  The push-pull body carries the
+        coherence-digest annotation (catalog/state.encode_annotated —
+        Go peers ignore the extra key); plain encode() is the fallback
+        for bare state doubles in tests."""
         if self.state is not None and self._handle is not None:
-            data = self.state.encode()
+            enc = getattr(self.state, "encode_annotated", None) \
+                or self.state.encode
+            data = enc()
             self._lib.st_set_local_state(self._handle, data, len(data))
 
     # Engine stats order (native/transport.cc Transport::stats).  An

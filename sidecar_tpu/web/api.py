@@ -23,6 +23,11 @@ Routes (http.go:64-76, http_api.go:35-45):
                                     percentiles + SLO verdicts
                                     (telemetry/propagation.py)
   GET  /api/propagation             human-readable lag table
+  GET  /api/digest.json             local catalog coherence digest
+                                    (ops/digest.py live twin; lock-free)
+  GET  /api/coherence.json          cluster digest-agreement view + SLO
+                                    verdicts (telemetry/coherence.py)
+  GET  /api/coherence               human-readable coherence heat table
   GET  /api/debug/profile           live sampling CPU profile (pprof analog)
   GET  /api/haproxy/stats.csv       relay of the managed HAProxy's stats CSV
   GET  /api/damping.json            flap-damper penalties + suppressed set
@@ -209,6 +214,12 @@ class SidecarApi:
             return self.propagation_dump()
         if parts == ["propagation"]:
             return self.propagation_page()
+        if parts == ["digest.json"]:
+            return self.digest_dump()
+        if parts == ["coherence.json"]:
+            return self.coherence_dump()
+        if parts == ["coherence"]:
+            return self.coherence_page()
         if parts == ["damping.json"] or parts == ["damping"]:
             return self.damping_dump()
         if parts == ["debug", "stacks"]:
@@ -443,6 +454,94 @@ class SidecarApi:
             "<tr><th>site</th><th>origin</th><th>count</th>"
             "<th>p50</th><th>p95</th><th>p99</th><th>max</th></tr>"
             + "".join(rows) + "</table>"
+        ).encode()
+        return 200, "text/html", body, CORS_HEADERS
+
+    def digest_dump(self):
+        """The local catalog's coherence digest
+        (``GET /api/digest.json`` — ops/digest.py live twin): the same
+        ``{"Buckets", "Records", "Hex"}`` document the push-pull
+        annotation carries.  Lock-free: one immutable-snapshot read,
+        the coherence plane's read-path contract."""
+        doc_fn = getattr(self.state, "digest_doc", None)
+        if doc_fn is None:
+            return self._json(200, {"enabled": False})
+        return self._json(200, doc_fn())
+
+    def coherence_dump(self):
+        """Cluster coherence view (``GET /api/coherence.json`` —
+        telemetry/coherence.py): per-host digest agreement, the quorum
+        digest, the pairwise differing-bucket matrix (each entry
+        lower-bounds the records diverged between that host pair),
+        the diverged-record estimate, and time-to-coherence — plus the
+        coherence-SLO verdicts when an evaluator is attached
+        (``state.slo_evaluator``, telemetry/slo.py)."""
+        from sidecar_tpu.telemetry import coherence
+
+        doc = coherence.snapshot()
+        slo = getattr(self.state, "slo_evaluator", None)
+        if slo is not None and doc.get("enabled"):
+            doc["slo"] = slo.evaluate_coherence()
+        return self._json(200, doc)
+
+    def coherence_page(self):
+        """Auto-refreshing human view of the coherence monitor
+        (``GET /api/coherence`` — the /api/propagation convention):
+        one summary row per host, then the pairwise differing-bucket
+        matrix as a compact heat table (0 = the pair agrees; darker =
+        more buckets — at least that many records — apart)."""
+        from sidecar_tpu.telemetry import coherence
+
+        doc = coherence.snapshot()
+        if not doc.get("enabled"):
+            return (200, "text/html",
+                    b"<h3>Coherence monitor disabled "
+                    b"(SIDECAR_TPU_COHERENCE=0)</h3>", CORS_HEADERS)
+        rows = []
+        for host, ent in sorted(doc.get("hosts", {}).items()):
+            mark = " (local)" if ent["local"] else ""
+            rows.append(
+                f"<tr><td>{host}{mark}</td><td>{ent['records']}</td>"
+                f"<td>{'yes' if ent['agree'] else 'no'}</td>"
+                f"<td>{ent['diff_vs_quorum']}</td></tr>")
+        quorum = doc.get("quorum") or {}
+        matrix = doc.get("matrix") or {}
+        hosts = matrix.get("hosts") or []
+        heat = []
+        if hosts:
+            heat.append("<tr><th></th>" + "".join(
+                f"<th>{h}</th>" for h in hosts) + "</tr>")
+            buckets = max(1, doc.get("buckets") or 1)
+            for a, row in zip(hosts, matrix.get("diff") or []):
+                cells = []
+                for d in row:
+                    # Heat shading: white at 0 diverging to red as the
+                    # differing-bucket count approaches the full width.
+                    frac = min(1.0, d / buckets)
+                    g = int(255 - 195 * frac)
+                    cells.append(
+                        f"<td style=\"background:rgb(255,{g},{g})\">"
+                        f"{d}</td>")
+                heat.append(f"<tr><th>{a}</th>" + "".join(cells)
+                            + "</tr>")
+        ttc = doc.get("ttc") or {}
+        body = (
+            "\n\t\t\t<head>\n\t\t\t<meta http-equiv=\"refresh\" "
+            "content=\"4\">\n\t\t\t</head>\n\t\t\t"
+            "<h3>Cluster coherence — catalog digest agreement</h3>"
+            f"<p>agreement: <b>{quorum.get('agreement', 'n/a')}</b>"
+            f" &nbsp; diverged-record estimate (lower bound): "
+            f"<b>{doc.get('diverged_estimate', 'n/a')}</b>"
+            f" &nbsp; time-to-coherence: last "
+            f"{ttc.get('last_ms', 'n/a')} ms over {ttc.get('count', 0)}"
+            " changes</p>"
+            "\n<table border=1 cellpadding=4>"
+            "<tr><th>host</th><th>records</th><th>quorum?</th>"
+            "<th>diff buckets</th></tr>"
+            + "".join(rows) + "</table>"
+            "<h4>Pairwise differing buckets</h4>"
+            "\n<table border=1 cellpadding=4>"
+            + "".join(heat) + "</table>"
         ).encode()
         return 200, "text/html", body, CORS_HEADERS
 

@@ -1,0 +1,438 @@
+"""PR 15 live coherence surfaces (docs/telemetry.md):
+
+* the ``CoherenceMonitor`` verdict plane (telemetry/coherence.py) —
+  quorum agreement, the pairwise differing-bucket matrix, the
+  diverged-record estimate, peer-cap overflow accounting, geometry
+  filtering, wire-annotation harvesting, and time-to-coherence under
+  an injected clock;
+* the coherence SLO rules (telemetry/slo.py) — the ``agreement >= f``
+  floor form, pass/fail verdicts against the ``coherence.ttc``
+  histogram and ``coherence.agreement`` gauge, and the null-verdict
+  contract for unevaluable or out-of-plane rules;
+* QueryHub per-subscriber delivery-lag instrumentation (query/hub.py);
+* the wiring: push-pull annotation → ``merge`` harvest → the global
+  monitor, and the web exposition (``/api/digest.json``,
+  ``/api/coherence.json``, ``/api/coherence``).
+"""
+
+import json
+
+import pytest
+
+from sidecar_tpu import metrics
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog import ServicesState, decode
+from sidecar_tpu.ops import digest as digest_ops
+from sidecar_tpu.telemetry import coherence
+from sidecar_tpu.telemetry.coherence import CoherenceMonitor
+from sidecar_tpu.telemetry.slo import SloEvaluator, SloRule
+from sidecar_tpu.web.api import SidecarApi
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+
+B = digest_ops.DEFAULT_BUCKETS
+
+
+def _value(pairs):
+    return digest_ops.IncrementalDigest.of(pairs).value()
+
+
+V1 = _value([(1, 8), (2, 16)])
+V2 = _value([(1, 8), (2, 16), (3, 24)])   # V1 plus one extra record
+
+
+class TestMonitor:
+    def test_unanimous_cluster(self):
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        m.observe("h1", V1, buckets=B, records=2, local=True, now_ns=0)
+        m.observe("h2", V1, buckets=B, records=2, now_ns=1)
+        m.observe("h3", V1, buckets=B, records=2, now_ns=2)
+        doc = m.snapshot()
+        assert doc["quorum"]["agreement"] == 1.0
+        assert doc["quorum"]["count"] == 3
+        assert doc["diverged_estimate"] == 0
+        assert all(ent["agree"] for ent in doc["hosts"].values())
+        assert all(d == 0 for row in doc["matrix"]["diff"] for d in row)
+        assert doc["local"] == "h1"
+        assert doc["hosts"]["h1"]["local"] is True
+
+    def test_divergent_peer(self):
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        m.observe("h1", V1, buckets=B, records=2, local=True, now_ns=0)
+        m.observe("h2", V1, buckets=B, records=2, now_ns=1)
+        m.observe("h3", V2, buckets=B, records=3, now_ns=2)
+        doc = m.snapshot()
+        assert doc["quorum"]["agreement"] == round(2 / 3, 6)
+        assert doc["hosts"]["h3"]["agree"] is False
+        diff = doc["hosts"]["h3"]["diff_vs_quorum"]
+        # One extra record diverges at most one bucket (lower bound).
+        assert diff == 1
+        assert doc["diverged_estimate"] == diff
+        hosts = doc["matrix"]["hosts"]
+        mat = doc["matrix"]["diff"]
+        for i in range(len(hosts)):
+            assert mat[i][i] == 0
+            for j in range(len(hosts)):
+                assert mat[i][j] == mat[j][i]
+        i3 = hosts.index("h3")
+        assert mat[i3][hosts.index("h1")] == diff
+
+    def test_quorum_tie_break_deterministic(self):
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        m.observe("h1", V1, buckets=B, local=True, now_ns=0)
+        m.observe("h2", V2, buckets=B, now_ns=1)
+        doc = m.snapshot()
+        # 1-vs-1 tie: the smaller digest value wins, deterministically.
+        assert doc["quorum"]["hex"] == \
+            digest_ops.digest_to_hex(min(V1, V2))
+        assert doc["quorum"]["agreement"] == 0.5
+
+    def test_peer_cap_overflow_counted(self):
+        m = CoherenceMonitor(enabled=True, max_peers=1)
+        m.observe("h2", V1, buckets=B, now_ns=0)
+        # The local host ALWAYS fits, even past the cap.
+        m.observe("h1", V1, buckets=B, local=True, now_ns=1)
+        m.observe("h3", V1, buckets=B, now_ns=2)   # over the cap
+        doc = m.snapshot()
+        assert doc["overflow_peers"] == 1
+        assert "h3" not in doc["hosts"]
+        assert {"h1", "h2"} <= set(doc["hosts"])
+
+    def test_geometry_mismatch_excluded(self):
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        m.observe("h1", V1, buckets=B, local=True, now_ns=0)
+        m.observe("h2", _value([(1, 8)])[: 2 * 32],
+                  buckets=32, now_ns=1)
+        doc = m.snapshot()
+        # h2's 32-bucket digest is incomparable with the local 64.
+        assert doc["buckets"] == B
+        assert "h2" not in doc["hosts"]
+        assert "h1" in doc["hosts"]
+
+    def test_observe_doc_wire_round_trip(self):
+        state = ServicesState(hostname="h9")
+        state.set_clock(lambda: 1000)
+        state.add_service_entry(S.Service(
+            id="s1", name="app", image="i:1", hostname="h9",
+            updated=5, status=S.ALIVE))
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        assert m.observe_doc("h9", state.digest_doc(), now_ns=0)
+        ent = m._hosts["h9"]
+        assert ent["value"] == state.digest_snapshot[1]
+        assert ent["records"] == 1
+
+    def test_observe_doc_malformed_never_raises(self):
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        good_hex = digest_ops.digest_to_hex(V1)
+        bad = [
+            None,
+            "not a dict",
+            {},
+            {"Buckets": B},                       # no Hex
+            {"Buckets": B, "Hex": "zz" * 8 * B},  # non-hex chars
+            {"Buckets": B, "Hex": "abc"},         # bad length
+            {"Buckets": 32, "Hex": good_hex},     # hex/buckets mismatch
+            {"Buckets": "many", "Hex": good_hex},
+        ]
+        for doc in bad:
+            assert m.observe_doc("h2", doc, now_ns=0) is False
+        assert m._hosts == {}
+        assert m.observe_doc("h2", {"Buckets": B, "Records": 2,
+                                    "Hex": good_hex}, now_ns=0)
+
+    def test_time_to_coherence(self):
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        t0 = 5_000_000_000
+        t1 = 7_500_000_000
+        m.observe("h1", V1, buckets=B, local=True, version=7,
+                  now_ns=t0)
+        # Single-host view: agreement-with-nobody holds the mark open.
+        assert m.snapshot()["pending_change"] is True
+        assert m.snapshot()["ttc"]["count"] == 0
+        m.observe("h2", V1, buckets=B, now_ns=t1)
+        doc = m.snapshot()
+        assert doc["pending_change"] is False
+        assert doc["ttc"]["count"] == 1
+        assert doc["ttc"]["last_ms"] == 2500.0
+        assert doc["ttc"]["version"] == 7
+
+    def test_mark_measures_from_first_change(self):
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        t0 = 1_000_000_000
+        m.observe("h1", V1, buckets=B, local=True, version=1,
+                  now_ns=t0)
+        # A second local change does NOT restart the window.
+        m.observe("h1", V2, buckets=B, local=True, version=2,
+                  now_ns=t0 + 500_000_000)
+        m.observe("h2", V2, buckets=B, now_ns=t0 + 2_000_000_000)
+        doc = m.snapshot()
+        assert doc["ttc"]["last_ms"] == 2000.0
+        assert doc["ttc"]["version"] == 1
+
+    def test_disagreement_keeps_window_open(self):
+        m = CoherenceMonitor(enabled=True, max_peers=8)
+        m.observe("h1", V1, buckets=B, local=True, version=1, now_ns=0)
+        m.observe("h2", V2, buckets=B, now_ns=10)
+        doc = m.snapshot()
+        assert doc["pending_change"] is True
+        assert doc["ttc"]["count"] == 0
+
+    def test_disabled_monitor_is_inert(self):
+        m = CoherenceMonitor(enabled=False)
+        m.observe("h1", V1, buckets=B, local=True, now_ns=0)
+        assert m.observe_doc("h2", {"Buckets": B, "Hex":
+                                    digest_ops.digest_to_hex(V1)}) \
+            is False
+        doc = m.snapshot()
+        assert doc["enabled"] is False
+        assert "hosts" not in doc
+
+
+class TestSloCoherence:
+    def test_parse_agreement_floor(self):
+        rule = SloRule.parse("agreement >= 0.99")
+        assert rule.direction == ">="
+        assert rule.unit == "fraction"
+        assert rule.percentile == "agreement"
+        assert rule.key == "agreement_0_99"
+        assert rule.text() == "agreement >= 0.99"
+        assert rule.check(1.0) and not rule.check(0.9)
+
+    def test_evaluate_coherence_pass(self, monkeypatch):
+        monkeypatch.setattr(
+            "sidecar_tpu.metrics.snapshot",
+            lambda: {"histograms": {"coherence.ttc": {
+                "count": 3, "p99_ms": 1500.0, "max_ms": 1800.0}},
+                "gauges": {"coherence.agreement": 1.0}})
+        ev = SloEvaluator(["p99 <= 2 s", "agreement >= 0.99"])
+        block = ev.evaluate_coherence(publish=False)
+        assert block["pass"] is True and block["evaluated"] == 2
+        assert block["rules"][0]["observed"] == 1.5
+        assert block["rules"][1]["observed"] == 1.0
+        assert block["rules"][1]["direction"] == ">="
+
+    def test_evaluate_coherence_fail_publishes_verdicts(self,
+                                                        monkeypatch):
+        monkeypatch.setattr(
+            "sidecar_tpu.metrics.snapshot",
+            lambda: {"histograms": {"coherence.ttc": {
+                "count": 3, "p99_ms": 2500.0, "max_ms": 2600.0}},
+                "gauges": {"coherence.agreement": 0.9}})
+        published = {}
+        monkeypatch.setattr("sidecar_tpu.metrics.set_gauge",
+                            lambda name, v: published.__setitem__(
+                                name, v))
+        ev = SloEvaluator(["p99 <= 2 s", "agreement >= 0.99"])
+        block = ev.evaluate_coherence()
+        assert block["pass"] is False
+        assert all(v["pass"] is False for v in block["rules"])
+        assert published["slo.coherence.p99_2s.ok"] == 0.0
+        assert published["slo.coherence.agreement_0_99.ok"] == 0.0
+        assert published["slo.coherence.agreement_0_99.observed"] == 0.9
+
+    def test_unevaluable_rules_report_null(self, monkeypatch):
+        monkeypatch.setattr("sidecar_tpu.metrics.snapshot", lambda: {})
+        ev = SloEvaluator(["p99 <= 2 s", "agreement >= 0.99"])
+        block = ev.evaluate_coherence(publish=False)
+        assert block["evaluated"] == 0
+        assert block["pass"] is None
+        assert all(v["pass"] is None for v in block["rules"])
+
+    def test_floor_rule_is_null_in_lag_planes(self, monkeypatch):
+        ev = SloEvaluator(["agreement >= 0.99"])
+        block = ev.evaluate_lag({"samples": 5, "p99": 3.0},
+                                publish=False)
+        assert block["rules"][0]["pass"] is None
+        monkeypatch.setattr(
+            "sidecar_tpu.metrics.snapshot",
+            lambda: {"histograms": {"propagation.query.lag": {
+                "count": 4, "p99_ms": 100.0, "max_ms": 120.0}}})
+        block = ev.evaluate_live(publish=False)
+        assert block["rules"][0]["pass"] is None
+
+
+def _hist_count(name):
+    return metrics.snapshot()["histograms"].get(name, {}).get("count", 0)
+
+
+class TestHubLag:
+    def _state(self):
+        state = ServicesState(hostname="h1")
+        state.set_clock(lambda: T0)
+        state.add_service_entry(S.Service(
+            id="seed", name="web", image="img:1", hostname="h1",
+            updated=T0, status=S.ALIVE))
+        return state
+
+    def test_delivery_lag_instrumented(self):
+        state = self._state()
+        hub = state.query_hub()
+        sub = hub.subscribe("watcher")
+        sub.drain()   # consume the prime snapshot (no publish stamp)
+        assert sub.delivered == 0
+        base_ms = _hist_count("query.hub.lag")
+        base_gap = _hist_count("query.hub.lag.versions")
+        state.add_service_entry(S.Service(
+            id="aaa", name="web", image="img:2", hostname="h1",
+            updated=T0 + NS, status=S.ALIVE))
+        events = sub.drain()
+        assert [e.kind for e in events] == ["delta"]
+        assert sub.delivered == 1
+        assert sub.last_lag_versions == 0   # head hasn't moved past it
+        assert sub.last_lag_ms >= 0.0
+        assert _hist_count("query.hub.lag") == base_ms + 1
+        assert _hist_count("query.hub.lag.versions") == base_gap + 1
+        assert "query.hub.lag.max" in metrics.snapshot()["gauges"]
+        sub.close()
+
+    def test_version_gap_high_water_mark(self):
+        state = self._state()
+        hub = state.query_hub()
+        sub = hub.subscribe("slowpoke")
+        sub.drain()
+        for i in range(3):
+            state.add_service_entry(S.Service(
+                id=f"svc{i}", name="web", image="img:1", hostname="h1",
+                updated=T0 + (i + 1) * NS, status=S.ALIVE))
+        events = sub.drain()
+        assert len(events) == 3 and sub.delivered == 3
+        # The first delta was delivered 2 versions behind the head.
+        assert metrics.snapshot()["gauges"]["query.hub.lag.max"] >= 2
+        assert sub.last_lag_versions == 0   # caught up by the last one
+        sub.close()
+
+
+def _mk_state(hostname, n_svc=2):
+    state = ServicesState(hostname=hostname)
+    state.set_clock(lambda: T0)
+    for i in range(n_svc):
+        state.add_service_entry(S.Service(
+            id=f"{hostname}-s{i}", name="app", image="i:1",
+            hostname=hostname, updated=T0 + i, status=S.ALIVE))
+    return state
+
+
+class TestLiveWiring:
+    def setup_method(self):
+        coherence.monitor.reset()
+        coherence.configure(enabled=True)
+
+    def teardown_method(self):
+        coherence.monitor.reset()
+        coherence.configure()
+
+    def test_merge_harvests_peer_annotation(self):
+        h1 = _mk_state("h1")
+        h2 = _mk_state("h2", n_svc=3)
+        wire = h2.encode_annotated()
+        coherence.monitor.reset()   # only the harvest below shows
+        other = decode(wire)
+        assert other.wire_digest == h2.digest_doc()
+        h1.merge(other)
+        hosts = coherence.snapshot()["hosts"]
+        assert "h2" in hosts
+        assert hosts["h2"]["records"] == 3
+        # The annotation IS the digest: the monitor's h2 entry equals
+        # the sender's published snapshot byte for byte.
+        assert coherence.monitor._hosts["h2"]["value"] == \
+            h2.digest_snapshot[1]
+
+    def test_plain_wire_peer_stays_unobserved(self):
+        # A Go peer sends no annotation, and decode() deliberately
+        # leaves the decoded state's incremental digest EMPTY (only
+        # the writer maintains one) — so the merge harvests nothing
+        # rather than inventing a digest the peer never published.
+        h1 = _mk_state("h1")
+        h2 = _mk_state("h2")
+        other = decode(h2.encode())
+        assert other.wire_digest is None
+        assert other.digest_snapshot[0] == 0
+        coherence.monitor.reset()
+        h1.merge(other)
+        assert "h2" not in coherence.snapshot()["hosts"]
+
+    def test_in_process_merge_uses_live_snapshot(self):
+        # Merging an in-process state (no wire hop): the fallback
+        # reads the peer's LIVE digest snapshot.
+        h1 = _mk_state("h1")
+        h2 = _mk_state("h2", n_svc=3)
+        coherence.monitor.reset()
+        h1.merge(h2)
+        hosts = coherence.snapshot()["hosts"]
+        assert "h2" in hosts and hosts["h2"]["records"] == 3
+
+    def test_local_writes_feed_monitor(self):
+        state = _mk_state("h1")
+        doc = coherence.snapshot()
+        assert doc["local"] == "h1"
+        assert doc["hosts"]["h1"]["records"] == 2
+        assert state.digest_snapshot[0] == 2
+
+
+def make_api(**kw):
+    state = ServicesState(hostname="h1")
+    state.set_clock(lambda: T0)
+    for key, val in kw.items():
+        setattr(state, key, val)
+    state.add_service_entry(S.Service(
+        id="aaa111", name="web", image="img:1", hostname="h1",
+        updated=T0, status=S.ALIVE))
+    return SidecarApi(state, members_fn=lambda: ["h1"],
+                      cluster_name="test-cluster")
+
+
+class TestWebSurfaces:
+    def setup_method(self):
+        coherence.monitor.reset()
+        coherence.configure(enabled=True)
+
+    def teardown_method(self):
+        coherence.monitor.reset()
+        coherence.configure()
+
+    def test_digest_json(self):
+        api = make_api()
+        status, ctype, body, _ = api.dispatch("GET", "/api/digest.json")
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["Buckets"] == B
+        assert doc["Records"] == 1
+        assert digest_ops.digest_from_hex(doc["Hex"]) == \
+            api.state.digest_snapshot[1]
+
+    def test_coherence_json(self):
+        api = make_api()
+        _, _, body, _ = api.dispatch("GET", "/api/coherence.json")
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert doc["hosts"]["h1"]["local"] is True
+        assert doc["quorum"]["agreement"] == 1.0
+        assert "slo" not in doc   # no evaluator attached
+
+    def test_coherence_json_with_slo_block(self, monkeypatch):
+        monkeypatch.setattr(
+            "sidecar_tpu.metrics.snapshot",
+            lambda: {"gauges": {"coherence.agreement": 1.0}})
+        api = make_api(slo_evaluator=SloEvaluator(["agreement >= 0.99"]))
+        _, _, body, _ = api.dispatch("GET", "/api/coherence.json")
+        doc = json.loads(body)
+        assert doc["slo"]["pass"] is True
+
+    def test_coherence_page(self):
+        api = make_api()
+        status, ctype, body, _ = api.dispatch("GET", "/api/coherence")
+        assert status == 200 and ctype.startswith("text/html")
+        text = body.decode()
+        assert "Cluster coherence — catalog digest agreement" in text
+        assert "h1" in text
+
+    def test_disabled_convention(self):
+        coherence.configure(enabled=False)
+        api = make_api()
+        _, _, body, _ = api.dispatch("GET", "/api/coherence.json")
+        assert json.loads(body) == {
+            "enabled": False, "max_peers": coherence.monitor.max_peers,
+            "local": None, "overflow_peers": 0}
+        _, _, page, _ = api.dispatch("GET", "/api/coherence")
+        assert b"disabled" in page

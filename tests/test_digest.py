@@ -1,0 +1,487 @@
+"""Order-invariant catalog digests (ops/digest.py, docs/telemetry.md):
+the ONE fingerprint definition shared by the simulator scan, the NumPy
+oracle, and the live catalog writer.  The acceptance pins:
+
+* **Twin byte-identity** — the jnp path, the NumPy oracle, and the
+  pure-Python ``IncrementalDigest`` produce byte-equal digests for the
+  same record multiset, and the REAL ``ServicesState`` writer path
+  lands on the same bytes when live ``updated`` stamps numerically
+  equal sim ticks.
+* **Incremental == recomputed** — the live digest maintained through
+  add / supersede / in-place tombstone / +1 s restamp / GC churn
+  matches a from-scratch rebuild after every mutation.
+* **Digest-off non-perturbation** — ``run_with_digest`` rides the
+  identical trajectory as the plain drivers on all four model families
+  (single-chip exact + compressed, both sharded twins at
+  d ∈ {1, 2, 4, 8}), so digest-off dispatches stay bit-identical to
+  pre-digest programs (the TestDefenseOffBitIdentity pattern).
+* **Curve == oracle replay** — the in-scan divergence curve of a
+  chaotic (seed-6, lossy, cold-start) run equals a per-round NumPy
+  replay bucket for bucket.
+* **Lock-free reads** — ``digest_doc`` never takes ``state._lock``.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from sidecar_tpu import service as S
+from sidecar_tpu.bridge import SimBridge
+from sidecar_tpu.catalog import ServicesState, decode
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import digest as digest_ops
+from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops.status import ALIVE, TOMBSTONE, pack
+from sidecar_tpu.parallel.mesh import make_mesh
+
+from tests.test_sharded import DetShardedSim, det_sample_peers
+from tests.test_sharded_compressed import (
+    DET,
+    DetShardedCompressedSim,
+    assert_states_equal,
+)
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+DS = (1, 2, 4, 8)
+
+DET_DENSE = TimeConfig(refresh_interval_s=1000.0,
+                       push_pull_interval_s=1e6, sweep_interval_s=1.0)
+
+# A small mixed-status catalog, shared by the twin-identity tests:
+# (hostname, service id, tick, status).  Ticks double as the live
+# ``updated`` stamps so live_key(tick, status) == pack(tick, status).
+RECORDS = (
+    ("h1", "web-1", 5, ALIVE),
+    ("h1", "web-2", 9, ALIVE),
+    ("h2", "web-1", 7, TOMBSTONE),
+    ("h2", "db-1", 12, ALIVE),
+    ("h3", "cache", 3, ALIVE),
+)
+
+
+def _oracle(records, buckets=digest_ops.DEFAULT_BUCKETS):
+    idents = [digest_ops.ident_of(h, s) for h, s, _, _ in records]
+    keys = [int(pack(t, st)) for _, _, t, st in records]
+    return digest_ops.digest_np(idents, keys, buckets)
+
+
+class TestRecordHashTwins:
+    def test_buckets_must_be_power_of_two(self):
+        for bad in (0, 3, 48, -2):
+            with pytest.raises(ValueError, match="power of two"):
+                digest_ops.IncrementalDigest(bad)
+
+    def test_three_twins_byte_equal(self):
+        oracle = _oracle(RECORDS)
+        # jnp path: one belief row holding the packed keys at the
+        # record slots, idents from the live identity function.
+        idents = np.asarray(
+            [digest_ops.ident_of(h, s) for h, s, _, _ in RECORDS],
+            np.uint32)
+        packed = np.asarray([[int(pack(t, st))
+                              for _, _, t, st in RECORDS]], np.int32)
+        jnp_dig = np.asarray(digest_ops.node_digests(
+            packed, idents, digest_ops.DEFAULT_BUCKETS))[0]
+        # pure-Python incremental path.
+        inc = digest_ops.IncrementalDigest.of(
+            (digest_ops.ident_of(h, s), digest_ops.live_key(t, st))
+            for h, s, t, st in RECORDS)
+        val = digest_ops.digest_value(oracle)
+        assert digest_ops.digest_value(jnp_dig) == val
+        assert inc.value() == val
+        assert inc.count == len(RECORDS)
+
+    def test_order_invariant(self):
+        fwd = digest_ops.IncrementalDigest.of(
+            (digest_ops.ident_of(h, s), digest_ops.live_key(t, st))
+            for h, s, t, st in RECORDS)
+        rev = digest_ops.IncrementalDigest.of(
+            (digest_ops.ident_of(h, s), digest_ops.live_key(t, st))
+            for h, s, t, st in reversed(RECORDS))
+        assert fwd.value() == rev.value()
+
+    def test_remove_inverts_add(self):
+        dig = digest_ops.IncrementalDigest.of(
+            (digest_ops.ident_of(h, s), digest_ops.live_key(t, st))
+            for h, s, t, st in RECORDS)
+        h, s, t, st = RECORDS[2]
+        dig.remove(digest_ops.ident_of(h, s), digest_ops.live_key(t, st))
+        rest = digest_ops.IncrementalDigest.of(
+            (digest_ops.ident_of(a, b), digest_ops.live_key(c, d))
+            for a, b, c, d in RECORDS[:2] + RECORDS[3:])
+        assert dig.value() == rest.value()
+        assert dig.count == len(RECORDS) - 1
+
+    def test_hex_round_trip(self):
+        dig = digest_ops.IncrementalDigest.of(
+            (digest_ops.ident_of(h, s), digest_ops.live_key(t, st))
+            for h, s, t, st in RECORDS)
+        assert digest_ops.digest_from_hex(dig.hex()) == dig.value()
+        assert len(dig.hex()) == 16 * dig.buckets
+        with pytest.raises(ValueError, match="not a"):
+            digest_ops.digest_from_hex("abc")
+        with pytest.raises(ValueError):
+            digest_ops.digest_from_hex("")
+
+    def test_diff_buckets_lower_bounds_divergence(self):
+        base = _oracle(RECORDS)
+        for k in (1, 2, 3):
+            churned = list(RECORDS)
+            for i in range(k):   # k records advance one tick
+                h, s, t, st = churned[i]
+                churned[i] = (h, s, t + 1, st)
+            diff = digest_ops.diff_buckets_py(base, _oracle(churned))
+            assert 1 <= diff <= k
+        assert digest_ops.diff_buckets_py(base, base) == 0
+
+    def test_diff_buckets_size_mismatch(self):
+        with pytest.raises(ValueError, match="sizes differ"):
+            digest_ops.diff_buckets_py(
+                _oracle(RECORDS, 64), _oracle(RECORDS, 32))
+
+    def test_live_key_matches_sim_pack(self):
+        for tick, st in ((1, ALIVE), (77, TOMBSTONE), (500, ALIVE)):
+            assert digest_ops.live_key(tick, st) == int(pack(tick, st))
+
+    def test_catalog_idents_use_live_identity(self):
+        pairs = [("h1", "a"), ("h2", "b")]
+        got = digest_ops.catalog_idents(pairs)
+        assert got.tolist() == [digest_ops.ident_of(h, s)
+                                for h, s in pairs]
+
+
+class TestLiveWriterByteIdentity:
+    """The cross-plane pin: identical catalog contents through the REAL
+    ``ServicesState`` writer path, the NumPy oracle, and the jnp path
+    yield byte-identical digests."""
+
+    def _live_state(self):
+        state = ServicesState(hostname="h1")
+        # A tiny clock keeps tick-scale ``updated`` stamps un-stale.
+        state.set_clock(lambda: 1000)
+        for h, s, t, st in RECORDS:
+            state.add_service_entry(S.Service(
+                id=s, name="app", image="i:1", hostname=h,
+                updated=t, status=st))
+        return state
+
+    def test_sim_live_oracle_agree(self):
+        state = self._live_state()
+        count, value = state.digest_snapshot
+        assert count == len(RECORDS)
+        assert value == digest_ops.digest_value(_oracle(RECORDS))
+        idents = np.asarray(
+            [digest_ops.ident_of(h, s) for h, s, _, _ in RECORDS],
+            np.uint32)
+        packed = np.asarray([[int(pack(t, st))
+                              for _, _, t, st in RECORDS]], np.int32)
+        jnp_dig = np.asarray(digest_ops.node_digests(
+            packed, idents, digest_ops.DEFAULT_BUCKETS))[0]
+        assert digest_ops.digest_value(jnp_dig) == value
+
+    def test_digest_doc_wire_round_trip(self):
+        state = self._live_state()
+        doc = state.digest_doc()
+        assert doc["Records"] == len(RECORDS)
+        assert doc["Buckets"] == digest_ops.DEFAULT_BUCKETS
+        assert digest_ops.digest_from_hex(doc["Hex"]) == \
+            state.digest_snapshot[1]
+
+    def test_encode_stays_go_pure_annotated_carries_digest(self):
+        state = self._live_state()
+        assert b'"Digest"' not in state.encode()
+        back = decode(state.encode_annotated())
+        assert back.wire_digest == state.digest_doc()
+        # The annotated body still decodes to the same catalog.
+        assert decode(state.encode()).wire_digest is None
+
+    def test_lock_free_read_path(self):
+        """``digest_doc`` (the /api/digest.json + push-pull annotation
+        read) must not acquire ``state._lock`` — pinned by reading
+        while another thread holds the writer lock."""
+        state = self._live_state()
+        hold = threading.Event()
+        release = threading.Event()
+
+        def writer():
+            with state._lock:
+                hold.set()
+                release.wait(timeout=5)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        assert hold.wait(timeout=5)
+        out: list = []
+        reader = threading.Thread(
+            target=lambda: out.append(state.digest_doc()), daemon=True)
+        reader.start()
+        reader.join(timeout=1.0)
+        locked_out = reader.is_alive()
+        release.set()
+        t.join(timeout=5)
+        assert not locked_out, "digest_doc blocked on state._lock"
+        assert out and out[0]["Records"] == len(RECORDS)
+
+
+class TestIncrementalVsRecomputed:
+    """The live digest maintained through every writer-path mutation
+    equals a from-scratch rebuild of the surviving records."""
+
+    @staticmethod
+    def _recompute(state):
+        return digest_ops.IncrementalDigest.of(
+            (digest_ops.ident_of(svc.hostname, svc.id),
+             digest_ops.live_key(svc.updated, svc.status))
+            for server in state.servers.values()
+            for svc in server.services.values())
+
+    def _check(self, state, phase):
+        ref = self._recompute(state)
+        assert state._digest.value() == ref.value(), phase
+        assert state._digest.count == ref.count, phase
+        # The published snapshot tracks the incremental digest.
+        count, value = state.digest_snapshot
+        assert (count, value) == (ref.count, ref.value()), phase
+
+    def test_add_supersede_tombstone_expire_gc(self):
+        clock = {"now": T0}
+        state = ServicesState(hostname="h1")
+        state.set_clock(lambda: clock["now"])
+        for hi, host in enumerate(("h1", "h2", "h3")):
+            for si in range(3):
+                state.add_service_entry(S.Service(
+                    id=f"{host}-s{si}", name="app", image="i:1",
+                    hostname=host, updated=T0 + hi * NS + si,
+                    status=S.ALIVE))
+        self._check(state, "adds")
+
+        # LWW supersede (replace-in-dict path).
+        state.add_service_entry(S.Service(
+            id="h2-s0", name="app", image="i:2", hostname="h2",
+            updated=T0 + 30 * NS, status=S.ALIVE))
+        self._check(state, "supersede")
+
+        # Stale arrival: rejected, digest untouched.
+        before = state._digest.value()
+        state.add_service_entry(S.Service(
+            id="h2-s0", name="app", image="i:1", hostname="h2",
+            updated=T0 - 10 * NS, status=S.ALIVE))
+        assert state._digest.value() == before
+        self._check(state, "stale-reject")
+
+        # Dead-node expiry: in-place tombstone restamp per record.
+        state.expire_server("h3")
+        self._check(state, "expire_server")
+
+        # Discovery-driven tombstone (tombstone + double announce).
+        state.tombstone_services("h1", [
+            S.Service(id="h1-s0", name="app", image="i:1",
+                      hostname="h1", updated=T0, status=S.ALIVE)])
+        self._check(state, "tombstone_services")
+
+        # Lifespan sweep: +1 s-rule tombstones for expired ALIVE rows.
+        clock["now"] = T0 + int((S.ALIVE_LIFESPAN + 5) * NS)
+        state.tombstone_others_services()
+        self._check(state, "lifespan-sweep")
+
+        # GC: 3 h-old tombstones drop out entirely.
+        clock["now"] = T0 + int((S.TOMBSTONE_LIFESPAN + 120) * NS)
+        state.tombstone_others_services()
+        self._check(state, "tombstone-gc")
+
+
+@pytest.fixture
+def det_peers(monkeypatch):
+    monkeypatch.setattr(gossip_ops, "sample_peers", det_sample_peers)
+
+
+class TestDigestOffBitIdentity:
+    """``run_with_digest`` must ride the exact trajectory of the plain
+    drivers (same per-round fold_in keys; the digest columns only READ
+    the post-round state) — pinned per family, sharded twins at every
+    d, the TestDefenseOffBitIdentity pattern.  This is the regression
+    pin behind the bench block's rounds-to-ε ratio of 1.0."""
+
+    ROUNDS = 8
+
+    def test_exact(self):
+        params = SimParams(n=16, services_per_node=2, fanout=2,
+                           budget=4, drop_prob=0.3)
+        sim = ExactSim(params, topology.complete(16), DET_DENSE)
+        st = sim.init_state()
+        key = jax.random.PRNGKey(3)
+        plain, conv = sim.run(st, key, self.ROUNDS, donate=False)
+        dug, dt, dconv = sim.run_with_digest(st, key, self.ROUNDS,
+                                             donate=False)
+        np.testing.assert_array_equal(np.asarray(plain.known),
+                                      np.asarray(dug.known))
+        np.testing.assert_array_equal(np.asarray(plain.sent),
+                                      np.asarray(dug.sent))
+        np.testing.assert_array_equal(np.asarray(conv),
+                                      np.asarray(dconv))
+        assert int(dt.count) == self.ROUNDS
+
+    def _compressed_run(self, sim, digest=False):
+        rng = np.random.default_rng(7)
+        slots = np.sort(rng.choice(sim.p.m, size=5,
+                                   replace=False)).astype(np.int32)
+        st = sim.mint(sim.init_state(), slots, 7)
+        key = jax.random.PRNGKey(11)
+        if digest:
+            return sim.run_with_digest(st, key, self.ROUNDS,
+                                       cap=self.ROUNDS, donate=False,
+                                       sparse=False)
+        final, _conv = sim.run(st, key, self.ROUNDS, donate=False,
+                               sparse=False)
+        return final
+
+    def test_compressed(self, det_peers):
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        sim = CompressedSim(params, topology.complete(16), DET)
+        ref = self._compressed_run(sim)
+        got, dt = self._compressed_run(sim, digest=True)
+        assert_states_equal(ref, got, "compressed digest-on")
+        assert int(dt.count) == self.ROUNDS
+
+    def test_sharded_dense_by_d(self, det_peers):
+        params = SimParams(n=16, services_per_node=2, fanout=2,
+                           budget=4)
+        exact = ExactSim(params, topology.complete(16), DET_DENSE)
+        st0 = exact.init_state()
+        key = jax.random.PRNGKey(5)
+        ref, _ = exact.run(st0, key, self.ROUNDS, donate=False)
+        for d in DS:
+            sharded = DetShardedSim(
+                params, topology.complete(16), DET_DENSE,
+                mesh=make_mesh(jax.devices()[:d]))
+            got, dt, _conv = sharded.run_with_digest(
+                sharded.init_state(), key, self.ROUNDS, donate=False)
+            np.testing.assert_array_equal(
+                np.asarray(ref.known), np.asarray(got.known),
+                err_msg=f"known d={d}")
+            assert int(dt.count) == self.ROUNDS, f"d={d}"
+
+    @pytest.mark.pallas
+    def test_sharded_compressed_by_d(self, det_peers, monkeypatch):
+        monkeypatch.setenv(kernel_ops.ENV_VAR, "pallas")
+        params = CompressedParams(n=16, services_per_node=2, fanout=2,
+                                  budget=4, cache_lines=32)
+        single = CompressedSim(params, topology.complete(16), DET)
+        ref = self._compressed_run(single)
+        for d in DS:
+            sharded = DetShardedCompressedSim(
+                params, topology.complete(16), DET,
+                mesh=make_mesh(jax.devices()[:d]))
+            got, dt = self._compressed_run(sharded, digest=True)
+            assert_states_equal(ref, got, f"sharded-compressed d={d}")
+            assert int(dt.count) == self.ROUNDS, f"d={d}"
+
+
+class TestDigestTrace:
+    def test_cap_truncates_with_overflow(self):
+        params = SimParams(n=8, services_per_node=2, fanout=2, budget=4)
+        sim = ExactSim(params, topology.complete(8), DET_DENSE)
+        _f, dt, _c = sim.run_with_digest(sim.init_state(),
+                                         jax.random.PRNGKey(0), 6,
+                                         cap=3, donate=False)
+        assert int(dt.count) == 6
+        assert bool(dt.overflow)
+        assert dt.rec.shape == (3, digest_ops.DIGEST_WIDTH)
+        summary = digest_ops.summarize_digest(dt)
+        assert summary["truncated"] and summary["rounds"] == 6
+
+    def test_summary_and_dicts(self):
+        params = SimParams(n=8, services_per_node=2, fanout=3, budget=8)
+        sim = ExactSim(params, topology.complete(8), DET_DENSE)
+        _f, dt, _c = sim.run_with_digest(
+            sim.init_state(), jax.random.PRNGKey(1), 12, donate=False)
+        rounds = digest_ops.digest_to_dicts(dt)
+        assert len(rounds) == 12
+        assert set(rounds[0]) == set(digest_ops.DIGEST_FIELDS) | \
+            {"agreement"}
+        summary = digest_ops.summarize_digest(dt)
+        # A warm-started complete graph reaches coherence well inside
+        # 12 rounds; the summary must name the round.
+        assert summary["agreement_last"] == 1.0
+        assert summary["round_coherent"] >= 0
+
+    def test_divergence_curve_matches_oracle_replay(self):
+        """The chaos acceptance pin: a seed-6 lossy cold-start run's
+        in-scan divergence curve equals a per-round NumPy oracle
+        replay, bucket count for bucket count."""
+        params = SimParams(n=12, services_per_node=2, fanout=2,
+                           budget=3, drop_prob=0.3)
+        sim = ExactSim(params, topology.complete(12), DET_DENSE)
+        rounds = 10
+        base = jax.random.PRNGKey(6)
+        _f, dt, _c = sim.run_with_digest(sim.init_state(), base,
+                                         rounds, donate=False)
+        rec = np.asarray(dt.rec)
+        idents = digest_ops.default_idents(params.m)
+        st = sim.init_state()
+        for i in range(rounds):
+            st = sim.step(st, jax.random.fold_in(base, i))
+            known = np.asarray(st.known)
+            alive = np.asarray(st.node_alive)
+            digs = digest_ops.node_digests_np(
+                known, idents, digest_ops.DEFAULT_BUCKETS)
+            truth = np.where(alive[:, None], known, 0).max(
+                axis=0, keepdims=True)
+            ref = digest_ops.node_digests_np(
+                truth, idents, digest_ops.DEFAULT_BUCKETS)[0]
+            diffs = digest_ops.diff_counts_np(digs, ref)
+            assert rec[i, digest_ops.DIG_DIFF_TOTAL] == \
+                int(diffs[alive].sum()), f"round {i + 1}"
+            assert rec[i, digest_ops.DIG_DIFF_MAX] == \
+                int(diffs[alive].max()), f"round {i + 1}"
+            assert rec[i, digest_ops.DIG_AGREE] == \
+                int(((diffs == 0) & alive).sum()), f"round {i + 1}"
+
+
+class TestBridgeDigest:
+    CFG = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=2.0)
+
+    def _state(self):
+        state = ServicesState(hostname="h1")
+        state.set_clock(lambda: T0)
+        for hi, host in enumerate(("h1", "h2", "h3")):
+            for si in range(2):
+                state.add_service_entry(S.Service(
+                    id=f"{host}-svc{si}", name=f"app{si}", image="i:1",
+                    hostname=host, updated=T0 + hi * NS + si,
+                    status=S.ALIVE))
+        return state
+
+    def test_digest_block_shape(self):
+        report = SimBridge(self._state(), self.CFG).simulate(
+            rounds=8, digest=4)
+        doc = report.digest
+        assert doc["requested"] == 4
+        assert doc["buckets"] == digest_ops.DEFAULT_BUCKETS
+        assert len(doc["rounds"]) == 4
+        final = doc["final"]
+        # Warm snapshot: everyone already agrees with the truth.
+        assert final["agreement"] == 1.0
+        assert final["diff_total"] == 0
+        assert digest_ops.digest_from_hex(final["quorum_hex"])
+        assert set(final["node_diff_buckets"]) == {"h1", "h2", "h3"}
+
+    def test_digest_mutual_exclusions(self):
+        bridge = SimBridge(self._state(), self.CFG)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            bridge.simulate(rounds=4, digest=2, trace=2)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            bridge.simulate(rounds=4, digest=2, deltas_cap=2)
+
+    def test_digest_buckets_validated(self):
+        bridge = SimBridge(self._state(), self.CFG)
+        with pytest.raises(ValueError, match="power of two"):
+            bridge.simulate(rounds=4, digest=2, digest_buckets=5)

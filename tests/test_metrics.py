@@ -82,13 +82,17 @@ class TestCatalogTimers:
             id="aaa111", name="web", image="w:1", hostname="h1",
             updated=T0, status=S.ALIVE,
             ports=[S.Port("tcp", 32768, 8080, "10.0.0.1")]))
-        # Admission emits the propagation-lag histogram (PR 11) before
-        # the timer — drain both datagrams.
-        grams = drain(statsd, min_count=2)
+        # Admission emits the propagation-lag histogram (PR 11) and the
+        # coherence-digest observations (PR 15: coherence.observed /
+        # .peers / .agreement / .diverged.estimate) around the timer —
+        # drain the whole burst.
+        grams = drain(statsd, min_count=6)
         assert any(g.startswith("sidecar.addServiceEntry:")
                    and g.endswith("|ms") for g in grams)
         assert any(g.startswith("sidecar.propagation.catalog.lag:")
                    and g.endswith("|ms") for g in grams)
+        assert any(g.startswith("sidecar.coherence.observed:")
+                   and g.endswith("|c") for g in grams)
         assert metrics.snapshot()["timers"]["addServiceEntry"]["count"] >= 1
 
 

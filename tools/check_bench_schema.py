@@ -50,6 +50,7 @@ KNOWN_RESULT_BLOCKS = {
     "adversary": dict,
     "sweep": dict,
     "topology": dict,
+    "coherence": dict,
     "cost": dict,
     "regression": dict,
     "telemetry": dict,
@@ -95,6 +96,21 @@ def validate_result(doc: dict, issues: List[str],
         for key in ("programs", "reconciliation"):
             if key in cost and not isinstance(cost[key], dict):
                 issues.append(f"{ctx}: cost.{key} is not an object")
+    if isinstance(doc.get("coherence"), dict):
+        coh = doc["coherence"]
+        for key in ("digest_off", "digest_on", "live"):
+            if key in coh and not isinstance(coh[key], dict):
+                issues.append(
+                    f"{ctx}: coherence.{key} is not an object")
+        if "bit_identical" in coh \
+                and not isinstance(coh["bit_identical"], bool):
+            issues.append(
+                f"{ctx}: coherence.bit_identical is not a bool")
+        ratio = coh.get("rounds_to_eps_ratio")
+        if ratio is not None and not isinstance(ratio, NUMBER):
+            issues.append(
+                f"{ctx}: coherence.rounds_to_eps_ratio is neither "
+                "null nor a number")
 
 
 def validate_error(doc: dict, issues: List[str],
