@@ -874,6 +874,33 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# coherence bench failed: {exc}", file=sys.stderr)
 
+    # Anti-entropy heal block (benchmarks/antientropy.py,
+    # docs/antientropy.md): a config6-style partition healed full-body
+    # vs digest-directed — measured session bytes and heal wall-clock
+    # on two live catalogs, plus the cluster-scale byte model over the
+    # chaos twin's digest trace priced with the live-measured
+    # constants.  BENCH_ANTIENTROPY=0 skips it;
+    # BENCH_ANTIENTROPY_NODES / BENCH_ANTIENTROPY_ROUNDS size the sim,
+    # BENCH_ANTIENTROPY_CATALOG / BENCH_ANTIENTROPY_DIVERGED the live
+    # pair.
+    antientropy_block = None
+    if os.environ.get("BENCH_ANTIENTROPY", "1") != "0":
+        try:
+            from benchmarks.antientropy import run_antientropy_bench
+            _watchdog_note("antientropy")
+            antientropy_block = run_antientropy_bench(
+                n=int(os.environ.get("BENCH_ANTIENTROPY_NODES", "64")),
+                rounds=int(os.environ.get("BENCH_ANTIENTROPY_ROUNDS",
+                                          "120")),
+                catalog=int(os.environ.get("BENCH_ANTIENTROPY_CATALOG",
+                                           "1500")),
+                diverged=int(os.environ.get("BENCH_ANTIENTROPY_DIVERGED",
+                                            "30")))
+            _watchdog_note("antientropy",
+                           {"antientropy": antientropy_block})
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# antientropy bench failed: {exc}", file=sys.stderr)
+
     # Kernel-cost observatory block (sidecar_tpu/telemetry/cost.py,
     # docs/perf.md): per-phase attribution + compile/HBM telemetry for
     # the single-chip families, reconciled against the measured
@@ -922,6 +949,8 @@ def main() -> None:
         **({"sweep": sweep} if sweep else {}),
         **({"topology": topology_block} if topology_block else {}),
         **({"coherence": coherence_block} if coherence_block else {}),
+        **({"antientropy": antientropy_block}
+           if antientropy_block else {}),
         **({"cost": cost_block} if cost_block else {}),
         "telemetry": telemetry,
     }

@@ -1,0 +1,269 @@
+"""Anti-entropy heal bench block (bench.py ``antientropy`` key).
+
+The claim docs/antientropy.md makes, measured twice:
+
+1. **Live twin (measured bytes)** — two real ``ServicesState``
+   catalogs diverged by a partition-shaped delta heal two ways from
+   identical starting pairs: the full-body push-pull exchange (both
+   annotated catalogs cross the wire, the pre-ladder status quo) and a
+   digest-directed ``ReconcileSession`` (Merkle-ladder walk, then only
+   the records in differing leaf buckets).  Both must land on
+   byte-identical digests; the block reports the measured JSON bytes
+   and wall-clock of each, so ``bytes_ratio`` (full/digest, the ≥ 5×
+   acceptance bar) and ``heal_time_ratio`` (digest/full, the ≤ 1.10
+   bar) are real measurements, not estimates.
+
+2. **Sim twin (cluster-scale extrapolation)** — one config6-style
+   asymmetric partition (full cut rounds [10, 40) plus 20% A→B loss
+   for the whole run, churn on side A only, mid-partition) through
+   ``ChaosExactSim.run_with_digest``.  The digest trace gives the
+   per-round diverged-bucket counts and the heal round; the byte model
+   prices each post-heal session both ways — full body = the whole
+   catalog in both directions, digest-directed = the ladder walk plus
+   the diverged records — using the *live twin's measured* per-record
+   and per-bucket byte costs, so the sim ratio extrapolates measured
+   constants rather than inventing them.  Digest direction changes
+   which BYTES carry the records, never which records arrive (the
+   full body is a superset of every divergent record), so the
+   heal-round trajectory is shared and the sim heal-time ratio is 1.0
+   by construction — reported null, never a silent pass, if the heal
+   never completes inside the horizon.
+
+Env contract (docs/env.md): ``BENCH_ANTIENTROPY=0`` skips the block;
+``BENCH_ANTIENTROPY_NODES`` (default 64) sizes the sim cluster,
+``BENCH_ANTIENTROPY_ROUNDS`` (default 120) its horizon,
+``BENCH_ANTIENTROPY_CATALOG`` (default 1500) the live catalog size and
+``BENCH_ANTIENTROPY_DIVERGED`` (default 30) the live divergence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import jax
+import numpy as np
+
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog.state import ServicesState
+from sidecar_tpu.models.exact import SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import digest as digest_ops
+from sidecar_tpu.ops import topology as topo_mod
+from sidecar_tpu.transport.antientropy import (AntiEntropyResponder,
+                                               LoopbackChannel,
+                                               ReconcileSession,
+                                               SessionConfig, merge_body)
+
+NS = S.NS_PER_SECOND
+_T_BASE = 1_700_000_000 * NS
+
+
+# -- live twin ---------------------------------------------------------------
+
+def _build_pair(catalog: int, diverged: int):
+    """One partition-shaped divergence: ``catalog`` shared records both
+    sides agree on, plus ``diverged`` records split 2:1 across the cut
+    (churn landed mostly on side A — the config6 asymmetry).  Built
+    fresh per measurement so the full-body and digest-directed heals
+    start from identical pairs."""
+    a = ServicesState(hostname="side-a")
+    b = ServicesState(hostname="side-b")
+    for st in (a, b):
+        st.set_clock(lambda: _T_BASE + 3600 * NS)
+    for i in range(catalog):
+        svc = S.Service(id=f"svc{i}", name=f"app{i % 40}",
+                        image=f"img:{i % 7}", hostname=f"host{i % 64}",
+                        updated=_T_BASE + i, status=S.ALIVE)
+        a.add_service_entry(svc)
+        b.add_service_entry(svc)
+    cut_a = (2 * diverged) // 3
+    for i in range(diverged):
+        svc = S.Service(id=f"churn{i}", name="churned",
+                        image="img:new", hostname=f"host{i % 64}",
+                        updated=_T_BASE + catalog + i, status=S.ALIVE)
+        (a if i < cut_a else b).add_service_entry(svc)
+    return a, b
+
+
+def _heal_full(a: ServicesState, b: ServicesState) -> dict:
+    """The status-quo heal: both annotated catalogs cross the wire and
+    both sides merge the other's body whole."""
+    t0 = time.perf_counter()
+    doc_a = a.encode_annotated()
+    doc_b = b.encode_annotated()
+    merge_body(b, json.loads(doc_a))
+    merge_body(a, json.loads(doc_b))
+    wall = time.perf_counter() - t0
+    return {
+        "bytes": len(doc_a) + len(doc_b),
+        "wall_s": round(wall, 6),
+        "coherent": a.digest_snapshot == b.digest_snapshot,
+    }
+
+
+def _heal_digest(a: ServicesState, b: ServicesState) -> dict:
+    """The ladder heal: one ``ReconcileSession`` over a loopback
+    channel — hello, narrowing levels, then only the records in
+    differing leaf buckets, both directions."""
+    chan = LoopbackChannel(AntiEntropyResponder(b))
+    t0 = time.perf_counter()
+    rep = ReconcileSession(a, chan, config=SessionConfig(),
+                           enabled=True).run()
+    wall = time.perf_counter() - t0
+    return {
+        "bytes": rep.total_bytes,
+        "digest_bytes": rep.digest_bytes,
+        "record_bytes": rep.record_bytes,
+        "records_moved": rep.records_sent + rep.records_received,
+        "levels_walked": rep.levels_walked,
+        "mode": rep.mode,
+        "wall_s": round(wall, 6),
+        "coherent": bool(rep.coherent)
+        and a.digest_snapshot == b.digest_snapshot,
+    }
+
+
+def _live_twin(catalog: int, diverged: int) -> dict:
+    full = _heal_full(*_build_pair(catalog, diverged))
+    digest = _heal_digest(*_build_pair(catalog, diverged))
+    ok = full["coherent"] and digest["coherent"] \
+        and digest["mode"] == "digest"
+    return {
+        "catalog": catalog, "diverged": diverged,
+        "full": full, "digest": digest,
+        "bytes_ratio": round(full["bytes"] / digest["bytes"], 2)
+        if ok and digest["bytes"] else None,
+        "heal_time_ratio": round(digest["wall_s"] / full["wall_s"], 4)
+        if ok and full["wall_s"] > 0 else None,
+    }
+
+
+# -- sim twin ----------------------------------------------------------------
+
+def _sim_twin(n: int, rounds: int, rec_bytes: float,
+              bucket_hdr_bytes: float, seed: int = 6) -> dict:
+    """config6-shaped partition → heal on the exact chaos model, byte
+    model priced with the live twin's measured constants."""
+    from sidecar_tpu.chaos import ChaosExactSim, EdgeFault, FaultPlan
+    from sidecar_tpu.ops.status import ALIVE as _ALIVE
+    from sidecar_tpu.ops.status import TOMBSTONE as _TOMB
+    from sidecar_tpu.ops.status import pack as _pack
+    from sidecar_tpu.ops.status import unpack_status as _ust
+    from sidecar_tpu.ops.status import unpack_ts as _uts
+
+    import jax.numpy as jnp
+
+    n = max(16, n - n % 2)
+    spn = 4
+    split_at, lift_at = 10, 40
+    side_a = tuple(range(n // 2))
+    side_b = tuple(range(n // 2, n))
+    plan = FaultPlan(
+        seed=seed,
+        edges=(EdgeFault(src=side_a, dst=side_b, drop_prob=0.2),),
+    ).with_edges(*FaultPlan.partition(side_a, side_b, split_at, lift_at))
+
+    params = SimParams(n=n, services_per_node=spn, fanout=3, budget=15)
+    cfg = TimeConfig(refresh_interval_s=10_000.0, push_pull_interval_s=2.0)
+
+    # Side-A-only churn mid-partition (config6's asymmetry): the heal
+    # must carry the backlog across the cut.
+    def perturb(state, key, now):
+        round_idx = now // cfg.round_ticks
+        active = (round_idx >= split_at + 5) & (round_idx < lift_at - 5)
+        owner = jnp.arange(params.m, dtype=jnp.int32) // spn
+        cols = jnp.arange(params.m, dtype=jnp.int32)
+        churn = jax.random.bernoulli(key, 0.02 / spn, (params.m,))
+        own = state.known[owner, cols]
+        flip = churn & active & (owner < (n // 2)) & (_uts(own) > 0) & \
+            state.node_alive[owner]
+        st = _ust(own)
+        new_val = jnp.where(
+            flip, _pack(now, jnp.where(st == _ALIVE, _TOMB, _ALIVE)), own)
+        known = state.known.at[owner, cols].set(new_val)
+        reset = jnp.where(flip, owner, params.n)
+        sent = state.sent.at[reset, cols].set(jnp.int8(0), mode="drop")
+        return dataclasses.replace(state, known=known, sent=sent)
+
+    sim = ChaosExactSim(params, topo_mod.complete(n), cfg, plan=plan,
+                        perturb=perturb)
+    _, dt, _ = sim.run_with_digest(sim.init_state(),
+                                   jax.random.PRNGKey(seed), rounds,
+                                   cap=rounds)
+    rec = np.asarray(dt.rec)[:min(int(np.asarray(dt.count)), rounds)]
+    rnds = rec[:, digest_ops.DIG_ROUND]
+    alive = np.maximum(rec[:, digest_ops.DIG_ALIVE], 1)
+    diff_total = rec[:, digest_ops.DIG_DIFF_TOTAL]
+    coherent = (rec[:, digest_ops.DIG_AGREE] == rec[:, digest_ops.DIG_ALIVE])
+    post = np.flatnonzero(coherent & (rnds >= lift_at))
+    heal_round = int(rnds[post[0]]) if post.size else None
+
+    # Byte model over the heal window [lift, heal]: one push-pull
+    # session per alive node per round (the pp cadence at this cfg).
+    # Full body ships the whole catalog both ways; digest-directed
+    # ships the level-0 ladder + one narrowing header per differing
+    # bucket per level + the diverged records (diff_total is the
+    # digest plane's documented per-round diverged lower bound).
+    # rec_bytes / bucket_hdr_bytes come MEASURED from the live twin.
+    depth = digest_ops.DEFAULT_LADDER_DEPTH
+    base = digest_ops.DEFAULT_BUCKETS
+    full_bytes = digest_bytes = 0.0
+    if heal_round is not None:
+        window = (rnds >= lift_at) & (rnds <= heal_round)
+        for a_r, d_r in zip(alive[window], diff_total[window]):
+            sessions = float(a_r)
+            full_bytes += sessions * 2 * params.m * rec_bytes
+            digest_bytes += sessions * 2 * base * bucket_hdr_bytes
+            digest_bytes += float(d_r) * depth * bucket_hdr_bytes
+            digest_bytes += 2.0 * float(d_r) * rec_bytes
+    return {
+        "n": n, "spn": spn, "rounds": rounds,
+        "partition": [split_at, lift_at],
+        "heal_round": heal_round,
+        "heal_rounds_after_lift": (heal_round - lift_at
+                                   if heal_round is not None else None),
+        "diff_peak": int(diff_total.max()) if diff_total.size else 0,
+        "full_bytes_model": int(full_bytes),
+        "digest_bytes_model": int(digest_bytes),
+        "bytes_ratio": round(full_bytes / digest_bytes, 2)
+        if heal_round is not None and digest_bytes > 0 else None,
+        # Same records arrive either way (the full body is a superset
+        # of the divergence), so the heal-round trajectory is shared:
+        # 1.0 by construction, null if the heal never lands.
+        "heal_time_ratio": 1.0 if heal_round is not None else None,
+    }
+
+
+# -- entry point -------------------------------------------------------------
+
+def run_antientropy_bench(n: int = 64, rounds: int = 120,
+                          catalog: int = 1500,
+                          diverged: int = 30) -> dict:
+    live = _live_twin(catalog, diverged)
+    # Calibrate the sim byte model from the live measurement: bytes
+    # per record from the full-body wire, bytes per ladder bucket
+    # header from the session's digest traffic.
+    rec_bytes = live["full"]["bytes"] / max(1, 2 * (catalog + diverged))
+    dig = live["digest"]
+    hdr = dig["digest_bytes"] / max(1, 2 * digest_ops.DEFAULT_BUCKETS
+                                    + dig["levels_walked"])
+    sim = _sim_twin(n, rounds, rec_bytes=rec_bytes, bucket_hdr_bytes=hdr)
+    return {
+        "live": live,
+        "sim": sim,
+        "rec_bytes_measured": round(rec_bytes, 1),
+        "bytes_ratio": live["bytes_ratio"],
+        "heal_time_ratio": live["heal_time_ratio"],
+    }
+
+
+if __name__ == "__main__":  # pragma: no cover - manual runs
+    print(json.dumps(run_antientropy_bench(
+        n=int(os.environ.get("BENCH_ANTIENTROPY_NODES", "64")),
+        rounds=int(os.environ.get("BENCH_ANTIENTROPY_ROUNDS", "120")),
+        catalog=int(os.environ.get("BENCH_ANTIENTROPY_CATALOG", "1500")),
+        diverged=int(os.environ.get("BENCH_ANTIENTROPY_DIVERGED", "30"))),
+        indent=2))

@@ -83,6 +83,27 @@ def _digest_buckets() -> int:
         return digest_ops.DEFAULT_BUCKETS
 
 
+def _ladder_depth() -> int:
+    """Merkle-ladder depth for the live digest
+    (``SIDECAR_TPU_ANTIENTROPY_DEPTH``, >= 1; default
+    ops/digest.DEFAULT_LADDER_DEPTH).  Depth 1 degenerates to the flat
+    PR 15 digest — reconciliation then narrows in one step."""
+    import os
+
+    raw = os.environ.get("SIDECAR_TPU_ANTIENTROPY_DEPTH", "")
+    if not raw:
+        return digest_ops.DEFAULT_LADDER_DEPTH
+    try:
+        depth = int(raw)
+        if depth < 1:
+            raise ValueError(raw)
+        return depth
+    except (ValueError, TypeError):
+        log.warning("Bad SIDECAR_TPU_ANTIENTROPY_DEPTH=%r; using "
+                    "default %d", raw, digest_ops.DEFAULT_LADDER_DEPTH)
+        return digest_ops.DEFAULT_LADDER_DEPTH
+
+
 @dataclasses.dataclass
 class ChangeEvent:
     """A major state transition (catalog/services_state.go:42-46)."""
@@ -221,11 +242,16 @@ class ServicesState:
         # The live coherence digest (ops/digest.py — the ONE definition
         # shared with the sim's run_with_digest scan): maintained
         # incrementally by the writer under the state lock (every
-        # add/replace/tombstone/expire is an O(1) lane update) and
+        # add/replace/tombstone/expire is an O(depth) lane update) and
         # PUBLISHED as an immutable snapshot tuple so readers — the
         # push-pull annotation, /api/digest.json, the coherence
         # monitor — never take the lock (atomic reference read).
-        self._digest = digest_ops.IncrementalDigest(_digest_buckets())
+        # A LadderDigest's level 0 is byte-identical to the former
+        # IncrementalDigest, so every existing consumer is unchanged;
+        # the deeper levels feed anti-entropy reconciliation
+        # (transport/antientropy.py).
+        self._digest = digest_ops.LadderDigest(_digest_buckets(),
+                                               _ladder_depth())
         self.digest_snapshot: tuple = (0, self._digest.value())
         # Peer digest annotation captured by decode() from a push-pull
         # body's "Digest" key — None on states built directly.
@@ -302,7 +328,42 @@ class ServicesState:
         annotation never contend with the writer."""
         count, value = self.digest_snapshot
         return {"Buckets": self._digest.buckets, "Records": count,
-                "Hex": digest_ops.digest_to_hex(value)}
+                "Hex": digest_ops.digest_to_hex(value),
+                # The anti-entropy version gate: advertising a ladder
+                # geometry declares this peer speaks digest-directed
+                # reconciliation (transport/antientropy.py).  Plain-wire
+                # peers (and Go's encoding/json) ignore the extra key;
+                # absence of it routes a session straight to the
+                # full-body fallback.
+                "Ladder": {"Depth": self._digest.depth,
+                           "Leaf": self._digest.leaf_buckets}}
+
+    def digest_level(self, level: int) -> tuple:
+        """One ladder level's canonical digest, read under the state
+        lock (levels deeper than the published snapshot are maintained
+        by the writer but not snapshotted — reconciliation sessions are
+        rare next to mutations, so they pay the lock, not the writer)."""
+        with self._lock:
+            return self._digest.level(level)
+
+    def ladder_geometry(self) -> tuple:
+        """(base buckets, depth) — fixed at construction."""
+        return self._digest.base, self._digest.depth
+
+    def services_in_buckets(self, buckets, leaf_buckets: int) -> list:
+        """Copies of every record whose identity hashes into one of
+        ``buckets`` at the ``leaf_buckets`` ladder level — the
+        digest-directed session body (ships divergence, not catalogs).
+        Tombstones are records too: a reconciling peer must learn of
+        deaths it missed."""
+        want = set(buckets)
+        out = []
+        with self._lock:
+            for _, _, svc in self.each_service_sorted():
+                ident = digest_ops.ident_of(svc.hostname, svc.id)
+                if digest_ops.bucket_of(ident, leaf_buckets) in want:
+                    out.append(svc.copy())
+        return out
 
     def _digest_remove(self, svc: Service) -> None:
         """Writer-side capture: MUST run BEFORE a record is replaced,

@@ -95,6 +95,10 @@ class ChaosSimState:
     def node_alive(self):
         return self.sim.node_alive
 
+    @property
+    def known(self):
+        return self.sim.known
+
 
 class CompiledFaultPlan:
     """A FaultPlan resolved against a concrete cluster size: node
@@ -828,6 +832,18 @@ class ChaosExactSim(ExactSim):
             start_round=start_round, sparse=sparse)
         self._publish_injection_metrics(before, final)
         return final, tr, conv
+
+    def run_with_digest(self, state, key, num_rounds: int, cap: int = 0,
+                        buckets: int = 64, idents=None,
+                        donate: bool = True, start_round=None,
+                        sparse=None):
+        before = self._counter_snapshot(state)
+        final, dt, conv = super().run_with_digest(
+            state, key, num_rounds, cap=cap, buckets=buckets,
+            idents=idents, donate=donate, start_round=start_round,
+            sparse=sparse)
+        self._publish_injection_metrics(before, final)
+        return final, dt, conv
 
     def run_with_provenance(self, state, key, num_rounds: int, tracked,
                             cap: int = 0, prov=None, donate: bool = True,

@@ -66,6 +66,17 @@ from sidecar_tpu.ops.status import is_known
 # thousands).
 DEFAULT_BUCKETS = 64
 
+# Merkle-ladder depth: level k has DEFAULT_BUCKETS << k buckets, so the
+# default ladder is 64 → 128 → 256 → 512 → 1024.  The bucket index at
+# 2B buckets is ONE MORE BIT of the same mixed ident (bucket_ids shifts
+# one bit less), so a parent bucket's lane sums are exactly the
+# wrapping sum of its two children: every coarser level folds out of
+# the leaf level (:func:`fold_digest`), and a reconciliation session
+# can narrow disagreement level-by-level, requesting children only for
+# differing parents — O(divergence · depth) digest bytes, never
+# O(catalog).
+DEFAULT_LADDER_DEPTH = 5
+
 _M32 = 0xFFFFFFFF
 _M64 = 0xFFFFFFFFFFFFFFFF
 
@@ -146,6 +157,33 @@ def diff_counts(dig: jax.Array, ref: jax.Array) -> jax.Array:
     diverged-record count vs the reference catalog."""
     differ = jnp.any(dig != ref[None, :, :], axis=-1)
     return jnp.sum(differ.astype(jnp.int32), axis=-1)
+
+
+def fold_digest_jnp(dig: jax.Array) -> jax.Array:
+    """One ladder fold on-device: uint32 [..., 2B, 2] -> [..., B, 2].
+    Children (2b, 2b+1) sum (mod 2^32) into parent b — byte-identical
+    to digesting at B buckets directly (the prefix property; pinned in
+    tests/test_antientropy.py)."""
+    b2 = dig.shape[-2]
+    if b2 < 2 or b2 % 2:
+        raise ValueError(f"cannot fold {b2} buckets")
+    folded = dig.reshape(dig.shape[:-2] + (b2 // 2, 2, 2)).sum(axis=-2)
+    return folded.astype(jnp.uint32)
+
+
+def ladder_digests(packed: jax.Array, idents: jax.Array,
+                   base: int = DEFAULT_BUCKETS,
+                   depth: int = DEFAULT_LADDER_DEPTH) -> list:
+    """All node digests at every ladder level, coarse → fine: int32
+    [N, M] -> ``depth`` arrays uint32 [N, base << k, 2].  ONE
+    elementwise hash + segment-sum at the leaf level; coarser levels
+    are folds (no rehash)."""
+    if depth < 1:
+        raise ValueError(f"ladder depth must be >= 1, got {depth}")
+    levels = [node_digests(packed, idents, base << (depth - 1))]
+    for _ in range(depth - 1):
+        levels.append(fold_digest_jnp(levels[-1]))
+    return levels[::-1]
 
 
 # Digest-record layout — flat int32 [DIGEST_WIDTH], the trace-record
@@ -282,6 +320,28 @@ def diff_counts_np(dig, ref) -> np.ndarray:
     return np.any(dig != ref[None, :, :], axis=-1).sum(axis=-1)
 
 
+def fold_digest_np(dig) -> np.ndarray:
+    """Oracle twin of :func:`fold_digest_jnp`: uint32 [..., 2B, 2] ->
+    [..., B, 2] by pairwise child sum (uint32 wrap)."""
+    dig = np.asarray(dig, np.uint32)
+    b2 = dig.shape[-2]
+    if b2 < 2 or b2 % 2:
+        raise ValueError(f"cannot fold {b2} buckets")
+    return dig.reshape(dig.shape[:-2] + (b2 // 2, 2, 2)).sum(
+        axis=-2, dtype=np.uint32)
+
+
+def ladder_digests_np(packed, idents, base: int = DEFAULT_BUCKETS,
+                      depth: int = DEFAULT_LADDER_DEPTH) -> list:
+    """Oracle twin of :func:`ladder_digests` (coarse → fine)."""
+    if depth < 1:
+        raise ValueError(f"ladder depth must be >= 1, got {depth}")
+    levels = [node_digests_np(packed, idents, base << (depth - 1))]
+    for _ in range(depth - 1):
+        levels.append(fold_digest_np(levels[-1]))
+    return levels[::-1]
+
+
 def default_idents(m: int) -> np.ndarray:
     """The pure-sim slot identity table (uint32 [M]): slot j's ident is
     a mixed function of j.  Bridge-backed runs replace this with
@@ -330,6 +390,17 @@ def live_key(updated: int, status: int) -> int:
     return ((int(updated) << 3) | (int(status) & 7)) & _M64
 
 
+def bucket_of(ident: int, buckets: int) -> int:
+    """Bucket index of an ident at any power-of-two bucket count — the
+    pure-Python twin of :func:`bucket_ids`.  The index at 2B buckets is
+    ``(index at B) << 1 | next-bit``: deeper ladder levels refine, never
+    reshuffle (the prefix property)."""
+    shift = _bucket_shift(buckets)
+    if shift >= 32:
+        return 0
+    return fmix32_py(((ident & _M32) * _K1) & _M32) >> shift
+
+
 def record_hash(ident: int, key: int, buckets: int = DEFAULT_BUCKETS):
     """(bucket, lane0, lane1) of one record — the shared definition in
     pure Python (the reference implementation the array twins are
@@ -341,9 +412,7 @@ def record_hash(ident: int, key: int, buckets: int = DEFAULT_BUCKETS):
     k = fmix32_py(lo) ^ ((fmix32_py(hi ^ _GOLD) * _K1) & _M32)
     lane0 = fmix32_py(ident ^ k)
     lane1 = fmix32_py(((ident + _GOLD) & _M32) ^ ((k * _K1) & _M32))
-    shift = _bucket_shift(buckets)
-    bucket = 0 if shift >= 32 else fmix32_py((ident * _K1) & _M32) >> shift
-    return bucket, lane0, lane1
+    return bucket_of(ident, buckets), lane0, lane1
 
 
 class IncrementalDigest:
@@ -392,6 +461,113 @@ class IncrementalDigest:
         for ident, key in records:
             dig.add(ident, key)
         return dig
+
+
+class LadderDigest:
+    """The live catalog's Merkle ladder: one lane table per level
+    (level k has ``base << k`` buckets), all maintained incrementally —
+    one :func:`record_hash` per mutation (lanes are level-independent;
+    only the bucket index deepens), then ``depth`` O(1) lane updates.
+    ``level(0)`` is byte-identical to ``IncrementalDigest(base)`` over
+    the same records, so the coarse digest every existing surface pins
+    (push-pull annotation, /api/digest.json, CoherenceMonitor) is
+    unchanged; the deeper levels exist for reconciliation narrowing."""
+
+    __slots__ = ("base", "depth", "count", "_shifts", "_lanes")
+
+    def __init__(self, base: int = DEFAULT_BUCKETS,
+                 depth: int = DEFAULT_LADDER_DEPTH):
+        if depth < 1:
+            raise ValueError(f"ladder depth must be >= 1, got {depth}")
+        self._shifts = [_bucket_shift(base << k) for k in range(depth)]
+        self.base = base
+        self.depth = depth
+        self.count = 0
+        self._lanes = [[0] * (2 * (base << k)) for k in range(depth)]
+
+    def _apply(self, ident: int, key: int, sign: int) -> None:
+        ident &= _M32
+        _, l0, l1 = record_hash(ident, key, 1)
+        mixed = fmix32_py((ident * _K1) & _M32)
+        for lanes, shift in zip(self._lanes, self._shifts):
+            i = 2 * (0 if shift >= 32 else mixed >> shift)
+            lanes[i] = (lanes[i] + sign * l0) & _M32
+            lanes[i + 1] = (lanes[i + 1] + sign * l1) & _M32
+
+    def add(self, ident: int, key: int) -> None:
+        self._apply(ident, key, 1)
+        self.count += 1
+
+    def remove(self, ident: int, key: int) -> None:
+        self._apply(ident, key, -1)
+        self.count -= 1
+
+    def buckets_at(self, level: int) -> int:
+        return self.base << level
+
+    @property
+    def buckets(self) -> int:
+        """Coarse (level-0) bucket count — the IncrementalDigest
+        drop-in attribute (``digest_doc`` reads it)."""
+        return self.base
+
+    @property
+    def leaf_level(self) -> int:
+        return self.depth - 1
+
+    @property
+    def leaf_buckets(self) -> int:
+        return self.base << (self.depth - 1)
+
+    def level(self, k: int) -> tuple:
+        """Canonical flat-tuple digest of ladder level ``k``."""
+        return tuple(self._lanes[k])
+
+    def hex(self, k: int = 0) -> str:
+        return digest_to_hex(self._lanes[k])
+
+    def value(self) -> tuple:
+        """The coarse (level-0) digest — the IncrementalDigest drop-in
+        read every existing consumer keeps using."""
+        return tuple(self._lanes[0])
+
+    def leaf_bucket(self, ident: int) -> int:
+        """Which leaf bucket this ident's records live in — the
+        session's record-selection key."""
+        return bucket_of(ident, self.leaf_buckets)
+
+    @classmethod
+    def of(cls, records, base: int = DEFAULT_BUCKETS,
+           depth: int = DEFAULT_LADDER_DEPTH) -> "LadderDigest":
+        """Build from an iterable of ``(ident, key)`` pairs."""
+        dig = cls(base, depth)
+        for ident, key in records:
+            dig.add(ident, key)
+        return dig
+
+
+def fold_digest(value) -> tuple:
+    """Pure-Python ladder fold: canonical flat tuple at 2B buckets ->
+    B buckets (children ``2b``/``2b+1`` lane-sum into parent ``b``)."""
+    v = digest_value(value)
+    if len(v) < 4 or len(v) % 4:
+        raise ValueError(f"cannot fold digest of {len(v) // 2} buckets")
+    out = []
+    for i in range(0, len(v), 4):
+        out.append((v[i] + v[i + 2]) & _M32)
+        out.append((v[i + 1] + v[i + 3]) & _M32)
+    return tuple(out)
+
+
+def diff_bucket_ids(a, b) -> list:
+    """Indices of differing buckets between two same-size canonical
+    digests — the narrowing step's parent set."""
+    a = digest_value(a)
+    b = digest_value(b)
+    if len(a) != len(b):
+        raise ValueError(f"digest sizes differ: {len(a)} vs {len(b)}")
+    return [i // 2 for i in range(0, len(a), 2)
+            if a[i] != b[i] or a[i + 1] != b[i + 1]]
 
 
 def digest_value(dig) -> tuple:

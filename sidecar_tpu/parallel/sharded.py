@@ -91,7 +91,9 @@ class ShardedSim:
                  node_side: Optional[np.ndarray] = None,
                  board_exchange: Optional[str] = None,
                  exchange_stub: bool = False,
-                 sparse: Optional[str] = None):
+                 sparse: Optional[str] = None,
+                 digest_gate: Optional[bool] = None,
+                 gate_buckets: int = 8):
         if topo.n != params.n:
             raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
         if cut_mask is not None and topo.nbrs is None:
@@ -148,6 +150,38 @@ class ShardedSim:
                 for h in self._zoned_plan.hops)
             metrics.set_gauge("parallel.exchange.zoned_rows",
                               float(self._zoned_plan.total_rows))
+        # Digest-gated exchange (the anti-entropy subsystem's kernel
+        # leg, docs/antientropy.md): before the zoned hops, every shard
+        # publishes a tiny per-row catalog digest (gate_buckets wide —
+        # one all_gather of [d, gb, 2] uint32 per round) and each hop
+        # whose sender and receiver blocks provably already agree is
+        # skipped under a lax.cond.  The skip predicate is computed
+        # from REPLICATED (all-gathered) data, so every shard takes the
+        # same branch and the ppermute inside the cond stays a valid
+        # collective; a skipped hop's offers could only re-deliver
+        # values the receiver holds (equal digests ⇒ equal catalogs up
+        # to hash collision), so the gated round is bit-identical in
+        # the converged state (pinned in tests/test_antientropy.py).
+        # Default off (None → SIDECAR_TPU_ANTIENTROPY_GATE env, "1" to
+        # enable) — the ungated program compiles byte-for-byte as
+        # before.
+        if digest_gate is None:
+            import os
+            digest_gate = os.environ.get(
+                "SIDECAR_TPU_ANTIENTROPY_GATE", "0") == "1"
+        if digest_gate and self.board_exchange != "zoned":
+            raise ValueError(
+                "digest_gate composes with board_exchange='zoned' "
+                f"only (got {self.board_exchange!r}): all_gather and "
+                "ring ship whole blocks a digest cannot split")
+        self.digest_gate = bool(digest_gate)
+        self._gate_buckets = int(gate_buckets)
+        self._gate_idents = None
+        if self.digest_gate:
+            digest_ops.bucket_ids_np(np.zeros(1, np.uint32),
+                                     self._gate_buckets)  # validates
+            self._gate_idents = jnp.asarray(
+                digest_ops.default_idents(params.m))
         self.exchange_bytes_per_round = {
             "all_gather": (params.n - nl) * payload_ints * 4,
             "ring": (self.d - 1) * nl * payload_ints * 4,
@@ -191,6 +225,35 @@ class ShardedSim:
             node_alive=jax.device_put(jnp.ones((p.n,), bool), repl),
             round_idx=jax.device_put(jnp.zeros((), jnp.int32), repl),
         )
+
+    def gate_predicates(self, state: SimState) -> np.ndarray:
+        """Host-side replica of the digest gate's per-hop skip
+        predicate — bool [d-1], entry ``h-1`` True iff ring hop ``h``
+        would be SKIPPED on the next round (all shards internally
+        uniform and every receiver/sender pair digest-equal).  This is
+        the same formula the compiled gate evaluates on-device
+        (replicated, from the all-gathered [d, gb, 2] table), exposed
+        on the host so tests and the bench can prove the gate actually
+        engages in the converged state rather than inferring it from
+        bit-identity alone."""
+        if not self.digest_gate:
+            raise ValueError("gate_predicates requires digest_gate=True")
+        known = np.asarray(state.known)
+        dig = digest_ops.node_digests_np(
+            known, np.asarray(self._gate_idents), self._gate_buckets)
+        nl = known.shape[0] // self.d
+        uni = []
+        first = []
+        for i in range(self.d):
+            blk = dig[i * nl:(i + 1) * nl]
+            uni.append(bool((blk == blk[:1]).all()))
+            first.append(blk[0])
+        first_arr = np.stack(first)
+        out = np.zeros(self.d - 1, bool)
+        for h in range(1, self.d):
+            out[h - 1] = all(uni) and bool(
+                (first_arr == np.roll(first_arr, -h, axis=0)).all())
+        return out
 
     # -- the per-shard gossip round (inside shard_map) ---------------------
 
@@ -446,23 +509,69 @@ class ShardedSim:
                         return tuple(lax.ppermute(b, NODE_AXIS, perm)
                                      for b in blocks)
 
-                cur = zoned_send(live[0]) if live else None
-                for j, h in enumerate(live):
-                    if j + 1 < len(live):
-                        # Double buffer: the next hop's (smaller)
-                        # transfer is issued before this hop's block is
-                        # consumed, same overlap shape as the ring leg.
-                        nxt = zoned_send(live[j + 1])
-                    zrows, _zvalid = self._zoned_tabs[h - 1]
-                    ss = (ax + h) % d                       # sender shard
-                    senders_h = ss * nl + zrows[ss]
-                    keep_b = (None if keepmask is None
-                              else keepmask[senders_h])
-                    groups.append(self._block_candidates(
-                        known0, cur[0], cur[1], cur[2], senders_h,
-                        alive, r0, nl, now, keep_b))
-                    if j + 1 < len(live):
-                        cur = nxt
+                if live and self.digest_gate:
+                    # Digest-gated hops: each hop runs under a
+                    # lax.cond on a REPLICATED skip predicate — all
+                    # shards uniform AND every (receiver, sender=-h)
+                    # pair's digests equal — computed from one tiny
+                    # all_gather, so every shard takes the same branch
+                    # and the ppermute inside the cond is collective-
+                    # safe.  The skip branch emits shape-matched
+                    # no-op candidates (rows = nl drop in the combined
+                    # scatter).  No double buffering here: a cond
+                    # boundary would entangle adjacent hops' branches.
+                    gb = self._gate_buckets
+                    dig_l = digest_ops.node_digests(
+                        known0, self._gate_idents, gb)       # [nl, gb, 2]
+                    uni = jnp.all(dig_l == dig_l[:1])
+                    with cost.phase("exchange"):
+                        dig_all = lax.all_gather(dig_l[0], NODE_AXIS)
+                        uni_all = lax.all_gather(uni, NODE_AXIS)
+                    all_uni = jnp.all(uni_all)
+                    for h in live:
+                        agree_h = all_uni & jnp.all(
+                            dig_all == jnp.roll(dig_all, -h, axis=0))
+                        zrows, _zvalid = self._zoned_tabs[h - 1]
+                        ss = (ax + h) % d                   # sender shard
+                        senders_h = ss * nl + zrows[ss]
+                        keep_b = (None if keepmask is None
+                                  else keepmask[senders_h])
+                        sz = zrows.shape[1] * fanout * budget
+
+                        def live_fn(_, h=h, senders_h=senders_h,
+                                    keep_b=keep_b):
+                            cur = zoned_send(h)
+                            return self._block_candidates(
+                                known0, cur[0], cur[1], cur[2],
+                                senders_h, alive, r0, nl, now, keep_b)
+
+                        def skip_fn(_, sz=sz):
+                            return (jnp.full((sz,), nl, jnp.int32),
+                                    jnp.zeros((sz,), jnp.int32),
+                                    jnp.zeros((sz,), jnp.int32),
+                                    jnp.zeros((sz,), bool))
+
+                        groups.append(lax.cond(~agree_h, live_fn,
+                                               skip_fn, None))
+                else:
+                    cur = zoned_send(live[0]) if live else None
+                    for j, h in enumerate(live):
+                        if j + 1 < len(live):
+                            # Double buffer: the next hop's (smaller)
+                            # transfer is issued before this hop's
+                            # block is consumed, same overlap shape as
+                            # the ring leg.
+                            nxt = zoned_send(live[j + 1])
+                        zrows, _zvalid = self._zoned_tabs[h - 1]
+                        ss = (ax + h) % d                   # sender shard
+                        senders_h = ss * nl + zrows[ss]
+                        keep_b = (None if keepmask is None
+                                  else keepmask[senders_h])
+                        groups.append(self._block_candidates(
+                            known0, cur[0], cur[1], cur[2], senders_h,
+                            alive, r0, nl, now, keep_b))
+                        if j + 1 < len(live):
+                            cur = nxt
         else:  # ring — stream offer blocks hop by hop over ppermute
             if d > 1:
                 perm = [(i, (i - 1) % d) for i in range(d)]

@@ -2,6 +2,10 @@
 the Python delegate bridging it to the catalog (reference:
 services_delegate.go + the NinesStack/memberlist dependency)."""
 
+from sidecar_tpu.transport.antientropy import (AntiEntropyResponder,
+                                               ReconcileSession,
+                                               SessionConfig, reconcile)
 from sidecar_tpu.transport.gossip import GossipTransport, load_native
 
-__all__ = ["GossipTransport", "load_native"]
+__all__ = ["GossipTransport", "load_native", "AntiEntropyResponder",
+           "ReconcileSession", "SessionConfig", "reconcile"]

@@ -21,6 +21,7 @@ import ctypes
 import logging
 import pathlib
 import queue as queue_mod
+import random
 import subprocess
 import threading
 import time
@@ -156,7 +157,11 @@ class GossipTransport:
                  indirect_probes: int = -1,
                  handoff_queue_depth: int = 1024,
                  fault_injector=None,
-                 max_pending_broadcasts: int = 4096) -> None:
+                 max_pending_broadcasts: int = 4096,
+                 push_pull_retries: int = 3,
+                 push_pull_backoff_ms: float = 100.0,
+                 push_pull_jitter: float = 0.5,
+                 push_pull_attempt_timeout: float = 5.0) -> None:
         import socket
 
         self.node_name = node_name or socket.gethostname()
@@ -195,6 +200,21 @@ class GossipTransport:
         if max_pending_broadcasts <= 0:
             raise ValueError("max_pending_broadcasts must be positive")
         self.max_pending_broadcasts = max_pending_broadcasts
+        # Push-pull client retry discipline (the anti-entropy session's
+        # backoff contract, transport/antientropy.py): a failed seed
+        # join/exchange gets push_pull_retries extra attempts, each
+        # under push_pull_attempt_timeout, separated by exponential
+        # backoff (base push_pull_backoff_ms, doubled per attempt) plus
+        # uniform jitter so a partition heal doesn't produce a
+        # thundering herd of simultaneous redials.
+        if push_pull_retries < 0:
+            raise ValueError("push_pull_retries must be >= 0")
+        self.push_pull_retries = push_pull_retries
+        self.push_pull_backoff_ms = push_pull_backoff_ms
+        self.push_pull_jitter = push_pull_jitter
+        self.push_pull_attempt_timeout = push_pull_attempt_timeout
+        # Injectable for deterministic backoff tests.
+        self._retry_rng = random.Random()
         self._lib = load_native()
         self._handle: Optional[int] = None
         self._quit = threading.Event()
@@ -236,16 +256,74 @@ class GossipTransport:
 
         for seed in seeds or []:
             host, _, port_s = seed.partition(":")
-            try:
-                self.join(host, int(port_s) if port_s else 7946)
-            except OSError as exc:
-                log.warning("Failed to join seed %s: %s", seed, exc)
+            self.join_with_retry(host, int(port_s) if port_s else 7946)
         return port
 
     def join(self, host: str, port: int = 7946) -> None:
-        """TCP dial + full-state exchange (memberlist.Join)."""
+        """TCP dial + full-state exchange (memberlist.Join) — ONE
+        attempt; raises OSError on failure (callers that want the
+        retry discipline use :meth:`join_with_retry`)."""
         if self._lib.st_join(self._handle, host.encode(), port) != 0:
             raise OSError(f"join {host}:{port} failed")
+
+    def _join_once(self, host: str, port: int, timeout: float) -> None:
+        """One join attempt under a per-attempt timeout.  ``st_join``
+        is a blocking native call (TCP dial + full-state exchange), so
+        it runs on a worker thread; on timeout the attempt is charged
+        as failed while the dial is left to die in the background (a
+        blocking C call cannot be cancelled — the engine's own socket
+        timeouts reap it)."""
+        outcome: list = []
+
+        def work() -> None:
+            try:
+                self.join(host, port)
+                outcome.append(None)
+            except OSError as exc:
+                outcome.append(exc)
+
+        t = threading.Thread(target=work, name="gossip-join",
+                             daemon=True)
+        t.start()
+        t.join(timeout)
+        if t.is_alive():
+            raise OSError(
+                f"join {host}:{port} timed out after {timeout:.1f}s")
+        if outcome and outcome[0] is not None:
+            raise outcome[0]
+
+    def join_with_retry(self, host: str, port: int = 7946) -> bool:
+        """Seed-join with bounded retries, per-attempt timeout, and
+        exponential backoff + jitter.  Before this, a failed seed join
+        surfaced as ONE log line and the node waited a full
+        ``push_pull_interval`` (20 s default) for anti-entropy to
+        rescue it — the slowest, most fragile part of partition heal.
+        Returns True on success; exhaustion is counted
+        (``transport.pushpull.failures``), never silent."""
+        last: Optional[OSError] = None
+        for attempt in range(self.push_pull_retries + 1):
+            if attempt:
+                delay_ms = self.push_pull_backoff_ms * (2 ** (attempt - 1))
+                delay_ms *= 1.0 + self.push_pull_jitter \
+                    * self._retry_rng.random()
+                metrics.histogram("transport.pushpull.backoff_ms",
+                                  delay_ms)
+                metrics.incr("transport.pushpull.retries")
+                if self._quit.wait(delay_ms / 1000.0):
+                    break   # stopping — don't redial a dead transport
+            try:
+                self._join_once(host, port,
+                                self.push_pull_attempt_timeout)
+                return True
+            except OSError as exc:
+                last = exc
+                log.warning("Join %s:%d attempt %d/%d failed: %s",
+                            host, port, attempt + 1,
+                            self.push_pull_retries + 1, exc)
+        metrics.incr("transport.pushpull.failures")
+        log.warning("Giving up on seed %s:%d after %d attempts: %s",
+                    host, port, self.push_pull_retries + 1, last)
+        return False
 
     def stop(self) -> None:
         self._quit.set()

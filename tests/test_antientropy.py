@@ -1,0 +1,547 @@
+"""Self-healing anti-entropy contract (PR 16): the Merkle-ladder
+digest, the digest-directed reconciliation session, and the
+digest-gated sharded exchange.
+
+Four layers, pinned:
+
+* **Ladder twins** — jnp / NumPy / pure-Python ladders are
+  byte-identical at every level, one fold equals digesting at the
+  coarser width directly (the prefix property), and ``LadderDigest``
+  level 0 is a drop-in for ``IncrementalDigest`` (the coarse digest
+  every existing surface reads is unchanged).
+* **Session state machine** — happy path, noop, bounded retries with
+  deterministic backoff, graceful degradation to ONE counted full-body
+  exchange, plain-wire version gating, and the shed-records
+  re-delivery contract the bridge loop's backpressure depends on.
+* **Sim ↔ live agreement** — one partition FaultPlan through
+  ``ChaosExactSim.run_with_digest`` AND two live catalogs reconciled
+  by the session land on byte-identical digests, plus the plain-wire
+  Go-fixture regression (the ladder annotation must not move a byte of
+  ``encode()``).
+* **Digest-gated exchange** — gated zoned ``board_exchange`` is
+  bit-identical to ungated at d ∈ {1, 2, 4, 8} and the skip predicate
+  provably engages once (and only once) the cluster converges.
+"""
+
+import json
+import pathlib
+import random
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from sidecar_tpu import metrics
+from sidecar_tpu import service as S
+from sidecar_tpu.catalog.state import ServicesState
+from sidecar_tpu.ops import digest as digest_ops
+from sidecar_tpu.transport import antientropy as ae
+
+NS = S.NS_PER_SECOND
+T0 = 1_700_000_000 * NS
+FIXTURES = pathlib.Path(__file__).resolve().parent.parent / "fixtures"
+
+
+def make_state(host: str, n: int = 0, prefix: str = "svc") -> ServicesState:
+    st = ServicesState(hostname=host, cluster_name="test")
+    st.set_clock(lambda: T0 + 3600 * NS)
+    for i in range(n):
+        add(st, f"{prefix}{i}", updated=T0 + i)
+    return st
+
+
+def add(st: ServicesState, sid: str, updated: int = T0,
+        status: int = S.ALIVE, host: str = "recho") -> None:
+    st.add_service_entry(S.Service(
+        id=sid, name="app", image="img:1", hostname=host,
+        updated=updated, status=status))
+
+
+# -- ladder twins ------------------------------------------------------------
+
+class TestLadderTwins:
+    def _packed(self, rng, n=6, m=96):
+        packed = rng.integers(0, 2**20, size=(n, m), dtype=np.int64) \
+            .astype(np.int32)
+        packed[rng.random((n, m)) < 0.3] = 0    # unknowns
+        idents = digest_ops.default_idents(m)
+        return packed, idents
+
+    def test_jnp_np_ladders_identical(self):
+        packed, idents = self._packed(np.random.default_rng(1))
+        lad_j = digest_ops.ladder_digests(
+            packed, idents, base=16, depth=4)
+        lad_n = digest_ops.ladder_digests_np(
+            packed, idents, base=16, depth=4)
+        assert len(lad_j) == len(lad_n) == 4
+        for dj, dn in zip(lad_j, lad_n):
+            np.testing.assert_array_equal(np.asarray(dj), dn)
+
+    def test_fold_equals_direct_digest(self):
+        """The prefix property: folding the 2B-bucket digest IS the
+        B-bucket digest, byte for byte, in both array twins."""
+        packed, idents = self._packed(np.random.default_rng(2))
+        for b in (8, 16, 64):
+            fine = digest_ops.node_digests_np(packed, idents, 2 * b)
+            direct = digest_ops.node_digests_np(packed, idents, b)
+            np.testing.assert_array_equal(
+                digest_ops.fold_digest_np(fine), direct)
+            fine_j = digest_ops.node_digests(packed, idents, 2 * b)
+            np.testing.assert_array_equal(
+                np.asarray(digest_ops.fold_digest_jnp(fine_j)),
+                np.asarray(digest_ops.node_digests(packed, idents, b)))
+
+    def test_bucket_prefix_property(self):
+        for ident in (1, 7, 0xDEADBEEF, 2**32 - 1):
+            for b in (8, 64, 512):
+                assert digest_ops.bucket_of(ident, 2 * b) >> 1 \
+                    == digest_ops.bucket_of(ident, b)
+
+    def test_pure_python_ladder_matches_np_oracle(self):
+        """LadderDigest.level(k) over (ident, key) pairs ==
+        node_digests_np at base << k over the same records."""
+        rng = np.random.default_rng(3)
+        m = 64
+        idents = digest_ops.default_idents(m)
+        keys = rng.integers(1, 2**20, size=m, dtype=np.int64) \
+            .astype(np.int32)
+        lad = digest_ops.LadderDigest(base=16, depth=3)
+        for ident, key in zip(idents, keys):
+            lad.add(int(ident), int(key))
+        packed = keys[None, :]
+        for k in range(3):
+            oracle = digest_ops.node_digests_np(
+                packed, idents, 16 << k)[0]
+            assert lad.level(k) == tuple(oracle.reshape(-1).tolist())
+
+    def test_level0_is_incremental_digest(self):
+        inc = digest_ops.IncrementalDigest()
+        lad = digest_ops.LadderDigest()
+        for i in range(50):
+            ident = digest_ops.ident_of("h", f"s{i}")
+            key = digest_ops.live_key(T0 + i, S.ALIVE)
+            inc.add(ident, key)
+            lad.add(ident, key)
+        assert lad.value() == inc.value()
+        assert lad.buckets == inc.buckets
+        assert lad.hex() == inc.hex()
+
+    def test_add_remove_invertible_at_every_level(self):
+        lad = digest_ops.LadderDigest(base=8, depth=4)
+        zero = [lad.level(k) for k in range(4)]
+        pairs = [(digest_ops.ident_of("h", f"s{i}"),
+                  digest_ops.live_key(T0 + i, S.ALIVE))
+                 for i in range(20)]
+        for ident, key in pairs:
+            lad.add(ident, key)
+        for ident, key in pairs:
+            lad.remove(ident, key)
+        assert [lad.level(k) for k in range(4)] == zero
+        assert lad.count == 0
+
+    def test_fold_digest_pure_python(self):
+        lad = digest_ops.LadderDigest(base=8, depth=2)
+        for i in range(30):
+            lad.add(digest_ops.ident_of("h", f"s{i}"),
+                    digest_ops.live_key(T0 + i, S.ALIVE))
+        assert digest_ops.fold_digest(lad.level(1)) == lad.level(0)
+
+    def test_diff_bucket_ids(self):
+        a = digest_ops.LadderDigest(base=8, depth=1)
+        b = digest_ops.LadderDigest(base=8, depth=1)
+        ident = digest_ops.ident_of("h", "only-in-a")
+        a.add(ident, digest_ops.live_key(T0, S.ALIVE))
+        diff = digest_ops.diff_bucket_ids(a.level(0), b.level(0))
+        assert diff == [digest_ops.bucket_of(ident, 8)]
+        with pytest.raises(ValueError):
+            digest_ops.diff_bucket_ids(a.level(0), (0, 0))
+
+
+# -- catalog plumbing --------------------------------------------------------
+
+class TestCatalogLadder:
+    def test_digest_doc_advertises_ladder(self):
+        st = make_state("adv", n=3)
+        doc = st.digest_doc()
+        assert doc["Ladder"]["Depth"] == st.ladder_geometry()[1]
+        assert doc["Ladder"]["Leaf"] == \
+            digest_ops.DEFAULT_BUCKETS << (doc["Ladder"]["Depth"] - 1)
+        # level 0 stays the coarse digest every surface already pins
+        assert doc["Hex"] == digest_ops.digest_to_hex(st.digest_level(0))
+
+    def test_services_in_buckets_roundtrip(self):
+        st = make_state("rt", n=40)
+        _, depth = st.ladder_geometry()
+        leaf = digest_ops.DEFAULT_BUCKETS << (depth - 1)
+        for _, _, svc in list(st.each_service_sorted())[:5]:
+            b = digest_ops.bucket_of(
+                digest_ops.ident_of(svc.hostname, svc.id), leaf)
+            got = st.services_in_buckets([b], leaf)
+            assert any(s.id == svc.id for s in got)
+
+
+# -- session state machine ---------------------------------------------------
+
+class TestReconcileSession:
+    def _pair(self, diverged_a=3, diverged_b=2, shared=40):
+        a = make_state("side-a", n=shared)
+        b = make_state("side-b", n=shared)
+        for i in range(diverged_a):
+            add(a, f"only-a{i}", updated=T0 + 10_000 + i)
+        for i in range(diverged_b):
+            add(b, f"only-b{i}", updated=T0 + 20_000 + i)
+        return a, b
+
+    def test_happy_path_heals_and_converges(self):
+        a, b = self._pair()
+        chan = ae.LoopbackChannel(ae.AntiEntropyResponder(b))
+        rep = ae.reconcile(a, chan, enabled=True)
+        assert rep.mode == "digest"
+        assert rep.states == ["HELLO", "NARROW", "TRANSFER", "VERIFY",
+                              "DONE"]
+        assert rep.coherent is True
+        assert a.digest_snapshot == b.digest_snapshot
+        assert rep.records_received >= 2 and rep.records_sent >= 3
+
+    def test_ships_divergence_not_catalogs(self):
+        a, b = self._pair(shared=300)
+        full = len(a.encode_annotated()) + len(b.encode_annotated())
+        chan = ae.LoopbackChannel(ae.AntiEntropyResponder(b))
+        rep = ae.reconcile(a, chan, enabled=True)
+        assert rep.coherent is True
+        assert rep.total_bytes * 5 <= full   # the ≥5x acceptance bar
+
+    def test_noop_session_is_two_messages(self):
+        a, b = self._pair(diverged_a=0, diverged_b=0)
+        chan = ae.LoopbackChannel(ae.AntiEntropyResponder(b))
+        rep = ae.reconcile(a, chan, enabled=True)
+        assert rep.states == ["HELLO", "DONE"]
+        assert rep.coherent is True
+        assert rep.record_bytes == 0 and rep.records_received == 0
+
+    def test_flaky_channel_retries_with_deterministic_backoff(self):
+        a, b = self._pair()
+        fails = {"n": 0}
+
+        def fail(doc):
+            if doc["T"] == "hello" and fails["n"] < 2:
+                fails["n"] += 1
+                raise ae.ChannelError("injected")
+
+        sleeps = []
+        cfg = ae.SessionConfig(retries=3, backoff_ms=50.0, jitter=0.5)
+        rep = ae.ReconcileSession(
+            a, ae.LoopbackChannel(ae.AntiEntropyResponder(b), fail=fail),
+            config=cfg, enabled=True, rng=random.Random(42),
+            sleep=sleeps.append).run()
+        assert rep.coherent is True and rep.retries == 2
+        replay = random.Random(42)
+        expected = [50.0 * (2 ** k) * (1 + 0.5 * replay.random()) / 1000.0
+                    for k in range(2)]
+        assert sleeps == pytest.approx(expected)
+
+    def test_dead_channel_fails_loudly(self):
+        a, _ = self._pair()
+
+        class Dead(ae.Channel):
+            def send(self, doc, timeout):
+                raise ae.ChannelError("down")
+
+        before = metrics.counter("antientropy.failures")
+        rep = ae.ReconcileSession(
+            a, Dead(), config=ae.SessionConfig(retries=1, backoff_ms=0.0),
+            enabled=True, sleep=lambda _s: None).run()
+        assert rep.mode == "failed"
+        assert rep.states[-1] == "FAILED"
+        assert metrics.counter("antientropy.failures") == before + 1
+
+    def test_ladder_mismatch_falls_back_to_counted_full_body(self):
+        a, b = self._pair()
+
+        class Mismatch(ae.Channel):
+            def __init__(self):
+                self.inner = ae.LoopbackChannel(
+                    ae.AntiEntropyResponder(b))
+
+            def send(self, doc, timeout):
+                resp = self.inner.send(doc, timeout)
+                if resp.get("T") == "hello":
+                    resp = dict(resp, Depth=99)
+                return resp
+
+        before = metrics.counter("antientropy.fallbacks")
+        rep = ae.ReconcileSession(a, Mismatch(), enabled=True).run()
+        assert rep.mode == "full"
+        assert "mismatch" in rep.fallback_reason
+        assert metrics.counter("antientropy.fallbacks") == before + 1
+        assert a.digest_snapshot == b.digest_snapshot  # still heals
+
+    def test_plain_wire_peer_is_version_gated(self):
+        a, b = self._pair()
+        before = metrics.counter("antientropy.plainwire")
+        chan = ae.LoopbackChannel(ae.AntiEntropyResponder(b))
+        rep = ae.ReconcileSession(
+            a, chan, enabled=True,
+            peer_doc={"Buckets": 64, "Hex": "00"}).run()   # no Ladder
+        assert rep.mode == "full"
+        assert rep.fallback_reason == "plain-wire peer"
+        assert metrics.counter("antientropy.plainwire") == before + 1
+        # the body sent to the plain peer is today's un-annotated wire
+        assert "Digest" not in chan.requests[0]["Body"]
+
+    def test_disabled_env_gate_routes_to_full_body(self, monkeypatch):
+        monkeypatch.setenv("SIDECAR_TPU_ANTIENTROPY", "0")
+        a, b = self._pair()
+        rep = ae.reconcile(
+            a, ae.LoopbackChannel(ae.AntiEntropyResponder(b)))
+        assert rep.mode == "full"
+        assert rep.fallback_reason == "disabled"
+
+    def test_shed_records_are_redelivered(self):
+        """The bridge-loop backpressure contract: a record shed by
+        ``_deliver_inbound`` (single-writer queue full) is re-delivered
+        by the next digest-directed session — shedding is deferral,
+        never loss."""
+        from sidecar_tpu.transport.gossip import GossipTransport
+
+        a, b = self._pair(diverged_a=0, diverged_b=0)
+        add(a, "shed-me", updated=T0 + 99_000)
+
+        class Harness:
+            INBOUND_PUT_RETRIES = GossipTransport.INBOUND_PUT_RETRIES
+            INBOUND_PUT_TIMEOUT = 0.001
+            _deliver_inbound = GossipTransport._deliver_inbound
+
+            def __init__(self, state):
+                self.state = state
+                self._quit = threading.Event()
+
+        # Fill b's single-writer queue (no writer loop drains it), so
+        # the bridge path MUST shed the inbound record.
+        while True:
+            try:
+                b.service_msgs.put_nowait(S.Service(
+                    id="filler", name="f", image="i", hostname="x",
+                    updated=T0, status=S.ALIVE))
+            except Exception:
+                break
+        shed_before = metrics.counter("transport.shedInbound")
+        Harness(b)._deliver_inbound(
+            S.Service(id="shed-me", name="app", image="img:1",
+                      hostname="recho", updated=T0 + 99_000,
+                      status=S.ALIVE))
+        def has(st, sid):
+            srv = st.servers.get("recho")
+            return bool(srv and sid in srv.services)
+
+        assert metrics.counter("transport.shedInbound") == shed_before + 1
+        assert not has(b, "shed-me")
+
+        rep = ae.reconcile(
+            a, ae.LoopbackChannel(ae.AntiEntropyResponder(b)),
+            enabled=True)
+        assert rep.coherent is True
+        assert has(b, "shed-me")
+        assert a.digest_snapshot == b.digest_snapshot
+
+
+# -- sim <-> live agreement --------------------------------------------------
+
+class TestSimLiveAgreement:
+    def test_partition_faultplan_sim_and_live_sessions_agree(self):
+        """ONE partition FaultPlan, both twins: the chaos sim runs it
+        under ``run_with_digest`` (divergence measured in-scan); the
+        live twin rebuilds the two sides' beliefs as real catalogs and
+        heals them with a ReconcileSession.  The healed live digest
+        must be byte-identical to the NumPy oracle's digest of the
+        merged sim beliefs — same records, same identity function,
+        same bytes."""
+        from sidecar_tpu.chaos import ChaosExactSim, FaultPlan
+        from sidecar_tpu.models.exact import SimParams
+        from sidecar_tpu.models.timecfg import TimeConfig
+        from sidecar_tpu.ops import topology
+
+        n, spn = 8, 2
+        m = n * spn
+        side_a = tuple(range(n // 2))
+        side_b = tuple(range(n // 2, n))
+        plan = FaultPlan(seed=16).with_edges(
+            *FaultPlan.partition(side_a, side_b, 0, 1000))
+        params = SimParams(n=n, services_per_node=spn, fanout=3,
+                           budget=8)
+        slot_names = [(f"h{j // spn}", f"s{j}") for j in range(m)]
+        idents = digest_ops.catalog_idents(slot_names)
+        sim = ChaosExactSim(params, topology.complete(n),
+                            TimeConfig(refresh_interval_s=10_000.0),
+                            plan=plan)
+        final, dt, _ = sim.run_with_digest(
+            sim.init_state(), jax.random.PRNGKey(16), 12, cap=12,
+            idents=idents)
+        rec = np.asarray(dt.rec)[:int(np.asarray(dt.count))]
+        assert (rec[:, digest_ops.DIG_DIFF_TOTAL] > 0).all(), \
+            "partition must keep the sides diverged"
+
+        known = np.asarray(final.known)
+        k_a, k_b = known[0], known[n - 1]
+        assert not np.array_equal(k_a, k_b)
+
+        def rebuild(host, beliefs):
+            st = ServicesState(hostname=host, cluster_name="twin")
+            st.set_clock(lambda: 1_000_000)
+            for j, packed in enumerate(beliefs):
+                if packed == 0:
+                    continue
+                st.add_service_entry(S.Service(
+                    id=slot_names[j][1], name="app", image="i",
+                    hostname=slot_names[j][0],
+                    updated=int(packed) >> 3,
+                    status=int(packed) & 7))
+            return st
+
+        live_a, live_b = rebuild("node0", k_a), rebuild("node7", k_b)
+        assert live_a.digest_snapshot != live_b.digest_snapshot
+        rep = ae.reconcile(
+            live_a, ae.LoopbackChannel(ae.AntiEntropyResponder(live_b)),
+            enabled=True)
+        assert rep.mode == "digest" and rep.coherent is True
+        assert live_a.digest_snapshot == live_b.digest_snapshot
+
+        merged = np.maximum(k_a, k_b)[None, :]
+        oracle = digest_ops.node_digests_np(
+            merged, idents, digest_ops.DEFAULT_BUCKETS)[0]
+        assert live_a.digest_snapshot[1] \
+            == tuple(oracle.reshape(-1).tolist())
+
+    def test_plain_wire_go_fixture_unmoved(self):
+        """The ladder must not move a single byte of the plain wire:
+        the Go fixture round-trips through a ladder-bearing state
+        byte-identically, while the annotated wire now advertises the
+        ladder geometry."""
+        from sidecar_tpu.catalog import state as state_mod
+
+        wire = (FIXTURES / "go_wire_state.json").read_bytes()
+        st = state_mod.decode(wire)
+        assert st.encode() == wire
+        ann = json.loads(st.encode_annotated())
+        assert ann["Digest"]["Ladder"]["Depth"] >= 1
+
+
+# -- digest-gated sharded exchange -------------------------------------------
+
+@pytest.fixture(scope="module")
+def zoned_setup():
+    from sidecar_tpu.models.exact import SimParams
+    from sidecar_tpu.models.timecfg import TimeConfig
+    from sidecar_tpu.ops import topology
+
+    params = SimParams(n=16, services_per_node=2, fanout=4, budget=8)
+    topo = topology.zoned(16, 4, local_hops=2, remote_deg=4, gateways=2)
+    cfg = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=1e6,
+                     sweep_interval_s=1.0)
+    return params, topo, cfg
+
+
+class TestDigestGatedExchange:
+    DS = (1, 2, 4, 8)
+
+    def test_gate_requires_zoned(self, zoned_setup):
+        from sidecar_tpu.parallel.mesh import make_mesh
+        from sidecar_tpu.parallel.sharded import ShardedSim
+
+        params, topo, cfg = zoned_setup
+        with pytest.raises(ValueError):
+            ShardedSim(params, topo, cfg,
+                       mesh=make_mesh(jax.devices()[:1]),
+                       board_exchange="all_gather", digest_gate=True)
+
+    @pytest.mark.parametrize("d", DS)
+    def test_gated_bit_identical_and_engages(self, zoned_setup, d):
+        """The tentpole pin: gated vs ungated zoned exchange is
+        bit-identical every round at every shard count, AND the skip
+        predicate engages once the cluster converges (never before)."""
+        from sidecar_tpu.parallel.mesh import make_mesh
+        from sidecar_tpu.parallel.sharded import ShardedSim
+
+        params, topo, cfg = zoned_setup
+        if d > len(jax.devices()):
+            pytest.skip(f"needs {d} devices")
+        mesh = make_mesh(jax.devices()[:d])
+        off = ShardedSim(params, topo, cfg, mesh=mesh,
+                         board_exchange="zoned", digest_gate=False)
+        on = ShardedSim(params, topo, cfg, mesh=mesh,
+                        board_exchange="zoned", digest_gate=True)
+        so, sn = off.init_state(), on.init_state()
+        if d > 1:
+            assert not on.gate_predicates(sn).any(), \
+                "gate must pass traffic while diverged"
+        for i in range(14):
+            k = jax.random.PRNGKey(i)
+            so, sn = off.step(so, k), on.step(sn, k)
+            np.testing.assert_array_equal(np.asarray(so.known),
+                                          np.asarray(sn.known))
+            np.testing.assert_array_equal(np.asarray(so.sent),
+                                          np.asarray(sn.sent))
+        k = np.asarray(sn.known)
+        assert (k == k[:1]).all(), "cluster should converge in 14 rounds"
+        if d > 1:
+            assert on.gate_predicates(sn).all(), \
+                "gate must skip every hop once converged"
+
+
+# -- hardened push-pull client -----------------------------------------------
+
+class TestJoinWithRetry:
+    def _harness(self, fail_times: int, retries: int = 3,
+                 jitter: float = 0.0):
+        from sidecar_tpu.transport.gossip import GossipTransport
+
+        class Harness:
+            join_with_retry = GossipTransport.join_with_retry
+            _join_once = GossipTransport._join_once
+
+            def __init__(self):
+                self._quit = threading.Event()
+                self.push_pull_retries = retries
+                self.push_pull_backoff_ms = 1.0
+                self.push_pull_jitter = jitter
+                self.push_pull_attempt_timeout = 2.0
+                self._retry_rng = random.Random(7)
+                self.calls = 0
+
+            def join(self, host, port=7946):
+                self.calls += 1
+                if self.calls <= fail_times:
+                    raise OSError("dial refused")
+
+        return Harness()
+
+    def test_succeeds_after_transient_failures(self):
+        h = self._harness(fail_times=2)
+        r_before = metrics.counter("transport.pushpull.retries")
+        assert h.join_with_retry("seed", 7946) is True
+        assert h.calls == 3
+        assert metrics.counter("transport.pushpull.retries") \
+            == r_before + 2
+
+    def test_exhaustion_counted_never_silent(self):
+        h = self._harness(fail_times=99, retries=2)
+        f_before = metrics.counter("transport.pushpull.failures")
+        assert h.join_with_retry("seed", 7946) is False
+        assert h.calls == 3
+        assert metrics.counter("transport.pushpull.failures") \
+            == f_before + 1
+
+    def test_stop_interrupts_backoff(self):
+        h = self._harness(fail_times=99, retries=5)
+        h.push_pull_backoff_ms = 60_000.0
+        h._quit.set()   # stopping transport must not sit in backoff
+        assert h.join_with_retry("seed", 7946) is False
+        assert h.calls == 1
+
+    def test_constructor_rejects_negative_retries(self):
+        from sidecar_tpu.transport.gossip import GossipTransport
+
+        state = make_state("neg")
+        with pytest.raises(ValueError):
+            GossipTransport(state, bind_port=0, push_pull_retries=-1)

@@ -31,6 +31,9 @@ GOOD_RESULT = {
     "cost": {"programs": {"exact.step": {"compile_ms": 100.0}},
              "reconciliation": {"within_tolerance": True}},
     "regression": {"overall": "neutral", "metrics": []},
+    "antientropy": {"live": {"bytes_ratio": 19.6},
+                    "sim": {"heal_round": 42},
+                    "bytes_ratio": 19.6, "heal_time_ratio": 0.13},
 }
 
 
@@ -54,6 +57,23 @@ class TestResultRecords:
     def test_bad_cost_blocks(self):
         doc = dict(GOOD_RESULT, cost={"programs": [1, 2]})
         assert any("cost.programs" in i for i in issues_for(doc))
+
+    def test_antientropy_ratios_number_or_null(self):
+        # null is the honest non-result (fallback / heal never landed).
+        doc = dict(GOOD_RESULT,
+                   antientropy={"live": {}, "sim": {},
+                                "bytes_ratio": None,
+                                "heal_time_ratio": None})
+        assert issues_for(doc) == []
+        doc = dict(GOOD_RESULT,
+                   antientropy={"bytes_ratio": "19x",
+                                "heal_time_ratio": 1.0})
+        assert any("antientropy.bytes_ratio" in i
+                   for i in issues_for(doc))
+
+    def test_antientropy_twin_blocks_must_be_objects(self):
+        doc = dict(GOOD_RESULT, antientropy={"live": [1], "sim": {}})
+        assert any("antientropy.live" in i for i in issues_for(doc))
 
 
 class TestErrorRecords:

@@ -51,6 +51,7 @@ KNOWN_RESULT_BLOCKS = {
     "sweep": dict,
     "topology": dict,
     "coherence": dict,
+    "antientropy": dict,
     "cost": dict,
     "regression": dict,
     "telemetry": dict,
@@ -111,6 +112,21 @@ def validate_result(doc: dict, issues: List[str],
             issues.append(
                 f"{ctx}: coherence.rounds_to_eps_ratio is neither "
                 "null nor a number")
+    if isinstance(doc.get("antientropy"), dict):
+        ae = doc["antientropy"]
+        for key in ("live", "sim"):
+            if key in ae and not isinstance(ae[key], dict):
+                issues.append(
+                    f"{ctx}: antientropy.{key} is not an object")
+        # The two acceptance headlines: null (an honest non-result —
+        # fallback taken or heal never landed) or a number; anything
+        # else is a schema break.
+        for key in ("bytes_ratio", "heal_time_ratio"):
+            val = ae.get(key)
+            if val is not None and not isinstance(val, NUMBER):
+                issues.append(
+                    f"{ctx}: antientropy.{key} is neither "
+                    "null nor a number")
 
 
 def validate_error(doc: dict, issues: List[str],
