@@ -901,6 +901,28 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# antientropy bench failed: {exc}", file=sys.stderr)
 
+    # Autopilot closed-loop block (benchmarks/autopilot.py,
+    # docs/autopilot.md): observe a config6-seeded chaos run through
+    # its telemetry, fit the conditions, sweep the knob space against
+    # the SLO rules, and verify the winner by bit-identical unbatched
+    # replay.  The block carries the acceptance claims as measured
+    # fields: closed_loop (recommendation passes the SLO the status-quo
+    # baseline fails), eval_ratio (ES evaluations / exhaustive grid),
+    # replay_bit_identical.  BENCH_AUTOPILOT=0 skips it;
+    # BENCH_AUTOPILOT_NODES / BENCH_AUTOPILOT_ROUNDS size the sweep.
+    autopilot_block = None
+    if os.environ.get("BENCH_AUTOPILOT", "1") != "0":
+        try:
+            from benchmarks.autopilot import run_autopilot_bench
+            _watchdog_note("autopilot")
+            autopilot_block = run_autopilot_bench(
+                n=int(os.environ.get("BENCH_AUTOPILOT_NODES", "32")),
+                rounds=int(os.environ.get("BENCH_AUTOPILOT_ROUNDS",
+                                          "60")))
+            _watchdog_note("autopilot", {"autopilot": autopilot_block})
+        except Exception as exc:  # the headline must survive a side bench
+            print(f"# autopilot bench failed: {exc}", file=sys.stderr)
+
     # Kernel-cost observatory block (sidecar_tpu/telemetry/cost.py,
     # docs/perf.md): per-phase attribution + compile/HBM telemetry for
     # the single-chip families, reconciled against the measured
@@ -951,6 +973,7 @@ def main() -> None:
         **({"coherence": coherence_block} if coherence_block else {}),
         **({"antientropy": antientropy_block}
            if antientropy_block else {}),
+        **({"autopilot": autopilot_block} if autopilot_block else {}),
         **({"cost": cost_block} if cost_block else {}),
         "telemetry": telemetry,
     }

@@ -1,0 +1,35 @@
+"""Digital-twin autopilot: the live↔sim control loop (docs/autopilot.md).
+
+The sweep plane answered "what would happen under config X"; the
+autopilot asks and ANSWERS the operator's real question — "which
+config meets my SLO under what the cluster is going through right
+now" — by closing the loop the ROADMAP names:
+
+* :mod:`fit`        — telemetry → a :class:`ConditionEstimate`
+  (loss/churn as data axes, pauses as a ``FaultPlan``);
+* :mod:`objective`  — ``telemetry/slo.py`` rules → the scalar the
+  search minimizes (the same grammar ``POST /sweep`` verdicts use);
+* :mod:`search`     — grid seeding + elite-jitter ES, one vmapped
+  ``FleetSim`` dispatch per generation, every evaluation counted;
+* :mod:`controller` — recommend / replay-verify / apply-gate, the
+  ``POST /autopilot/recommend`` + ``GET /api/autopilot.json``
+  surfaces, and the ``autopilot.*`` metrics.
+"""
+
+from sidecar_tpu.autopilot.controller import (  # noqa: F401
+    AutopilotController,
+    default_axes,
+    replay_check,
+)
+from sidecar_tpu.autopilot.fit import (  # noqa: F401
+    ConditionEstimate,
+    fit_from_trace,
+    fit_live,
+)
+from sidecar_tpu.autopilot.objective import Objective  # noqa: F401
+from sidecar_tpu.autopilot.search import (  # noqa: F401
+    AxisSpec,
+    FleetEvaluator,
+    SearchResult,
+    es_search,
+)

@@ -1,0 +1,346 @@
+"""The autopilot control loop (docs/autopilot.md).
+
+``AutopilotController.recommend`` closes the live↔sim loop in four
+moves, each owned by a sibling module:
+
+1. **fit** (autopilot/fit.py) — snapshot the cluster's conditions into
+   a :class:`ConditionEstimate` (loss/churn as data-axis base fields,
+   pauses as a shared ``FaultPlan``);
+2. **objective** (autopilot/objective.py) — the operator's
+   ``telemetry/slo.py`` rules become the scalar the search minimizes;
+3. **search** (autopilot/search.py) — grid seeding + elite-jitter ES
+   over the data-axis knob space, one vmapped ``FleetSim`` dispatch
+   per generation, every scenario counted;
+4. **verify + gate** — the winning bundle is replayed UNBATCHED
+   through the classic sim (``ExactSim`` / ``ChaosExactSim``) and must
+   be bit-identical to its fleet lane (:func:`replay_check`) before it
+   is recommended; auto-APPLY (rewriting the bridge's live
+   ``TimeConfig`` with the winner's clock knobs) additionally requires
+   the ``SIDECAR_TPU_AUTOPILOT_APPLY=1`` master gate — a request may
+   ask for apply, but only the operator's environment can arm it, and
+   a blocked apply is counted (``autopilot.apply_blocked``), never
+   silent.
+
+Env contract (docs/env.md):
+
+* ``SIDECAR_TPU_AUTOPILOT_APPLY`` — "1" arms auto-apply; anything
+  else leaves every recommendation advisory.
+* ``SIDECAR_TPU_AUTOPILOT_RULES`` — comma-separated default SLO rules
+  (requests may override per call).
+* ``SIDECAR_TPU_AUTOPILOT_ROUNDS`` / ``_GENERATIONS`` /
+  ``_POPULATION`` — default search budget knobs.
+
+Every recommendation publishes ``autopilot.*`` metrics
+(docs/metrics.md) and stores its report on the catalog state
+(``state.autopilot_report``) for ``GET /api/autopilot.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Optional
+
+import jax
+import numpy as np
+
+from sidecar_tpu import metrics
+from sidecar_tpu.autopilot.fit import ConditionEstimate, fit_live
+from sidecar_tpu.autopilot.objective import Objective
+from sidecar_tpu.autopilot.search import (
+    AxisSpec,
+    EvalResult,
+    FleetEvaluator,
+    es_search,
+)
+from sidecar_tpu.fleet import restart_churn_perturb
+from sidecar_tpu.fleet.batch import _TIMECFG_FIELDS
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import topology as topo_mod
+
+ENV_APPLY = "SIDECAR_TPU_AUTOPILOT_APPLY"
+ENV_RULES = "SIDECAR_TPU_AUTOPILOT_RULES"
+ENV_ROUNDS = "SIDECAR_TPU_AUTOPILOT_ROUNDS"
+ENV_GENERATIONS = "SIDECAR_TPU_AUTOPILOT_GENERATIONS"
+ENV_POPULATION = "SIDECAR_TPU_AUTOPILOT_POPULATION"
+
+DEFAULT_AUTOPILOT_RULES = ("converge <= 30 rounds", "agreement >= 0.99")
+
+# The fleet-lane ↔ unbatched-run comparison surface (the exact-family
+# lockstep contract, tests/test_fleet.py).
+REPLAY_FIELDS = ("known", "sent", "node_alive", "round_idx")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def apply_armed() -> bool:
+    """The master auto-apply gate: only ``SIDECAR_TPU_AUTOPILOT_APPLY=1``
+    in the operator's environment arms it."""
+    return os.environ.get(ENV_APPLY, "0") == "1"
+
+
+def default_axes(timecfg: TimeConfig,
+                 params: Optional[SimParams] = None) -> tuple:
+    """The stock searchable knobs, anchored at the status-quo config:
+    gossip cadence (log scale — it spans orders of magnitude),
+    transmit limit, and the suspicion window."""
+    limit = params.resolved_retransmit_limit() if params is not None \
+        else 6
+    return (
+        AxisSpec("push_pull_interval_s", 0.5, 30.0, log=True,
+                 base=timecfg.push_pull_interval_s),
+        AxisSpec("retransmit_limit", 2, 12, base=limit),
+        AxisSpec("suspicion_window_s", 0.0, 8.0,
+                 base=timecfg.suspicion_window_s),
+    )
+
+
+def axis_from_wire(doc: dict) -> AxisSpec:
+    """An ``AxisSpec`` from the ``POST /autopilot/recommend`` wire form
+    (unknown keys rejected loudly — a typoed bound silently defaulting
+    would search the wrong space)."""
+    if not isinstance(doc, dict):
+        raise ValueError(f"axis entries must be objects, got {doc!r}")
+    allowed = {"name", "lo", "hi", "integer", "log", "base"}
+    bad = set(doc) - allowed
+    if bad:
+        raise ValueError(
+            f"unknown axis field(s) {sorted(bad)}; expected a subset "
+            f"of {sorted(allowed)}")
+    for req in ("name", "lo", "hi"):
+        if req not in doc:
+            raise ValueError(f"axis entry missing {req!r}: {doc!r}")
+    return AxisSpec(name=str(doc["name"]), lo=float(doc["lo"]),
+                    hi=float(doc["hi"]),
+                    integer=bool(doc.get("integer", False)),
+                    log=bool(doc.get("log", False)),
+                    base=None if doc.get("base") is None
+                    else float(doc["base"]))
+
+
+def estimate_from_wire(doc: dict, *, n: int,
+                       services_per_node: int) -> ConditionEstimate:
+    """A ``ConditionEstimate`` from the request body (operators may
+    state conditions directly instead of fitting them)."""
+    if not isinstance(doc, dict):
+        raise ValueError(
+            f"'estimate' must be an object, got {doc!r}")
+    allowed = {"loss_rate", "churn_rate", "paused_frac",
+               "seconds_per_round"}
+    bad = set(doc) - allowed
+    if bad:
+        raise ValueError(
+            f"unknown estimate field(s) {sorted(bad)}; expected a "
+            f"subset of {sorted(allowed)}")
+    for k in ("loss_rate", "churn_rate", "paused_frac"):
+        v = doc.get(k, 0.0)
+        if not 0.0 <= float(v) <= 1.0:
+            raise ValueError(f"estimate.{k}={v} not in [0, 1]")
+    return ConditionEstimate(
+        n=n, services_per_node=services_per_node,
+        loss_rate=float(doc.get("loss_rate", 0.0)),
+        churn_rate=float(doc.get("churn_rate", 0.0)),
+        paused_frac=float(doc.get("paused_frac", 0.0)),
+        seconds_per_round=doc.get("seconds_per_round"),
+        source="request")
+
+
+def replay_check(result: EvalResult) -> dict:
+    """Verify the winner OUTSIDE the batch: rebuild scenario ``lane``'s
+    classic unbatched sim (``scenario_params`` / ``scenario_timecfg`` /
+    ``scenario_plan`` + the static-prob churn twin) and require its
+    final state to be bit-identical to the fleet row on every
+    :data:`REPLAY_FIELDS` leaf.  A recommendation whose replay
+    diverges is reported with ``identical: false`` — the controller
+    refuses to apply it."""
+    batch, run, lane = result.batch, result.run, result.lane
+    spec = batch.specs[lane]
+    params_i = batch.scenario_params(lane)
+    perturb = (restart_churn_perturb(params_i, prob=spec.churn_prob)
+               if spec.churn_prob > 0 else None)
+    topo = (topo_mod.from_name(batch.topology, params_i.n)
+            if batch.topology else topo_mod.complete(params_i.n))
+    plan_i = batch.scenario_plan(lane)
+    if plan_i is not None:
+        from sidecar_tpu.chaos import ChaosExactSim
+        sim = ChaosExactSim(params_i, topo, batch.scenario_timecfg(lane),
+                            plan=plan_i, perturb=perturb)
+    else:
+        sim = ExactSim(params_i, topo, batch.scenario_timecfg(lane),
+                       perturb=perturb)
+    rounds = int(run.rounds[lane])       # stop=False → the full horizon
+    final, _conv = sim.run(sim.init_state(),
+                           jax.random.PRNGKey(spec.seed), rounds)
+    fleet_st = run.final_states
+    a_src = fleet_st.sim if hasattr(fleet_st, "sim") else fleet_st
+    b_src = final.sim if hasattr(final, "sim") else final
+    fields = {}
+    for name in REPLAY_FIELDS:
+        a = np.asarray(getattr(a_src, name))[lane]
+        b = np.asarray(getattr(b_src, name))
+        fields[name] = bool(np.array_equal(a, b))
+    return {"checked": True, "rounds": rounds,
+            "identical": all(fields.values()), "fields": fields}
+
+
+class AutopilotController:
+    """One recommendation pass over the knob space.
+
+    ``bridge`` (bridge/sim_bridge.SimBridge) supplies the live catalog
+    shape, the protocol clock, and the apply target; either may be
+    omitted for library use (then ``n`` and ``estimate`` must be
+    given)."""
+
+    def __init__(self, bridge=None, state=None,
+                 timecfg: Optional[TimeConfig] = None) -> None:
+        self.bridge = bridge
+        self.state = state if state is not None \
+            else getattr(bridge, "state", None)
+        self.timecfg = timecfg if timecfg is not None \
+            else getattr(bridge, "t", None) or TimeConfig()
+
+    # -- the loop ----------------------------------------------------------
+
+    def recommend(self, *, rules=None, axes=None, estimate=None,
+                  rounds: Optional[int] = None, eps: float = 0.01,
+                  n: Optional[int] = None, services_per_node: int = 4,
+                  fanout: int = 3, budget: int = 15, seed: int = 0,
+                  seed_grid: int = 2, generations: Optional[int] = None,
+                  population: Optional[int] = None, elites: int = 2,
+                  apply: bool = False, provenance: int = 0,
+                  max_batch: Optional[int] = None) -> dict:
+        """Run fit → objective → search → verify → gate and return the
+        report (also stored as ``state.autopilot_report``).  Raises
+        ``ValueError`` on malformed rules/axes/estimate — the bridge
+        maps it to a parseable 400."""
+        t0 = time.perf_counter()
+        if rules is None:
+            raw = os.environ.get(ENV_RULES, "")
+            rules = [r for r in (p.strip() for p in raw.split(","))
+                     if r] or list(DEFAULT_AUTOPILOT_RULES)
+        if not isinstance(rules, (list, tuple)) or not rules:
+            raise ValueError(
+                "'rules' must be a non-empty list of SLO rule strings")
+        rounds = int(rounds if rounds is not None
+                     else _env_int(ENV_ROUNDS, 120))
+        if rounds < 1:
+            raise ValueError(f"rounds={rounds} must be >= 1")
+        generations = int(generations if generations is not None
+                          else _env_int(ENV_GENERATIONS, 2))
+        population = int(population if population is not None
+                         else _env_int(ENV_POPULATION, 6))
+        if n is None:
+            if self.state is not None:
+                with self.state._lock:
+                    n = len(self.state.servers)
+                n = max(int(n), 8)
+            elif estimate is not None and hasattr(estimate, "n"):
+                n = int(estimate.n)
+            else:
+                raise ValueError(
+                    "'n' is required without a live catalog to size "
+                    "the twin from")
+        n, spn = int(n), int(services_per_node)
+
+        if estimate is None:
+            estimate = fit_live(n=n, services_per_node=spn)
+        elif isinstance(estimate, dict):
+            estimate = estimate_from_wire(estimate, n=n,
+                                          services_per_node=spn)
+
+        # Cold-start study clock (the sweep convention): refresh pinned
+        # out so rounds-to-ε measures pure epidemic spread.
+        cfg = dataclasses.replace(self.timecfg,
+                                  refresh_interval_s=10_000.0)
+        params = SimParams(n=n, services_per_node=spn,
+                           fanout=int(fanout), budget=int(budget))
+        if axes is None:
+            axes = default_axes(cfg, params)
+        else:
+            axes = tuple(ax if isinstance(ax, AxisSpec)
+                         else axis_from_wire(ax) for ax in axes)
+
+        spr = cfg.round_ticks / cfg.ticks_per_second
+        objective = Objective(rules, seconds_per_round=spr)
+        base = dict(estimate.base_fields())
+        base["seed"] = int(seed)
+        plan = estimate.fault_plan(seed=int(seed))
+        tracked = ()
+        if provenance:
+            from sidecar_tpu.ops import provenance as prov_ops
+            tracked = prov_ops.default_tracked(params.m,
+                                               int(provenance))
+        evaluator = FleetEvaluator(
+            params, cfg, objective, plan=plan, rounds=rounds,
+            eps=float(eps), base=base, tracked=tracked,
+            max_batch=max_batch)
+        result = es_search(evaluator, axes, seed_grid=int(seed_grid),
+                           generations=generations,
+                           population=population, elites=int(elites),
+                           seed=int(seed))
+        replay = replay_check(result.best)
+
+        # -- the apply gate ------------------------------------------------
+        armed = apply_armed()
+        applied_fields: dict = {}
+        applied = False
+        if apply and armed and replay["identical"]:
+            applied_fields = {k: v for k, v
+                              in result.best.candidate.items()
+                              if k in _TIMECFG_FIELDS}
+            if self.bridge is not None and applied_fields:
+                self.bridge.t = dataclasses.replace(self.bridge.t,
+                                                    **applied_fields)
+            applied = bool(applied_fields)
+            metrics.incr("autopilot.applied")
+        elif apply:
+            metrics.incr("autopilot.apply_blocked")
+
+        wall = time.perf_counter() - t0
+        metrics.incr("autopilot.recommendations")
+        metrics.incr("autopilot.evaluations", result.evaluations)
+        metrics.set_gauge("autopilot.best_score", result.best.score)
+        if result.baseline is not None:
+            metrics.set_gauge("autopilot.baseline_score",
+                              result.baseline.score)
+        best_pass = result.best.slo.get("pass")
+        metrics.set_gauge("autopilot.slo_pass",
+                          1.0 if best_pass else 0.0)
+        metrics.set_gauge("autopilot.replay_identical",
+                          1.0 if replay["identical"] else 0.0)
+        metrics.histogram_since("autopilot.recommend", t0)
+
+        report = {
+            "rules": objective.rules_text,
+            "estimate": estimate.to_json(),
+            "axes": [dataclasses.asdict(ax) for ax in axes],
+            "n": n, "services_per_node": spn,
+            "fanout": int(fanout), "budget": int(budget),
+            "rounds": rounds, "eps": float(eps), "seed": int(seed),
+            "fault_plan": (None if plan is None else
+                           {"nodes_paused": sum(
+                               len(nf.nodes) for nf in plan.nodes),
+                            "seed": plan.seed}),
+            "baseline": (None if result.baseline is None
+                         else result.baseline.to_json()),
+            "recommended": result.best.to_json(),
+            "evaluations": result.evaluations,
+            "dispatches": result.dispatches,
+            "generations_run": result.generations_run,
+            "grid_points": result.grid_points,
+            "candidates": len(result.history),
+            "replay": replay,
+            "apply": {"requested": bool(apply), "armed": armed,
+                      "applied": applied, "fields": applied_fields},
+            "wall_seconds": round(wall, 3),
+        }
+        if self.state is not None:
+            self.state.autopilot_report = report
+        return report

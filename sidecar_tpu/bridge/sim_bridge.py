@@ -751,7 +751,7 @@ class SimBridge:
               conv_every: int = 1, stop: bool = True,
               base: Optional[dict] = None,
               max_batch: Optional[int] = None,
-              provenance: int = 8) -> dict:
+              provenance: int = 8, slo=None) -> dict:
         """Evaluate a protocol-configuration grid in batched fleet
         dispatches (sidecar_tpu/fleet) and return the Pareto table.
 
@@ -773,6 +773,14 @@ class SimBridge:
         docs/telemetry.md), adding a per-scenario ``p99_lag_rounds``
         column to the table — the capacity-planning answer to "which
         config meets the lag SLO", not just "which converges".
+
+        ``slo`` (optional list of ``telemetry/slo.py`` rule strings —
+        "converge <= 5 s", "agreement >= 0.99", "p99 <= 12 rounds")
+        adds a per-row ``slo`` verdict block via
+        ``SloEvaluator.evaluate_row`` — the SAME evaluation contract
+        the autopilot's objective minimizes (docs/autopilot.md).
+        Malformed rules raise ``ValueError`` before any dispatch (a
+        parseable 400 on the HTTP surface).
 
         Each phase of the dispatch path records a span
         (``bridge.sweep.expand`` → ``.build`` → ``.run`` →
@@ -826,6 +834,18 @@ class SimBridge:
             raise ValueError(
                 f"provenance={provenance} must be >= 0 (tracer count; "
                 "0 disables the lag column)")
+        # SLO rules parse BEFORE any dispatch: a malformed rule is a
+        # named 400 up front, not a failure after the grid ran.
+        evaluator = None
+        if slo is not None:
+            from sidecar_tpu.telemetry.slo import SloEvaluator
+            if not isinstance(slo, (list, tuple)) or not slo or \
+                    not all(isinstance(r, str) for r in slo):
+                raise ValueError(
+                    "'slo' must be a non-empty list of rule strings "
+                    "(telemetry/slo.py grammar, e.g. "
+                    "'converge <= 5 s', 'agreement >= 0.99')")
+            evaluator = SloEvaluator(slo)   # ValueError → 400
         t_req = time.perf_counter()
         with _span("bridge.sweep.expand"):
             specs = expand_grid(axes, base)
@@ -856,6 +876,12 @@ class SimBridge:
                 rows = run.table(cfg.round_ticks, cfg.ticks_per_second)
             for j, src_idx in enumerate(idxs):
                 rows[j]["config"] = batch.specs[j].axes()
+                if evaluator is not None:
+                    rows[j]["slo"] = evaluator.evaluate_row(
+                        rows[j], lag=run.lag_summary(j),
+                        seconds_per_round=(cfg.round_ticks
+                                           / cfg.ticks_per_second),
+                        publish=False)
                 table[src_idx] = rows[j]
             batches += 1
         with _span("bridge.sweep.pareto"):
@@ -875,7 +901,14 @@ class SimBridge:
             "scenarios_per_sec": round(len(specs) / wall, 2)
             if wall > 0 else None,
             "table": table,
-            "pareto_front": front,
+            "pareto_front": list(front),
+            # Rows the front refused to consider (never reached ε
+            # within the horizon) — counted, never silently dropped
+            # (fleet/grid.ParetoFront.excluded).
+            "pareto_excluded": {"count": len(front.excluded),
+                                "indices": list(front.excluded)},
+            **({"slo_rules": [r.text() for r in evaluator.rules]}
+               if evaluator is not None else {}),
         }
 
     @staticmethod
@@ -883,6 +916,52 @@ class SimBridge:
         from sidecar_tpu.fleet import build_batches
         return build_batches(specs, params, cfg, family="exact",
                              max_batch=max_batch)
+
+    # -- the autopilot loop (docs/autopilot.md) ----------------------------
+
+    def autopilot_recommend(self, req: dict) -> dict:
+        """``POST /autopilot/recommend``: one pass of the digital-twin
+        control loop (sidecar_tpu/autopilot) — fit current conditions
+        (or take the request's ``estimate``), search the knob space
+        against the request's ``rules``, replay-verify the winner, and
+        recommend (apply only behind ``SIDECAR_TPU_AUTOPILOT_APPLY``).
+        Malformed rules/axes/estimates raise ``ValueError`` — a
+        parseable 400."""
+        from sidecar_tpu.autopilot import AutopilotController
+
+        allowed = {"rules", "axes", "estimate", "rounds", "eps", "n",
+                   "services_per_node", "fanout", "budget", "seed",
+                   "seed_grid", "generations", "population", "elites",
+                   "apply", "provenance"}
+        bad = set(req) - allowed
+        if bad:
+            raise ValueError(
+                f"unknown autopilot field(s) {sorted(bad)}; expected "
+                f"a subset of {sorted(allowed)}")
+        n = req.get("n")
+        rounds = req.get("rounds")
+        generations = req.get("generations")
+        population = req.get("population")
+        ctl = AutopilotController(bridge=self)
+        return ctl.recommend(
+            rules=req.get("rules"),
+            axes=req.get("axes"),
+            estimate=req.get("estimate"),
+            rounds=None if rounds is None else int(rounds),
+            eps=float(req.get("eps", 0.01)),
+            n=None if n is None else int(n),
+            services_per_node=int(req.get("services_per_node", 4)),
+            fanout=int(req.get("fanout", 3)),
+            budget=int(req.get("budget", 15)),
+            seed=int(req.get("seed", 0)),
+            seed_grid=int(req.get("seed_grid", 2)),
+            generations=None if generations is None
+            else int(generations),
+            population=None if population is None
+            else int(population),
+            elites=int(req.get("elites", 2)),
+            apply=bool(req.get("apply", False)),
+            provenance=int(req.get("provenance", 0)))
 
     @staticmethod
     def _map_deltas(batches, mapping: BridgeMapping, params: SimParams,
@@ -954,9 +1033,22 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
     (sidecar_tpu/fleet, docs/sweep.md): the grid is expanded, chunked
     into vmapped fleet dispatches, and answered with a per-config
     Pareto table (rounds/seconds-to-ε, analytic exchange bytes,
-    ``pareto_front`` indices).  Malformed grids (unknown axis names,
-    out-of-range knobs, duplicate names) return 400 with a parseable
-    ``{"message": ...}`` body."""
+    ``pareto_front`` indices, plus the counted ``pareto_excluded``
+    never-converged rows).  An optional ``"slo": [rule, ...]`` list
+    (telemetry/slo.py grammar) adds per-row verdict blocks.  Malformed
+    grids or rules (unknown axis names, out-of-range knobs, duplicate
+    names, bad rule syntax) return 400 with a parseable
+    ``{"message": ...}`` body.
+
+    POST /autopilot/recommend {"rules": [slo rule, ...], "axes":
+    [{"name": knob, "lo": L, "hi": H, "log": bool, "integer": bool,
+    "base": status-quo}, ...], "estimate": {"loss_rate": f,
+    "churn_rate": f, "paused_frac": f}, "rounds": N, "n": nodes,
+    "generations": G, "population": P, "apply": bool} — one pass of
+    the digital-twin autopilot (sidecar_tpu/autopilot,
+    docs/autopilot.md): fit → search → replay-verify → recommend;
+    ``apply`` rewrites the bridge clock only behind the
+    ``SIDECAR_TPU_AUTOPILOT_APPLY=1`` gate."""
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -1011,12 +1103,17 @@ def serve_bridge(bridge: SimBridge, bind: str = "127.0.0.1",
                 conv_every=int(req.get("conv_every", 1)),
                 stop=bool(req.get("stop", True)),
                 base=base,
-                provenance=int(req.get("provenance", 8)))
+                provenance=int(req.get("provenance", 8)),
+                slo=req.get("slo"))
+
+        def _do_autopilot(self, req: dict) -> dict:
+            return bridge.autopilot_recommend(req)
 
         def do_POST(self):
             route = self.path.split("?")[0]
             handlers = {"/simulate": self._do_simulate,
-                        "/sweep": self._do_sweep}
+                        "/sweep": self._do_sweep,
+                        "/autopilot/recommend": self._do_autopilot}
             if route not in handlers:
                 self._reply(404, {"message": "not found"})
                 return

@@ -18,6 +18,7 @@ from sidecar_tpu.fleet.batch import (  # noqa: F401
 )
 from sidecar_tpu.fleet.engine import FleetRun, FleetSim  # noqa: F401
 from sidecar_tpu.fleet.grid import (  # noqa: F401
+    ParetoFront,
     build_batches,
     expand_grid,
     pareto_front,

@@ -123,22 +123,41 @@ def build_batches(specs, params, timecfg: TimeConfig = TimeConfig(),
     return out
 
 
+class ParetoFront(list):
+    """The front indices, PLUS the rows the front refused to consider.
+
+    Behaves exactly like the plain ``list`` of non-dominated indices it
+    always was (existing callers index/iterate it unchanged), with one
+    extra attribute: ``excluded`` — the indices of rows dropped before
+    domination testing because a key was ``None`` (never converged
+    within the horizon).  The repo's no-silent-caps rule: a sweep that
+    quietly discards half its grid reads as "these are the trade-offs"
+    when it should read "half your configs never reached ε"."""
+
+    def __init__(self, front=(), excluded=()):
+        super().__init__(front)
+        self.excluded = tuple(excluded)
+
+
 def pareto_front(rows: list, *, keys=("rounds_to_eps",
-                                      "exchange_bytes")) -> list:
+                                      "exchange_bytes")) -> ParetoFront:
     """Indices of the non-dominated rows, minimizing every key (the
     convergence-time-vs-bytes trade the capacity planner reads).
     Rows with a ``None`` key (never converged within the horizon) are
     excluded from the front outright: a config that never reaches ε is
-    not a capacity-planning candidate however cheap its wire bytes —
-    the table still lists it, flagged by its ``None``."""
+    not a capacity-planning candidate however cheap its wire bytes.
+    They are NOT silently dropped — the returned :class:`ParetoFront`
+    counts them in its ``excluded`` tuple and the table still lists
+    them, flagged by their ``None``."""
     def val(row, k):
         v = row.get(k)
         return float("inf") if v is None else float(v)
 
-    front = []
+    front, excluded = [], []
     for i, a in enumerate(rows):
         av = [val(a, k) for k in keys]
         if any(v == float("inf") for v in av):
+            excluded.append(i)
             continue
         dominated = False
         for j, b in enumerate(rows):
@@ -151,4 +170,4 @@ def pareto_front(rows: list, *, keys=("rounds_to_eps",
                 break
         if not dominated:
             front.append(i)
-    return front
+    return ParetoFront(front, excluded)

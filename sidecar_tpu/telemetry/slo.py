@@ -17,8 +17,12 @@ three ways:
   evaluator is attached to the catalog (``state.slo_evaluator``).
 
 Rule syntax (one string per rule): ``"<pctl> <= <threshold> <unit>"``
-with pctl ∈ {p50, p95, p99, max} and unit ∈ {rounds, s, seconds, ms}
-— e.g. ``"p99 <= 12 rounds"``, ``"p95<=1.5s"``.
+with pctl ∈ {p50, p95, p99, max, converge} and unit ∈ {rounds, s,
+seconds, ms} — e.g. ``"p99 <= 12 rounds"``, ``"p95<=1.5s"``.  The
+``converge`` subject bounds whole-cluster ε-convergence rather than a
+lag percentile ("converge <= 20 rounds", "converge <= 5 s") and is
+checked against sweep/autopilot result rows via
+:meth:`SloEvaluator.evaluate_row`.
 
 The coherence plane (telemetry/coherence.py) adds a FLOOR rule form,
 ``"agreement >= <fraction>"``, and :meth:`SloEvaluator
@@ -52,7 +56,7 @@ DEFAULT_RULES = ("p99 <= 16 rounds", "p99 <= 2 s")
 DEFAULT_COHERENCE_RULES = ("p99 <= 2 s", "agreement >= 0.99")
 
 _RULE_RE = re.compile(
-    r"^\s*(p50|p95|p99|max)\s*<=\s*([0-9.]+)\s*"
+    r"^\s*(p50|p95|p99|max|converge)\s*<=\s*([0-9.]+)\s*"
     r"(rounds?|seconds?|s|ms)\s*$", re.IGNORECASE)
 # Floor form — a LOWER bound on a unitless fraction gauge
 # ("agreement >= 0.99"): the coherence plane's quorum-agreement SLO.
@@ -65,7 +69,7 @@ class SloRule:
     """One declarative bound: a lag-percentile ceiling (``<=``) or a
     fraction floor (``>=``)."""
 
-    percentile: str          # p50 | p95 | p99 | max | agreement
+    percentile: str          # p50 | p95 | p99 | max | converge | agreement
     threshold: float         # in `unit`
     unit: str                # "rounds" | "s" | "ms" | "fraction"
     direction: str = "<="    # "<=" ceiling | ">=" floor
@@ -86,8 +90,8 @@ class SloRule:
                        direction=">=")
         raise ValueError(
             f"bad SLO rule {text!r}: expected "
-            "'<p50|p95|p99|max> <= <threshold> <rounds|s|ms>' or "
-            "'agreement >= <fraction>'")
+            "'<p50|p95|p99|max|converge> <= <threshold> "
+            "<rounds|s|ms>' or 'agreement >= <fraction>'")
 
     @property
     def key(self) -> str:
@@ -100,6 +104,8 @@ class SloRule:
     def text(self) -> str:
         if self.direction == ">=":
             return f"{self.percentile} >= {self.threshold:g}"
+        if self.percentile == "converge":
+            return f"converge <= {self.threshold:g} {self.unit}"
         return (f"{self.percentile} lag <= {self.threshold:g} "
                 f"{self.unit}")
 
@@ -171,6 +177,55 @@ class SloEvaluator:
             verdicts.append(self._verdict(rule, observed, ok, publish))
         return self._block(verdicts)
 
+    def evaluate_row(self, row: dict, lag: Optional[dict] = None,
+                     seconds_per_round: Optional[float] = None,
+                     publish: bool = False) -> dict:
+        """Verdict block for ONE fleet-sweep result row
+        (fleet/engine.FleetRun.table): the contract ``POST /sweep``
+        per-config verdicts and the autopilot objective share.
+
+        * ``converge`` rules bound ``rounds_to_eps`` (rounds unit) or
+          ``seconds_to_eps`` (s/ms).  A row that RAN but never reached
+          ε is an honest FAIL (observed null, pass false) — never a
+          null verdict: "never converged" violates every convergence
+          ceiling.
+        * percentile rules (p50/p95/p99/max) bound the row's pooled
+          propagation-lag summary (``lag``), rounds directly, s/ms via
+          ``seconds_per_round``; unevaluable → null.
+        * ``agreement >= f`` floors bound ``digest_agreement``;
+          null when the row carries no digest."""
+        verdicts = []
+        for rule in self.rules:
+            observed, ok = None, None
+            if rule.direction == ">=":
+                g = row.get("digest_agreement")
+                if g is not None:
+                    observed = float(g)
+                    ok = observed >= rule.threshold
+            elif rule.percentile == "converge":
+                if rule.unit == "rounds":
+                    v = row.get("rounds_to_eps")
+                    thr = rule.threshold
+                else:
+                    v = row.get("seconds_to_eps")
+                    thr = _threshold_seconds(rule)
+                if v is not None:
+                    observed = float(v)
+                    ok = observed <= thr
+                elif row.get("rounds_run"):
+                    ok = False      # ran the horizon, never converged
+            elif lag and lag.get("samples"):
+                rounds_v = lag.get(rule.percentile)
+                if rounds_v is not None:
+                    if rule.unit == "rounds":
+                        observed = float(rounds_v)
+                        ok = observed <= rule.threshold
+                    elif seconds_per_round is not None:
+                        observed = float(rounds_v) * seconds_per_round
+                        ok = observed <= _threshold_seconds(rule)
+            verdicts.append(self._verdict(rule, observed, ok, publish))
+        return self._block(verdicts)
+
     def evaluate_live(self, publish: bool = True) -> dict:
         """Verdict block for the LIVE path: seconds/ms rules checked
         against the pooled ``propagation.query.lag`` histogram (the
@@ -228,8 +283,9 @@ class SloEvaluator:
     def _verdict(self, rule: SloRule, observed, ok,
                  publish: bool, prefix: str = "") -> dict:
         if publish and ok is not None:
-            metrics.set_gauge(f"slo.{prefix}{rule.key}.observed",
-                              observed)
+            if observed is not None:
+                metrics.set_gauge(f"slo.{prefix}{rule.key}.observed",
+                                  observed)
             metrics.set_gauge(f"slo.{prefix}{rule.key}.ok",
                               1.0 if ok else 0.0)
         return {"rule": rule.text(),

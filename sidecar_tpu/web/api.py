@@ -218,6 +218,8 @@ class SidecarApi:
             return self.digest_dump()
         if parts == ["coherence.json"]:
             return self.coherence_dump()
+        if parts == ["autopilot.json"]:
+            return self.autopilot_dump()
         if parts == ["coherence"]:
             return self.coherence_page()
         if parts == ["damping.json"] or parts == ["damping"]:
@@ -467,6 +469,19 @@ class SidecarApi:
         if doc_fn is None:
             return self._json(200, {"enabled": False})
         return self._json(200, doc_fn())
+
+    def autopilot_dump(self):
+        """The last autopilot recommendation report
+        (``GET /api/autopilot.json`` — sidecar_tpu/autopilot,
+        docs/autopilot.md): the fitted condition estimate, SLO rules,
+        baseline-vs-recommended verdicts, search cost, the replay
+        bit-identity check, and the apply-gate outcome.  ``{"enabled":
+        false}`` until a recommendation has run (the digest_dump
+        graceful-absence convention)."""
+        report = getattr(self.state, "autopilot_report", None)
+        if report is None:
+            return self._json(200, {"enabled": False})
+        return self._json(200, {"enabled": True, **report})
 
     def coherence_dump(self):
         """Cluster coherence view (``GET /api/coherence.json`` —

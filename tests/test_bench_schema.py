@@ -34,6 +34,12 @@ GOOD_RESULT = {
     "antientropy": {"live": {"bytes_ratio": 19.6},
                     "sim": {"heal_round": 42},
                     "bytes_ratio": 19.6, "heal_time_ratio": 0.13},
+    "autopilot": {"fit": {"loss_rate": 0.3},
+                  "baseline": {"pass": False},
+                  "recommended": {"pass": True},
+                  "closed_loop": True, "evaluations": 21,
+                  "grid_points": 64, "eval_ratio": 0.3281,
+                  "replay_bit_identical": True},
 }
 
 
@@ -74,6 +80,30 @@ class TestResultRecords:
     def test_antientropy_twin_blocks_must_be_objects(self):
         doc = dict(GOOD_RESULT, antientropy={"live": [1], "sim": {}})
         assert any("antientropy.live" in i for i in issues_for(doc))
+
+    def test_autopilot_honest_nulls_legal(self):
+        # BENCH_AUTOPILOT skipped claims: ratio/replay may be null,
+        # baseline may be null — but never the wrong type.
+        doc = dict(GOOD_RESULT,
+                   autopilot={"fit": {}, "baseline": None,
+                              "recommended": {},
+                              "eval_ratio": None,
+                              "replay_bit_identical": None})
+        assert issues_for(doc) == []
+
+    def test_autopilot_bad_types_flagged(self):
+        doc = dict(GOOD_RESULT,
+                   autopilot={"fit": [], "baseline": "none",
+                              "recommended": {},
+                              "eval_ratio": "a third",
+                              "replay_bit_identical": 1,
+                              "closed_loop": "yes"})
+        issues = issues_for(doc)
+        for field in ("autopilot.fit", "autopilot.baseline",
+                      "autopilot.eval_ratio",
+                      "autopilot.replay_bit_identical",
+                      "autopilot.closed_loop"):
+            assert any(field in i for i in issues), field
 
 
 class TestErrorRecords:

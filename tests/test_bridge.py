@@ -116,6 +116,89 @@ class TestBridgeServer:
             server.shutdown()
 
 
+AUTOPILOT_REQ = {
+    "rules": ["converge <= 30 rounds"],
+    "estimate": {"loss_rate": 0.2},
+    "rounds": 20, "seed": 1, "seed_grid": 1, "generations": 1,
+    "population": 2,
+    "axes": [{"name": "push_pull_interval_s", "lo": 0.5, "hi": 30.0,
+              "log": True, "base": 2.0}],
+}
+
+
+class TestAutopilotRoute:
+    """``POST /autopilot/recommend`` (docs/autopilot.md): the
+    digital-twin loop over the wire, the report persisted for
+    ``GET /api/autopilot.json``, and the 400 contract for malformed
+    rules/axes/estimates/fields."""
+
+    def test_recommend_over_http_and_api_dump(self):
+        bridge = SimBridge(make_state(), CFG)
+        server = serve_bridge(bridge, port=0)
+        try:
+            port = server.server_address[1]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/autopilot/recommend",
+                data=json.dumps(AUTOPILOT_REQ).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                doc = json.loads(resp.read())
+        finally:
+            server.shutdown()
+        assert doc["rules"] == ["converge <= 30 rounds"]
+        assert doc["estimate"]["loss_rate"] == 0.2
+        assert doc["recommended"]["slo"]["pass"] is True
+        assert doc["replay"]["identical"] is True
+        assert doc["apply"]["applied"] is False    # never armed here
+        assert doc["evaluations"] == doc["candidates"] > 0
+        # The report is persisted on the catalog state and surfaced by
+        # the web plane's GET /api/autopilot.json.
+        from sidecar_tpu.web.api import SidecarApi
+        api = SidecarApi(bridge.state, members_fn=lambda: ["h1"],
+                         cluster_name="t")
+        status, ctype, body, _ = api.dispatch("GET",
+                                              "/api/autopilot.json")
+        assert status == 200 and ctype == "application/json"
+        dumped = json.loads(body)
+        assert dumped["enabled"] is True
+        assert dumped["recommended"] == doc["recommended"]
+
+    def test_api_dump_before_any_recommendation(self):
+        from sidecar_tpu.web.api import SidecarApi
+        api = SidecarApi(make_state(), members_fn=lambda: ["h1"],
+                         cluster_name="t")
+        _, _, body, _ = api.dispatch("GET", "/api/autopilot.json")
+        assert json.loads(body) == {"enabled": False}
+
+    def test_malformed_autopilot_request_is_400(self):
+        bridge = SimBridge(make_state(), CFG)
+        server = serve_bridge(bridge, port=0)
+        try:
+            port = server.server_address[1]
+            for bad in (
+                    dict(AUTOPILOT_REQ, rules=["p99 <= soon"]),
+                    dict(AUTOPILOT_REQ, rules=[]),
+                    dict(AUTOPILOT_REQ, estimate={"loss_rate": 2.0}),
+                    dict(AUTOPILOT_REQ, estimate={"bogus": 0.1}),
+                    dict(AUTOPILOT_REQ,
+                         axes=[{"name": "no_such_knob",
+                                "lo": 0, "hi": 1}]),
+                    dict(AUTOPILOT_REQ, typo_field=1),
+            ):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/autopilot/recommend",
+                    data=json.dumps(bad).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST")
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 400
+                assert json.loads(err.value.read())["message"]
+        finally:
+            server.shutdown()
+
+
 class TestChunkedPipeline:
     """PR 3: long simulate() requests are split into pipelined donated
     chunks (SimBridge.CHUNK_ROUNDS).  Chunking must be bit-invisible:

@@ -418,6 +418,23 @@ class TestGrid:
         ]
         assert pareto_front(rows) == [0, 1]
 
+    def test_pareto_front_counts_excluded(self):
+        """Never-converged rows are EXCLUDED from the front, not
+        silently dropped: the ``ParetoFront.excluded`` tuple names
+        them (and stays invisible to list-typed callers)."""
+        rows = [
+            {"rounds_to_eps": 10, "exchange_bytes": 100},
+            {"rounds_to_eps": None, "exchange_bytes": 1},
+            {"rounds_to_eps": 5, "exchange_bytes": 200},
+            {"rounds_to_eps": 7, "exchange_bytes": None},
+        ]
+        front = pareto_front(rows)
+        assert isinstance(front, list)
+        assert front == [0, 2]
+        assert front.excluded == (1, 3)
+        # All-converged grids exclude nothing.
+        assert pareto_front(rows[:1]).excluded == ()
+
 
 class TestTopologyAxis:
     """Topology as a compile-key sweep axis: grid points group into
@@ -614,6 +631,65 @@ class TestSweepHttp:
                 assert err.value.code == 400
                 doc = json.loads(err.value.read())
                 assert doc["message"]
+        finally:
+            server.shutdown()
+
+    def test_sweep_reports_pareto_excluded(self):
+        """A config that cannot converge within the horizon shows up
+        in ``pareto_excluded`` with its index — the sweep surface's
+        half of the ParetoFront contract."""
+        doc = self._bridge().sweep(
+            axes={"drop_prob": [0.0, 0.97]}, rounds=6, eps=0.001,
+            n=16, services_per_node=2, budget=5, provenance=0,
+            stop=False)
+        assert doc["pareto_excluded"]["count"] >= 1
+        for i in doc["pareto_excluded"]["indices"]:
+            assert doc["table"][i]["rounds_to_eps"] is None
+            assert i not in doc["pareto_front"]
+
+    def test_sweep_slo_verdicts_per_row(self):
+        """``"slo"`` rules in the request annotate every row with the
+        telemetry/slo.py verdict block and echo the parsed rules."""
+        doc = self._bridge().sweep(
+            axes={"drop_prob": [0.0, 0.97]}, rounds=24, eps=0.05,
+            n=16, services_per_node=2, budget=5, provenance=0,
+            stop=False,
+            slo=["converge <= 12 rounds", "agreement >= 0.99"])
+        assert doc["slo_rules"] == ["converge <= 12 rounds",
+                                    "agreement >= 0.99"]
+        verdicts = {row["config"]["drop_prob"]: row["slo"]
+                    for row in doc["table"]}
+        assert verdicts[0.0]["pass"] is True
+        # 97% loss cannot reach ε in 24 rounds: an honest FAIL (the
+        # run finished the horizon), never a null free pass.
+        assert verdicts[0.97]["pass"] is False
+        assert verdicts[0.97]["evaluated"] == 2
+
+    def test_sweep_without_slo_has_no_block(self):
+        doc = self._bridge().sweep(
+            axes={"fanout": [2]}, rounds=10, eps=0.05, n=12,
+            services_per_node=2, budget=5, provenance=0)
+        assert "slo_rules" not in doc
+        assert all("slo" not in row for row in doc["table"])
+
+    def test_sweep_malformed_slo_is_400(self):
+        from sidecar_tpu.bridge import serve_bridge
+
+        server = serve_bridge(self._bridge(), port=0)
+        try:
+            port = server.server_address[1]
+            for bad_slo in (["p99 <= fast"], [], "converge <= 5 s",
+                            [42]):
+                body = json.dumps({
+                    "axes": {"fanout": [2]}, "rounds": 10, "n": 12,
+                    "services_per_node": 2, "slo": bad_slo}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/sweep", data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 400
+                assert json.loads(err.value.read())["message"]
         finally:
             server.shutdown()
 

@@ -65,6 +65,19 @@ class TestRepoIsClean:
                 f"{expected} not seen by the checker:\n{names}")
         assert all(d.endswith(" donates") for d in fleet), names
 
+    def test_autopilot_adds_no_new_scan_drivers(self):
+        """PR 17 satellite: the autopilot deliberately reuses the
+        fleet plane's jitted drivers (FleetSim via
+        autopilot/search.FleetEvaluator) rather than minting its own —
+        pin that so a future jitted search driver cannot appear
+        without entering the donate-or-waiver contract."""
+        drivers = list_drivers(REPO / "sidecar_tpu")
+        autopilot = [d for d in drivers if "autopilot/" in d]
+        assert autopilot == [], (
+            "autopilot grew its own jitted scan drivers — they must "
+            "donate (or carry a no-donate waiver) and this pin must "
+            f"be updated:\n" + "\n".join(autopilot))
+
     def test_cli_list_mode(self):
         proc = subprocess.run(
             [sys.executable, str(REPO / "tools" /

@@ -52,6 +52,7 @@ KNOWN_RESULT_BLOCKS = {
     "topology": dict,
     "coherence": dict,
     "antientropy": dict,
+    "autopilot": dict,
     "cost": dict,
     "regression": dict,
     "telemetry": dict,
@@ -127,6 +128,34 @@ def validate_result(doc: dict, issues: List[str],
                 issues.append(
                     f"{ctx}: antientropy.{key} is neither "
                     "null nor a number")
+    if isinstance(doc.get("autopilot"), dict):
+        ap = doc["autopilot"]
+        for key in ("fit", "recommended"):
+            if key in ap and not isinstance(ap[key], dict):
+                issues.append(
+                    f"{ctx}: autopilot.{key} is not an object")
+        # baseline may be null (include_baseline off) but never a
+        # non-object; the headline eval_ratio is number-or-null and
+        # replay_bit_identical bool-or-null (honest non-results).
+        if "baseline" in ap and ap["baseline"] is not None \
+                and not isinstance(ap["baseline"], dict):
+            issues.append(
+                f"{ctx}: autopilot.baseline is neither null nor an "
+                "object")
+        ratio = ap.get("eval_ratio")
+        if ratio is not None and not isinstance(ratio, NUMBER):
+            issues.append(
+                f"{ctx}: autopilot.eval_ratio is neither null nor "
+                "a number")
+        replay = ap.get("replay_bit_identical")
+        if replay is not None and not isinstance(replay, bool):
+            issues.append(
+                f"{ctx}: autopilot.replay_bit_identical is neither "
+                "null nor a bool")
+        if "closed_loop" in ap \
+                and not isinstance(ap["closed_loop"], bool):
+            issues.append(
+                f"{ctx}: autopilot.closed_loop is not a bool")
 
 
 def validate_error(doc: dict, issues: List[str],
