@@ -748,6 +748,27 @@ def main() -> None:
         except Exception as exc:  # the headline must survive a side bench
             print(f"# query bench failed: {exc}", file=sys.stderr)
 
+    # The 100k-watcher read-path soak (benchmarks/bench_query.py
+    # run_query_scale): subscriber ramp across relay tiers, gap-free
+    # delivery, p50/p99 hub lag, and the zero-copy serialization ratio.
+    # BENCH_QUERY_SCALE=0 skips it; BENCH_QUERY_SCALE_SUBS caps the ramp.
+    query_scale = None
+    if os.environ.get("BENCH_QUERY", "1") != "0" and \
+            os.environ.get("BENCH_QUERY_SCALE", "1") != "0":
+        try:
+            _watchdog_note("query_scale")
+            import importlib.util as _ilu
+            _spec = _ilu.spec_from_file_location(
+                "bench_query_scale",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "benchmarks", "bench_query.py"))
+            _bqs = _ilu.module_from_spec(_spec)
+            _spec.loader.exec_module(_bqs)
+            query_scale = _bqs.run_query_scale()
+            _watchdog_note("query_scale", {"query_scale": query_scale})
+        except Exception as exc:
+            print(f"# query scale bench failed: {exc}", file=sys.stderr)
+
     # Robustness under chaos (benchmarks/robustness.py, docs/chaos.md):
     # false-positive tombstone evictions + proxy-config churn under
     # config6-seeded loss/pause chaos, suspicion+damping ON vs OFF at
@@ -966,6 +987,7 @@ def main() -> None:
         **({"north_star_faithful_k1024": north_star_k1024}
            if north_star_k1024 else {}),
         **({"query": query_bench} if query_bench else {}),
+        **({"query_scale": query_scale} if query_scale else {}),
         **({"robustness": robustness} if robustness else {}),
         **({"adversary": adversary} if adversary else {}),
         **({"sweep": sweep} if sweep else {}),

@@ -22,19 +22,30 @@ PR added.  Run: python benchmarks/bench_query.py  → one JSON line.
 from __future__ import annotations
 
 import json
+import math
+import os
 import pathlib
 import statistics
 import sys
 import threading
 import time
+from typing import Optional
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
+from sidecar_tpu import metrics  # noqa: E402
 from sidecar_tpu import service as S  # noqa: E402
 from sidecar_tpu.catalog import ServicesState  # noqa: E402
+from sidecar_tpu.query.hub import relay_tree  # noqa: E402
 
 NS = S.NS_PER_SECOND
 T0 = 1_700_000_000 * NS
+
+# Largest subscriber count at which the per-subscriber-serialization
+# baseline is actually executed (it is O(n_subs × events) json.dumps
+# calls — the exact cost the zero-copy path deletes; running it at 100k
+# would dominate the bench for no extra information).
+BASELINE_MAX_SUBS = 2000
 
 
 def build_state(hosts: int, services_per_host: int) -> ServicesState:
@@ -152,8 +163,11 @@ def bench_watch_fanout(state: ServicesState, n_subs: int,
 
 
 def run_query_bench(hosts: int = 64, services_per_host: int = 16,
-                    duration_s: float = 0.5, n_subs: int = 32,
+                    duration_s: float = 0.5,
+                    n_subs: Optional[int] = None,
                     events: int = 200) -> dict:
+    if n_subs is None:
+        n_subs = int(os.environ.get("BENCH_QUERY_SUBS", "32"))
     state = build_state(hosts, services_per_host)
     out = {
         "snapshot_hosts": hosts,
@@ -168,9 +182,235 @@ def run_query_bench(hosts: int = 64, services_per_host: int = 16,
     return out
 
 
+# -- the 100k-watcher synthetic soak (the query_scale bench block) ---------
+
+def _percentile(sorted_vals: list, q: float) -> Optional[float]:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(len(sorted_vals) * q))
+    return sorted_vals[idx]
+
+
+def _scale_level(n_subs: int, hosts: int, services_per_host: int,
+                 events: int, workers: int, max_fanout: int,
+                 subs_per_relay: int) -> dict:
+    """One ramp level: n_subs synthetic watchers (Subscription objects
+    drained by a small worker pool — no thread per watcher) spread
+    across a relay tree, `events` versions published through it.
+
+    Measures per level: root publish wall time (O(relays), the
+    writer-path claim), sampled p50/p99 publish-to-deliver lag in ms
+    and in versions, gap-free delivery, and serialization work — bytes
+    actually encoded per published version (query.encode.* deltas)
+    vs the per-subscriber-serialization baseline re-encoding the same
+    documents once per watcher (executed only up to BASELINE_MAX_SUBS).
+    """
+    state = build_state(hosts, services_per_host)
+    hub = state.query_hub()
+    enc_bytes0 = metrics.counter("query.encode.bytes")
+    enc_count0 = metrics.counter("query.encode.count")
+    dropped0 = metrics.counter("query.hub.dropped")
+    coalesced0 = metrics.counter("query.hub.coalesced")
+
+    relays: list = []
+    if n_subs > subs_per_relay:
+        n_leaves = math.ceil(n_subs / subs_per_relay)
+        leaves, relays = relay_tree(hub, n_leaves,
+                                    max_fanout=max_fanout)
+        tiers = 1
+        while n_leaves > max_fanout:
+            n_leaves = math.ceil(n_leaves / max_fanout)
+            tiers += 1
+    else:
+        leaves, tiers = [hub], 0
+    subs = [leaves[i % len(leaves)].subscribe(f"s{i}",
+                                              buffer=events + 8,
+                                              prime=False)
+            for i in range(n_subs)]
+    base_version = hub.current().version
+
+    # Per-sub cursors: expect[i] is the next delta version sub i must
+    # see; a resync marker legally jumps it (cursor reset), anything
+    # else is a gap.
+    expect = [base_version + 1] * n_subs
+    target = base_version + events
+    gaps = [0]
+    resyncs = [0]
+    deliveries = [0]
+    bytes_handed = [0]
+    lag_ms_samples: list = []
+    lag_ver_samples: list = []
+    stats_lock = threading.Lock()
+    first_events: list = []   # sub 0's events, for the baseline replay
+    deadline = time.perf_counter() + 180.0
+
+    def worker(lo: int, hi: int) -> None:
+        remaining = set(range(lo, hi))
+        l_gaps = l_resyncs = l_deliv = l_bytes = 0
+        l_ms: list = []
+        l_ver: list = []
+        while remaining and time.perf_counter() < deadline:
+            progressed = False
+            for i in list(remaining):
+                evs = subs[i].drain()
+                if evs:
+                    progressed = True
+                for ev in evs:
+                    l_deliv += 1
+                    if ev.kind == "snapshot":
+                        l_resyncs += 1
+                        expect[i] = ev.version + 1
+                        buf = ev.snapshot.resync_doc_bytes()
+                    else:
+                        if ev.version != expect[i]:
+                            l_gaps += 1
+                        expect[i] = ev.version + 1
+                        # The zero-copy handoff: the shared cached wire
+                        # buffer, as the /watch writer and UrlListener
+                        # POST it.
+                        buf = ev.delta_doc_bytes()
+                        if l_deliv % 97 == 1:
+                            l_ms.append(max(0.0, (time.time_ns()
+                                                  - ev.published_ns)
+                                            / 1e6))
+                            cur = hub.current().version
+                            l_ver.append(max(0, cur - ev.version))
+                    l_bytes += len(buf)
+                    if i == 0:
+                        first_events.append(ev)
+                if expect[i] > target:
+                    remaining.discard(i)
+            if not progressed:
+                time.sleep(0.002)
+        with stats_lock:
+            gaps[0] += l_gaps
+            resyncs[0] += l_resyncs
+            deliveries[0] += l_deliv
+            bytes_handed[0] += l_bytes
+            lag_ms_samples.extend(l_ms)
+            lag_ver_samples.extend(l_ver)
+            if remaining:
+                gaps[0] += len(remaining)  # stalled subs count as gaps
+
+    n_workers = min(workers, n_subs)
+    bounds = [(k * n_subs // n_workers, (k + 1) * n_subs // n_workers)
+              for k in range(n_workers)]
+    threads = [threading.Thread(target=worker, args=b, daemon=True)
+               for b in bounds]
+    for t in threads:
+        t.start()
+
+    publish_ms = []
+    for ei in range(events):
+        t0 = time.perf_counter()
+        # Status flip per event (unchanged-status re-announces emit no
+        # change event, see bench_resolve's writer).
+        state.add_service_entry(S.Service(
+            id="host001-svc001", name="svc001", image="bench:1",
+            hostname="host001", updated=T0 + 10**13 + ei,
+            status=S.ALIVE if ei % 2 else S.UNHEALTHY))
+        publish_ms.append((time.perf_counter() - t0) * 1e3)
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.perf_counter()) + 5)
+    drained = all(not t.is_alive() for t in threads)
+
+    enc_bytes = metrics.counter("query.encode.bytes") - enc_bytes0
+    enc_count = metrics.counter("query.encode.count") - enc_count0
+    zero_copy_bpv = enc_bytes / events
+
+    baseline = None
+    if n_subs <= BASELINE_MAX_SUBS and first_events:
+        # The old read path, replayed honestly: one json.dumps of the
+        # SAME document per subscriber per event.
+        bl_bytes = 0
+        t0 = time.perf_counter()
+        for ev in first_events:
+            if ev.kind != "delta":
+                continue
+            for _ in range(n_subs):
+                bl_bytes += len(json.dumps(
+                    {"Version": ev.version,
+                     "ChangeEvent": ev.change.to_json()},
+                    separators=(",", ":")).encode())
+        baseline = {
+            "bytes_per_version": round(bl_bytes
+                                       / max(1, len(first_events))),
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 2),
+        }
+
+    for r in relays:
+        r.close()
+    if not relays:
+        for sub in subs:
+            sub.close()
+    publish_ms.sort()
+    lag_ms_samples.sort()
+    lag_ver_samples.sort()
+    return {
+        "subscribers": n_subs,
+        "events": events,
+        "relays": len(relays),
+        "tiers": tiers,
+        "gap_free": drained and gaps[0] == 0,
+        "gaps": gaps[0],
+        "resyncs": resyncs[0],
+        "deliveries": deliveries[0],
+        "dropped": metrics.counter("query.hub.dropped") - dropped0,
+        "coalesced": metrics.counter("query.hub.coalesced") - coalesced0,
+        "publish_p50_ms": round(_percentile(publish_ms, 0.5), 3),
+        "publish_p99_ms": round(_percentile(publish_ms, 0.99), 3),
+        "lag_p50_ms": (round(_percentile(lag_ms_samples, 0.5), 3)
+                       if lag_ms_samples else None),
+        "lag_p99_ms": (round(_percentile(lag_ms_samples, 0.99), 3)
+                       if lag_ms_samples else None),
+        "lag_p50_versions": (_percentile(lag_ver_samples, 0.5)
+                             if lag_ver_samples else None),
+        "lag_p99_versions": (_percentile(lag_ver_samples, 0.99)
+                             if lag_ver_samples else None),
+        "bytes_encoded_per_version": round(zero_copy_bpv, 1),
+        "encodings_per_version": round(enc_count / events, 2),
+        "bytes_handed_off": bytes_handed[0],
+        **({"baseline": baseline} if baseline else {}),
+    }
+
+
+def run_query_scale(hosts: int = 16, services_per_host: int = 8,
+                    events: int = 6, workers: int = 8,
+                    max_fanout: int = 16,
+                    subs_per_relay: int = 2048) -> dict:
+    """The 100k-watcher soak: subscriber ramp 32 → BENCH_QUERY_SCALE_SUBS
+    (default 100000) across relay tiers; headline = gap-free at max
+    scale, bounded p99 version lag, and the zero-copy serialization
+    ratio (baseline bytes per version / bytes actually encoded per
+    version) at the largest level where the baseline runs (≥1k subs)."""
+    max_subs = int(os.environ.get("BENCH_QUERY_SCALE_SUBS", "100000"))
+    ramp = sorted({n for n in (32, 1000, 10000, 100000)
+                   if n < max_subs} | {max_subs})
+    levels = [_scale_level(n, hosts, services_per_host, events, workers,
+                           max_fanout, subs_per_relay) for n in ramp]
+    ratio = None
+    for lv in levels:
+        bl = lv.get("baseline")
+        if bl and lv["bytes_encoded_per_version"] > 0:
+            ratio = round(bl["bytes_per_version"]
+                          / lv["bytes_encoded_per_version"], 1)
+    top = levels[-1]
+    return {
+        "levels": levels,
+        "max_subscribers": top["subscribers"],
+        "gap_free": all(lv["gap_free"] for lv in levels),
+        "lag_p99_ms": top["lag_p99_ms"],
+        "lag_p99_versions": top["lag_p99_versions"],
+        "publish_p99_ms": top["publish_p99_ms"],
+        "serialization_ratio": ratio,
+    }
+
+
 def main() -> int:
-    print(json.dumps({"metric": "query-plane resolve/fanout",
-                      **run_query_bench()}))
+    doc = {"metric": "query-plane resolve/fanout", **run_query_bench()}
+    if os.environ.get("BENCH_QUERY_SCALE", "0") != "0":
+        doc["query_scale"] = run_query_scale()
+    print(json.dumps(doc))
     return 0
 
 

@@ -79,7 +79,10 @@ def state_changed_event_json(state: ServicesState,
 
 
 def delta_event_json(version: int, event: ChangeEvent) -> bytes:
-    """Delta wire shape (docs/query.md): one versioned change."""
+    """Delta wire shape (docs/query.md): one versioned change.  The
+    drain loop serves this same document from the QueryEvent's cached
+    buffer (``QueryEvent.delta_doc_bytes`` — byte-identical); this
+    builder survives for consumers holding a bare ChangeEvent."""
     return json.dumps({"Version": version,
                        "ChangeEvent": event.to_json()},
                       separators=(",", ":")).encode()
@@ -88,7 +91,12 @@ def delta_event_json(version: int, event: ChangeEvent) -> bytes:
 def resync_event_json(snapshot) -> bytes:
     """Resync wire shape (docs/query.md): the subscriber fell behind and
     the hub collapsed its backlog — the full state at the latest
-    version replaces every missed delta."""
+    version replaces every missed delta.  Served from the snapshot's
+    shared per-version buffer when it carries one (every listener
+    resyncing at a version POSTs the same object)."""
+    cached = getattr(snapshot, "resync_doc_bytes", None)
+    if cached is not None:
+        return cached()
     return json.dumps({"Version": snapshot.version,
                        "State": snapshot.to_json()},
                       separators=(",", ":")).encode()
@@ -165,10 +173,14 @@ class UrlListener(Listener):
                     return
                 if ev is None:
                     continue
+                # Shared per-version wire buffers (zero-copy fan-out):
+                # every listener POSTing this version sends the SAME
+                # bytes object; serialization happened at most once,
+                # whoever got there first.
                 if ev.kind == "snapshot":
-                    data = resync_event_json(ev.snapshot)
+                    data = ev.snapshot.resync_doc_bytes()
                 else:
-                    data = delta_event_json(ev.version, ev.change)
+                    data = ev.delta_doc_bytes()
                 err = with_retries(self.retries,
                                    lambda: self._post(data))
                 if err is not None:

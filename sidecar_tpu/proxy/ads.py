@@ -22,7 +22,17 @@ update latency drops from worst-case 1 s to the hub's fan-out latency.
 This implementation serves the protocol with grpcio generic handlers
 (no generated service stubs) over the shared resource generation in
 proxy/envoy.py.  Ordering on snapshot push follows go-control-plane's
-make-before-break: clusters → endpoints → listeners."""
+make-before-break: clusters → endpoints → listeners.
+
+Alongside SotW the server speaks **incremental (delta) xDS** on the
+same ADS service (``DeltaAggregatedResources``): per-resource version
+stamps from ``EnvoyResources.versions`` let each stream diff a
+client's acked cache against the new snapshot and ship only changed
+resources + removed names per hub delta, with full-set resync as the
+fallback on version-gap or NACK — and the snapshot rebuild itself
+reuses the previous snapshot's encoded Any objects for
+version-unchanged resources (``ads.delta.*`` metrics,
+docs/query.md)."""
 
 from __future__ import annotations
 
@@ -34,6 +44,7 @@ from typing import Optional
 
 import grpc
 
+from sidecar_tpu import metrics
 from sidecar_tpu.catalog.state import ServicesState
 from sidecar_tpu.proxy import xds_proto
 from sidecar_tpu.proxy.envoy import (
@@ -47,9 +58,15 @@ log = logging.getLogger(__name__)
 
 ADS_METHOD = ("/envoy.service.discovery.v3.AggregatedDiscoveryService/"
               "StreamAggregatedResources")
+ADS_DELTA_METHOD = ("/envoy.service.discovery.v3."
+                    "AggregatedDiscoveryService/DeltaAggregatedResources")
 
 # Make-before-break push order (go-control-plane's ADS ordering).
 PUSH_ORDER = (TYPE_CLUSTER, TYPE_ENDPOINT, TYPE_LISTENER)
+
+# EnvoyResources.versions kind key per type_url.
+_VERSION_KIND = {TYPE_CLUSTER: "clusters", TYPE_ENDPOINT: "endpoints",
+                 TYPE_LISTENER: "listeners"}
 
 
 class Snapshot:
@@ -58,11 +75,20 @@ class Snapshot:
     ``by_type`` maps type_url → list of ``(name, Any)`` pairs so the
     stream can scope a response to a request's ``resource_names``
     (go-control-plane's sotw responder filters by name — the semantics
-    behind envoy/server.go:61-124)."""
+    behind envoy/server.go:61-124).
 
-    def __init__(self, version: str, by_type: dict[str, list]):
+    ``versions`` maps type_url → ``{name: resource version}`` (the
+    per-resource stamps from ``EnvoyResources.versions``) — the delta
+    xDS stream diffs a client's acked cache against these to send only
+    changed resources + removed names instead of the full set."""
+
+    def __init__(self, version: str, by_type: dict[str, list],
+                 versions: Optional[dict[str, dict[str, str]]] = None):
         self.version = version
         self.by_type = by_type
+        self.versions = versions if versions is not None \
+            else {t: {name: version for name, _ in pairs}
+                  for t, pairs in by_type.items()}
 
     def resources(self, type_url: str, names) -> list:
         """The Any payloads for one response: everything for a wildcard
@@ -72,6 +98,9 @@ class Snapshot:
         if not names:
             return [res for _, res in pairs]
         return [res for name, res in pairs if name in names]
+
+    def pairs(self, type_url: str) -> dict:
+        return dict(self.by_type.get(type_url, []))
 
 
 class AdsServer:
@@ -116,15 +145,40 @@ class AdsServer:
         res = resources_from_state(catalog, self.bind_ip,
                                    self.use_hostnames, eds_mode="ads",
                                    damper=hub.damper)
-        by_type = {
-            TYPE_CLUSTER: [(c["name"], xds_proto.cluster_to_any(c))
-                           for c in res.clusters],
-            TYPE_ENDPOINT: [(e["cluster_name"],
-                             xds_proto.endpoint_to_any(e))
-                            for e in res.endpoints],
-            TYPE_LISTENER: [(li["name"], xds_proto.listener_to_any(li))
-                            for li in res.listeners],
+        # Incremental rebuild: a resource whose per-name version stamp
+        # is unchanged since the previous snapshot keeps its encoded Any
+        # object (the stamps are constructed so version-unchanged ⇒
+        # content-unchanged, proxy/envoy.py) — per hub delta the proto
+        # encoding work is O(changed resources), not O(catalog).
+        prev = self.snapshot()
+        versions = {t: dict(res.versions[k])
+                    for t, k in _VERSION_KIND.items()}
+        sources = {
+            TYPE_CLUSTER: (res.clusters, "name",
+                           xds_proto.cluster_to_any),
+            TYPE_ENDPOINT: (res.endpoints, "cluster_name",
+                            xds_proto.endpoint_to_any),
+            TYPE_LISTENER: (res.listeners, "name",
+                            xds_proto.listener_to_any),
         }
+        reused = encoded = 0
+        by_type: dict[str, list] = {}
+        for type_url, (dicts, key, encode) in sources.items():
+            prev_pairs = prev.pairs(type_url)
+            prev_vers = prev.versions.get(type_url, {})
+            pairs = []
+            for doc in dicts:
+                name = doc[key]
+                if name in prev_pairs and \
+                        prev_vers.get(name) == versions[type_url][name]:
+                    pairs.append((name, prev_pairs[name]))
+                    reused += 1
+                else:
+                    pairs.append((name, encode(doc)))
+                    encoded += 1
+            by_type[type_url] = pairs
+        metrics.incr("ads.delta.reused", reused)
+        metrics.incr("ads.delta.encoded", encoded)
         with self._cond:
             version = str(catalog.version)
             if catalog.version == self._published_version:
@@ -135,7 +189,7 @@ class AdsServer:
                 version = f"{catalog.version}.d{self._damping_gen}"
             else:
                 self._damping_gen = 0
-            self._snapshot = Snapshot(version, by_type)
+            self._snapshot = Snapshot(version, by_type, versions)
             self._published_version = catalog.version
             self._cond.notify_all()
         log.debug("ads: published snapshot %s", self._snapshot.version)
@@ -304,6 +358,177 @@ class AdsServer:
             sub["names"] = names
             yield respond(self.snapshot(), type_url)
 
+    # -- the incremental (delta) stream handler ------------------------------
+
+    def delta_aggregated_resources(self, request_iterator, context):
+        """One incremental ADS stream (delta xDS, docs/query.md).
+
+        Per type the stream keeps the client's acked resource cache
+        (``name → version``) and, on every new snapshot, sends ONLY the
+        resources whose per-name version moved plus the removed names —
+        instead of regenerating and resending the full set per hub
+        delta.  Full-set resync stays the fallback:
+
+        * a client that cannot prove its cache (no
+          ``initial_resource_versions`` on subscribe — the version-gap
+          case) gets the complete set (``ads.delta.full_resync``);
+        * a NACK wipes the server's view of the client cache and the
+          next response is again the complete set (``ads.delta.nack``).
+        """
+        requests: queue.Queue = queue.Queue()
+        done = threading.Event()
+
+        def reader():
+            try:
+                for req in request_iterator:
+                    requests.put(req)
+            except Exception:
+                pass
+            finally:
+                done.set()
+
+        threading.Thread(target=reader, daemon=True,
+                         name="ads-delta-stream-reader").start()
+
+        nonce_counter = 0
+        # type_url → {"names": frozenset | None (None = wildcard),
+        #             "have": {name: version} (client cache, server
+        #             view), "nonce", "system_version", "resync"}.
+        subs: dict[str, dict] = {}
+
+        def respond(snap: Snapshot, type_url: str, sub: dict,
+                    full: bool = False):
+            """Build one DeltaDiscoveryResponse, or None when the
+            client's cache already matches the snapshot scope."""
+            nonlocal nonce_counter
+            vers = snap.versions.get(type_url, {})
+            pairs = snap.pairs(type_url)
+            scope = set(pairs) if sub["names"] is None \
+                else set(sub["names"]) & set(pairs)
+            have = sub["have"]
+            if full:
+                changed = sorted(scope)
+            else:
+                changed = sorted(n for n in scope
+                                 if have.get(n) != vers.get(n))
+            removed = sorted(set(have) - scope)
+            sub["system_version"] = snap.version
+            if not changed and not removed and not full:
+                return None
+            nonce_counter += 1
+            nonce = str(nonce_counter)
+            x = xds_proto.pb()
+            resp = x.DeltaDiscoveryResponse(
+                system_version_info=snap.version, type_url=type_url,
+                nonce=nonce)
+            wrapped = []
+            for name in changed:
+                r = x.Resource(name=name,
+                               version=vers.get(name, snap.version))
+                r.resource.CopyFrom(pairs[name])
+                wrapped.append(r)
+            resp.resources.extend(wrapped)
+            resp.removed_resources.extend(removed)
+            # Server-side view of the client cache advances at send
+            # time; a NACK resets it (full resync), so a rejected
+            # update can never strand the client on a diff base the
+            # server believes but the client refused.
+            for name in changed:
+                have[name] = vers.get(name, snap.version)
+            for name in removed:
+                have.pop(name, None)
+            sub["nonce"] = nonce
+            metrics.incr("ads.delta.resources_sent", len(changed))
+            metrics.incr("ads.delta.removed_sent", len(removed))
+            if full:
+                metrics.incr("ads.delta.full_resync")
+            return resp
+
+        while not done.is_set() and not self._stop.is_set():
+            try:
+                req = requests.get(timeout=0.1)
+            except queue.Empty:
+                # Push path: diff every subscribed type against the new
+                # snapshot in make-before-break order.  A type whose
+                # scope didn't move just advances its system version —
+                # no response on the wire (the whole point).
+                snap = self.snapshot()
+                for type_url in PUSH_ORDER:
+                    sub = subs.get(type_url)
+                    if sub is None or \
+                            sub["system_version"] == snap.version:
+                        continue
+                    resp = respond(snap, type_url, sub,
+                                   full=sub["resync"])
+                    if resp is not None:
+                        sub["resync"] = False
+                        yield resp
+                continue
+
+            type_url = req.type_url
+            if not type_url:
+                log.warning("ads: delta request with empty type_url "
+                            "ignored")
+                continue
+            first = type_url not in subs
+            sub = subs.setdefault(
+                type_url, {"names": None, "have": {}, "nonce": None,
+                           "system_version": None, "resync": False})
+            sub_names = list(req.resource_names_subscribe)
+            unsub_names = set(req.resource_names_unsubscribe)
+
+            if first:
+                # Initial subscription: explicit names, or wildcard
+                # when none / "*" are given.  initial_resource_versions
+                # is the client's surviving cache (e.g. across a
+                # reconnect): only resources whose version moved are
+                # resent, stale/unknown names come back as removals.
+                # No initial versions = nothing provable = full set.
+                if sub_names and sub_names != ["*"]:
+                    sub["names"] = frozenset(sub_names)
+                sub["have"] = dict(req.initial_resource_versions)
+                resp = respond(self.snapshot(), type_url, sub,
+                               full=not sub["have"])
+                if resp is not None:
+                    yield resp
+                continue
+
+            if req.response_nonce and req.response_nonce != sub["nonce"]:
+                # Stale nonce: ACK/NACK meaning void (xDS stale-response
+                # rule); subscription changes below still apply.
+                pass
+            elif req.response_nonce and req.HasField("error_detail"):
+                # NACK: the client rejected the last delta — the
+                # server-side cache view is no longer trustworthy, so
+                # wipe it and resend the complete scoped set.
+                log.warning("ads: delta NACK for %s: %s", type_url,
+                            req.error_detail.message)
+                metrics.incr("ads.delta.nack")
+                sub["have"] = {}
+                resp = respond(self.snapshot(), type_url, sub, full=True)
+                if resp is not None:
+                    yield resp
+                continue
+
+            # Subscription maintenance (ACK or spontaneous request):
+            # newly subscribed names are served immediately, an
+            # unsubscribe drops them from the tracked cache.
+            changed_scope = False
+            if sub_names and sub["names"] is not None:
+                new = frozenset(sub["names"]) | set(sub_names)
+                if new != sub["names"]:
+                    sub["names"] = new
+                    changed_scope = True
+            if unsub_names and sub["names"] is not None:
+                sub["names"] = frozenset(sub["names"]) - unsub_names
+                for name in unsub_names:
+                    sub["have"].pop(name, None)
+                changed_scope = True
+            if changed_scope:
+                resp = respond(self.snapshot(), type_url, sub)
+                if resp is not None:
+                    yield resp
+
     # -- serving ------------------------------------------------------------
 
     def _handlers(self):
@@ -312,8 +537,15 @@ class AdsServer:
             self.stream_aggregated_resources,
             request_deserializer=x.DiscoveryRequest.FromString,
             response_serializer=x.DiscoveryResponse.SerializeToString)
+        delta_rpc = grpc.stream_stream_rpc_method_handler(
+            self.delta_aggregated_resources,
+            request_deserializer=x.DeltaDiscoveryRequest.FromString,
+            response_serializer=(
+                x.DeltaDiscoveryResponse.SerializeToString))
         service, method = ADS_METHOD.lstrip("/").split("/")
-        return grpc.method_handlers_generic_handler(service, {method: rpc})
+        delta_method = ADS_DELTA_METHOD.rsplit("/", 1)[1]
+        return grpc.method_handlers_generic_handler(
+            service, {method: rpc, delta_method: delta_rpc})
 
     def serve(self, bind: str = "0.0.0.0", port: int = 7776) -> int:
         """Start the gRPC server (reference binds :7776,

@@ -89,11 +89,28 @@ def lookup_host(hostname: str) -> str:
 
 @dataclasses.dataclass
 class EnvoyResources:
-    """adapter.go:45-49 — v3 proto-JSON resource dicts."""
+    """adapter.go:45-49 — v3 proto-JSON resource dicts.
+
+    ``versions`` maps resource kind (``"endpoints"``/``"clusters"``/
+    ``"listeners"``) → ``{envoy_name: version}`` — per-resource version
+    stamps derived from the snapshot's frozen per-service ``updated``
+    stamps, chosen so a resource's version changes **iff its content
+    can have changed** (the incremental-xDS invariant, docs/query.md):
+
+    * endpoints — ``"<max contributing updated>.<endpoint count>"``:
+      any address/status/damping admission change bumps a contributor's
+      stamp or the count;
+    * clusters — constant (content is a pure function of the name and
+      the server's fixed eds_mode);
+    * listeners — the owning service's proxy mode (content is
+      ``f(name, port, proxy_mode, bind_ip)``; name/port are the
+      resource name, bind_ip is fixed per server).
+    """
 
     endpoints: list[dict]
     clusters: list[dict]
     listeners: list[dict]
+    versions: Optional[dict[str, dict[str, str]]] = None
 
 
 def _lb_endpoints(svc: Service, svc_port: int,
@@ -202,6 +219,10 @@ def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
     cluster_map: dict[str, dict] = {}
     listener_map: dict[str, dict] = {}
     ports_map: dict[int, str] = {}
+    # Per-resource version inputs (see EnvoyResources.versions):
+    # envoy_name → [max contributing svc.updated, lb endpoint count].
+    ep_stamp: dict[str, list] = {}
+    listener_mode: dict[str, str] = {}
 
     # ``state`` is either a live ServicesState (walk under its lock,
     # copying out) or an immutable query-plane CatalogSnapshot (no lock
@@ -235,6 +256,9 @@ def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
 
             envoy_name = svc_name(svc.name, port.service_port)
             lbs = _lb_endpoints(svc, port.service_port, use_hostnames)
+            stamp = ep_stamp.setdefault(envoy_name, [0, 0])
+            stamp[0] = max(stamp[0], svc.updated)
+            stamp[1] += len(lbs)
             if envoy_name in endpoint_map:
                 endpoint_map[envoy_name]["endpoints"][0][
                     "lb_endpoints"].extend(lbs)
@@ -257,6 +281,7 @@ def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
                 try:
                     listener_map[envoy_name] = _listener_from_service(
                         svc, envoy_name, port.service_port, bind_ip)
+                    listener_mode[envoy_name] = svc.proxy_mode
                 except ValueError as exc:
                     log.error("Failed to create Envoy listener for service "
                               "%r and port %d: %s", svc.name,
@@ -267,6 +292,13 @@ def resources_from_state(state: ServicesState, bind_ip: str = "0.0.0.0",
         endpoints=list(endpoint_map.values()),
         clusters=list(cluster_map.values()),
         listeners=list(listener_map.values()),
+        versions={
+            "endpoints": {n: f"{s[0]}.{s[1]}"
+                          for n, s in ep_stamp.items()
+                          if n in endpoint_map},
+            "clusters": {n: "cfg" for n in cluster_map},
+            "listeners": dict(listener_mode),
+        },
     )
 
 

@@ -24,12 +24,20 @@ Wire shapes and backpressure semantics: docs/query.md.
 """
 
 from sidecar_tpu.query.snapshot import CatalogSnapshot, ServerView
-from sidecar_tpu.query.hub import QueryEvent, QueryHub, Subscription
+from sidecar_tpu.query.hub import (
+    QueryEvent,
+    QueryHub,
+    RelayHub,
+    Subscription,
+    relay_tree,
+)
 
 __all__ = [
     "CatalogSnapshot",
     "ServerView",
     "QueryEvent",
     "QueryHub",
+    "RelayHub",
     "Subscription",
+    "relay_tree",
 ]

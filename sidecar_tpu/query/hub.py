@@ -25,7 +25,9 @@ needs them).
 from __future__ import annotations
 
 import collections
+import json
 import logging
+import math
 import threading
 import time
 from typing import Optional
@@ -36,6 +38,7 @@ from sidecar_tpu.telemetry import propagation as _propagation
 from sidecar_tpu.query.snapshot import (
     CatalogSnapshot,
     ServerView,
+    record_encode,
     snapshot_from_state,
 )
 
@@ -45,6 +48,17 @@ log = logging.getLogger(__name__)
 # consumer collapses to a snapshot quickly instead of holding hundreds
 # of delta events alive; large enough to ride normal bursts.
 DEFAULT_SUBSCRIBER_BUFFER = 64
+
+# Relay hubs ride a deeper queue than leaf subscribers: a relay that
+# coalesces forces a resync on EVERY subscriber downstream of it, so
+# the tier trades a little memory for far fewer collapses.
+DEFAULT_RELAY_BUFFER = 256
+
+# Fill lock for the per-event wire-encoding caches.  One lock for all
+# events is fine: it is only ever taken by the FIRST consumer of each
+# buffer (once per published version), never on the shared-buffer hot
+# path.  Re-entrant: delta_doc_bytes fills change_frag under it.
+_event_fill = threading.RLock()
 
 
 class QueryEvent:
@@ -61,7 +75,8 @@ class QueryEvent:
     carry 0.
     """
 
-    __slots__ = ("kind", "version", "snapshot", "change", "published_ns")
+    __slots__ = ("kind", "version", "snapshot", "change", "published_ns",
+                 "_frag", "_delta_doc")
 
     def __init__(self, kind: str, version: int,
                  snapshot: CatalogSnapshot, change=None,
@@ -71,6 +86,41 @@ class QueryEvent:
         self.snapshot = snapshot
         self.change = change
         self.published_ns = published_ns
+        self._frag: Optional[bytes] = None
+        self._delta_doc: Optional[bytes] = None
+
+    # -- shared wire encodings (zero-copy fan-out, docs/query.md) ----------
+
+    def change_frag(self) -> bytes:
+        """Compact encoding of this delta's ChangeEvent — filled once
+        per published version under the fill lock, then handed to every
+        consumer as the same object: the /watch ``Deltas`` array and the
+        UrlListener POST body are composed from this buffer instead of
+        re-running ``json.dumps`` per subscriber."""
+        frag = self._frag
+        if frag is None:
+            with _event_fill:
+                if self._frag is None:
+                    buf = json.dumps(self.change.to_json(),
+                                     separators=(",", ":")).encode()
+                    record_encode(len(buf))
+                    self._frag = buf
+                frag = self._frag
+        return frag
+
+    def delta_doc_bytes(self) -> bytes:
+        """The UrlListener delta POST body
+        (``{"Version": V, "ChangeEvent": {...}}``, byte-identical to
+        ``delta_event_json``) as one cached buffer shared by every
+        listener delivering this version."""
+        doc = self._delta_doc
+        if doc is None:
+            with _event_fill:
+                if self._delta_doc is None:
+                    self._delta_doc = (b'{"Version":%d,"ChangeEvent":%s}'
+                                       % (self.version, self.change_frag()))
+                doc = self._delta_doc
+        return doc
 
     def __repr__(self) -> str:  # pragma: no cover — debugging aid
         return f"QueryEvent({self.kind}, v{self.version})"
@@ -205,17 +255,27 @@ class QueryHub:
         self.state = state
         self.default_buffer = default_buffer
         self._lock = threading.Lock()      # subscriber set + version
-        self._subs: list[Subscription] = []
+        # Keyed by id(sub): O(1) unsubscribe at 100k-subscriber churn
+        # (the old list scan made churn quadratic) while dict insertion
+        # order keeps publish-order iteration stable.
+        self._subs: dict[int, Subscription] = {}
         self._current: Optional[CatalogSnapshot] = None
         # High-water mark of the delivery version gap across ALL
         # subscribers — the query.hub.lag.max gauge (reset with the
-        # metrics registry in tests).
+        # metrics registry in tests).  Guarded by its own lock, NOT the
+        # registry lock: every delivery calls _observe_lag, and an
+        # unlocked read-modify-write here let concurrent deliveries
+        # regress the high-water mark.
         self._max_lag_versions = 0
+        self._lag_lock = threading.Lock()
 
     def _observe_lag(self, gap: int) -> None:
-        if gap > self._max_lag_versions:
-            self._max_lag_versions = gap
-        metrics.set_gauge("query.hub.lag.max", self._max_lag_versions)
+        with self._lag_lock:
+            if gap > self._max_lag_versions:
+                self._max_lag_versions = gap
+            # Gauge write inside the lock so a stale value can never
+            # overwrite a newer maximum.
+            metrics.set_gauge("query.hub.lag.max", self._max_lag_versions)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -293,7 +353,7 @@ class QueryHub:
                 hostname=self.state.hostname,
                 servers=servers)
             self._current = snap
-            subs = list(self._subs)
+            subs = list(self._subs.values())
         metrics.incr("query.hub.published")
         metrics.set_gauge("query.snapshot.version", snap.version)
         qevent = QueryEvent("delta", snap.version, snap, change=event,
@@ -334,7 +394,7 @@ class QueryHub:
         # append) — the subscriber would hold a stale cursor with no
         # delta coming.
         with self._lock:
-            self._subs.append(sub)
+            self._subs[id(sub)] = sub
             if prime:
                 # Inside the registration critical section: a publish
                 # interleaved after registration could collapse the
@@ -352,12 +412,184 @@ class QueryHub:
 
     def _remove(self, sub: Subscription) -> None:
         with self._lock:
-            try:
-                self._subs.remove(sub)
-            except ValueError:
+            if self._subs.pop(id(sub), None) is None:
                 return
             metrics.set_gauge("query.hub.subscribers", len(self._subs))
 
     def subscriber_count(self) -> int:
         with self._lock:
             return len(self._subs)
+
+
+# -- tiered relay fan-out ---------------------------------------------------
+
+_relay_count = 0
+_relay_count_lock = threading.Lock()
+
+
+def _relay_count_delta(d: int) -> None:
+    global _relay_count
+    with _relay_count_lock:
+        _relay_count += d
+        metrics.set_gauge("query.hub.tier.relays", _relay_count)
+
+
+class RelayHub:
+    """A coalescing fan-out tier between the root :class:`QueryHub` and
+    its subscribers (docs/query.md).
+
+    The relay holds ONE bounded subscription on its parent (the root
+    hub or another relay) and re-fans every event to its own
+    subscriptions from a dedicated delivery thread.  With W relays over
+    N subscribers the writer-path publish touches W queues instead of
+    N — O(relays) on the catalog writer — and the O(N) offer work
+    happens on relay threads, off the writer.  Composing relays builds
+    a tree (:func:`relay_tree`) whose per-hub fan-out stays bounded.
+
+    Semantics are preserved end-to-end:
+
+    * Events are re-fanned by reference — same ``QueryEvent``, same
+      shared wire buffers, original ``published_ns`` — so a leaf
+      subscriber's ``query.hub.lag`` measures true publish-to-deliver
+      latency across every tier, and its version-gap is computed
+      against the ROOT head.
+    * A relay that falls behind collapses its parent queue to a
+      snapshot marker exactly like any subscriber; re-fanning that
+      marker resyncs everyone downstream (gap-free by construction).
+    * Subscribing primes from the relay's *delivered horizon* (the last
+      event it re-fanned), not the root head: the relay-local stream
+      stays contiguous — prime at vK, next delta vK+1.
+    """
+
+    def __init__(self, parent, name: str = "relay",
+                 buffer: int = DEFAULT_RELAY_BUFFER,
+                 poll: float = 0.5) -> None:
+        self.name = name
+        self._parent = parent
+        self._root = getattr(parent, "_root", parent)
+        self._lock = threading.Lock()      # horizon + subscriber set
+        self._subs: dict[int, Subscription] = {}
+        self._closed = False
+        self._poll = poll
+        self.relayed = 0
+        self._psub = parent.subscribe(f"relay:{name}", buffer=buffer,
+                                      prime=False)
+        # Horizon AFTER subscribing: events already queued are ≤ this
+        # version and get skipped as catch-up duplicates; everything
+        # newer flows through, so the horizon is never ahead of a
+        # missed event.
+        self._last: CatalogSnapshot = parent.current()
+        _relay_count_delta(+1)
+        self._thread = threading.Thread(
+            target=self._pump, name=f"relay-{name}", daemon=True)
+        self._thread.start()
+
+    # -- QueryHub surface consumed by Subscription -------------------------
+
+    @property
+    def _current(self) -> Optional[CatalogSnapshot]:
+        # Lag accounting measures staleness against the ROOT head.
+        return self._root._current
+
+    @property
+    def default_buffer(self) -> int:
+        return self._root.default_buffer
+
+    @property
+    def damper(self):
+        return self._root.damper
+
+    def current(self) -> CatalogSnapshot:
+        return self._root.current()
+
+    def _observe_lag(self, gap: int) -> None:
+        self._root._observe_lag(gap)
+
+    # -- delivery ----------------------------------------------------------
+
+    def _pump(self) -> None:
+        while True:
+            ev = self._psub.get(timeout=self._poll)
+            if self._closed or self._psub.closed:
+                return
+            if ev is None:
+                continue
+            with self._lock:
+                # Horizon advance + fan-out list are ONE critical
+                # section with subscribe()'s prime (the same discipline
+                # as QueryHub.publish): a subscriber primed at _last
+                # can never miss a later event.
+                if ev.version <= self._last.version:
+                    continue  # pre-subscription catch-up duplicate
+                self._last = ev.snapshot
+                subs = list(self._subs.values())
+            t0 = time.perf_counter()
+            for sub in subs:
+                sub._offer(ev)
+            self.relayed += 1
+            metrics.incr("query.hub.tier.relayed")
+            metrics.histogram_since("query.hub.tier.fanout", t0)
+
+    # -- subscriptions (QueryHub parity) -----------------------------------
+
+    def subscribe(self, name: str, buffer: Optional[int] = None,
+                  prime: bool = True) -> Subscription:
+        sub = Subscription(self, name,
+                           buffer if buffer is not None
+                           else self.default_buffer)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(f"relay {self.name} is closed")
+            self._subs[id(sub)] = sub
+            if prime:
+                with sub._cond:
+                    sub._pending_snapshot = self._last
+                    sub._cond.notify_all()
+        return sub
+
+    def _remove(self, sub: Subscription) -> None:
+        with self._lock:
+            self._subs.pop(id(sub), None)
+
+    def subscriber_count(self) -> int:
+        with self._lock:
+            return len(self._subs)
+
+    def close(self) -> None:
+        """Detach from the parent and close every downstream
+        subscription (their blocked gets wake with None)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            subs = list(self._subs.values())
+            self._subs.clear()
+        self._psub.close()
+        for sub in subs:
+            sub.close()
+        _relay_count_delta(-1)
+
+
+def relay_tree(root: QueryHub, leaves: int, max_fanout: int = 16,
+               buffer: int = DEFAULT_RELAY_BUFFER,
+               name: str = "tier") -> tuple[list, list]:
+    """Build a balanced relay tree under ``root`` with ``leaves`` leaf
+    relays and at most ``max_fanout`` children per hub; returns
+    ``(leaf_relays, all_relays)``.  Spread subscribers across the leaf
+    relays: one root publish then costs ≤ ``max_fanout`` offers and
+    every delivery thread re-fans a bounded set (100k subscribers at
+    2048/leaf → 49 leaves, 4 mid relays, 2 tiers)."""
+    if leaves < 1:
+        raise ValueError("relay tree needs at least one leaf")
+    sizes = [leaves]
+    while sizes[0] > max_fanout:
+        sizes.insert(0, math.ceil(sizes[0] / max_fanout))
+    parents: list = [root]
+    relays: list = []
+    for tier, size in enumerate(sizes):
+        level = [RelayHub(parents[i * len(parents) // size],
+                          name=f"{name}{tier}.{i}", buffer=buffer)
+                 for i in range(size)]
+        relays.extend(level)
+        parents = level
+    return parents, relays

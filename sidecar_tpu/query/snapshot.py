@@ -22,9 +22,26 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 from typing import Iterator, Mapping, Optional
 
+from sidecar_tpu import metrics
 from sidecar_tpu.service import Service, ns_to_rfc3339
+
+_SEP = (",", ":")
+
+
+def record_encode(nbytes: int) -> None:
+    """Account one wire-encoding cache fill (``query.encode.*``).
+
+    Counted ONLY on fills — never on cache hits — so the counters read
+    as "serialization work actually performed": at N subscribers the
+    zero-copy read path holds ``query.encode.bytes`` at O(1) per
+    published version while the old path would have been O(N).  The
+    bench's ``query_scale`` block derives its baseline-vs-zero-copy
+    ratio from exactly these counters."""
+    metrics.incr("query.encode.count")
+    metrics.incr("query.encode.bytes", nbytes)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,14 +66,20 @@ class ServerView:
 class CatalogSnapshot:
     """One immutable, versioned view of the catalog.
 
-    The lazy serialization caches are benign-race safe: concurrent
-    first readers may compute the same value twice, but assignment is
-    atomic and the inputs are frozen, so every reader sees a correct
-    (and eventually the same) object.
+    Every serialization is computed at most once per version, under the
+    snapshot's fill lock, and the SAME buffer object is handed to every
+    consumer — the /watch chunk writer, UrlListener POST bodies, and the
+    state-dump endpoints all share it (zero-copy fan-out: per version
+    the cost is one ``json.dumps`` plus O(subscribers) pointer
+    hand-offs).  The fast path is lock-free: a filled cache slot is read
+    without taking the lock (attribute assignment is atomic), only the
+    first reader of each form pays for the fill.
     """
 
     __slots__ = ("version", "changed_ns", "cluster_name", "hostname",
-                 "servers", "_json", "_encoded", "_by_service")
+                 "servers", "_fill", "_json", "_encoded", "_by_service",
+                 "_by_service_encoded", "_watch_raw", "_watch_by_service",
+                 "_resync_doc")
 
     def __init__(self, version: int, changed_ns: int, cluster_name: str,
                  hostname: str,
@@ -66,9 +89,14 @@ class CatalogSnapshot:
         self.cluster_name = cluster_name
         self.hostname = hostname
         self.servers = servers
+        self._fill = threading.RLock()
         self._json: Optional[dict] = None
         self._encoded: Optional[bytes] = None
         self._by_service: Optional[dict] = None
+        self._by_service_encoded: Optional[bytes] = None
+        self._watch_raw: Optional[bytes] = None
+        self._watch_by_service: Optional[bytes] = None
+        self._resync_doc: Optional[bytes] = None
 
     # -- iteration (mirrors ServicesState's view methods) ------------------
 
@@ -89,36 +117,107 @@ class CatalogSnapshot:
     def to_json(self) -> dict:
         """State-dump wire shape (``ServicesState.to_json`` parity) plus
         the version cursor."""
-        if self._json is None:
-            self._json = {
-                "Servers": {h: s.to_json()
-                            for h, s in self.servers.items()},
-                "LastChanged": ns_to_rfc3339(self.changed_ns),
-                "ClusterName": self.cluster_name,
-                "Hostname": self.hostname,
-                "Version": self.version,
-            }
-        return self._json
+        doc = self._json
+        if doc is None:
+            with self._fill:
+                if self._json is None:
+                    self._json = {
+                        "Servers": {h: s.to_json()
+                                    for h, s in self.servers.items()},
+                        "LastChanged": ns_to_rfc3339(self.changed_ns),
+                        "ClusterName": self.cluster_name,
+                        "Hostname": self.hostname,
+                        "Version": self.version,
+                    }
+                doc = self._json
+        return doc
 
     def encode(self) -> bytes:
-        if self._encoded is None:
-            self._encoded = json.dumps(
-                self.to_json(), separators=(",", ":")).encode()
-        return self._encoded
+        enc = self._encoded
+        if enc is None:
+            with self._fill:
+                if self._encoded is None:
+                    buf = json.dumps(self.to_json(),
+                                     separators=_SEP).encode()
+                    record_encode(len(buf))
+                    self._encoded = buf
+                enc = self._encoded
+        return enc
 
     def by_service(self) -> dict[str, list[Service]]:
         """Instances grouped by service name (``ServicesState.by_service``
         parity, same deterministic order) — computed once per version."""
-        if self._by_service is None:
-            out: dict[str, list[Service]] = {}
-            for _, _, svc in self.each_service_sorted():
-                out.setdefault(svc.name, []).append(svc)
-            self._by_service = out
-        return self._by_service
+        grouped = self._by_service
+        if grouped is None:
+            with self._fill:
+                if self._by_service is None:
+                    out: dict[str, list[Service]] = {}
+                    for _, _, svc in self.each_service_sorted():
+                        out.setdefault(svc.name, []).append(svc)
+                    self._by_service = out
+                grouped = self._by_service
+        return grouped
 
     def by_service_json(self) -> dict:
         return {name: [svc.to_json() for svc in instances]
                 for name, instances in self.by_service().items()}
+
+    def by_service_encoded(self) -> bytes:
+        """Compact encoding of :meth:`by_service_json` — one fill per
+        version, shared by every by-service /watch subscriber."""
+        enc = self._by_service_encoded
+        if enc is None:
+            with self._fill:
+                if self._by_service_encoded is None:
+                    buf = json.dumps(self.by_service_json(),
+                                     separators=_SEP).encode()
+                    record_encode(len(buf))
+                    self._by_service_encoded = buf
+                enc = self._by_service_encoded
+        return enc
+
+    # -- shared wire documents (zero-copy fan-out) -------------------------
+
+    def watch_doc_bytes(self, by_service: bool) -> bytes:
+        """The full /watch snapshot document
+        (``{"Version": V, "Snapshot": ...}``) as ONE cached buffer —
+        every /watch subscriber of a version writes this same object to
+        its socket (wrap in ``memoryview`` for partial writes)."""
+        if by_service:
+            doc = self._watch_by_service
+        else:
+            doc = self._watch_raw
+        if doc is None:
+            with self._fill:
+                body = (self.by_service_encoded() if by_service
+                        else self.encode())
+                if by_service:
+                    if self._watch_by_service is None:
+                        self._watch_by_service = (
+                            b'{"Version":%d,"Snapshot":%s}'
+                            % (self.version, body))
+                    doc = self._watch_by_service
+                else:
+                    if self._watch_raw is None:
+                        self._watch_raw = (
+                            b'{"Version":%d,"Snapshot":%s}'
+                            % (self.version, body))
+                    doc = self._watch_raw
+        return doc
+
+    def resync_doc_bytes(self) -> bytes:
+        """The UrlListener resync POST body
+        (``{"Version": V, "State": ...}``, docs/query.md) as one cached
+        buffer shared by every listener that fell behind at this
+        version."""
+        doc = self._resync_doc
+        if doc is None:
+            with self._fill:
+                if self._resync_doc is None:
+                    self._resync_doc = (b'{"Version":%d,"State":%s}'
+                                        % (self.version, self.encode()))
+                doc = self._resync_doc
+        return doc
 
 
 def snapshot_from_state(state, version: int) -> CatalogSnapshot:

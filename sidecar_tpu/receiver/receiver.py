@@ -95,6 +95,11 @@ class Receiver:
 
         Both filter via should_notify + subscriptions, then enqueue a
         batched reload."""
+        if isinstance(payload, (memoryview, bytearray)):
+            # Zero-copy handoff from the query plane: the sender POSTs
+            # the hub's shared per-version buffer (possibly as a view);
+            # json.loads only takes str/bytes/bytearray.
+            payload = bytes(payload)
         evt = json.loads(payload)
         if not isinstance(evt, dict):
             raise ValueError("StateChangedEvent: not an object")

@@ -697,6 +697,24 @@ class SidecarApi:
             "Deltas": [ev.change.to_json() for ev in events],
         }
 
+    # Zero-copy variants (docs/query.md): same document CONTENT as the
+    # dict builders above, but served from the per-version buffers the
+    # snapshot/event objects cache — every subscriber of a version
+    # writes the SAME bytes object, so fan-out serialization is O(1)
+    # per version instead of O(subscribers).
+
+    def watch_snapshot_bytes(self, by_service: bool,
+                             snapshot=None) -> bytes:
+        if snapshot is None:
+            snapshot = self.state.query_hub().current()
+        return snapshot.watch_doc_bytes(by_service)
+
+    def watch_delta_bytes(self, events: list) -> bytes:
+        frags = [ev.change_frag() for ev in events]
+        return (b'{"From":%d,"Version":%d,"Deltas":[%s]}'
+                % (events[0].version, events[-1].version,
+                   b",".join(frags)))
+
 
     def _json(self, status: int, doc: dict):
         body = json.dumps(doc, indent=2).encode()

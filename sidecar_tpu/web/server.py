@@ -72,19 +72,23 @@ def make_handler(api: SidecarApi, ui_dir: Optional[str],
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
 
-                def push(doc: dict) -> None:
+                def push(payload: bytes) -> None:
                     # The delivery hop of the live propagation path
-                    # (docs/telemetry.md): serialize + write one /watch
-                    # document to this subscriber.
+                    # (docs/telemetry.md): write one /watch document to
+                    # this subscriber.  ``payload`` is the hub's shared
+                    # per-version buffer — the same object every other
+                    # watcher of this version writes — so this hop does
+                    # zero serialization; the memoryview keeps the
+                    # chunked framing from copying the body.
                     with _span("watch.deliver"):
-                        payload = json.dumps(doc).encode()
-                        self.wfile.write(b"%x\r\n%s\r\n"
-                                         % (len(payload), payload))
+                        self.wfile.write(b"%x\r\n" % len(payload))
+                        self.wfile.write(memoryview(payload))
+                        self.wfile.write(b"\r\n")
                         self.wfile.flush()
 
                 current = api.state.query_hub().current()
                 if since is None or since != current.version:
-                    push(api.watch_snapshot_doc(by_service, current))
+                    push(api.watch_snapshot_bytes(by_service, current))
                 cursor = current.version
                 while True:
                     ev = sub.get(timeout=30.0)
@@ -102,14 +106,14 @@ def make_handler(api: SidecarApi, ui_dir: Optional[str],
                     if snaps:
                         latest = snaps[-1].snapshot
                         if latest.version > cursor:
-                            push(api.watch_snapshot_doc(by_service,
-                                                        latest))
+                            push(api.watch_snapshot_bytes(by_service,
+                                                          latest))
                             cursor = latest.version
                     deltas = [e for e in events
                               if e.kind == "delta" and
                               e.version > cursor]
                     if deltas:
-                        push(api.watch_delta_doc(deltas))
+                        push(api.watch_delta_bytes(deltas))
                         cursor = deltas[-1].version
             except OSError:
                 pass  # client went away

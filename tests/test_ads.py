@@ -606,3 +606,262 @@ def test_port_conflict_raises_not_shared():
             second.serve(bind="127.0.0.1", port=port)
     finally:
         first.shutdown()
+
+
+class StubDeltaXds(StubXds):
+    """StubXds extended with the delta-xDS wire shapes (Resource /
+    DeltaDiscoveryResponse), so the incremental stream generator is
+    testable protoc-free alongside the SotW one."""
+
+    class _Resource:
+        class _Any:
+            def __init__(self):
+                self.payload = None
+
+            def CopyFrom(self, other):  # noqa: N802 — protobuf shape
+                self.payload = other
+
+        def __init__(self, name="", version=""):
+            self.name = name
+            self.version = version
+            self.resource = self._Any()
+
+    class _DeltaDiscoveryResponse:
+        def __init__(self, system_version_info="", type_url="",
+                     nonce=""):
+            self.system_version_info = system_version_info
+            self.type_url = type_url
+            self.nonce = nonce
+            self.resources = []
+            self.removed_resources = []
+
+    def __init__(self):
+        super().__init__()
+        self._PB.Resource = self._Resource
+        self._PB.DeltaDiscoveryResponse = self._DeltaDiscoveryResponse
+
+
+class StubDeltaRequest:
+    def __init__(self, type_url, subscribe=(), unsubscribe=(),
+                 initial_versions=None, nonce="", error=None):
+        self.type_url = type_url
+        self.resource_names_subscribe = list(subscribe)
+        self.resource_names_unsubscribe = list(unsubscribe)
+        self.initial_resource_versions = dict(initial_versions or {})
+        self.response_nonce = nonce
+        self._error = error
+
+        class _Detail:
+            message = error or ""
+        self.error_detail = _Detail()
+
+    def HasField(self, name):  # noqa: N802 — protobuf API shape
+        return name == "error_detail" and self._error is not None
+
+
+def _resource_bytes(resp) -> int:
+    """Proxy for wire size: the serialized payloads of every Resource
+    in one delta response (the stub Anys are JSON-able tuples)."""
+    import json
+
+    return sum(len(json.dumps(r.resource.payload))
+               for r in resp.resources)
+
+
+class TestDeltaStreamLogicWithoutProtoc:
+    """Drives AdsServer.delta_aggregated_resources directly: the
+    per-resource version diffing, the removed-names flow, and the two
+    full-resync fallbacks (version gap, NACK)."""
+
+    def setup_stream(self, monkeypatch):
+        import queue as queue_mod
+
+        from sidecar_tpu.proxy import ads as ads_mod
+
+        monkeypatch.setattr(ads_mod, "xds_proto", StubDeltaXds())
+        state = make_state()
+        server = AdsServer(state, bind_ip="192.168.168.168")
+        server.refresh()
+        inbox: "queue_mod.Queue" = queue_mod.Queue()
+
+        def request_iter():
+            while True:
+                req = inbox.get()
+                if req is None:
+                    return
+                yield req
+
+        gen = server.delta_aggregated_resources(request_iter(), None)
+        responses: "queue_mod.Queue" = queue_mod.Queue()
+
+        def pump():
+            try:
+                for resp in gen:
+                    responses.put(resp)
+            except Exception as exc:  # pragma: no cover — surface it
+                responses.put(exc)
+
+        threading.Thread(target=pump, daemon=True).start()
+        return state, server, inbox, responses
+
+    def teardown_stream(self, server, inbox):
+        server._stop.set()
+        inbox.put(None)
+
+    def test_initial_wildcard_sends_full_set_once(self, monkeypatch):
+        from sidecar_tpu import metrics
+
+        state, server, inbox, responses = self.setup_stream(monkeypatch)
+        resync0 = metrics.counter("ads.delta.full_resync")
+        try:
+            inbox.put(StubDeltaRequest(TYPE_CLUSTER))
+            resp = responses.get(timeout=5)
+            assert resp.type_url == TYPE_CLUSTER
+            assert {r.name for r in resp.resources} == {"web:8080",
+                                                        "raw-tcp:9000"}
+            assert {r.resource.payload for r in resp.resources} == \
+                {("cluster", "web:8080"), ("cluster", "raw-tcp:9000")}
+            assert list(resp.removed_resources) == []
+            # No initial_resource_versions = nothing provable = the
+            # version-gap fallback: a counted full resync.
+            assert metrics.counter("ads.delta.full_resync") \
+                - resync0 == 1
+        finally:
+            self.teardown_stream(server, inbox)
+
+    def test_single_change_sends_only_changed_resource(
+            self, monkeypatch):
+        """The acceptance pin: after one service's status change, the
+        wire carries ONE endpoint resource — not the full set of every
+        type — and strictly fewer resource bytes than the initial
+        full-set push."""
+        import queue as queue_mod
+
+        from sidecar_tpu import metrics
+
+        state, server, inbox, responses = self.setup_stream(monkeypatch)
+        try:
+            full_bytes = {}
+            for type_url in (TYPE_CLUSTER, TYPE_ENDPOINT, TYPE_LISTENER):
+                inbox.put(StubDeltaRequest(type_url))
+                resp = responses.get(timeout=5)
+                full_bytes[type_url] = _resource_bytes(resp)
+                inbox.put(StubDeltaRequest(type_url, nonce=resp.nonce))
+            sent0 = metrics.counter("ads.delta.resources_sent")
+
+            # ONE service changes: web on h2 starts draining, which
+            # moves only the web:8080 endpoint stamp (the listener's
+            # proxy_mode and the cluster config are untouched).
+            state.set_clock(lambda: T0 + NS)
+            state.add_service_entry(S.Service(
+                id="bbb222", name="web", image="site/web:1.2",
+                hostname="h2", updated=T0 + NS, status=S.DRAINING,
+                proxy_mode="http",
+                ports=[S.Port("tcp", 32769, 8080, "10.0.0.2")]))
+            server.refresh()
+
+            push = responses.get(timeout=5)
+            # Endpoints only — cluster + listener stamps are untouched
+            # by a heartbeat, so those types stay silent.
+            assert push.type_url == TYPE_ENDPOINT
+            assert [r.name for r in push.resources] == ["web:8080"]
+            assert list(push.removed_resources) == []
+            assert _resource_bytes(push) < full_bytes[TYPE_ENDPOINT]
+            assert metrics.counter("ads.delta.resources_sent") \
+                - sent0 == 1
+            with pytest.raises(queue_mod.Empty):
+                responses.get(timeout=0.5)  # nothing else on the wire
+        finally:
+            self.teardown_stream(server, inbox)
+
+    def test_nack_wipes_cache_and_resends_full_set(self, monkeypatch):
+        from sidecar_tpu import metrics
+
+        state, server, inbox, responses = self.setup_stream(monkeypatch)
+        try:
+            inbox.put(StubDeltaRequest(TYPE_ENDPOINT))
+            first = responses.get(timeout=5)
+            assert {r.name for r in first.resources} == {"web:8080",
+                                                         "raw-tcp:9000"}
+            nack0 = metrics.counter("ads.delta.nack")
+            resync0 = metrics.counter("ads.delta.full_resync")
+            inbox.put(StubDeltaRequest(TYPE_ENDPOINT, nonce=first.nonce,
+                                       error="rejected"))
+            again = responses.get(timeout=5)
+            assert {r.name for r in again.resources} == {"web:8080",
+                                                         "raw-tcp:9000"}
+            assert metrics.counter("ads.delta.nack") - nack0 == 1
+            assert metrics.counter("ads.delta.full_resync") \
+                - resync0 == 1
+        finally:
+            self.teardown_stream(server, inbox)
+
+    def test_initial_versions_diffed_and_stale_names_removed(
+            self, monkeypatch):
+        """A reconnecting client proves its cache with
+        initial_resource_versions: a fully current cache draws NO
+        response, a stale entry draws only that resource, an unknown
+        name comes back as a removal."""
+        import queue as queue_mod
+
+        state, server, inbox, responses = self.setup_stream(monkeypatch)
+        try:
+            vers = dict(server.snapshot().versions[TYPE_ENDPOINT])
+            stale = dict(vers, ghost="0.1")
+            stale["web:8080"] = "0.0"  # behind the snapshot
+            inbox.put(StubDeltaRequest(TYPE_ENDPOINT,
+                                       initial_versions=stale))
+            resp = responses.get(timeout=5)
+            assert [r.name for r in resp.resources] == ["web:8080"]
+            assert list(resp.removed_resources) == ["ghost"]
+
+            # Fully current cache on a fresh stream: silence.
+            state2, server2, inbox2, responses2 = \
+                self.setup_stream(monkeypatch)
+            try:
+                inbox2.put(StubDeltaRequest(
+                    TYPE_ENDPOINT,
+                    initial_versions=dict(
+                        server2.snapshot().versions[TYPE_ENDPOINT])))
+                with pytest.raises(queue_mod.Empty):
+                    responses2.get(timeout=0.5)
+            finally:
+                self.teardown_stream(server2, inbox2)
+        finally:
+            self.teardown_stream(server, inbox)
+
+    def test_refresh_reuses_unchanged_any_objects(self, monkeypatch):
+        """The incremental rebuild: a refresh after one service's
+        change re-encodes ONLY the moved resource — every other Any is
+        the previous snapshot's object, by identity."""
+        from sidecar_tpu import metrics
+        from sidecar_tpu.proxy import ads as ads_mod
+
+        monkeypatch.setattr(ads_mod, "xds_proto", StubDeltaXds())
+        state = make_state()
+        server = AdsServer(state, bind_ip="192.168.168.168")
+        server.refresh()
+        before = server.snapshot()
+        reused0 = metrics.counter("ads.delta.reused")
+        encoded0 = metrics.counter("ads.delta.encoded")
+
+        state.set_clock(lambda: T0 + NS)
+        state.add_service_entry(S.Service(
+            id="bbb222", name="web", image="site/web:1.2",
+            hostname="h2", updated=T0 + NS, status=S.DRAINING,
+            proxy_mode="http",
+            ports=[S.Port("tcp", 32769, 8080, "10.0.0.2")]))
+        assert server.refresh() is True
+        after = server.snapshot()
+
+        for type_url in (TYPE_CLUSTER, TYPE_LISTENER):
+            prev_pairs = before.pairs(type_url)
+            for name, res in after.pairs(type_url).items():
+                assert res is prev_pairs[name], (type_url, name)
+        ep_before = before.pairs(TYPE_ENDPOINT)
+        ep_after = after.pairs(TYPE_ENDPOINT)
+        assert ep_after["web:8080"] is not ep_before["web:8080"]
+        assert ep_after["raw-tcp:9000"] is ep_before["raw-tcp:9000"]
+        # 2 clusters + 2 listeners + 1 endpoint reused; 1 re-encoded.
+        assert metrics.counter("ads.delta.reused") - reused0 == 5
+        assert metrics.counter("ads.delta.encoded") - encoded0 == 1

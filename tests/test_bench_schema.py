@@ -40,6 +40,13 @@ GOOD_RESULT = {
                   "closed_loop": True, "evaluations": 21,
                   "grid_points": 64, "eval_ratio": 0.3281,
                   "replay_bit_identical": True},
+    "query_scale": {"levels": [{"subscribers": 32, "gap_free": True},
+                               {"subscribers": 100000,
+                                "gap_free": True}],
+                    "max_subscribers": 100000, "gap_free": True,
+                    "lag_p99_ms": 7049.0, "lag_p99_versions": 5,
+                    "publish_p99_ms": 3.1,
+                    "serialization_ratio": 1105.7},
 }
 
 
@@ -104,6 +111,38 @@ class TestResultRecords:
                       "autopilot.replay_bit_identical",
                       "autopilot.closed_loop"):
             assert any(field in i for i in issues), field
+
+
+    def test_query_scale_honest_nulls_legal(self):
+        # A watchdog-cut or baseline-capped soak reports null
+        # headlines, never fake numbers.
+        doc = dict(GOOD_RESULT,
+                   query_scale={"levels": [], "max_subscribers": 32,
+                                "gap_free": False,
+                                "lag_p99_ms": None,
+                                "lag_p99_versions": None,
+                                "publish_p99_ms": None,
+                                "serialization_ratio": None})
+        assert issues_for(doc) == []
+
+    def test_query_scale_bad_types_flagged(self):
+        doc = dict(GOOD_RESULT,
+                   query_scale={"levels": {"32": {}},
+                                "max_subscribers": "100k",
+                                "gap_free": "yes",
+                                "serialization_ratio": "1105x"})
+        issues = issues_for(doc)
+        for field in ("query_scale.levels",
+                      "query_scale.max_subscribers",
+                      "query_scale.gap_free",
+                      "query_scale.serialization_ratio"):
+            assert any(field in i for i in issues), field
+
+    def test_query_scale_levels_must_hold_objects(self):
+        doc = dict(GOOD_RESULT,
+                   query_scale={"levels": [{"subscribers": 32}, 17]})
+        assert any("query_scale.levels[1]" in i
+                   for i in issues_for(doc))
 
 
 class TestErrorRecords:

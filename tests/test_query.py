@@ -555,3 +555,374 @@ class TestHttpListenerDropOldest:
             held.append(listener.chan().get_nowait().service.id)
         # The OLDEST five were evicted; the newest 50 survive in order.
         assert held == [f"e{i}" for i in range(5, 55)]
+
+
+class TestLagAccounting:
+    def test_observe_lag_concurrent_hammer(self):
+        """query.hub.lag.max is a high-water mark fed from every
+        delivery thread; the old unlocked read-modify-write let racing
+        observers regress it.  Hammer from 8 threads and require the
+        gauge to equal the TRUE maximum."""
+        import random
+
+        state = make_state()
+        hub = state.query_hub()
+        seqs = []
+        for t in range(8):
+            rng = random.Random(1000 + t)
+            seqs.append([rng.randrange(5000) for _ in range(3000)])
+        true_max = max(max(s) for s in seqs)
+        barrier = threading.Barrier(len(seqs))
+
+        def run(seq):
+            barrier.wait()
+            for gap in seq:
+                hub._observe_lag(gap)
+
+        threads = [threading.Thread(target=run, args=(s,), daemon=True)
+                   for s in seqs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert hub._max_lag_versions == true_max
+        assert metrics.snapshot()["gauges"]["query.hub.lag.max"] \
+            == true_max
+
+
+class TestSubscriberRegistry:
+    def test_publish_order_stable_after_mid_close(self):
+        """The id-keyed dict registry must keep publish-order iteration
+        identical to the old list: insertion order, mid-close removes
+        without reordering, re-subscribe appends at the tail."""
+        state = make_state()
+        hub = state.query_hub()
+        subs = {n: hub.subscribe(n, buffer=8, prime=False)
+                for n in ("a", "b", "c", "d", "e")}
+        subs["c"].close()
+        assert [s.name for s in hub._subs.values()] == \
+            ["a", "b", "d", "e"]
+        subs["f"] = hub.subscribe("f", buffer=8, prime=False)
+        assert [s.name for s in hub._subs.values()] == \
+            ["a", "b", "d", "e", "f"]
+        state.add_service_entry(S.Service(
+            id="reg0", name="app0", image="i:1", hostname="h1",
+            updated=T0 + NS, status=S.ALIVE))
+        for name in ("a", "b", "d", "e", "f"):
+            ev = subs[name].get(timeout=1)
+            assert ev is not None and ev.kind == "delta", name
+
+    def test_close_is_idempotent(self):
+        state = make_state()
+        hub = state.query_hub()
+        a = hub.subscribe("a", buffer=8, prime=False)
+        b = hub.subscribe("b", buffer=8, prime=False)
+        a.close()
+        a.close()  # second close must be a no-op, not a miscount
+        assert hub.subscriber_count() == 1
+        assert metrics.snapshot()["gauges"]["query.hub.subscribers"] == 1
+        b.close()
+        assert hub.subscriber_count() == 0
+
+
+class TestZeroCopyEncodings:
+    def publish_one(self, state, sid="zc0"):
+        state.add_service_entry(S.Service(
+            id=sid, name="app0", image="i:1", hostname="h1",
+            updated=T0 + NS, status=S.ALIVE))
+
+    def test_watch_doc_cached_and_content_identical(self):
+        state = make_state()
+        snap = state.query_hub().current()
+        raw = snap.watch_doc_bytes(False)
+        assert raw is snap.watch_doc_bytes(False)
+        doc = json.loads(raw)
+        assert doc["Version"] == snap.version
+        assert doc["Snapshot"] == snap.to_json()
+        by = snap.watch_doc_bytes(True)
+        assert by is snap.watch_doc_bytes(True)
+        assert json.loads(by)["Snapshot"] == snap.by_service_json()
+
+    def test_fanout_shares_one_event_and_one_buffer(self):
+        """Publish once with two subscribers: both receive the SAME
+        QueryEvent object, and its wire doc is one shared buffer —
+        byte-identical to the legacy per-consumer builder."""
+        from sidecar_tpu.catalog.url_listener import delta_event_json
+
+        state = make_state()
+        hub = state.query_hub()
+        s1 = hub.subscribe("s1", buffer=8, prime=False)
+        s2 = hub.subscribe("s2", buffer=8, prime=False)
+        self.publish_one(state)
+        e1, e2 = s1.get(timeout=1), s2.get(timeout=1)
+        assert e1 is e2
+        assert e1.delta_doc_bytes() is e2.delta_doc_bytes()
+        assert e1.change_frag() is e2.change_frag()
+        assert e1.delta_doc_bytes() == delta_event_json(e1.version,
+                                                        e1.change)
+
+    def test_resync_doc_byte_parity_with_legacy(self):
+        from sidecar_tpu.catalog import url_listener as ul
+
+        state = make_state()
+        snap = state.query_hub().current()
+        legacy = json.dumps({"Version": snap.version,
+                             "State": snap.to_json()},
+                            separators=(",", ":")).encode()
+        assert snap.resync_doc_bytes() == legacy
+        # The listener helper serves the cached object, not a copy.
+        assert ul.resync_event_json(snap) is snap.resync_doc_bytes()
+
+    def test_one_encode_fill_per_version_many_consumers(self):
+        """The acceptance invariant behind the 100k-watcher climb:
+        query.encode.count advances once per version no matter how many
+        consumers read the buffers, including concurrently."""
+        state = make_state()
+        hub = state.query_hub()
+        subs = [hub.subscribe(f"n{i}", buffer=8, prime=False)
+                for i in range(16)]
+        count0 = metrics.counter("query.encode.count")
+        self.publish_one(state)
+        events = [s.get(timeout=1) for s in subs]
+        barrier = threading.Barrier(len(events))
+        bufs = []
+
+        def read(ev):
+            barrier.wait()
+            bufs.append(ev.delta_doc_bytes())
+
+        threads = [threading.Thread(target=read, args=(ev,), daemon=True)
+                   for ev in events]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(set(map(id, bufs))) == 1
+        # Exactly ONE fill (the ChangeEvent fragment) for the version.
+        assert metrics.counter("query.encode.count") - count0 == 1
+
+
+def wait_until(cond, timeout=10.0, interval=0.01):
+    import time as _time
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        if cond():
+            return True
+        _time.sleep(interval)
+    return cond()
+
+
+class TestRelayHub:
+    def test_relay_subscribers_see_root_versions(self):
+        from sidecar_tpu.query import RelayHub
+
+        state = make_state()
+        hub = state.query_hub()
+        relay = RelayHub(hub, name="r0", poll=0.05)
+        try:
+            sub = relay.subscribe("leaf")
+            prime = sub.get(timeout=1)
+            assert prime.kind == "snapshot" and prime.version == 1
+            for i in range(3):
+                state.add_service_entry(S.Service(
+                    id=f"rl{i}", name="app0", image="i:1", hostname="h1",
+                    updated=T0 + (i + 1) * NS, status=S.ALIVE))
+            versions = []
+            while len(versions) < 3:
+                ev = sub.get(timeout=2)
+                assert ev is not None and ev.kind == "delta"
+                assert ev.change.service.id == f"rl{len(versions)}"
+                versions.append(ev.version)
+            assert versions == [2, 3, 4]
+            assert versions[-1] == hub.current().version
+        finally:
+            relay.close()
+
+    def test_relay_subscribe_primes_from_horizon(self):
+        """A subscriber priming mid-stream starts at the relay's
+        delivered horizon and the next delta is horizon+1 — contiguous
+        by construction, never a gap against the relay-local stream."""
+        from sidecar_tpu.query import RelayHub
+
+        state = make_state()
+        hub = state.query_hub()
+        relay = RelayHub(hub, name="rh", poll=0.05)
+        try:
+            for i in range(2):
+                state.add_service_entry(S.Service(
+                    id=f"hz{i}", name="app0", image="i:1", hostname="h1",
+                    updated=T0 + (i + 1) * NS, status=S.ALIVE))
+            assert wait_until(
+                lambda: relay._last.version == hub.current().version)
+            sub = relay.subscribe("late")
+            prime = sub.get(timeout=1)
+            assert prime.kind == "snapshot"
+            assert prime.version == hub.current().version
+            state.add_service_entry(S.Service(
+                id="hz2", name="app0", image="i:1", hostname="h1",
+                updated=T0 + 9 * NS, status=S.ALIVE))
+            nxt = sub.get(timeout=2)
+            assert nxt.kind == "delta"
+            assert nxt.version == prime.version + 1
+        finally:
+            relay.close()
+
+    def test_slow_downstream_coalesces_then_resumes(self):
+        from sidecar_tpu.query import RelayHub
+
+        state = make_state()
+        hub = state.query_hub()
+        relay = RelayHub(hub, name="rc", poll=0.05)
+        try:
+            sub = relay.subscribe("slow", buffer=1, prime=False)
+            for i in range(4):
+                state.add_service_entry(S.Service(
+                    id=f"sl{i}", name="app0", image="i:1", hostname="h1",
+                    updated=T0 + (i + 1) * NS, status=S.ALIVE))
+            target = hub.current().version
+            assert wait_until(lambda: relay._last.version == target)
+            ev = sub.get(timeout=2)
+            assert ev.kind == "snapshot" and ev.version == target
+            state.add_service_entry(S.Service(
+                id="sl9", name="app0", image="i:1", hostname="h1",
+                updated=T0 + 9 * NS, status=S.ALIVE))
+            nxt = sub.get(timeout=2)
+            assert nxt.kind == "delta" and nxt.version == target + 1
+        finally:
+            relay.close()
+
+    def test_relay_close_semantics_and_gauge(self):
+        from sidecar_tpu.query import RelayHub
+
+        state = make_state()
+        hub = state.query_hub()
+        gauge = lambda: metrics.snapshot()["gauges"].get(  # noqa: E731
+            "query.hub.tier.relays", 0)
+        g0 = gauge()
+        relay = RelayHub(hub, name="gx", poll=0.05)
+        assert gauge() == g0 + 1
+        sub = relay.subscribe("down")
+        relay.close()
+        assert gauge() == g0
+        assert sub.get(timeout=1) is None and sub.closed
+        with pytest.raises(RuntimeError):
+            relay.subscribe("late")
+        assert hub.subscriber_count() == 0  # parent sub detached
+
+    def test_two_tier_tree_gap_free(self):
+        from sidecar_tpu.query import relay_tree
+
+        state = make_state()
+        hub = state.query_hub()
+        leaves, relays = relay_tree(hub, leaves=4, max_fanout=2,
+                                    name="tt")
+        try:
+            assert len(leaves) == 4 and len(relays) == 6  # 2 mid + 4
+            assert hub.subscriber_count() == 2  # only the mid tier
+            subs = [leaf.subscribe(f"l{i}", buffer=16, prime=False)
+                    for i, leaf in enumerate(leaves)]
+            for i in range(5):
+                state.add_service_entry(S.Service(
+                    id=f"tt{i}", name="app0", image="i:1", hostname="h1",
+                    updated=T0 + (i + 1) * NS, status=S.ALIVE))
+            for sub in subs:
+                versions = []
+                while len(versions) < 5:
+                    ev = sub.get(timeout=2)
+                    assert ev is not None and ev.kind == "delta"
+                    versions.append(ev.version)
+                assert versions == [2, 3, 4, 5, 6]
+        finally:
+            for relay in relays:
+                relay.close()
+
+
+@pytest.mark.slow
+class TestRelayTierSoak:
+    """The ≥10k-subscriber acceptance soak: a two-tier relay tree fans
+    one publish stream to 10 000 subscriptions.  Every healthy
+    subscriber must see the identical gap-free version sequence; the
+    deliberately tiny-buffered minority must coalesce with exact
+    drop/coalesce counter accounting; and each version's wire buffer
+    must be ONE shared object across all subscribers (zero aliasing
+    between versions)."""
+
+    N_SUBS = 10_000
+    EVENTS = 12
+    TINY_EVERY = 100  # every 100th subscriber gets a 2-slot buffer
+
+    def test_ten_thousand_subscribers_gap_free(self):
+        import hashlib
+
+        from sidecar_tpu.query import relay_tree
+
+        state = make_state()
+        hub = state.query_hub()
+        base = hub.current().version
+        target = base + self.EVENTS
+        dropped0 = metrics.counter("query.hub.dropped")
+        coalesced0 = metrics.counter("query.hub.coalesced")
+        leaves, relays = relay_tree(hub, leaves=8, max_fanout=4,
+                                    name="soak")
+        subs = []
+        for i in range(self.N_SUBS):
+            tiny = (i % self.TINY_EVERY) == 0
+            subs.append(leaves[i % len(leaves)].subscribe(
+                f"soak{i}", buffer=2 if tiny else self.EVENTS + 4,
+                prime=False))
+        for i in range(self.EVENTS):
+            state.add_service_entry(S.Service(
+                id=f"ev{i}", name="app0", image="i:1", hostname="h1",
+                updated=T0 + (i + 1) * NS, status=S.ALIVE))
+        # All queues are fully populated once every leaf's horizon hits
+        # the head; draining after that is non-blocking + deterministic.
+        assert wait_until(
+            lambda: all(leaf._last.version == target for leaf in leaves),
+            timeout=60)
+        delta_delivered = 0
+        snapshot_delivered = 0
+        buf_by_version: dict = {}
+        digest_by_version: dict = {}
+        try:
+            for i, sub in enumerate(subs):
+                events = sub.drain()
+                tiny = (i % self.TINY_EVERY) == 0
+                if tiny:
+                    # Collapsed: exactly one marker at the head, every
+                    # missed delta subsumed.
+                    assert [ev.kind for ev in events] == ["snapshot"], i
+                    assert events[0].version == target
+                    snapshot_delivered += 1
+                    continue
+                versions = [ev.version for ev in events]
+                assert versions == list(range(base + 1, target + 1)), i
+                delta_delivered += len(events)
+                for ev in events:
+                    buf = ev.delta_doc_bytes()
+                    seen = buf_by_version.setdefault(ev.version, buf)
+                    # Zero-copy: every subscriber of a version holds
+                    # THE SAME buffer object.
+                    assert seen is buf, (i, ev.version)
+            # No two versions alias one buffer.
+            for version, buf in buf_by_version.items():
+                digest_by_version[version] = hashlib.sha256(
+                    buf).hexdigest()
+            assert len(set(digest_by_version.values())) == self.EVENTS
+            assert len(set(map(id, buf_by_version.values()))) \
+                == self.EVENTS
+            # Conservation: every offered event was either delivered as
+            # a delta or counted into query.hub.dropped — and each
+            # collapse transition produced exactly one marker delivery.
+            n_tiny = len(range(0, self.N_SUBS, self.TINY_EVERY))
+            dropped = metrics.counter("query.hub.dropped") - dropped0
+            coalesced = metrics.counter("query.hub.coalesced") \
+                - coalesced0
+            assert delta_delivered + dropped \
+                == self.EVENTS * self.N_SUBS
+            assert delta_delivered \
+                == self.EVENTS * (self.N_SUBS - n_tiny)
+            assert coalesced == n_tiny
+            assert snapshot_delivered == coalesced
+        finally:
+            for relay in relays:
+                relay.close()

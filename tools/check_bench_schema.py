@@ -46,6 +46,7 @@ KNOWN_RESULT_BLOCKS = {
     "north_star_faithful": dict,
     "sharded": dict,
     "query": dict,
+    "query_scale": dict,
     "robustness": dict,
     "adversary": dict,
     "sweep": dict,
@@ -113,6 +114,36 @@ def validate_result(doc: dict, issues: List[str],
             issues.append(
                 f"{ctx}: coherence.rounds_to_eps_ratio is neither "
                 "null nor a number")
+    if isinstance(doc.get("query_scale"), dict):
+        qs = doc["query_scale"]
+        levels = qs.get("levels")
+        if levels is not None:
+            if not isinstance(levels, list):
+                issues.append(
+                    f"{ctx}: query_scale.levels is not a list")
+            else:
+                for i, level in enumerate(levels):
+                    if not isinstance(level, dict):
+                        issues.append(
+                            f"{ctx}: query_scale.levels[{i}] is not "
+                            "an object")
+        if "max_subscribers" in qs \
+                and not isinstance(qs["max_subscribers"], int):
+            issues.append(
+                f"{ctx}: query_scale.max_subscribers is not an int")
+        if "gap_free" in qs and not isinstance(qs["gap_free"], bool):
+            issues.append(
+                f"{ctx}: query_scale.gap_free is not a bool")
+        # The acceptance headlines: null (an honest non-result — e.g.
+        # the ramp was capped below the baseline threshold, or a
+        # watchdog cut the run short) or a number; never anything else.
+        for key in ("serialization_ratio", "lag_p99_ms",
+                    "lag_p99_versions", "publish_p99_ms"):
+            val = qs.get(key)
+            if val is not None and not isinstance(val, NUMBER):
+                issues.append(
+                    f"{ctx}: query_scale.{key} is neither "
+                    "null nor a number")
     if isinstance(doc.get("antientropy"), dict):
         ae = doc["antientropy"]
         for key in ("live", "sim"):
