@@ -817,6 +817,22 @@ class SimBridge:
             tvals.append(base["topology"])
         for t_name in dict.fromkeys(tvals):
             topo_mod.from_name(str(t_name), int(n))  # ValueError → 400
+        # Cadence axes are validated BEFORE the grid expands, like the
+        # overlay names above — a malformed tick_period/tick_phase is a
+        # named 400 up front, not a spec error pt047 deep into the
+        # expansion (docs/pipeline.md).
+        for ax, floor in (("tick_period", 1), ("tick_phase", 0)):
+            vals = axes.get(ax)
+            vals = list(vals) if isinstance(vals, (list, tuple)) else []
+            if base.get(ax) is not None:
+                vals.append(base[ax])
+            for v in vals:
+                if isinstance(v, bool) or not isinstance(v, int) \
+                        or v < floor:
+                    raise ValueError(
+                        f"{ax}={v!r} must be an int >= {floor} "
+                        "(per-node gossip cadence in rounds, "
+                        "docs/pipeline.md)")
         # Library-only axes get a NAMED rejection here rather than the
         # batch builder's family/plan error: the HTTP surface has no
         # way to supply a FaultPlan structure or select the compressed

@@ -272,6 +272,12 @@ class ChaosExactSim(ExactSim):
     # FaultPlan-driven *node liveness* composes with the sparse path on
     # the plain sims instead (tests/test_sparse.py).
     supports_sparse = False
+    # The chaos round interleaves delay rings and adversary forgery
+    # between select and fold — the one-round-stale pipelined carry
+    # (docs/pipeline.md) has no slot for those structures, so chaos runs
+    # stay lockstep.  SIDECAR_TPU_PIPELINE=1 degrades here (auto-OFF
+    # contract in ops/pipeline.py); pipeline=True raises.
+    supports_pipeline = False
 
     def __init__(self, params: SimParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
@@ -745,7 +751,9 @@ class ChaosExactSim(ExactSim):
             prev.sim, nxt.sim, budget=min(self.p.budget, self.p.m),
             fanout=self.p.fanout,
             limit=self.p.resolved_retransmit_limit(), stats=stats,
-            rejected_future=nxt.rejected_future - prev.rejected_future)
+            rejected_future=nxt.rejected_future - prev.rejected_future,
+            tick_period=self._knobs.tick_period,
+            tick_phase=self._knobs.tick_phase)
 
     def injection_counts(self, cst: ChaosSimState) -> dict:
         return {"dropped": int(cst.injected_drops),
@@ -804,22 +812,23 @@ class ChaosExactSim(ExactSim):
             metrics.incr("defense.sim.quarantinedOrigins", quarantined)
 
     def run(self, state, key, num_rounds: int, donate: bool = True,
-            start_round=None, sparse=None):
+            start_round=None, sparse=None, pipeline=None):
         # Snapshot the injection counters BEFORE dispatch: the donating
         # run deletes the input state's buffers (models/exact.py).
         # (The snapshot reads device scalars, so a chaos sim pays one
         # sync per chunk even when start_round is supplied.)
         before = self._counter_snapshot(state)
         final, conv = super().run(state, key, num_rounds, donate=donate,
-                                  start_round=start_round, sparse=sparse)
+                                  start_round=start_round, sparse=sparse,
+                                  pipeline=pipeline)
         self._publish_injection_metrics(before, final)
         return final, conv
 
     def run_fast(self, state, key, num_rounds: int, donate: bool = True,
-                 sparse=None):
+                 sparse=None, pipeline=None):
         before = self._counter_snapshot(state)
         final = super().run_fast(state, key, num_rounds, donate=donate,
-                                 sparse=sparse)
+                                 sparse=sparse, pipeline=pipeline)
         self._publish_injection_metrics(before, final)
         return final
 

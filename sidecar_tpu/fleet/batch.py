@@ -84,6 +84,10 @@ class ScenarioSpec:
     future_fudge_s: Optional[float] = None   # negative = bound disabled
     origin_budget: Optional[int] = None      # negative = budget disabled
     origin_quarantine: Optional[int] = None  # negative = quarantine off
+    tick_period: Optional[int] = None        # per-node gossip cadence
+    #                                          (rounds between ticks;
+    #                                          None/1 = every round)
+    tick_phase: Optional[int] = None         # cadence phase offset
 
     def axes(self) -> dict:
         """The non-default knobs, for report/Pareto tables."""
@@ -139,6 +143,15 @@ class ScenarioBatch:
         kw = {f: getattr(s, f) for f in _TIMECFG_FIELDS
               if getattr(s, f) is not None}
         return dataclasses.replace(self.timecfg, **kw)
+
+    def scenario_cadence(self, i: int) -> tuple:
+        """Scenario ``i``'s ``(tick_period, tick_phase)`` for the
+        unbatched classic twin's constructor (``ExactSim(...,
+        tick_period=..., tick_phase=...)``) — ``(1, 0)`` when the spec
+        states neither (the pre-cadence program)."""
+        s = self.specs[i]
+        return (s.tick_period if s.tick_period is not None else 1,
+                s.tick_phase if s.tick_phase is not None else 0)
 
     def scenario_plan(self, i: int):
         """Scenario ``i``'s FaultPlan: the shared structure re-seeded
@@ -223,6 +236,24 @@ class ScenarioBatch:
                     continue  # any negative value means "knob off"
                 if v is not None and v < 0:
                     raise ValueError(f"{s.name}: {f}={v} must be >= 0")
+            # Cadence axes (docs/pipeline.md): named, typed rejection —
+            # a float or zero period would silently stall every node
+            # (x % 0) or truncate to a different grid point.
+            if s.tick_period is not None and (
+                    isinstance(s.tick_period, bool)
+                    or not isinstance(s.tick_period, int)
+                    or s.tick_period < 1):
+                raise ValueError(
+                    f"{s.name}: tick_period={s.tick_period!r} must be "
+                    "an int >= 1 (rounds between gossip ticks; 1 = "
+                    "every round)")
+            if s.tick_phase is not None and (
+                    isinstance(s.tick_phase, bool)
+                    or not isinstance(s.tick_phase, int)
+                    or s.tick_phase < 0):
+                raise ValueError(
+                    f"{s.name}: tick_phase={s.tick_phase!r} must be "
+                    "an int >= 0 (cadence phase offset in rounds)")
             if s.fault_seed is not None and plan is None:
                 raise ValueError(
                     f"{s.name}: fault_seed={s.fault_seed} needs a "
@@ -299,6 +330,18 @@ class ScenarioBatch:
                 lambda i: (specs[i].fault_seed
                            if specs[i].fault_seed is not None
                            else (plan.seed if plan is not None else 0)),
+                np.int32),
+            # Always stacked (every RoundKnobs field is a vmapped data
+            # leaf): at period 1 the compiled cadence gate maps nothing
+            # — value-identical to the unbatched pre-cadence program
+            # (ops/knobs.RoundKnobs.cadence_enabled).
+            tick_period=stack(
+                lambda i: (specs[i].tick_period
+                           if specs[i].tick_period is not None else 1),
+                np.int32),
+            tick_phase=stack(
+                lambda i: (specs[i].tick_phase
+                           if specs[i].tick_phase is not None else 0),
                 np.int32),
         )
         keys = jnp.stack([jax.random.PRNGKey(s.seed) for s in specs])

@@ -6,7 +6,8 @@ parameter space:
 
 * **data axes** (vary within a batch): ``retransmit_limit``,
   ``drop_prob``, ``churn_prob``, ``mint_frac``, ``fault_seed``,
-  ``seed``, and the per-scenario TimeConfig overrides
+  ``seed``, ``tick_period``/``tick_phase`` (the per-node gossip
+  cadence, docs/pipeline.md), and the per-scenario TimeConfig overrides
   (``push_pull_interval_s``, ``sweep_interval_s``,
   ``refresh_interval_s``, ``suspicion_window_s``,
   ``alive_lifespan_s``, ``draining_lifespan_s``,
@@ -38,7 +39,7 @@ _DATA_AXES = (
     "fault_seed", "push_pull_interval_s", "sweep_interval_s",
     "refresh_interval_s", "suspicion_window_s", "alive_lifespan_s",
     "draining_lifespan_s", "tombstone_lifespan_s", "future_fudge_s",
-    "origin_budget", "origin_quarantine",
+    "origin_budget", "origin_quarantine", "tick_period", "tick_phase",
 )
 _STATIC_AXES = ("fanout", "budget", "topology")
 KNOWN_AXES = _DATA_AXES + _STATIC_AXES

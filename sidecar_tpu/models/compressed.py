@@ -114,12 +114,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from sidecar_tpu.models.exact import clone_state
+from sidecar_tpu.models.exact import _resolve_cadence, clone_state
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import digest as digest_ops
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import kernels as kernel_ops
 from sidecar_tpu.ops import knobs as knob_ops
+from sidecar_tpu.ops import pipeline as pipeline_ops
 from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
@@ -302,12 +303,24 @@ class CompressedSim:
     # twin sets this False and the drivers degrade/raise accordingly.
     supports_sparse = True
 
+    # Whether this sim implements the software-pipelined round
+    # (docs/pipeline.md); wrappers without a pipelined twin set this
+    # False and ``run*(pipeline=...)`` degrades/raises accordingly.
+    supports_pipeline = True
+
+    # Pin the pipelined publish to the XLA kernel twin: the sharded
+    # subclass runs the pipelined round at the GLOBAL-array jit level
+    # (GSPMD partitions it), where the Pallas kernels cannot partition.
+    _pipeline_force_xla = False
+
     def __init__(self, params: CompressedParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  perturb: Optional[PerturbFn] = None,
                  cut_mask: Optional[np.ndarray] = None,
                  node_side: Optional[np.ndarray] = None,
-                 sparse: Optional[str] = None):
+                 sparse: Optional[str] = None,
+                 pipeline: Optional[str] = None,
+                 tick_period=None, tick_phase=None):
         if topo.n != params.n:
             raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
         if cut_mask is not None and topo.nbrs is None:
@@ -338,11 +351,21 @@ class CompressedSim:
         # resolved once at construction like the kernel path; the caps
         # are static — they shape the compacted program.
         self._sparse_mode = sparse_ops.resolve_sparse(sparse)
+        # Software-pipelined round mode (ops/pipeline.py,
+        # docs/pipeline.md): resolved once at construction; ``auto``
+        # keeps the drivers on the classic lockstep round.
+        self._pipeline_mode = pipeline_ops.resolve_pipeline(pipeline)
+        # Per-node tick cadence (docs/pipeline.md): scalars or [N]
+        # vectors; a (provable) period of 1 strips the gate and
+        # compiles the pre-cadence program bit for bit.
+        tick_period, tick_phase = _resolve_cadence(
+            tick_period, tick_phase, params.n)
         # Static data-axis knob bundle (ops/knobs.py): Python scalars
         # that const-fold the round into the pre-knob program; the
         # fleet engine passes a stacked traced bundle per round instead.
         self._knobs = knob_ops.from_protocol(
-            params, timecfg, recover_rounds=params.recover_rounds)
+            params, timecfg, recover_rounds=params.recover_rounds,
+            tick_period=tick_period, tick_phase=tick_phase)
         cap = params.sparse_cap or sparse_ops.default_frontier_cap(params.n)
         self._sparse_caps = (min(params.n, cap),
                              min(params.n, cap * params.fanout),
@@ -363,6 +386,19 @@ class CompressedSim:
         return dict(stagger=self._stagger,
                     stagger_period=self._stagger_period,
                     round_idx=round_idx)
+
+    def _gate_kw(self, round_idx, kn=None):
+        """The full ``sample_peers`` gating kwargs for this round:
+        stagger (topology-attached) plus the per-node tick cadence
+        (knob-carried — a traced fleet axis).  ``{}`` when neither is
+        active, so the ungated program stays byte-identical."""
+        kn = self._knobs if kn is None else kn
+        kw = self._stagger_kw(round_idx)
+        if kn.cadence_enabled:
+            kw = dict(kw)
+            kw.update(tick_period=kn.tick_period,
+                      tick_phase=kn.tick_phase, round_idx=round_idx)
+        return kw
 
     # -- state construction -------------------------------------------------
 
@@ -1066,7 +1102,7 @@ class CompressedSim:
         src = gossip_ops.sample_peers(
             k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
             node_alive=state.node_alive, cut_mask=self._cut,
-            **self._stagger_kw(round_idx))
+            **self._gate_kw(round_idx, kn))
         state = self._round_gossip_announce(state, src, k_drop,
                                             round_idx, now, kn=kn)
 
@@ -1222,7 +1258,7 @@ class CompressedSim:
         src = gossip_ops.sample_peers(
             k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
             node_alive=state.node_alive, cut_mask=self._cut,
-            **self._stagger_kw(round_idx))
+            **self._gate_kw(round_idx))
 
         sender, recv, announcer, ann = self._sparse_frontiers(
             state, src, limit, round_idx, now)
@@ -1255,6 +1291,73 @@ class CompressedSim:
         ov = overflow.astype(jnp.int32)
         stats = jnp.stack([1 - ov, ov, frontier])
         return dataclasses.replace(state, round_idx=round_idx), stats
+
+    # -- the software-pipelined round (docs/pipeline.md) ---------------------
+
+    def _select_inflight(self, state, round_sel, k_round, kn=None):
+        """Select round ``round_sel``'s publish from the CURRENT
+        (pre-fold) cache: the raw board plus the pull sources, with the
+        transmit-budget bump charged immediately (``_publish`` bumps
+        ``cache_sent`` exactly as the lockstep round does; the fold's
+        changed-line reset wins on overlap — the bump-then-reset order
+        of the exact family).  Consumes the ``k_peers`` leg of
+        ``round_sel``'s 4-way split, so every draw keeps its lockstep
+        stream position.  The admission gates do NOT run here — the
+        board is carried raw and gated at fold time against the fold
+        tick's ``now``.  Returns ``((src, bval, bslot), cache_sent)``."""
+        p = self.p
+        kn = self._knobs if kn is None else kn
+        _kp, k_peers, _kd, _kpp = jax.random.split(k_round, 4)
+        src = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout, nbrs=self._nbrs, deg=self._deg,
+            node_alive=state.node_alive, cut_mask=self._cut,
+            **self._gate_kw(round_sel, kn))
+        bval, bslot, sent = self._publish(
+            state, kn.limit, force_xla=self._pipeline_force_xla)
+        return (src, bval, bslot), sent
+
+    def _step_pipelined(self, state, inflight, k_now, k_next, kn=None):
+        """One software-pipelined round (docs/pipeline.md): fold the
+        carried round-``r`` boards while round ``r+1``'s publish is
+        selected from the PRE-fold cache — the honest one-round-stale
+        schedule (a board reflects its publisher's belief before this
+        tick's deliveries and announces landed).  The admission gates
+        (staleness/future/budget) and the liveness mask run at FOLD
+        time against this tick's ``now``/``node_alive`` — a board from
+        a publisher that died in this tick's perturb folds nothing,
+        exactly as in the lockstep round.  ``k_now`` is round ``r``'s
+        folded key (perturb/drop/push-pull legs); ``k_next`` is round
+        ``r+1``'s (its peers leg, consumed one tick early)."""
+        p, t = self.p, self.t
+        kn = self._knobs if kn is None else kn
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, _k_peers, k_drop, k_pp = jax.random.split(k_now, 4)
+
+        if self.perturb is not None:
+            if getattr(self.perturb, "wants_knobs", False):
+                state = self.perturb(state, k_perturb, now, kn)
+            else:
+                state = self.perturb(state, k_perturb, now)
+
+        src, bval, bslot = inflight
+        inflight, sent = self._select_inflight(state, round_idx + 1,
+                                               k_next, kn=kn)
+        state = self._pull_merge(state, sent, bval, bslot, src,
+                                 state.node_alive, now, drop_key=k_drop,
+                                 kn=kn)
+        state = self._announce(state, round_idx, now, kn=kn)
+
+        state = lax.cond(
+            round_idx % kn.push_pull_rounds == 0,
+            lambda st: self._push_pull_stride(st, k_pp, now, kn=kn),
+            lambda st: st, state)
+        state = lax.cond(
+            round_idx % kn.sweep_rounds == 0,
+            lambda st: self._floor_advance_and_sweep(st, now, kn=kn),
+            lambda st: st, state)
+
+        return dataclasses.replace(state, round_idx=round_idx), inflight
 
     # -- metrics ------------------------------------------------------------
 
@@ -1451,6 +1554,9 @@ class CompressedSim:
         src = self._prov_sample_src(k_peers, alive)
         src = gossip_ops.stagger_gate(src, round_idx, self._stagger,
                                       self._stagger_period)
+        if kn.cadence_enabled:
+            src = gossip_ops.cadence_gate(src, round_idx, kn.tick_period,
+                                          kn.tick_phase)
         pulls = [(src, None)]
 
         # The stride exchange (_push_pull_stride): node i merges the
@@ -1488,6 +1594,19 @@ class CompressedSim:
         return sparse_ops.resolve_request(self._sparse_mode, sparse,
                                           self.supports_sparse)
 
+    def _resolve_pipeline_request(self, pipeline):
+        return pipeline_ops.resolve_request(self._pipeline_mode, pipeline,
+                                            self.supports_pipeline)
+
+    def _pipeline_dispatch(self, sparse):
+        """Guard a pipelined dispatch: the carried board is dense, so
+        the sparse-frontier round cannot compose with it."""
+        if self._resolve_sparse_request(sparse):
+            raise ValueError(
+                "pipelined execution does not compose with the "
+                "sparse-frontier round (the carried publish is dense); "
+                "pass sparse='0' or pipeline=False")
+
     def step(self, state, key):
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
@@ -1501,7 +1620,8 @@ class CompressedSim:
         return self._step_sparse_jit(state, key)
 
     def run(self, state, key, num_rounds: int, conv_every: int = 1,
-            donate: bool = True, start_round=None, sparse=None):
+            donate: bool = True, start_round=None, sparse=None,
+            pipeline=None):
         """Run ``num_rounds``, sampling the convergence metric every
         ``conv_every`` rounds (the returned curve has
         ``num_rounds // conv_every`` points, at rounds ``conv_every,
@@ -1513,6 +1633,12 @@ class CompressedSim:
             raise ValueError(
                 f"num_rounds={num_rounds} not divisible by "
                 f"conv_every={conv_every}")
+        if self._resolve_pipeline_request(pipeline):
+            self._pipeline_dispatch(sparse)
+            final, conv, _inflight = self.run_pipelined(
+                state, key, num_rounds, conv_every, donate=donate,
+                start_round=start_round)
+            return final, conv
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
@@ -1545,7 +1671,13 @@ class CompressedSim:
         return self._run_behind_jit(state, key, num_rounds, every)
 
     def run_fast(self, state, key, num_rounds: int, donate: bool = True,
-                 start_round=None, sparse=None):
+                 start_round=None, sparse=None, pipeline=None):
+        if self._resolve_pipeline_request(pipeline):
+            self._pipeline_dispatch(sparse)
+            final, _inflight = self.run_fast_pipelined(
+                state, key, num_rounds, donate=donate,
+                start_round=start_round)
+            return final
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
@@ -1557,6 +1689,63 @@ class CompressedSim:
         self.last_sparse_stats = None
         return self._run_fast_jit(state, key, num_rounds)
 
+    # -- pipelined drivers (docs/pipeline.md) --------------------------------
+    # The explicit-arity twins of run/run_fast: they thread the
+    # ``(state, inflight)`` scan carry so chunked dispatches resume the
+    # software pipeline exactly where the previous chunk left it
+    # (tests pin chunked == straight round for round).
+
+    def run_pipelined(self, state, key, num_rounds: int,
+                      conv_every: int = 1, *, inflight=None,
+                      donate: bool = True, start_round=None):
+        """Pipelined :meth:`run`: returns ``(final, conv, inflight)``.
+        ``inflight=None`` primes the pipeline from the current cache
+        (:meth:`prime_pipeline`); chunked callers pass the previous
+        chunk's carry instead."""
+        self._resolve_pipeline_request(True)
+        if num_rounds % conv_every:
+            raise ValueError(
+                f"num_rounds={num_rounds} not divisible by "
+                f"conv_every={conv_every}")
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if inflight is None:
+            state, inflight = self._prime_jit(state, key)
+        self.last_sparse_stats = None
+        return self._run_pipelined_jit(state, key, num_rounds,
+                                       conv_every, inflight)
+
+    def run_fast_pipelined(self, state, key, num_rounds: int, *,
+                           inflight=None, donate: bool = True,
+                           start_round=None):
+        """Pipelined :meth:`run_fast`: returns ``(final, inflight)``."""
+        self._resolve_pipeline_request(True)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if inflight is None:
+            state, inflight = self._prime_jit(state, key)
+        self.last_sparse_stats = None
+        return self._run_fast_pipelined_jit(state, key, num_rounds,
+                                            inflight)
+
+    def prime_pipeline(self, state, key):
+        """Fill the software pipeline: select round
+        ``state.round_idx + 1``'s publish from the current cache.
+        Returns ``(state, inflight)`` — the pipelined scan carry."""
+        return self._prime_jit(state, key)
+
+    def step_pipelined(self, state, inflight, key):
+        """One pipelined round from the BASE key (the drivers' key
+        schedule) — the stepwise probe the lockstep suites compare
+        against the scan drivers."""
+        self._check_horizon(state, 1)
+        return self._step_pipelined_jit(
+            state, inflight,
+            jax.random.fold_in(key, state.round_idx),
+            jax.random.fold_in(key, state.round_idx + 1))
+
     def _trace_record(self, prev, nxt, stats):
         """One round's flight-recorder record (ops/trace.py) — the
         behind census goes through :meth:`behind`, so the sharded
@@ -1566,7 +1755,9 @@ class CompressedSim:
         return trace_ops.compressed_record(
             prev, nxt, self.behind(nxt),
             budget=min(p.budget, p.cache_lines), fanout=p.fanout,
-            limit=p.resolved_retransmit_limit(), stats=stats)
+            limit=p.resolved_retransmit_limit(), stats=stats,
+            tick_period=self._knobs.tick_period,
+            tick_phase=self._knobs.tick_phase)
 
     def run_with_trace(self, state, key, num_rounds: int, cap: int = 0,
                        donate: bool = True, start_round=None,
@@ -1694,6 +1885,21 @@ class CompressedSim:
     def _step_sparse_jit(self, state, key):
         return self._step_sparse(state, key)
 
+    # no-donate: the pipeline prologue's input state is the caller's —
+    # only the scan drivers own their buffers.
+    @functools.partial(jax.jit, static_argnums=0)
+    def _prime_jit(self, state, key):
+        inflight, sent = self._select_inflight(
+            state, state.round_idx + 1,
+            jax.random.fold_in(key, state.round_idx))
+        return dataclasses.replace(state, cache_sent=sent), inflight
+
+    # no-donate: the pipelined single-round probe serves the stepwise
+    # lockstep suites.
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_pipelined_jit(self, state, inflight, k_now, k_next):
+        return self._step_pipelined(state, inflight, k_now, k_next)
+
     # Per-round keys fold the round index into the base key so chunked/
     # resumed runs replay identical randomness (see ExactSim).
 
@@ -1724,6 +1930,45 @@ class CompressedSim:
             return self._step(st, jax.random.fold_in(key, st.round_idx)), None
         final, _ = lax.scan(body, state, None, length=num_rounds)
         return final
+
+    # -- pipelined scan drivers (docs/pipeline.md) ---------------------------
+    # Same donation and per-round key folding as the lockstep drivers;
+    # the carry is ``(state, inflight)`` — round r+1's publish selected
+    # inside the tick that folds round r.
+
+    @functools.partial(jax.jit, static_argnums=(0, 3, 4),
+                       donate_argnums=(1, 5))
+    def _run_pipelined_jit(self, state, key, num_rounds, conv_every,
+                           inflight):
+        def inner(carry, _):
+            st, infl = carry
+            return self._step_pipelined(
+                st, infl,
+                jax.random.fold_in(key, st.round_idx),
+                jax.random.fold_in(key, st.round_idx + 1)), None
+
+        def body(carry, _):
+            carry, _ = lax.scan(inner, carry, None, length=conv_every)
+            return carry, self.convergence(carry[0])
+
+        (final, inflight), conv = lax.scan(
+            body, (state, inflight), None,
+            length=num_rounds // conv_every)
+        return final, conv, inflight
+
+    @functools.partial(jax.jit, static_argnums=(0, 3),
+                       donate_argnums=(1, 4))
+    def _run_fast_pipelined_jit(self, state, key, num_rounds, inflight):
+        def body(carry, _):
+            st, infl = carry
+            return self._step_pipelined(
+                st, infl,
+                jax.random.fold_in(key, st.round_idx),
+                jax.random.fold_in(key, st.round_idx + 1)), None
+
+        (final, inflight), _ = lax.scan(body, (state, inflight), None,
+                                        length=num_rounds)
+        return final, inflight
 
     @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
     def _run_deltas_jit(self, state, key, num_rounds, cap):

@@ -53,6 +53,7 @@ from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import digest as digest_ops
 from sidecar_tpu.ops import gossip as gossip_ops
 from sidecar_tpu.ops import knobs as knob_ops
+from sidecar_tpu.ops import pipeline as pipeline_ops
 from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
@@ -131,6 +132,38 @@ class SimParams:
 PerturbFn = Callable[[SimState, jax.Array, jax.Array], SimState]
 
 
+def _resolve_cadence(tick_period, tick_phase, n: int):
+    """Normalize constructor cadence arguments (shared by every model
+    family): ``None``/1 stays the static Python scalar that compiles
+    the pre-cadence program; anything else becomes an int32 device
+    vector (scalar → length-1, broadcast by the gate).  Period values
+    must be ≥ 1 ints, phase any int — the named validation the fleet
+    grid mirrors (fleet/grid.py)."""
+    if tick_period is None:
+        tick_period = 1
+    if tick_phase is None:
+        tick_phase = 0
+    if isinstance(tick_period, int) and isinstance(tick_phase, int):
+        if tick_period < 1:
+            raise ValueError(
+                f"tick_period must be an int ≥ 1, got {tick_period!r}")
+        if tick_period == 1:
+            return 1, 0
+        return tick_period, tick_phase
+    period = np.asarray(tick_period, dtype=np.int64).reshape(-1)
+    phase = np.asarray(tick_phase, dtype=np.int64).reshape(-1)
+    if (period < 1).any():
+        raise ValueError(
+            f"tick_period entries must be ≥ 1, got min {period.min()}")
+    for name, v in (("tick_period", period), ("tick_phase", phase)):
+        if v.shape[0] not in (1, n):
+            raise ValueError(
+                f"{name} must be a scalar or a length-{n} per-node "
+                f"vector, got shape {v.shape}")
+    return (jnp.asarray(period, jnp.int32),
+            jnp.asarray(phase, jnp.int32))
+
+
 class ExactSim:
     """Single-chip exact simulator (multi-chip: ``sidecar_tpu.parallel``)."""
 
@@ -138,12 +171,19 @@ class ExactSim:
     # wrapper overrides to False (its fault-gated round stays dense —
     # the delay rings/packet masks are already bounded structures).
     supports_sparse = True
+    # Whether this sim implements the software-pipelined round
+    # (ops/pipeline.py, docs/pipeline.md); the chaos wrapper overrides
+    # to False (its fault-gated delivery rings assume the lockstep
+    # select-deliver ordering).
+    supports_pipeline = True
 
     def __init__(self, params: SimParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  perturb: Optional[PerturbFn] = None,
                  cut_mask: Optional[np.ndarray] = None,
-                 sparse: Optional[str] = None):
+                 sparse: Optional[str] = None,
+                 pipeline: Optional[str] = None,
+                 tick_period=None, tick_phase=None):
         if topo.n != params.n:
             raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
         self.p = params
@@ -172,11 +212,22 @@ class ExactSim:
                          or topo.stagger_period <= 1
                          else jnp.asarray(topo.stagger, jnp.int32))
         self._stagger_period = int(topo.stagger_period)
+        # Pipelined-round mode (ops/pipeline.py, docs/pipeline.md):
+        # resolved once at construction, like sparse/kernels.
+        self._pipeline_mode = pipeline_ops.resolve_pipeline(pipeline)
+        # Heterogeneous tick cadence (docs/pipeline.md): None/1 compiles
+        # the pre-cadence program bit for bit; a scalar or per-node [N]
+        # vector keeps the cadence gate compiled.  Rides the knob
+        # bundle, so the fleet can sweep it as a data axis.
+        tick_period, tick_phase = _resolve_cadence(
+            tick_period, tick_phase, params.n)
         # The static data-axis knob bundle (ops/knobs.py): plain Python
         # scalars that const-fold the round into exactly the pre-knob
         # program; the fleet engine overrides per round with a stacked,
         # traced bundle instead (docs/sweep.md).
-        self._knobs = knob_ops.from_protocol(params, timecfg)
+        self._knobs = knob_ops.from_protocol(
+            params, timecfg, tick_period=tick_period,
+            tick_phase=tick_phase)
         # Max positive clock-skew offset any stamping site can add to a
         # tick (0 outside the chaos family) — the horizon guard folds it
         # in so an injected rushing clock cannot silently run the packed
@@ -195,6 +246,22 @@ class ExactSim:
         return dict(stagger=self._stagger,
                     stagger_period=self._stagger_period,
                     round_idx=round_idx)
+
+    def _gate_kw(self, round_idx, kn=None):
+        """All of ``sample_peers``'s delivery-gating kwargs for this
+        round: the topology's stagger offsets plus the knob bundle's
+        heterogeneous tick cadence (ops/gossip.cadence_gate) — ``{}``
+        when neither is active, so the call compiles the pre-gate
+        program byte for byte.  Gossip fan-out only; the push-pull
+        partner draw never takes these (anti-entropy is the catch-up
+        channel)."""
+        kn = self._knobs if kn is None else kn
+        kw = self._stagger_kw(round_idx)
+        if kn.cadence_enabled:
+            kw = dict(kw)
+            kw.update(tick_period=kn.tick_period,
+                      tick_phase=kn.tick_phase, round_idx=round_idx)
+        return kw
 
     # -- state construction ------------------------------------------------
 
@@ -386,7 +453,7 @@ class ExactSim:
             k_peers, p.n, p.fanout,
             nbrs=self._nbrs, deg=self._deg,
             node_alive=node_alive, cut_mask=self._cut,
-            **self._stagger_kw(round_idx),
+            **self._gate_kw(round_idx, kn),
         )
         known, sent = self._round_deliver_announce(
             known, sent, node_alive, dst, k_drop, round_idx, now, kn=kn)
@@ -457,7 +524,7 @@ class ExactSim:
             k_peers, p.n, p.fanout,
             nbrs=self._nbrs, deg=self._deg,
             node_alive=node_alive, cut_mask=self._cut,
-            **self._stagger_kw(round_idx),
+            **self._gate_kw(round_idx),
         )
         sender = jnp.any(
             gossip_ops.eligible_records(known, sent, limit), axis=1)
@@ -517,6 +584,147 @@ class ExactSim:
         return SimState(known=known, sent=sent, node_alive=node_alive,
                         round_idx=round_idx), stats
 
+    # -- software-pipelined round (ops/pipeline.py, docs/pipeline.md) ------
+    # The (state, inflight) scan carry: inflight is round r's already-
+    # selected publish (dst, svc_idx, msg), chosen from the state BEFORE
+    # round r-1's deliveries were folded — the honest one-round-stale
+    # semantics of pipelined gossiping.  Each tick folds round r's
+    # in-flight messages AND selects round r+1's publish from the
+    # pre-fold belief, so on device the next round's publish/top-k
+    # overlaps the current round's gather/apply (the scheduler is free
+    # to interleave them — no data dependence until the combined
+    # scatter).  Per-round PRNG streams stay positionally identical to
+    # the lockstep round: round r's (perturb, peers, drop, pp) keys are
+    # the 4-way split of fold_in(key, r-1); the peers leg is simply
+    # consumed one tick early, by the selection.
+
+    def _select_inflight(self, known, sent, node_alive, round_sel,
+                         k_round, kn=None):
+        """Select round ``round_sel``'s publish from the current belief:
+        sampled fan-out targets (gated by stagger/cadence at
+        ``round_sel``, with the CURRENT — stale-by-one — liveness), the
+        top-budget eligible records, and the transmit-count charge.
+        Returns ``(inflight, sent)`` where inflight = (dst, svc_idx,
+        msg).  The charge lands on the pre-apply ``sent``, so a version
+        advance folding in the same tick resets it — the reset wins on
+        overlap, exactly the lockstep bump-then-reset ordering."""
+        p = self.p
+        kn = self._knobs if kn is None else kn
+        _kp, k_peers, _kd, _kpp = jax.random.split(k_round, 4)
+        dst = gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+            **self._gate_kw(round_sel, kn),
+        )
+        svc_idx, msg = gossip_ops.select_messages(
+            known, sent, p.budget, kn.limit)
+        sent = gossip_ops.record_transmissions(
+            sent, svc_idx, msg, p.fanout, kn.limit)
+        return (dst, svc_idx, msg), sent
+
+    def _step_pipelined(self, state: SimState, inflight, k_now, k_next,
+                        kn=None):
+        """One pipelined tick: fold round r's carried in-flight publish
+        into the state, select round r+1's publish from the PRE-fold
+        belief, then run the lockstep anti-entropy/sweep tail.  Returns
+        ``(state, inflight')``.  ``k_now = fold_in(key, r-1)`` carries
+        round r's perturb/drop/push-pull streams; ``k_next =
+        fold_in(key, r)`` is split for round r+1's peer draw.  Announce
+        re-stamps are computed against the pre-fold belief (they land
+        in the same combined scatter, as in the lockstep round).  The
+        in-flight targets were gated with LAST round's liveness (the
+        stale-by-one selection), but the fold's sender/receiver
+        liveness gates read THIS round's — a packet from a sender that
+        died in this tick's perturb is dropped, as in the lockstep
+        round."""
+        p, t = self.p, self.t
+        kn = self._knobs if kn is None else kn
+        round_idx = state.round_idx + 1
+        now = round_idx * t.round_ticks
+        k_perturb, _k_peers, k_drop, k_pp = jax.random.split(k_now, 4)
+
+        if self.perturb is not None:
+            if getattr(self.perturb, "wants_knobs", False):
+                state = self.perturb(state, k_perturb, now, kn)
+            else:
+                state = self.perturb(state, k_perturb, now)
+        known, sent, node_alive = state.known, state.sent, state.node_alive
+        dst, svc_idx, msg = inflight
+
+        record_keep = None
+        if kn.needs_drop_draw:
+            record_keep = jax.random.bernoulli(
+                k_drop, kn.keep_prob,
+                (p.n, p.fanout, svc_idx.shape[1]))
+        tb = kn.budget_arg()
+        sender_own = None
+        if tb is not None:
+            sender_own = (self.owner[jnp.minimum(svc_idx, p.m - 1)]
+                          == jnp.arange(p.n, dtype=jnp.int32)[:, None])
+        d_rows, d_cols, d_vals, d_adv = gossip_ops.prepare_deliveries(
+            known, dst, svc_idx, msg,
+            now_tick=now, stale_ticks=kn.stale_ticks,
+            node_alive=node_alive,
+            record_keep=record_keep,
+            future_ticks=kn.future_arg(),
+            tomb_budget=tb, sender_own=sender_own,
+        )
+        a_rows, a_cols, a_vals, a_due = self._announce_updates(
+            known, node_alive, round_idx, now, kn=kn)
+
+        # Round r+1's publish, from the pre-fold belief — the overlap.
+        inflight, sent = self._select_inflight(
+            known, sent, node_alive, round_idx + 1, k_next, kn=kn)
+
+        rows = jnp.concatenate([d_rows, a_rows])
+        cols = jnp.concatenate([d_cols, a_cols])
+        vals = jnp.concatenate([d_vals, a_vals])
+        advanced = jnp.concatenate([d_adv, a_due])
+        known, sent = gossip_ops.apply_updates(
+            known, sent, rows, cols, vals, advanced)
+
+        pp_partner = gossip_ops.sample_peers(
+            k_pp, p.n, 1,
+            nbrs=self._nbrs, deg=self._deg,
+            node_alive=node_alive, cut_mask=self._cut,
+        )[:, 0]
+        pp_tb = kn.budget_arg()
+
+        def do_push_pull(kn_se):
+            kn_, se = kn_se
+            merged = gossip_ops.push_pull(
+                kn_, pp_partner, now_tick=now,
+                stale_ticks=kn.stale_ticks, node_alive=node_alive,
+                future_ticks=kn.future_arg(),
+                tomb_budget=pp_tb,
+                owner=self.owner if pp_tb is not None else None)
+            se = jnp.where(merged != kn_, jnp.int8(0), se)
+            return merged, se
+
+        known, sent = lax.cond(
+            round_idx % kn.push_pull_rounds == 0,
+            do_push_pull, lambda kn_se: kn_se, (known, sent))
+
+        def do_sweep(kn_se):
+            kn_, se = kn_se
+            swept, expired = ttl_sweep(
+                kn_, now,
+                alive_lifespan=kn.alive_lifespan,
+                draining_lifespan=kn.draining_lifespan,
+                tombstone_lifespan=kn.tombstone_lifespan,
+                one_second=t.one_second,
+                suspicion_window=kn.suspicion_window)
+            se = jnp.where(swept != kn_, jnp.int8(0), se)
+            return swept, se
+
+        known, sent = lax.cond(
+            round_idx % kn.sweep_rounds == 0,
+            do_sweep, lambda kn_se: kn_se, (known, sent))
+
+        return SimState(known=known, sent=sent, node_alive=node_alive,
+                        round_idx=round_idx), inflight
+
     def convergence(self, state: SimState) -> jax.Array:
         """Fraction of (alive-node, slot) cells agreeing with the global
         freshest belief — 1.0 means every live node has converged."""
@@ -562,7 +770,7 @@ class ExactSim:
             k_peers, p.n, p.fanout,
             nbrs=self._nbrs, deg=self._deg,
             node_alive=node_alive, cut_mask=self._cut,
-            **self._stagger_kw(round_idx),
+            **self._gate_kw(round_idx, kn),
         )
         pp_partner = gossip_ops.sample_peers(
             k_pp, p.n, 1,
@@ -605,6 +813,21 @@ class ExactSim:
         return sparse_ops.resolve_request(self._sparse_mode, sparse,
                                           self.supports_sparse)
 
+    def _resolve_pipeline_request(self, pipeline):
+        return pipeline_ops.resolve_request(self._pipeline_mode, pipeline,
+                                            self.supports_pipeline)
+
+    def _pipeline_dispatch(self, sparse):
+        """Guard for a pipelined ``run``/``run_fast`` dispatch: the
+        pipelined round has no sparse-frontier form (the selection it
+        hoists IS the dense select) — an explicit or env-forced sparse
+        request composes with it only by raising loudly."""
+        if self._resolve_sparse_request(sparse):
+            raise ValueError(
+                "pipelined execution does not compose with the "
+                "sparse-frontier round (the hoisted publish is the dense "
+                "select); dispatch one or the other — docs/pipeline.md")
+
     def step(self, state: SimState, key: jax.Array) -> SimState:
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
@@ -617,12 +840,23 @@ class ExactSim:
         return self._step_sparse_jit(state, key)
 
     def run(self, state: SimState, key: jax.Array, num_rounds: int,
-            donate: bool = True, start_round=None, sparse=None):
+            donate: bool = True, start_round=None, sparse=None,
+            pipeline=None):
         """Scan ``num_rounds`` gossip rounds; returns (final state,
         per-round convergence fraction [num_rounds]).  Donates ``state``
         unless ``donate=False`` (see the drivers note above).
         ``sparse`` selects the sparse-frontier round (docs/sparse.md);
-        the dispatch's stats land in ``last_sparse_stats``."""
+        the dispatch's stats land in ``last_sparse_stats``.
+        ``pipeline`` selects the software-pipelined round
+        (docs/pipeline.md; one-round-stale publish) — ``None`` follows
+        ``SIDECAR_TPU_PIPELINE``, and the off path dispatches the
+        UNCHANGED lockstep drivers, bit for bit."""
+        if self._resolve_pipeline_request(pipeline):
+            self._pipeline_dispatch(sparse)
+            final, conv, _inflight = self.run_pipelined(
+                state, key, num_rounds, donate=donate,
+                start_round=start_round)
+            return final, conv
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
@@ -635,8 +869,13 @@ class ExactSim:
         return self._run_jit(state, key, num_rounds)
 
     def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
-                 donate: bool = True, sparse=None):
+                 donate: bool = True, sparse=None, pipeline=None):
         """Scan without per-round metrics — the benchmark path."""
+        if self._resolve_pipeline_request(pipeline):
+            self._pipeline_dispatch(sparse)
+            final, _inflight = self.run_fast_pipelined(
+                state, key, num_rounds, donate=donate)
+            return final
         self._check_horizon(state, num_rounds)
         if not donate:
             state = clone_state(state)
@@ -648,12 +887,51 @@ class ExactSim:
         self.last_sparse_stats = None
         return self._run_fast_jit(state, key, num_rounds)
 
+    def run_pipelined(self, state: SimState, key: jax.Array,
+                      num_rounds: int, *, inflight=None,
+                      donate: bool = True, start_round=None):
+        """Scan ``num_rounds`` software-pipelined rounds
+        (docs/pipeline.md): returns ``(final state, conv[num_rounds],
+        inflight)``.  Pass the returned ``inflight`` back to chain
+        chunked dispatches bit-identically to a straight run (the
+        chunked == straight contract of every driver); ``inflight=None``
+        primes the pipeline by selecting round ``round_idx + 1``'s
+        publish from ``state`` — positionally the same peer/select keys
+        the lockstep round would use.  Composes with ``run``/
+        ``run_fast`` only (trace/digest/delta/provenance planes keep
+        the lockstep round)."""
+        self._resolve_pipeline_request(True)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if inflight is None:
+            state, inflight = self._prime_jit(state, key)
+        self.last_sparse_stats = None
+        return self._run_pipelined_jit(state, key, num_rounds, inflight)
+
+    def run_fast_pipelined(self, state: SimState, key: jax.Array,
+                           num_rounds: int, *, inflight=None,
+                           donate: bool = True, start_round=None):
+        """Pipelined scan without per-round metrics — the benchmark
+        path.  Returns ``(final state, inflight)``."""
+        self._resolve_pipeline_request(True)
+        self._check_horizon(state, num_rounds, start_round)
+        if not donate:
+            state = clone_state(state)
+        if inflight is None:
+            state, inflight = self._prime_jit(state, key)
+        self.last_sparse_stats = None
+        return self._run_fast_pipelined_jit(state, key, num_rounds,
+                                            inflight)
+
     def _trace_record(self, prev: SimState, nxt: SimState, stats):
         """One round's flight-recorder record (ops/trace.py)."""
         return trace_ops.exact_record(
             prev, nxt, budget=min(self.p.budget, self.p.m),
             fanout=self.p.fanout,
-            limit=self.p.resolved_retransmit_limit(), stats=stats)
+            limit=self.p.resolved_retransmit_limit(), stats=stats,
+            tick_period=self._knobs.tick_period,
+            tick_phase=self._knobs.tick_phase)
 
     def run_with_trace(self, state: SimState, key: jax.Array,
                        num_rounds: int, cap: int = 0,
@@ -786,6 +1064,40 @@ class ExactSim:
     def _step_sparse_jit(self, state: SimState, key: jax.Array):
         return self._step_sparse(state, key)
 
+    # no-donate: the pipeline prologue runs once per chain, and the
+    # oracle/replay probes diff against its input.
+    @functools.partial(jax.jit, static_argnums=0)
+    def _prime_jit(self, state: SimState, key: jax.Array):
+        inflight, sent = self._select_inflight(
+            state.known, state.sent, state.node_alive,
+            state.round_idx + 1,
+            jax.random.fold_in(key, state.round_idx))
+        return dataclasses.replace(state, sent=sent), inflight
+
+    # no-donate: the pipelined single-round probe is the oracle path.
+    @functools.partial(jax.jit, static_argnums=0)
+    def _step_pipelined_jit(self, state: SimState, inflight, k_now,
+                            k_next):
+        return self._step_pipelined(state, inflight, k_now, k_next)
+
+    def prime_pipeline(self, state: SimState, key: jax.Array):
+        """The pipeline prologue as a public probe: select round
+        ``round_idx + 1``'s publish from ``state`` (charging ``sent``).
+        Returns ``(state, inflight)`` — what a fresh
+        :meth:`run_pipelined` computes before its first tick."""
+        return self._prime_jit(state, key)
+
+    def step_pipelined(self, state: SimState, inflight, key: jax.Array):
+        """One pipelined tick → ``(state, inflight')`` — the oracle
+        lockstep probe (no-donate).  ``key`` is the chain's BASE key;
+        the per-round now/next keys are folded in here exactly as the
+        scan drivers fold them."""
+        self._check_horizon(state, 1)
+        return self._step_pipelined_jit(
+            state, inflight,
+            jax.random.fold_in(key, state.round_idx),
+            jax.random.fold_in(key, state.round_idx + 1))
+
     # Per-round keys are derived by folding the round index into the base
     # key (not by splitting over num_rounds), so a checkpointed run
     # resumed in chunks replays the exact same randomness as a straight
@@ -806,6 +1118,42 @@ class ExactSim:
 
         final, _ = lax.scan(body, state, None, length=num_rounds)
         return final
+
+    # -- pipelined scan drivers (docs/pipeline.md) -------------------------
+    # The (state, inflight) carry chains chunk-to-chunk exactly like the
+    # state does, so BOTH are donated; per-round keys fold the round
+    # index as everywhere else, keeping chunked == straight.
+
+    @functools.partial(jax.jit, static_argnums=(0, 3),
+                       donate_argnums=(1, 4))
+    def _run_pipelined_jit(self, state: SimState, key: jax.Array,
+                           num_rounds: int, inflight):
+        def body(carry, _):
+            st, infl = carry
+            st2, infl2 = self._step_pipelined(
+                st, infl,
+                jax.random.fold_in(key, st.round_idx),
+                jax.random.fold_in(key, st.round_idx + 1))
+            return (st2, infl2), self.convergence(st2)
+
+        (final, inflight), conv = lax.scan(
+            body, (state, inflight), None, length=num_rounds)
+        return final, conv, inflight
+
+    @functools.partial(jax.jit, static_argnums=(0, 3),
+                       donate_argnums=(1, 4))
+    def _run_fast_pipelined_jit(self, state: SimState, key: jax.Array,
+                                num_rounds: int, inflight):
+        def body(carry, _):
+            st, infl = carry
+            return self._step_pipelined(
+                st, infl,
+                jax.random.fold_in(key, st.round_idx),
+                jax.random.fold_in(key, st.round_idx + 1)), None
+
+        (final, inflight), _ = lax.scan(
+            body, (state, inflight), None, length=num_rounds)
+        return final, inflight
 
     @functools.partial(jax.jit, static_argnums=(0, 3, 4), donate_argnums=1)
     def _run_deltas_jit(self, state: SimState, key: jax.Array,

@@ -116,6 +116,32 @@ def refresh_due(own, slots, round_idx, *, refresh_rounds: int,
     return at_phase & (elapsed >= guard)
 
 
+def cadence_gate(dst, round_idx, tick_period, tick_phase, self_idx=None):
+    """Heterogeneous tick-cadence gate (docs/pipeline.md): a node ticks
+    iff ``(round_idx + phase[i]) % period[i] == 0``; off this round, it
+    resolves every sampled target to itself (the merge no-op self-send,
+    like dead senders and cut edges).  ``tick_period``/``tick_phase``
+    may be Python ints, traced scalars (the fleet data axis), or
+    per-node ``[N]`` vectors (mixed-hardware fleets); scalars broadcast.
+    Periods are clamped to ≥ 1, so a traced period of 1 is a value
+    no-op (``x % 1 == 0`` gates nothing).  Gossip fan-out only;
+    anti-entropy push-pull is never gated (it is the catch-up channel).
+    The PRNG draw upstream happens unconditionally — cadence gates
+    delivery, never the stream — and off nodes still select and charge
+    ``sent`` for the round they sat out (the stagger-gate semantics of
+    PR 13, inherited unchanged)."""
+    n = dst.shape[0]
+    if self_idx is None:
+        self_idx = jnp.arange(n, dtype=jnp.int32)
+    period = jnp.broadcast_to(
+        jnp.asarray(tick_period, jnp.int32).reshape(-1), (n,))
+    phase = jnp.broadcast_to(
+        jnp.asarray(tick_phase, jnp.int32).reshape(-1), (n,))
+    period = jnp.maximum(period, 1)
+    off = ((round_idx + phase) % period) != 0
+    return jnp.where(off[:, None], self_idx.reshape(-1, 1), dst)
+
+
 def stagger_gate(dst, round_idx, stagger, stagger_period: int,
                  self_idx=None):
     """Round-stagger phase gate (pipelined gossiping, docs/topology.md):
@@ -124,18 +150,20 @@ def stagger_gate(dst, round_idx, stagger, stagger_period: int,
     no-op self-send, like dead senders and cut edges).  ``stagger=None``
     or period ≤ 1 returns ``dst`` untouched — the unstaggered program,
     bit for bit.  Gossip fan-out only; anti-entropy push-pull is never
-    staggered (it is the catch-up channel)."""
+    staggered (it is the catch-up channel).
+
+    This is the uniform-period special case of :func:`cadence_gate`
+    (``tick_period = stagger_period`` for every node, ``tick_phase =
+    stagger``) and delegates to it."""
     if stagger is None or stagger_period <= 1:
         return dst
-    if self_idx is None:
-        self_idx = jnp.arange(dst.shape[0], dtype=jnp.int32)
-    off = ((round_idx + stagger) % stagger_period) != 0
-    return jnp.where(off[:, None], self_idx.reshape(-1, 1), dst)
+    return cadence_gate(dst, round_idx, stagger_period, stagger,
+                        self_idx=self_idx)
 
 
 def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
                  cut_mask=None, stagger=None, stagger_period=1,
-                 round_idx=None):
+                 round_idx=None, tick_period=None, tick_phase=None):
     """Sample ``fanout`` gossip targets per node.
 
     Returns dst[int32 N, fanout].  Dead senders and cut edges resolve to
@@ -148,6 +176,11 @@ def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
     (:func:`stagger_gate`; needs ``round_idx``).  The PRNG draw happens
     unconditionally — staggering gates delivery, never the stream — so
     staggered and unstaggered runs stay key-comparable.
+    tick_period/tick_phase: heterogeneous per-node cadence
+    (:func:`cadence_gate`; needs ``round_idx``) — scalar or per-node,
+    static or traced; ``None`` compiles the pre-cadence program bit for
+    bit.  Composes with stagger (a node sends only when both gates are
+    on).
     """
     self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
     if nbrs is None:
@@ -173,6 +206,12 @@ def sample_peers(key, n, fanout, *, nbrs=None, deg=None, node_alive=None,
         if round_idx is None:
             raise ValueError("stagger gating needs the current round_idx")
         dst = stagger_gate(dst, round_idx, stagger, stagger_period,
+                           self_idx=self_idx[:, 0])
+    if tick_period is not None:
+        if round_idx is None:
+            raise ValueError("cadence gating needs the current round_idx")
+        dst = cadence_gate(dst, round_idx, tick_period,
+                           0 if tick_phase is None else tick_phase,
                            self_idx=self_idx[:, 0])
     return dst
 

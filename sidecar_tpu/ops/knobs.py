@@ -86,6 +86,22 @@ class RoundKnobs:
     quarantine_threshold: Any = -1  # cumulative budget violations that
                             # quarantine an origin (negative = off —
                             # chaos/sim_inject.py, docs/chaos.md)
+    tick_period: Any = 1    # per-node gossip cadence (rounds between
+                            # ticks; 1 = every round — the pre-cadence
+                            # program).  Scalar, or a per-node [N]
+                            # vector (heterogeneous fleets).
+    tick_phase: Any = 0     # per-node cadence phase offset (rounds);
+                            # a node ticks iff
+                            # (round_idx + phase) % period == 0
+
+    @property
+    def cadence_enabled(self) -> bool:
+        """Static gate for :func:`ops.gossip.cadence_gate`: False only
+        when the tick period is PROVABLY 1 (a static 1 compiles the
+        gate away — exactly the pre-cadence program); a traced period
+        or a per-node vector keeps the gate compiled, value-identical
+        at period 1 because ``x % 1 == 0`` gates nothing."""
+        return not (_static(self.tick_period) and self.tick_period <= 1)
 
     @property
     def suspicion_enabled(self) -> bool:
@@ -160,8 +176,8 @@ class RoundKnobs:
 
 
 def from_protocol(params, timecfg, *, recover_rounds: int = 1,
-                  fault_seed: int = 0, churn_prob: float = 0.0
-                  ) -> RoundKnobs:
+                  fault_seed: int = 0, churn_prob: float = 0.0,
+                  tick_period=1, tick_phase=0) -> RoundKnobs:
     """The static bundle for a classic single-scenario sim: plain
     Python scalars read off ``SimParams``/``CompressedParams`` +
     ``TimeConfig`` — const-folds into the pre-knob program."""
@@ -185,4 +201,6 @@ def from_protocol(params, timecfg, *, recover_rounds: int = 1,
                      else timecfg.tomb_budget),
         quarantine_threshold=(-1 if timecfg.quarantine_threshold is None
                               else timecfg.quarantine_threshold),
+        tick_period=tick_period,
+        tick_phase=tick_phase,
     )

@@ -1,0 +1,96 @@
+"""Software-pipelined round execution — mode plumbing.
+
+Every model family historically advanced one global synchronous round
+per ``lax.scan`` tick: publish/select, gather/deliver, fold/apply, the
+board exchange, announce, and anti-entropy serialize back-to-back
+inside each tick (PR 12's phase attribution shows it on device).
+Pipelined gossiping ("The Algorithm of Pipelined Gossiping", PAPERS.md)
+overlaps them: round i+1's publish — peer selection plus message
+selection, computed from the PRE-fold state — is issued inside the same
+scan tick that folds/applies round i, carried as a ``(state, inflight)``
+scan carry.  The semantics are *honestly one round stale*: a message
+selected for round i+1 reflects the sender's belief before round i's
+deliveries landed, exactly the behavior of a real node that serializes
+its outgoing packet while its inbox drains.  Convergence pays a bounded
+staleness tax (the bench ``pipeline`` block pins the rounds-to-ε ratio
+≤ 1.10); device time wins because the publish/gather phase of the next
+round overlaps the fold/apply + exchange of the current one
+(``pipeline.overlap_ms``, docs/pipeline.md).
+
+This module holds the mode resolution shared by every model:
+
+* ``SIDECAR_TPU_PIPELINE=auto|0|1`` (or the ``pipeline=`` driver
+  argument), resolved ONCE at sim construction like
+  ``SIDECAR_TPU_SPARSE``:
+
+  - ``0``    — pipelined execution disabled; ``run*(...,
+    pipeline=True)`` raises.  The pre-pipeline behavior.
+  - ``1``    — drivers default to the pipelined step.
+  - ``auto`` (default) — drivers default to the classic lockstep
+    round.  UNLIKE sparse, auto never silently opts in: pipelining
+    changes round semantics (one-round-stale publish), so it is only
+    ever entered by an explicit ``pipeline=True`` / env ``1`` — never
+    by a host-side arbiter.
+
+* :func:`resolve_request` — per-dispatch resolution with the same
+  ``supports_pipeline`` degrade/raise contract as
+  ``ops/sparse.resolve_request`` (env default degrades on an
+  unsupporting sim, an explicit ``True`` raises loudly).
+
+The ``pipeline=off`` dispatch calls the UNCHANGED pre-PR jitted
+drivers — bit-identity is structural, pinned per family in
+tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from sidecar_tpu import metrics
+
+PIPELINE_ENV = "SIDECAR_TPU_PIPELINE"
+PIPELINE_MODES = ("auto", "0", "1")
+
+
+def resolve_pipeline(explicit: Optional[str] = None, *,
+                     record: bool = True) -> str:
+    """Resolve the pipelined-execution mode: an explicit constructor
+    argument wins, else ``SIDECAR_TPU_PIPELINE``, else ``auto``.
+
+    Returns one of ``"auto" | "0" | "1"``.  Resolved at sim
+    construction (the ``SIDECAR_TPU_KERNELS`` contract: toggling the
+    env var affects sims built afterwards)."""
+    mode = explicit
+    if mode is None:
+        mode = os.environ.get(PIPELINE_ENV, "auto").strip().lower() \
+            or "auto"
+    mode = {"on": "1", "off": "0"}.get(mode, mode)
+    if mode not in PIPELINE_MODES:
+        raise ValueError(
+            f"pipeline mode must be one of {PIPELINE_MODES}, got "
+            f"{mode!r} (explicit argument or {PIPELINE_ENV})")
+    if record:
+        metrics.incr(f"pipeline.mode.{mode}")
+    return mode
+
+
+def resolve_request(mode: str, pipeline,
+                    supports_pipeline: bool = True) -> bool:
+    """Per-dispatch pipeline resolution, shared by every sim family.
+
+    ``pipeline=None`` follows the construction-time ``mode`` — ``auto``
+    means OFF (pipelining changes semantics; it is never a silent
+    default) and an env-forced ``"1"`` DEGRADES to lockstep on a sim
+    that doesn't implement the path.  An explicit ``True`` raises when
+    the mode is ``"0"`` or the sim can't honor it."""
+    if pipeline is None:
+        pipeline = mode == "1"
+        if pipeline and not supports_pipeline:
+            return False        # env default degrades, never breaks
+    if pipeline and (mode == "0" or not supports_pipeline):
+        raise ValueError(
+            "pipelined execution is disabled or unsupported on this sim "
+            f"(mode={mode!r}, supports_pipeline={supports_pipeline}; "
+            f"see {PIPELINE_ENV} / docs/pipeline.md)")
+    return bool(pipeline)

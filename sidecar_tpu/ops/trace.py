@@ -63,6 +63,12 @@ one, which tests/test_telemetry.py pins at d ∈ {1, 2, 4, 8}):
   docs/chaos.md).  Only the chaos family under an active ClockFault
   can produce a nonzero value — a global-clock round never stamps
   beyond ``now`` — so the column is truthfully 0 everywhere else.
+* ``ticked_nodes`` — alive nodes whose per-node cadence gate fired
+  this round (ops/gossip.cadence_gate, docs/pipeline.md).  Under the
+  default uniform cadence (period 1) this equals the alive census, so
+  the column doubles as per-round cluster size; under a heterogeneous
+  cadence it is the round's ACTIVE gossip population — the
+  denominator for per-ticking-node byte budgets.
 """
 
 from __future__ import annotations
@@ -99,10 +105,12 @@ TRACE_TOMBSTONES = 7
 TRACE_SUSPECTS = 8
 TRACE_FP_TOMBSTONES = 9
 TRACE_REJECTED_FUTURE = 10
-TRACE_WIDTH = 11
+TRACE_TICKED_NODES = 11
+TRACE_WIDTH = 12
 TRACE_FIELDS = ("round", "frontier", "behind", "admitted",
                 "exchange_bytes", "sparse", "overflow", "tombstones",
-                "suspects", "fp_tombstones", "rejected_future")
+                "suspects", "fp_tombstones", "rejected_future",
+                "ticked_nodes")
 
 
 @jax.tree_util.register_dataclass
@@ -179,9 +187,30 @@ def fp_tombstone_entries(prev, nxt, owner_alive) -> jax.Array:
     return jnp.sum((entered & owner_alive).astype(jnp.int32))
 
 
+def ticked_census(round_idx, node_alive, tick_period=None,
+                  tick_phase=None) -> jax.Array:
+    """#alive nodes whose cadence gate fires at ``round_idx`` (the
+    ``ticked_nodes`` column).  ``tick_period=None`` (or a provably-1
+    static) is the uniform cadence: every alive node ticks."""
+    alive = node_alive
+    if tick_period is None or (isinstance(tick_period, int)
+                               and tick_period <= 1):
+        return jnp.sum(alive.astype(jnp.int32))
+    n = alive.shape[0]
+    per = jnp.broadcast_to(
+        jnp.asarray(tick_period, jnp.int32).reshape(-1), (n,))
+    pha = jnp.broadcast_to(
+        jnp.asarray(0 if tick_phase is None else tick_phase,
+                    jnp.int32).reshape(-1), (n,))
+    ticked = ((jnp.asarray(round_idx, jnp.int32) + pha)
+              % jnp.maximum(per, 1)) == 0
+    return jnp.sum((ticked & alive).astype(jnp.int32))
+
+
 def build_record(round_idx, frontier, behind, admitted, exchange_bytes,
                  tombstones, suspects, fp_tombstones,
-                 stats=None, rejected_future=0) -> jax.Array:
+                 stats=None, rejected_future=0,
+                 ticked_nodes=0) -> jax.Array:
     """Assemble the [TRACE_WIDTH] int32 record; ``stats`` is the sparse
     step's int32 [3] vector (sparse-taken, overflowed, frontier-hwm) or
     None on dense rounds."""
@@ -202,11 +231,13 @@ def build_record(round_idx, frontier, behind, admitted, exchange_bytes,
         jnp.asarray(suspects, jnp.int32),
         jnp.asarray(fp_tombstones, jnp.int32),
         jnp.asarray(rejected_future, jnp.int32),
+        jnp.asarray(ticked_nodes, jnp.int32),
     ])
 
 
 def exact_record(prev, nxt, *, budget: int, fanout: int, limit: int,
-                 stats=None, rejected_future=0) -> jax.Array:
+                 stats=None, rejected_future=0, tick_period=None,
+                 tick_phase=None) -> jax.Array:
     """One round's record for the EXACT family (``SimState`` in, both
     the single-chip model and the sharded twin — the reductions shard
     cleanly under GSPMD)."""
@@ -225,11 +256,15 @@ def exact_record(prev, nxt, *, budget: int, fanout: int, limit: int,
                               alive[owner][None, :])
     return build_record(nxt.round_idx, frontier, behind, admitted,
                         xbytes, tombs, suspects, fp, stats,
-                        rejected_future=rejected_future)
+                        rejected_future=rejected_future,
+                        ticked_nodes=ticked_census(
+                            nxt.round_idx, alive, tick_period,
+                            tick_phase))
 
 
 def compressed_record(prev, nxt, behind, *, budget: int, fanout: int,
-                      limit: int, stats=None) -> jax.Array:
+                      limit: int, stats=None, tick_period=None,
+                      tick_phase=None) -> jax.Array:
     """One round's record for the COMPRESSED family
     (``CompressedState`` in; ``behind`` is the model's own census —
     ``CompressedSim.behind(nxt)`` — passed in so the sharded twin's
@@ -251,7 +286,10 @@ def compressed_record(prev, nxt, behind, *, budget: int, fanout: int,
     behind_i = jnp.minimum(jnp.asarray(behind, jnp.float32),
                            jnp.float32(2**31 - 1)).astype(jnp.int32)
     return build_record(nxt.round_idx, frontier, behind_i, admitted,
-                        xbytes, tombs, suspects, fp, stats)
+                        xbytes, tombs, suspects, fp, stats,
+                        ticked_nodes=ticked_census(
+                            nxt.round_idx, alive, tick_period,
+                            tick_phase))
 
 
 # -- host-side views ---------------------------------------------------------
@@ -304,4 +342,6 @@ def summarize(trace: RoundTrace) -> dict:
             recorded[:, TRACE_FP_TOMBSTONES].astype(np.int64).sum()),
         "rejected_future_total": int(
             recorded[:, TRACE_REJECTED_FUTURE].astype(np.int64).sum()),
+        "ticked_nodes_last": int(recorded[-1, TRACE_TICKED_NODES]),
+        "ticked_nodes_min": int(recorded[:, TRACE_TICKED_NODES].min()),
     }

@@ -49,10 +49,16 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sidecar_tpu import metrics
-from sidecar_tpu.models.exact import SimParams, SimState, clone_state
+from sidecar_tpu.models.exact import (
+    SimParams,
+    SimState,
+    _resolve_cadence,
+    clone_state,
+)
 from sidecar_tpu.models.timecfg import TimeConfig
 from sidecar_tpu.ops import digest as digest_ops
 from sidecar_tpu.ops import gossip as gossip_ops
+from sidecar_tpu.ops import pipeline as pipeline_ops
 from sidecar_tpu.ops import provenance as prov_ops
 from sidecar_tpu.ops import sparse as sparse_ops
 from sidecar_tpu.ops import suspicion as suspicion_ops
@@ -84,6 +90,15 @@ class ShardedSim:
     # (docs/sparse.md); select-level compaction, per shard.
     supports_sparse = True
 
+    # The software-pipelined round (docs/pipeline.md) is available via
+    # TWIN DELEGATION: the pipelined program is the single-chip
+    # ExactSim's, jitted over the GLOBAL row-sharded tensors — GSPMD
+    # partitions the publish/fold, so pipelined-sharded is bit-identical
+    # to pipelined-single-chip BY CONSTRUCTION (it IS the same program,
+    # including the single-chip PRNG stream — the per-shard streams and
+    # board-exchange modes are lockstep-path concepts).
+    supports_pipeline = True
+
     def __init__(self, params: SimParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  mesh=None,
@@ -93,7 +108,9 @@ class ShardedSim:
                  exchange_stub: bool = False,
                  sparse: Optional[str] = None,
                  digest_gate: Optional[bool] = None,
-                 gate_buckets: int = 8):
+                 gate_buckets: int = 8,
+                 pipeline: Optional[str] = None,
+                 tick_period=None, tick_phase=None):
         if topo.n != params.n:
             raise ValueError(f"topology has {topo.n} nodes, params say {params.n}")
         if cut_mask is not None and topo.nbrs is None:
@@ -102,7 +119,21 @@ class ShardedSim:
         self.t = timecfg
         self.topo = topo
         self._sparse_mode = sparse_ops.resolve_sparse(sparse)
+        self._pipeline_mode = pipeline_ops.resolve_pipeline(pipeline)
         self.last_sparse_stats = None
+        # Per-node tick cadence (docs/pipeline.md), validated here and
+        # normalized to full-[N] replicated vectors for the per-shard
+        # ``[gi]`` slices; the raw arguments are kept for the pipelined
+        # single-chip twin, which re-resolves them itself.
+        self._cadence_args = (tick_period, tick_phase)
+        tp, tph = _resolve_cadence(tick_period, tick_phase, params.n)
+        self._cadence = None
+        if not (isinstance(tp, int) and tp <= 1):
+            self._cadence = tuple(
+                jnp.broadcast_to(
+                    jnp.asarray(v, jnp.int32).reshape(-1), (params.n,))
+                for v in (tp, tph))
+        self._pipe_twin = None
         # The dense twin exchanges bounded OFFER tensors, not boards:
         # all_gather replicates them, ring streams sender blocks hop by
         # hop, zoned ships only the row blocks the overlay can make
@@ -278,15 +309,21 @@ class ShardedSim:
         return jnp.where(alive[gi][:, None], dst, gi[:, None])
 
     def _stagger_gate(self, dst, gi, round_idx):
-        """Round-stagger gating (docs/topology.md), applied AFTER the
-        sampling draw so the per-shard PRNG streams stay key-comparable
-        with the unstaggered run; compiles away when no stagger is
-        attached.  Gossip fan-out only — the stride push-pull is the
-        catch-up channel and never staggers."""
-        if self._stagger is None:
-            return dst
-        off = ((round_idx + self._stagger[gi]) % self._stagger_period) != 0
-        return jnp.where(off[:, None], gi[:, None], dst)
+        """Round-stagger + tick-cadence gating (docs/topology.md,
+        docs/pipeline.md), applied AFTER the sampling draw so the
+        per-shard PRNG streams stay key-comparable with the ungated
+        run; compiles away when neither is attached.  Gossip fan-out
+        only — the stride push-pull is the catch-up channel and never
+        gates."""
+        if self._stagger is not None:
+            off = ((round_idx + self._stagger[gi])
+                   % self._stagger_period) != 0
+            dst = jnp.where(off[:, None], gi[:, None], dst)
+        if self._cadence is not None:
+            per, pha = self._cadence
+            dst = gossip_ops.cadence_gate(dst, round_idx, per[gi],
+                                          pha[gi], self_idx=gi)
+        return dst
 
     def _block_candidates(self, known0, dst_b, svc_b, msg_b, senders,
                           alive, r0, nl, now, keep_b):
@@ -710,6 +747,10 @@ class ShardedSim:
         dst_all = gossip_ops.stagger_gate(
             jnp.concatenate(parts, axis=0), round_idx, self._stagger,
             self._stagger_period)
+        if self._cadence is not None:
+            per, pha = self._cadence
+            dst_all = gossip_ops.cadence_gate(dst_all, round_idx, per,
+                                              pha)
         pushes = [(dst_all, None)]
 
         # The stride exchange is two one-way pulls from the receiver's
@@ -829,6 +870,77 @@ class ShardedSim:
         return sparse_ops.resolve_request(self._sparse_mode, sparse,
                                           self.supports_sparse)
 
+    def _resolve_pipeline_request(self, pipeline):
+        return pipeline_ops.resolve_request(self._pipeline_mode, pipeline,
+                                            self.supports_pipeline)
+
+    def _pipeline_dispatch(self, sparse):
+        """Guard a pipelined dispatch: the pipelined program is the
+        single-chip ExactSim's (twin delegation), which composes with
+        neither the sparse-frontier round nor the partition-side
+        push-pull mask."""
+        if self._resolve_sparse_request(sparse):
+            raise ValueError(
+                "pipelined execution does not compose with the "
+                "sparse-frontier round (the carried publish is dense); "
+                "pass sparse='0' or pipeline=False")
+        if self._side is not None:
+            raise ValueError(
+                "pipelined execution does not support node_side: the "
+                "single-chip pipelined program draws uniform push-pull "
+                "partners, which have no side mask")
+
+    def _pipeline_twin(self):
+        """The lazily-built single-chip ExactSim whose pipelined jit
+        program this twin dispatches on the row-sharded global state
+        (GSPMD propagates the sharding through publish/fold).  Same
+        params/topology/timecfg/cut/cadence; ``pipeline='1'`` so its
+        drivers never silently fall back to lockstep."""
+        if self._pipe_twin is None:
+            from sidecar_tpu.models.exact import ExactSim
+            tp, tph = self._cadence_args
+            self._pipe_twin = ExactSim(
+                self.p, self.topo, self.t,
+                cut_mask=(None if self._cut is None
+                          else np.asarray(self._cut)),
+                pipeline="1", tick_period=tp, tick_phase=tph)
+        return self._pipe_twin
+
+    def run_pipelined(self, state: SimState, key: jax.Array,
+                      num_rounds: int, *, inflight=None,
+                      donate: bool = True, start_round=None):
+        """Pipelined :meth:`run` → ``(final, conv, inflight)``: the
+        single-chip pipelined program on the sharded state (see
+        :meth:`_pipeline_twin`) — bit-identical to
+        ``ExactSim.run_pipelined`` by construction."""
+        self._resolve_pipeline_request(True)
+        self._pipeline_dispatch(False)
+        return self._pipeline_twin().run_pipelined(
+            state, key, num_rounds, inflight=inflight, donate=donate,
+            start_round=start_round)
+
+    def run_fast_pipelined(self, state: SimState, key: jax.Array,
+                           num_rounds: int, *, inflight=None,
+                           donate: bool = True, start_round=None):
+        """Pipelined :meth:`run_fast` → ``(final, inflight)``."""
+        self._resolve_pipeline_request(True)
+        self._pipeline_dispatch(False)
+        return self._pipeline_twin().run_fast_pipelined(
+            state, key, num_rounds, inflight=inflight, donate=donate,
+            start_round=start_round)
+
+    def prime_pipeline(self, state: SimState, key: jax.Array):
+        """Fill the software pipeline (the twin's prologue)."""
+        self._resolve_pipeline_request(True)
+        self._pipeline_dispatch(False)
+        return self._pipeline_twin().prime_pipeline(state, key)
+
+    def step_pipelined(self, state: SimState, inflight, key: jax.Array):
+        """One pipelined round from the BASE key (the twin's probe)."""
+        self._resolve_pipeline_request(True)
+        self._pipeline_dispatch(False)
+        return self._pipeline_twin().step_pipelined(state, inflight, key)
+
     def step(self, state: SimState, key: jax.Array) -> SimState:
         self._check_horizon(state, 1)
         return self._step_jit(state, key)
@@ -840,7 +952,14 @@ class ShardedSim:
         return self._step_sparse_jit(state, key)
 
     def run(self, state: SimState, key: jax.Array, num_rounds: int,
-            donate: bool = True, start_round=None, sparse=None):
+            donate: bool = True, start_round=None, sparse=None,
+            pipeline=None):
+        if self._resolve_pipeline_request(pipeline):
+            self._pipeline_dispatch(sparse)
+            final, conv, _inflight = self.run_pipelined(
+                state, key, num_rounds, donate=donate,
+                start_round=start_round)
+            return final, conv
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)
@@ -856,10 +975,13 @@ class ShardedSim:
         """One round's flight-recorder record (ops/trace.py): computed
         at the jit level over the GLOBAL tensors, so GSPMD shards the
         reductions — the stream is bit-identical to ExactSim's."""
+        tp, tph = (self._cadence if self._cadence is not None
+                   else (None, None))
         return trace_ops.exact_record(
             prev, nxt, budget=min(self.p.budget, self.p.m),
             fanout=self.p.fanout,
-            limit=self.p.resolved_retransmit_limit(), stats=stats)
+            limit=self.p.resolved_retransmit_limit(), stats=stats,
+            tick_period=tp, tick_phase=tph)
 
     def run_with_trace(self, state: SimState, key: jax.Array,
                        num_rounds: int, cap: int = 0,
@@ -948,7 +1070,14 @@ class ShardedSim:
         return self._run_prov_jit(state, key, num_rounds, prov, tracked)
 
     def run_fast(self, state: SimState, key: jax.Array, num_rounds: int,
-                 donate: bool = True, start_round=None, sparse=None):
+                 donate: bool = True, start_round=None, sparse=None,
+                 pipeline=None):
+        if self._resolve_pipeline_request(pipeline):
+            self._pipeline_dispatch(sparse)
+            final, _inflight = self.run_fast_pipelined(
+                state, key, num_rounds, donate=donate,
+                start_round=start_round)
+            return final
         self._check_horizon(state, num_rounds, start_round)
         if not donate:
             state = clone_state(state)

@@ -113,6 +113,12 @@ class ShardedCompressedSim(CompressedSim):
     (same driver contract: init_state / step / run / run_fast / mint /
     convergence), state sharded along the node axis."""
 
+    # The pipelined round runs at the GLOBAL-array jit level (GSPMD
+    # partitions it — see the class docstring note below run_pipelined
+    # in CompressedSim), where the Pallas publish cannot partition: pin
+    # the pipelined select to the bit-identical XLA kernel twin.
+    _pipeline_force_xla = True
+
     def __init__(self, params: CompressedParams, topo: Topology,
                  timecfg: TimeConfig = TimeConfig(),
                  mesh=None,
@@ -122,10 +128,24 @@ class ShardedCompressedSim(CompressedSim):
                  board_exchange: Optional[str] = None,
                  a2a_slack: int = 2,
                  exchange_stub: bool = False,
-                 sparse: Optional[str] = None):
+                 sparse: Optional[str] = None,
+                 pipeline: Optional[str] = None,
+                 tick_period=None, tick_phase=None):
         super().__init__(params, topo, timecfg, perturb=perturb,
                          cut_mask=cut_mask, node_side=node_side,
-                         sparse=sparse)
+                         sparse=sparse, pipeline=pipeline,
+                         tick_period=tick_period, tick_phase=tick_phase)
+        # Per-node tick cadence, normalized to full-[N] replicated
+        # vectors so the per-shard round bodies can take ``[gi]``
+        # slices (mirrors the ``self._stagger[gi]`` idiom); None
+        # compiles the pre-cadence program bit for bit.
+        self._cadence = None
+        if self._knobs.cadence_enabled:
+            self._cadence = tuple(
+                jnp.broadcast_to(
+                    jnp.asarray(v, jnp.int32).reshape(-1), (params.n,))
+                for v in (self._knobs.tick_period,
+                          self._knobs.tick_phase))
         if a2a_slack < 1:
             raise ValueError("a2a_slack must be >= 1")
         # None → SIDECAR_TPU_BOARD_EXCHANGE, default all_gather
@@ -389,6 +409,10 @@ class ShardedCompressedSim(CompressedSim):
             dst = gossip_ops.stagger_gate(
                 dst, round_idx, self._stagger[gi], self._stagger_period,
                 self_idx=gi)
+        if self._cadence is not None:
+            per, pha = self._cadence
+            dst = gossip_ops.cadence_gate(dst, round_idx, per[gi],
+                                          pha[gi], self_idx=gi)
         return self._gossip_shard_body(own_l, cslot_l, cval_l, csent_l,
                                        floor, alive, dst, k_drop,
                                        round_idx)
@@ -865,6 +889,9 @@ class ShardedCompressedSim(CompressedSim):
         dst = gossip_ops.stagger_gate(
             self._sample_dst_jit(k_peers, state.node_alive),
             round_idx, self._stagger, self._stagger_period)
+        if self._cadence is not None:
+            per, pha = self._cadence
+            dst = gossip_ops.cadence_gate(dst, round_idx, per, pha)
         dst = lax.with_sharding_constraint(dst, self._row_sharding)
 
         sender = jnp.any(kernel_ops.eligible_lines(

@@ -138,11 +138,15 @@ class OracleSim:
         pre = self.known.copy()
 
         # 1. select + deliveries (sampling shared with the kernel).
+        # ``_gate_kw`` mirrors the sim's stagger/cadence delivery gates
+        # (ops/gossip.cadence_gate): off nodes self-send — and still
+        # select and charge ``sent`` below, the PR 13 semantics.
         dst = np.asarray(gossip_ops.sample_peers(
             k_peers, p.n, p.fanout,
             nbrs=self.sim._nbrs, deg=self.sim._deg,
             node_alive=jax.numpy.asarray(self.node_alive),
             cut_mask=self.sim._cut,
+            **self.sim._gate_kw(self.round_idx),
         ))
         svc_idx, msg = gossip_ops.select_messages(
             jax.numpy.asarray(self.known),
@@ -419,6 +423,164 @@ class OracleSim:
             return ()
         return tuple(int(i) for i in
                      np.where(self.origin_violations >= qt)[0])
+
+
+class PipelinedOracleSim(OracleSim):
+    """Sequential mirror of :meth:`ExactSim._step_pipelined`
+    (docs/pipeline.md): the ``(state, inflight)`` carry with the honest
+    one-round-stale publish.  Call :meth:`prime` once with the chain's
+    base key (the prologue — mirrors ``ExactSim.prime_pipeline``), then
+    :meth:`step` per tick with the SAME base key; per-round now/next
+    keys are folded in here exactly as the scan drivers fold them.
+
+    Scope mirrors the kernel's: plain ``ExactSim`` rounds only — the
+    chaos planes (clock skew, adversary, quarantine) declare
+    ``supports_pipeline = False`` on the sim and are rejected here too.
+    """
+
+    def __init__(self, sim: ExactSim, state: SimState):
+        super().__init__(sim, state)
+        if self.clocks is not None or self.adv is not None \
+                or self.quarantine_threshold is not None:
+            raise ValueError(
+                "the pipelined oracle mirrors the plain ExactSim round; "
+                "chaos planes (clocks/adversary/quarantine) are "
+                "lockstep-only (supports_pipeline=False)")
+        self.inflight = None
+
+    # -- the hoisted publish (ExactSim._select_inflight's mirror) ---------
+
+    def _select(self, k_round: jax.Array, round_sel: int):
+        """Select round ``round_sel``'s publish from the CURRENT belief
+        (sampling shared with the kernel), charge ``sent``, and return
+        the in-flight triple.  The charge lands pre-apply, so a version
+        advance folding in the same tick resets it — the kernel's
+        bump-then-reset ordering."""
+        p = self.p
+        _kp, k_peers, _kd, _kpp = jax.random.split(k_round, 4)
+        dst = np.asarray(gossip_ops.sample_peers(
+            k_peers, p.n, p.fanout,
+            nbrs=self.sim._nbrs, deg=self.sim._deg,
+            node_alive=jax.numpy.asarray(self.node_alive),
+            cut_mask=self.sim._cut,
+            **self.sim._gate_kw(round_sel),
+        ))
+        svc_idx, msg = gossip_ops.select_messages(
+            jax.numpy.asarray(self.known),
+            jax.numpy.asarray(self.sent.astype(np.int8)),
+            p.budget, self.limit)
+        svc_idx, msg = np.asarray(svc_idx), np.asarray(msg)
+        for node in range(p.n):
+            for b in range(msg.shape[1]):
+                if msg[node, b] > 0:
+                    self.sent[node, int(svc_idx[node, b])] += p.fanout
+        return dst, svc_idx, msg
+
+    def prime(self, key: jax.Array) -> None:
+        """The pipeline prologue: select round ``round_idx + 1``'s
+        publish from the current state."""
+        self.inflight = self._select(
+            jax.random.fold_in(key, self.round_idx), self.round_idx + 1)
+
+    # -- one pipelined tick ----------------------------------------------
+
+    def step(self, key: jax.Array) -> None:
+        """Fold the carried in-flight publish, select the next round's
+        from the pre-fold belief, then run the lockstep push-pull/sweep
+        tail.  ``key`` is the chain's BASE key."""
+        if self.inflight is None:
+            raise ValueError("pipelined oracle not primed — call "
+                             "prime(key) first")
+        p, t = self.p, self.t
+        k_now = jax.random.fold_in(key, self.round_idx)
+        k_next = jax.random.fold_in(key, self.round_idx + 1)
+        self.round_idx += 1
+        now = self.round_idx * t.round_ticks
+        _k_perturb, _k_peers, k_drop, k_pp = jax.random.split(k_now, 4)
+
+        pre = self.known.copy()
+        dst, svc_idx, msg = self.inflight
+        budget = msg.shape[1]
+
+        # Round r+1's publish, from the pre-fold belief — BEFORE the
+        # deliveries mutate known/sent (its transmit charge may then be
+        # reset by an advancing delivery below, exactly the kernel's
+        # combined-scatter resolution).
+        self.inflight = self._select(k_next, self.round_idx + 1)
+
+        drop = None
+        if p.drop_prob > 0:
+            keep = jax.random.bernoulli(
+                k_drop, 1.0 - p.drop_prob, (p.n, p.fanout, budget))
+            drop = ~np.asarray(keep)
+
+        tb = self.tomb_budget
+        for s in range(p.n):
+            # The in-flight targets were gated with LAST round's
+            # liveness (the stale-by-one selection), but the fold drops
+            # packets from senders dead NOW — expand_deliveries' sender
+            # gate reads the current round's liveness in both modes.
+            send_ok = bool(self.node_alive[s])
+            for f in range(p.fanout):
+                tgt = int(dst[s, f])
+                stale_floor = now - t.stale_ticks
+                rank = 0
+                for b in range(budget):
+                    val = int(msg[s, b])
+                    ts = val >> STATUS_BITS
+                    if ts > 0 and ts < stale_floor:   # staleness gate
+                        continue
+                    if self._too_future(ts, now):     # future bound
+                        continue
+                    sv = int(svc_idx[s, b])
+                    if tb is not None and ts > 0:
+                        own = int(self.owner[min(sv, p.m - 1)]) == s
+                        suspicious = (not own) and (
+                            _st(val) == TOMBSTONE or ts > now)
+                        if suspicious:
+                            rank += 1
+                            if rank > tb:
+                                continue
+                    if not send_ok or not self.node_alive[tgt]:
+                        continue
+                    if drop is not None and drop[s, f, b]:
+                        continue
+                    self.apply_one(tgt, sv, val, pre)
+
+        # Announce re-stamps vs the pre-fold belief (same combined
+        # scatter in the kernel).
+        guard = (t.refresh_rounds * t.round_ticks) // 4
+        for m in range(p.m):
+            o = int(self.owner[m])
+            if not self.node_alive[o]:
+                continue
+            cur = int(pre[o, m])
+            ts, st = _ts(cur), _st(cur)
+            if ts == 0 or st == TOMBSTONE:
+                continue
+            phase = ((m * 2654435761) & 0xFFFFFFFF) % t.refresh_rounds
+            due = (self.round_idx % t.refresh_rounds) == phase \
+                and (now - ts) >= guard
+            if t.suspicion_window > 0 and st == SUSPECT:
+                due, st = True, ALIVE
+            if due:
+                self.apply_one(o, m, _pack(now, st), pre)
+
+        # Lockstep tail: anti-entropy push-pull, then the sweep.
+        if self.round_idx % t.push_pull_rounds == 0:
+            partner = np.asarray(gossip_ops.sample_peers(
+                k_pp, p.n, 1,
+                nbrs=self.sim._nbrs, deg=self.sim._deg,
+                node_alive=jax.numpy.asarray(self.node_alive),
+                cut_mask=self.sim._cut,
+            ))[:, 0]
+            alive = self.node_alive
+            partner = np.where(alive & alive[partner], partner,
+                               np.arange(p.n))
+            self.push_pull(partner, now, None)
+
+        if self.round_idx % t.sweep_rounds == 0:
+            self.sweep(now, None)
 
 
 class ProvenanceOracle:

@@ -40,6 +40,24 @@ GOOD_RESULT = {
                   "closed_loop": True, "evaluations": 21,
                   "grid_points": 64, "eval_ratio": 0.3281,
                   "replay_bit_identical": True},
+    "pipeline": {"n": 4096, "rounds": 60,
+                 "exact": {"lockstep_ms_per_round": 35.6,
+                           "pipelined_ms_per_round": 27.1,
+                           "speedup": 1.31,
+                           "rounds_per_sec_pipelined": 36.9,
+                           "vs_pr5_headline": 1.313},
+                 "compressed": {"lockstep_ms_per_round": 1.3,
+                                "pipelined_ms_per_round": 1.1},
+                 "convergence": {"lockstep_rounds_to_eps": 80,
+                                 "pipelined_rounds_to_eps": 80,
+                                 "rounds_to_eps_ratio": 1.0},
+                 "cadence": {"mixed_periods": [1, 2, 4],
+                             "rounds_to_eps_ratio": 1.25},
+                 "sharded": {"devices": 4, "overlap_ms": 0.4,
+                             "publish_and_merge_coresident": True},
+                 "summary": {"vs_pr5_headline": 1.313,
+                             "rounds_to_eps_ratio": 1.0,
+                             "overlap_ms": 0.4}},
     "query_scale": {"levels": [{"subscribers": 32, "gap_free": True},
                                {"subscribers": 100000,
                                 "gap_free": True}],
@@ -112,6 +130,29 @@ class TestResultRecords:
                       "autopilot.closed_loop"):
             assert any(field in i for i in issues), field
 
+
+    def test_pipeline_honest_nulls_legal(self):
+        # One failing leg nulls itself (benchmarks/pipeline.py) and the
+        # summary headlines it fed; the block must still validate.
+        doc = dict(GOOD_RESULT,
+                   pipeline={"n": 512, "rounds": 60,
+                             "exact": None, "sharded": None,
+                             "summary": {"vs_pr5_headline": None,
+                                         "rounds_to_eps_ratio": None,
+                                         "overlap_ms": None}})
+        assert issues_for(doc) == []
+
+    def test_pipeline_bad_types_flagged(self):
+        doc = dict(GOOD_RESULT,
+                   pipeline={"exact": [1], "cadence": "mixed",
+                             "summary": {"vs_pr5_headline": "1.3x",
+                                         "rounds_to_eps_ratio": True,
+                                         "overlap_ms": {}}})
+        issues = issues_for(doc)
+        for field in ("pipeline.exact", "pipeline.cadence",
+                      "pipeline.summary.vs_pr5_headline",
+                      "pipeline.summary.overlap_ms"):
+            assert any(field in i for i in issues), field
 
     def test_query_scale_honest_nulls_legal(self):
         # A watchdog-cut or baseline-capped soak reports null

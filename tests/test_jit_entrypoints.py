@@ -65,6 +65,27 @@ class TestRepoIsClean:
                 f"{expected} not seen by the checker:\n{names}")
         assert all(d.endswith(" donates") for d in fleet), names
 
+    def test_pipelined_scan_drivers_are_covered(self):
+        """PR 19 satellite: the software-pipelined scan drivers
+        (docs/pipeline.md) carry a second full-size array in the carry
+        — the inflight board — so donation matters MORE there, not
+        less: an undonated pipelined run would triple-buffer.  Pin
+        that the checker SEES them and that all of them donate.  (The
+        sharded families delegate to these programs — twin delegation
+        and inheritance — so the four single-chip drivers are the
+        complete set.)"""
+        drivers = list_drivers(REPO / "sidecar_tpu")
+        pipelined = [d for d in drivers if "_pipelined_jit" in d]
+        names = "\n".join(pipelined)
+        for expected in (
+                "models/compressed.py:_run_pipelined_jit",
+                "models/compressed.py:_run_fast_pipelined_jit",
+                "models/exact.py:_run_pipelined_jit",
+                "models/exact.py:_run_fast_pipelined_jit"):
+            assert any(expected in d for d in pipelined), (
+                f"{expected} not seen by the checker:\n{names}")
+        assert all(d.endswith(" donates") for d in pipelined), names
+
     def test_autopilot_adds_no_new_scan_drivers(self):
         """PR 17 satellite: the autopilot deliberately reuses the
         fleet plane's jitted drivers (FleetSim via

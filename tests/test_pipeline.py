@@ -1,0 +1,518 @@
+"""Software-pipelined rounds + heterogeneous tick cadence
+(docs/pipeline.md): the PR-19 contract suite.
+
+Four load-bearing pins:
+
+* **pipeline=off bit-identity** — the off dispatch calls the UNCHANGED
+  lockstep drivers: a sim constructed with ``pipeline="0"`` (and a
+  static ``tick_period=1``) lowers byte-identical step HLO and runs
+  bit-identical trajectories to a default-constructed sim, on the
+  exact, compressed (xla AND pallas), and both sharded families at
+  d ∈ {1, 2, 4, 8}.
+* **pipelined oracle lockstep** — the ``(state, inflight)`` carry with
+  the honest one-round-stale publish, validated round-for-round
+  against the sequential NumPy ``PipelinedOracleSim``.
+* **chunked == straight** — the pipelined scan drivers resume from a
+  carried inflight bit-identically to an unchunked run (the standing
+  driver contract).
+* **cadence lockstep** — per-node ``tick_period``/``tick_phase`` as a
+  DATA axis: dense == sparse on both single-chip families, single-chip
+  == sharded across mesh widths and board-exchange modes, fleet rows
+  == unbatched staggered twins, and the trace plane's ``ticked_nodes``
+  census.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sidecar_tpu.chaos import ChaosExactSim, FaultPlan
+from sidecar_tpu.fleet import FleetSim, ScenarioBatch, ScenarioSpec
+from sidecar_tpu.models.compressed import CompressedParams, CompressedSim
+from sidecar_tpu.models.exact import ExactSim, SimParams
+from sidecar_tpu.models.timecfg import TimeConfig
+from sidecar_tpu.ops import kernels as kernel_ops
+from sidecar_tpu.ops import pipeline as pipeline_ops
+from sidecar_tpu.ops import topology
+from sidecar_tpu.ops import trace as trace_ops
+from sidecar_tpu.parallel.mesh import make_mesh
+from sidecar_tpu.parallel.sharded import ShardedSim
+from sidecar_tpu.parallel.sharded_compressed import ShardedCompressedSim
+from sidecar_tpu.sim.oracle import OracleSim, PipelinedOracleSim
+
+# Push-pull and sweeps fire inside the horizons used here; refresh
+# pinned far out so trajectories have a fixed convergence target.
+FAST = TimeConfig(refresh_interval_s=1000.0, push_pull_interval_s=2.0,
+                  sweep_interval_s=1.0)
+
+PARAMS = SimParams(n=16, services_per_node=3, fanout=2, budget=6)
+
+
+def exact_sim(**kw):
+    return ExactSim(PARAMS, topology.erdos_renyi(16, avg_degree=4.0,
+                                                 seed=1), FAST, **kw)
+
+
+def comp_sim(n=16, cls=CompressedSim, **kw):
+    p = CompressedParams(n=n, services_per_node=3, fanout=2, budget=6,
+                         cache_lines=16)
+    return cls(p, topology.erdos_renyi(n, avg_degree=4.0, seed=1),
+               FAST, **kw)
+
+
+def mint_burst(sim, n_slots, seed=5):
+    rng = np.random.default_rng(seed)
+    slots = np.sort(rng.choice(sim.p.m, size=n_slots, replace=False))
+    return sim.mint(sim.init_state(), jnp.asarray(slots, jnp.int32), 10)
+
+
+def assert_states_equal(a, b, fields, msg=""):
+    for f in fields:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, f)), np.asarray(getattr(b, f)),
+            err_msg=f"{msg}{f}")
+
+
+EXACT_FIELDS = ("known", "sent", "node_alive", "round_idx")
+COMP_FIELDS = ("own", "cache_slot", "cache_val", "cache_sent", "floor",
+               "node_alive", "round_idx")
+
+# A heterogeneous cadence over 16 nodes: thirds at periods 1/2/4,
+# phases cycling 0..2 — every gate case (always-on, offset, skipping).
+TICK_PERIOD = np.choose(np.arange(16) % 3, [1, 2, 4]).astype(np.int32)
+TICK_PHASE = (np.arange(16) % 3).astype(np.int32)
+
+
+class TestPipelineOffBitIdentity:
+    """``pipeline=off`` (and static ``tick_period=1``) dispatches the
+    UNCHANGED pre-PR programs — lowered HLO text equal, trajectories
+    bit-equal."""
+
+    def test_exact_off_program_identical(self):
+        base, off = exact_sim(), exact_sim(pipeline="0", tick_period=1,
+                                           tick_phase=0)
+        st = base.init_state()
+        key = jax.random.PRNGKey(0)
+        hlo = [jax.jit(s._step).lower(st, key).as_text()
+               for s in (base, off)]
+        assert hlo[0] == hlo[1]
+
+    def test_compressed_off_program_identical(self):
+        base, off = comp_sim(), comp_sim(pipeline="0", tick_period=1,
+                                         tick_phase=0)
+        st = base.init_state()
+        key = jax.random.PRNGKey(0)
+        hlo = [jax.jit(s._step).lower(st, key).as_text()
+               for s in (base, off)]
+        assert hlo[0] == hlo[1]
+
+    def test_exact_off_run_bit_identical(self):
+        base, off = exact_sim(), exact_sim(pipeline="0")
+        key = jax.random.PRNGKey(3)
+        fa, ca = base.run(base.init_state(), key, 12)
+        fb, cb = off.run(off.init_state(), key, 12, pipeline=False)
+        assert_states_equal(fa, fb, EXACT_FIELDS)
+        np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+
+    @pytest.mark.parametrize("mode", ["xla", "pallas"])
+    def test_compressed_off_run_bit_identical(self, monkeypatch, mode):
+        monkeypatch.setenv(kernel_ops.ENV_VAR, mode)
+        base, off = comp_sim(), comp_sim(pipeline="0", tick_period=1)
+        assert base._kernels == mode
+        key = jax.random.PRNGKey(3)
+        fa = base.run_fast(mint_burst(base, 8), key, 12)
+        fb = off.run_fast(mint_burst(off, 8), key, 12, pipeline=False)
+        assert_states_equal(fa, fb, COMP_FIELDS)
+
+    # d=1 (the CPU-client buffer-reuse hazard case) and d=8 stay in
+    # tier-1; the interior widths ride the slow lane.
+    @pytest.mark.parametrize("d", [
+        1, pytest.param(2, marks=pytest.mark.slow),
+        pytest.param(4, marks=pytest.mark.slow), 8])
+    def test_sharded_families_off_bit_identical(self, d):
+        mesh = make_mesh(jax.devices()[:d])
+        key = jax.random.PRNGKey(3)
+        base = ShardedSim(PARAMS, topology.complete(16), FAST,
+                          mesh=mesh)
+        off = ShardedSim(PARAMS, topology.complete(16), FAST,
+                         mesh=mesh, pipeline="0", tick_period=1)
+        # Snapshot run A's fields to host BEFORE run B executes: on the
+        # CPU client a cache-deserialized executable can reclaim run A's
+        # output buffers once run B's donated program runs (the same
+        # buffer-reuse hazard tests/conftest.py works around).
+        fa, _ = base.run(base.init_state(), key, 8)
+        ref = {f: np.asarray(getattr(fa, f)).copy()
+               for f in EXACT_FIELDS}
+        fb, _ = off.run(off.init_state(), key, 8, pipeline=False)
+        for f in EXACT_FIELDS:
+            np.testing.assert_array_equal(
+                ref[f], np.asarray(getattr(fb, f)),
+                err_msg=f"d={d} exact {f}")
+        cbase = comp_sim(cls=ShardedCompressedSim, mesh=mesh)
+        coff = comp_sim(cls=ShardedCompressedSim, mesh=mesh,
+                        pipeline="0", tick_period=1)
+        fa = cbase.run_fast(mint_burst(cbase, 8), key, 8)
+        ref = {f: np.asarray(getattr(fa, f)).copy()
+               for f in COMP_FIELDS}
+        fb = coff.run_fast(mint_burst(coff, 8), key, 8, pipeline=False)
+        for f in COMP_FIELDS:
+            np.testing.assert_array_equal(
+                ref[f], np.asarray(getattr(fb, f)),
+                err_msg=f"d={d} comp {f}")
+
+
+class TestPipelinedOracleLockstep:
+    """The tentpole semantics pin: the pipelined exact round — carried
+    inflight, one-round-stale selection, bump-then-reset transmit
+    charge — matches the sequential NumPy mirror round for round."""
+
+    def _run_both(self, sim, rounds, seed=0):
+        state = sim.init_state()
+        oracle = PipelinedOracleSim(sim, state)
+        key = jax.random.PRNGKey(seed)
+        oracle.prime(key)
+        state, inflight = sim.prime_pipeline(state, key)
+        for i in range(rounds):
+            state, inflight = sim.step_pipelined(state, inflight,
+                                                 key)
+            oracle.step(key)
+            np.testing.assert_array_equal(
+                np.asarray(state.known), oracle.known,
+                err_msg=f"known diverged at round {i + 1}")
+            np.testing.assert_array_equal(
+                np.asarray(state.sent).astype(np.int32), oracle.sent,
+                err_msg=f"sent diverged at round {i + 1}")
+
+    def test_matches_oracle(self):
+        self._run_both(exact_sim(pipeline="1"), rounds=15, seed=42)
+
+    def test_matches_oracle_with_loss(self):
+        sim = ExactSim(
+            SimParams(n=12, services_per_node=2, fanout=2, budget=5,
+                      drop_prob=0.3),
+            topology.complete(12), FAST, pipeline="1")
+        self._run_both(sim, rounds=12, seed=7)
+
+    def test_scan_driver_matches_stepwise(self):
+        """run_pipelined (the scan) == step_pipelined per round — the
+        drivers' fold_in key schedule is the stepwise one."""
+        sim = exact_sim(pipeline="1")
+        key = jax.random.PRNGKey(9)
+        fa, conv, _ = sim.run_pipelined(sim.init_state(), key, 10,
+                                        donate=False)
+        st, inflight = sim.prime_pipeline(sim.init_state(), key)
+        for _ in range(10):
+            st, inflight = sim.step_pipelined(st, inflight, key)
+        assert_states_equal(fa, st, EXACT_FIELDS)
+
+
+class TestChunkedEqualsStraight:
+    def test_exact_pipelined_chunks(self):
+        sim = exact_sim(pipeline="1")
+        key = jax.random.PRNGKey(5)
+        straight, conv, _ = sim.run_pipelined(sim.init_state(), key,
+                                              12, donate=False)
+        st, inflight, curves = sim.init_state(), None, []
+        for c in range(3):
+            st, cv, inflight = sim.run_pipelined(
+                st, key, 4, inflight=inflight, start_round=4 * c)
+            curves.append(np.asarray(cv))
+        assert_states_equal(straight, st, EXACT_FIELDS)
+        np.testing.assert_array_equal(np.asarray(conv),
+                                      np.concatenate(curves))
+
+    def test_compressed_pipelined_chunks(self):
+        sim = comp_sim(pipeline="1")
+        key = jax.random.PRNGKey(5)
+        straight, conv, _ = sim.run_pipelined(
+            mint_burst(sim, 8), key, 12, donate=False)
+        st, inflight, curves = mint_burst(sim, 8), None, []
+        for c in range(3):
+            st, cv, inflight = sim.run_pipelined(
+                st, key, 4, inflight=inflight, start_round=4 * c)
+            curves.append(np.asarray(cv))
+        assert_states_equal(straight, st, COMP_FIELDS)
+        np.testing.assert_array_equal(np.asarray(conv),
+                                      np.concatenate(curves))
+
+
+class TestCadenceLockstep:
+    """tick_period/tick_phase as a data axis: every execution plane
+    agrees on the gated trajectory."""
+
+    def test_exact_cadence_matches_oracle(self):
+        """The staggered oracle twin: OracleSim mirrors the cadence
+        gate through the sim's ``_gate_kw``."""
+        sim = exact_sim(tick_period=TICK_PERIOD, tick_phase=TICK_PHASE)
+        state = sim.init_state()
+        oracle = OracleSim(sim, state)
+        keys = jax.random.split(jax.random.PRNGKey(2), 12)
+        for i in range(12):
+            state = sim.step(state, keys[i])
+            oracle.step(keys[i])
+            np.testing.assert_array_equal(
+                np.asarray(state.known), oracle.known,
+                err_msg=f"known diverged at round {i + 1}")
+
+    def test_exact_dense_equals_sparse(self):
+        sim = exact_sim(tick_period=TICK_PERIOD, tick_phase=TICK_PHASE)
+        key = jax.random.PRNGKey(4)
+        fd, cd = sim.run(sim.init_state(), key, 12, sparse=False)
+        fs, cs = sim.run(sim.init_state(), key, 12, sparse=True)
+        assert_states_equal(fd, fs, EXACT_FIELDS)
+        np.testing.assert_array_equal(np.asarray(cd), np.asarray(cs))
+
+    def test_compressed_dense_equals_sparse(self):
+        sim = comp_sim(tick_period=TICK_PERIOD, tick_phase=TICK_PHASE)
+        key = jax.random.PRNGKey(4)
+        fd = sim.run_fast(mint_burst(sim, 8), key, 12, sparse=False)
+        fs = sim.run_fast(mint_burst(sim, 8), key, 12, sparse=True)
+        assert_states_equal(fd, fs, COMP_FIELDS)
+
+    def test_period_one_vector_matches_baseline(self):
+        """A TRACED all-ones cadence keeps the gate compiled but must
+        be value-identical to the gateless program."""
+        base = exact_sim()
+        vec = exact_sim(tick_period=np.ones(16, np.int32),
+                        tick_phase=np.zeros(16, np.int32))
+        key = jax.random.PRNGKey(6)
+        fa, ca = base.run(base.init_state(), key, 10)
+        fb, cb = vec.run(vec.init_state(), key, 10)
+        assert_states_equal(fa, fb, EXACT_FIELDS)
+        np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+
+    # Tier-1 keeps the mesh-width extremes; the interior widths and the
+    # alternate exchange modes ride the slow lane (the 870 s budget).
+    @pytest.mark.parametrize("d,mode", [
+        (1, "all_gather"), (8, "all_gather"),
+        pytest.param(2, "all_gather", marks=pytest.mark.slow),
+        pytest.param(4, "all_gather", marks=pytest.mark.slow),
+        pytest.param(4, "all_to_all", marks=pytest.mark.slow),
+        pytest.param(4, "ring", marks=pytest.mark.slow)])
+    def test_sharded_compressed_matches_single_chip(self, monkeypatch,
+                                                    d, mode):
+        """Heterogeneous cadence, single-chip == sharded across mesh
+        widths and board-exchange modes — on the deterministic peer
+        rule (tests/test_sharded_compressed.py): random peer draws use
+        per-shard key streams, so bit-exactness is only defined with
+        peers pinned; the cadence gate composes on top."""
+        from tests.test_sharded import det_sample_peers
+        from tests.test_sharded_compressed import (
+            DET, DetShardedCompressedSim, run_lockstep)
+
+        from sidecar_tpu.ops import gossip as gossip_ops
+
+        def det_cadenced(key, n, fanout, **kw):
+            tick_period = kw.pop("tick_period", None)
+            tick_phase = kw.pop("tick_phase", None)
+            round_idx = kw.pop("round_idx", None)
+            kw.pop("stagger", None)
+            kw.pop("stagger_period", None)
+            dst = det_sample_peers(key, n, fanout, **kw)
+            if tick_period is not None:
+                dst = gossip_ops.cadence_gate(
+                    dst, round_idx, tick_period,
+                    0 if tick_phase is None else tick_phase)
+            return dst
+
+        monkeypatch.setattr(gossip_ops, "sample_peers", det_cadenced)
+        params = CompressedParams(n=16, services_per_node=3, fanout=2,
+                                  budget=6, cache_lines=16)
+        single = CompressedSim(params, topology.complete(16), DET,
+                               tick_period=TICK_PERIOD,
+                               tick_phase=TICK_PHASE)
+        sharded = DetShardedCompressedSim(
+            params, topology.complete(16), DET, board_exchange=mode,
+            mesh=make_mesh(jax.devices()[:d]),
+            tick_period=TICK_PERIOD, tick_phase=TICK_PHASE)
+        run_lockstep(single, sharded, rounds=12, mint_at=(0, 5))
+
+    @pytest.mark.parametrize("d", [2, 8])
+    def test_sharded_exact_pipelined_twin_with_cadence(self, d):
+        """Twin delegation (parallel/sharded.py): the sharded exact
+        pipelined run — heterogeneous cadence included — is the
+        single-chip pipelined program on the row-sharded state.  State
+        bitwise; conv allclose (GSPMD reduction order owns the last
+        ulp)."""
+        key = jax.random.PRNGKey(10)
+        single = exact_sim(pipeline="1", tick_period=TICK_PERIOD,
+                           tick_phase=TICK_PHASE)
+        ref, rc, _ = single.run_pipelined(single.init_state(), key, 8)
+        sharded = ShardedSim(PARAMS, topology.erdos_renyi(
+            16, avg_degree=4.0, seed=1), FAST,
+            mesh=make_mesh(jax.devices()[:d]), pipeline="1",
+            tick_period=TICK_PERIOD, tick_phase=TICK_PHASE)
+        got, gc, _ = sharded.run_pipelined(sharded.init_state(), key, 8)
+        assert_states_equal(ref, got, EXACT_FIELDS, msg=f"d={d}: ")
+        np.testing.assert_allclose(np.asarray(rc), np.asarray(gc),
+                                   rtol=1e-6)
+
+    def test_pipeline_composes_with_cadence(self):
+        """Pipelined + cadenced together still matches the pipelined
+        oracle (the gate fires at fold time on the in-flight board)."""
+        sim = exact_sim(pipeline="1", tick_period=TICK_PERIOD,
+                        tick_phase=TICK_PHASE)
+        state = sim.init_state()
+        oracle = PipelinedOracleSim(sim, state)
+        key = jax.random.PRNGKey(13)
+        oracle.prime(key)
+        state, inflight = sim.prime_pipeline(state, key)
+        for i in range(10):
+            state, inflight = sim.step_pipelined(state, inflight,
+                                                 key)
+            oracle.step(key)
+            np.testing.assert_array_equal(
+                np.asarray(state.known), oracle.known,
+                err_msg=f"known diverged at round {i + 1}")
+
+
+class TestCompositionGates:
+    def test_sparse_plus_pipeline_raises(self):
+        sim = comp_sim(pipeline="1")
+        with pytest.raises(ValueError, match="sparse"):
+            sim.run(mint_burst(sim, 8), jax.random.PRNGKey(0), 4,
+                    sparse=True, pipeline=True)
+
+    def test_explicit_request_on_disabled_sim_raises(self):
+        sim = exact_sim(pipeline="0")
+        with pytest.raises(ValueError, match="pipeline"):
+            sim.run(sim.init_state(), jax.random.PRNGKey(0), 4,
+                    pipeline=True)
+
+    def test_env_one_never_arbited_by_auto(self, monkeypatch):
+        """auto NEVER silently opts in (unlike sparse): only env ``1``
+        or an explicit True enters the pipelined round."""
+        monkeypatch.delenv(pipeline_ops.PIPELINE_ENV, raising=False)
+        sim = exact_sim()
+        assert sim._resolve_pipeline_request(None) is False
+
+    def test_chaos_rejects_pipeline(self):
+        sim = ChaosExactSim(PARAMS, topology.complete(16), FAST,
+                            plan=FaultPlan(seed=0))
+        assert sim.supports_pipeline is False
+        with pytest.raises(ValueError, match="pipeline"):
+            sim.run(sim.init_state(), jax.random.PRNGKey(0), 4,
+                    pipeline=True)
+
+    def test_chaos_env_one_degrades_bit_identically(self, monkeypatch):
+        base = ChaosExactSim(PARAMS, topology.complete(16), FAST,
+                             plan=FaultPlan(seed=0))
+        key = jax.random.PRNGKey(1)
+        ref, _ = base.run(base.init_state(), key, 8)
+        monkeypatch.setenv(pipeline_ops.PIPELINE_ENV, "1")
+        degraded = ChaosExactSim(PARAMS, topology.complete(16), FAST,
+                                 plan=FaultPlan(seed=0))
+        got, _ = degraded.run(degraded.init_state(), key, 8)
+        np.testing.assert_array_equal(np.asarray(ref.known),
+                                      np.asarray(got.known))
+
+
+class TestFleetCadence:
+    def test_fleet_rows_match_unbatched_staggered_twins(self):
+        """The /sweep acceptance pin at the fleet level: cadence axes
+        stacked as data, each row bit-identical to the unbatched sim
+        built with that scenario's tick vector."""
+        base_t = TimeConfig(refresh_interval_s=10_000.0,
+                            push_pull_interval_s=2.0)
+        specs = (ScenarioSpec(name="every", seed=1),
+                 ScenarioSpec(name="half", seed=2, tick_period=2),
+                 ScenarioSpec(name="offset", seed=3, tick_period=3,
+                              tick_phase=1))
+        batch = ScenarioBatch.build(specs, PARAMS, base_t,
+                                    family="exact")
+        fleet = FleetSim(batch)
+        run = fleet.run(fleet.init_states(), 20, eps=0.01, stop=False)
+        topo = topology.complete(16)
+        for i, spec in enumerate(specs):
+            tp, tph = batch.scenario_cadence(i)
+            twin = ExactSim(batch.scenario_params(i), topo,
+                            batch.scenario_timecfg(i),
+                            tick_period=tp, tick_phase=tph)
+            final, conv = twin.run(twin.init_state(),
+                                   jax.random.PRNGKey(spec.seed), 20)
+            for name in EXACT_FIELDS:
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(run.final_states, name))[i],
+                    np.asarray(getattr(final, name)),
+                    err_msg=f"{spec.name}: {name}")
+            np.testing.assert_array_equal(run.convergence[:, i],
+                                          np.asarray(conv),
+                                          err_msg=spec.name)
+
+    def test_cadence_validation_named(self):
+        for bad, frag in ((dict(tick_period=0), "tick_period"),
+                          (dict(tick_period=True), "tick_period"),
+                          (dict(tick_phase=-1), "tick_phase")):
+            with pytest.raises(ValueError, match=frag):
+                ScenarioBatch.build(
+                    (ScenarioSpec(name="x", **bad),), PARAMS, FAST,
+                    family="exact")
+
+
+class TestTraceTickedNodes:
+    def test_census_column(self):
+        per = np.asarray([1, 2] * 8, np.int32)
+        pha = np.asarray([0, 1] * 8, np.int32)
+        sim = exact_sim(tick_period=per, tick_phase=pha)
+        _, tr, _ = sim.run_with_trace(sim.init_state(),
+                                      jax.random.PRNGKey(0), 6)
+        col = np.asarray(tr.rec)[:6, trace_ops.TRACE_TICKED_NODES]
+        # Rounds 1..6: even rounds tick all 16, odd rounds only the
+        # period-1 half (phase 1 on the period-2 nodes).
+        np.testing.assert_array_equal(col, [16, 8, 16, 8, 16, 8])
+        summary = trace_ops.summarize(tr)
+        assert summary["ticked_nodes_min"] == 8
+        assert summary["ticked_nodes_last"] == 8
+
+    def test_uniform_cadence_counts_alive(self):
+        sim = exact_sim()
+        _, tr, _ = sim.run_with_trace(sim.init_state(),
+                                      jax.random.PRNGKey(0), 4)
+        col = np.asarray(tr.rec)[:4, trace_ops.TRACE_TICKED_NODES]
+        np.testing.assert_array_equal(col, [16] * 4)
+
+
+class TestBridgeCadenceSweep:
+    def _bridge(self):
+        from tests.test_bridge import CFG, make_state
+
+        from sidecar_tpu.bridge import SimBridge
+        return SimBridge(make_state(), CFG)
+
+    def test_sweep_over_tick_period(self):
+        doc = self._bridge().sweep(
+            axes={"tick_period": [1, 2]}, rounds=20, eps=0.05, n=12,
+            services_per_node=2, budget=5, provenance=0)
+        assert doc["points"] == 2
+        periods = sorted(row["config"]["tick_period"]
+                         for row in doc["table"])
+        assert periods == [1, 2]
+        assert doc["pareto_front"]
+
+    def test_malformed_cadence_is_400(self):
+        from sidecar_tpu.bridge import serve_bridge
+
+        server = serve_bridge(self._bridge(), port=0)
+        try:
+            port = server.server_address[1]
+            for axes in ({"tick_period": [0]},
+                         {"tick_period": [1.5]},
+                         {"tick_phase": [-1]}):
+                body = json.dumps({
+                    "axes": axes, "rounds": 10, "n": 12,
+                    "services_per_node": 2, "budget": 5}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/sweep", data=body,
+                    headers={"Content-Type": "application/json"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 400
+                doc = json.loads(err.value.read())
+                assert "docs/pipeline.md" in doc["message"]
+        finally:
+            server.shutdown()

@@ -54,6 +54,7 @@ KNOWN_RESULT_BLOCKS = {
     "coherence": dict,
     "antientropy": dict,
     "autopilot": dict,
+    "pipeline": dict,
     "cost": dict,
     "regression": dict,
     "telemetry": dict,
@@ -159,6 +160,29 @@ def validate_result(doc: dict, issues: List[str],
                 issues.append(
                     f"{ctx}: antientropy.{key} is neither "
                     "null nor a number")
+    if isinstance(doc.get("pipeline"), dict):
+        pl = doc["pipeline"]
+        # Per-family legs may be null (one failing leg must not sink
+        # the block — benchmarks/pipeline.py) but never a non-object.
+        for key in ("exact", "compressed", "convergence", "cadence",
+                    "sharded", "summary"):
+            if key in pl and pl[key] is not None \
+                    and not isinstance(pl[key], dict):
+                issues.append(
+                    f"{ctx}: pipeline.{key} is neither null nor an "
+                    "object")
+        # The acceptance headlines ride in summary: each is null (an
+        # honest non-result — the leg failed or a denominator was
+        # missing) or a number; anything else is a schema break.
+        summary = pl.get("summary")
+        if isinstance(summary, dict):
+            for key in ("vs_pr5_headline", "rounds_to_eps_ratio",
+                        "overlap_ms"):
+                val = summary.get(key)
+                if val is not None and not isinstance(val, NUMBER):
+                    issues.append(
+                        f"{ctx}: pipeline.summary.{key} is neither "
+                        "null nor a number")
     if isinstance(doc.get("autopilot"), dict):
         ap = doc["autopilot"]
         for key in ("fit", "recommended"):
